@@ -1,0 +1,118 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+A from-scratch rebuild of the capabilities of Horovod (reference:
+gangiswag/horovod v0.20.3) designed for TPU hardware: collectives compile
+into XLA programs over the ICI mesh via ``jax.shard_map``/``pjit`` instead of
+running through a background NCCL/MPI thread; the host-side control plane
+(launcher, rendezvous, elastic driver, eager collectives) mirrors the
+reference's coordinator architecture.
+
+Quick start (the reference's README recipe, TPU-style)::
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    mesh = hvd.mesh()
+
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01 * hvd.size()))
+
+    @jax.jit
+    def train_step(params, opt_state, batch):
+        def spmd(params, batch):
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # grads are allreduced inside the optimizer update:
+            updates, new_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_state, loss
+        return jax.shard_map(spmd, mesh=mesh,
+                             in_specs=(P(), hvd.data_pspec()),
+                             out_specs=(P(), P(), P()))(params, batch)
+
+API surface parity map (reference file → here):
+  basics.py hvd.init/rank/size/...    → common/basics.py
+  mpi_ops allreduce/allgather/...     → ops/collective_ops.py
+  compression.py                      → ops/compression.py
+  adasum (common/ops/adasum)          → ops/adasum.py
+  tensor fusion (fusion_buffer)       → ops/fusion.py
+  DistributedOptimizer                → parallel/optimizer.py
+  DistributedGradientTape             → parallel/tape.py
+  broadcast_variables/object          → parallel/functions.py
+  SyncBatchNorm                       → parallel/sync_batch_norm.py
+  elastic State/run                   → elastic/
+  horovodrun launcher                 → runner/
+"""
+
+from .common.basics import (  # noqa: F401
+    CROSS_AXIS,
+    HVD_AXES,
+    LOCAL_AXIS,
+    cross_rank,
+    cross_size,
+    data_sharding,
+    in_hvd_context,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_batch_size,
+    local_rank,
+    local_size,
+    mesh,
+    mpi_threads_supported,
+    rank,
+    replicated_sharding,
+    shutdown,
+    size,
+)
+from .common.exceptions import (  # noqa: F401
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from .ops.collective_ops import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    ReduceOp,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_async,
+    alltoall,
+    alltoall_async,
+    barrier,
+    broadcast,
+    broadcast_async,
+    grouped_allreduce,
+    join,
+    poll,
+    synchronize,
+)
+from .ops.compression import Compression  # noqa: F401
+from .ops.fusion import allreduce_pytree  # noqa: F401
+from .parallel.functions import (  # noqa: F401
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+    broadcast_variables,
+)
+from .parallel.optimizer import DistributedOptimizer  # noqa: F401
+from .parallel.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from .parallel.tape import (  # noqa: F401
+    DistributedGradientTape,
+    allreduce_gradients,
+    grad,
+    value_and_grad,
+)
+from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
+
+from jax.sharding import PartitionSpec as _P
+
+
+def data_pspec(*extra):
+    """PartitionSpec splitting the leading (batch) dim over all ranks."""
+    return _P(HVD_AXES, *extra)
+
+
+__version__ = "0.1.0"

@@ -1,0 +1,285 @@
+"""Core world state: init/shutdown, device mesh, rank/size queries.
+
+Reference surface: ``HorovodBasics`` (horovod/common/basics.py:22-258) backed
+by the C ABI ``horovod_init/rank/size/local_rank/...`` (operations.cc:685-889).
+
+TPU-native redesign
+-------------------
+The reference runs **one process per GPU**; a rank is a process. On TPU the
+idiomatic unit is **one process per host, one rank per chip**, with all chips
+of a job joined in a single :class:`jax.sharding.Mesh` (single-controller
+SPMD). We therefore keep Horovod's three-level world vocabulary but map it
+onto the mesh:
+
+====================  =============================================
+Horovod concept        horovod_tpu mapping
+====================  =============================================
+rank                  global chip index (``hvd_cross * local_size + hvd_local``)
+local_rank            chip index within this host (mesh axis ``hvd_local``)
+cross_rank            host/process index (mesh axis ``hvd_cross``)
+size                  total chips in the mesh
+local_size            chips per host
+cross_size            number of hosts
+====================  =============================================
+
+The mesh is always 2-D ``(hvd_cross, hvd_local)`` so hierarchical collectives
+(intra-host over ICI, cross-host over DCN) fall out of the axis structure the
+same way the reference splits ``local_comm``/``cross_comm``
+(mpi_context.h:78-84, nccl_operations.cc:190-380).
+
+``rank()``/``local_rank()``/``cross_rank()`` are **context sensitive**: inside
+a ``jax.shard_map`` over the Horovod mesh they return the traced per-chip
+index (so model code like ``if hvd.rank() == 0`` compiles to a per-device
+predicate, matching the per-process value a reference user would see); in
+eager host code they return the index of this process's *leader chip*.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from . import config as _config
+from .exceptions import NotInitializedError
+
+# Mesh axis names. The pair mirrors the reference's local/cross communicator
+# split (mpi_context.h:78-84). ``HVD_AXES`` is the flat "world" axis tuple —
+# psum over it is the reference's flat ring allreduce.
+CROSS_AXIS = "hvd_cross"
+LOCAL_AXIS = "hvd_local"
+HVD_AXES: Tuple[str, str] = (CROSS_AXIS, LOCAL_AXIS)
+
+
+class _State:
+    """Process-global framework state (reference: HorovodGlobalState,
+    global_state.h:42-122 — minus the background-thread machinery, which on
+    TPU lives in the native controller, see horovod_tpu/cc/)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self.initialized = False
+        self.config: Optional[_config.Config] = None
+        self.mesh: Optional[Mesh] = None
+        self.process_index: int = 0
+        self.process_count: int = 1
+        self.local_device_count: int = 0
+        self.timeline = None  # utils.timeline.Timeline, attached lazily
+        self.controller = None  # runtime controller client (eager path)
+        self.joined = False
+
+
+_state = _State()
+
+
+def _build_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Arrange all job devices into the 2-D (cross, local) Horovod mesh.
+
+    Devices are ordered host-major so that chips on the same host are
+    contiguous along ``hvd_local`` — the layout that keeps ``hvd_local``
+    collectives on ICI and only ``hvd_cross`` traffic on DCN (the analogue of
+    the reference packing ranks host-by-host, hosts.py:100-150).
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_proc = max(1, jax.process_count())
+    per_proc = len(devices) // n_proc if n_proc > 1 else len(devices)
+    if n_proc > 1 and per_proc * n_proc == len(devices):
+        # Host-major ordering: sort by (process_index, id).
+        devices.sort(key=lambda d: (d.process_index, d.id))
+        grid = np.array(devices, dtype=object).reshape(n_proc, per_proc)
+    else:
+        grid = np.array(devices, dtype=object).reshape(1, len(devices))
+    return Mesh(grid, HVD_AXES)
+
+
+def init(
+    comm=None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> None:
+    """Initialize the framework (reference: hvd.init(), basics.py:33 →
+    InitializeHorovodOnce, operations.cc:628-674).
+
+    Unlike the reference there is no background communication thread to spawn
+    for the compiled path: collectives are compiled *into* the XLA program
+    over the ICI mesh. What init does:
+
+    1. read env knobs into an immutable :class:`Config`;
+    2. build the global 2-D device mesh;
+    3. (multi-host) assume ``jax.distributed.initialize`` was already called
+       by the launcher (runner/), mirroring the launcher-injected
+       ``HOROVOD_RANK/SIZE`` env contract (gloo_run.py:65-76);
+    4. start the timeline if ``HOROVOD_TIMELINE`` is set.
+
+    ``comm`` is accepted for API parity with the reference (an MPI
+    communicator there) and must be ``None`` or a device list.
+    """
+    with _state.lock:
+        if _state.initialized:
+            return
+        if comm is not None and devices is None:
+            devices = comm  # parity: allow init(devices)
+        _state.config = _config.from_env()
+        _state.mesh = _build_mesh(devices)
+        _state.process_index = jax.process_index()
+        _state.process_count = jax.process_count()
+        _state.local_device_count = _state.mesh.devices.shape[1]
+        if _state.config.timeline:
+            from ..utils.timeline import Timeline
+
+            _state.timeline = Timeline(_state.config.timeline,
+                                       mark_cycles=_state.config.timeline_mark_cycles)
+        _state.initialized = True
+
+
+def shutdown() -> None:
+    """Tear down framework state (reference: horovod_shutdown,
+    operations.cc:676-683). Safe to call multiple times; init() can be called
+    again afterwards (the elastic reset path relies on this,
+    common/elastic.py:147-168)."""
+    with _state.lock:
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        if _state.controller is not None:
+            _state.controller.close()
+            _state.controller = None
+        _state.initialized = False
+        _state.mesh = None
+        _state.config = None
+        _state.joined = False
+
+
+atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    """Reference: horovod_is_initialized (operations.cc:759)."""
+    return _state.initialized
+
+
+def _require_init() -> _State:
+    if not _state.initialized:
+        raise NotInitializedError()
+    return _state
+
+
+def mesh() -> Mesh:
+    """The global 2-D ``(hvd_cross, hvd_local)`` device mesh."""
+    return _require_init().mesh
+
+
+def config() -> _config.Config:
+    return _require_init().config
+
+
+def timeline():
+    return _require_init().timeline
+
+
+def _bound_axes() -> frozenset:
+    """Names of mesh axes bound in the current trace (inside shard_map)."""
+    try:
+        from jax._src.core import get_axis_env
+
+        return frozenset(get_axis_env().axis_sizes)
+    except Exception:  # pragma: no cover - private-API drift fallback
+        bound = set()
+        for name in HVD_AXES:
+            try:
+                jax.lax.axis_index(name)
+                bound.add(name)
+            except NameError:
+                pass
+        return frozenset(bound)
+
+
+def in_hvd_context() -> bool:
+    """True when tracing under shard_map over the Horovod mesh axes."""
+    bound = _bound_axes()
+    return CROSS_AXIS in bound or LOCAL_AXIS in bound
+
+
+def size() -> int:
+    """Total number of ranks (= chips). Reference: horovod_size
+    (operations.cc:795)."""
+    s = _require_init()
+    return int(s.mesh.devices.size)
+
+
+def local_size() -> int:
+    """Chips on this host. Reference: horovod_local_size (operations.cc:787)."""
+    return _require_init().local_device_count
+
+
+def cross_size() -> int:
+    """Number of hosts. Reference: horovod_cross_size (operations.cc:817)."""
+    return int(_require_init().mesh.devices.shape[0])
+
+
+def rank():
+    """Global rank. Traced per-chip inside shard_map; leader-chip rank in
+    eager code. Reference: horovod_rank (operations.cc:771)."""
+    s = _require_init()
+    if in_hvd_context():
+        return jax.lax.axis_index(HVD_AXES)
+    return s.process_index * s.local_device_count
+
+
+def local_rank():
+    """Rank within the host. Reference: horovod_local_rank
+    (operations.cc:779)."""
+    _require_init()
+    if in_hvd_context():
+        return jax.lax.axis_index(LOCAL_AXIS)
+    return 0
+
+
+def cross_rank():
+    """Host index. Reference: horovod_cross_rank (operations.cc:809)."""
+    s = _require_init()
+    if in_hvd_context():
+        return jax.lax.axis_index(CROSS_AXIS)
+    return s.process_index
+
+
+def is_homogeneous() -> bool:
+    """True when every host has the same number of chips (always true for a
+    well-formed mesh). Reference: horovod_is_homogeneous (operations.cc:825)."""
+    _require_init()
+    return True
+
+
+def mpi_threads_supported() -> bool:
+    """Parity stub (reference: horovod_mpi_threads_supported,
+    operations.cc:833). The compiled-collective path has no MPI; the eager
+    control plane is thread-safe, so report True."""
+    _require_init()
+    return True
+
+
+# --- convenience sharding helpers -----------------------------------------
+
+
+def data_sharding(extra: Sequence[Optional[str]] = ()) -> NamedSharding:
+    """NamedSharding that splits the leading (batch) dim over all ranks."""
+    return NamedSharding(mesh(), PartitionSpec(HVD_AXES, *extra))
+
+
+def replicated_sharding() -> NamedSharding:
+    """NamedSharding that replicates a value on every rank."""
+    return NamedSharding(mesh(), PartitionSpec())
+
+
+def local_batch_size(global_batch: int) -> int:
+    n = size()
+    if global_batch % n != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by world size {n}"
+        )
+    return global_batch // n

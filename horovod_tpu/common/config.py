@@ -1,0 +1,157 @@
+"""Environment-variable configuration knobs.
+
+The reference converges three config layers (env vars, CLI flags, YAML) onto
+environment variables consumed by the native core at init time
+(operations.cc:416-518, knob names common.h:64-90, config_parser.py). We keep
+the same knob names with a ``HOROVOD_`` prefix so reference users can carry
+their tuning over, and read them once at :func:`horovod_tpu.init` into a
+typed, immutable :class:`Config`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {v!r}")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(f"{name} must be a float, got {v!r}")
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None or v == "":
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_str(name: str, default: Optional[str]) -> Optional[str]:
+    v = os.environ.get(name)
+    return default if v in (None, "") else v
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    """Runtime knobs, mirroring the reference's env contract.
+
+    Defaults match the reference where a reference default exists
+    (fusion threshold 64 MiB and cycle time 5 ms: operations.cc:437,445;
+    cache capacity 1024: operations.cc:452-461; stall warning 60 s:
+    stall_inspector.h:36-66).
+    """
+
+    # --- tensor fusion (operations.cc:437; controller.cc:360-378) ---
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    cycle_time_ms: float = 5.0
+
+    # --- response cache (operations.cc:452-461) ---
+    cache_capacity: int = 1024
+
+    # --- hierarchical collectives (operations.cc:463-487) ---
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+
+    # --- autotune (common.h:68-73) ---
+    autotune: bool = False
+    autotune_log: Optional[str] = None
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # --- timeline (operations.cc:420-434) ---
+    timeline: Optional[str] = None
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector (stall_inspector.h:36-66) ---
+    stall_check_disable: bool = False
+    stall_warning_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+
+    # --- logging ---
+    log_level: str = "warning"
+    log_hide_timestamp: bool = False
+
+    # --- elastic (launcher-injected; gloo_run.py:65-76) ---
+    elastic: bool = False
+
+    # --- launcher-injected world description (gloo_run.py:65-76) ---
+    rank: Optional[int] = None
+    size: Optional[int] = None
+    local_rank: Optional[int] = None
+    local_size: Optional[int] = None
+    cross_rank: Optional[int] = None
+    cross_size: Optional[int] = None
+    rendezvous_addr: Optional[str] = None
+    rendezvous_port: Optional[int] = None
+
+    # --- controller transport (env_parser.h:26-32 analogue) ---
+    controller: str = "tcp"  # "tcp" (rank-0 coordinator over sockets) | "none"
+    cpu_operations: str = "ring"  # CPU eager data plane: "ring" | "naive"
+
+    # --- number of independent collective streams (HOROVOD_NUM_NCCL_STREAMS) ---
+    num_streams: int = 1
+
+
+def from_env() -> Config:
+    """Read all knobs from the environment (reference: operations.cc:416-518)."""
+    return Config(
+        fusion_threshold_bytes=_env_int("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024),
+        cycle_time_ms=_env_float("HOROVOD_CYCLE_TIME", 5.0),
+        cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
+        hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE", False),
+        hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER", False),
+        autotune=_env_bool("HOROVOD_AUTOTUNE", False),
+        autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
+        autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),
+        autotune_steps_per_sample=_env_int("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10),
+        autotune_bayes_opt_max_samples=_env_int(
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 20
+        ),
+        autotune_gaussian_process_noise=_env_float(
+            "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8
+        ),
+        timeline=_env_str("HOROVOD_TIMELINE", None),
+        timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
+        stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE", False),
+        stall_warning_time_seconds=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
+        stall_shutdown_time_seconds=_env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0
+        ),
+        log_level=_env_str("HOROVOD_LOG_LEVEL", "warning") or "warning",
+        log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME", False),
+        elastic=_env_bool("HOROVOD_ELASTIC", False),
+        rank=_opt_int("HOROVOD_RANK"),
+        size=_opt_int("HOROVOD_SIZE"),
+        local_rank=_opt_int("HOROVOD_LOCAL_RANK"),
+        local_size=_opt_int("HOROVOD_LOCAL_SIZE"),
+        cross_rank=_opt_int("HOROVOD_CROSS_RANK"),
+        cross_size=_opt_int("HOROVOD_CROSS_SIZE"),
+        rendezvous_addr=_env_str("HOROVOD_GLOO_RENDEZVOUS_ADDR", None),
+        rendezvous_port=_opt_int("HOROVOD_GLOO_RENDEZVOUS_PORT"),
+        controller=_env_str("HOROVOD_CONTROLLER", "tcp") or "tcp",
+        cpu_operations=_env_str("HOROVOD_CPU_OPERATIONS", "ring") or "ring",
+        num_streams=_env_int("HOROVOD_NUM_STREAMS", 1),
+    )
+
+
+def _opt_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return None if v in (None, "") else int(v)

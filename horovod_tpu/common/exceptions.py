@@ -1,0 +1,56 @@
+"""Exception hierarchy for horovod_tpu.
+
+Parity with the reference's ``horovod/common/exceptions.py``:
+``HorovodInternalError`` aborts the current step and (under elastic) rolls
+back to the last committed state; ``HostsUpdatedInterrupt`` signals a world
+change without failure (reference: horovod/common/exceptions.py:19-33,
+horovod/common/elastic.py:147-168).
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error in a collective or the runtime.
+
+    Under :func:`horovod_tpu.elastic.run` this triggers state restore and a
+    re-initialization with the current world.
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Raised between steps when the host set changed (elastic mode).
+
+    ``skip_sync`` mirrors the reference (common/exceptions.py:28-33): when the
+    update was caused by a failure the new state must be restored, not synced.
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(HorovodTpuError):
+    """An API requiring ``hvd.init()`` was called before initialization."""
+
+    def __init__(self, what: str = "Horovod-TPU"):
+        super().__init__(
+            f"{what} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class DuplicateTensorNameError(HorovodTpuError):
+    """Two in-flight collectives used the same tensor name.
+
+    Reference: DUPLICATE_NAME_ERROR, horovod/common/common.h:163.
+    """
+
+
+class TensorShapeMismatchError(HorovodTpuError):
+    """Ranks disagreed on shape/dtype/op for a named collective.
+
+    Reference: the coordinator's cross-rank consistency checks in
+    ``Controller::ConstructResponse`` (controller.cc:380-657).
+    """

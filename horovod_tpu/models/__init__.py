@@ -1,0 +1,12 @@
+"""Model zoo for benchmarks and examples (reference benchmarks use
+tf.keras.applications ResNet50 et al., docs/benchmarks.rst)."""
+
+from .mnist import MnistNet  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)

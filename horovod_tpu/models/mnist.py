@@ -1,0 +1,29 @@
+"""Small MNIST convnet — the reference's smoke-test model
+(examples/tensorflow2/tensorflow2_mnist.py, examples/pytorch/pytorch_mnist.py:
+two convs + two dense layers)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistNet(nn.Module):
+    """Conv(32) → Conv(64) → maxpool → Dense(128) → Dense(10), matching the
+    shape of the reference example models."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(32, (3, 3), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), dtype=self.dtype)(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x

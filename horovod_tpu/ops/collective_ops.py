@@ -1,0 +1,528 @@
+"""Collective operations: allreduce / allgather / broadcast / alltoall / join.
+
+Reference surface: the op set of ``horovod/common/message.h:50-52``
+(ALLREDUCE, ALLGATHER, BROADCAST, JOIN, ADASUM, ALLTOALL) exposed per
+framework as ``hvd.allreduce/allgather/broadcast/alltoall``
+(torch/mpi_ops.py:130-646, tensorflow/mpi_ops.py).
+
+TPU-native redesign
+-------------------
+The reference executes every collective from a background thread through
+NCCL/MPI/Gloo after a rank-0 negotiation round (operations.cc:571-624).  On
+TPU the fast path is the opposite: collectives are **compiled into the XLA
+program** over the ICI mesh, where XLA schedules and fuses them with compute.
+So each op here has two modes, selected automatically:
+
+* **compiled (in-jit)** — when tracing under ``jax.shard_map`` over the
+  Horovod mesh axes, ops lower straight to ``lax.psum`` / ``lax.all_gather``
+  / ``lax.all_to_all`` / masked-``psum`` broadcast.  This is the analogue of
+  the reference's NCCL ops (nccl_operations.cc), with XLA playing the role of
+  the fusion buffer and stream scheduler.
+* **eager (host)** — outside jit, ops run over the *process world* (one
+  participant per host), matching how a reference user would allreduce a
+  metric or broadcast an object outside the training graph. Data rides a
+  cached one-op jit program over the leader chips.
+
+Hierarchical allreduce (reference: NCCLHierarchicalAllreduce,
+nccl_operations.cc:190-380) decomposes into intra-host ``psum_scatter`` (ICI)
+→ cross-host ``psum`` (DCN) → intra-host ``all_gather`` (ICI), enabled by
+``HOROVOD_HIERARCHICAL_ALLREDUCE`` or per-call.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..common import basics
+from ..common.basics import CROSS_AXIS, HVD_AXES, LOCAL_AXIS
+from ..common.exceptions import DuplicateTensorNameError
+from .compression import Compression
+
+
+class ReduceOp(enum.IntEnum):
+    """Reduction ops (reference: torch/mpi_ops.py:48-56 — Average, Sum,
+    Adasum; plus Min/Max/Product which XLA gives us for free)."""
+
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+# Reference-style aliases (hvd.Average / hvd.Sum / hvd.Adasum).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def _hvd_axes_in_trace() -> Tuple[str, ...]:
+    """Horovod mesh axes bound in the current trace, in (cross, local) order."""
+    bound = basics._bound_axes()
+    return tuple(a for a in HVD_AXES if a in bound)
+
+
+def _resolve_axes(axes) -> Tuple[str, ...]:
+    if axes is None:
+        return _hvd_axes_in_trace()
+    if isinstance(axes, str):
+        return (axes,)
+    return tuple(axes)
+
+
+def _world_size(axes: Tuple[str, ...]):
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    return n
+
+
+def _vma(x) -> frozenset:
+    """Varying-manual-axes of ``x``: which mesh axes the value differs
+    across. JAX tracks this in the aval; an empty set means the value is
+    provably identical on every device."""
+    try:
+        return frozenset(jax.typeof(x).vma)
+    except Exception:  # pragma: no cover - non-traced / API drift
+        return frozenset()
+
+
+def _is_replicated(x, axes: Tuple[str, ...]) -> bool:
+    return not (set(axes) & _vma(x))
+
+
+def _scale(tensor, factor):
+    """Pre/post scaling (reference: prescale/postscale in message.h:48-113 and
+    the ScaleBuffer CUDA kernel, ops/cuda/cuda_kernels.cu:128). On TPU this is
+    a fused elementwise multiply XLA folds into the surrounding program."""
+    if factor is None or factor == 1.0:
+        return tensor
+    if jnp.issubdtype(tensor.dtype, jnp.integer):
+        return (tensor * factor).astype(tensor.dtype)
+    return tensor * jnp.asarray(factor, dtype=tensor.dtype)
+
+
+def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
+    """Hierarchical allreduce: intra-host reduce-scatter → cross-host
+    allreduce → intra-host allgather (reference algorithm:
+    nccl_operations.cc:190-380, including the non-divisible remainder handled
+    separately — here via the flat-psum fallback, matching the reference's
+    root reduce/bcast remainder leg at nccl_operations.cc:244-307)."""
+    nl = lax.axis_size(local_axis)
+    if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
+        shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
+        shard = lax.psum(shard, cross_axis)
+        # Final allgather leg, expressed as a psum of disjointly-placed
+        # shards: numerically identical to lax.all_gather but the result is
+        # provably replicated for the sharding checker (all_gather output is
+        # conservatively treated as device-varying). Note the flat psum
+        # below is usually optimal on TPU — XLA already decomposes a global
+        # AllReduce over ICI/DCN — so hierarchical mode is a tuning knob for
+        # multi-slice topologies, as in the reference (operations.cc:475-487).
+        li = lax.axis_index(local_axis)
+        # Fresh zeros (not zeros_like(x)) so the buffer doesn't inherit x's
+        # cross-axis varying mark — shard is already cross-reduced.
+        full = jnp.zeros(x.shape, x.dtype)
+        full = lax.dynamic_update_slice_in_dim(
+            full, shard, li * shard.shape[0], 0)
+        return lax.psum(full, local_axis)
+    return lax.psum(x, (cross_axis, local_axis))
+
+
+def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],
+                       presummed: bool):
+    """Allreduce semantics for an input that is provably identical on every
+    rank (VMA-invariant) — no collective needed.
+
+    Two interpretations exist and the caller picks via ``presummed``:
+
+    * ``presummed=False`` (direct ``hvd.allreduce`` calls): every rank holds
+      the same value, so Sum → N·x, Average/Min/Max → x, Product → x^N —
+      exactly what the wire collective would return on equal inputs.
+    * ``presummed=True`` (gradient paths: DistributedOptimizer, tape): under
+      ``jax.shard_map``, autodiff *auto-psums* gradients of replicated
+      parameters, so an invariant gradient is already the cross-rank SUM of
+      local gradients. Horovod-Average then only needs the ÷N; Horovod-Sum
+      is the identity. Without this, wrapping a plain ``jax.grad`` step in
+      DistributedOptimizer would double-count by a factor of N.
+    """
+    n = _world_size(axes)
+    if presummed:
+        if op in (ReduceOp.SUM, ReduceOp.ADASUM):
+            return x
+        if op == ReduceOp.AVERAGE:
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return x // n
+            return x / jnp.asarray(n, dtype=x.dtype)
+        raise ValueError(
+            f"op {op} is not meaningful for pre-reduced gradients")
+    if op == ReduceOp.SUM:
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return x * n
+        return x * jnp.asarray(n, dtype=x.dtype)
+    if op in (ReduceOp.AVERAGE, ReduceOp.MIN, ReduceOp.MAX, ReduceOp.ADASUM):
+        return x  # equal contributions: avg/min/max/adasum are the identity
+    if op == ReduceOp.PRODUCT:
+        return x ** n
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...], hierarchical: bool):
+    if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
+        if hierarchical and set(axes) == set(HVD_AXES):
+            red = _psum_hierarchical(x)
+        else:
+            red = lax.psum(x, axes)
+        if op == ReduceOp.AVERAGE:
+            n = _world_size(axes)
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                red = red // n
+            else:
+                red = red / jnp.asarray(n, dtype=red.dtype)
+        return red
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axes)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axes)
+    if op == ReduceOp.PRODUCT:
+        # XLA has no pprod; exp/log is lossy, so gather + local reduce. The
+        # closing pmax over identical values re-establishes replication for
+        # the sharding checker at negligible extra cost.
+        g = lax.all_gather(x, axes, axis=0, tiled=False)
+        return lax.pmax(jnp.prod(g, axis=0), axes)
+    raise ValueError(f"unsupported reduce op {op}")
+
+
+def allreduce(
+    tensor,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=Compression.none,
+    name: Optional[str] = None,
+    axes=None,
+    hierarchical: Optional[bool] = None,
+    _presummed: bool = False,
+):
+    """Allreduce ``tensor`` across all ranks.
+
+    Reference: hvd.allreduce (tensorflow/__init__.py:53-153,
+    torch/mpi_ops.py:163-228). ``op=Average`` divides the sum by world size;
+    ``op=Adasum`` uses the adaptive-summation reduction (see ops/adasum.py).
+    ``compression`` casts to a 16-bit wire format around the reduction
+    (prefer ``Compression.bf16`` on TPU).
+
+    If ``tensor`` is provably replicated across the requested mesh axes
+    (VMA-invariant), no collective is emitted — see
+    :func:`_reduce_replicated`. ``_presummed`` is set by the gradient paths
+    (optimizer/tape) to mark that an invariant input is an autodiff-summed
+    gradient rather than an equal per-rank contribution.
+    """
+    tensor = jnp.asarray(tensor)
+    axes_t = _resolve_axes(axes)
+    if op == ReduceOp.ADASUM and not (
+            axes_t and _is_replicated(tensor, axes_t)):
+        from . import adasum as _adasum
+
+        return _adasum.adasum_allreduce(
+            tensor, axes=axes, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, compression=compression)
+
+    tensor = _scale(tensor, prescale_factor)
+    compressed, ctx = compression.compress(tensor)
+    if axes_t:
+        if _is_replicated(compressed, axes_t):
+            red = _reduce_replicated(compressed, op, axes_t, _presummed)
+        else:
+            if hierarchical is None:
+                hierarchical = (
+                    basics.is_initialized()
+                    and basics.config().hierarchical_allreduce
+                )
+            red = _reduce_in_jit(compressed, op, axes_t, bool(hierarchical))
+    else:
+        red = _eager_allreduce(compressed, op)
+    red = compression.decompress(red, ctx)
+    return _scale(red, postscale_factor)
+
+
+def grouped_allreduce(tensors: Sequence, **kwargs):
+    """Allreduce a list of tensors as one logical group (reference:
+    grouped allreduce added for torch in mpi_ops.py; the fusion analogue).
+    Under jit, XLA fuses the per-tensor psums; for stronger guarantees use
+    :mod:`horovod_tpu.ops.fusion` which packs one flat buffer per dtype."""
+    return [allreduce(t, **kwargs) for t in tensors]
+
+
+def allgather(tensor, *, name: Optional[str] = None, axes=None):
+    """Gather tensors from all ranks, concatenated along dim 0.
+
+    Reference: hvd.allgather (torch/mpi_ops.py:230-291). The reference
+    supports ragged first dims via the coordinator's size exchange; under XLA
+    shapes are static, so in-jit all shards must share a shape — ragged
+    gathers belong on the eager path (allgather_object in
+    parallel/functions.py covers the reference's ragged use cases).
+    """
+    tensor = jnp.asarray(tensor)
+    axes_t = _resolve_axes(axes)
+    if axes_t:
+        if _is_replicated(tensor, axes_t):
+            # Equal contribution from every rank: the gather is a local tile.
+            reps = (_world_size(axes_t),) + (1,) * (tensor.ndim - 1)
+            return jnp.tile(tensor, reps)
+        return lax.all_gather(tensor, axes_t, axis=0, tiled=True)
+    return _eager_allgather(tensor)
+
+
+def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
+              axes=None):
+    """Broadcast ``tensor`` from ``root_rank`` to all ranks.
+
+    Reference: hvd.broadcast (torch/mpi_ops.py:293-344). On TPU this lowers
+    to the native CollectiveBroadcast HLO (``lax.pbroadcast``); on backends
+    without that lowering it falls back to a masked ``psum`` (one
+    collective, no size× gather blow-up): every rank contributes zeros
+    except the root.
+    """
+    tensor = jnp.asarray(tensor)
+    axes_t = _resolve_axes(axes)
+    if not axes_t:
+        return _eager_broadcast(tensor, root_rank)
+    if _is_replicated(tensor, axes_t):
+        return tensor  # already equal everywhere: nothing to move
+    wire = tensor
+    bool_in = wire.dtype == jnp.bool_
+    if bool_in:
+        wire = wire.astype(jnp.uint8)
+
+    def _native(w):
+        return lax.pbroadcast(w, axes_t, root_rank)
+
+    def _masked(w):
+        mask = (lax.axis_index(axes_t) == root_rank).astype(w.dtype)
+        return lax.psum(w * mask, axes_t)
+
+    out = lax.platform_dependent(wire, tpu=_native, default=_masked)
+    if bool_in:
+        out = out.astype(jnp.bool_)
+    return out
+
+
+def alltoall(tensor, splits=None, *, name: Optional[str] = None, axes=None):
+    """Scatter slices of ``tensor`` along dim 0 to every rank and gather the
+    received slices, concatenated along dim 0.
+
+    Reference: hvd.alltoall (operations.cc:1031-1092,
+    collective_operations.h:192-257). Returns ``(output, received_splits)``
+    for parity with the reference's uneven-split API. In-jit, XLA requires
+    static shapes, so only the even-split case (``splits=None`` with dim 0
+    divisible by world size, or all-equal splits) is compiled; uneven splits
+    are an eager/controller feature.
+    """
+    tensor = jnp.asarray(tensor)
+    axes_t = _resolve_axes(axes)
+    if not axes_t:
+        out = _eager_alltoall(tensor, splits)
+        n = tensor.shape[0] if tensor.ndim else 0
+        return out, jnp.asarray([n], dtype=jnp.int32)
+    n = _world_size(axes_t)
+    if splits is not None:
+        s = np.asarray(splits)
+        if not (s.ndim == 1 and len(s) == n and np.all(s == s[0])):
+            raise NotImplementedError(
+                "uneven alltoall splits require static shapes under XLA; "
+                "use equal splits in compiled code (reference uneven path: "
+                "operations.cc:1031-1092)")
+    if tensor.shape[0] % n != 0:
+        raise ValueError(
+            f"alltoall dim 0 ({tensor.shape[0]}) must be divisible by the "
+            f"world size ({n})")
+    if _is_replicated(tensor, axes_t):
+        # Equal input on every rank: rank r receives its own block from each
+        # sender — a local slice + tile, no wire traffic.
+        blk = tensor.shape[0] // n
+        mine = lax.dynamic_slice_in_dim(
+            tensor, lax.axis_index(axes_t) * blk, blk, 0)
+        out = jnp.tile(mine, (n,) + (1,) * (tensor.ndim - 1))
+    else:
+        out = lax.all_to_all(tensor, axes_t, split_axis=0, concat_axis=0,
+                             tiled=True)
+    recv = jnp.full((n,), tensor.shape[0] // n, dtype=jnp.int32)
+    return out, recv
+
+
+def join() -> int:
+    """Signal that this process has exhausted its data (reference: JoinOp,
+    collective_operations.cc:256-264; torch/mpi_ops.py:646).
+
+    In the reference, joined ranks contribute zeros to subsequent collectives
+    until all ranks join; the call returns the rank of the last rank to join.
+    Single-controller SPMD has no per-rank data exhaustion inside the
+    compiled step — handle ragged data by padding/masking the global batch.
+    Eagerly, this is a process-world barrier; with one process it returns
+    this process's rank immediately.
+    """
+    s = basics._require_init()
+    s.joined = True
+    if s.process_count == 1:
+        return basics.rank()
+    raise NotImplementedError(
+        "multi-process eager join lands with the controller transport")
+
+
+def barrier() -> None:
+    """Host-side barrier over processes (the reference uses controller
+    Barrier, controller.h:145)."""
+    s = basics._require_init()
+    if s.process_count == 1:
+        return
+    raise NotImplementedError(
+        "multi-process barrier lands with the controller transport")
+
+
+# ---------------------------------------------------------------------------
+# Eager (host) path — process-world collectives.
+#
+# With one process per host and a single controller, eager collectives have
+# one participant per process. Under a single process they reduce over a
+# world of one, which must still apply op semantics exactly (average of one
+# tensor is the tensor). Multi-host eager data rides the controller + fused
+# jit programs (runner/ + cc/); until that transport is attached, multi-host
+# eager collectives raise.
+# ---------------------------------------------------------------------------
+
+
+def _eager_world() -> int:
+    return basics._require_init().process_count
+
+
+def _eager_allreduce(tensor, op: ReduceOp):
+    if _eager_world() == 1:
+        return tensor  # sum/avg/min/max/product over a world of one
+    raise NotImplementedError(
+        "multi-host eager allreduce lands with the controller transport")
+
+
+def _eager_allgather(tensor):
+    if _eager_world() == 1:
+        return tensor
+    raise NotImplementedError(
+        "multi-host eager allgather lands with the controller transport")
+
+
+def _eager_broadcast(tensor, root_rank: int):
+    if _eager_world() == 1:
+        return tensor
+    raise NotImplementedError(
+        "multi-host eager broadcast lands with the controller transport")
+
+
+def _eager_alltoall(tensor, splits):
+    if _eager_world() == 1:
+        return tensor
+    raise NotImplementedError(
+        "multi-host eager alltoall lands with the controller transport")
+
+
+# ---------------------------------------------------------------------------
+# Handle-based async API (reference: torch/mpi_ops.py:66-161 — allreduce_async
+# returns an int handle; synchronize(handle) blocks; poll(handle) checks).
+#
+# JAX arrays are asynchronous futures by construction: dispatch returns
+# immediately and block_until_ready() is the synchronize. The HandleManager
+# preserves the reference contract (including duplicate-name rejection,
+# common.h:163) on top of that.
+# ---------------------------------------------------------------------------
+
+
+class _HandleManager:
+    """Reference: torch/handle_manager.{h,cc} + the name table in
+    TensorQueue (tensor_queue.h:28)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._results = {}
+        self._names = set()
+        self._next = 0
+
+    def allocate(self, value, name: Optional[str]):
+        with self._lock:
+            if name is not None:
+                if name in self._names:
+                    raise DuplicateTensorNameError(
+                        f"Tensor name {name!r} already in an in-flight "
+                        "collective (reference: DUPLICATE_NAME_ERROR, "
+                        "common.h:163)")
+                self._names.add(name)
+            h = self._next
+            self._next += 1
+            self._results[h] = (value, name)
+            return h
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            if handle not in self._results:
+                # Already synchronized/cleared: completed (the reference's
+                # HandleManager reports finished handles as done).
+                return True
+            value, _ = self._results[handle]
+        try:
+            return bool(value.is_ready())
+        except AttributeError:
+            return True
+
+    def wait_and_clear(self, handle: int):
+        with self._lock:
+            value, name = self._results.pop(handle)
+            if name is not None:
+                self._names.discard(name)
+        return jax.block_until_ready(value)
+
+
+_handles = _HandleManager()
+
+
+def allreduce_async(tensor, *, name: Optional[str] = None, **kwargs) -> int:
+    """Dispatch an allreduce, returning an integer handle
+    (reference: torch/mpi_ops.py:119-127)."""
+    return _handles.allocate(allreduce(tensor, name=name, **kwargs), name)
+
+
+def allgather_async(tensor, *, name: Optional[str] = None, **kwargs) -> int:
+    return _handles.allocate(allgather(tensor, name=name, **kwargs), name)
+
+
+def broadcast_async(tensor, root_rank: int = 0, *,
+                    name: Optional[str] = None, **kwargs) -> int:
+    return _handles.allocate(
+        broadcast(tensor, root_rank, name=name, **kwargs), name)
+
+
+def alltoall_async(tensor, splits=None, *, name: Optional[str] = None,
+                   **kwargs) -> int:
+    return _handles.allocate(alltoall(tensor, splits, name=name, **kwargs),
+                             name)
+
+
+def poll(handle: int) -> bool:
+    """True when the collective behind ``handle`` has completed
+    (reference: torch/mpi_ops.py:88-99)."""
+    return _handles.poll(handle)
+
+
+def synchronize(handle: int):
+    """Block until the collective completes and return its result
+    (reference: torch/mpi_ops.py:101-127)."""
+    return _handles.wait_and_clear(handle)

@@ -1,0 +1,77 @@
+"""Gradient compression applied around allreduce.
+
+Reference: ``horovod/tensorflow/compression.py`` / ``horovod/torch/compression.py``
+(fp16 cast before allreduce, cast back after; tensorflow/compression.py:46-64).
+
+TPU note: bfloat16 is the MXU-native 16-bit format — it keeps fp32's exponent
+range, so unlike fp16 it needs no loss scaling and reduces over ICI at half
+the bandwidth of fp32. ``Compression.fp16`` is kept for API parity and maps
+to IEEE float16; prefer ``Compression.bf16`` on TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Compressor:
+    """Interface: compress returns (compressed_tensor, context); decompress
+    restores the original dtype."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: compression.py NoneCompressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: jnp.dtype
+
+    @classmethod
+    def compress(cls, tensor):
+        ctx = tensor.dtype
+        if jnp.issubdtype(ctx, jnp.floating) and ctx != cls.wire_dtype:
+            return tensor.astype(cls.wire_dtype), ctx
+        return tensor, ctx
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        if ctx is not None and tensor.dtype != ctx:
+            return tensor.astype(ctx)
+        return tensor
+
+
+class FP16Compressor(_CastCompressor):
+    """Cast float tensors to float16 on the wire (reference:
+    tensorflow/compression.py:46-64)."""
+
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """Cast float tensors to bfloat16 on the wire — the TPU-native choice."""
+
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace mirroring the reference's ``hvd.Compression``."""
+
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

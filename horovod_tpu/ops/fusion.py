@@ -1,0 +1,180 @@
+"""Tensor fusion: pack many small tensors into few large collectives.
+
+Reference: the 64 MiB fusion buffer (fusion_buffer_manager.{h,cc},
+operations.cc:437) plus ``Controller::FuseResponses`` which bins ready
+tensors under the threshold with look-ahead across mixed dtypes
+(controller.cc:686-809). Fusion is Horovod's single most important
+performance feature: it amortizes per-collective launch latency over many
+gradients.
+
+TPU-native redesign
+-------------------
+Under XLA, shapes are static at trace time, so fusion needs no runtime
+negotiation at all: we pack the gradient pytree into flat per-dtype buckets
+**once, during tracing**, and every compiled step reduces whole buckets. The
+response-cache "learned schedule" of the reference (response_cache.cc — the
+steady-state fast path) becomes simply the XLA compilation cache: the first
+trace fixes the fused schedule, subsequent steps replay it at zero
+negotiation cost.
+
+Bucketing mirrors the reference policy: greedy first-fit in tree order,
+per-dtype buffers (mixed dtypes can't share one XLA collective), capped at
+``HOROVOD_FUSION_THRESHOLD`` bytes, and bucket lengths rounded up to a
+multiple of 64 elements so hierarchical reduce-scatter shards evenly
+(reference: FUSION_BUFFER_ATOMIC_UNIT, common.h:97; controller.cc:360-378).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from . import collective_ops as C
+from .compression import Compression
+
+# Reference: FUSION_BUFFER_ATOMIC_UNIT = 64 (common.h:97) — keeps fused
+# buffers divisible for hierarchical/Adasum sharding.
+ATOMIC_UNIT = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fused buffer: which flat leaves it holds and how to unpack them."""
+
+    dtype: Any
+    leaf_indices: Tuple[int, ...]
+    sizes: Tuple[int, ...]
+    shapes: Tuple[Tuple[int, ...], ...]
+    padded_size: int  # total elements, rounded up to ATOMIC_UNIT
+
+
+def plan_buckets(
+    leaves: Sequence[jax.Array],
+    threshold_bytes: Optional[int] = None,
+) -> List[Bucket]:
+    """Greedy first-fit bucketing in leaf order, one buffer per dtype run.
+
+    Matches the reference's FuseResponses policy (controller.cc:686-809):
+    walk tensors in order, open a new buffer when the current one would
+    exceed the threshold or the dtype changes (the reference's look-ahead
+    skips over mixed dtypes; leaf order here is pytree order, which is
+    deterministic, so we simply group by dtype)."""
+    if threshold_bytes is None:
+        threshold_bytes = (
+            basics.config().fusion_threshold_bytes
+            if basics.is_initialized()
+            else 64 * 1024 * 1024
+        )
+    by_dtype: dict = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    buckets: List[Bucket] = []
+    for dtype, idxs in by_dtype.items():
+        itemsize = jnp.dtype(dtype).itemsize
+        cur_idx: List[int] = []
+        cur_elems = 0
+        max_elems = max(ATOMIC_UNIT, threshold_bytes // itemsize)
+        for i in idxs:
+            n = int(np.prod(jnp.shape(leaves[i]), dtype=np.int64)) or 1
+            if cur_idx and cur_elems + n > max_elems:
+                buckets.append(_close_bucket(dtype, cur_idx, leaves))
+                cur_idx, cur_elems = [], 0
+            cur_idx.append(i)
+            cur_elems += n
+        if cur_idx:
+            buckets.append(_close_bucket(dtype, cur_idx, leaves))
+    return buckets
+
+
+def _close_bucket(dtype, idxs: List[int], leaves) -> Bucket:
+    shapes = tuple(tuple(jnp.shape(leaves[i])) for i in idxs)
+    sizes = tuple(int(np.prod(s, dtype=np.int64)) or 1 for s in shapes)
+    total = sum(sizes)
+    padded = ((total + ATOMIC_UNIT - 1) // ATOMIC_UNIT) * ATOMIC_UNIT
+    return Bucket(dtype=dtype, leaf_indices=tuple(idxs), sizes=sizes,
+                  shapes=shapes, padded_size=padded)
+
+
+def pack(bucket: Bucket, leaves: Sequence[jax.Array]) -> jax.Array:
+    """Concatenate the bucket's leaves into one flat padded buffer (the
+    MemcpyInFusionBuffer analogue, collective_operations.cc:34-59 — here a
+    traced concatenate that XLA fuses)."""
+    flat = [jnp.ravel(jnp.asarray(leaves[i])) for i in bucket.leaf_indices]
+    buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
+    pad = bucket.padded_size - buf.shape[0]
+    if pad:
+        buf = jnp.concatenate([buf, jnp.zeros((pad,), dtype=buf.dtype)])
+    return buf
+
+
+def unpack(bucket: Bucket, buf: jax.Array) -> List[jax.Array]:
+    """Split a fused buffer back into leaves (MemcpyOutFusionBuffer)."""
+    out = []
+    off = 0
+    for size, shape in zip(bucket.sizes, bucket.shapes):
+        out.append(jnp.reshape(buf[off:off + size], shape))
+        off += size
+    return out
+
+
+def allreduce_pytree(
+    tree,
+    *,
+    op: C.ReduceOp = C.ReduceOp.AVERAGE,
+    compression=Compression.none,
+    threshold_bytes: Optional[int] = None,
+    axes=None,
+    hierarchical: Optional[bool] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    presummed: bool = False,
+):
+    """Allreduce every leaf of a pytree with tensor fusion.
+
+    This is what :class:`horovod_tpu.DistributedOptimizer` runs on the
+    gradient tree — the analogue of the reference's per-step fused
+    NCCL allreduce cycle (RunLoopOnce → FuseResponses → NCCLAllreduce,
+    operations.cc:571-624).
+
+    Leaves that are already replicated across the mesh axes (VMA-invariant)
+    are handled without a collective; ``presummed`` controls their
+    interpretation (see :func:`collective_ops._reduce_replicated`). The
+    default ``presummed=False`` gives plain collective semantics (equal
+    contributions); the gradient paths (DistributedOptimizer, tape) pass
+    ``presummed=True`` because shard_map autodiff auto-psums gradients of
+    replicated parameters. Only genuinely per-rank leaves are packed into
+    fused buffers and reduced on the wire."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    axes_t = C._resolve_axes(axes)
+    out: List[Optional[jax.Array]] = [None] * len(leaves)
+
+    varying_idx: List[int] = []
+    for i, leaf in enumerate(leaves):
+        if axes_t and C._is_replicated(leaf, axes_t):
+            out[i] = C.allreduce(
+                leaf, op=op, compression=compression, axes=axes,
+                hierarchical=hierarchical, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, _presummed=presummed)
+        else:
+            varying_idx.append(i)
+
+    if varying_idx:
+        vleaves = [leaves[i] for i in varying_idx]
+        buckets = plan_buckets(vleaves, threshold_bytes)
+        for bucket in buckets:
+            buf = pack(bucket, vleaves)
+            red = C.allreduce(
+                buf, op=op, compression=compression, axes=axes,
+                hierarchical=hierarchical, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            for j, leaf in zip(bucket.leaf_indices, unpack(bucket, red)):
+                out[varying_idx[j]] = leaf
+    return jax.tree.unflatten(treedef, out)

@@ -1,0 +1,98 @@
+"""State broadcast / gather helpers.
+
+Reference: ``horovod/tensorflow/functions.py`` (broadcast_variables:47,
+broadcast_object:59-134, allgather_object:136) and
+``horovod/torch/functions.py`` (broadcast_parameters:30,
+broadcast_optimizer_state:70-160). These implement the reference's
+checkpoint/resume pattern: rank 0 owns the initial state and broadcasts it at
+start (SURVEY §5.4).
+
+On TPU the parameter tree lives replicated across the mesh inside the
+compiled program, so ``broadcast_variables`` is only needed (a) to force
+bit-identical initialization across hosts in multi-controller setups and
+(b) after elastic resets. It lowers to fused masked-psum broadcasts.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import basics
+from ..ops import collective_ops as C
+from ..ops import fusion
+
+
+def broadcast_variables(variables, root_rank: int = 0, *, axes=None):
+    """Broadcast a pytree of arrays from ``root_rank`` to all ranks
+    (reference: tensorflow/functions.py:47-57). Leaves are fused into
+    per-dtype buckets so the broadcast is a handful of collectives, not one
+    per variable (the reference gets this from tensor fusion)."""
+    leaves, treedef = jax.tree.flatten(variables)
+    if not leaves:
+        return variables
+    axes_t = C._resolve_axes(axes)
+    if not axes_t:
+        # Eager process-world broadcast: identity on a single process.
+        return jax.tree.unflatten(
+            treedef, [C._eager_broadcast(jnp.asarray(l), root_rank)
+                      for l in leaves])
+    buckets = fusion.plan_buckets(leaves)
+    out = [None] * len(leaves)
+    for bucket in buckets:
+        buf = fusion.pack(bucket, leaves)
+        red = C.broadcast(buf, root_rank, axes=axes_t)
+        for i, leaf in zip(bucket.leaf_indices, fusion.unpack(bucket, red)):
+            out[i] = leaf
+    return jax.tree.unflatten(treedef, out)
+
+
+# Reference torch naming (torch/functions.py:30).
+broadcast_parameters = broadcast_variables
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0, *, axes=None):
+    """Broadcast optimizer state (reference: torch/functions.py:70-160 —
+    there it must walk torch state dicts; optax state is already a pytree,
+    so it reduces to broadcast_variables over the array leaves)."""
+    leaves, treedef = jax.tree.flatten(opt_state)
+    arr_idx = [i for i, l in enumerate(leaves) if _is_array(l)]
+    new = broadcast_variables([leaves[i] for i in arr_idx], root_rank,
+                              axes=axes)
+    for i, v in zip(arr_idx, new):
+        leaves[i] = v
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _is_array(x) -> bool:
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
+    """Broadcast an arbitrary picklable object from ``root_rank``
+    (reference: tensorflow/functions.py:59-134 — pickle → uint8 tensor →
+    bcast size → bcast payload → unpickle). Eager/process-world only."""
+    basics._require_init()
+    if basics._state.process_count == 1:
+        return obj
+    buf = io.BytesIO()
+    pickle.dump(obj, buf)
+    payload = jnp.frombuffer(buf.getvalue(), dtype=jnp.uint8)
+    size = C._eager_broadcast(jnp.asarray([payload.size]), root_rank)
+    data = C._eager_broadcast(payload, root_rank)
+    return pickle.loads(np.asarray(data[: int(size[0])]).tobytes())
+
+
+def allgather_object(obj: Any, name: str = None) -> List[Any]:
+    """Gather a picklable object from every process into a list
+    (reference: tensorflow/functions.py:136-177)."""
+    basics._require_init()
+    if basics._state.process_count == 1:
+        return [obj]
+    raise NotImplementedError(
+        "multi-host allgather_object lands with the controller transport")

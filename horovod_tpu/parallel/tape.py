@@ -1,0 +1,132 @@
+"""Gradient-transform wrappers: the DistributedGradientTape equivalent.
+
+Reference: ``hvd.DistributedGradientTape`` (tensorflow/__init__.py:511-576)
+wraps a TF GradientTape so ``tape.gradient`` returns allreduced gradients,
+via ``_make_allreduce_grads_fn`` (tensorflow/__init__.py:246-278).
+
+JAX has no tape — gradients come from ``jax.grad`` / ``jax.value_and_grad``.
+The equivalents here wrap those transforms so the returned gradients are
+already fused-allreduced across the mesh, which is exactly what the
+reference's tape wrapper does at the same point in the step.
+
+A subtlety makes this more than sugar: under ``jax.shard_map`` autodiff
+*auto-psums* gradients of replicated inputs (the transpose of the implicit
+replicate-to-varying broadcast), producing per-parameter fp32 SUM
+collectives outside our control — no fusion policy, no compression, no
+Adasum. To reclaim Horovod semantics we first cast the differentiated
+arguments to device-varying (``lax.pcast(..., to='varying')``), so the raw
+gradients are true per-rank locals, then run them through the fused
+allreduce exactly as the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from ..ops import collective_ops as C
+from ..ops import fusion
+from ..ops.compression import Compression
+
+
+def _pvary_tree(tree, axes_t):
+    """Cast every leaf to be varying over ``axes_t`` so autodiff produces
+    local (un-psummed) gradients for it."""
+
+    def one(x):
+        missing = tuple(a for a in axes_t if a not in C._vma(x))
+        return lax.pcast(x, missing, to="varying") if missing else x
+
+    return jax.tree.map(one, tree)
+
+
+def allreduce_gradients(
+    grads,
+    *,
+    op: C.ReduceOp = C.ReduceOp.AVERAGE,
+    compression=Compression.none,
+    fusion_threshold_bytes: Optional[int] = None,
+    axes=None,
+    hierarchical: Optional[bool] = None,
+):
+    """Allreduce a gradient pytree (reference: _make_allreduce_grads_fn,
+    tensorflow/__init__.py:246-278). Fused into per-dtype buckets;
+    ``presummed=True`` because invariant gradient leaves under shard_map are
+    autodiff-psummed sums, not equal per-rank contributions."""
+    return fusion.allreduce_pytree(
+        grads, op=op, compression=compression,
+        threshold_bytes=fusion_threshold_bytes, axes=axes,
+        hierarchical=hierarchical, presummed=True)
+
+
+def value_and_grad(
+    fun,
+    argnums=0,
+    has_aux: bool = False,
+    *,
+    op: C.ReduceOp = C.ReduceOp.AVERAGE,
+    compression=Compression.none,
+    fusion_threshold_bytes: Optional[int] = None,
+    axes=None,
+    hierarchical: Optional[bool] = None,
+    **jax_kwargs,
+):
+    """``jax.value_and_grad`` whose gradients are allreduced across ranks —
+    the DistributedGradientTape of the JAX world
+    (reference: tensorflow/__init__.py:511-576)."""
+    vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux,
+                            **jax_kwargs)
+    idxs = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+
+    def wrapped(*args, **kwargs):
+        axes_t = C._resolve_axes(axes)
+        if axes_t:
+            args = list(args)
+            for i in idxs:
+                args[i] = _pvary_tree(args[i], axes_t)
+        val, grads = vg(*args, **kwargs)
+        grads = allreduce_gradients(
+            grads, op=op, compression=compression,
+            fusion_threshold_bytes=fusion_threshold_bytes, axes=axes,
+            hierarchical=hierarchical)
+        return val, grads
+
+    return wrapped
+
+
+def grad(fun, argnums=0, has_aux: bool = False, **kwargs):
+    """``jax.grad`` with allreduced gradients (see :func:`value_and_grad`).
+    Mirrors the jax.grad contract: with ``has_aux`` returns
+    ``(grads, aux)``, otherwise just ``grads``."""
+    vg = value_and_grad(fun, argnums=argnums, has_aux=has_aux, **kwargs)
+
+    def wrapped(*args, **kw):
+        val, grads = vg(*args, **kw)
+        if has_aux:
+            return grads, val[1]
+        return grads
+
+    return wrapped
+
+
+class DistributedGradientTape:
+    """Name-parity shim for reference users porting TF2 code
+    (tensorflow/__init__.py:511-576).
+
+    Usage::
+
+        tape = hvd.DistributedGradientTape(loss_fn)
+        loss, grads = tape.gradient(params, batch)
+
+    where ``loss_fn(params, *inputs)`` is a scalar loss. The gradients
+    returned are allreduced. New code should call
+    :func:`horovod_tpu.value_and_grad` directly.
+    """
+
+    def __init__(self, loss_fn, **kwargs):
+        self._vg = value_and_grad(loss_fn, **kwargs)
+
+    def gradient(self, params, *inputs):
+        return self._vg(params, *inputs)

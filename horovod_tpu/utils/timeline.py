@@ -1,0 +1,139 @@
+"""Timeline: Chrome-tracing-format profiling of framework activity.
+
+Reference: ``horovod/common/timeline.{h,cc}`` — coordinator-side writer
+thread fed by a lockfree queue, emitting per-tensor lifecycle events
+(NEGOTIATE_* → QUEUE → WAIT_FOR_DATA → op activities) viewable in
+``chrome://tracing`` (SURVEY §5.1). Enabled by ``HOROVOD_TIMELINE=<file>``
+or at runtime via :func:`horovod_tpu.start_timeline`
+(reference: operations.cc:715-757, basics.py:75-98).
+
+TPU-native redesign: on the compiled path the per-collective schedule lives
+inside XLA, where the platform profiler (``jax.profiler``) already captures
+device activity — so this Timeline records the *host-side* framework events
+(eager collectives, controller cycles, elastic transitions, step markers)
+and offers :func:`trace` context managers that bracket XLA launches. Events
+are written by a dedicated writer thread consuming a queue, like the
+reference's writer design (timeline.h:48-80), so tracing never blocks the
+training loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Timeline:
+    """Chrome-tracing JSON writer (reference: timeline.cc).
+
+    Event categories mirror the reference activities (common.h:31-62):
+    NEGOTIATE_ALLREDUCE, QUEUE, WAIT_FOR_DATA, MEMCPY_IN_FUSION_BUFFER,
+    XLA_ALLREDUCE (our NCCL_ALLREDUCE analogue), CYCLE markers.
+    """
+
+    def __init__(self, path: str, mark_cycles: bool = False) -> None:
+        self._path = path
+        self._mark_cycles = mark_cycles
+        self._queue: "queue.Queue" = queue.Queue()
+        self._start = time.perf_counter()
+        self._closed = False
+        self._pid = os.getpid()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="hvd-timeline-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    # -- event emission (any thread) ------------------------------------
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._start) * 1e6
+
+    def emit(self, name: str, phase: str, *, tid: str = "main",
+             ts: Optional[float] = None, args: Optional[dict] = None) -> None:
+        if self._closed:
+            return
+        ev = {"name": name, "ph": phase, "pid": self._pid, "tid": tid,
+              "ts": self._now_us() if ts is None else ts}
+        if args:
+            ev["args"] = args
+        self._queue.put(ev)
+
+    def begin(self, tensor_name: str, activity: str) -> None:
+        """Begin an activity for a tensor (reference: Timeline::ActivityStart)."""
+        self.emit(activity, "B", tid=tensor_name)
+
+    def end(self, tensor_name: str, activity: str = "") -> None:
+        """End the current activity (reference: Timeline::ActivityEnd)."""
+        self.emit(activity, "E", tid=tensor_name)
+
+    def instant(self, name: str, *, tid: str = "main",
+                args: Optional[dict] = None) -> None:
+        self.emit(name, "i", tid=tid, args=args)
+
+    def mark_cycle_start(self) -> None:
+        """Cycle markers (HOROVOD_TIMELINE_MARK_CYCLES, operations.cc:430)."""
+        if self._mark_cycles:
+            self.instant("CYCLE_START", tid="cycles")
+
+    @contextmanager
+    def trace(self, tensor_name: str, activity: str):
+        """Bracket a host-side activity: with tl.trace("grads", "XLA_ALLREDUCE")."""
+        self.begin(tensor_name, activity)
+        try:
+            yield
+        finally:
+            self.end(tensor_name, activity)
+
+    # -- writer thread ---------------------------------------------------
+
+    def _writer_loop(self) -> None:
+        while True:
+            ev = self._queue.get()
+            if ev is None:
+                return
+            line = json.dumps(ev)
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            self._file.write(line)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._writer.join(timeout=5)
+        self._file.write("\n]\n")
+        self._file.flush()
+        self._file.close()
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
+    """Start timeline recording at runtime (reference: hvd.start_timeline,
+    basics.py:75-98). Attaches to global state so framework internals emit
+    into it."""
+    from ..common import basics
+
+    s = basics._require_init()
+    if s.timeline is not None:
+        s.timeline.close()
+    s.timeline = Timeline(path, mark_cycles=mark_cycles)
+    return s.timeline
+
+
+def stop_timeline() -> None:
+    """Stop recording (reference: hvd.stop_timeline)."""
+    from ..common import basics
+
+    s = basics._require_init()
+    if s.timeline is not None:
+        s.timeline.close()
+        s.timeline = None

@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; collective semantics are
+tested on 8 virtual CPU devices (the same XLA collectives, different
+interconnect), mirroring the reference's localhost `mpirun -np 2` strategy
+(SURVEY §4). The axon sitecustomize preimports jax, so the platform switch
+must go through jax.config (backends initialize lazily)."""
+
+import os
+
+# Must be set before the first backend initialization.
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " " + _flag).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd_init():
+    hvd.init()
+    yield
+
+
+@pytest.fixture()
+def mesh():
+    return hvd.mesh()

@@ -1,0 +1,82 @@
+"""Adasum numerics against a NumPy reference implementation.
+
+Models the reference's test_adasum_pytorch.py / test_adasum_tensorflow.py,
+which validate the VHDD tree combine against a straight NumPy port of the
+math (adasum.h:101-141)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import adasum
+
+N = 8
+
+
+def np_adasum_pair(a, b):
+    dot = np.vdot(a, b)
+    na = np.vdot(a, a)
+    nb = np.vdot(b, b)
+    ac = 1.0 - dot / (2 * na) if na > 0 else 1.0
+    bc = 1.0 - dot / (2 * nb) if nb > 0 else 1.0
+    return ac * a + bc * b
+
+
+def np_adasum_tree(stack):
+    vecs = [stack[i].astype(np.float64) for i in range(stack.shape[0])]
+    while len(vecs) > 1:
+        tail = [vecs[-1]] if len(vecs) % 2 == 1 else []
+        body = vecs[: len(vecs) - len(tail)]
+        vecs = [np_adasum_pair(body[i], body[i + 1])
+                for i in range(0, len(body), 2)] + tail
+    return vecs[0]
+
+
+def test_pair_combine_matches_numpy():
+    rng = np.random.RandomState(0)
+    a = rng.randn(16).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+    out = adasum.adasum_combine(jnp.asarray(a), jnp.asarray(b))
+    np.testing.assert_allclose(np.asarray(out), np_adasum_pair(a, b),
+                               rtol=1e-5)
+
+
+def test_pair_combine_orthogonal_sums():
+    # Orthogonal vectors: dot = 0 → plain sum (docs/adasum_user_guide.rst).
+    a = jnp.asarray([1.0, 0.0])
+    b = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(np.asarray(adasum.adasum_combine(a, b)),
+                               [1.0, 1.0])
+
+
+def test_pair_combine_parallel_averages():
+    # Identical vectors: dot = |a|² → coefficients ½ → average.
+    a = jnp.asarray([2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(adasum.adasum_combine(a, a)),
+                               [2.0, 4.0])
+
+
+def test_pair_combine_zero_operand():
+    a = jnp.zeros(4)
+    b = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(adasum.adasum_combine(a, b)),
+                               np.asarray(b))
+
+
+def test_adasum_allreduce_matches_numpy_tree():
+    rng = np.random.RandomState(7)
+    x = rng.randn(N, 32).astype(np.float32)
+
+    out = jax.shard_map(
+        lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
+        mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+        out_specs=P())(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np_adasum_tree(x), rtol=1e-4)
+
+
+def test_adasum_eager_single_process_identity():
+    x = jnp.arange(4.0)
+    np.testing.assert_array_equal(
+        np.asarray(hvd.allreduce(x, op=hvd.Adasum)), np.asarray(x))

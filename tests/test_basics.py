@@ -1,0 +1,75 @@
+"""World/topology API tests (reference: test/parallel/test_tensorflow.py
+rank/size assertions + basics.py surface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+
+def test_initialized():
+    assert hvd.is_initialized()
+
+
+def test_world_shape():
+    assert hvd.size() == 8
+    assert hvd.local_size() * hvd.cross_size() == hvd.size()
+    assert hvd.size() == hvd.mesh().devices.size
+
+
+def test_eager_ranks():
+    # Single process: leader rank 0, cross rank 0.
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+    assert hvd.mpi_threads_supported()
+
+
+def test_traced_ranks_are_per_chip():
+    mesh = hvd.mesh()
+
+    def f(x):
+        return x + hvd.rank()
+
+    out = jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+                        out_specs=P(hvd.HVD_AXES))(jnp.zeros(8))
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8))
+
+
+def test_traced_local_cross_ranks():
+    mesh = hvd.mesh()
+    n_local = hvd.local_size()
+
+    def f(x):
+        return x + hvd.local_rank() + 100 * hvd.cross_rank()
+
+    out = jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+                        out_specs=P(hvd.HVD_AXES))(jnp.zeros(8))
+    expect = [100 * (i // n_local) + (i % n_local) for i in range(8)]
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_local_batch_size():
+    assert hvd.local_batch_size(64) == 8
+    with pytest.raises(ValueError):
+        hvd.local_batch_size(7)
+
+
+def test_reinit_after_shutdown():
+    # Reference: elastic reset re-runs hvd.shutdown + hvd.init
+    # (common/elastic.py:147-168).
+    hvd.shutdown()
+    assert not hvd.is_initialized()
+    hvd.init()
+    assert hvd.is_initialized()
+    assert hvd.size() == 8
+
+
+def test_double_init_is_noop():
+    hvd.init()
+    hvd.init()
+    assert hvd.size() == 8

@@ -1,0 +1,227 @@
+"""Collective-op semantics on the 8-device mesh.
+
+Models the reference's op matrix tests (test/parallel/test_tensorflow.py:
+every dtype x op x fused/unfused over a real 2-process world) — here the
+world is 8 XLA devices and the collectives are the compiled shard_map path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops as C
+
+N = 8
+
+
+def spmd(f, in_specs=P(hvd.HVD_AXES), out_specs=P()):
+    return jax.shard_map(f, mesh=hvd.mesh(), in_specs=in_specs,
+                         out_specs=out_specs)
+
+
+def per_rank_inputs(shape, dtype):
+    """world-stacked input: rank i sees slice i."""
+    rng = np.random.RandomState(42)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return rng.randint(-10, 10, size=(N,) + shape).astype(dtype)
+    return rng.randn(N, *shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float16, jnp.bfloat16,
+                                   np.int32])
+@pytest.mark.parametrize("shape", [(4,), (3, 5), (2, 3, 4)])
+def test_allreduce_sum(dtype, shape):
+    x = per_rank_inputs(shape, dtype)
+    out = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum),
+               in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    expect = np.asarray(x, dtype=np.float64).sum(axis=0)
+    rtol = 5e-2 if jnp.dtype(dtype).itemsize == 2 else 1e-5
+    np.testing.assert_allclose(np.asarray(out, np.float64), expect, rtol=rtol,
+                               atol=1e-1 if jnp.dtype(dtype).itemsize == 2 else 1e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_allreduce_average(dtype):
+    x = per_rank_inputs((6,), dtype)
+    out = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Average),
+               in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        expect = x.sum(axis=0) // N  # integer average truncates
+        np.testing.assert_array_equal(np.asarray(out), expect)
+    else:
+        np.testing.assert_allclose(np.asarray(out), x.mean(axis=0), rtol=1e-5)
+
+
+def test_allreduce_min_max():
+    x = per_rank_inputs((5,), np.float32)
+    out_min = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Min),
+                   in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    out_max = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Max),
+                   in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out_min), x.min(axis=0))
+    np.testing.assert_allclose(np.asarray(out_max), x.max(axis=0))
+
+
+def test_allreduce_product():
+    x = np.full((N, 3), 2.0, np.float32)
+    out = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Product),
+               in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), np.full(3, 2.0 ** N))
+
+
+def test_allreduce_prescale_postscale():
+    # Reference: prescale/postscale factors in the request
+    # (message.h:48-113; test_tensorflow.py prescale tests).
+    x = per_rank_inputs((4,), np.float32)
+    out = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum, prescale_factor=0.5,
+                                       postscale_factor=3.0),
+               in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(out), x.sum(0) * 0.5 * 3.0,
+                               rtol=1e-5)
+
+
+def test_allreduce_compression_roundtrip():
+    x = per_rank_inputs((16,), np.float32)
+    out = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum,
+                                       compression=hvd.Compression.bf16),
+               in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    assert out.dtype == jnp.float32  # decompressed back
+    np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=5e-2, atol=0.2)
+
+
+def test_allreduce_hierarchical_matches_flat():
+    # Reference: NCCLHierarchicalAllreduce must agree with flat ring
+    # (nccl_operations.cc:190-380).
+    x = per_rank_inputs((8, 3), np.float32)  # dim0 divisible by local_size
+    flat = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum, hierarchical=False),
+                in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    hier = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum, hierarchical=True),
+                in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(hier), np.asarray(flat), rtol=1e-5)
+
+
+def test_allreduce_hierarchical_remainder_shape():
+    # Non-divisible leading dim falls back to flat psum (the reference
+    # handles the remainder via a separate root-reduce leg,
+    # nccl_operations.cc:244-307).
+    x = per_rank_inputs((5, 3), np.float32)
+    hier = spmd(lambda v: hvd.allreduce(v[0], op=hvd.Sum, hierarchical=True),
+                in_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(hier), x.sum(0), rtol=1e-5)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_allgather(dtype):
+    # all_gather output carries a per-device varying mark (each rank holds
+    # its own—identical—copy), so collect every rank's copy and compare.
+    x = per_rank_inputs((2, 3), dtype)
+    out = spmd(lambda v: hvd.allgather(v[0])[None],
+               in_specs=P(hvd.HVD_AXES),
+               out_specs=P(hvd.HVD_AXES))(jnp.asarray(x))
+    out = np.asarray(out)
+    assert out.shape == (N, N * 2, 3)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], x.reshape(N * 2, 3))
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_broadcast(root):
+    # Each rank holds rank-dependent values; all must end with root's.
+    def f(_):
+        mine = jnp.full((4,), hvd.rank(), jnp.float32)
+        return hvd.broadcast(mine, root_rank=root)
+
+    out = spmd(f, in_specs=P(hvd.HVD_AXES))(jnp.zeros(N))
+    np.testing.assert_array_equal(np.asarray(out), np.full(4, root))
+
+
+def test_broadcast_bool():
+    def f(_):
+        mine = jnp.asarray([hvd.rank() % 2 == 1])
+        return hvd.broadcast(mine, root_rank=3)
+
+    out = spmd(f, in_specs=P(hvd.HVD_AXES))(jnp.zeros(N))
+    assert bool(np.asarray(out)[0]) is True
+
+
+def test_broadcast_int():
+    def f(_):
+        mine = jnp.asarray([hvd.rank()], jnp.int32)
+        return hvd.broadcast(mine, root_rank=5)
+
+    out = spmd(f, in_specs=P(hvd.HVD_AXES))(jnp.zeros(N))
+    assert int(np.asarray(out)[0]) == 5
+
+
+def test_alltoall_even():
+    # rank r sends row block [r*N+k] to rank k; rank r receives [k*N+r].
+    def f(_):
+        mine = (jnp.arange(N, dtype=jnp.float32) + N * hvd.rank())
+        out, splits = hvd.alltoall(mine)
+        return out, splits
+
+    out, splits = spmd(f, in_specs=P(hvd.HVD_AXES),
+                       out_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES)))(
+        jnp.zeros(N))
+    out = np.asarray(out).reshape(N, N)
+    for r in range(N):
+        np.testing.assert_array_equal(out[r], np.arange(N) * N + r)
+    assert np.all(np.asarray(splits) == 1)
+
+
+def test_alltoall_uneven_rejected_in_jit():
+    with pytest.raises(NotImplementedError):
+        spmd(lambda v: hvd.alltoall(v[0], splits=[2, 1, 1, 1, 1, 1, 0, 1])[0],
+             in_specs=P(hvd.HVD_AXES))(jnp.zeros((N, N)))
+
+
+def test_grouped_allreduce():
+    x = per_rank_inputs((3,), np.float32)
+    y = per_rank_inputs((2,), np.float32)
+
+    def f(a, b):
+        return tuple(hvd.grouped_allreduce([a[0], b[0]], op=hvd.Sum))
+
+    outs = spmd(f, in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
+                out_specs=(P(), P()))(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(np.asarray(outs[0]), x.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[1]), y.sum(0), rtol=1e-5)
+
+
+def test_eager_singleprocess_semantics():
+    # Eager ops run over the process world (=1 here): identity results.
+    x = jnp.arange(4.0)
+    np.testing.assert_array_equal(np.asarray(hvd.allreduce(x, op=hvd.Sum)), x)
+    np.testing.assert_array_equal(np.asarray(hvd.allgather(x)), x)
+    np.testing.assert_array_equal(np.asarray(hvd.broadcast(x, 0)), x)
+    out, splits = hvd.alltoall(x)
+    np.testing.assert_array_equal(np.asarray(out), x)
+    hvd.barrier()
+
+
+def test_async_handles():
+    # Reference: handle-based async API (torch/mpi_ops.py:66-161).
+    x = jnp.arange(4.0)
+    h = hvd.allreduce_async(x, name="t1", op=hvd.Sum)
+    assert isinstance(h, int)
+    out = hvd.synchronize(h)
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_async_duplicate_name_rejected():
+    from horovod_tpu.common.exceptions import DuplicateTensorNameError
+
+    x = jnp.zeros(2)
+    h = hvd.allreduce_async(x, name="dup")
+    with pytest.raises(DuplicateTensorNameError):
+        hvd.allreduce_async(x, name="dup")
+    hvd.synchronize(h)
+    h2 = hvd.allreduce_async(x, name="dup")  # name freed after synchronize
+    hvd.synchronize(h2)
+
+
+def test_join_single_process():
+    assert hvd.join() == hvd.rank()

@@ -1,0 +1,57 @@
+"""broadcast_variables / broadcast_object / optimizer-state tests
+(reference: test/parallel/test_torch.py broadcast_parameters and
+broadcast_optimizer_state cases; tensorflow/functions.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+N = 8
+
+
+def test_broadcast_variables_in_mesh():
+    # Each rank starts with rank-dependent params; after broadcast all must
+    # equal root's (rank 3).
+    def f(_):
+        me = hvd.rank().astype(jnp.float32)
+        params = {"w": jnp.full((4, 3), me), "b": jnp.full((2,), me * 10)}
+        out = hvd.broadcast_variables(params, root_rank=3)
+        return out
+
+    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+                        out_specs=P())(jnp.zeros(N))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4, 3), 3.0))
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.full((2,), 30.0))
+
+
+def test_broadcast_variables_eager_identity():
+    params = {"w": jnp.arange(6.0).reshape(2, 3)}
+    out = hvd.broadcast_variables(params, root_rank=0)
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(params["w"]))
+
+
+def test_broadcast_optimizer_state():
+    params = {"w": jnp.ones((3,))}
+    tx = optax.adam(1e-3)
+    state = tx.init(params)
+    out = hvd.broadcast_optimizer_state(state, root_rank=0)
+    # Structure preserved, arrays intact (eager single-process: identity).
+    la, ta = jax.tree.flatten(state)
+    lb, tb = jax.tree.flatten(out)
+    assert ta == tb
+    for a, b in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_broadcast_object_roundtrip():
+    obj = {"epoch": 3, "lr": 0.1, "name": "resnet"}
+    assert hvd.broadcast_object(obj, root_rank=0) == obj
+
+
+def test_allgather_object_single_process():
+    assert hvd.allgather_object({"x": 1}) == [{"x": 1}]

@@ -1,0 +1,59 @@
+"""Timeline tests (reference: test/parallel/test_timeline.py — run a job
+with HOROVOD_TIMELINE set and validate the JSON trace)."""
+
+import json
+
+import jax.numpy as jnp
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.timeline import Timeline
+
+
+def test_timeline_writes_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "timeline.json")
+    tl = Timeline(path, mark_cycles=True)
+    tl.begin("grads", "NEGOTIATE_ALLREDUCE")
+    tl.end("grads", "NEGOTIATE_ALLREDUCE")
+    with tl.trace("grads", "XLA_ALLREDUCE"):
+        pass
+    tl.mark_cycle_start()
+    tl.instant("STEP", args={"step": 1})
+    tl.close()
+
+    events = json.load(open(path))
+    names = [e["name"] for e in events]
+    assert "NEGOTIATE_ALLREDUCE" in names
+    assert "XLA_ALLREDUCE" in names
+    assert "CYCLE_START" in names
+    assert "STEP" in names
+    phases = {e["ph"] for e in events}
+    assert {"B", "E", "i"} <= phases
+    # Begin/End pairing per tid
+    for tid in {e["tid"] for e in events}:
+        stack = 0
+        for e in events:
+            if e["tid"] != tid:
+                continue
+            if e["ph"] == "B":
+                stack += 1
+            elif e["ph"] == "E":
+                stack -= 1
+                assert stack >= 0
+        assert stack == 0
+
+
+def test_start_stop_timeline_runtime(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = hvd.start_timeline(path)
+    tl.instant("MARK")
+    hvd.stop_timeline()
+    events = json.load(open(path))
+    assert any(e["name"] == "MARK" for e in events)
+
+
+def test_poll_after_synchronize_reports_done():
+    # Regression: poll on a cleared handle must return True, not raise
+    # (reference HandleManager contract).
+    h = hvd.allreduce_async(jnp.zeros(2), name="pollsync")
+    hvd.synchronize(h)
+    assert hvd.poll(h) is True
