@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Synthetic ResNet-50 training benchmark — the reference's headline harness.
+
+Mirrors ``examples/tensorflow2/tensorflow2_synthetic_benchmark.py`` from the
+reference (docs/benchmarks.rst:66-80): ResNet-50, synthetic ImageNet-shaped
+data, SGD-momentum, DistributedOptimizer gradient averaging, reporting
+images/sec. Runs on every visible chip via the Horovod mesh.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_images_per_sec_per_chip", "value": <img/s/chip>,
+   "unit": "images/sec/chip", "vs_baseline": <ratio>}
+
+``vs_baseline`` compares against 103.55 images/sec/device — the only
+absolute per-device throughput published in the reference:
+tf_cnn_benchmarks ResNet-101, batch 64, 1656.82 images/sec on 16 Pascal
+GPUs (docs/benchmarks.rst:27-43) → 103.55/GPU. BASELINE.json publishes no
+chip-level numbers (`published: {}`), so that figure is the anchor.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:27-43
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-chip batch size (reference default: 32)")
+    ap.add_argument("--num-warmup", type=int, default=3)
+    ap.add_argument("--num-iters", type=int, default=5,
+                    help="timing rounds (reference: 10)")
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--fp16-allreduce", action="store_true",
+                    help="bf16 wire compression (reference flag name kept)")
+    args = ap.parse_args()
+
+    hvd.init()
+    n_chips = hvd.size()
+    global_batch = args.batch_size * n_chips
+    log(f"devices: {jax.devices()}  world={n_chips}  "
+        f"global_batch={global_batch}")
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
+                           train=False)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    compression = (hvd.Compression.bf16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                  compression=compression)
+    opt_state = tx.init(params)
+
+    mesh = hvd.mesh()
+    rep = NamedSharding(mesh, P())
+    data_sh = hvd.data_sharding()
+
+    # Pin shardings up front so step 2 doesn't recompile on resharded args.
+    params = jax.device_put(params, rep)
+    batch_stats = jax.device_put(batch_stats, rep)
+    opt_state = jax.device_put(opt_state, rep)
+
+    images = jax.device_put(
+        jnp.asarray(np.random.randn(global_batch, 224, 224, 3),
+                    jnp.bfloat16), data_sh)
+    labels = jax.device_put(
+        jnp.asarray(np.random.randint(0, 1000, global_batch)), data_sh)
+
+    def loss_fn(p, bs, xb, yb):
+        logits, new_vars = model.apply(
+            {"params": p, "batch_stats": bs}, xb, train=True,
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb).mean()
+        return loss, new_vars["batch_stats"]
+
+    @jax.jit
+    def train_step(p, bs, s, xb, yb):
+        def spmd(p, bs, s, xb, yb):
+            (loss, nbs), grads = hvd.value_and_grad(
+                loss_fn, has_aux=True)(p, bs, xb, yb)
+            nbs = hvd.allreduce_pytree(nbs, op=hvd.Average)
+            updates, ns = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), nbs, ns, hvd.allreduce(loss)
+
+        return jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(), P(), hvd.data_pspec(), hvd.data_pspec()),
+            out_specs=(P(), P(), P(), P()))(p, bs, s, xb, yb)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_warmup):
+        params, batch_stats, opt_state, loss = train_step(
+            params, batch_stats, opt_state, images, labels)
+    jax.block_until_ready(loss)
+    log(f"warmup ({args.num_warmup} steps incl. compile): "
+        f"{time.perf_counter() - t0:.1f}s  loss={float(loss):.3f}")
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, batch_stats, opt_state, loss = train_step(
+                params, batch_stats, opt_state, images, labels)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        rate = global_batch * args.num_batches_per_iter / dt
+        img_secs.append(rate)
+        log(f"iter {i}: {rate:.1f} img/s total")
+
+    total = float(np.mean(img_secs))
+    per_chip = total / n_chips
+    log(f"Total img/sec on {n_chips} chip(s): {total:.1f} "
+        f"(± {float(np.std(img_secs)):.1f});  per chip: {per_chip:.1f}")
+
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -76,17 +76,31 @@ class _State:
 _state = _State()
 
 
-def _build_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+def _build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
     """Arrange all job devices into the 2-D (cross, local) Horovod mesh.
 
     Devices are ordered host-major so that chips on the same host are
     contiguous along ``hvd_local`` — the layout that keeps ``hvd_local``
     collectives on ICI and only ``hvd_cross`` traffic on DCN (the analogue of
     the reference packing ranks host-by-host, hosts.py:100-150).
+
+    ``mesh_shape=(cross, local)`` overrides the inferred host/chip split —
+    used to emulate a multi-host topology on a single host (tests, dryruns)
+    or to re-slice a multi-slice pod.
     """
     if devices is None:
         devices = jax.devices()
     devices = list(devices)
+    if mesh_shape is not None:
+        cross, local = mesh_shape
+        if cross * local != len(devices):
+            raise ValueError(
+                f"mesh_shape {mesh_shape} does not cover {len(devices)} devices")
+        grid = np.array(devices, dtype=object).reshape(cross, local)
+        return Mesh(grid, HVD_AXES)
     n_proc = max(1, jax.process_count())
     per_proc = len(devices) // n_proc if n_proc > 1 else len(devices)
     if n_proc > 1 and per_proc * n_proc == len(devices):
@@ -101,6 +115,7 @@ def _build_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
 def init(
     comm=None,
     devices: Optional[Sequence[jax.Device]] = None,
+    mesh_shape: Optional[Tuple[int, int]] = None,
 ) -> None:
     """Initialize the framework (reference: hvd.init(), basics.py:33 →
     InitializeHorovodOnce, operations.cc:628-674).
@@ -125,10 +140,10 @@ def init(
         if comm is not None and devices is None:
             devices = comm  # parity: allow init(devices)
         _state.config = _config.from_env()
-        _state.mesh = _build_mesh(devices)
+        _state.mesh = _build_mesh(devices, mesh_shape)
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
-        _state.local_device_count = _state.mesh.devices.shape[1]
+        _state.local_device_count = int(_state.mesh.devices.shape[1])
         if _state.config.timeline:
             from ..utils.timeline import Timeline
 
