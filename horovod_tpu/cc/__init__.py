@@ -1,0 +1,352 @@
+"""ctypes binding to the native core (libhvdtpu.so).
+
+Reference surface: ``horovod/common/basics.py:22-258`` wraps the C ABI the
+same way (ctypes over operations.cc:685-889). The library is built on demand
+with the in-tree Makefile (g++, no external deps) and cached under
+``cc/build/``.
+
+The native core is the host-side control plane: the rank-0 coordinator
+negotiation loop, response cache, tensor fusion, stall inspector, timeline
+writer, autotuner, and the TCP data plane for eager collectives between
+worker processes. The compiled XLA path (ops/collective_ops.py) never
+touches it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "build", "libhvdtpu.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+# DataType enum values — must match common.h.
+_DTYPE_MAP = {
+    np.dtype(np.uint8): 0,
+    np.dtype(np.int8): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.float16): 4,
+    # bfloat16 handled separately (ml_dtypes), value 5
+    np.dtype(np.float32): 6,
+    np.dtype(np.float64): 7,
+    np.dtype(np.bool_): 8,
+}
+
+try:  # bfloat16 numpy extension (ships with jax)
+    import ml_dtypes
+
+    _DTYPE_MAP[np.dtype(ml_dtypes.bfloat16)] = 5
+except ImportError:  # pragma: no cover
+    pass
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _up_to_date() -> bool:
+    srcdir = os.path.join(_HERE, "src")
+    if not os.path.exists(_LIB_PATH):
+        return False
+    newest_src = max(
+        os.path.getmtime(os.path.join(srcdir, f)) for f in os.listdir(srcdir))
+    return os.path.getmtime(_LIB_PATH) >= newest_src
+
+
+def build(force: bool = False) -> str:
+    """Compile libhvdtpu.so if missing (or ``force``). Returns its path.
+
+    Serialized across processes with an flock: N workers launched together
+    on one host (the launcher's normal mode) must not race `make` on the
+    same build directory.
+    """
+    if not force and _up_to_date():
+        return _LIB_PATH
+    import fcntl
+
+    os.makedirs(os.path.join(_HERE, "build"), exist_ok=True)
+    lock_path = os.path.join(_HERE, "build", ".lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if not force and _up_to_date():  # another process built it
+                return _LIB_PATH
+            jobs = os.cpu_count() or 2
+            proc = subprocess.run(
+                ["make", "-C", _HERE, f"-j{jobs}"],
+                capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native core build failed:\n{proc.stdout}\n{proc.stderr}")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _LIB_PATH
+
+
+def load():
+    """Build (if needed) and load the native library. Thread-safe, cached."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = build()
+        lib = ctypes.CDLL(path)  # CDLL releases the GIL during calls
+        # Signatures.
+        lib.hvdtpu_init.restype = ctypes.c_int
+        lib.hvdtpu_shutdown.restype = None
+        lib.hvdtpu_is_initialized.restype = ctypes.c_int
+        lib.hvdtpu_last_error.restype = ctypes.c_char_p
+        for f in ("rank", "size", "local_rank", "local_size", "cross_rank",
+                  "cross_size"):
+            getattr(lib, f"hvdtpu_{f}").restype = ctypes.c_int
+        lib.hvdtpu_fusion_threshold.restype = ctypes.c_int64
+        lib.hvdtpu_cycle_time_ms.restype = ctypes.c_double
+        lib.hvdtpu_allreduce.restype = ctypes.c_int
+        lib.hvdtpu_allreduce.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_double, ctypes.c_double]
+        lib.hvdtpu_allgather.restype = ctypes.c_int
+        lib.hvdtpu_allgather.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int]
+        lib.hvdtpu_broadcast.restype = ctypes.c_int
+        lib.hvdtpu_broadcast.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
+        lib.hvdtpu_alltoall.restype = ctypes.c_int
+        lib.hvdtpu_alltoall.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvdtpu_join.restype = ctypes.c_int
+        lib.hvdtpu_barrier.restype = ctypes.c_int
+        lib.hvdtpu_poll.restype = ctypes.c_int
+        lib.hvdtpu_poll.argtypes = [ctypes.c_int]
+        lib.hvdtpu_wait.restype = ctypes.c_int
+        lib.hvdtpu_wait.argtypes = [ctypes.c_int]
+        lib.hvdtpu_handle_error.restype = ctypes.c_char_p
+        lib.hvdtpu_handle_error.argtypes = [ctypes.c_int]
+        lib.hvdtpu_result_bytes.restype = ctypes.c_int64
+        lib.hvdtpu_result_bytes.argtypes = [ctypes.c_int]
+        lib.hvdtpu_fetch.restype = None
+        lib.hvdtpu_fetch.argtypes = [ctypes.c_int, ctypes.c_void_p]
+        lib.hvdtpu_join_result.restype = ctypes.c_int
+        lib.hvdtpu_join_result.argtypes = [ctypes.c_int]
+        lib.hvdtpu_recv_splits.restype = ctypes.c_int
+        lib.hvdtpu_recv_splits.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.hvdtpu_release.restype = None
+        lib.hvdtpu_release.argtypes = [ctypes.c_int]
+        lib.hvdtpu_start_timeline.restype = ctypes.c_int
+        lib.hvdtpu_start_timeline.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.hvdtpu_stop_timeline.restype = ctypes.c_int
+        lib.hvdtpu_autotune_active.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def _np_dtype_code(arr: np.ndarray) -> int:
+    code = _DTYPE_MAP.get(arr.dtype)
+    if code is None:
+        raise TypeError(f"dtype {arr.dtype} not supported by the native core")
+    return code
+
+
+def _shape_arg(arr: np.ndarray):
+    shape = (ctypes.c_int64 * max(1, arr.ndim))(*(arr.shape or (1,)))
+    return shape, arr.ndim if arr.ndim > 0 else 1
+
+
+class NativeError(RuntimeError):
+    """An error reported by the native core (precondition/consistency)."""
+
+
+class NativeShutdownError(RuntimeError):
+    """The core aborted (peer lost / shutdown) — maps to
+    HorovodInternalError for the elastic path."""
+
+
+class CoreContext:
+    """Process-level handle to the native runtime.
+
+    One per process, created by ``basics.init`` when the launcher env
+    contract (HOROVOD_RANK/SIZE + controller address) is present.
+    """
+
+    # Reduce op codes (common.h ReduceOp).
+    SUM, MIN, MAX, PRODUCT, ADASUM = 0, 1, 2, 3, 4
+
+    def __init__(self) -> None:
+        self._lib = load()
+        if self._lib.hvdtpu_init() != 0:
+            raise NativeError(
+                self._lib.hvdtpu_last_error().decode() or "init failed")
+
+    # -- world queries --
+    def rank(self) -> int: return self._lib.hvdtpu_rank()
+    def size(self) -> int: return self._lib.hvdtpu_size()
+    def local_rank(self) -> int: return self._lib.hvdtpu_local_rank()
+    def local_size(self) -> int: return self._lib.hvdtpu_local_size()
+    def cross_rank(self) -> int: return self._lib.hvdtpu_cross_rank()
+    def cross_size(self) -> int: return self._lib.hvdtpu_cross_size()
+    def fusion_threshold(self) -> int:
+        return self._lib.hvdtpu_fusion_threshold()
+    def cycle_time_ms(self) -> float:
+        return self._lib.hvdtpu_cycle_time_ms()
+    def autotune_active(self) -> bool:
+        return bool(self._lib.hvdtpu_autotune_active())
+
+    def close(self) -> None:
+        self._lib.hvdtpu_shutdown()
+
+    def is_initialized(self) -> bool:
+        return bool(self._lib.hvdtpu_is_initialized())
+
+    # -- handle plumbing --
+    def _check_handle(self, handle: int, keepalive) -> "NativeHandle":
+        if handle < 0:
+            raise NativeError(self._lib.hvdtpu_last_error().decode())
+        return NativeHandle(self._lib, handle, keepalive)
+
+    # -- collectives (async; return NativeHandle) --
+    def allreduce_async(self, arr: np.ndarray, name: str, op: int = SUM,
+                        prescale: float = 1.0,
+                        postscale: float = 1.0) -> "NativeHandle":
+        arr = np.ascontiguousarray(arr)
+        shape, ndim = _shape_arg(arr)
+        h = self._lib.hvdtpu_allreduce(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p), shape, ndim,
+            _np_dtype_code(arr), op, prescale, postscale)
+        nh = self._check_handle(h, arr)
+        nh.result_array = arr  # reduced in place
+        return nh
+
+    def allgather_async(self, arr: np.ndarray, name: str) -> "NativeHandle":
+        arr = np.ascontiguousarray(arr)
+        shape, ndim = _shape_arg(arr)
+        h = self._lib.hvdtpu_allgather(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p), shape, ndim,
+            _np_dtype_code(arr))
+        nh = self._check_handle(h, arr)
+        nh.gather_row_shape = arr.shape[1:] if arr.ndim else ()
+        nh.gather_dtype = arr.dtype
+        return nh
+
+    def broadcast_async(self, arr: np.ndarray, name: str,
+                        root: int) -> "NativeHandle":
+        arr = np.ascontiguousarray(arr)
+        shape, ndim = _shape_arg(arr)
+        h = self._lib.hvdtpu_broadcast(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p), shape, ndim,
+            _np_dtype_code(arr), root)
+        nh = self._check_handle(h, arr)
+        nh.result_array = arr  # received in place
+        return nh
+
+    def alltoall_async(self, arr: np.ndarray, name: str,
+                       splits: Optional[Sequence[int]] = None
+                       ) -> "NativeHandle":
+        arr = np.ascontiguousarray(arr)
+        shape, ndim = _shape_arg(arr)
+        if splits is not None:
+            sp = (ctypes.c_int64 * len(splits))(*splits)
+            nsp = len(splits)
+        else:
+            sp, nsp = None, 0
+        h = self._lib.hvdtpu_alltoall(
+            name.encode(), arr.ctypes.data_as(ctypes.c_void_p), shape, ndim,
+            _np_dtype_code(arr), sp, nsp)
+        nh = self._check_handle(h, arr)
+        nh.gather_row_shape = arr.shape[1:] if arr.ndim else ()
+        nh.gather_dtype = arr.dtype
+        return nh
+
+    def join_async(self) -> "NativeHandle":
+        return self._check_handle(self._lib.hvdtpu_join(), None)
+
+    def barrier(self) -> None:
+        self._check_handle(self._lib.hvdtpu_barrier(), None).wait()
+
+    # -- timeline --
+    def start_timeline(self, path: str, mark_cycles: bool = False) -> None:
+        self._lib.hvdtpu_start_timeline(path.encode(), int(mark_cycles))
+
+    def stop_timeline(self) -> None:
+        self._lib.hvdtpu_stop_timeline()
+
+
+class NativeHandle:
+    """Async collective handle (reference: torch handle + synchronize,
+    torch/mpi_ops.py:66-161)."""
+
+    def __init__(self, lib, handle: int, keepalive) -> None:
+        self._lib = lib
+        self._handle = handle
+        self._keepalive = keepalive  # pin the input buffer until done
+        self.result_array: Optional[np.ndarray] = None
+        self.gather_row_shape = ()
+        self.gather_dtype = None
+        self._released = False
+
+    def poll(self) -> bool:
+        return bool(self._lib.hvdtpu_poll(self._handle))
+
+    def wait(self):
+        """Block until done; return the result array (in-place ops) or the
+        fetched output (allgather/alltoall)."""
+        status = self._lib.hvdtpu_wait(self._handle)
+        if status == 5:  # IN_PROGRESS cannot be returned by wait
+            raise AssertionError("wait returned IN_PROGRESS")
+        if status != 0:
+            msg = self._lib.hvdtpu_handle_error(self._handle).decode()
+            self.release()
+            if status in (1, 3):  # UNKNOWN_ERROR / ABORTED
+                raise NativeShutdownError(msg)
+            raise NativeError(msg)
+        try:
+            # Cache post-completion metadata before the handle is released.
+            self._join_result = self._lib.hvdtpu_join_result(self._handle)
+            world = self._lib.hvdtpu_size()
+            if world > 0:
+                buf = (ctypes.c_int64 * world)()
+                n = self._lib.hvdtpu_recv_splits(self._handle, buf, world)
+                self._recv_splits = list(buf[:n])
+            if self.result_array is not None:
+                return self.result_array
+            nbytes = self._lib.hvdtpu_result_bytes(self._handle)
+            out = np.empty(nbytes, dtype=np.uint8)
+            if nbytes > 0:
+                self._lib.hvdtpu_fetch(
+                    self._handle, out.ctypes.data_as(ctypes.c_void_p))
+            arr = out.view(self.gather_dtype or np.uint8)
+            row = tuple(self.gather_row_shape)
+            if row:
+                arr = arr.reshape((-1,) + row)
+            return arr
+        finally:
+            self.release()
+
+    def join_result(self) -> int:
+        """Last rank to join (valid after wait)."""
+        return getattr(self, "_join_result", -1)
+
+    def recv_splits(self):
+        """Alltoall rows received per rank (valid after wait)."""
+        return getattr(self, "_recv_splits", [])
+
+    def release(self) -> None:
+        if not self._released:
+            self._lib.hvdtpu_release(self._handle)
+            self._released = True
+            self._keepalive = None

@@ -1,0 +1,64 @@
+#include "adasum.h"
+
+#include <cmath>
+#include <vector>
+
+namespace hvdtpu {
+
+namespace {
+
+template <typename T>
+Status AdasumTyped(Transport& t, T* mine, int64_t count) {
+  int size = t.size(), rank = t.rank();
+  std::vector<T> theirs(static_cast<size_t>(count));
+  for (int d = 1; d < size; d <<= 1) {
+    int partner = rank ^ d;
+    if (!t.RingExchange(partner, mine, static_cast<size_t>(count) * sizeof(T),
+                        partner, theirs.data(),
+                        static_cast<size_t>(count) * sizeof(T))) {
+      return Status::UnknownError("adasum: peer connection lost");
+    }
+    // Deterministic orientation: the lower rank's buffer is `a`
+    // (reference dispatches the same way so both sides compute the
+    // identical combine, adasum.h:101-141).
+    const T* a = (rank & d) == 0 ? mine : theirs.data();
+    const T* b = (rank & d) == 0 ? theirs.data() : mine;
+    double dot = 0.0, na = 0.0, nb = 0.0;
+    for (int64_t i = 0; i < count; ++i) {
+      double ai = static_cast<double>(a[i]), bi = static_cast<double>(b[i]);
+      dot += ai * bi;
+      na += ai * ai;
+      nb += bi * bi;
+    }
+    double acoef = na <= 0.0 ? 1.0 : 1.0 - dot / (2.0 * na);
+    double bcoef = nb <= 0.0 ? 1.0 : 1.0 - dot / (2.0 * nb);
+    for (int64_t i = 0; i < count; ++i) {
+      mine[i] = static_cast<T>(acoef * static_cast<double>(a[i]) +
+                               bcoef * static_cast<double>(b[i]));
+    }
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
+Status AdasumAllreduce(Transport& t, void* buf, int64_t count, DataType dt) {
+  int size = t.size();
+  if ((size & (size - 1)) != 0) {
+    return Status::PreconditionError(
+        "Adasum requires a power-of-2 number of ranks (reference: "
+        "torch/mpi_ops.py:95-115).");
+  }
+  if (size == 1 || count == 0) return Status::OK();
+  switch (dt) {
+    case DataType::HVDTPU_FLOAT32:
+      return AdasumTyped(t, static_cast<float*>(buf), count);
+    case DataType::HVDTPU_FLOAT64:
+      return AdasumTyped(t, static_cast<double*>(buf), count);
+    default:
+      return Status::InvalidArgument(
+          "Adasum host path supports float32/float64 buffers.");
+  }
+}
+
+}  // namespace hvdtpu

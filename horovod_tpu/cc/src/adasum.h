@@ -1,0 +1,30 @@
+// Adasum adaptive-summation allreduce (host path).
+//
+// Reference: horovod/common/ops/adasum/adasum.h — recursive
+// distance-doubling where each pairwise merge computes dot products and
+// squared norms and combines `a*(1 - dot/2|a|²) + b*(1 - dot/2|b|²)` so
+// orthogonal gradient contributions add and parallel ones average
+// (adasum.h:73-141, FusedAllreduce VHDD at 196+). Like the reference's MPI
+// tree (adasum_mpi.cc), ranks must be a power of two
+// (torch/mpi_ops.py:95-115 enforces the same).
+//
+// This host implementation exchanges full buffers per level (log2(N)
+// rounds) instead of vector-halving — numerically identical, simpler, and
+// the eager path is latency- not bandwidth-bound. The compiled TPU path has
+// its own XLA implementation (horovod_tpu/ops/adasum.py).
+#ifndef HVDTPU_ADASUM_H
+#define HVDTPU_ADASUM_H
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+// In-place adasum allreduce of `count` elements. Supports float32/float64
+// (16-bit floats are widened by the caller). Returns PreconditionError for
+// non-power-of-2 world sizes.
+Status AdasumAllreduce(Transport& t, void* buf, int64_t count, DataType dt);
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_ADASUM_H

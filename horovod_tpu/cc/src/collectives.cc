@@ -1,0 +1,269 @@
+#include "collectives.h"
+
+#include <algorithm>
+#include <cstring>
+
+#include "logging.h"
+
+namespace hvdtpu {
+namespace collectives {
+
+namespace {
+
+template <typename T>
+void ReduceTyped(T* dst, const T* src, int64_t n, ReduceOp op) {
+  switch (op) {
+    case ReduceOp::SUM:
+    case ReduceOp::ADASUM:  // data-plane leg of adasum still sums chunks
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] + src[i];
+      break;
+    case ReduceOp::MIN:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::min(dst[i], src[i]);
+      break;
+    case ReduceOp::MAX:
+      for (int64_t i = 0; i < n; ++i) dst[i] = std::max(dst[i], src[i]);
+      break;
+    case ReduceOp::PRODUCT:
+      for (int64_t i = 0; i < n; ++i) dst[i] = dst[i] * src[i];
+      break;
+  }
+}
+
+template <typename Cvt16>
+void Reduce16(uint16_t* dst, const uint16_t* src, int64_t n, ReduceOp op,
+              Cvt16 to_f, uint16_t (*from_f)(float)) {
+  // convert → float op → convert back (reference: float16_sum, half.h:142).
+  for (int64_t i = 0; i < n; ++i) {
+    float a = to_f(dst[i]), b = to_f(src[i]);
+    float r;
+    switch (op) {
+      case ReduceOp::SUM:
+      case ReduceOp::ADASUM: r = a + b; break;
+      case ReduceOp::MIN: r = std::min(a, b); break;
+      case ReduceOp::MAX: r = std::max(a, b); break;
+      case ReduceOp::PRODUCT: r = a * b; break;
+      default: r = a + b;
+    }
+    dst[i] = from_f(r);
+  }
+}
+
+}  // namespace
+
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
+                ReduceOp op) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8:
+    case DataType::HVDTPU_BOOL:
+      ReduceTyped(static_cast<uint8_t*>(dst),
+                  static_cast<const uint8_t*>(src), count, op);
+      break;
+    case DataType::HVDTPU_INT8:
+      ReduceTyped(static_cast<int8_t*>(dst), static_cast<const int8_t*>(src),
+                  count, op);
+      break;
+    case DataType::HVDTPU_INT32:
+      ReduceTyped(static_cast<int32_t*>(dst),
+                  static_cast<const int32_t*>(src), count, op);
+      break;
+    case DataType::HVDTPU_INT64:
+      ReduceTyped(static_cast<int64_t*>(dst),
+                  static_cast<const int64_t*>(src), count, op);
+      break;
+    case DataType::HVDTPU_FLOAT32:
+      ReduceTyped(static_cast<float*>(dst), static_cast<const float*>(src),
+                  count, op);
+      break;
+    case DataType::HVDTPU_FLOAT64:
+      ReduceTyped(static_cast<double*>(dst), static_cast<const double*>(src),
+                  count, op);
+      break;
+    case DataType::HVDTPU_FLOAT16:
+      Reduce16(static_cast<uint16_t*>(dst),
+               static_cast<const uint16_t*>(src), count, op, Fp16ToFloat,
+               FloatToFp16);
+      break;
+    case DataType::HVDTPU_BFLOAT16:
+      Reduce16(static_cast<uint16_t*>(dst),
+               static_cast<const uint16_t*>(src), count, op, Bf16ToFloat,
+               FloatToBf16);
+      break;
+  }
+}
+
+void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor) {
+  if (factor == 1.0) return;
+  switch (dt) {
+    case DataType::HVDTPU_FLOAT32: {
+      float* p = static_cast<float*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i) p[i] *= f;
+      break;
+    }
+    case DataType::HVDTPU_FLOAT64: {
+      double* p = static_cast<double*>(buf);
+      for (int64_t i = 0; i < count; ++i) p[i] *= factor;
+      break;
+    }
+    case DataType::HVDTPU_FLOAT16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToFp16(Fp16ToFloat(p[i]) * f);
+      break;
+    }
+    case DataType::HVDTPU_BFLOAT16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      float f = static_cast<float>(factor);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = FloatToBf16(Bf16ToFloat(p[i]) * f);
+      break;
+    }
+    case DataType::HVDTPU_INT32: {
+      int32_t* p = static_cast<int32_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int32_t>(p[i] * factor);
+      break;
+    }
+    case DataType::HVDTPU_INT64: {
+      int64_t* p = static_cast<int64_t*>(buf);
+      for (int64_t i = 0; i < count; ++i)
+        p[i] = static_cast<int64_t>(p[i] * factor);
+      break;
+    }
+    default:
+      break;  // uint8/int8/bool: scaling is not meaningful
+  }
+}
+
+Status RingAllreduce(Transport& t, void* buf, int64_t count, DataType dt,
+                     ReduceOp op) {
+  int size = t.size(), rank = t.rank();
+  if (size == 1 || count == 0) return Status::OK();
+  size_t es = DataTypeSize(dt);
+  char* base = static_cast<char*>(buf);
+
+  // Chunk boundaries: first (count % size) chunks get one extra element.
+  auto chunk_count = [&](int c) {
+    return count / size + (c < count % size ? 1 : 0);
+  };
+  std::vector<int64_t> offs(static_cast<size_t>(size) + 1, 0);
+  for (int c = 0; c < size; ++c) offs[c + 1] = offs[c] + chunk_count(c);
+
+  int right = (rank + 1) % size;
+  int left = (rank - 1 + size) % size;
+  std::vector<char> recv_tmp(static_cast<size_t>(chunk_count(0)) * es);
+
+  // Reduce-scatter: after step s, the chunk (rank - s) has absorbed s+1
+  // contributions; after size-1 steps rank owns chunk (rank+1)%size fully
+  // reduced (ring structure identical to NCCL's ring allreduce).
+  for (int s = 0; s < size - 1; ++s) {
+    int send_c = ((rank - s) % size + size) % size;
+    int recv_c = ((rank - s - 1) % size + size) % size;
+    int64_t sc = chunk_count(send_c), rc = chunk_count(recv_c);
+    if (!t.RingExchange(right, base + offs[send_c] * es,
+                        static_cast<size_t>(sc) * es, left, recv_tmp.data(),
+                        static_cast<size_t>(rc) * es)) {
+      return Status::UnknownError("ring allreduce: peer connection lost");
+    }
+    ReduceInto(base + offs[recv_c] * es, recv_tmp.data(), rc, dt, op);
+  }
+  // Allgather: circulate the reduced chunks.
+  for (int s = 0; s < size - 1; ++s) {
+    int send_c = ((rank + 1 - s) % size + size) % size;
+    int recv_c = ((rank - s) % size + size) % size;
+    int64_t sc = chunk_count(send_c), rc = chunk_count(recv_c);
+    if (!t.RingExchange(right, base + offs[send_c] * es,
+                        static_cast<size_t>(sc) * es, left,
+                        base + offs[recv_c] * es,
+                        static_cast<size_t>(rc) * es)) {
+      return Status::UnknownError("ring allgather: peer connection lost");
+    }
+  }
+  return Status::OK();
+}
+
+Status AllgatherV(Transport& t, const void* in, int64_t in_bytes,
+                  const std::vector<int64_t>& bytes_per_rank,
+                  std::vector<char>* out) {
+  int size = t.size(), rank = t.rank();
+  std::vector<int64_t> offs(static_cast<size_t>(size) + 1, 0);
+  for (int i = 0; i < size; ++i) offs[i + 1] = offs[i] + bytes_per_rank[i];
+  out->resize(static_cast<size_t>(offs[size]));
+  if (bytes_per_rank[rank] != in_bytes) {
+    return Status::InvalidArgument("allgatherv: local size mismatch");
+  }
+  std::memcpy(out->data() + offs[rank], in, static_cast<size_t>(in_bytes));
+  if (size == 1) return Status::OK();
+  int right = (rank + 1) % size;
+  int left = (rank - 1 + size) % size;
+  // Ring: step s passes block (rank - s) onward.
+  for (int s = 0; s < size - 1; ++s) {
+    int send_b = ((rank - s) % size + size) % size;
+    int recv_b = ((rank - s - 1) % size + size) % size;
+    if (!t.RingExchange(right, out->data() + offs[send_b],
+                        static_cast<size_t>(bytes_per_rank[send_b]), left,
+                        out->data() + offs[recv_b],
+                        static_cast<size_t>(bytes_per_rank[recv_b]))) {
+      return Status::UnknownError("allgatherv: peer connection lost");
+    }
+  }
+  return Status::OK();
+}
+
+Status Broadcast(Transport& t, void* buf, int64_t bytes, int root) {
+  int size = t.size(), rank = t.rank();
+  if (size == 1 || bytes == 0) return Status::OK();
+  // Binomial tree in root-relative rank space: log2(size) rounds.
+  // After round k every vrank < 2^k holds the data; vrank v in
+  // [2^k, 2^{k+1}) receives from v - 2^k.
+  int vrank = ((rank - root) % size + size) % size;
+  for (int step = 1; step < size; step <<= 1) {
+    if (vrank < step) {
+      if (vrank + step < size) {
+        int dst = (vrank + step + root) % size;
+        if (!t.SendToRank(dst, buf, static_cast<size_t>(bytes))) {
+          return Status::UnknownError("broadcast: peer connection lost");
+        }
+      }
+    } else if (vrank < 2 * step) {
+      int src = (vrank - step + root) % size;
+      if (!t.RecvFromRank(src, buf, static_cast<size_t>(bytes))) {
+        return Status::UnknownError("broadcast: peer connection lost");
+      }
+    }
+  }
+  return Status::OK();
+}
+
+Status AllToAllV(Transport& t, const void* in,
+                 const std::vector<int64_t>& send_bytes,
+                 const std::vector<int64_t>& recv_bytes,
+                 std::vector<char>* out) {
+  int size = t.size(), rank = t.rank();
+  std::vector<int64_t> soffs(static_cast<size_t>(size) + 1, 0);
+  std::vector<int64_t> roffs(static_cast<size_t>(size) + 1, 0);
+  for (int i = 0; i < size; ++i) {
+    soffs[i + 1] = soffs[i] + send_bytes[i];
+    roffs[i + 1] = roffs[i] + recv_bytes[i];
+  }
+  out->resize(static_cast<size_t>(roffs[size]));
+  const char* src = static_cast<const char*>(in);
+  std::memcpy(out->data() + roffs[rank], src + soffs[rank],
+              static_cast<size_t>(send_bytes[rank]));
+  // Pairwise rounds: at step s exchange with (rank+s) / (rank-s).
+  for (int s = 1; s < size; ++s) {
+    int to = (rank + s) % size;
+    int from = (rank - s + size) % size;
+    if (!t.RingExchange(to, src + soffs[to],
+                        static_cast<size_t>(send_bytes[to]), from,
+                        out->data() + roffs[from],
+                        static_cast<size_t>(recv_bytes[from]))) {
+      return Status::UnknownError("alltoallv: peer connection lost");
+    }
+  }
+  return Status::OK();
+}
+
+}  // namespace collectives
+}  // namespace hvdtpu

@@ -1,0 +1,57 @@
+// Host data-plane collectives over the TCP mesh.
+//
+// These are the TPU framework's analogue of the reference's Gloo CPU backend
+// (gloo_operations.cc ring/halving-doubling): the eager/host path for
+// metrics, object broadcast, and elastic state sync. The compiled XLA path
+// (jax shard_map + psum over ICI) is the training fast path and never touches
+// these.
+//
+// Algorithms: bandwidth-optimal ring allreduce (reduce-scatter + allgather,
+// the same decomposition as NCCLAllreduce's ring), ring allgatherv (uneven
+// first dims, reference: MPIAllgather's displacement math,
+// collective_operations.cc allgather helpers), binomial-tree broadcast, and
+// pairwise alltoallv (reference: MPI_Alltoallv, mpi_operations.cc).
+#ifndef HVDTPU_COLLECTIVES_H
+#define HVDTPU_COLLECTIVES_H
+
+#include <cstdint>
+#include <vector>
+
+#include "common.h"
+#include "transport.h"
+
+namespace hvdtpu {
+namespace collectives {
+
+// In-place sum/min/max/prod allreduce of `count` elements.
+Status RingAllreduce(Transport& t, void* buf, int64_t count, DataType dt,
+                     ReduceOp op);
+
+// Gather variable-sized byte blocks; `out` = blocks concatenated by rank.
+Status AllgatherV(Transport& t, const void* in, int64_t in_bytes,
+                  const std::vector<int64_t>& bytes_per_rank,
+                  std::vector<char>* out);
+
+// Broadcast `bytes` from `root` (binomial tree, log2(size) rounds).
+Status Broadcast(Transport& t, void* buf, int64_t bytes, int root);
+
+// Pairwise exchange: send_bytes[i] bytes go to rank i (taken sequentially
+// from `in`), recv_bytes[i] land in `out` at rank-i offset.
+Status AllToAllV(Transport& t, const void* in,
+                 const std::vector<int64_t>& send_bytes,
+                 const std::vector<int64_t>& recv_bytes,
+                 std::vector<char>* out);
+
+// dst[i] = dst[i] (op) src[i] — the reduction kernel under the ring
+// (reference: the MPI op table + float16_sum custom op, half.h:142).
+void ReduceInto(void* dst, const void* src, int64_t count, DataType dt,
+                ReduceOp op);
+
+// In-place multiply by `factor` (reference: ScaleBufferCPUImpl,
+// collective_operations.h:89-125).
+void ScaleBuffer(void* buf, int64_t count, DataType dt, double factor);
+
+}  // namespace collectives
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_COLLECTIVES_H

@@ -1,0 +1,107 @@
+#include "common.h"
+
+#include <cstdlib>
+
+namespace hvdtpu {
+
+const char* DataTypeName(DataType dt) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8: return "uint8";
+    case DataType::HVDTPU_INT8: return "int8";
+    case DataType::HVDTPU_INT32: return "int32";
+    case DataType::HVDTPU_INT64: return "int64";
+    case DataType::HVDTPU_FLOAT16: return "float16";
+    case DataType::HVDTPU_BFLOAT16: return "bfloat16";
+    case DataType::HVDTPU_FLOAT32: return "float32";
+    case DataType::HVDTPU_FLOAT64: return "float64";
+    case DataType::HVDTPU_BOOL: return "bool";
+  }
+  return "unknown";
+}
+
+// IEEE fp16 software conversion (reference keeps an AVX/F16C fast path in
+// half.h:142; plain bit manipulation is plenty for the host control plane).
+float Fp16ToFloat(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;  // +-0
+    } else {
+      // subnormal: normalize
+      exp = 127 - 15 + 1;
+      while ((mant & 0x400u) == 0) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ffu;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (mant << 13);  // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+uint16_t FloatToFp16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp >= 0x1f) {
+    // overflow / inf / nan
+    uint32_t m = ((bits >> 23) & 0xff) == 0xff && mant ? 0x200u : 0;
+    return static_cast<uint16_t>(sign | 0x7c00u | m);
+  }
+  if (exp <= 0) {
+    if (exp < -10) return static_cast<uint16_t>(sign);  // underflow to 0
+    // subnormal
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half_mant = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half_mant & 1))) half_mant++;
+    return static_cast<uint16_t>(sign | half_mant);
+  }
+  uint32_t half_mant = mant >> 13;
+  uint32_t rem = mant & 0x1fffu;
+  uint16_t out =
+      static_cast<uint16_t>(sign | (static_cast<uint32_t>(exp) << 10) | half_mant);
+  if (rem > 0x1000u || (rem == 0x1000u && (out & 1))) out++;
+  return out;
+}
+
+int64_t EnvInt64(const char* name, int64_t dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return std::strtoll(v, nullptr, 10);
+}
+
+double EnvDouble(const char* name, double dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return std::strtod(v, nullptr);
+}
+
+bool EnvBool(const char* name, bool dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr || *v == '\0') return dflt;
+  return !(std::string(v) == "0" || std::string(v) == "false" ||
+           std::string(v) == "False" || std::string(v) == "");
+}
+
+std::string EnvString(const char* name, const std::string& dflt) {
+  const char* v = std::getenv(name);
+  if (v == nullptr) return dflt;
+  return std::string(v);
+}
+
+}  // namespace hvdtpu

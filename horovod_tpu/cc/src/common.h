@@ -1,0 +1,164 @@
+// horovod_tpu native core: shared types.
+//
+// TPU-native rebuild of the reference's framework-neutral core types
+// (reference: horovod/common/common.h:31-258, half.h). The native core is the
+// host-side control plane: it negotiates readiness across worker processes
+// (one per TPU host), runs the eager/host data plane over TCP, and feeds the
+// compiled XLA path with a learned static schedule. No CUDA, no MPI.
+#ifndef HVDTPU_COMMON_H
+#define HVDTPU_COMMON_H
+
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+// Wire/compute dtypes (reference: DataType, common.h message dtypes).
+enum class DataType : uint8_t {
+  HVDTPU_UINT8 = 0,
+  HVDTPU_INT8 = 1,
+  HVDTPU_INT32 = 2,
+  HVDTPU_INT64 = 3,
+  HVDTPU_FLOAT16 = 4,
+  HVDTPU_BFLOAT16 = 5,
+  HVDTPU_FLOAT32 = 6,
+  HVDTPU_FLOAT64 = 7,
+  HVDTPU_BOOL = 8,
+};
+
+inline size_t DataTypeSize(DataType dt) {
+  switch (dt) {
+    case DataType::HVDTPU_UINT8:
+    case DataType::HVDTPU_INT8:
+    case DataType::HVDTPU_BOOL:
+      return 1;
+    case DataType::HVDTPU_FLOAT16:
+    case DataType::HVDTPU_BFLOAT16:
+      return 2;
+    case DataType::HVDTPU_INT32:
+    case DataType::HVDTPU_FLOAT32:
+      return 4;
+    case DataType::HVDTPU_INT64:
+    case DataType::HVDTPU_FLOAT64:
+      return 8;
+  }
+  return 0;
+}
+
+const char* DataTypeName(DataType dt);
+
+// Reduction ops for allreduce (reference: ReduceOp in torch/mpi_ops.py:48-56;
+// Sum is the wire op, Average is Sum + postscale, Adasum is its own path).
+enum class ReduceOp : uint8_t {
+  SUM = 0,
+  MIN = 1,
+  MAX = 2,
+  PRODUCT = 3,
+  ADASUM = 4,
+};
+
+// Status codes (reference: StatusType, common.h:132-150).
+enum class StatusType : uint8_t {
+  OK = 0,
+  UNKNOWN_ERROR = 1,
+  PRECONDITION_ERROR = 2,
+  ABORTED = 3,
+  INVALID_ARGUMENT = 4,
+  IN_PROGRESS = 5,
+};
+
+class Status {
+ public:
+  Status() = default;
+  static Status OK() { return Status(); }
+  static Status UnknownError(const std::string& m) {
+    return Status(StatusType::UNKNOWN_ERROR, m);
+  }
+  static Status PreconditionError(const std::string& m) {
+    return Status(StatusType::PRECONDITION_ERROR, m);
+  }
+  static Status Aborted(const std::string& m) {
+    return Status(StatusType::ABORTED, m);
+  }
+  static Status InvalidArgument(const std::string& m) {
+    return Status(StatusType::INVALID_ARGUMENT, m);
+  }
+  static Status InProgress() { return Status(StatusType::IN_PROGRESS, ""); }
+  bool ok() const { return type_ == StatusType::OK; }
+  bool in_progress() const { return type_ == StatusType::IN_PROGRESS; }
+  StatusType type() const { return type_; }
+  const std::string& reason() const { return reason_; }
+
+ private:
+  Status(StatusType t, std::string r) : type_(t), reason_(std::move(r)) {}
+  StatusType type_ = StatusType::OK;
+  std::string reason_;
+};
+
+// bf16 <-> f32 (truncation / round-to-nearest-even) and fp16 <-> f32 software
+// conversion for host-side reductions (reference: half.{h,cc} float16 sum
+// with the same convert-accumulate-convert structure).
+inline float Bf16ToFloat(uint16_t v) {
+  uint32_t bits = static_cast<uint32_t>(v) << 16;
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+inline uint16_t FloatToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // round to nearest even
+  uint32_t rounding = 0x7fff + ((bits >> 16) & 1);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+float Fp16ToFloat(uint16_t h);
+uint16_t FloatToFp16(float f);
+
+// Duplicate-name message (reference: DUPLICATE_NAME_ERROR, common.h:163-166).
+#define HVDTPU_DUPLICATE_NAME_ERROR                                         \
+  "Requested to collect a tensor with the same name as another tensor "     \
+  "that is currently being processed. If you want to request another "      \
+  "tensor, use a different tensor name."
+
+// Environment knob names. Same contract as the reference
+// (common.h:64-90, gloo_run.py:65-76) so launcher/env docs carry over.
+#define HVDTPU_ENV_RANK "HOROVOD_RANK"
+#define HVDTPU_ENV_SIZE "HOROVOD_SIZE"
+#define HVDTPU_ENV_LOCAL_RANK "HOROVOD_LOCAL_RANK"
+#define HVDTPU_ENV_LOCAL_SIZE "HOROVOD_LOCAL_SIZE"
+#define HVDTPU_ENV_CROSS_RANK "HOROVOD_CROSS_RANK"
+#define HVDTPU_ENV_CROSS_SIZE "HOROVOD_CROSS_SIZE"
+#define HVDTPU_ENV_CONTROLLER_ADDR "HOROVOD_CONTROLLER_ADDR"
+#define HVDTPU_ENV_CONTROLLER_PORT "HOROVOD_CONTROLLER_PORT"
+#define HVDTPU_ENV_FUSION_THRESHOLD "HOROVOD_FUSION_THRESHOLD"
+#define HVDTPU_ENV_CYCLE_TIME "HOROVOD_CYCLE_TIME"
+#define HVDTPU_ENV_CACHE_CAPACITY "HOROVOD_CACHE_CAPACITY"
+#define HVDTPU_ENV_TIMELINE "HOROVOD_TIMELINE"
+#define HVDTPU_ENV_TIMELINE_MARK_CYCLES "HOROVOD_TIMELINE_MARK_CYCLES"
+#define HVDTPU_ENV_STALL_CHECK_DISABLE "HOROVOD_STALL_CHECK_DISABLE"
+#define HVDTPU_ENV_STALL_CHECK_TIME "HOROVOD_STALL_CHECK_TIME_SECONDS"
+#define HVDTPU_ENV_STALL_SHUTDOWN_TIME "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+#define HVDTPU_ENV_AUTOTUNE "HOROVOD_AUTOTUNE"
+#define HVDTPU_ENV_AUTOTUNE_LOG "HOROVOD_AUTOTUNE_LOG"
+#define HVDTPU_ENV_AUTOTUNE_WARMUP_SAMPLES "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+#define HVDTPU_ENV_AUTOTUNE_STEPS_PER_SAMPLE "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+#define HVDTPU_ENV_AUTOTUNE_BAYES_OPT_MAX_SAMPLES \
+  "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES"
+#define HVDTPU_ENV_AUTOTUNE_GAUSSIAN_PROCESS_NOISE \
+  "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE"
+
+// Env parsing helpers (reference: utils/env_parser.{h,cc}).
+int64_t EnvInt64(const char* name, int64_t dflt);
+double EnvDouble(const char* name, double dflt);
+bool EnvBool(const char* name, bool dflt);
+std::string EnvString(const char* name, const std::string& dflt);
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_COMMON_H

@@ -1,0 +1,529 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <deque>
+#include <numeric>
+#include <sstream>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+int64_t ElementCount(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+// Canonical shape used for cache validation. Allreduce is shape-agnostic on
+// the wire (the fused buffer is flat), so its cache key is the flattened
+// count — this also lets fused responses be split back into cacheable
+// singles without carrying per-tensor shapes (the reference's
+// ResponseCache::put does the same split using local entry params).
+std::vector<int64_t> CacheKeyShape(const Request& req) {
+  if (req.request_type == Request::ALLREDUCE ||
+      req.request_type == Request::ADASUM) {
+    return {ElementCount(req.tensor_shape)};
+  }
+  return req.tensor_shape;
+}
+
+Request CanonicalizedForCache(const Request& req) {
+  Request c = req;
+  c.tensor_shape = CacheKeyShape(req);
+  return c;
+}
+
+bool IsDataResponse(Response::Type t) {
+  return t == Response::ALLREDUCE || t == Response::ADASUM ||
+         t == Response::ALLGATHER || t == Response::BROADCAST ||
+         t == Response::ALLTOALL;
+}
+
+}  // namespace
+
+bool Controller::IncrementTensorCount(const Request& req) {
+  auto& p = message_table_[req.tensor_name];
+  if (p.ready_ranks.insert(req.request_rank).second) {
+    p.requests.push_back(req);
+  }
+  if (timeline_ != nullptr) {
+    if (p.ready_ranks.size() == 1) {
+      timeline_->NegotiateStart(req.tensor_name,
+                                Request::TypeName(req.request_type));
+    }
+    timeline_->NegotiateRankReady(req.tensor_name, req.request_rank);
+  }
+  if (stall_ != nullptr) {
+    stall_->RecordUncachedTensorRank(req.tensor_name, req.request_rank);
+  }
+  size_t required = static_cast<size_t>(size_) - joined_ranks_.size();
+  return p.ready_ranks.size() >= required;
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  // Cross-rank consistency validation — the "distributed sanitizer"
+  // (reference: ConstructResponse, controller.cc:380-657). Must run before
+  // any data hits the wire or compiled code, so mismatches surface as clear
+  // errors instead of corrupt reductions.
+  PendingTensor p = std::move(message_table_[name]);
+  message_table_.erase(name);
+  if (stall_ != nullptr) stall_->RemoveUncachedTensor(name);
+  if (timeline_ != nullptr) timeline_->NegotiateEnd(name);
+
+  const Request& first = p.requests[0];
+  Response resp;
+  resp.tensor_names = {name};
+  resp.tensor_type = first.tensor_type;
+  resp.prescale_factor = first.prescale_factor;
+  resp.postscale_factor = first.postscale_factor;
+  resp.reduce_op = first.reduce_op;
+  resp.root_rank = first.root_rank;
+  auto fail = [&](const std::string& msg) {
+    Response e;
+    e.response_type = Response::ERROR;
+    e.tensor_names = {name};
+    e.error_message = msg;
+    return e;
+  };
+
+  std::ostringstream err;
+  for (size_t i = 1; i < p.requests.size(); ++i) {
+    const Request& r = p.requests[i];
+    if (r.request_type != first.request_type) {
+      err << "Mismatched collective operations: rank " << first.request_rank
+          << " requested " << Request::TypeName(first.request_type)
+          << " but rank " << r.request_rank << " requested "
+          << Request::TypeName(r.request_type) << ".";
+      return fail(err.str());
+    }
+    if (r.tensor_type != first.tensor_type) {
+      err << "Mismatched data types: rank " << first.request_rank << " has "
+          << DataTypeName(first.tensor_type) << " but rank " << r.request_rank
+          << " has " << DataTypeName(r.tensor_type) << ".";
+      return fail(err.str());
+    }
+    if (r.prescale_factor != first.prescale_factor ||
+        r.postscale_factor != first.postscale_factor) {
+      return fail("Mismatched prescale/postscale factors across ranks.");
+    }
+  }
+
+  switch (first.request_type) {
+    case Request::ALLREDUCE:
+    case Request::ADASUM: {
+      for (size_t i = 1; i < p.requests.size(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape != first.tensor_shape) {
+          err << "Mismatched allreduce tensor shapes: rank "
+              << first.request_rank << " and rank " << r.request_rank
+              << " disagree for tensor " << name << ".";
+          return fail(err.str());
+        }
+        if (r.reduce_op != first.reduce_op) {
+          return fail("Mismatched reduce ops across ranks for tensor " +
+                      name + ".");
+        }
+      }
+      resp.response_type = first.request_type == Request::ADASUM
+                               ? Response::ADASUM
+                               : Response::ALLREDUCE;
+      resp.tensor_sizes = {ElementCount(first.tensor_shape)};
+      resp.cache_shape = {ElementCount(first.tensor_shape)};
+      break;
+    }
+    case Request::BROADCAST: {
+      for (size_t i = 1; i < p.requests.size(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape != first.tensor_shape) {
+          return fail("Mismatched broadcast tensor shapes across ranks for "
+                      "tensor " + name + ".");
+        }
+        if (r.root_rank != first.root_rank) {
+          err << "Mismatched broadcast root ranks: rank "
+              << first.request_rank << " specified root "
+              << first.root_rank << " but rank " << r.request_rank
+              << " specified root " << r.root_rank << ".";
+          return fail(err.str());
+        }
+      }
+      if (joined_ranks_.count(first.root_rank) != 0) {
+        return fail("Broadcast root rank " +
+                    std::to_string(first.root_rank) + " has joined.");
+      }
+      resp.response_type = Response::BROADCAST;
+      resp.tensor_sizes = {ElementCount(first.tensor_shape)};
+      resp.cache_shape = first.tensor_shape;
+      break;
+    }
+    case Request::ALLGATHER: {
+      // First dims may differ; the rest must match
+      // (reference: controller.cc allgather leg).
+      for (size_t i = 1; i < p.requests.size(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape.size() != first.tensor_shape.size()) {
+          return fail("Mismatched allgather tensor ranks for tensor " +
+                      name + ".");
+        }
+        for (size_t d = 1; d < first.tensor_shape.size(); ++d) {
+          if (r.tensor_shape[d] != first.tensor_shape[d]) {
+            return fail("Mismatched allgather non-first dimensions for "
+                        "tensor " + name + ".");
+          }
+        }
+      }
+      if (first.tensor_shape.empty()) {
+        return fail("Allgather requires at least a 1-D tensor.");
+      }
+      resp.response_type = Response::ALLGATHER;
+      resp.tensor_sizes.assign(static_cast<size_t>(size_), 0);
+      for (const Request& r : p.requests) {
+        resp.tensor_sizes[r.request_rank] = r.tensor_shape[0];
+      }
+      resp.cache_shape = first.tensor_shape;  // representative row shape
+      break;
+    }
+    case Request::ALLTOALL: {
+      for (size_t i = 1; i < p.requests.size(); ++i) {
+        const Request& r = p.requests[i];
+        if (r.tensor_shape.size() != first.tensor_shape.size()) {
+          return fail("Mismatched alltoall tensor ranks for tensor " + name +
+                      ".");
+        }
+        for (size_t d = 1; d < first.tensor_shape.size(); ++d) {
+          if (r.tensor_shape[d] != first.tensor_shape[d]) {
+            return fail("Mismatched alltoall non-first dimensions for "
+                        "tensor " + name + ".");
+          }
+        }
+      }
+      if (first.tensor_shape.empty()) {
+        return fail("Alltoall requires at least a 1-D tensor.");
+      }
+      // Build the size×size split matrix [src*size+dst] (the reference
+      // exchanges recv splits via AlltoallGetRecvSplits,
+      // controller.h:148-151; we centralize it in the response).
+      resp.response_type = Response::ALLTOALL;
+      resp.tensor_sizes.assign(static_cast<size_t>(size_) * size_, 0);
+      for (const Request& r : p.requests) {
+        std::vector<int64_t> splits = r.splits;
+        if (splits.empty()) {
+          if (r.tensor_shape[0] % size_ != 0) {
+            return fail("Alltoall first dimension (" +
+                        std::to_string(r.tensor_shape[0]) +
+                        ") is not divisible by the world size and no splits "
+                        "were provided for tensor " + name + ".");
+          }
+          splits.assign(static_cast<size_t>(size_),
+                        r.tensor_shape[0] / size_);
+        }
+        if (static_cast<int>(splits.size()) != size_) {
+          return fail("Alltoall splits length must equal the world size for "
+                      "tensor " + name + ".");
+        }
+        int64_t total = std::accumulate(splits.begin(), splits.end(),
+                                        int64_t{0});
+        if (total != r.tensor_shape[0]) {
+          return fail("Alltoall splits sum (" + std::to_string(total) +
+                      ") does not match the first dimension (" +
+                      std::to_string(r.tensor_shape[0]) + ") on rank " +
+                      std::to_string(r.request_rank) + ".");
+        }
+        for (int dst = 0; dst < size_; ++dst) {
+          resp.tensor_sizes[static_cast<size_t>(r.request_rank) * size_ +
+                            dst] = splits[dst];
+        }
+      }
+      resp.cache_shape = first.tensor_shape;  // representative row shape
+      break;
+    }
+    case Request::BARRIER: {
+      resp.response_type = Response::BARRIER;
+      break;
+    }
+    case Request::JOIN:
+      break;  // handled in CoordinatorCycle
+  }
+  return resp;
+}
+
+void Controller::CollectNewlyCompleteTensors(std::vector<Response>* out) {
+  size_t required = static_cast<size_t>(size_) - joined_ranks_.size();
+  std::vector<std::string> fire;
+  for (auto& kv : message_table_) {
+    if (kv.second.ready_ranks.size() >= required) fire.push_back(kv.first);
+  }
+  std::sort(fire.begin(), fire.end());  // deterministic order
+  for (auto& name : fire) out->push_back(ConstructResponse(name));
+}
+
+std::vector<Response> Controller::FuseResponses(
+    std::vector<Response> responses, int64_t threshold_bytes) {
+  // Greedy packing with look-ahead over the whole list (reference:
+  // FuseResponses, controller.cc:686-809 — scans past non-matching
+  // responses so mixed dtypes don't break fusion runs).
+  std::deque<Response> queue(std::make_move_iterator(responses.begin()),
+                             std::make_move_iterator(responses.end()));
+  std::vector<Response> out;
+  while (!queue.empty()) {
+    Response r = std::move(queue.front());
+    queue.pop_front();
+    if (r.response_type == Response::ALLREDUCE ||
+        r.response_type == Response::ADASUM) {
+      size_t es = DataTypeSize(r.tensor_type);
+      int64_t bytes = 0;
+      for (auto c : r.tensor_sizes) bytes += c * static_cast<int64_t>(es);
+      for (auto it = queue.begin();
+           it != queue.end() && bytes < threshold_bytes;) {
+        const Response& s = *it;
+        if (s.response_type == r.response_type &&
+            s.tensor_type == r.tensor_type &&
+            s.reduce_op == r.reduce_op &&
+            s.prescale_factor == r.prescale_factor &&
+            s.postscale_factor == r.postscale_factor) {
+          int64_t sbytes = 0;
+          for (auto c : s.tensor_sizes)
+            sbytes += c * static_cast<int64_t>(es);
+          if (bytes + sbytes <= threshold_bytes) {
+            r.tensor_names.insert(r.tensor_names.end(),
+                                  s.tensor_names.begin(),
+                                  s.tensor_names.end());
+            r.tensor_sizes.insert(r.tensor_sizes.end(),
+                                  s.tensor_sizes.begin(),
+                                  s.tensor_sizes.end());
+            bytes += sbytes;
+            it = queue.erase(it);
+            continue;
+          }
+        }
+        ++it;
+      }
+      if (r.tensor_names.size() > 1) r.cache_shape.clear();
+    }
+    out.push_back(std::move(r));
+  }
+  return out;
+}
+
+ResponseList Controller::CoordinatorCycle(std::vector<RequestList> rank_lists,
+                                          int64_t fusion_threshold_bytes) {
+  ResponseList final_list;
+
+  // Shutdown latch: any rank asking out takes the whole job down together
+  // (reference: RequestList shutdown bit).
+  for (const auto& l : rank_lists) {
+    if (l.shutdown) shutdown_latch_ = true;
+  }
+
+  // --- cache coordination (reference: controller.cc:75-164) ---
+  // Agreed hits = bitwise AND over all ranks (joined ranks vote "all yes");
+  // invalidations = bitwise OR.
+  std::vector<int64_t> agreed;
+  bool first_vote = true;
+  std::vector<int64_t> invalid_words;
+  for (int r = 0; r < size_; ++r) {
+    const auto& l = rank_lists[r];
+    for (size_t w = 0; w < l.invalid_bits.size(); ++w) {
+      if (w >= invalid_words.size()) invalid_words.resize(w + 1, 0);
+      invalid_words[w] |= l.invalid_bits[w];
+    }
+    if (l.joined) continue;  // all-ones vote: does not constrain the AND
+    if (first_vote) {
+      agreed = l.cache_bits;
+      first_vote = false;
+    } else {
+      agreed = AndWords(agreed, l.cache_bits);
+    }
+  }
+  // Remove invalidated bits from the agreed set.
+  for (size_t w = 0; w < agreed.size() && w < invalid_words.size(); ++w) {
+    agreed[w] &= ~invalid_words[w];
+  }
+  final_list.invalid_bits = invalid_words;
+
+  std::vector<Response> responses;
+  // Cached responses fire first, ordered by bit index — identical on every
+  // rank by construction.
+  for (uint32_t bit : UnpackBits(agreed)) {
+    if (!cache_->has_bit(bit)) continue;
+    cache_->touch(bit);
+    responses.push_back(cache_->get_response(bit));
+  }
+
+  // --- negotiation of uncached tensors ---
+  bool joined_grew = false;
+  for (int r = 0; r < size_; ++r) {
+    for (const Request& req : rank_lists[r].requests) {
+      if (req.request_type == Request::JOIN) {
+        if (joined_ranks_.insert(req.request_rank).second) {
+          last_joined_rank_ = req.request_rank;
+          joined_grew = true;
+        }
+        continue;
+      }
+      if (IncrementTensorCount(req)) {
+        responses.push_back(ConstructResponse(req.tensor_name));
+      }
+    }
+  }
+  // Ranks joining lowers the participation requirement; re-scan
+  // (reference: join handling in ComputeResponseList).
+  if (joined_grew) CollectNewlyCompleteTensors(&responses);
+
+  if (static_cast<int>(joined_ranks_.size()) == size_) {
+    Response j;
+    j.response_type = Response::JOIN;
+    j.last_joined_rank = last_joined_rank_;
+    responses.push_back(j);
+    joined_ranks_.clear();
+    last_joined_rank_ = -1;
+  }
+
+  // Stall detection on whatever is still pending.
+  if (stall_ != nullptr && stall_->CheckForStalledTensors()) {
+    shutdown_latch_ = true;
+  }
+
+  final_list.responses =
+      FuseResponses(std::move(responses), fusion_threshold_bytes);
+  final_list.shutdown = shutdown_latch_;
+
+  if (autotune_hook) {
+    int64_t fuse = 0;
+    double cyc = 0.0;
+    if (autotune_hook(final_list.responses, &fuse, &cyc)) {
+      final_list.has_tuned_params = true;
+      final_list.tuned_fusion_threshold = fuse;
+      final_list.tuned_cycle_time_ms = cyc;
+    }
+  }
+  return final_list;
+}
+
+void Controller::ApplyResponseList(const ResponseList& final_list,
+                                   CycleResult* out) {
+  // 1. Agreed evictions — every rank drops the same bits so numbering stays
+  // aligned. Pending hit requests whose entry got evicted are resubmitted
+  // as uncached next cycle.
+  for (uint32_t bit : UnpackBits(final_list.invalid_bits)) {
+    if (!cache_->has_bit(bit)) continue;
+    Response victim = cache_->get_response(bit);
+    const std::string& name = victim.tensor_names[0];
+    auto it = pending_cached_.find(name);
+    if (it != pending_cached_.end()) {
+      resend_uncached_.push_back(it->second);
+      pending_cached_.erase(it);
+    }
+    cache_->erase_response(bit);
+  }
+
+  // 2. Cache insertions: split fused responses into per-tensor singles on
+  // every rank identically (reference: ResponseCache::put on the received
+  // list splits fused responses the same way).
+  for (const Response& resp : final_list.responses) {
+    if (resp.response_type == Response::JOIN) {
+      self_joined_ = false;
+      continue;
+    }
+    if (!IsDataResponse(resp.response_type)) continue;
+    if (resp.tensor_names.size() == 1) {
+      if (!resp.cache_shape.empty()) cache_->put(resp);
+    } else {
+      for (size_t i = 0; i < resp.tensor_names.size(); ++i) {
+        Response single = resp;
+        single.tensor_names = {resp.tensor_names[i]};
+        single.tensor_sizes = {resp.tensor_sizes[i]};
+        single.cache_shape = {resp.tensor_sizes[i]};
+        cache_->put(single);
+      }
+    }
+    // Fired cached-hit requests are no longer pending.
+    for (const auto& name : resp.tensor_names) pending_cached_.erase(name);
+  }
+
+  if (final_list.has_tuned_params) {
+    out->tuned_fusion_threshold = final_list.tuned_fusion_threshold;
+    out->tuned_cycle_time_ms = final_list.tuned_cycle_time_ms;
+  }
+  out->responses = final_list.responses;
+  out->shutdown = final_list.shutdown;
+}
+
+Controller::CycleResult Controller::RunCycle(bool request_shutdown,
+                                             int64_t fusion_threshold_bytes) {
+  CycleResult result;
+  if (timeline_ != nullptr) timeline_->MarkCycleStart();
+
+  // Classify newly ready tensors: cache hit / invalid / uncached.
+  RequestList mine;
+  mine.shutdown = request_shutdown;
+  mine.joined = self_joined_;
+  mine.requests = std::move(resend_uncached_);
+  resend_uncached_.clear();
+  for (Request& req : tensor_queue_->PopMessages()) {
+    if (req.request_type == Request::JOIN) {
+      self_joined_ = true;
+      mine.joined = true;
+      mine.requests.push_back(std::move(req));
+      continue;
+    }
+    Request canon = CanonicalizedForCache(req);
+    switch (cache_->cached(canon)) {
+      case ResponseCache::CacheState::HIT:
+        pending_cached_.emplace(req.tensor_name, std::move(req));
+        break;
+      case ResponseCache::CacheState::INVALID:
+        my_invalid_bits_.push_back(cache_->peek_cache_bit(canon));
+        // Held locally; resent once the eviction round-trips.
+        pending_cached_.emplace(req.tensor_name, std::move(req));
+        break;
+      case ResponseCache::CacheState::MISS:
+        mine.requests.push_back(std::move(req));
+        break;
+    }
+  }
+  // Vote all currently pending hits (re-voted every cycle until they fire).
+  {
+    std::vector<uint32_t> bits;
+    for (const auto& kv : pending_cached_) {
+      Request canon = CanonicalizedForCache(kv.second);
+      if (cache_->cached(canon) == ResponseCache::CacheState::HIT) {
+        bits.push_back(cache_->peek_cache_bit(canon));
+      }
+    }
+    mine.cache_bits = PackBits(bits, cache_->num_active_bits());
+  }
+  mine.invalid_bits = PackBits(my_invalid_bits_, cache_->num_active_bits());
+  my_invalid_bits_.clear();
+
+  ResponseList final_list;
+  if (size_ == 1) {
+    final_list = CoordinatorCycle({std::move(mine)}, fusion_threshold_bytes);
+  } else if (is_coordinator()) {
+    std::vector<RequestList> rank_lists;
+    if (!transport_->GatherRequestLists(&rank_lists)) {
+      result.transport_failure = true;
+      return result;
+    }
+    rank_lists[0] = std::move(mine);
+    final_list = CoordinatorCycle(std::move(rank_lists),
+                                  fusion_threshold_bytes);
+    if (!transport_->BcastResponseList(final_list)) {
+      result.transport_failure = true;
+      return result;
+    }
+  } else {
+    if (!transport_->SendRequestList(mine) ||
+        !transport_->RecvResponseList(&final_list)) {
+      result.transport_failure = true;
+      return result;
+    }
+  }
+
+  ApplyResponseList(final_list, &result);
+  return result;
+}
+
+}  // namespace hvdtpu

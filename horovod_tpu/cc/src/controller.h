@@ -1,0 +1,121 @@
+// Rank-0 coordinator negotiation protocol.
+//
+// Reference: horovod/common/controller.{h,cc}. The protocol
+// (controller.h:63-100): every cycle each rank reports which tensors became
+// ready; the coordinator counts submissions per tensor
+// (IncrementTensorCount, controller.cc:837-860), and when every
+// participating rank has submitted a tensor it validates cross-rank
+// consistency and builds a Response (ConstructResponse,
+// controller.cc:380-657), packs small allreduces under the fusion threshold
+// (FuseResponses, controller.cc:686-809), and broadcasts the ordered
+// ResponseList that every rank then executes identically. A bit-indexed
+// response cache short-circuits negotiation for previously seen tensors
+// (controller.cc:75-164) — the steady-state fast path.
+#ifndef HVDTPU_CONTROLLER_H
+#define HVDTPU_CONTROLLER_H
+
+#include <cstdint>
+#include <functional>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+
+class Controller {
+ public:
+  Controller(int rank, int size, Transport* transport, TensorQueue* queue,
+             ResponseCache* cache, StallInspector* stall, Timeline* timeline)
+      : rank_(rank),
+        size_(size),
+        transport_(transport),
+        tensor_queue_(queue),
+        cache_(cache),
+        stall_(stall),
+        timeline_(timeline) {}
+
+  struct CycleResult {
+    std::vector<Response> responses;  // ordered, identical on every rank
+    bool shutdown = false;
+    bool transport_failure = false;
+    int64_t tuned_fusion_threshold = 0;   // nonzero → apply
+    double tuned_cycle_time_ms = 0.0;     // nonzero → apply
+  };
+
+  // One negotiation cycle (reference: ComputeResponseList,
+  // controller.cc:63-358). `request_shutdown` = this process wants out.
+  // Joined state is tracked internally from JOIN requests.
+  CycleResult RunCycle(bool request_shutdown, int64_t fusion_threshold_bytes);
+
+  bool is_coordinator() const { return rank_ == 0; }
+  bool self_joined() const { return self_joined_; }
+
+  // Coordinator-side autotune hook (set by operations.cc when
+  // HOROVOD_AUTOTUNE=1): called once per cycle with the negotiated
+  // responses; returns true + new params when a new setting should be
+  // broadcast (reference: parameter_manager.Update / SynchronizeParameters,
+  // operations.cc:614-621, controller.cc:34-48).
+  std::function<bool(const std::vector<Response>&, int64_t*, double*)>
+      autotune_hook;
+
+ private:
+  // -- coordinator state --
+  struct PendingTensor {
+    std::vector<Request> requests;  // one per submitting rank
+    std::set<int> ready_ranks;
+  };
+
+  // Returns true when all participating (non-joined) ranks have submitted
+  // (reference: IncrementTensorCount, controller.cc:837-860).
+  bool IncrementTensorCount(const Request& req);
+  // Cross-rank consistency validation + response construction (reference:
+  // ConstructResponse, controller.cc:380-657).
+  Response ConstructResponse(const std::string& name);
+  // Pack consecutive same-dtype allreduces under the threshold (reference:
+  // FuseResponses, controller.cc:686-809).
+  std::vector<Response> FuseResponses(std::vector<Response> responses,
+                                      int64_t threshold_bytes);
+  // Tensors that became complete because `joined_ranks_` grew.
+  void CollectNewlyCompleteTensors(std::vector<Response>* out);
+
+  ResponseList CoordinatorCycle(std::vector<RequestList> rank_lists,
+                                int64_t fusion_threshold_bytes);
+  void ApplyResponseList(const ResponseList& final_list, CycleResult* out);
+
+  // -- per-rank (all ranks) cache voting state --
+  // Cached-hit requests held locally (by name) until their bit fires
+  // globally; re-voted every cycle.
+  std::unordered_map<std::string, Request> pending_cached_;
+  // Invalid-bit votes to send this cycle.
+  std::vector<uint32_t> my_invalid_bits_;
+  // Requests to send as uncached next cycle (post-eviction resubmits).
+  std::vector<Request> resend_uncached_;
+
+  int rank_;
+  int size_;
+  Transport* transport_;
+  TensorQueue* tensor_queue_;
+  ResponseCache* cache_;
+  StallInspector* stall_;
+  Timeline* timeline_;
+
+  // Coordinator-only.
+  std::unordered_map<std::string, PendingTensor> message_table_;
+  std::set<int> joined_ranks_;
+  int last_joined_rank_ = -1;
+  bool shutdown_latch_ = false;
+
+  bool self_joined_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_CONTROLLER_H

@@ -1,0 +1,38 @@
+// Persistent fusion buffer.
+//
+// Reference: horovod/common/fusion_buffer_manager.{h,cc} — a per-(device,
+// framework) persistent buffer sized by HOROVOD_FUSION_THRESHOLD (64 MB
+// default, operations.cc:437); fused tensors are memcpy'd in, reduced as one
+// flat buffer, and memcpy'd out (collective_operations.cc:34-59). The host
+// control plane has one device (CPU), so one buffer suffices; it grows to
+// the high-water mark and is reused across cycles.
+#ifndef HVDTPU_FUSION_BUFFER_H
+#define HVDTPU_FUSION_BUFFER_H
+
+#include <cstdint>
+#include <vector>
+
+namespace hvdtpu {
+
+class FusionBufferManager {
+ public:
+  // Returns a buffer of at least `bytes`, reusing the persistent allocation.
+  char* GetBuffer(int64_t bytes) {
+    if (static_cast<int64_t>(buffer_.size()) < bytes) {
+      buffer_.resize(static_cast<size_t>(bytes));
+    }
+    return buffer_.data();
+  }
+  int64_t capacity() const { return static_cast<int64_t>(buffer_.size()); }
+  void Release() {
+    buffer_.clear();
+    buffer_.shrink_to_fit();
+  }
+
+ private:
+  std::vector<char> buffer_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_FUSION_BUFFER_H

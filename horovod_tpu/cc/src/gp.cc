@@ -1,0 +1,91 @@
+#include "gp.h"
+
+#include <cmath>
+
+namespace hvdtpu {
+
+double GaussianProcess::Kernel(const std::vector<double>& a,
+                               const std::vector<double>& b) const {
+  double d2 = 0.0;
+  for (int i = 0; i < dims_; ++i) {
+    double d = a[i] - b[i];
+    d2 += d * d;
+  }
+  return std::exp(-d2 / (2.0 * length_scale_ * length_scale_));
+}
+
+bool GaussianProcess::Fit(const std::vector<std::vector<double>>& x,
+                          const std::vector<double>& y) {
+  size_t n = x.size();
+  x_ = x;
+  // K + noise^2 I
+  std::vector<std::vector<double>> k(n, std::vector<double>(n));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      k[i][j] = k[j][i] = Kernel(x[i], x[j]);
+    }
+    k[i][i] += noise_ * noise_;
+  }
+  // Cholesky K = L L^T
+  l_.assign(n, std::vector<double>(n, 0.0));
+  for (size_t i = 0; i < n; ++i) {
+    for (size_t j = 0; j <= i; ++j) {
+      double s = k[i][j];
+      for (size_t m = 0; m < j; ++m) s -= l_[i][m] * l_[j][m];
+      if (i == j) {
+        if (s <= 0.0) return false;
+        l_[i][i] = std::sqrt(s);
+      } else {
+        l_[i][j] = s / l_[j][j];
+      }
+    }
+  }
+  // alpha = K^-1 y via two triangular solves.
+  std::vector<double> z(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = y[i];
+    for (size_t m = 0; m < i; ++m) s -= l_[i][m] * z[m];
+    z[i] = s / l_[i][i];
+  }
+  alpha_.assign(n, 0.0);
+  for (size_t ii = n; ii-- > 0;) {
+    double s = z[ii];
+    for (size_t m = ii + 1; m < n; ++m) s -= l_[m][ii] * alpha_[m];
+    alpha_[ii] = s / l_[ii][ii];
+  }
+  return true;
+}
+
+void GaussianProcess::Predict(const std::vector<double>& x, double* mean,
+                              double* stddev) const {
+  size_t n = x_.size();
+  std::vector<double> kstar(n);
+  for (size_t i = 0; i < n; ++i) kstar[i] = Kernel(x, x_[i]);
+  double mu = 0.0;
+  for (size_t i = 0; i < n; ++i) mu += kstar[i] * alpha_[i];
+  // v = L^-1 k*; var = k(x,x) - v.v
+  std::vector<double> v(n);
+  for (size_t i = 0; i < n; ++i) {
+    double s = kstar[i];
+    for (size_t m = 0; m < i; ++m) s -= l_[i][m] * v[m];
+    v[i] = s / l_[i][i];
+  }
+  double var = Kernel(x, x);
+  for (size_t i = 0; i < n; ++i) var -= v[i] * v[i];
+  *mean = mu;
+  *stddev = var > 0.0 ? std::sqrt(var) : 0.0;
+}
+
+double GaussianProcess::ExpectedImprovement(const std::vector<double>& x,
+                                            double best_y, double xi) const {
+  double mu, sigma;
+  Predict(x, &mu, &sigma);
+  if (sigma <= 1e-12) return 0.0;
+  double imp = mu - best_y - xi;
+  double z = imp / sigma;
+  double cdf = 0.5 * std::erfc(-z / std::sqrt(2.0));
+  double pdf = std::exp(-0.5 * z * z) / std::sqrt(2.0 * M_PI);
+  return imp * cdf + sigma * pdf;
+}
+
+}  // namespace hvdtpu

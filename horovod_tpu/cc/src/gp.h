@@ -1,0 +1,51 @@
+// Gaussian process regression + expected improvement, for the autotuner.
+//
+// Reference: horovod/common/optim/{gaussian_process,bayesian_optimization}
+// .{h,cc} — GP with RBF kernel fitted to (params, score) samples, next
+// sample point chosen by maximizing expected improvement. The reference uses
+// Eigen + LBFGS; the search space here is 2-D and tiny, so plain Cholesky
+// and random-candidate EI maximization are ample.
+#ifndef HVDTPU_GP_H
+#define HVDTPU_GP_H
+
+#include <cstdint>
+#include <vector>
+
+namespace hvdtpu {
+
+class GaussianProcess {
+ public:
+  // noise: observation stddev (reference knob
+  // HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, default 0.8).
+  GaussianProcess(int dims, double length_scale, double noise)
+      : dims_(dims), length_scale_(length_scale), noise_(noise) {}
+
+  // Fit to n samples of `dims_`-dimensional x in [0,1] and scores y
+  // (normalized by the caller). Returns false if the kernel matrix is not
+  // positive definite.
+  bool Fit(const std::vector<std::vector<double>>& x,
+           const std::vector<double>& y);
+
+  // Posterior mean and standard deviation at a point.
+  void Predict(const std::vector<double>& x, double* mean,
+               double* stddev) const;
+
+  // Expected improvement over `best_y` at `x` (xi = exploration margin).
+  double ExpectedImprovement(const std::vector<double>& x, double best_y,
+                             double xi = 0.01) const;
+
+ private:
+  double Kernel(const std::vector<double>& a,
+                const std::vector<double>& b) const;
+
+  int dims_;
+  double length_scale_;
+  double noise_;
+  std::vector<std::vector<double>> x_;
+  std::vector<double> alpha_;            // K^-1 y
+  std::vector<std::vector<double>> l_;   // Cholesky factor of K
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_GP_H

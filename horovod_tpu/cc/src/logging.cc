@@ -1,0 +1,70 @@
+#include "logging.h"
+
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+
+#include "common.h"
+
+namespace hvdtpu {
+
+static std::atomic<int> g_log_rank{-1};
+static std::mutex g_log_mutex;
+
+void SetLogRank(int rank) { g_log_rank.store(rank); }
+
+LogLevel MinLogLevelFromEnv() {
+  std::string v = EnvString("HOROVOD_LOG_LEVEL", "warning");
+  if (v == "trace") return LogLevel::TRACE;
+  if (v == "debug") return LogLevel::DEBUG;
+  if (v == "info") return LogLevel::INFO;
+  if (v == "warning") return LogLevel::WARNING;
+  if (v == "error") return LogLevel::ERROR;
+  if (v == "fatal") return LogLevel::FATAL;
+  return LogLevel::WARNING;
+}
+
+bool LogLevelEnabled(LogLevel level) {
+  static LogLevel min_level = MinLogLevelFromEnv();
+  return static_cast<int>(level) >= static_cast<int>(min_level);
+}
+
+static const char* LevelName(LogLevel l) {
+  switch (l) {
+    case LogLevel::TRACE: return "TRACE";
+    case LogLevel::DEBUG: return "DEBUG";
+    case LogLevel::INFO: return "INFO";
+    case LogLevel::WARNING: return "WARNING";
+    case LogLevel::ERROR: return "ERROR";
+    case LogLevel::FATAL: return "FATAL";
+  }
+  return "?";
+}
+
+LogMessage::LogMessage(const char* file, int line, LogLevel level)
+    : file_(file), line_(line), level_(level) {}
+
+LogMessage::~LogMessage() {
+  bool hide_time = EnvBool("HOROVOD_LOG_HIDE_TIME", false);
+  std::lock_guard<std::mutex> g(g_log_mutex);
+  if (!hide_time) {
+    auto now = std::chrono::system_clock::now();
+    auto us = std::chrono::duration_cast<std::chrono::microseconds>(
+                  now.time_since_epoch())
+                  .count();
+    std::fprintf(stderr, "[%lld.%06lld] ",
+                 static_cast<long long>(us / 1000000),
+                 static_cast<long long>(us % 1000000));
+  }
+  int rank = g_log_rank.load();
+  if (rank >= 0) {
+    std::fprintf(stderr, "[rank %d] ", rank);
+  }
+  std::fprintf(stderr, "[%s] %s:%d: %s\n", LevelName(level_), file_, line_,
+               stream_.str().c_str());
+  if (level_ == LogLevel::FATAL) std::abort();
+}
+
+}  // namespace hvdtpu

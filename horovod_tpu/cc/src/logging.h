@@ -1,0 +1,46 @@
+// Leveled logger with rank prefix (reference: common/logging.{h,cc} — same
+// LOG(level) macro shape, HOROVOD_LOG_LEVEL + HOROVOD_LOG_HIDE_TIME knobs).
+#ifndef HVDTPU_LOGGING_H
+#define HVDTPU_LOGGING_H
+
+#include <sstream>
+#include <string>
+
+namespace hvdtpu {
+
+enum class LogLevel : int {
+  TRACE = 0,
+  DEBUG = 1,
+  INFO = 2,
+  WARNING = 3,
+  ERROR = 4,
+  FATAL = 5,
+};
+
+LogLevel MinLogLevelFromEnv();
+void SetLogRank(int rank);
+
+class LogMessage {
+ public:
+  LogMessage(const char* file, int line, LogLevel level);
+  ~LogMessage();
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  std::ostringstream stream_;
+  const char* file_;
+  int line_;
+  LogLevel level_;
+};
+
+bool LogLevelEnabled(LogLevel level);
+
+#define HVDTPU_LOG(level)                                       \
+  if (::hvdtpu::LogLevelEnabled(::hvdtpu::LogLevel::level))     \
+  ::hvdtpu::LogMessage(__FILE__, __LINE__,                      \
+                       ::hvdtpu::LogLevel::level)               \
+      .stream()
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_LOGGING_H

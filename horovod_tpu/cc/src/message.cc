@@ -1,0 +1,131 @@
+#include "message.h"
+
+namespace hvdtpu {
+
+const char* Request::TypeName(Type t) {
+  switch (t) {
+    case ALLREDUCE: return "ALLREDUCE";
+    case ALLGATHER: return "ALLGATHER";
+    case BROADCAST: return "BROADCAST";
+    case JOIN: return "JOIN";
+    case ADASUM: return "ADASUM";
+    case ALLTOALL: return "ALLTOALL";
+    case BARRIER: return "BARRIER";
+  }
+  return "?";
+}
+
+void Request::Serialize(WireWriter& w) const {
+  w.i32(request_rank);
+  w.u8(request_type);
+  w.u8(static_cast<uint8_t>(tensor_type));
+  w.str(tensor_name);
+  w.i32(root_rank);
+  w.i32(device);
+  w.i64s(tensor_shape);
+  w.f64(prescale_factor);
+  w.f64(postscale_factor);
+  w.u8(static_cast<uint8_t>(reduce_op));
+  w.i64s(splits);
+}
+
+Request Request::Deserialize(WireReader& r) {
+  Request q;
+  q.request_rank = r.i32();
+  q.request_type = static_cast<Type>(r.u8());
+  q.tensor_type = static_cast<DataType>(r.u8());
+  q.tensor_name = r.str();
+  q.root_rank = r.i32();
+  q.device = r.i32();
+  q.tensor_shape = r.i64s();
+  q.prescale_factor = r.f64();
+  q.postscale_factor = r.f64();
+  q.reduce_op = static_cast<ReduceOp>(r.u8());
+  q.splits = r.i64s();
+  return q;
+}
+
+void RequestList::Serialize(WireWriter& w) const {
+  w.u8(shutdown ? 1 : 0);
+  w.u8(joined ? 1 : 0);
+  w.i64s(cache_bits);
+  w.i64s(invalid_bits);
+  w.i32(static_cast<int32_t>(requests.size()));
+  for (const auto& q : requests) q.Serialize(w);
+}
+
+RequestList RequestList::Deserialize(WireReader& r) {
+  RequestList l;
+  l.shutdown = r.u8() != 0;
+  l.joined = r.u8() != 0;
+  l.cache_bits = r.i64s();
+  l.invalid_bits = r.i64s();
+  int32_t n = r.i32();
+  l.requests.reserve(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i) l.requests.push_back(Request::Deserialize(r));
+  return l;
+}
+
+void Response::Serialize(WireWriter& w) const {
+  w.u8(response_type);
+  w.i32(static_cast<int32_t>(tensor_names.size()));
+  for (const auto& s : tensor_names) w.str(s);
+  w.str(error_message);
+  w.i32(static_cast<int32_t>(devices.size()));
+  for (auto d : devices) w.i32(d);
+  w.i64s(tensor_sizes);
+  w.i32(last_joined_rank);
+  w.i32(root_rank);
+  w.u8(static_cast<uint8_t>(tensor_type));
+  w.f64(prescale_factor);
+  w.f64(postscale_factor);
+  w.u8(static_cast<uint8_t>(reduce_op));
+  w.i64s(cache_shape);
+}
+
+Response Response::Deserialize(WireReader& r) {
+  Response p;
+  p.response_type = static_cast<Type>(r.u8());
+  int32_t n = r.i32();
+  p.tensor_names.reserve(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i) p.tensor_names.push_back(r.str());
+  p.error_message = r.str();
+  int32_t nd = r.i32();
+  p.devices.reserve(static_cast<size_t>(nd));
+  for (int32_t i = 0; i < nd; ++i) p.devices.push_back(r.i32());
+  p.tensor_sizes = r.i64s();
+  p.last_joined_rank = r.i32();
+  p.root_rank = r.i32();
+  p.tensor_type = static_cast<DataType>(r.u8());
+  p.prescale_factor = r.f64();
+  p.postscale_factor = r.f64();
+  p.reduce_op = static_cast<ReduceOp>(r.u8());
+  p.cache_shape = r.i64s();
+  return p;
+}
+
+void ResponseList::Serialize(WireWriter& w) const {
+  w.u8(shutdown ? 1 : 0);
+  w.i64s(invalid_bits);
+  w.u8(has_tuned_params ? 1 : 0);
+  w.i64(tuned_fusion_threshold);
+  w.f64(tuned_cycle_time_ms);
+  w.i32(static_cast<int32_t>(responses.size()));
+  for (const auto& p : responses) p.Serialize(w);
+}
+
+ResponseList ResponseList::Deserialize(WireReader& r) {
+  ResponseList l;
+  l.shutdown = r.u8() != 0;
+  l.invalid_bits = r.i64s();
+  l.has_tuned_params = r.u8() != 0;
+  l.tuned_fusion_threshold = r.i64();
+  l.tuned_cycle_time_ms = r.f64();
+  int32_t n = r.i32();
+  l.responses.reserve(static_cast<size_t>(n));
+  for (int32_t i = 0; i < n; ++i)
+    l.responses.push_back(Response::Deserialize(r));
+  return l;
+}
+
+}  // namespace hvdtpu

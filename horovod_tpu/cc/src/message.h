@@ -1,0 +1,117 @@
+// Negotiation message types.
+//
+// Reference: horovod/common/message.{h,cc} — Request (message.h:48-113),
+// Response (145-217), RequestList/ResponseList with shutdown bit. Same
+// protocol roles, hand-rolled wire format (see wire.h) instead of
+// FlatBuffers.
+#ifndef HVDTPU_MESSAGE_H
+#define HVDTPU_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+// A worker announces "tensor X is ready on my rank" with one Request
+// (reference: message.h:48-113).
+struct Request {
+  enum Type : uint8_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    JOIN = 3,
+    ADASUM = 4,
+    ALLTOALL = 5,
+    BARRIER = 6,  // host-side barrier (reference exposes this via controller)
+  };
+
+  int32_t request_rank = 0;
+  Type request_type = ALLREDUCE;
+  DataType tensor_type = DataType::HVDTPU_FLOAT32;
+  std::string tensor_name;
+  int32_t root_rank = 0;  // broadcast root
+  int32_t device = 0;     // CPU=0; kept for cross-rank consistency checks
+  std::vector<int64_t> tensor_shape;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  std::vector<int64_t> splits;  // alltoall send splits (rows per dest rank)
+
+  void Serialize(WireWriter& w) const;
+  static Request Deserialize(WireReader& r);
+  static const char* TypeName(Type t);
+};
+
+// Per-cycle batch of requests from one worker, plus the shutdown flag and
+// the response-cache hit bitvector (reference: RequestList message.h:115-143;
+// the cache bits ride the same round as in controller.cc:75-164).
+struct RequestList {
+  std::vector<Request> requests;
+  bool shutdown = false;
+  bool joined = false;                  // this rank is in joined state
+  std::vector<int64_t> cache_bits;      // bit-packed cache hits this cycle
+  std::vector<int64_t> invalid_bits;    // cached entries whose params changed
+
+  void Serialize(WireWriter& w) const;
+  static RequestList Deserialize(WireReader& r);
+};
+
+// Coordinator verdict for one (possibly fused) set of tensors
+// (reference: Response, message.h:145-217).
+struct Response {
+  enum Type : uint8_t {
+    ALLREDUCE = 0,
+    ALLGATHER = 1,
+    BROADCAST = 2,
+    JOIN = 3,
+    ADASUM = 4,
+    ALLTOALL = 5,
+    BARRIER = 6,
+    ERROR = 7,
+  };
+
+  Type response_type = ALLREDUCE;
+  std::vector<std::string> tensor_names;  // >1 = fused
+  std::string error_message;
+  std::vector<int32_t> devices;
+  // ALLREDUCE/ADASUM: per-tensor element counts (fusion slicing);
+  // ALLGATHER: first-dim sizes per rank (reference: tensor_sizes);
+  // ALLTOALL: flattened size×size matrix of send splits [src*size+dst].
+  std::vector<int64_t> tensor_sizes;
+  int32_t last_joined_rank = -1;  // JOIN: rank of the last rank to join
+  int32_t root_rank = 0;          // BROADCAST root
+  // Execution + cache-replication params. The reference keeps these on the
+  // entries; we carry them in the response so every rank (including joined
+  // ranks holding no entry) caches and executes identically.
+  DataType tensor_type = DataType::HVDTPU_FLOAT32;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  std::vector<int64_t> cache_shape;  // single-tensor responses: full shape
+
+  void Serialize(WireWriter& w) const;
+  static Response Deserialize(WireReader& r);
+};
+
+// Coordinator -> workers broadcast for one cycle (reference: ResponseList,
+// message.h:219-247). Carries tuned parameters when autotuning is active
+// (reference: ParameterManager::Params broadcast, controller.cc:34-48).
+struct ResponseList {
+  std::vector<Response> responses;
+  bool shutdown = false;
+  std::vector<int64_t> invalid_bits;  // cache bits every rank must evict
+  bool has_tuned_params = false;
+  int64_t tuned_fusion_threshold = 0;
+  double tuned_cycle_time_ms = 0.0;
+
+  void Serialize(WireWriter& w) const;
+  static ResponseList Deserialize(WireReader& r);
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_MESSAGE_H

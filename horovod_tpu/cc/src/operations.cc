@@ -1,0 +1,833 @@
+// Global state + background loop + C ABI implementation.
+//
+// Reference structure: operations.cc — InitializeHorovodOnce spawns the
+// background thread (628-674); BackgroundThreadLoop reads env knobs and
+// builds contexts (354-569); RunLoopOnce paces cycles and executes
+// responses (571-624); Enqueue* push TensorTableEntries (893-1120); the C
+// ABI exposes init/rank/size/... (685-889).
+#include "operations.h"
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <cstring>
+#include <limits>
+#include <memory>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+
+#include "adasum.h"
+#include "collectives.h"
+#include "common.h"
+#include "controller.h"
+#include "fusion_buffer.h"
+#include "logging.h"
+#include "message.h"
+#include "parameter_manager.h"
+#include "response_cache.h"
+#include "socket.h"
+#include "stall_inspector.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+#include "transport.h"
+
+namespace hvdtpu {
+namespace {
+
+struct Global {
+  int rank = 0, size = 1, local_rank = 0, local_size = 1;
+  int cross_rank = 0, cross_size = 1;
+
+  TensorQueue tensor_queue;
+  ResponseCache response_cache;
+  StallInspector stall_inspector;
+  Timeline timeline;
+  FusionBufferManager fusion_manager;
+  ParameterManager parameter_manager;
+  std::unique_ptr<Transport> transport;
+  std::unique_ptr<Controller> controller;
+
+  std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
+  std::atomic<int64_t> cycle_time_us{1000};
+  std::atomic<bool> shutdown_requested{false};
+  std::atomic<bool> loop_running{false};
+
+  std::thread background;
+
+  std::mutex handle_mu;
+  std::unordered_map<int, EntryPtr> handles;
+  int next_handle = 0;
+
+  std::mutex join_mu;
+  EntryPtr current_join;
+
+  std::atomic<int> op_counter{0};       // join auto-names (rank-local)
+  std::atomic<int> barrier_counter{0};  // barrier sequence — must align
+                                        // across ranks, so joins (rank-local
+                                        // events) get their own counter
+};
+
+std::mutex g_mu;
+std::unique_ptr<Global> g;
+
+std::mutex g_err_mu;
+std::string g_last_error;
+
+void SetLastError(const std::string& msg) {
+  std::lock_guard<std::mutex> l(g_err_mu);
+  g_last_error = msg;
+}
+
+int64_t ShapeCount(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (auto d : shape) n *= d;
+  return n;
+}
+
+int64_t RowElems(const std::vector<int64_t>& shape) {
+  int64_t n = 1;
+  for (size_t i = 1; i < shape.size(); ++i) n *= shape[i];
+  return n;
+}
+
+// Identity element per reduce op for joined ranks' zero-substitute
+// contribution (reference substitutes zero tensors,
+// tensor_queue.cc GetTensorEntriesFromResponse; zeros are only the identity
+// for SUM, so we use the true identity per op).
+void FillIdentity(void* buf, int64_t count, DataType dt, ReduceOp op) {
+  size_t bytes = static_cast<size_t>(count) * DataTypeSize(dt);
+  if (op == ReduceOp::SUM || op == ReduceOp::ADASUM) {
+    std::memset(buf, 0, bytes);
+    return;
+  }
+  auto fill = [&](auto value, auto* p) {
+    for (int64_t i = 0; i < count; ++i) p[i] = value;
+  };
+  switch (dt) {
+    case DataType::HVDTPU_UINT8:
+    case DataType::HVDTPU_BOOL: {
+      uint8_t* p = static_cast<uint8_t*>(buf);
+      fill(op == ReduceOp::MIN ? uint8_t{255}
+           : op == ReduceOp::MAX ? uint8_t{0} : uint8_t{1}, p);
+      break;
+    }
+    case DataType::HVDTPU_INT8: {
+      int8_t* p = static_cast<int8_t*>(buf);
+      fill(op == ReduceOp::MIN ? int8_t{127}
+           : op == ReduceOp::MAX ? int8_t{-128} : int8_t{1}, p);
+      break;
+    }
+    case DataType::HVDTPU_INT32: {
+      int32_t* p = static_cast<int32_t*>(buf);
+      fill(op == ReduceOp::MIN ? std::numeric_limits<int32_t>::max()
+           : op == ReduceOp::MAX ? std::numeric_limits<int32_t>::min()
+                                 : int32_t{1}, p);
+      break;
+    }
+    case DataType::HVDTPU_INT64: {
+      int64_t* p = static_cast<int64_t*>(buf);
+      fill(op == ReduceOp::MIN ? std::numeric_limits<int64_t>::max()
+           : op == ReduceOp::MAX ? std::numeric_limits<int64_t>::min()
+                                 : int64_t{1}, p);
+      break;
+    }
+    case DataType::HVDTPU_FLOAT32: {
+      float* p = static_cast<float*>(buf);
+      fill(op == ReduceOp::MIN ? std::numeric_limits<float>::infinity()
+           : op == ReduceOp::MAX ? -std::numeric_limits<float>::infinity()
+                                 : 1.0f, p);
+      break;
+    }
+    case DataType::HVDTPU_FLOAT64: {
+      double* p = static_cast<double*>(buf);
+      fill(op == ReduceOp::MIN ? std::numeric_limits<double>::infinity()
+           : op == ReduceOp::MAX ? -std::numeric_limits<double>::infinity()
+                                 : 1.0, p);
+      break;
+    }
+    case DataType::HVDTPU_FLOAT16:
+    case DataType::HVDTPU_BFLOAT16: {
+      uint16_t* p = static_cast<uint16_t*>(buf);
+      float v = op == ReduceOp::MIN ? std::numeric_limits<float>::infinity()
+                : op == ReduceOp::MAX
+                    ? -std::numeric_limits<float>::infinity()
+                    : 1.0f;
+      uint16_t w = dt == DataType::HVDTPU_FLOAT16 ? FloatToFp16(v)
+                                                  : FloatToBf16(v);
+      fill(w, p);
+      break;
+    }
+  }
+}
+
+Status RunAllreduceWire(Global& gs, void* buf, int64_t count, DataType dt,
+                        ReduceOp op) {
+  if (op != ReduceOp::ADASUM) {
+    return collectives::RingAllreduce(*gs.transport, buf, count, dt, op);
+  }
+  // Adasum: widen 16-bit floats to f32 for the dot-product math
+  // (reference computes adasum in full precision with fp16 AVX
+  // specializations, adasum.h:101-141).
+  if (dt == DataType::HVDTPU_FLOAT16 || dt == DataType::HVDTPU_BFLOAT16) {
+    std::vector<float> wide(static_cast<size_t>(count));
+    const uint16_t* p = static_cast<const uint16_t*>(buf);
+    for (int64_t i = 0; i < count; ++i) {
+      wide[i] = dt == DataType::HVDTPU_FLOAT16 ? Fp16ToFloat(p[i])
+                                               : Bf16ToFloat(p[i]);
+    }
+    Status s = AdasumAllreduce(*gs.transport, wide.data(), count,
+                               DataType::HVDTPU_FLOAT32);
+    if (!s.ok()) return s;
+    uint16_t* q = static_cast<uint16_t*>(buf);
+    for (int64_t i = 0; i < count; ++i) {
+      q[i] = dt == DataType::HVDTPU_FLOAT16 ? FloatToFp16(wide[i])
+                                            : FloatToBf16(wide[i]);
+    }
+    return s;
+  }
+  return AdasumAllreduce(*gs.transport, buf, count, dt);
+}
+
+void PerformOperation(Global& gs, const Response& resp) {
+  // Identity substitution for names this rank holds no entry for — the
+  // joined-rank case (reference: zero-tensor substitution in
+  // GetTensorEntriesFromResponse). Driven purely by entry presence, not the
+  // controller's joined flag: a rank that enqueued a tensor and then joined
+  // still contributes its real data.
+  switch (resp.response_type) {
+    case Response::ALLREDUCE:
+    case Response::ADASUM: {
+      DataType dt = resp.tensor_type;
+      size_t es = DataTypeSize(dt);
+      int64_t total = 0;
+      for (auto c : resp.tensor_sizes) total += c;
+      std::vector<EntryPtr> entries =
+          gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names);
+      bool have_all = true, have_any = false;
+      for (const auto& e : entries) {
+        if (e != nullptr) have_any = true;
+        else have_all = false;
+      }
+      const std::string& lane =
+          resp.tensor_names.empty() ? std::string("fused")
+                                    : resp.tensor_names[0];
+      gs.timeline.Start(lane, resp.response_type == Response::ADASUM
+                                  ? "ADASUM" : "ALLREDUCE");
+      char* buf;
+      bool in_place = entries.size() == 1 && entries[0] != nullptr;
+      if (in_place) {
+        buf = static_cast<char*>(entries[0]->data);
+      } else {
+        buf = gs.fusion_manager.GetBuffer(total * static_cast<int64_t>(es));
+        gs.timeline.ActivityStart(lane, "MEMCPY_IN_FUSION_BUFFER");
+        int64_t off = 0;
+        for (size_t i = 0; i < entries.size(); ++i) {
+          if (entries[i] != nullptr) {
+            std::memcpy(buf + off * es, entries[i]->data,
+                        static_cast<size_t>(resp.tensor_sizes[i]) * es);
+          } else {
+            FillIdentity(buf + off * es, resp.tensor_sizes[i], dt,
+                         resp.reduce_op);
+          }
+          off += resp.tensor_sizes[i];
+        }
+        gs.timeline.ActivityEnd(lane);
+      }
+      if (have_any && resp.prescale_factor != 1.0) {
+        // Prescale only real contributions; identity slices are already the
+        // op's neutral element. (Identity values are scale-invariant for
+        // SUM(0) and MIN/MAX(±inf); fused buffers are single-op anyway.)
+        if (have_all) {
+          collectives::ScaleBuffer(buf, total, dt, resp.prescale_factor);
+        } else {
+          int64_t off = 0;
+          for (size_t i = 0; i < entries.size(); ++i) {
+            if (entries[i] != nullptr) {
+              collectives::ScaleBuffer(buf + off * es, resp.tensor_sizes[i],
+                                       dt, resp.prescale_factor);
+            }
+            off += resp.tensor_sizes[i];
+          }
+        }
+      }
+      gs.timeline.ActivityStart(lane, "TCP_ALLREDUCE");
+      Status s = RunAllreduceWire(gs, buf, total, dt, resp.reduce_op);
+      gs.timeline.ActivityEnd(lane);
+      if (s.ok() && resp.postscale_factor != 1.0) {
+        collectives::ScaleBuffer(buf, total, dt, resp.postscale_factor);
+      }
+      if (!in_place && have_any) {
+        gs.timeline.ActivityStart(lane, "MEMCPY_OUT_FUSION_BUFFER");
+        int64_t off = 0;
+        for (size_t i = 0; i < entries.size(); ++i) {
+          if (entries[i] != nullptr) {
+            std::memcpy(entries[i]->data, buf + off * es,
+                        static_cast<size_t>(resp.tensor_sizes[i]) * es);
+          }
+          off += resp.tensor_sizes[i];
+        }
+        gs.timeline.ActivityEnd(lane);
+      }
+      gs.timeline.End(lane);
+      for (auto& e : entries) {
+        if (e) e->MarkDone(s);
+      }
+      break;
+    }
+    case Response::ALLGATHER: {
+      DataType dt = resp.tensor_type;
+      size_t es = DataTypeSize(dt);
+      int64_t row = RowElems(resp.cache_shape);
+      std::vector<int64_t> bytes_per_rank(resp.tensor_sizes.size());
+      for (size_t r = 0; r < resp.tensor_sizes.size(); ++r) {
+        bytes_per_rank[r] =
+            resp.tensor_sizes[r] * row * static_cast<int64_t>(es);
+      }
+      EntryPtr e =
+          gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names).at(0);
+      const std::string& lane = resp.tensor_names[0];
+      gs.timeline.Start(lane, "ALLGATHER");
+      std::vector<char> scratch;
+      std::vector<char>* out = e ? &e->output : &scratch;
+      const void* in = e ? e->data : nullptr;
+      int64_t in_bytes = e ? bytes_per_rank[gs.rank] : 0;
+      Status s = collectives::AllgatherV(*gs.transport, in, in_bytes,
+                                         bytes_per_rank, out);
+      gs.timeline.End(lane);
+      if (e) e->MarkDone(s);
+      break;
+    }
+    case Response::BROADCAST: {
+      DataType dt = resp.tensor_type;
+      size_t es = DataTypeSize(dt);
+      int64_t count = resp.tensor_sizes.empty() ? 0 : resp.tensor_sizes[0];
+      EntryPtr e =
+          gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names).at(0);
+      const std::string& lane = resp.tensor_names[0];
+      gs.timeline.Start(lane, "BROADCAST");
+      std::vector<char> scratch;
+      void* buf;
+      if (e) {
+        buf = e->data;
+      } else {
+        scratch.resize(static_cast<size_t>(count) * es);
+        buf = scratch.data();
+      }
+      Status s = collectives::Broadcast(*gs.transport, buf,
+                                        count * static_cast<int64_t>(es),
+                                        resp.root_rank);
+      gs.timeline.End(lane);
+      if (e) e->MarkDone(s);
+      break;
+    }
+    case Response::ALLTOALL: {
+      DataType dt = resp.tensor_type;
+      size_t es = DataTypeSize(dt);
+      int64_t row = RowElems(resp.cache_shape);
+      int n = gs.size;
+      std::vector<int64_t> send_bytes(n), recv_bytes(n), recv_rows(n);
+      for (int r = 0; r < n; ++r) {
+        send_bytes[r] = resp.tensor_sizes[static_cast<size_t>(gs.rank) * n +
+                                          r] * row * static_cast<int64_t>(es);
+        recv_rows[r] =
+            resp.tensor_sizes[static_cast<size_t>(r) * n + gs.rank];
+        recv_bytes[r] = recv_rows[r] * row * static_cast<int64_t>(es);
+      }
+      EntryPtr e =
+          gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names).at(0);
+      const std::string& lane = resp.tensor_names[0];
+      gs.timeline.Start(lane, "ALLTOALL");
+      std::vector<char> scratch;
+      std::vector<char>* out = e ? &e->output : &scratch;
+      const void* in = e ? e->data : nullptr;
+      Status s = collectives::AllToAllV(*gs.transport, in, send_bytes,
+                                        recv_bytes, out);
+      gs.timeline.End(lane);
+      if (e) {
+        e->recv_splits = recv_rows;
+        e->MarkDone(s);
+      }
+      break;
+    }
+    case Response::BARRIER: {
+      auto entries = gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names);
+      for (auto& e : entries) {
+        if (e) e->MarkDone(Status::OK());
+      }
+      break;
+    }
+    case Response::JOIN: {
+      std::lock_guard<std::mutex> l(gs.join_mu);
+      if (gs.current_join) {
+        // Drop the name reservation from the tensor table, then complete.
+        gs.tensor_queue.GetAndRemoveEntries({gs.current_join->name});
+        gs.current_join->join_result = resp.last_joined_rank;
+        gs.current_join->MarkDone(Status::OK());
+        gs.current_join.reset();
+      }
+      break;
+    }
+    case Response::ERROR: {
+      auto entries = gs.tensor_queue.GetAndRemoveEntries(resp.tensor_names);
+      for (auto& e : entries) {
+        if (e) e->MarkDone(Status::PreconditionError(resp.error_message));
+      }
+      break;
+    }
+  }
+}
+
+void AbortEverything(Global& gs, const Status& reason) {
+  gs.tensor_queue.AbortAll(reason);
+  std::lock_guard<std::mutex> l(gs.join_mu);
+  if (gs.current_join) {
+    gs.current_join->MarkDone(reason);
+    gs.current_join.reset();
+  }
+}
+
+// The single communication thread (reference: BackgroundThreadLoop,
+// operations.cc:354-569 — one thread owns all negotiation + wire traffic so
+// ops execute in a globally agreed order regardless of submission order).
+void BackgroundLoop(Global* gs) {
+  SetLogRank(gs->rank);
+  auto last_cycle = std::chrono::steady_clock::now();
+  while (true) {
+    // Pace the negotiation cycle (reference: HOROVOD_CYCLE_TIME sleep,
+    // operations.cc:571-580).
+    auto next = last_cycle + std::chrono::microseconds(
+                                 gs->cycle_time_us.load());
+    std::this_thread::sleep_until(next);
+    last_cycle = std::chrono::steady_clock::now();
+
+    bool want_shutdown = gs->shutdown_requested.load();
+    Controller::CycleResult cycle =
+        gs->controller->RunCycle(want_shutdown, gs->fusion_threshold.load());
+    if (cycle.transport_failure) {
+      AbortEverything(*gs,
+                      Status::UnknownError(
+                          "Horovod background loop lost connection to a "
+                          "peer; the job world has changed or a worker "
+                          "died (HorovodInternalError)"));
+      break;
+    }
+    if (cycle.tuned_fusion_threshold > 0) {
+      gs->fusion_threshold.store(cycle.tuned_fusion_threshold);
+    }
+    if (cycle.tuned_cycle_time_ms > 0) {
+      gs->cycle_time_us.store(
+          static_cast<int64_t>(cycle.tuned_cycle_time_ms * 1000));
+    }
+    int64_t bytes_this_cycle = 0;
+    for (const Response& r : cycle.responses) {
+      PerformOperation(*gs, r);
+      if (r.response_type == Response::ALLREDUCE ||
+          r.response_type == Response::ADASUM) {
+        for (auto c : r.tensor_sizes) {
+          bytes_this_cycle +=
+              c * static_cast<int64_t>(DataTypeSize(r.tensor_type));
+        }
+      }
+    }
+    if (gs->parameter_manager.active() && gs->controller->is_coordinator()) {
+      gs->parameter_manager.RecordBytes(bytes_this_cycle);
+    }
+    if (cycle.shutdown) {
+      AbortEverything(*gs, Status::Aborted("Horovod has been shut down"));
+      break;
+    }
+  }
+  gs->loop_running.store(false);
+}
+
+int EnqueueEntry(EntryPtr entry, Request req) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g || !g->loop_running.load()) {
+    SetLastError("Horovod native core is not initialized");
+    return -1;
+  }
+  Status s = g->tensor_queue.AddToTensorQueue(entry, std::move(req));
+  if (!s.ok()) {
+    SetLastError(s.reason());
+    return -1;
+  }
+  std::lock_guard<std::mutex> h(g->handle_mu);
+  int handle = g->next_handle++;
+  g->handles.emplace(handle, std::move(entry));
+  return handle;
+}
+
+EntryPtr GetHandle(int handle) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g) return nullptr;
+  std::lock_guard<std::mutex> h(g->handle_mu);
+  auto it = g->handles.find(handle);
+  return it == g->handles.end() ? nullptr : it->second;
+}
+
+}  // namespace
+}  // namespace hvdtpu
+
+using namespace hvdtpu;  // NOLINT
+
+extern "C" {
+
+int hvdtpu_init(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (g && g->loop_running.load()) return 0;  // idempotent
+  auto gs = std::make_unique<Global>();
+  gs->rank = static_cast<int>(EnvInt64(HVDTPU_ENV_RANK, 0));
+  gs->size = static_cast<int>(EnvInt64(HVDTPU_ENV_SIZE, 1));
+  gs->local_rank = static_cast<int>(EnvInt64(HVDTPU_ENV_LOCAL_RANK, 0));
+  gs->local_size = static_cast<int>(EnvInt64(HVDTPU_ENV_LOCAL_SIZE, 1));
+  gs->cross_rank = static_cast<int>(
+      EnvInt64(HVDTPU_ENV_CROSS_RANK, gs->rank));
+  gs->cross_size = static_cast<int>(
+      EnvInt64(HVDTPU_ENV_CROSS_SIZE, gs->size));
+  SetLogRank(gs->rank);
+
+  gs->fusion_threshold.store(
+      EnvInt64(HVDTPU_ENV_FUSION_THRESHOLD, 64 * 1024 * 1024));
+  // HOROVOD_CYCLE_TIME is milliseconds in the reference (default 5,
+  // operations.cc:445); host TCP negotiation is cheap so default 1 ms.
+  gs->cycle_time_us.store(static_cast<int64_t>(
+      EnvDouble(HVDTPU_ENV_CYCLE_TIME, 1.0) * 1000));
+  gs->response_cache.set_capacity(static_cast<uint32_t>(
+      EnvInt64(HVDTPU_ENV_CACHE_CAPACITY, 1024)));
+  gs->stall_inspector.Configure(
+      !EnvBool(HVDTPU_ENV_STALL_CHECK_DISABLE, false),
+      EnvDouble(HVDTPU_ENV_STALL_CHECK_TIME, 60.0),
+      EnvDouble(HVDTPU_ENV_STALL_SHUTDOWN_TIME, 0.0), gs->size);
+
+  std::string coord_addr =
+      EnvString(HVDTPU_ENV_CONTROLLER_ADDR, "127.0.0.1");
+  int coord_port =
+      static_cast<int>(EnvInt64(HVDTPU_ENV_CONTROLLER_PORT, 42223));
+  double timeout = EnvDouble("HOROVOD_START_TIMEOUT", 120.0);
+  gs->transport =
+      Transport::Create(gs->rank, gs->size, coord_addr, coord_port, timeout);
+  if (!gs->transport) {
+    SetLastError("failed to establish transport (rendezvous with peers)");
+    return 1;
+  }
+
+  // Timeline is coordinator-only (reference: operations.cc:420-423).
+  std::string timeline_path = EnvString(HVDTPU_ENV_TIMELINE, "");
+  if (!timeline_path.empty() && gs->rank == 0) {
+    gs->timeline.Initialize(timeline_path,
+                            EnvBool(HVDTPU_ENV_TIMELINE_MARK_CYCLES, false));
+  }
+
+  gs->controller = std::make_unique<Controller>(
+      gs->rank, gs->size, gs->transport.get(), &gs->tensor_queue,
+      &gs->response_cache, gs->rank == 0 ? &gs->stall_inspector : nullptr,
+      gs->rank == 0 ? &gs->timeline : nullptr);
+
+  if (EnvBool(HVDTPU_ENV_AUTOTUNE, false) && gs->rank == 0) {
+    gs->parameter_manager.Initialize(
+        gs->fusion_threshold.load(),
+        gs->cycle_time_us.load() / 1000.0,
+        EnvString(HVDTPU_ENV_AUTOTUNE_LOG, ""),
+        EnvInt64(HVDTPU_ENV_AUTOTUNE_WARMUP_SAMPLES, 3),
+        EnvInt64(HVDTPU_ENV_AUTOTUNE_STEPS_PER_SAMPLE, 10),
+        EnvInt64(HVDTPU_ENV_AUTOTUNE_BAYES_OPT_MAX_SAMPLES, 20),
+        EnvDouble(HVDTPU_ENV_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8));
+    Global* raw = gs.get();
+    gs->controller->autotune_hook =
+        [raw](const std::vector<Response>& responses, int64_t* fuse,
+              double* cyc) {
+          return raw->parameter_manager.Update(responses, fuse, cyc);
+        };
+  }
+
+  gs->loop_running.store(true);
+  gs->background = std::thread(BackgroundLoop, gs.get());
+  g = std::move(gs);
+  return 0;
+}
+
+void hvdtpu_shutdown(void) {
+  std::unique_ptr<Global> local;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    if (!g) return;
+    local = std::move(g);
+  }
+  local->shutdown_requested.store(true);
+  if (local->background.joinable()) local->background.join();
+  local->timeline.Shutdown();
+  AbortEverything(*local, Status::Aborted("Horovod has been shut down"));
+}
+
+int hvdtpu_is_initialized(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g && g->loop_running.load() ? 1 : 0;
+}
+
+const char* hvdtpu_last_error(void) {
+  std::lock_guard<std::mutex> l(g_err_mu);
+  return g_last_error.c_str();
+}
+
+int hvdtpu_rank(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->rank : -1;
+}
+int hvdtpu_size(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->size : -1;
+}
+int hvdtpu_local_rank(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->local_rank : -1;
+}
+int hvdtpu_local_size(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->local_size : -1;
+}
+int hvdtpu_cross_rank(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->cross_rank : -1;
+}
+int hvdtpu_cross_size(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->cross_size : -1;
+}
+int64_t hvdtpu_fusion_threshold(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->fusion_threshold.load() : -1;
+}
+double hvdtpu_cycle_time_ms(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g ? g->cycle_time_us.load() / 1000.0 : -1;
+}
+
+int hvdtpu_allreduce(const char* name, void* data, const int64_t* shape,
+                     int ndim, int dtype, int op, double prescale,
+                     double postscale) {
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->name = name;
+  entry->type = static_cast<ReduceOp>(op) == ReduceOp::ADASUM
+                    ? Request::ADASUM
+                    : Request::ALLREDUCE;
+  entry->dtype = static_cast<DataType>(dtype);
+  entry->data = data;
+  entry->shape.assign(shape, shape + ndim);
+  entry->count = ShapeCount(entry->shape);
+  entry->prescale_factor = prescale;
+  entry->postscale_factor = postscale;
+  entry->reduce_op = static_cast<ReduceOp>(op);
+
+  Request req;
+  req.request_rank = hvdtpu_rank();
+  req.request_type = entry->type;
+  req.tensor_type = entry->dtype;
+  req.tensor_name = entry->name;
+  req.tensor_shape = entry->shape;
+  req.prescale_factor = prescale;
+  req.postscale_factor = postscale;
+  req.reduce_op = entry->reduce_op;
+  return EnqueueEntry(std::move(entry), std::move(req));
+}
+
+int hvdtpu_allgather(const char* name, const void* data,
+                     const int64_t* shape, int ndim, int dtype) {
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->name = name;
+  entry->type = Request::ALLGATHER;
+  entry->dtype = static_cast<DataType>(dtype);
+  entry->data = const_cast<void*>(data);
+  entry->shape.assign(shape, shape + ndim);
+  entry->count = ShapeCount(entry->shape);
+
+  Request req;
+  req.request_rank = hvdtpu_rank();
+  req.request_type = Request::ALLGATHER;
+  req.tensor_type = entry->dtype;
+  req.tensor_name = entry->name;
+  req.tensor_shape = entry->shape;
+  return EnqueueEntry(std::move(entry), std::move(req));
+}
+
+int hvdtpu_broadcast(const char* name, void* data, const int64_t* shape,
+                     int ndim, int dtype, int root) {
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->name = name;
+  entry->type = Request::BROADCAST;
+  entry->dtype = static_cast<DataType>(dtype);
+  entry->data = data;
+  entry->shape.assign(shape, shape + ndim);
+  entry->count = ShapeCount(entry->shape);
+  entry->root_rank = root;
+
+  Request req;
+  req.request_rank = hvdtpu_rank();
+  req.request_type = Request::BROADCAST;
+  req.tensor_type = entry->dtype;
+  req.tensor_name = entry->name;
+  req.tensor_shape = entry->shape;
+  req.root_rank = root;
+  return EnqueueEntry(std::move(entry), std::move(req));
+}
+
+int hvdtpu_alltoall(const char* name, const void* data, const int64_t* shape,
+                    int ndim, int dtype, const int64_t* splits, int nsplits) {
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->name = name;
+  entry->type = Request::ALLTOALL;
+  entry->dtype = static_cast<DataType>(dtype);
+  entry->data = const_cast<void*>(data);
+  entry->shape.assign(shape, shape + ndim);
+  entry->count = ShapeCount(entry->shape);
+  if (nsplits > 0) entry->splits.assign(splits, splits + nsplits);
+
+  Request req;
+  req.request_rank = hvdtpu_rank();
+  req.request_type = Request::ALLTOALL;
+  req.tensor_type = entry->dtype;
+  req.tensor_name = entry->name;
+  req.tensor_shape = entry->shape;
+  req.splits = entry->splits;
+  return EnqueueEntry(std::move(entry), std::move(req));
+}
+
+int hvdtpu_join(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g || !g->loop_running.load()) {
+    SetLastError("Horovod native core is not initialized");
+    return -1;
+  }
+  auto entry = std::make_shared<TensorTableEntry>();
+  entry->name = "join." + std::to_string(g->op_counter.fetch_add(1));
+  entry->type = Request::JOIN;
+
+  Request req;
+  req.request_rank = g->rank;
+  req.request_type = Request::JOIN;
+  req.tensor_name = entry->name;
+  {
+    std::lock_guard<std::mutex> j(g->join_mu);
+    if (g->current_join) {
+      SetLastError("join already in progress");
+      return -1;
+    }
+    // Completion comes from the JOIN response (which names no tensors), so
+    // track the entry in current_join; it also sits in the tensor table to
+    // reserve its name until the join resolves.
+    g->current_join = entry;
+  }
+  Status s = g->tensor_queue.AddToTensorQueue(entry, std::move(req));
+  if (!s.ok()) {
+    std::lock_guard<std::mutex> j(g->join_mu);
+    g->current_join.reset();
+    SetLastError(s.reason());
+    return -1;
+  }
+  std::lock_guard<std::mutex> h(g->handle_mu);
+  int handle = g->next_handle++;
+  g->handles.emplace(handle, std::move(entry));
+  return handle;
+}
+
+int hvdtpu_barrier(void) {
+  int seq, rank;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    if (!g || !g->loop_running.load()) {
+      SetLastError("Horovod native core is not initialized");
+      return -1;
+    }
+    seq = g->barrier_counter.fetch_add(1);
+    rank = g->rank;
+  }
+  auto entry = std::make_shared<TensorTableEntry>();
+  // Sequence-numbered name: ranks align because every rank issues barriers
+  // in the same program order.
+  entry->name = "barrier." + std::to_string(seq);
+  entry->type = Request::BARRIER;
+
+  Request req;
+  req.request_rank = rank;
+  req.request_type = Request::BARRIER;
+  req.tensor_name = entry->name;
+  return EnqueueEntry(std::move(entry), std::move(req));
+}
+
+int hvdtpu_poll(int handle) {
+  EntryPtr e = GetHandle(handle);
+  return e == nullptr || e->Done() ? 1 : 0;
+}
+
+int hvdtpu_wait(int handle) {
+  EntryPtr e = GetHandle(handle);
+  if (e == nullptr) {
+    SetLastError("unknown handle");
+    return static_cast<int>(StatusType::INVALID_ARGUMENT);
+  }
+  Status s = e->Wait();
+  return static_cast<int>(s.type());
+}
+
+const char* hvdtpu_handle_error(int handle) {
+  EntryPtr e = GetHandle(handle);
+  static thread_local std::string msg;
+  msg = e == nullptr ? "unknown handle" : e->status.reason();
+  return msg.c_str();
+}
+
+int64_t hvdtpu_result_bytes(int handle) {
+  EntryPtr e = GetHandle(handle);
+  return e == nullptr ? -1 : static_cast<int64_t>(e->output.size());
+}
+
+void hvdtpu_fetch(int handle, void* out) {
+  EntryPtr e = GetHandle(handle);
+  if (e != nullptr && !e->output.empty()) {
+    std::memcpy(out, e->output.data(), e->output.size());
+  }
+}
+
+int hvdtpu_join_result(int handle) {
+  EntryPtr e = GetHandle(handle);
+  return e == nullptr ? -1 : e->join_result;
+}
+
+int hvdtpu_recv_splits(int handle, int64_t* out, int max) {
+  EntryPtr e = GetHandle(handle);
+  if (e == nullptr) return 0;
+  int n = static_cast<int>(std::min<size_t>(e->recv_splits.size(),
+                                            static_cast<size_t>(max)));
+  for (int i = 0; i < n; ++i) out[i] = e->recv_splits[i];
+  return n;
+}
+
+void hvdtpu_release(int handle) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g) return;
+  std::lock_guard<std::mutex> h(g->handle_mu);
+  g->handles.erase(handle);
+}
+
+int hvdtpu_start_timeline(const char* path, int mark_cycles) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g) return 1;
+  if (g->rank != 0) return 0;  // coordinator-only writer
+  g->timeline.Initialize(path, mark_cycles != 0);
+  return 0;
+}
+
+int hvdtpu_stop_timeline(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  if (!g) return 1;
+  g->timeline.Shutdown();
+  return 0;
+}
+
+int hvdtpu_autotune_active(void) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g && g->parameter_manager.active() ? 1 : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,69 @@
+// Global state + background loop + C ABI.
+//
+// Reference: horovod/common/operations.{h,cc} and global_state.h — the
+// single background communication thread (BackgroundThreadLoop,
+// operations.cc:354) that owns all negotiation and host collectives, the
+// Enqueue* API (operations.cc:893-1120), and the C ABI consumed by the
+// Python bindings (operations.cc:685-889). The rationale for one thread
+// (operations.cc:332-351) carries over: global agreement on op order, async
+// submission from any thread, and a single owner for the TCP transport.
+#ifndef HVDTPU_OPERATIONS_H
+#define HVDTPU_OPERATIONS_H
+
+#include <cstdint>
+
+extern "C" {
+
+// Lifecycle. hvdtpu_init reads the launcher env contract (HOROVOD_RANK/
+// SIZE/..., HOROVOD_CONTROLLER_ADDR/PORT) and spawns the background loop.
+// Returns 0 on success.
+int hvdtpu_init(void);
+void hvdtpu_shutdown(void);
+int hvdtpu_is_initialized(void);
+const char* hvdtpu_last_error(void);
+
+int hvdtpu_rank(void);
+int hvdtpu_size(void);
+int hvdtpu_local_rank(void);
+int hvdtpu_local_size(void);
+int hvdtpu_cross_rank(void);
+int hvdtpu_cross_size(void);
+int64_t hvdtpu_fusion_threshold(void);
+double hvdtpu_cycle_time_ms(void);
+
+// Collectives: enqueue returns a handle (>= 0) or -1 (see
+// hvdtpu_last_error). dtype = hvdtpu::DataType, op = hvdtpu::ReduceOp.
+// Average rides SUM + postscale 1/size, as in the reference wire protocol.
+int hvdtpu_allreduce(const char* name, void* data, const int64_t* shape,
+                     int ndim, int dtype, int op, double prescale,
+                     double postscale);
+int hvdtpu_allgather(const char* name, const void* data,
+                     const int64_t* shape, int ndim, int dtype);
+int hvdtpu_broadcast(const char* name, void* data, const int64_t* shape,
+                     int ndim, int dtype, int root);
+int hvdtpu_alltoall(const char* name, const void* data, const int64_t* shape,
+                    int ndim, int dtype, const int64_t* splits, int nsplits);
+int hvdtpu_join(void);
+int hvdtpu_barrier(void);
+
+// Handle API (reference: torch handle_manager + poll/synchronize,
+// torch/mpi_ops.py:66-161).
+int hvdtpu_poll(int handle);
+int hvdtpu_wait(int handle);  // blocks; returns StatusType (0 = OK)
+const char* hvdtpu_handle_error(int handle);
+int64_t hvdtpu_result_bytes(int handle);
+void hvdtpu_fetch(int handle, void* out);
+int hvdtpu_join_result(int handle);
+int hvdtpu_recv_splits(int handle, int64_t* out, int max);
+void hvdtpu_release(int handle);
+
+// Timeline (reference: horovod_start_timeline, operations.cc:715-757).
+int hvdtpu_start_timeline(const char* path, int mark_cycles);
+int hvdtpu_stop_timeline(void);
+
+// Autotune introspection (for tests / AUTOTUNE_LOG tooling).
+int hvdtpu_autotune_active(void);
+
+}  // extern "C"
+
+#endif  // HVDTPU_OPERATIONS_H

@@ -1,0 +1,163 @@
+#include "parameter_manager.h"
+
+#include <algorithm>
+#include <cmath>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+// Search bounds, log-scale (reference tunes fusion threshold 0..64MB and
+// cycle time 1..25ms over a discrete grid/BO hybrid; we use a continuous
+// log box that covers the same region).
+constexpr double kMinFusionLog = 10.0;  // 2^10 = 1 KB
+constexpr double kMaxFusionLog = 28.0;  // 2^28 = 256 MB
+constexpr double kMinCycleLog = -1.0;   // 2^-1 = 0.5 ms
+constexpr double kMaxCycleLog = 5.64;   // ~50 ms
+}  // namespace
+
+void ParameterManager::Initialize(int64_t fusion_threshold,
+                                  double cycle_time_ms,
+                                  const std::string& log_path,
+                                  int64_t warmup_samples,
+                                  int64_t cycles_per_sample,
+                                  int64_t max_samples, double gp_noise) {
+  active_ = true;
+  current_fusion_ = best_fusion_ = fusion_threshold;
+  current_cycle_ = best_cycle_ = cycle_time_ms;
+  warmup_samples_ = warmup_samples;
+  cycles_per_sample_ = cycles_per_sample;
+  max_samples_ = max_samples;
+  gp_noise_ = gp_noise;
+  window_start_ = std::chrono::steady_clock::now();
+  if (!log_path.empty()) {
+    log_ = std::fopen(log_path.c_str(), "w");
+    if (log_ != nullptr) {
+      std::fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n");
+    }
+  }
+}
+
+ParameterManager::~ParameterManager() {
+  if (log_ != nullptr) std::fclose(log_);
+}
+
+void ParameterManager::RecordBytes(int64_t bytes) {
+  bytes_accum_ += bytes;
+}
+
+std::vector<double> ParameterManager::ToUnit(int64_t fusion,
+                                             double cycle) const {
+  double f = std::log2(std::max<double>(1.0, static_cast<double>(fusion)));
+  double c = std::log2(std::max(1e-3, cycle));
+  return {(f - kMinFusionLog) / (kMaxFusionLog - kMinFusionLog),
+          (c - kMinCycleLog) / (kMaxCycleLog - kMinCycleLog)};
+}
+
+void ParameterManager::FromUnit(const std::vector<double>& u,
+                                int64_t* fusion, double* cycle) const {
+  double f = kMinFusionLog + u[0] * (kMaxFusionLog - kMinFusionLog);
+  double c = kMinCycleLog + u[1] * (kMaxCycleLog - kMinCycleLog);
+  *fusion = static_cast<int64_t>(std::pow(2.0, f));
+  *cycle = std::pow(2.0, c);
+}
+
+void ParameterManager::ProposeNext() {
+  // Normalize scores to zero-mean/unit-variance for the GP.
+  double mean = 0.0;
+  for (double y : ys_) mean += y;
+  mean /= static_cast<double>(ys_.size());
+  double var = 0.0;
+  for (double y : ys_) var += (y - mean) * (y - mean);
+  double sd = std::sqrt(var / static_cast<double>(ys_.size()));
+  if (sd <= 0.0) sd = 1.0;
+  std::vector<double> yn(ys_.size());
+  double best_n = -1e30;
+  for (size_t i = 0; i < ys_.size(); ++i) {
+    yn[i] = (ys_[i] - mean) / sd;
+    best_n = std::max(best_n, yn[i]);
+  }
+  GaussianProcess gp(2, 0.3, gp_noise_);
+  bool fitted = gp.Fit(xs_, yn);
+
+  auto rnd = [this]() {
+    // xorshift64* — deterministic, no external RNG dependency.
+    rng_state_ ^= rng_state_ >> 12;
+    rng_state_ ^= rng_state_ << 25;
+    rng_state_ ^= rng_state_ >> 27;
+    return static_cast<double>((rng_state_ * 0x2545F4914F6CDD1Dull) >> 11) /
+           static_cast<double>(1ull << 53);
+  };
+  std::vector<double> best_x = {rnd(), rnd()};
+  if (fitted) {
+    double best_ei = -1.0;
+    for (int i = 0; i < 1000; ++i) {
+      std::vector<double> cand = {rnd(), rnd()};
+      double ei = gp.ExpectedImprovement(cand, best_n);
+      if (ei > best_ei) {
+        best_ei = ei;
+        best_x = cand;
+      }
+    }
+  }
+  FromUnit(best_x, &current_fusion_, &current_cycle_);
+  pending_broadcast_ = true;
+}
+
+bool ParameterManager::Update(const std::vector<Response>& responses,
+                              int64_t* fusion_out, double* cycle_out) {
+  if (!active_ || done_) return false;
+  if (pending_broadcast_) {
+    // Ship the newly proposed params this cycle.
+    pending_broadcast_ = false;
+    *fusion_out = current_fusion_;
+    *cycle_out = current_cycle_;
+    return true;
+  }
+  cycles_in_window_++;
+  if (cycles_in_window_ < cycles_per_sample_) return false;
+  double elapsed = std::chrono::duration<double>(
+                       std::chrono::steady_clock::now() - window_start_)
+                       .count();
+  int64_t bytes = bytes_accum_;
+  bytes_accum_ = 0;
+  cycles_in_window_ = 0;
+  window_start_ = std::chrono::steady_clock::now();
+  if (bytes == 0 || elapsed <= 0.0) {
+    return false;  // idle window: don't score (reference pauses tuning)
+  }
+  double score = static_cast<double>(bytes) / elapsed;
+  samples_done_++;
+  if (samples_done_ <= warmup_samples_) return false;
+
+  if (log_ != nullptr) {
+    std::fprintf(log_, "%lld,%.3f,%.1f\n",
+                 static_cast<long long>(current_fusion_), current_cycle_,
+                 score);
+    std::fflush(log_);
+  }
+  xs_.push_back(ToUnit(current_fusion_, current_cycle_));
+  ys_.push_back(score);
+  if (score > best_score_) {
+    best_score_ = score;
+    best_fusion_ = current_fusion_;
+    best_cycle_ = current_cycle_;
+  }
+  if (static_cast<int64_t>(ys_.size()) >= max_samples_) {
+    // Converge: lock in the best seen configuration.
+    done_ = true;
+    current_fusion_ = best_fusion_;
+    current_cycle_ = best_cycle_;
+    HVDTPU_LOG(INFO) << "autotune converged: fusion_threshold="
+                     << best_fusion_ << " cycle_time_ms=" << best_cycle_
+                     << " (best " << best_score_ / 1e6 << " MB/s)";
+    *fusion_out = best_fusion_;
+    *cycle_out = best_cycle_;
+    return true;
+  }
+  ProposeNext();
+  return false;  // proposal ships next cycle via pending_broadcast_
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,82 @@
+// Autotuner for fusion threshold + cycle time.
+//
+// Reference: horovod/common/parameter_manager.{h,cc} — the coordinator
+// scores each sample window by bytes/sec, proposes the next (fusion
+// threshold, cycle time) point by Bayesian optimization, and broadcasts
+// tuned values to the workers inside the negotiation round
+// (SynchronizeParameters, controller.cc:34-48; update loop
+// operations.cc:614-621). Knobs: HOROVOD_AUTOTUNE,
+// HOROVOD_AUTOTUNE_LOG, warmup samples, steps per sample, max samples,
+// GP noise (common.h:68-73).
+#ifndef HVDTPU_PARAMETER_MANAGER_H
+#define HVDTPU_PARAMETER_MANAGER_H
+
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "gp.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+class ParameterManager {
+ public:
+  void Initialize(int64_t fusion_threshold, double cycle_time_ms,
+                  const std::string& log_path, int64_t warmup_samples,
+                  int64_t cycles_per_sample, int64_t max_samples,
+                  double gp_noise);
+  ~ParameterManager();
+
+  bool active() const { return active_ && !done_; }
+
+  // Byte accounting, called once per cycle after ops execute.
+  void RecordBytes(int64_t bytes);
+
+  // Decision point, called from the coordinator cycle. Returns true when
+  // new parameters should be broadcast this cycle.
+  bool Update(const std::vector<Response>& responses, int64_t* fusion_out,
+              double* cycle_out);
+
+  int64_t best_fusion_threshold() const { return best_fusion_; }
+  double best_cycle_time_ms() const { return best_cycle_; }
+
+ private:
+  // Normalized [0,1]^2 <-> (log fusion bytes, log cycle ms).
+  std::vector<double> ToUnit(int64_t fusion, double cycle) const;
+  void FromUnit(const std::vector<double>& u, int64_t* fusion,
+                double* cycle) const;
+  void ProposeNext();
+
+  bool active_ = false;
+  bool done_ = false;
+  std::FILE* log_ = nullptr;
+
+  int64_t warmup_samples_ = 3;
+  int64_t cycles_per_sample_ = 10;
+  int64_t max_samples_ = 20;
+  double gp_noise_ = 0.8;
+
+  int64_t current_fusion_ = 64 << 20;
+  double current_cycle_ = 1.0;
+  int64_t best_fusion_ = 64 << 20;
+  double best_cycle_ = 1.0;
+  double best_score_ = 0.0;
+
+  int64_t bytes_accum_ = 0;
+  int64_t cycles_in_window_ = 0;
+  std::chrono::steady_clock::time_point window_start_;
+  int64_t samples_done_ = 0;
+
+  std::vector<std::vector<double>> xs_;
+  std::vector<double> ys_;  // raw bytes/sec scores
+  uint64_t rng_state_ = 0x9e3779b97f4a7c15ull;
+
+  bool pending_broadcast_ = false;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_PARAMETER_MANAGER_H

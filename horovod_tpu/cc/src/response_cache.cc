@@ -1,0 +1,139 @@
+#include "response_cache.h"
+
+#include <algorithm>
+
+namespace hvdtpu {
+
+ResponseCache::CacheState ResponseCache::cached(const Request& req) const {
+  auto it = cache_.find(req.tensor_name);
+  if (it == cache_.end()) return CacheState::MISS;
+  const CacheEntry& e = it->second;
+  bool same = e.dtype == req.tensor_type && e.shape == req.tensor_shape &&
+              e.prescale == req.prescale_factor &&
+              e.postscale == req.postscale_factor &&
+              e.reduce_op == req.reduce_op;
+  return same ? CacheState::HIT : CacheState::INVALID;
+}
+
+void ResponseCache::put(const Response& response) {
+  if (capacity_ == 0) return;
+  // Only single-tensor data-plane responses are cacheable (fusion happens
+  // over cached singles each cycle, as in the reference where fused
+  // responses are re-formed from cached bits, controller.cc:205-216).
+  // Alltoall stays uncached (splits may change per call) and so does
+  // allgather (ragged first dims mean there is no single job-wide shape to
+  // validate a hit against; the reference caches it by storing per-rank
+  // request params, but we keep one replicated shape so joined ranks can
+  // mirror insertions — see controller.cc).
+  if (response.tensor_names.size() != 1 ||
+      (response.response_type != Response::ALLREDUCE &&
+       response.response_type != Response::ADASUM &&
+       response.response_type != Response::BROADCAST)) {
+    return;
+  }
+  const std::string& name = response.tensor_names[0];
+  auto it = cache_.find(name);
+  if (it != cache_.end()) {
+    lru_.erase(it->second.lru_it);
+    it->second.response = response;
+    it->second.dtype = response.tensor_type;
+    it->second.shape = response.cache_shape;
+    it->second.prescale = response.prescale_factor;
+    it->second.postscale = response.postscale_factor;
+    it->second.reduce_op = response.reduce_op;
+    lru_.push_front(it->second.bit);
+    it->second.lru_it = lru_.begin();
+    return;
+  }
+  if (cache_.size() >= capacity_) {
+    // Evict least-recently-used (reference evicts via the same LRU list).
+    uint32_t victim = lru_.back();
+    erase_response(victim);
+  }
+  uint32_t bit;
+  if (!free_bits_.empty()) {
+    bit = free_bits_.back();
+    free_bits_.pop_back();
+  } else {
+    bit = static_cast<uint32_t>(bit_to_name_.size());
+    bit_to_name_.emplace_back();
+  }
+  bit_to_name_[bit] = name;
+  CacheEntry e;
+  e.response = response;
+  e.dtype = response.tensor_type;
+  e.shape = response.cache_shape;
+  e.prescale = response.prescale_factor;
+  e.postscale = response.postscale_factor;
+  e.reduce_op = response.reduce_op;
+  e.bit = bit;
+  lru_.push_front(bit);
+  e.lru_it = lru_.begin();
+  cache_.emplace(name, std::move(e));
+}
+
+Response ResponseCache::get_response(uint32_t bit) {
+  return cache_.at(bit_to_name_.at(bit)).response;
+}
+
+uint32_t ResponseCache::peek_cache_bit(const Request& req) const {
+  return cache_.at(req.tensor_name).bit;
+}
+
+void ResponseCache::erase_response(uint32_t bit) {
+  if (bit >= bit_to_name_.size() || bit_to_name_[bit].empty()) return;
+  auto it = cache_.find(bit_to_name_[bit]);
+  if (it != cache_.end()) {
+    lru_.erase(it->second.lru_it);
+    cache_.erase(it);
+  }
+  bit_to_name_[bit].clear();
+  free_bits_.push_back(bit);
+}
+
+void ResponseCache::clear() {
+  cache_.clear();
+  bit_to_name_.clear();
+  free_bits_.clear();
+  lru_.clear();
+}
+
+void ResponseCache::touch(uint32_t bit) {
+  if (bit >= bit_to_name_.size() || bit_to_name_[bit].empty()) return;
+  auto& e = cache_.at(bit_to_name_[bit]);
+  lru_.erase(e.lru_it);
+  lru_.push_front(bit);
+  e.lru_it = lru_.begin();
+}
+
+std::vector<int64_t> PackBits(const std::vector<uint32_t>& bits,
+                              size_t nbits) {
+  std::vector<int64_t> words((nbits + 63) / 64, 0);
+  for (uint32_t b : bits) {
+    if (b / 64 >= words.size()) words.resize(b / 64 + 1, 0);
+    words[b / 64] |= (int64_t{1} << (b % 64));
+  }
+  return words;
+}
+
+std::vector<uint32_t> UnpackBits(const std::vector<int64_t>& words) {
+  std::vector<uint32_t> bits;
+  for (size_t w = 0; w < words.size(); ++w) {
+    uint64_t word = static_cast<uint64_t>(words[w]);
+    while (word) {
+      int b = __builtin_ctzll(word);
+      bits.push_back(static_cast<uint32_t>(w * 64 + b));
+      word &= word - 1;
+    }
+  }
+  return bits;
+}
+
+std::vector<int64_t> AndWords(const std::vector<int64_t>& a,
+                              const std::vector<int64_t>& b) {
+  std::vector<int64_t> out(std::min(a.size(), b.size()));
+  for (size_t i = 0; i < out.size(); ++i) out[i] = a[i] & b[i];
+  return out;
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,77 @@
+// LRU cache of negotiated Responses, bit-indexed for cross-rank sync.
+//
+// Reference: horovod/common/response_cache.{h,cc} (response_cache.h:45-167).
+// After the first negotiation of a tensor, its Response is cached under a
+// stable bit position; on later cycles every rank marks the bits of its
+// ready tensors and the ranks agree via one bitwise-AND allreduce of the
+// bitvector instead of a full gather/bcast round (controller.cc:75-164).
+// This is the steady-state fast path.
+#ifndef HVDTPU_RESPONSE_CACHE_H
+#define HVDTPU_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+
+namespace hvdtpu {
+
+class ResponseCache {
+ public:
+  enum class CacheState { MISS, HIT, INVALID };
+
+  void set_capacity(uint32_t capacity) { capacity_ = capacity; }
+  uint32_t capacity() const { return capacity_; }
+  size_t num_active_bits() const { return cache_.size(); }
+
+  // MISS = never seen; HIT = cached with identical params; INVALID = cached
+  // but the request's dtype/shape/params changed (entry must be evicted
+  // globally before renegotiation) — reference: response_cache.cc cached().
+  CacheState cached(const Request& req) const;
+
+  // Cache a single-tensor response under its own carried params (dtype,
+  // cache_shape, scales, op) — every rank, joined or not, performs the same
+  // insertion so bit numbering stays aligned across the job.
+  void put(const Response& response);
+
+  Response get_response(uint32_t bit);
+  uint32_t peek_cache_bit(const Request& req) const;
+  bool has_bit(uint32_t bit) const { return bit < bit_to_name_.size() &&
+                                            !bit_to_name_[bit].empty(); }
+  void erase_response(uint32_t bit);
+  void clear();
+
+  // Bump LRU position for a hit (reference: update_cache_bits_).
+  void touch(uint32_t bit);
+
+ private:
+  struct CacheEntry {
+    Response response;
+    DataType dtype;
+    std::vector<int64_t> shape;
+    double prescale;
+    double postscale;
+    ReduceOp reduce_op;
+    uint32_t bit;
+    std::list<uint32_t>::iterator lru_it;
+  };
+
+  uint32_t capacity_ = 1024;
+  std::unordered_map<std::string, CacheEntry> cache_;
+  std::vector<std::string> bit_to_name_;
+  std::vector<uint32_t> free_bits_;
+  std::list<uint32_t> lru_;  // front = most recently used
+};
+
+// Helpers for the bit-packed vote exchanged between ranks.
+std::vector<int64_t> PackBits(const std::vector<uint32_t>& bits, size_t nbits);
+std::vector<uint32_t> UnpackBits(const std::vector<int64_t>& words);
+std::vector<int64_t> AndWords(const std::vector<int64_t>& a,
+                              const std::vector<int64_t>& b);
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_RESPONSE_CACHE_H

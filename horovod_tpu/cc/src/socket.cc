@@ -1,0 +1,223 @@
+#include "socket.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <thread>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+TcpSocket& TcpSocket::operator=(TcpSocket&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void TcpSocket::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+TcpSocket TcpSocket::Connect(const std::string& host, int port,
+                             double timeout_secs) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::duration<double>(timeout_secs);
+  std::string port_s = std::to_string(port);
+  while (true) {
+    struct addrinfo hints;
+    memset(&hints, 0, sizeof(hints));
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    int rc = ::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res);
+    if (rc == 0) {
+      for (struct addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+        int fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+        if (fd < 0) continue;
+        if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+          int one = 1;
+          ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+          ::freeaddrinfo(res);
+          return TcpSocket(fd);
+        }
+        ::close(fd);
+      }
+      ::freeaddrinfo(res);
+    }
+    if (std::chrono::steady_clock::now() >= deadline) {
+      HVDTPU_LOG(ERROR) << "connect to " << host << ":" << port
+                        << " timed out after " << timeout_secs << "s";
+      return TcpSocket();
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void TcpSocket::SetNonBlocking() {
+  int flags = ::fcntl(fd_, F_GETFL, 0);
+  if (flags >= 0) ::fcntl(fd_, F_SETFL, flags | O_NONBLOCK);
+}
+
+bool TcpSocket::SendAll(const void* data, size_t size) {
+  const char* p = static_cast<const char*>(data);
+  while (size > 0) {
+    ssize_t n = ::send(fd_, p, size, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        struct pollfd pfd{fd_, POLLOUT, 0};
+        if (::poll(&pfd, 1, 30000) <= 0) return false;
+        continue;
+      }
+      return false;
+    }
+    p += n;
+    size -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool TcpSocket::RecvAll(void* data, size_t size) {
+  char* p = static_cast<char*>(data);
+  while (size > 0) {
+    ssize_t n = ::recv(fd_, p, size, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        struct pollfd pfd{fd_, POLLIN, 0};
+        if (::poll(&pfd, 1, 30000) <= 0) return false;
+        continue;
+      }
+      return false;
+    }
+    p += n;
+    size -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool TcpSocket::SendFrame(const std::vector<char>& payload) {
+  int64_t len = static_cast<int64_t>(payload.size());
+  if (!SendAll(&len, 8)) return false;
+  return payload.empty() || SendAll(payload.data(), payload.size());
+}
+
+bool TcpSocket::RecvFrame(std::vector<char>* payload) {
+  int64_t len = 0;
+  if (!RecvAll(&len, 8)) return false;
+  if (len < 0 || len > (int64_t{1} << 40)) return false;
+  payload->resize(static_cast<size_t>(len));
+  return len == 0 || RecvAll(payload->data(), payload->size());
+}
+
+bool TcpSocket::SendRecv(const void* send_buf, size_t send_size,
+                         void* recv_buf, size_t recv_size) {
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  size_t to_send = send_size, to_recv = recv_size;
+  while (to_send > 0 || to_recv > 0) {
+    struct pollfd pfd;
+    pfd.fd = fd_;
+    pfd.events = 0;
+    if (to_send > 0) pfd.events |= POLLOUT;
+    if (to_recv > 0) pfd.events |= POLLIN;
+    pfd.revents = 0;
+    int rc = ::poll(&pfd, 1, 30000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (rc == 0) {
+      HVDTPU_LOG(ERROR) << "SendRecv poll timeout (30s)";
+      return false;
+    }
+    if (pfd.revents & (POLLERR | POLLHUP | POLLNVAL)) {
+      // Drain pending reads before declaring the peer dead.
+      if (!(pfd.revents & POLLIN)) return false;
+    }
+    if ((pfd.revents & POLLOUT) && to_send > 0) {
+      ssize_t n = ::send(fd_, sp, to_send, MSG_NOSIGNAL);
+      if (n < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (n > 0) {
+        sp += n;
+        to_send -= static_cast<size_t>(n);
+      }
+    }
+    if ((pfd.revents & POLLIN) && to_recv > 0) {
+      ssize_t n = ::recv(fd_, rp, to_recv, 0);
+      if (n == 0) return false;
+      if (n < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (n > 0) {
+        rp += n;
+        to_recv -= static_cast<size_t>(n);
+      }
+    }
+  }
+  return true;
+}
+
+bool TcpServer::Listen(int port) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) return false;
+  int one = 1;
+  ::setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd_, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) <
+      0) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  if (::listen(fd_, 128) < 0) {
+    ::close(fd_);
+    fd_ = -1;
+    return false;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd_, reinterpret_cast<struct sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  return true;
+}
+
+TcpSocket TcpServer::Accept(double timeout_secs) {
+  struct pollfd pfd;
+  pfd.fd = fd_;
+  pfd.events = POLLIN;
+  pfd.revents = 0;
+  int rc = ::poll(&pfd, 1, static_cast<int>(timeout_secs * 1000));
+  if (rc <= 0) return TcpSocket();
+  int cfd = ::accept(fd_, nullptr, nullptr);
+  if (cfd < 0) return TcpSocket();
+  int one = 1;
+  ::setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return TcpSocket(cfd);
+}
+
+void TcpServer::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+}  // namespace hvdtpu

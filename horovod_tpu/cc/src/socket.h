@@ -1,0 +1,73 @@
+// TCP socket helpers for the control and data planes.
+//
+// The reference's control plane rides MPI_Gather/Bcast or gloo's TCP store
+// (mpi_controller.cc:108-189, gloo_context.cc); the TPU control plane is
+// plain TCP between worker hosts. All messages are 8-byte-length-prefixed
+// frames.
+#ifndef HVDTPU_SOCKET_H
+#define HVDTPU_SOCKET_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class TcpSocket {
+ public:
+  TcpSocket() = default;
+  explicit TcpSocket(int fd) : fd_(fd) {}
+  TcpSocket(const TcpSocket&) = delete;
+  TcpSocket& operator=(const TcpSocket&) = delete;
+  TcpSocket(TcpSocket&& o) noexcept : fd_(o.fd_) { o.fd_ = -1; }
+  TcpSocket& operator=(TcpSocket&& o) noexcept;
+  ~TcpSocket() { Close(); }
+
+  bool valid() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+
+  // Data-plane sockets run non-blocking: a blocking send() of a multi-MB
+  // ring chunk would stall past the kernel socket buffer without draining
+  // the receive side — symmetric across the ring, that deadlocks. SendAll/
+  // RecvAll poll() on EAGAIN so callers keep sequential semantics.
+  void SetNonBlocking();
+
+  // Blocking connect with retry (the peer may not be listening yet during
+  // job bringup; reference gloo rendezvous retries the same way).
+  static TcpSocket Connect(const std::string& host, int port,
+                           double timeout_secs);
+
+  bool SendAll(const void* data, size_t size);
+  bool RecvAll(void* data, size_t size);
+
+  bool SendFrame(const std::vector<char>& payload);
+  bool RecvFrame(std::vector<char>* payload);
+
+  // Bidirectional exchange without deadlock on large payloads: progresses
+  // send and recv simultaneously via poll(). Needed by the ring collectives
+  // where both neighbors send at once.
+  bool SendRecv(const void* send_buf, size_t send_size, void* recv_buf,
+                size_t recv_size);
+
+ private:
+  int fd_ = -1;
+};
+
+class TcpServer {
+ public:
+  // Listen on an ephemeral (port=0) or fixed port on all interfaces.
+  bool Listen(int port);
+  int port() const { return port_; }
+  TcpSocket Accept(double timeout_secs);
+  void Close();
+  ~TcpServer() { Close(); }
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_SOCKET_H

@@ -1,0 +1,62 @@
+#include "stall_inspector.h"
+
+#include <sstream>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+void StallInspector::RecordUncachedTensorRank(const std::string& tensor_name,
+                                              int rank) {
+  if (!enabled_) return;
+  auto it = pending_.find(tensor_name);
+  if (it == pending_.end()) {
+    PendingTensor p;
+    p.first_seen = std::chrono::steady_clock::now();
+    p.ready_ranks.insert(rank);
+    pending_.emplace(tensor_name, std::move(p));
+  } else {
+    it->second.ready_ranks.insert(rank);
+  }
+}
+
+void StallInspector::RemoveUncachedTensor(const std::string& tensor_name) {
+  pending_.erase(tensor_name);
+}
+
+bool StallInspector::CheckForStalledTensors() {
+  if (!enabled_) return false;
+  auto now = std::chrono::steady_clock::now();
+  bool should_abort = false;
+  for (auto& kv : pending_) {
+    auto& p = kv.second;
+    double waited =
+        std::chrono::duration<double>(now - p.first_seen).count();
+    if (waited >= warning_secs_ && !p.warned) {
+      // Same diagnostic the reference emits: which ranks are ready, which
+      // are missing (stall_inspector.cc warning text structure).
+      std::ostringstream ready, missing;
+      for (int r : p.ready_ranks) ready << r << " ";
+      for (int r = 0; r < world_size_; ++r) {
+        if (p.ready_ranks.find(r) == p.ready_ranks.end()) missing << r << " ";
+      }
+      HVDTPU_LOG(WARNING)
+          << "One or more tensors were submitted to be reduced, gathered "
+          << "or broadcasted by subset of ranks and are waiting for "
+          << "remainder of ranks for more than " << warning_secs_
+          << " seconds. Stalled tensor: " << kv.first
+          << " [ready ranks: " << ready.str()
+          << "| missing ranks: " << missing.str() << "]";
+      p.warned = true;
+    }
+    if (shutdown_secs_ > 0 && waited >= shutdown_secs_) {
+      HVDTPU_LOG(ERROR) << "Tensor " << kv.first << " stalled for " << waited
+                        << "s, exceeding the shutdown deadline of "
+                        << shutdown_secs_ << "s; aborting.";
+      should_abort = true;
+    }
+  }
+  return should_abort;
+}
+
+}  // namespace hvdtpu

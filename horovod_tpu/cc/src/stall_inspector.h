@@ -1,0 +1,59 @@
+// Coordinator-side stall detection.
+//
+// Reference: horovod/common/stall_inspector.{h,cc} (stall_inspector.h:36-66,
+// wired into the negotiation at controller.cc:119-131): warns when a tensor
+// has been submitted by some-but-not-all ranks for longer than the warning
+// interval, listing ready vs missing ranks; optionally aborts the job after
+// a hard deadline.
+#ifndef HVDTPU_STALL_INSPECTOR_H
+#define HVDTPU_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <cstdint>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace hvdtpu {
+
+class StallInspector {
+ public:
+  void Configure(bool enabled, double warning_secs, double shutdown_secs,
+                 int world_size) {
+    enabled_ = enabled;
+    warning_secs_ = warning_secs;
+    shutdown_secs_ = shutdown_secs;
+    world_size_ = world_size;
+  }
+
+  // Record that `rank` submitted `tensor_name` this cycle.
+  void RecordUncachedTensorRank(const std::string& tensor_name, int rank);
+
+  // Tensor completed: forget it.
+  void RemoveUncachedTensor(const std::string& tensor_name);
+
+  // Scan for stalls; logs warnings. Returns true if the hard shutdown
+  // deadline has passed for some tensor (caller should abort, as the
+  // reference does when stall_shutdown_time elapses).
+  bool CheckForStalledTensors();
+
+  bool enabled() const { return enabled_; }
+
+ private:
+  struct PendingTensor {
+    std::chrono::steady_clock::time_point first_seen;
+    std::set<int> ready_ranks;
+    bool warned = false;
+  };
+
+  bool enabled_ = true;
+  double warning_secs_ = 60.0;
+  double shutdown_secs_ = 0.0;  // 0 = never hard-abort
+  int world_size_ = 1;
+  std::unordered_map<std::string, PendingTensor> pending_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_STALL_INSPECTOR_H

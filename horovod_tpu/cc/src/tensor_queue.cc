@@ -1,0 +1,61 @@
+#include "tensor_queue.h"
+
+namespace hvdtpu {
+
+Status TensorQueue::AddToTensorQueue(EntryPtr entry, Request message) {
+  std::lock_guard<std::mutex> g(mu_);
+  if (table_.find(entry->name) != table_.end()) {
+    return Status::InvalidArgument(HVDTPU_DUPLICATE_NAME_ERROR);
+  }
+  table_.emplace(entry->name, std::move(entry));
+  messages_.push_back(std::move(message));
+  return Status::OK();
+}
+
+std::vector<Request> TensorQueue::PopMessages() {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<Request> out(messages_.begin(), messages_.end());
+  messages_.clear();
+  return out;
+}
+
+std::vector<EntryPtr> TensorQueue::GetAndRemoveEntries(
+    const std::vector<std::string>& names) {
+  std::lock_guard<std::mutex> g(mu_);
+  std::vector<EntryPtr> out;
+  out.reserve(names.size());
+  for (const auto& n : names) {
+    auto it = table_.find(n);
+    if (it != table_.end()) {
+      out.push_back(it->second);
+      table_.erase(it);
+    } else {
+      out.push_back(nullptr);
+    }
+  }
+  return out;
+}
+
+EntryPtr TensorQueue::Get(const std::string& name) {
+  std::lock_guard<std::mutex> g(mu_);
+  auto it = table_.find(name);
+  return it == table_.end() ? nullptr : it->second;
+}
+
+void TensorQueue::AbortAll(const Status& reason) {
+  std::vector<EntryPtr> victims;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : table_) victims.push_back(kv.second);
+    table_.clear();
+    messages_.clear();
+  }
+  for (auto& e : victims) e->MarkDone(reason);
+}
+
+size_t TensorQueue::size() {
+  std::lock_guard<std::mutex> g(mu_);
+  return table_.size();
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,105 @@
+// Pending-tensor table + message queue.
+//
+// Reference: horovod/common/tensor_queue.{h,cc} — mutex-guarded
+// name→TensorTableEntry map plus a queue of negotiation messages; rejects
+// duplicate names (tensor_queue.h:28-69, common.h:163).
+#ifndef HVDTPU_TENSOR_QUEUE_H
+#define HVDTPU_TENSOR_QUEUE_H
+
+#include <condition_variable>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvdtpu {
+
+// One in-flight collective (reference: TensorTableEntry, common.h:191-258).
+// `data` points at caller-owned memory that must stay alive until the entry
+// completes; outputs that can't be written in place (allgather/alltoall)
+// land in `output`.
+struct TensorTableEntry {
+  std::string name;
+  Request::Type type = Request::ALLREDUCE;
+  DataType dtype = DataType::HVDTPU_FLOAT32;
+  void* data = nullptr;            // in/out for allreduce & broadcast
+  int64_t count = 0;               // element count of `data`
+  std::vector<int64_t> shape;
+  int32_t root_rank = 0;
+  double prescale_factor = 1.0;
+  double postscale_factor = 1.0;
+  ReduceOp reduce_op = ReduceOp::SUM;
+  std::vector<int64_t> splits;     // alltoall send splits (rows per rank)
+
+  // Results.
+  std::vector<char> output;        // allgather / alltoall received bytes
+  std::vector<int64_t> recv_splits;  // alltoall rows received per rank
+  int32_t join_result = -1;        // JOIN: last rank to join
+
+  // Completion signalling (reference uses a callback into the framework,
+  // common.h:231; the ctypes binding prefers wait/poll).
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  Status status;
+
+  void MarkDone(const Status& s) {
+    std::lock_guard<std::mutex> g(mu);
+    status = s;
+    done = true;
+    cv.notify_all();
+  }
+  Status Wait() {
+    std::unique_lock<std::mutex> g(mu);
+    cv.wait(g, [this] { return done; });
+    return status;
+  }
+  bool Done() {
+    std::lock_guard<std::mutex> g(mu);
+    return done;
+  }
+};
+
+using EntryPtr = std::shared_ptr<TensorTableEntry>;
+
+class TensorQueue {
+ public:
+  // Queue an entry + its negotiation request. Fails with
+  // DUPLICATE_NAME_ERROR if `name` is already in flight
+  // (reference: tensor_queue.cc AddToTensorQueue).
+  Status AddToTensorQueue(EntryPtr entry, Request message);
+
+  // Drain all pending negotiation messages (reference:
+  // PopMessagesFromQueue, controller.cc:79).
+  std::vector<Request> PopMessages();
+
+  // Look up + remove entries for a response's tensors (reference:
+  // GetTensorEntriesFromResponse). Aligned with `names`: slot i is nullptr
+  // when this rank holds no entry for names[i] — the joined-rank case,
+  // where the executor substitutes an identity contribution (the
+  // reference's zero-tensor substitution).
+  std::vector<EntryPtr> GetAndRemoveEntries(
+      const std::vector<std::string>& names);
+
+  EntryPtr Get(const std::string& name);
+
+  // Fail every pending entry (shutdown / elastic reset; reference:
+  // tensor_queue.cc ClearQueue-style teardown).
+  void AbortAll(const Status& reason);
+
+  size_t size();
+
+ private:
+  std::mutex mu_;
+  std::unordered_map<std::string, EntryPtr> table_;
+  std::deque<Request> messages_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TENSOR_QUEUE_H

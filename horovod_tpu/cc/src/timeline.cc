@@ -1,0 +1,146 @@
+#include "timeline.h"
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+void Timeline::Initialize(const std::string& path, bool mark_cycles) {
+  if (initialized_.load()) return;
+  file_ = std::fopen(path.c_str(), "w");
+  if (file_ == nullptr) {
+    HVDTPU_LOG(ERROR) << "Failed to open timeline file: " << path;
+    return;
+  }
+  std::fputs("[\n", file_);
+  first_event_ = true;
+  mark_cycles_ = mark_cycles;
+  start_ = std::chrono::steady_clock::now();
+  stop_ = false;
+  writer_ = std::thread(&Timeline::WriterLoop, this);
+  initialized_.store(true);
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_.load()) return;
+  {
+    std::lock_guard<std::mutex> g(mu_);
+    stop_ = true;
+    cv_.notify_all();
+  }
+  if (writer_.joinable()) writer_.join();
+  // Leave the JSON array unclosed — chrome://tracing accepts it, and so does
+  // the reference's writer (timeline.cc never writes the closing bracket).
+  std::fclose(file_);
+  file_ = nullptr;
+  initialized_.store(false);
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now() - start_)
+      .count();
+}
+
+int Timeline::LaneFor(const std::string& tensor) {
+  auto it = lanes_.find(tensor);
+  if (it != lanes_.end()) return it->second;
+  int lane = next_lane_++;
+  lanes_.emplace(tensor, lane);
+  return lane;
+}
+
+void Timeline::Enqueue(Event e) {
+  std::lock_guard<std::mutex> g(mu_);
+  queue_.push_back(std::move(e));
+  cv_.notify_one();
+}
+
+static void JsonEscape(const std::string& in, std::string* out) {
+  for (char c : in) {
+    if (c == '"' || c == '\\') {
+      out->push_back('\\');
+      out->push_back(c);
+    } else if (c >= 0x20) {
+      out->push_back(c);
+    }
+  }
+}
+
+void Timeline::WriterLoop() {
+  // Dedicated writer thread so fwrite latency never blocks the negotiation
+  // cycle (reference rationale: timeline.h:48-60).
+  std::unique_lock<std::mutex> lk(mu_);
+  while (true) {
+    cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+    while (!queue_.empty()) {
+      Event e = std::move(queue_.front());
+      queue_.pop_front();
+      lk.unlock();
+      int lane = LaneFor(e.tensor);
+      std::string name, tensor;
+      JsonEscape(e.name, &name);
+      JsonEscape(e.tensor, &tensor);
+      if (!first_event_) std::fputs(",\n", file_);
+      first_event_ = false;
+      if (e.ph == 'i') {
+        std::fprintf(file_,
+                     "{\"ph\":\"i\",\"name\":\"%s\",\"pid\":0,\"tid\":%d,"
+                     "\"ts\":%lld,\"s\":\"g\"}",
+                     name.c_str(), lane, static_cast<long long>(e.ts_us));
+      } else {
+        std::fprintf(file_,
+                     "{\"ph\":\"%c\",\"name\":\"%s\",\"pid\":0,\"tid\":%d,"
+                     "\"ts\":%lld,\"args\":{\"tensor\":\"%s\"}}",
+                     e.ph, name.c_str(), lane,
+                     static_cast<long long>(e.ts_us), tensor.c_str());
+      }
+      lk.lock();
+    }
+    if (stop_) break;
+  }
+  std::fflush(file_);
+}
+
+void Timeline::NegotiateStart(const std::string& tensor_name,
+                              const char* op_name) {
+  if (!initialized_.load()) return;
+  Enqueue({'B', std::string("NEGOTIATE_") + op_name, tensor_name, NowUs()});
+}
+
+void Timeline::NegotiateRankReady(const std::string& tensor_name, int rank) {
+  if (!initialized_.load()) return;
+  Enqueue({'i', "RANK_READY_" + std::to_string(rank), tensor_name, NowUs()});
+}
+
+void Timeline::NegotiateEnd(const std::string& tensor_name) {
+  if (!initialized_.load()) return;
+  Enqueue({'E', "NEGOTIATE", tensor_name, NowUs()});
+}
+
+void Timeline::Start(const std::string& tensor_name, const char* op_name) {
+  if (!initialized_.load()) return;
+  Enqueue({'B', op_name, tensor_name, NowUs()});
+}
+
+void Timeline::ActivityStart(const std::string& tensor_name,
+                             const char* activity) {
+  if (!initialized_.load()) return;
+  Enqueue({'B', activity, tensor_name, NowUs()});
+}
+
+void Timeline::ActivityEnd(const std::string& tensor_name) {
+  if (!initialized_.load()) return;
+  Enqueue({'E', "", tensor_name, NowUs()});
+}
+
+void Timeline::End(const std::string& tensor_name) {
+  if (!initialized_.load()) return;
+  Enqueue({'E', "", tensor_name, NowUs()});
+}
+
+void Timeline::MarkCycleStart() {
+  if (!initialized_.load() || !mark_cycles_) return;
+  Enqueue({'i', "CYCLE_START", "", NowUs()});
+}
+
+}  // namespace hvdtpu

@@ -1,0 +1,76 @@
+// Chrome-tracing timeline writer.
+//
+// Reference: horovod/common/timeline.{h,cc} — coordinator-only JSON writer
+// fed by a lockfree SPSC queue from the negotiation thread (timeline.h:48-80)
+// with per-tensor lifecycle phases NEGOTIATE_* → QUEUE → op activities
+// (common.h:31-62), runtime start/stop (operations.cc:715-757), and optional
+// cycle markers. We use a mutex+cv MPSC queue (the producer is the single
+// background thread, so contention is nil) and the same chrome://tracing
+// event shapes: ts/ph/B/E/X/i with tid = tensor lane.
+#ifndef HVDTPU_TIMELINE_H
+#define HVDTPU_TIMELINE_H
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvdtpu {
+
+class Timeline {
+ public:
+  ~Timeline() { Shutdown(); }
+
+  void Initialize(const std::string& path, bool mark_cycles);
+  void Shutdown();
+  bool Initialized() const { return initialized_.load(); }
+  bool MarkCycles() const { return mark_cycles_; }
+
+  // Per-tensor lifecycle (reference: timeline.h NegotiateStart/End,
+  // Start/ActivityStart/ActivityEnd/End).
+  void NegotiateStart(const std::string& tensor_name, const char* op_name);
+  void NegotiateRankReady(const std::string& tensor_name, int rank);
+  void NegotiateEnd(const std::string& tensor_name);
+  void Start(const std::string& tensor_name, const char* op_name);
+  void ActivityStart(const std::string& tensor_name, const char* activity);
+  void ActivityEnd(const std::string& tensor_name);
+  void End(const std::string& tensor_name);
+  void MarkCycleStart();
+
+ private:
+  struct Event {
+    char ph;              // 'B','E','X','i'
+    std::string name;     // event name (phase/activity)
+    std::string tensor;   // lane
+    int64_t ts_us;
+  };
+
+  void Enqueue(Event e);
+  void WriterLoop();
+  int64_t NowUs() const;
+  int LaneFor(const std::string& tensor);
+
+  std::atomic<bool> initialized_{false};
+  bool mark_cycles_ = false;
+  std::FILE* file_ = nullptr;
+  bool first_event_ = true;
+  std::chrono::steady_clock::time_point start_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  bool stop_ = false;
+  std::thread writer_;
+
+  std::unordered_map<std::string, int> lanes_;
+  int next_lane_ = 1;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TIMELINE_H

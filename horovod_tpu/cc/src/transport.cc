@@ -1,0 +1,276 @@
+#include "transport.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+
+#include "logging.h"
+
+namespace hvdtpu {
+
+namespace {
+
+// The address this process uses to reach the coordinator — the right NIC for
+// peers to reach us on multi-host jobs (the reference discovers routable
+// interfaces with a driver/task RPC dance, driver_service.py; asking the
+// kernel which source address the control connection bound to achieves the
+// same for our star topology).
+std::string LocalAddrOf(const TcpSocket& sock) {
+  struct sockaddr_storage ss;
+  socklen_t len = sizeof(ss);
+  if (::getsockname(sock.fd(), reinterpret_cast<struct sockaddr*>(&ss),
+                    &len) != 0) {
+    return "127.0.0.1";
+  }
+  char buf[64] = {0};
+  if (ss.ss_family == AF_INET) {
+    auto* a = reinterpret_cast<struct sockaddr_in*>(&ss);
+    ::inet_ntop(AF_INET, &a->sin_addr, buf, sizeof(buf));
+  } else if (ss.ss_family == AF_INET6) {
+    auto* a = reinterpret_cast<struct sockaddr_in6*>(&ss);
+    ::inet_ntop(AF_INET6, &a->sin6_addr, buf, sizeof(buf));
+  }
+  return buf[0] ? std::string(buf) : std::string("127.0.0.1");
+}
+
+}  // namespace
+
+Transport::~Transport() = default;
+
+std::unique_ptr<Transport> Transport::Create(int rank, int size,
+                                             const std::string& coord_addr,
+                                             int coord_port,
+                                             double timeout_secs) {
+  std::unique_ptr<Transport> t(new Transport(rank, size));
+  if (size == 1) return t;  // no wires needed
+  if (!t->data_server_.Listen(0)) {
+    HVDTPU_LOG(ERROR) << "failed to open data-plane listener";
+    return nullptr;
+  }
+  bool ok = rank == 0 ? t->SetupCoordinator(coord_port, timeout_secs)
+                      : t->SetupWorker(coord_addr, coord_port, timeout_secs);
+  if (!ok) return nullptr;
+  return t;
+}
+
+bool Transport::SetupCoordinator(int coord_port, double timeout_secs) {
+  if (!control_server_.Listen(coord_port)) {
+    HVDTPU_LOG(ERROR) << "coordinator failed to listen on port " << coord_port;
+    return false;
+  }
+  control_.resize(static_cast<size_t>(size_));
+  std::vector<std::string> addrs(static_cast<size_t>(size_), "127.0.0.1");
+  std::vector<int> ports(static_cast<size_t>(size_), 0);
+  ports[0] = data_server_.port();
+  // Accept size-1 hellos: {rank, data_port}; data addr observed from the
+  // connection itself.
+  for (int i = 1; i < size_; ++i) {
+    TcpSocket s = control_server_.Accept(timeout_secs);
+    if (!s.valid()) {
+      HVDTPU_LOG(ERROR) << "coordinator: timed out waiting for workers ("
+                        << i - 1 << "/" << size_ - 1 << " connected)";
+      return false;
+    }
+    std::vector<char> hello;
+    if (!s.RecvFrame(&hello)) return false;
+    WireReader r(hello);
+    int32_t wrank = r.i32();
+    int32_t wport = r.i32();
+    if (wrank <= 0 || wrank >= size_ || control_[wrank].valid()) {
+      HVDTPU_LOG(ERROR) << "coordinator: bad hello rank " << wrank;
+      return false;
+    }
+    struct sockaddr_storage ss;
+    socklen_t len = sizeof(ss);
+    char buf[64] = {0};
+    if (::getpeername(s.fd(), reinterpret_cast<struct sockaddr*>(&ss), &len) ==
+        0) {
+      if (ss.ss_family == AF_INET) {
+        auto* a = reinterpret_cast<struct sockaddr_in*>(&ss);
+        ::inet_ntop(AF_INET, &a->sin_addr, buf, sizeof(buf));
+      } else if (ss.ss_family == AF_INET6) {
+        auto* a = reinterpret_cast<struct sockaddr_in6*>(&ss);
+        ::inet_ntop(AF_INET6, &a->sin6_addr, buf, sizeof(buf));
+      }
+    }
+    addrs[wrank] = buf[0] ? buf : "127.0.0.1";
+    ports[wrank] = wport;
+    control_[wrank] = std::move(s);
+  }
+  // Coordinator's own data addr: as seen by workers we don't know generally;
+  // use the address of the first worker's control socket's local end.
+  addrs[0] = LocalAddrOf(control_[1]);
+  // Broadcast the address book.
+  WireWriter w;
+  for (int i = 0; i < size_; ++i) {
+    w.str(addrs[i]);
+    w.i32(ports[i]);
+  }
+  std::vector<char> book = w.take();
+  for (int i = 1; i < size_; ++i) {
+    if (!control_[i].SendFrame(book)) return false;
+  }
+  return SetupDataMesh(addrs, ports, timeout_secs);
+}
+
+bool Transport::SetupWorker(const std::string& coord_addr, int coord_port,
+                            double timeout_secs) {
+  control_.resize(1);
+  control_[0] = TcpSocket::Connect(coord_addr, coord_port, timeout_secs);
+  if (!control_[0].valid()) return false;
+  WireWriter hello;
+  hello.i32(rank_);
+  hello.i32(data_server_.port());
+  if (!control_[0].SendFrame(hello.data())) return false;
+  std::vector<char> book;
+  if (!control_[0].RecvFrame(&book)) return false;
+  WireReader r(book);
+  std::vector<std::string> addrs(static_cast<size_t>(size_));
+  std::vector<int> ports(static_cast<size_t>(size_));
+  for (int i = 0; i < size_; ++i) {
+    addrs[i] = r.str();
+    ports[i] = r.i32();
+  }
+  return SetupDataMesh(addrs, ports, timeout_secs);
+}
+
+bool Transport::SetupDataMesh(const std::vector<std::string>& addrs,
+                              const std::vector<int>& ports,
+                              double timeout_secs) {
+  // Deterministic full mesh: rank r dials every lower rank and accepts from
+  // every higher rank; the dialer announces its rank.
+  data_.resize(static_cast<size_t>(size_));
+  for (int peer = 0; peer < rank_; ++peer) {
+    TcpSocket s = TcpSocket::Connect(addrs[peer], ports[peer], timeout_secs);
+    if (!s.valid()) {
+      HVDTPU_LOG(ERROR) << "data mesh: rank " << rank_
+                        << " failed to reach rank " << peer << " at "
+                        << addrs[peer] << ":" << ports[peer];
+      return false;
+    }
+    int32_t me = rank_;
+    if (!s.SendAll(&me, 4)) return false;
+    s.SetNonBlocking();
+    data_[peer] = std::move(s);
+  }
+  for (int n = rank_ + 1; n < size_; ++n) {
+    TcpSocket s = data_server_.Accept(timeout_secs);
+    if (!s.valid()) {
+      HVDTPU_LOG(ERROR) << "data mesh: rank " << rank_
+                        << " timed out accepting peers";
+      return false;
+    }
+    int32_t peer = -1;
+    if (!s.RecvAll(&peer, 4) || peer <= rank_ || peer >= size_ ||
+        data_[peer].valid()) {
+      HVDTPU_LOG(ERROR) << "data mesh: bad peer hello " << peer;
+      return false;
+    }
+    s.SetNonBlocking();
+    data_[peer] = std::move(s);
+  }
+  return true;
+}
+
+bool Transport::GatherRequestLists(std::vector<RequestList>* out) {
+  out->assign(static_cast<size_t>(size_), RequestList{});
+  for (int i = 1; i < size_; ++i) {
+    std::vector<char> frame;
+    if (!control_[i].RecvFrame(&frame)) {
+      HVDTPU_LOG(ERROR) << "coordinator: lost worker rank " << i;
+      return false;
+    }
+    WireReader r(frame);
+    (*out)[i] = RequestList::Deserialize(r);
+  }
+  return true;
+}
+
+bool Transport::SendRequestList(const RequestList& list) {
+  WireWriter w;
+  list.Serialize(w);
+  return control_[0].SendFrame(w.data());
+}
+
+bool Transport::BcastResponseList(const ResponseList& list) {
+  WireWriter w;
+  list.Serialize(w);
+  std::vector<char> frame = w.take();
+  for (int i = 1; i < size_; ++i) {
+    if (!control_[i].SendFrame(frame)) return false;
+  }
+  return true;
+}
+
+bool Transport::RecvResponseList(ResponseList* out) {
+  std::vector<char> frame;
+  if (!control_[0].RecvFrame(&frame)) return false;
+  WireReader r(frame);
+  *out = ResponseList::Deserialize(r);
+  return true;
+}
+
+bool Transport::SendToRank(int dst, const void* data, size_t size) {
+  return data_[dst].SendAll(data, size);
+}
+
+bool Transport::RecvFromRank(int src, void* data, size_t size) {
+  return data_[src].RecvAll(data, size);
+}
+
+bool Transport::RingExchange(int right, const void* send_buf,
+                             size_t send_size, int left, void* recv_buf,
+                             size_t recv_size) {
+  if (right == left) {
+    // 2-rank ring: both directions on one socket.
+    return data_[right].SendRecv(send_buf, send_size, recv_buf, recv_size);
+  }
+  const char* sp = static_cast<const char*>(send_buf);
+  char* rp = static_cast<char*>(recv_buf);
+  size_t to_send = send_size, to_recv = recv_size;
+  while (to_send > 0 || to_recv > 0) {
+    struct pollfd pfds[2];
+    pfds[0].fd = data_[right].fd();
+    pfds[0].events = to_send > 0 ? POLLOUT : 0;
+    pfds[0].revents = 0;
+    pfds[1].fd = data_[left].fd();
+    pfds[1].events = to_recv > 0 ? POLLIN : 0;
+    pfds[1].revents = 0;
+    int rc = ::poll(pfds, 2, 30000);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (rc == 0) {
+      HVDTPU_LOG(ERROR) << "RingExchange poll timeout";
+      return false;
+    }
+    if ((pfds[0].revents & POLLOUT) && to_send > 0) {
+      ssize_t n = ::send(pfds[0].fd, sp, to_send, MSG_NOSIGNAL);
+      if (n < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (n > 0) {
+        sp += n;
+        to_send -= static_cast<size_t>(n);
+      }
+    }
+    if ((pfds[1].revents & POLLIN) && to_recv > 0) {
+      ssize_t n = ::recv(pfds[1].fd, rp, to_recv, 0);
+      if (n == 0) return false;
+      if (n < 0 && errno != EINTR && errno != EAGAIN) return false;
+      if (n > 0) {
+        rp += n;
+        to_recv -= static_cast<size_t>(n);
+      }
+    }
+    if ((pfds[0].revents & (POLLERR | POLLHUP | POLLNVAL)) && to_send > 0)
+      return false;
+    if ((pfds[1].revents & (POLLERR | POLLHUP | POLLNVAL)) &&
+        !(pfds[1].revents & POLLIN) && to_recv > 0)
+      return false;
+  }
+  return true;
+}
+
+}  // namespace hvdtpu

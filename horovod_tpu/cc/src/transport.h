@@ -1,0 +1,78 @@
+// Control-plane star + data-plane mesh over TCP.
+//
+// Reference split: the controller transport (MPI gather/bcast of serialized
+// lists, mpi_controller.cc:108-189, or gloo's store) vs the data plane
+// (NCCL/Gloo collectives). Here both ride TCP between worker processes (one
+// per TPU host): rank 0 runs the control server every cycle (gather
+// RequestLists, broadcast ResponseList — the coordinator protocol of
+// controller.h:63-100), and all ranks hold a full mesh of data links used by
+// the ring collectives in collectives.cc.
+#ifndef HVDTPU_TRANSPORT_H
+#define HVDTPU_TRANSPORT_H
+
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "message.h"
+#include "socket.h"
+
+namespace hvdtpu {
+
+class Transport {
+ public:
+  // rank 0 listens on `coord_port`; workers connect to
+  // `coord_addr:coord_port`. Establishes the control star and the full data
+  // mesh (address book exchanged through the coordinator, the same role the
+  // HTTP rendezvous store plays for gloo bootstrap, gloo_context.cc:49-84).
+  static std::unique_ptr<Transport> Create(int rank, int size,
+                                           const std::string& coord_addr,
+                                           int coord_port,
+                                           double timeout_secs);
+
+  ~Transport();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // --- control plane (cycle round-trip) ---
+  // Coordinator: receive one RequestList from every worker (index = rank,
+  // [0] is unused). Returns false on a lost worker.
+  bool GatherRequestLists(std::vector<RequestList>* out);
+  // Worker: send this cycle's RequestList.
+  bool SendRequestList(const RequestList& list);
+  // Coordinator: broadcast the agreed ResponseList.
+  bool BcastResponseList(const ResponseList& list);
+  // Worker: receive it.
+  bool RecvResponseList(ResponseList* out);
+
+  // --- data plane ---
+  bool SendToRank(int dst, const void* data, size_t size);
+  bool RecvFromRank(int src, void* data, size_t size);
+  // Simultaneous send-to-right / recv-from-left progress for ring steps
+  // (two different sockets, both progressed under one poll loop).
+  bool RingExchange(int right, const void* send_buf, size_t send_size,
+                    int left, void* recv_buf, size_t recv_size);
+
+ private:
+  Transport(int rank, int size) : rank_(rank), size_(size) {}
+  bool SetupCoordinator(int coord_port, double timeout_secs);
+  bool SetupWorker(const std::string& coord_addr, int coord_port,
+                   double timeout_secs);
+  bool SetupDataMesh(const std::vector<std::string>& addrs,
+                     const std::vector<int>& ports, double timeout_secs);
+
+  int rank_;
+  int size_;
+  // Control star: coordinator holds worker sockets indexed by rank (slot 0
+  // empty); workers hold a single socket to the coordinator in slot 0.
+  std::vector<TcpSocket> control_;
+  TcpServer control_server_;
+  // Full mesh of data links, indexed by peer rank (self slot empty).
+  std::vector<TcpSocket> data_;
+  TcpServer data_server_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_TRANSPORT_H

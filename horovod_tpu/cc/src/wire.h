@@ -1,0 +1,86 @@
+// Minimal length-prefixed little-endian serializer.
+//
+// The reference serializes Request/Response lists with FlatBuffers
+// (wire/message.fbs, message.cc:>serialize). Both ends of our wire are this
+// library, so a compact hand-rolled format avoids the vendored dependency
+// while keeping the same message semantics.
+#ifndef HVDTPU_WIRE_H
+#define HVDTPU_WIRE_H
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hvdtpu {
+
+class WireWriter {
+ public:
+  void u8(uint8_t v) { buf_.push_back(static_cast<char>(v)); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    i32(static_cast<int32_t>(s.size()));
+    buf_.insert(buf_.end(), s.begin(), s.end());
+  }
+  void i64s(const std::vector<int64_t>& v) {
+    i32(static_cast<int32_t>(v.size()));
+    for (auto x : v) i64(x);
+  }
+  void bytes(const std::vector<char>& v) {
+    i32(static_cast<int32_t>(v.size()));
+    buf_.insert(buf_.end(), v.begin(), v.end());
+  }
+  const std::vector<char>& data() const { return buf_; }
+  std::vector<char> take() { return std::move(buf_); }
+
+ private:
+  void raw(const void* p, size_t n) {
+    const char* c = static_cast<const char*>(p);
+    buf_.insert(buf_.end(), c, c + n);
+  }
+  std::vector<char> buf_;
+};
+
+class WireReader {
+ public:
+  WireReader(const char* data, size_t size) : p_(data), end_(data + size) {}
+  explicit WireReader(const std::vector<char>& v)
+      : WireReader(v.data(), v.size()) {}
+  uint8_t u8() { return static_cast<uint8_t>(*take(1)); }
+  int32_t i32() { int32_t v; std::memcpy(&v, take(4), 4); return v; }
+  int64_t i64() { int64_t v; std::memcpy(&v, take(8), 8); return v; }
+  double f64() { double v; std::memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    int32_t n = i32();
+    return std::string(take(static_cast<size_t>(n)), static_cast<size_t>(n));
+  }
+  std::vector<int64_t> i64s() {
+    int32_t n = i32();
+    std::vector<int64_t> v(static_cast<size_t>(n));
+    for (auto& x : v) x = i64();
+    return v;
+  }
+  std::vector<char> bytes() {
+    int32_t n = i32();
+    const char* p = take(static_cast<size_t>(n));
+    return std::vector<char>(p, p + n);
+  }
+  bool done() const { return p_ == end_; }
+
+ private:
+  const char* take(size_t n) {
+    if (p_ + n > end_) throw std::runtime_error("wire: truncated message");
+    const char* r = p_;
+    p_ += n;
+    return r;
+  }
+  const char* p_;
+  const char* end_;
+};
+
+}  // namespace hvdtpu
+
+#endif  // HVDTPU_WIRE_H

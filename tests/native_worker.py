@@ -1,0 +1,156 @@
+"""Worker script for multi-process native-core tests.
+
+Launched by tests/test_native_core.py as N subprocesses on localhost with
+the launcher env contract (HOROVOD_RANK/SIZE + controller address) — the
+same pattern the reference uses for its parallel test tier
+(`mpirun -np 2 pytest`, SURVEY §4). Exercises every collective, fusion,
+the response-cache steady state, the cross-rank consistency checker, and
+Adasum, asserting numerics at each step.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from horovod_tpu import cc  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    ctx = cc.CoreContext()
+    assert ctx.rank() == rank and ctx.size() == size
+
+    # allreduce sum / average / min / max / product
+    out = ctx.allreduce_async(np.arange(10, dtype=np.float32) + rank,
+                              "ar").wait()
+    assert np.allclose(out, np.arange(10) * size + sum(range(size)))
+    out = ctx.allreduce_async(np.full(5, float(rank), np.float32), "avg",
+                              postscale=1.0 / size).wait()
+    assert np.allclose(out, sum(range(size)) / size)
+    out = ctx.allreduce_async(np.array([float(rank)], np.float32), "mn",
+                              op=ctx.MIN).wait()
+    assert out[0] == 0.0
+    out = ctx.allreduce_async(np.array([float(rank)], np.float32), "mx",
+                              op=ctx.MAX).wait()
+    assert out[0] == size - 1
+    out = ctx.allreduce_async(np.array([2.0], np.float32), "pr",
+                              op=ctx.PRODUCT).wait()
+    assert out[0] == 2.0 ** size
+
+    # 16-bit dtypes through the software-converted reduction paths
+    out = ctx.allreduce_async(np.ones(8, np.float16), "f16").wait()
+    assert np.allclose(out.astype(np.float32), size)
+    try:
+        import ml_dtypes
+        out = ctx.allreduce_async(np.ones(8, ml_dtypes.bfloat16),
+                                  "bf16").wait()
+        assert np.allclose(np.asarray(out, np.float32), size)
+    except ImportError:
+        pass
+
+    # prescale/postscale
+    out = ctx.allreduce_async(np.ones(4, np.float32), "sc", prescale=2.0,
+                              postscale=0.5).wait()
+    assert np.allclose(out, size)
+
+    # ragged allgather: rank r contributes r+1 rows
+    g = ctx.allgather_async(np.full((rank + 1, 2), rank, np.int32),
+                            "ag").wait()
+    assert g.shape == (sum(r + 1 for r in range(size)), 2)
+    row = 0
+    for r in range(size):
+        assert (g[row:row + r + 1] == r).all()
+        row += r + 1
+
+    # broadcast from a non-zero root
+    root = 1 % size
+    out = ctx.broadcast_async(np.full(4, rank, np.float64), "bc",
+                              root=root).wait()
+    assert (out == root).all()
+
+    # alltoall with uneven splits: rank r sends d+1 rows to dest d
+    splits = [d + 1 for d in range(size)]
+    h = ctx.alltoall_async(np.full((sum(splits), 3), rank, np.float32),
+                           "a2a", splits=splits)
+    out = h.wait()
+    assert h.recv_splits() == [rank + 1] * size
+    row = 0
+    for src in range(size):
+        assert (out[row:row + rank + 1] == src).all()
+        row += rank + 1
+
+    # tensor fusion: a burst of small same-dtype tensors
+    hs = [ctx.allreduce_async(np.full(3, float(i), np.float32), f"f{i}")
+          for i in range(20)]
+    for i, h in enumerate(hs):
+        assert np.allclose(h.wait(), i * size)
+
+    # response-cache steady state: same name over many cycles
+    for _ in range(30):
+        out = ctx.allreduce_async(np.ones(4, np.float32), "steady").wait()
+        assert np.allclose(out, size)
+    # shape change on a cached tensor: must invalidate + renegotiate cleanly
+    for _ in range(3):
+        out = ctx.allreduce_async(np.ones(9, np.float32), "steady").wait()
+        assert np.allclose(out, size)
+
+    # cross-rank consistency checker (reference: ConstructResponse errors)
+    if size > 1:
+        try:
+            ctx.allreduce_async(np.ones(4 + rank, np.float32), "bad").wait()
+            raise AssertionError("expected shape-mismatch error")
+        except cc.NativeError as e:
+            assert "Mismatched" in str(e)
+        try:
+            arr = (np.ones(4, np.float32) if rank == 0
+                   else np.ones(4, np.float64))
+            ctx.allreduce_async(arr, "badtype").wait()
+            raise AssertionError("expected dtype-mismatch error")
+        except cc.NativeError as e:
+            assert "Mismatched" in str(e)
+
+    # adasum (power-of-2 worlds): identical vectors average to themselves
+    if size & (size - 1) == 0:
+        out = ctx.allreduce_async(np.ones(4, np.float32), "ads",
+                                  op=ctx.ADASUM).wait()
+        assert np.allclose(out, 1.0, atol=1e-5)
+
+    # large buffer: ring chunks far beyond kernel socket buffers must not
+    # deadlock (regression: blocking send() in the bidirectional exchange)
+    big = np.ones(8 << 20, np.float32)  # 32 MB
+    out = ctx.allreduce_async(big, "big").wait()
+    assert out[0] == size and out[-1] == size
+
+    # join: ranks exit the data loop at different times; late collectives
+    # proceed with identity contributions from joined ranks
+    if size > 1:
+        if rank == 0:
+            # Regression: a tensor enqueued *before* join must still
+            # contribute real data, not identity.
+            pre = ctx.allreduce_async(np.full(4, 2.0, np.float32),
+                                      "post_join")
+            jh = ctx.join_async()
+            assert np.allclose(pre.wait(), 2.0 * size)
+        else:
+            out = ctx.allreduce_async(
+                np.full(4, 2.0, np.float32), "post_join").wait()
+            assert np.allclose(out, 2.0 * size), out
+            # A second collective after rank 0 joined for real: identity
+            # contribution from the joined rank.
+            out = ctx.allreduce_async(
+                np.full(4, 3.0, np.float32), "post_join2").wait()
+            assert np.allclose(out, 3.0 * (size - 1)), out
+            jh = ctx.join_async()
+        jh.wait()
+        assert jh.join_result() >= 0
+
+    ctx.barrier()
+    ctx.close()
+    print(f"rank {rank}: OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,162 @@
+"""Native C++ core tests: single-process pipeline + multi-process localhost.
+
+Mirrors the reference's test tiers (SURVEY §4): single-process logic tests
+against the trivial world, and parallel tests running N real processes over
+localhost TCP — the analogue of `mpirun -np 2 pytest test_tensorflow.py`.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from horovod_tpu import cc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    """Single-process core context (world of one, full pipeline)."""
+    # Ensure a clean world regardless of inherited env.
+    for k in ("HOROVOD_RANK", "HOROVOD_SIZE"):
+        os.environ.pop(k, None)
+    c = cc.CoreContext()
+    yield c
+    c.close()
+
+
+class TestSingleProcess:
+    def test_world(self, ctx):
+        assert ctx.rank() == 0
+        assert ctx.size() == 1
+        assert ctx.fusion_threshold() == 64 * 1024 * 1024
+
+    def test_allreduce_identity(self, ctx):
+        a = np.arange(8, dtype=np.float32)
+        out = ctx.allreduce_async(a.copy(), "sp_ar").wait()
+        assert np.allclose(out, a)
+
+    def test_allreduce_postscale(self, ctx):
+        out = ctx.allreduce_async(np.ones(4, np.float32), "sp_ps",
+                                  postscale=0.25).wait()
+        assert np.allclose(out, 0.25)
+
+    def test_allgather(self, ctx):
+        out = ctx.allgather_async(np.ones((3, 2), np.float32),
+                                  "sp_ag").wait()
+        assert out.shape == (3, 2)
+
+    def test_broadcast(self, ctx):
+        out = ctx.broadcast_async(np.arange(4, dtype=np.int64), "sp_bc",
+                                  root=0).wait()
+        assert (out == np.arange(4)).all()
+
+    def test_alltoall(self, ctx):
+        h = ctx.alltoall_async(np.arange(6, dtype=np.float64).reshape(6, 1),
+                               "sp_a2a")
+        out = h.wait()
+        assert np.allclose(out.ravel(), np.arange(6))
+        assert h.recv_splits() == [6]
+
+    def test_barrier(self, ctx):
+        ctx.barrier()
+
+    def test_duplicate_name_rejected(self, ctx):
+        # Reference: DUPLICATE_NAME_ERROR (common.h:163) surfaces when a
+        # name is re-submitted while still in flight.
+        h1 = ctx.allreduce_async(np.ones(1024, np.float32), "sp_dup")
+        try:
+            h2 = ctx.allreduce_async(np.ones(1024, np.float32), "sp_dup")
+        except cc.NativeError as e:
+            assert "same name" in str(e)
+        else:
+            h2.wait()  # raced past the first completion — legal
+        h1.wait()
+
+    def test_int_dtypes(self, ctx):
+        for dt in (np.uint8, np.int8, np.int32, np.int64):
+            out = ctx.allreduce_async(np.ones(4, dt), f"sp_{dt.__name__}"
+                                      ).wait()
+            assert (out == 1).all()
+
+    def test_cache_steady_state(self, ctx):
+        for _ in range(20):
+            out = ctx.allreduce_async(np.ones(4, np.float32),
+                                      "sp_steady").wait()
+            assert np.allclose(out, 1.0)
+
+    def test_timeline(self, ctx, tmp_path):
+        path = str(tmp_path / "tl.json")
+        ctx.start_timeline(path)
+        for i in range(5):
+            ctx.allreduce_async(np.ones(4, np.float32), f"sp_tl{i}").wait()
+        ctx.stop_timeline()
+        import json
+        text = open(path).read().rstrip().rstrip(",")
+        events = json.loads(text + "]") if not text.endswith("]") else \
+            json.loads(text)
+        names = {e["name"] for e in events}
+        assert any(n.startswith("NEGOTIATE_") for n in names)
+        assert "ALLREDUCE" in names or "TCP_ALLREDUCE" in names
+
+
+def _run_world(n, extra_env=None, timeout=120):
+    port = _free_port()
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # workers don't need jax
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+        })
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    ok = True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        ok = ok and p.returncode == 0
+    assert ok, "worker failures:\n" + "\n----\n".join(outs)
+
+
+class TestMultiProcess:
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_world(self, n):
+        _run_world(n)
+
+    def test_world_3_small_fusion(self):
+        # Odd world + tiny fusion threshold forces multi-buffer fusion
+        # rounds and non-divisible ring chunks.
+        _run_world(3, {"HOROVOD_FUSION_THRESHOLD": str(256)})
+
+    def test_autotune_smoke(self):
+        _run_world(2, {
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+        })
