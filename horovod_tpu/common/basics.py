@@ -144,6 +144,24 @@ def init(
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
         _state.local_device_count = int(_state.mesh.devices.shape[1])
+        # Launcher-injected env contract (HOROVOD_RANK/SIZE +
+        # HOROVOD_CONTROLLER_ADDR, gloo_run.py:65-76): start the native
+        # control-plane core. It owns the rank-0 coordinator loop and the
+        # TCP data plane for eager (host) collectives between worker
+        # processes — the role MPI/Gloo play in the reference.
+        cfg = _state.config
+        if (cfg.size is not None and cfg.size > 1
+                and cfg.controller != "none"):
+            from .. import cc
+
+            _state.controller = cc.CoreContext()
+            if _state.process_count == 1:
+                # Process-world mode (no jax.distributed): each worker
+                # process is one Horovod rank, exactly the reference's
+                # process model. The local mesh serves in-process
+                # compiled collectives only.
+                _state.process_index = _state.controller.rank()
+                _state.process_count = _state.controller.size()
         if _state.config.timeline:
             from ..utils.timeline import Timeline
 
@@ -220,38 +238,59 @@ def in_hvd_context() -> bool:
     return CROSS_AXIS in bound or LOCAL_AXIS in bound
 
 
+def _process_world() -> bool:
+    """True in process-world mode: the native controller defines the world
+    (one rank per worker process, the reference's process model) because
+    jax.distributed is not gluing the devices into one global mesh."""
+    s = _state
+    return s.controller is not None and jax.process_count() == 1
+
+
 def size() -> int:
-    """Total number of ranks (= chips). Reference: horovod_size
+    """Total number of ranks. Mesh chips under single-controller SPMD;
+    worker processes in process-world mode. Reference: horovod_size
     (operations.cc:795)."""
     s = _require_init()
+    if _process_world():
+        return s.controller.size()
     return int(s.mesh.devices.size)
 
 
 def local_size() -> int:
-    """Chips on this host. Reference: horovod_local_size (operations.cc:787)."""
-    return _require_init().local_device_count
+    """Ranks on this host. Reference: horovod_local_size (operations.cc:787)."""
+    s = _require_init()
+    if _process_world():
+        return s.controller.local_size()
+    return s.local_device_count
 
 
 def cross_size() -> int:
     """Number of hosts. Reference: horovod_cross_size (operations.cc:817)."""
-    return int(_require_init().mesh.devices.shape[0])
+    s = _require_init()
+    if _process_world():
+        return s.controller.cross_size()
+    return int(s.mesh.devices.shape[0])
 
 
 def rank():
-    """Global rank. Traced per-chip inside shard_map; leader-chip rank in
-    eager code. Reference: horovod_rank (operations.cc:771)."""
+    """Global rank. Traced per-chip inside shard_map; process rank in eager
+    code. Reference: horovod_rank (operations.cc:771)."""
     s = _require_init()
     if in_hvd_context():
         return jax.lax.axis_index(HVD_AXES)
+    if _process_world():
+        return s.controller.rank()
     return s.process_index * s.local_device_count
 
 
 def local_rank():
     """Rank within the host. Reference: horovod_local_rank
     (operations.cc:779)."""
-    _require_init()
+    s = _require_init()
     if in_hvd_context():
         return jax.lax.axis_index(LOCAL_AXIS)
+    if _process_world():
+        return s.controller.local_rank()
     return 0
 
 
@@ -260,6 +299,8 @@ def cross_rank():
     s = _require_init()
     if in_hvd_context():
         return jax.lax.axis_index(CROSS_AXIS)
+    if _process_world():
+        return s.controller.cross_rank()
     return s.process_index
 
 

@@ -96,10 +96,10 @@ def adasum_allreduce(
     axes_t = C._resolve_axes(axes)
     tensor = C._scale(tensor, prescale_factor)
     if not axes_t:
-        if C._eager_world() == 1:
-            return C._scale(tensor, postscale_factor)
-        raise NotImplementedError(
-            "multi-host eager Adasum lands with the controller transport")
+        # Eager path: the native core runs recursive-doubling Adasum over
+        # the process world (cc/src/adasum.cc).
+        out = C._eager_allreduce(tensor, C.ReduceOp.ADASUM)
+        return C._scale(out, postscale_factor)
     ctx = None
     if compression is not None:
         tensor, ctx = compression.compress(tensor)

@@ -253,7 +253,7 @@ def allreduce(
                 )
             red = _reduce_in_jit(compressed, op, axes_t, bool(hierarchical))
     else:
-        red = _eager_allreduce(compressed, op)
+        red = _eager_allreduce(compressed, op, name)
     red = compression.decompress(red, ctx)
     return _scale(red, postscale_factor)
 
@@ -283,7 +283,7 @@ def allgather(tensor, *, name: Optional[str] = None, axes=None):
             reps = (_world_size(axes_t),) + (1,) * (tensor.ndim - 1)
             return jnp.tile(tensor, reps)
         return lax.all_gather(tensor, axes_t, axis=0, tiled=True)
-    return _eager_allgather(tensor)
+    return _eager_allgather(tensor, name)
 
 
 def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
@@ -299,7 +299,7 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
     if not axes_t:
-        return _eager_broadcast(tensor, root_rank)
+        return _eager_broadcast(tensor, root_rank, name)
     if _is_replicated(tensor, axes_t):
         return tensor  # already equal everywhere: nothing to move
     wire = tensor
@@ -334,9 +334,11 @@ def alltoall(tensor, splits=None, *, name: Optional[str] = None, axes=None):
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
     if not axes_t:
-        out = _eager_alltoall(tensor, splits)
-        n = tensor.shape[0] if tensor.ndim else 0
-        return out, jnp.asarray([n], dtype=jnp.int32)
+        out, recv = _eager_alltoall(tensor, splits, name)
+        if recv is None:  # world of one
+            n = tensor.shape[0] if tensor.ndim else 0
+            recv = jnp.asarray([n], dtype=jnp.int32)
+        return out, recv
     n = _world_size(axes_t)
     if splits is not None:
         s = np.asarray(splits)
@@ -371,69 +373,114 @@ def join() -> int:
     until all ranks join; the call returns the rank of the last rank to join.
     Single-controller SPMD has no per-rank data exhaustion inside the
     compiled step — handle ragged data by padding/masking the global batch.
-    Eagerly, this is a process-world barrier; with one process it returns
-    this process's rank immediately.
+    Eagerly the native core implements the full joined-rank protocol
+    (identity contributions until all ranks join).
     """
     s = basics._require_init()
     s.joined = True
-    if s.process_count == 1:
+    ctrl = s.controller
+    if ctrl is None or _eager_world() == 1:
         return basics.rank()
-    raise NotImplementedError(
-        "multi-process eager join lands with the controller transport")
+    h = ctrl.join_async()
+    h.wait()
+    return h.join_result()
 
 
 def barrier() -> None:
-    """Host-side barrier over processes (the reference uses controller
-    Barrier, controller.h:145)."""
+    """Host-side barrier over processes (reference: controller Barrier,
+    controller.h:145)."""
     s = basics._require_init()
-    if s.process_count == 1:
-        return
-    raise NotImplementedError(
-        "multi-process barrier lands with the controller transport")
+    if s.controller is not None and _eager_world() > 1:
+        s.controller.barrier()
 
 
 # ---------------------------------------------------------------------------
-# Eager (host) path — process-world collectives.
+# Eager (host) path — process-world collectives through the native core.
 #
-# With one process per host and a single controller, eager collectives have
-# one participant per process. Under a single process they reduce over a
-# world of one, which must still apply op semantics exactly (average of one
-# tensor is the tensor). Multi-host eager data rides the controller + fused
-# jit programs (runner/ + cc/); until that transport is attached, multi-host
-# eager collectives raise.
+# One participant per worker process (the reference's process model). Data
+# crosses process boundaries through the C++ controller + TCP data plane
+# (cc/): enqueue → rank-0 negotiation → fused ring collective → in-place
+# result. Under a single process they reduce over a world of one, which
+# still applies op semantics exactly (average of one tensor is the tensor).
 # ---------------------------------------------------------------------------
+
+_eager_name_lock = threading.Lock()
+_eager_name_counter = [0]
+
+
+def _eager_name(name: Optional[str], kind: str) -> str:
+    """Stable auto-name: processes stay aligned because collectives are
+    issued in identical program order on every rank (the same contract the
+    reference's auto-generated op names rely on)."""
+    if name is not None:
+        return name
+    with _eager_name_lock:
+        n = _eager_name_counter[0]
+        _eager_name_counter[0] += 1
+    return f"eager.{kind}.{n}"
 
 
 def _eager_world() -> int:
-    return basics._require_init().process_count
+    s = basics._require_init()
+    return s.controller.size() if s.controller is not None else s.process_count
 
 
-def _eager_allreduce(tensor, op: ReduceOp):
-    if _eager_world() == 1:
+def _controller():
+    return basics._require_init().controller
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(tensor))
+
+
+def _eager_allreduce(tensor, op: ReduceOp, name: Optional[str] = None):
+    ctrl = _controller()
+    world = _eager_world()
+    if ctrl is None or world == 1:
         return tensor  # sum/avg/min/max/product over a world of one
-    raise NotImplementedError(
-        "multi-host eager allreduce lands with the controller transport")
+    arr = _to_numpy(tensor)
+    opmap = {
+        ReduceOp.SUM: ctrl.SUM,
+        ReduceOp.AVERAGE: ctrl.SUM,
+        ReduceOp.MIN: ctrl.MIN,
+        ReduceOp.MAX: ctrl.MAX,
+        ReduceOp.PRODUCT: ctrl.PRODUCT,
+        ReduceOp.ADASUM: ctrl.ADASUM,
+    }
+    postscale = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
+    out = ctrl.allreduce_async(arr, _eager_name(name, "allreduce"),
+                               op=opmap[op], postscale=postscale).wait()
+    return jnp.asarray(out)
 
 
-def _eager_allgather(tensor):
-    if _eager_world() == 1:
+def _eager_allgather(tensor, name: Optional[str] = None):
+    ctrl = _controller()
+    if ctrl is None or _eager_world() == 1:
         return tensor
-    raise NotImplementedError(
-        "multi-host eager allgather lands with the controller transport")
+    out = ctrl.allgather_async(_to_numpy(tensor),
+                               _eager_name(name, "allgather")).wait()
+    return jnp.asarray(out)
 
 
-def _eager_broadcast(tensor, root_rank: int):
-    if _eager_world() == 1:
+def _eager_broadcast(tensor, root_rank: int, name: Optional[str] = None):
+    ctrl = _controller()
+    if ctrl is None or _eager_world() == 1:
         return tensor
-    raise NotImplementedError(
-        "multi-host eager broadcast lands with the controller transport")
+    out = ctrl.broadcast_async(_to_numpy(tensor),
+                               _eager_name(name, "broadcast"),
+                               root=root_rank).wait()
+    return jnp.asarray(out)
 
 
-def _eager_alltoall(tensor, splits):
-    if _eager_world() == 1:
-        return tensor
-    raise NotImplementedError(
-        "multi-host eager alltoall lands with the controller transport")
+def _eager_alltoall(tensor, splits, name: Optional[str] = None):
+    ctrl = _controller()
+    if ctrl is None or _eager_world() == 1:
+        return tensor, None
+    sp = None if splits is None else [int(x) for x in np.asarray(splits)]
+    h = ctrl.alltoall_async(_to_numpy(tensor),
+                            _eager_name(name, "alltoall"), splits=sp)
+    out = h.wait()
+    return jnp.asarray(out), jnp.asarray(h.recv_splits(), dtype=jnp.int32)
 
 
 # ---------------------------------------------------------------------------

@@ -76,23 +76,41 @@ def _is_array(x) -> bool:
 def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
     """Broadcast an arbitrary picklable object from ``root_rank``
     (reference: tensorflow/functions.py:59-134 — pickle → uint8 tensor →
-    bcast size → bcast payload → unpickle). Eager/process-world only."""
+    bcast size → bcast payload → unpickle). Eager/process-world."""
     basics._require_init()
-    if basics._state.process_count == 1:
+    if C._eager_world() == 1:
         return obj
     buf = io.BytesIO()
     pickle.dump(obj, buf)
-    payload = jnp.frombuffer(buf.getvalue(), dtype=jnp.uint8)
-    size = C._eager_broadcast(jnp.asarray([payload.size]), root_rank)
-    data = C._eager_broadcast(payload, root_rank)
-    return pickle.loads(np.asarray(data[: int(size[0])]).tobytes())
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    # Two rounds, as in the reference: sizes first (payloads differ per
+    # rank), then the root's payload at the agreed size.
+    size = C._eager_broadcast(np.asarray([payload.size], np.int64),
+                              root_rank, name and name + ".size")
+    if basics.rank() == root_rank:
+        wire = payload.copy()
+    else:
+        wire = np.zeros(int(np.asarray(size)[0]), np.uint8)
+    data = C._eager_broadcast(wire, root_rank, name)
+    return pickle.loads(np.asarray(data).tobytes())
 
 
 def allgather_object(obj: Any, name: str = None) -> List[Any]:
     """Gather a picklable object from every process into a list
-    (reference: tensorflow/functions.py:136-177)."""
+    (reference: tensorflow/functions.py:136-177 — ragged uint8 payloads
+    ride the allgatherv size exchange)."""
     basics._require_init()
-    if basics._state.process_count == 1:
+    if C._eager_world() == 1:
         return [obj]
-    raise NotImplementedError(
-        "multi-host allgather_object lands with the controller transport")
+    buf = io.BytesIO()
+    pickle.dump(obj, buf)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    lengths = np.asarray(
+        C._eager_allgather(np.asarray([payload.size], np.int64),
+                           name and name + ".size"))
+    data = np.asarray(C._eager_allgather(payload, name))
+    out, off = [], 0
+    for n in lengths.ravel():
+        out.append(pickle.loads(data[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out

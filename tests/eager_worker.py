@@ -1,0 +1,80 @@
+"""Worker for multi-process eager-API tests: the full horovod_tpu Python
+surface over the native core (reference analogue: running a user script
+under the launcher with `mpirun -np N`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank, (hvd.rank(), rank)
+    assert hvd.size() == size
+    assert hvd.is_initialized()
+
+    # eager allreduce: Average (reference default op)
+    out = hvd.allreduce(jnp.full((3,), float(rank)))
+    assert np.allclose(out, sum(range(size)) / size), out
+    # Sum with pre/postscale
+    out = hvd.allreduce(jnp.ones(4), op=hvd.Sum, prescale_factor=2.0,
+                        postscale_factor=0.5)
+    assert np.allclose(out, size), out
+    # Min / Max
+    assert float(hvd.allreduce(jnp.asarray(float(rank)).reshape(1),
+                               op=hvd.Min)[0]) == 0.0
+    assert float(hvd.allreduce(jnp.asarray(float(rank)).reshape(1),
+                               op=hvd.Max)[0]) == size - 1
+    # Adasum eager (power-of-2 worlds)
+    if size & (size - 1) == 0:
+        out = hvd.allreduce(jnp.ones(4), op=hvd.Adasum)
+        assert np.allclose(out, 1.0, atol=1e-5), out
+
+    # eager allgather (ragged)
+    g = hvd.allgather(jnp.full((rank + 1, 2), rank))
+    assert g.shape[0] == sum(r + 1 for r in range(size))
+
+    # eager broadcast
+    out = hvd.broadcast(jnp.full((4,), float(rank)), root_rank=0)
+    assert np.allclose(out, 0.0), out
+
+    # eager alltoall
+    out, recv = hvd.alltoall(jnp.arange(size * 2, dtype=jnp.float32))
+    assert out.shape[0] == size * 2
+    assert list(np.asarray(recv)) == [2] * size
+
+    # async handle API
+    h = hvd.allreduce_async(jnp.ones(8), name=f"async_t")
+    assert hvd.synchronize(h) is not None
+    assert hvd.poll(h)
+
+    # object broadcast / gather (the checkpoint/elastic state path)
+    obj = {"epoch": 3, "blob": b"x" * (100 + rank)} if rank == 0 else None
+    got = hvd.broadcast_object(obj, root_rank=0)
+    assert got["epoch"] == 3 and len(got["blob"]) == 100
+
+    objs = hvd.allgather_object({"rank": rank, "pad": "y" * rank})
+    assert [o["rank"] for o in objs] == list(range(size))
+
+    hvd.barrier()
+    last = hvd.join()
+    assert 0 <= last < size
+
+    hvd.shutdown()
+    print(f"rank {rank}: eager API OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

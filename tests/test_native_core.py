@@ -17,6 +17,7 @@ from horovod_tpu import cc
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "native_worker.py")
+EAGER_WORKER = os.path.join(REPO, "tests", "eager_worker.py")
 
 
 def _free_port():
@@ -113,12 +114,12 @@ class TestSingleProcess:
         assert "ALLREDUCE" in names or "TCP_ALLREDUCE" in names
 
 
-def _run_world(n, extra_env=None, timeout=120):
+def _run_world(n, extra_env=None, timeout=120, worker=WORKER):
     port = _free_port()
     procs = []
     for r in range(n):
         env = dict(os.environ)
-        env.pop("XLA_FLAGS", None)  # workers don't need jax
+        env.pop("XLA_FLAGS", None)  # workers don't need the 8-device mesh
         env.update({
             "PYTHONPATH": REPO,
             "HOROVOD_RANK": str(r),
@@ -128,7 +129,7 @@ def _run_world(n, extra_env=None, timeout=120):
         })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER], env=env,
+            [sys.executable, worker], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = []
     ok = True
@@ -160,3 +161,12 @@ class TestMultiProcess:
             "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
         })
+
+
+class TestEagerPythonAPI:
+    """The full hvd.* Python surface across worker processes — the
+    reference's `mpirun -np N pytest test_tensorflow.py` tier."""
+
+    @pytest.mark.parametrize("n", [2, 4])
+    def test_world(self, n):
+        _run_world(n, timeout=240, worker=EAGER_WORKER)
