@@ -186,6 +186,12 @@ def shutdown() -> None:
         _state.mesh = None
         _state.config = None
         _state.joined = False
+        # Re-align auto-generated collective names for the elastic
+        # shutdown→init cycle (survivors and respawned workers must both
+        # count from 0).
+        from ..ops import collective_ops
+
+        collective_ops._reset_eager_state()
 
 
 atexit.register(shutdown)

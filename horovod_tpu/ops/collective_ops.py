@@ -378,8 +378,8 @@ def join() -> int:
     """
     s = basics._require_init()
     s.joined = True
-    ctrl = s.controller
-    if ctrl is None or _eager_world() == 1:
+    ctrl, world = _eager_ctx()
+    if world == 1:
         return basics.rank()
     h = ctrl.join_async()
     h.wait()
@@ -389,9 +389,9 @@ def join() -> int:
 def barrier() -> None:
     """Host-side barrier over processes (reference: controller Barrier,
     controller.h:145)."""
-    s = basics._require_init()
-    if s.controller is not None and _eager_world() > 1:
-        s.controller.barrier()
+    ctrl, world = _eager_ctx()
+    if ctrl is not None and world > 1:
+        ctrl.barrier()
 
 
 # ---------------------------------------------------------------------------
@@ -429,14 +429,40 @@ def _controller():
     return basics._require_init().controller
 
 
+def _eager_ctx():
+    """(controller, world) for an eager collective. A multi-process job
+    whose controller is missing (HOROVOD_CONTROLLER=none, or HOROVOD_SIZE
+    unset under jax.distributed) must fail loudly: silently skipping the
+    collective would let ranks diverge unreduced."""
+    s = basics._require_init()
+    ctrl = s.controller
+    world = ctrl.size() if ctrl is not None else s.process_count
+    if ctrl is None and world > 1:
+        raise RuntimeError(
+            "eager collective in a multi-process job but the native "
+            "controller is disabled (HOROVOD_CONTROLLER=none or launcher "
+            "env contract missing) — cannot communicate between processes")
+    return ctrl, world
+
+
+def _reset_eager_state() -> None:
+    """Called by basics.shutdown(): auto-generated collective names restart
+    from 0 so ranks stay aligned across an elastic shutdown/init cycle."""
+    with _eager_name_lock:
+        _eager_name_counter[0] = 0
+    with _handles._lock:
+        _handles._results.clear()
+        _handles._names.clear()
+        _handles._next = 0
+
+
 def _to_numpy(tensor) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(tensor))
 
 
 def _eager_allreduce(tensor, op: ReduceOp, name: Optional[str] = None):
-    ctrl = _controller()
-    world = _eager_world()
-    if ctrl is None or world == 1:
+    ctrl, world = _eager_ctx()
+    if world == 1:
         return tensor  # sum/avg/min/max/product over a world of one
     arr = _to_numpy(tensor)
     opmap = {
@@ -454,8 +480,8 @@ def _eager_allreduce(tensor, op: ReduceOp, name: Optional[str] = None):
 
 
 def _eager_allgather(tensor, name: Optional[str] = None):
-    ctrl = _controller()
-    if ctrl is None or _eager_world() == 1:
+    ctrl, world = _eager_ctx()
+    if world == 1:
         return tensor
     out = ctrl.allgather_async(_to_numpy(tensor),
                                _eager_name(name, "allgather")).wait()
@@ -463,8 +489,8 @@ def _eager_allgather(tensor, name: Optional[str] = None):
 
 
 def _eager_broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    ctrl = _controller()
-    if ctrl is None or _eager_world() == 1:
+    ctrl, world = _eager_ctx()
+    if world == 1:
         return tensor
     out = ctrl.broadcast_async(_to_numpy(tensor),
                                _eager_name(name, "broadcast"),
@@ -473,8 +499,8 @@ def _eager_broadcast(tensor, root_rank: int, name: Optional[str] = None):
 
 
 def _eager_alltoall(tensor, splits, name: Optional[str] = None):
-    ctrl = _controller()
-    if ctrl is None or _eager_world() == 1:
+    ctrl, world = _eager_ctx()
+    if world == 1:
         return tensor, None
     sp = None if splits is None else [int(x) for x in np.asarray(splits)]
     h = ctrl.alltoall_async(_to_numpy(tensor),

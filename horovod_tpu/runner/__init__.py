@@ -1,0 +1,101 @@
+"""Launcher package: ``hvdrun`` CLI + programmatic ``run()`` API.
+
+Reference surface: ``horovod/runner/__init__.py`` (205 LoC) — the
+``horovod.run(func, np=..., hosts=...)`` API that executes a pickled
+function across the job and returns the per-rank results (launch.py:549-568:
+func shipped via KV store, executed by run_task.py).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import sys
+from typing import Any, Callable, List, Optional
+
+from .hosts import get_host_assignments, parse_host_files, parse_hosts
+from .http_server import KVStoreServer
+from .launch import run_commandline  # noqa: F401
+from .static_run import launch_static
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _dumps_call(func, args: tuple, kwargs: dict) -> bytes:
+    """Ship (func, args, kwargs) as data — cloudpickle when available (any
+    closure), stdlib pickle otherwise (top-level functions only)."""
+    payload = (func, args, kwargs)
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(payload)
+    except ImportError:
+        import pickle
+
+        return pickle.dumps(payload)
+
+
+def run(func: Callable[..., Any],
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        np: int = 1,
+        hosts: Optional[str] = None,
+        hostfile: Optional[str] = None,
+        env: Optional[dict] = None,
+        verbose: int = 0) -> List[Any]:
+    """Run ``func(*args, **kwargs)`` on ``np`` horovod_tpu processes and
+    return a list of the ``np`` return values ordered by rank (reference:
+    horovod.run, runner/__init__.py:90).
+
+    The function (with its closure) is cloudpickled into an in-process KV
+    store; workers fetch and execute it under the full launcher env
+    contract, so ``hvd.init()`` inside ``func`` joins the job world.
+    """
+    kwargs = kwargs or {}
+    if hostfile:
+        host_infos = parse_host_files(hostfile)
+    elif hosts:
+        host_infos = parse_hosts(hosts)
+    else:
+        host_infos = parse_hosts(f"localhost:{np}")
+    slots = get_host_assignments(host_infos, np)
+
+    kv = KVStoreServer()
+    kv_port = kv.start_server()
+    kv.store.put("runfunc", "func", _dumps_call(func, args, kwargs))
+
+    # The KV store lives in THIS (driver) process — workers must dial back
+    # here, not the first worker host.
+    from .static_run import is_local_host
+
+    if all(is_local_host(s.hostname) for s in slots):
+        addr = "127.0.0.1"
+    else:
+        addr = socket.getfqdn()
+    command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
+               addr, str(kv_port)]
+    base_env = dict(env if env is not None else os.environ)
+    base_env.setdefault("PYTHONPATH", os.pathsep.join(p for p in sys.path if p))
+
+    try:
+        launch_static(command, slots, controller_port=_free_port(),
+                      rendezvous_port=kv_port, env=base_env, verbose=verbose)
+        results: List[Any] = []
+        import pickle
+
+        for rank in range(np):
+            raw = kv.store.wait_for("runfunc_result", str(rank), timeout=5.0)
+            if raw is None:
+                raise RuntimeError(f"rank {rank} produced no result")
+            payload = pickle.loads(raw)
+            if payload["status"] != "ok":
+                raise RuntimeError(
+                    f"rank {rank} failed:\n{payload['error']}")
+            results.append(payload["value"])
+        return results
+    finally:
+        kv.shutdown_server()

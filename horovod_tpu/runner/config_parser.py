@@ -1,0 +1,109 @@
+"""Translate launcher args / YAML config into HOROVOD_* env vars.
+
+Reference surface: ``horovod/runner/common/util/config_parser.py`` (199 LoC)
+— the three equivalent config layers (env vars, CLI flags, YAML file) all
+converge on the env the core reads at init (SURVEY §5.6;
+operations.cc:416-518).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# arg attribute → env var. Same knob names as the reference so users can
+# carry settings over unchanged (common.h:64-90).
+_ARG_ENV = {
+    "fusion_threshold_mb": "HOROVOD_FUSION_THRESHOLD",  # MB → bytes below
+    "cycle_time_ms": "HOROVOD_CYCLE_TIME",
+    "cache_capacity": "HOROVOD_CACHE_CAPACITY",
+    "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
+    "hierarchical_allgather": "HOROVOD_HIERARCHICAL_ALLGATHER",
+    "autotune": "HOROVOD_AUTOTUNE",
+    "autotune_log_file": "HOROVOD_AUTOTUNE_LOG",
+    "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+    "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+    "autotune_bayes_opt_max_samples": "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
+    "autotune_gaussian_process_noise": "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+    "timeline_filename": "HOROVOD_TIMELINE",
+    "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
+    "no_stall_check": "HOROVOD_STALL_CHECK_DISABLE",
+    "stall_check_warning_time_seconds": "HOROVOD_STALL_CHECK_TIME_SECONDS",
+    "stall_check_shutdown_time_seconds": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+    "log_level": "HOROVOD_LOG_LEVEL",
+    "log_hide_timestamp": "HOROVOD_LOG_HIDE_TIME",
+}
+
+
+def _set(env: Dict[str, str], key: str, value: Any) -> None:
+    if value is None:
+        return
+    if isinstance(value, bool):
+        if value:
+            env[key] = "1"
+        return
+    env[key] = str(value)
+
+
+def set_env_from_args(env: Dict[str, str], args) -> Dict[str, str]:
+    """Apply parsed CLI args onto ``env`` (reference
+    config_parser.set_env_from_args)."""
+    for attr, key in _ARG_ENV.items():
+        value = getattr(args, attr, None)
+        if attr == "fusion_threshold_mb" and value is not None:
+            value = int(value * 1024 * 1024)
+        _set(env, key, value)
+    if getattr(args, "elastic", False):
+        env["HOROVOD_ELASTIC"] = "1"
+    return env
+
+
+def parse_config_file(path: str, args) -> None:
+    """Overlay a YAML config file onto an argparse namespace for every value
+    the user did not set on the command line (reference
+    launch.py:470-474 + config_parser.py). Nested sections mirror the
+    reference schema (fusion/timeline/autotune/stall_check/logging)."""
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f) or {}
+
+    def _maybe(attr: str, value: Any) -> None:
+        if value is not None and getattr(args, attr, None) in (None, False):
+            setattr(args, attr, value)
+
+    _maybe("fusion_threshold_mb", config.get("fusion", {}).get("threshold-mb"))
+    _maybe("cycle_time_ms", config.get("fusion", {}).get("cycle-time-ms"))
+    _maybe("cache_capacity", config.get("cache", {}).get("capacity"))
+    timeline = config.get("timeline", {})
+    _maybe("timeline_filename", timeline.get("filename"))
+    _maybe("timeline_mark_cycles", timeline.get("mark-cycles"))
+    autotune = config.get("autotune", {})
+    _maybe("autotune", autotune.get("enabled"))
+    _maybe("autotune_log_file", autotune.get("log-file"))
+    _maybe("autotune_warmup_samples", autotune.get("warmup-samples"))
+    _maybe("autotune_steps_per_sample", autotune.get("steps-per-sample"))
+    _maybe("autotune_bayes_opt_max_samples",
+           autotune.get("bayes-opt-max-samples"))
+    _maybe("autotune_gaussian_process_noise",
+           autotune.get("gaussian-process-noise"))
+    stall = config.get("stall-check", {})
+    if stall.get("enabled") is False:
+        args.no_stall_check = True
+    _maybe("stall_check_warning_time_seconds", stall.get("warning-time-seconds"))
+    _maybe("stall_check_shutdown_time_seconds",
+           stall.get("shutdown-time-seconds"))
+    library = config.get("library", {})
+    _maybe("mpi_threads_disable", library.get("mpi-threads-disable"))
+    logging_cfg = config.get("logging", {})
+    _maybe("log_level", logging_cfg.get("level"))
+    _maybe("log_hide_timestamp", logging_cfg.get("hide-timestamp"))
+
+
+def validate_config_args(args) -> None:
+    """Sanity checks mirroring config_parser.validate_config_args."""
+    if getattr(args, "fusion_threshold_mb", None) is not None \
+            and args.fusion_threshold_mb < 0:
+        raise ValueError("--fusion-threshold-mb must be >= 0")
+    if getattr(args, "cycle_time_ms", None) is not None \
+            and args.cycle_time_ms < 0:
+        raise ValueError("--cycle-time-ms must be >= 0")

@@ -1,0 +1,133 @@
+"""Host parsing and slot/rank assignment for the launcher.
+
+Reference surface: ``horovod/runner/common/util/hosts.py`` —
+``parse_hosts`` (host:slots strings), ``parse_host_files`` (mpirun-style
+hostfiles) and ``get_host_assignments`` (hosts.py:100-150), which packs
+ranks host-by-host and derives the three-level rank vocabulary
+(rank / local_rank / cross_rank) that the launcher injects as the
+``HOROVOD_*`` env contract (gloo_run.py:65-76).
+
+TPU note: one slot = one worker process. On a TPU pod the natural choice is
+one slot per host (each process drives all local chips through the mesh),
+but the assignment math is slot-count agnostic, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class HostInfo:
+    """A host and its slot count (reference: hosts.py HostInfo)."""
+
+    hostname: str
+    slots: int
+
+    @staticmethod
+    def from_string(host_string: str) -> "HostInfo":
+        parts = host_string.strip().split(":")
+        if len(parts) == 1 or parts[1] == "":
+            return HostInfo(parts[0], 1)
+        return HostInfo(parts[0], int(parts[1]))
+
+
+@dataclass
+class SlotInfo:
+    """Placement of one rank (reference: hosts.py SlotInfo)."""
+
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+    def to_response_string(self) -> str:
+        return ":".join(
+            str(v) for v in (self.rank, self.size, self.local_rank,
+                             self.local_size, self.cross_rank,
+                             self.cross_size))
+
+
+INVALID_HOST_CHARS = re.compile(r"[^a-zA-Z0-9.\-_]")
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse ``"h1:2,h2:4"`` into HostInfo list (reference hosts.py:69-80)."""
+    infos = []
+    for part in hosts_string.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        infos.append(HostInfo.from_string(part))
+    if not infos:
+        raise ValueError(f"no hosts found in {hosts_string!r}")
+    return infos
+
+
+def parse_host_files(filename: str) -> List[HostInfo]:
+    """Parse an mpirun-style hostfile: lines of ``host slots=N`` or
+    ``host:N`` or bare ``host`` (reference hosts.py:83-97)."""
+    infos = []
+    with open(filename) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)\s+slots\s*=\s*(\d+)", line)
+            if m:
+                infos.append(HostInfo(m.group(1), int(m.group(2))))
+            else:
+                infos.append(HostInfo.from_string(line))
+    if not infos:
+        raise ValueError(f"no hosts found in hostfile {filename}")
+    return infos
+
+
+def get_host_assignments(hosts: List[HostInfo], min_np: int,
+                         max_np: Optional[int] = None) -> List[SlotInfo]:
+    """Pack ranks host-by-host and compute local/cross ranks
+    (reference hosts.py:100-150).
+
+    ``cross_rank`` of a slot = index of its host among hosts that have a
+    slot at the same ``local_rank``; ``cross_size`` = number of such hosts.
+    Raises if total slots < min_np; assigns at most ``max_np or min_np``.
+    """
+    total_slots = sum(h.slots for h in hosts)
+    if total_slots < min_np:
+        raise ValueError(
+            f"requested {min_np} processes but hosts "
+            f"{[f'{h.hostname}:{h.slots}' for h in hosts]} only provide "
+            f"{total_slots} slots")
+    np_ = min(total_slots, max_np or min_np)
+
+    # Pack: rank i goes to the first host with a free slot.
+    per_host: List[int] = []  # ranks actually placed on each host
+    remaining = np_
+    for h in hosts:
+        take = min(h.slots, remaining)
+        per_host.append(take)
+        remaining -= take
+    assert remaining == 0
+
+    slots: List[SlotInfo] = []
+    rank = 0
+    for hi, h in enumerate(hosts):
+        for local_rank in range(per_host[hi]):
+            cross_rank = sum(1 for j in range(hi) if per_host[j] > local_rank)
+            cross_size = sum(1 for n in per_host if n > local_rank)
+            slots.append(SlotInfo(
+                hostname=h.hostname,
+                rank=rank,
+                local_rank=local_rank,
+                cross_rank=cross_rank,
+                size=np_,
+                local_size=per_host[hi],
+                cross_size=cross_size,
+            ))
+            rank += 1
+    return slots

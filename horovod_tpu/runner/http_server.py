@@ -1,0 +1,182 @@
+"""Threaded HTTP key-value store: rendezvous + run-func transport.
+
+Reference surface: ``horovod/runner/http/http_server.py`` (241 LoC) —
+``RendezvousServer`` (a KV store scoped ``global``/``local_<host>``/
+``cross_<local_rank>`` that the C++ Gloo context bootstraps against) and
+``KVStoreServer`` (transport for the pickled function in ``horovod.run``).
+
+TPU redesign: our native core negotiates over HOROVOD_CONTROLLER_ADDR/PORT
+directly (rank-0 coordinator, see cc/src/operations.cc), so rendezvous here
+serves the *launcher-level* jobs the reference also uses it for: publishing
+slot assignments (elastic ``rank_and_size``), shipping pickled functions,
+and collecting results. Same HTTP verb contract: GET/PUT/DELETE
+``/scope/key``; GET on a missing key returns 404 (clients poll).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _split(self) -> Tuple[str, str]:
+        parts = self.path.lstrip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_GET(self):  # noqa: N802
+        scope, key = self._split()
+        value = self.server.store.get(scope, key)  # type: ignore[attr-defined]
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):  # noqa: N802
+        scope, key = self._split()
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        self.server.store.put(scope, key, body)  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):  # noqa: N802
+        scope, key = self._split()
+        self.server.store.delete(scope, key)  # type: ignore[attr-defined]
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def log_message(self, fmt, *args):  # silence per-request logging
+        pass
+
+
+class _Store:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._data: Dict[str, Dict[str, bytes]] = {}
+        self._cv = threading.Condition(self._lock)
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._data.get(scope, {}).get(key)
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        with self._cv:
+            self._data.setdefault(scope, {})[key] = value
+            self._cv.notify_all()
+
+    def delete(self, scope: str, key: str) -> None:
+        with self._cv:
+            self._data.get(scope, {}).pop(key, None)
+            self._cv.notify_all()
+
+    def delete_scope(self, scope: str) -> None:
+        with self._cv:
+            self._data.pop(scope, None)
+            self._cv.notify_all()
+
+    def wait_for(self, scope: str, key: str,
+                 timeout: Optional[float] = None) -> Optional[bytes]:
+        with self._cv:
+            deadline = None
+            if timeout is not None:
+                import time
+
+                deadline = time.monotonic() + timeout
+            while True:
+                value = self._data.get(scope, {}).get(key)
+                if value is not None:
+                    return value
+                remaining = None
+                if deadline is not None:
+                    import time
+
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                self._cv.wait(remaining)
+
+
+class KVStoreServer:
+    """In-process HTTP KV server (reference http_server.py:139-235)."""
+
+    def __init__(self) -> None:
+        self.store = _Store()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start_server(self) -> int:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", 0), _KVHandler)
+        self._httpd.store = self.store  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    @property
+    def port(self) -> int:
+        assert self._httpd is not None
+        return self._httpd.server_address[1]
+
+    def shutdown_server(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+class RendezvousServer(KVStoreServer):
+    """KV store + slot-assignment publication (reference
+    http_server.py:35-137). ``init(host_alloc_plan)`` (re)publishes every
+    slot's rank/size tuple under scope ``rendezvous`` keyed by
+    ``<hostname>:<local_rank>``; elastic workers GET it to learn their new
+    identity after a reset (elastic/rendezvous.py:37-42)."""
+
+    def __init__(self, verbose: int = 0) -> None:
+        super().__init__()
+        self._verbose = verbose
+
+    def init(self, host_alloc_plan) -> None:
+        # Drop the whole previous plan: stale host:local_rank keys from a
+        # larger world must 404, not hand out dead identities.
+        self.store.delete_scope("rendezvous")
+        for slot in host_alloc_plan:
+            key = f"{slot.hostname}:{slot.local_rank}"
+            self.store.put("rendezvous", key,
+                           slot.to_response_string().encode())
+
+    def stop(self) -> None:
+        self.shutdown_server()
+
+
+def read_data_from_kvstore(addr: str, port: int, scope: str, key: str):
+    """Poll-free GET helper (reference runner/util/network.py)."""
+    import pickle
+    import urllib.request
+
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    with urllib.request.urlopen(url) as resp:
+        return pickle.loads(resp.read())
+
+
+def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
+                          value) -> None:
+    import pickle
+    import urllib.request
+
+    url = f"http://{addr}:{port}/{scope}/{key}"
+    req = urllib.request.Request(url, data=pickle.dumps(value), method="PUT")
+    urllib.request.urlopen(req).read()

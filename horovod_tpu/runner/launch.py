@@ -1,0 +1,206 @@
+"""``hvdrun`` — the horovodrun-equivalent CLI.
+
+Reference surface: ``horovod/runner/launch.py`` (727 LoC): argparse over
+-np/-H/--hostfile, tuning flags that become env vars, autotune/timeline/
+stall-check groups, elastic flags (--min-np/--max-np/
+--host-discovery-script), then ``_run`` → static or elastic launch
+(launch.py:212-481, 689-713).
+
+TPU redesign: there is no mpirun/jsrun dispatch — the single controller is
+the native rank-0 coordinator over TCP (``run_controller`` trivially picks
+it, mirroring launch.py:630-662's gloo branch). Everything else keeps the
+reference CLI contract so ``horovodrun -np 4 python train.py`` scripts port
+by renaming the binary.
+
+Usage::
+
+    python -m horovod_tpu.runner -np 4 python train.py
+    python -m horovod_tpu.runner -np 4 -H h1:2,h2:2 python train.py
+    python -m horovod_tpu.runner -np 2 --min-np 2 --max-np 4 \
+        --host-discovery-script ./discover.sh python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+from typing import List, Optional
+
+from . import config_parser
+from .hosts import get_host_assignments, parse_host_files, parse_hosts
+from .http_server import RendezvousServer
+from .static_run import launch_static
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job "
+                    "(horovodrun-compatible CLI)")
+    parser.add_argument("-v", "--version", action="store_true",
+                        help="print version and exit")
+    parser.add_argument("-np", "--num-proc", type=int, dest="np",
+                        help="total number of worker processes")
+    parser.add_argument("-H", "--hosts", dest="hosts",
+                        help="host:slots pairs, comma separated")
+    parser.add_argument("--hostfile", dest="hostfile",
+                        help="mpirun-style hostfile (host slots=N)")
+    parser.add_argument("--verbose", action="count", default=0,
+                        help="-v for launcher logs, -vv for per-slot commands")
+    parser.add_argument("--disable-cache", action="store_true",
+                        dest="disable_cache",
+                        help="disable the response cache "
+                             "(HOROVOD_CACHE_CAPACITY=0)")
+    parser.add_argument("--start-timeout", type=int, default=600,
+                        help="seconds to wait for all processes to start")
+    parser.add_argument("--config-file", dest="config_file",
+                        help="YAML config file (same schema as horovodrun)")
+
+    tune = parser.add_argument_group("tuning")
+    tune.add_argument("--fusion-threshold-mb", type=float,
+                      dest="fusion_threshold_mb")
+    tune.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
+    tune.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    tune.add_argument("--hierarchical-allreduce", action="store_true",
+                      dest="hierarchical_allreduce", default=None)
+    tune.add_argument("--hierarchical-allgather", action="store_true",
+                      dest="hierarchical_allgather", default=None)
+
+    autotune = parser.add_argument_group("autotune")
+    autotune.add_argument("--autotune", action="store_true", default=None)
+    autotune.add_argument("--autotune-log-file", dest="autotune_log_file")
+    autotune.add_argument("--autotune-warmup-samples", type=int,
+                          dest="autotune_warmup_samples")
+    autotune.add_argument("--autotune-steps-per-sample", type=int,
+                          dest="autotune_steps_per_sample")
+    autotune.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                          dest="autotune_bayes_opt_max_samples")
+    autotune.add_argument("--autotune-gaussian-process-noise", type=float,
+                          dest="autotune_gaussian_process_noise")
+
+    timeline = parser.add_argument_group("timeline")
+    timeline.add_argument("--timeline-filename", dest="timeline_filename")
+    timeline.add_argument("--timeline-mark-cycles", action="store_true",
+                          dest="timeline_mark_cycles", default=None)
+
+    stall = parser.add_argument_group("stall check")
+    stall.add_argument("--no-stall-check", action="store_true",
+                       dest="no_stall_check", default=None)
+    stall.add_argument("--stall-check-warning-time-seconds", type=float,
+                       dest="stall_check_warning_time_seconds")
+    stall.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                       dest="stall_check_shutdown_time_seconds")
+
+    logging_grp = parser.add_argument_group("logging")
+    logging_grp.add_argument("--log-level", dest="log_level",
+                             choices=["trace", "debug", "info", "warning",
+                                      "error", "fatal"])
+    logging_grp.add_argument("--log-hide-timestamp", action="store_true",
+                             dest="log_hide_timestamp", default=None)
+
+    elastic = parser.add_argument_group("elastic")
+    elastic.add_argument("--min-np", type=int, dest="min_np")
+    elastic.add_argument("--max-np", type=int, dest="max_np")
+    elastic.add_argument("--host-discovery-script",
+                         dest="host_discovery_script")
+    elastic.add_argument("--slots", type=int, dest="slots",
+                         help="slots per discovered host (elastic)")
+    elastic.add_argument("--reset-limit", type=int, dest="reset_limit")
+
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="the training command to launch")
+    args = parser.parse_args(argv)
+    args.elastic = args.host_discovery_script is not None or \
+        args.min_np is not None or args.max_np is not None
+    return args
+
+
+def _validate(args) -> None:
+    if args.version:
+        return
+    if not args.command:
+        raise ValueError("no command to run — usage: hvdrun -np N <command>")
+    if not args.elastic:
+        if args.np is None:
+            raise ValueError("-np is required for static jobs")
+        if args.hosts and args.hostfile:
+            raise ValueError("specify only one of -H and --hostfile")
+    else:
+        if not args.host_discovery_script and not (args.hosts or args.hostfile):
+            raise ValueError(
+                "elastic jobs need --host-discovery-script (or fixed -H)")
+    config_parser.validate_config_args(args)
+
+
+def _build_env(args) -> dict:
+    env = dict(os.environ)
+    config_parser.set_env_from_args(env, args)
+    if args.disable_cache:
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    return env
+
+
+def _get_hosts(args, np_: int):
+    if args.hostfile:
+        return parse_host_files(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    return parse_hosts(f"localhost:{np_}")
+
+
+def _run_static(args) -> None:
+    hosts = _get_hosts(args, args.np)
+    slots = get_host_assignments(hosts, args.np)
+    env = _build_env(args)
+    rendezvous = RendezvousServer(verbose=args.verbose)
+    rendezvous_port = rendezvous.start_server()
+    rendezvous.init(slots)
+    try:
+        launch_static(args.command, slots,
+                      controller_port=_free_port(),
+                      rendezvous_port=rendezvous_port,
+                      env=env, verbose=args.verbose)
+    finally:
+        rendezvous.stop()
+
+
+def _run_elastic(args) -> None:
+    from ..elastic.launcher import launch_elastic  # lazy: optional subsystem
+
+    launch_elastic(args, env=_build_env(args))
+
+
+def _run(args) -> None:
+    if args.version:
+        from .. import __version__
+
+        print(__version__)
+        return
+    if args.config_file:
+        config_parser.parse_config_file(args.config_file, args)
+    _validate(args)
+    if args.elastic:
+        _run_elastic(args)
+    else:
+        _run_static(args)
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> None:
+    args = parse_args(argv)
+    try:
+        _run(args)
+    except (ValueError, RuntimeError) as e:
+        print(f"hvdrun: {e}", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    run_commandline()

@@ -1,0 +1,145 @@
+"""Pickle-over-TCP RPC with HMAC-signed frames.
+
+Reference surface: ``horovod/runner/common/util/network.py`` (268 LoC) —
+``BasicService`` (multi-threaded socket server dispatching request objects
+to ``_handle``) and ``BasicClient`` (connect, send request, await response),
+with every frame signed by an HMAC of the job's secret key so a stray
+connection can't inject pickles. Used by the driver/task bootstrap services
+and the elastic worker-notification channel (§2.3, §5.3 of the survey).
+
+Wire format per message: ``len(4B big-endian) | hmac(32B) | pickle-bytes``.
+"""
+
+from __future__ import annotations
+
+import hmac
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Optional, Tuple
+
+from .secret import DIGEST_LENGTH_BYTES
+
+_LEN = struct.Struct(">I")
+
+
+class PingRequest:
+    pass
+
+
+class PingResponse:
+    def __init__(self, service_name: str, source_address: str):
+        self.service_name = service_name
+        self.source_address = source_address
+
+
+class AckResponse:
+    """Generic empty OK response."""
+
+
+def _sign(key: bytes, payload: bytes) -> bytes:
+    return hmac.new(key, payload, "sha256").digest()
+
+
+def write_message(sock: socket.socket, obj: Any, key: bytes) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + _sign(key, payload) + payload)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("connection closed mid-message")
+        buf += chunk
+    return buf
+
+
+def read_message(sock: socket.socket, key: bytes) -> Any:
+    (length,) = _LEN.unpack(_read_exact(sock, _LEN.size))
+    digest = _read_exact(sock, DIGEST_LENGTH_BYTES)
+    payload = _read_exact(sock, length)
+    if not hmac.compare_digest(digest, _sign(key, payload)):
+        raise PermissionError("HMAC mismatch on RPC message — wrong secret key")
+    return pickle.loads(payload)
+
+
+class BasicService:
+    """Threaded TCP server dispatching pickled requests to ``_handle``
+    (reference network.py:50-148)."""
+
+    def __init__(self, service_name: str, key: bytes, nics=None):
+        self._service_name = service_name
+        self._key = key
+        service = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    req = read_message(sock, service._key)
+                    resp = service._handle(req, self.client_address)
+                    write_message(sock, resp, service._key)
+                except (ConnectionError, PermissionError, EOFError):
+                    pass
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server(("0.0.0.0", 0), _Handler)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def _handle(self, req: Any, client_address: Tuple[str, int]) -> Any:
+        if isinstance(req, PingRequest):
+            return PingResponse(self._service_name, client_address[0])
+        raise NotImplementedError(
+            f"{self._service_name}: unknown request {type(req).__name__}")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def addresses(self) -> Tuple[str, int]:
+        return (socket.gethostname(), self._port)
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class BasicClient:
+    """Connects to a BasicService and exchanges one request/response per
+    call (reference network.py:150-268)."""
+
+    def __init__(self, service_name: str, addr: str, port: int, key: bytes,
+                 attempts: int = 3, timeout: float = 10.0):
+        self._service_name = service_name
+        self._addr = addr
+        self._port = port
+        self._key = key
+        self._attempts = attempts
+        self._timeout = timeout
+
+    def _send(self, req: Any) -> Any:
+        last_err: Optional[Exception] = None
+        for _ in range(self._attempts):
+            try:
+                with socket.create_connection((self._addr, self._port),
+                                              timeout=self._timeout) as sock:
+                    write_message(sock, req, self._key)
+                    return read_message(sock, self._key)
+            except (OSError, ConnectionError) as e:
+                last_err = e
+        raise ConnectionError(
+            f"{self._service_name} RPC to {self._addr}:{self._port} failed: "
+            f"{last_err}")
+
+    def ping(self) -> PingResponse:
+        return self._send(PingRequest())

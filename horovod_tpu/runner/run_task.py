@@ -1,0 +1,32 @@
+"""Worker entry for the programmatic ``run()`` API.
+
+Reference: ``horovod/runner/run_task.py`` + launch.py:549-568 — the driver
+ships a pickled function through the KV store; each worker fetches it,
+executes, and puts its return value back under its rank.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+from .http_server import put_data_into_kvstore, read_data_from_kvstore
+
+
+def main(addr: str, port: int) -> None:
+    rank = int(os.environ["HOROVOD_RANK"])
+    func, args, kwargs = read_data_from_kvstore(addr, port, "runfunc", "func")
+    try:
+        result = func(*args, **kwargs)
+        put_data_into_kvstore(addr, port, "runfunc_result", str(rank),
+                              {"status": "ok", "value": result})
+    except BaseException:
+        put_data_into_kvstore(addr, port, "runfunc_result", str(rank),
+                              {"status": "error",
+                               "error": traceback.format_exc()})
+        raise
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]))

@@ -1,0 +1,112 @@
+"""Subprocess execution with group kill and event-driven termination.
+
+Reference surface: ``horovod/runner/common/util/safe_shell_exec.py`` (227
+LoC): run a command in its own process group, stream stdout/stderr with an
+optional per-rank prefix, and terminate the whole group when any of the
+supplied ``threading.Event``s fires (the launcher's fail-fast path,
+gloo_run.py:260-266).
+
+Redesign: the reference interposes a fork()ed "middleman" process so the
+group survives launcher death; here a watcher *thread* + ``start_new_session``
+keeps the same kill semantics in-process, which is simpler and sufficient
+because the launcher owns worker lifetime on TPU pods.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, TextIO
+
+GRACEFUL_TERMINATION_TIME_S = 2.0
+
+
+def terminate_process_group(proc: subprocess.Popen,
+                            timeout: float = GRACEFUL_TERMINATION_TIME_S) -> None:
+    """SIGTERM the process group, escalate to SIGKILL after ``timeout``."""
+    if proc.poll() is not None:
+        return
+    try:
+        pgid = os.getpgid(proc.pid)
+    except (ProcessLookupError, PermissionError):
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        return
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return
+        time.sleep(0.05)
+    try:
+        os.killpg(pgid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+
+
+def _forward_stream(stream, sink: TextIO, prefix: Optional[str]) -> None:
+    for line in iter(stream.readline, ""):
+        if prefix is not None:
+            sink.write(f"[{prefix}]{line}")
+        else:
+            sink.write(line)
+        sink.flush()
+    stream.close()
+
+
+def execute(command,
+            env: Optional[Dict[str, str]] = None,
+            stdout: Optional[TextIO] = None,
+            stderr: Optional[TextIO] = None,
+            index: Optional[object] = None,
+            events: Optional[Sequence[threading.Event]] = None,
+            shell: bool = True) -> int:
+    """Run ``command``; return its exit code.
+
+    Mirrors safe_shell_exec.execute: output is line-forwarded (optionally
+    ``[index]``-prefixed); if any event in ``events`` fires the whole process
+    group is terminated and the exit code reflects the signal.
+    """
+    stdout = stdout if stdout is not None else sys.stdout
+    stderr = stderr if stderr is not None else sys.stderr
+    proc = subprocess.Popen(
+        command,
+        shell=shell,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        bufsize=1,
+        start_new_session=True,  # own process group for clean group kill
+    )
+    prefix = str(index) if index is not None else None
+    threads: List[threading.Thread] = []
+    for stream, sink in ((proc.stdout, stdout), (proc.stderr, stderr)):
+        t = threading.Thread(target=_forward_stream, args=(stream, sink, prefix),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    stop_watch = threading.Event()
+    if events:
+        def _watch():
+            while not stop_watch.is_set():
+                for ev in events:
+                    if ev.is_set():
+                        terminate_process_group(proc)
+                        return
+                time.sleep(0.05)
+
+        watcher = threading.Thread(target=_watch, daemon=True)
+        watcher.start()
+
+    proc.wait()
+    stop_watch.set()
+    for t in threads:
+        t.join(timeout=1.0)
+    return proc.returncode

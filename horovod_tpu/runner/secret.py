@@ -1,0 +1,14 @@
+"""Shared-secret generation for RPC message signing.
+
+Reference: ``horovod/runner/common/util/secret.py`` — a random key passed to
+every service/client pair so pickle-over-TCP RPC messages are HMAC-signed
+before being deserialized (network.py:50-148).
+"""
+
+import secrets
+
+DIGEST_LENGTH_BYTES = 32
+
+
+def make_secret_key() -> bytes:
+    return secrets.token_bytes(32)

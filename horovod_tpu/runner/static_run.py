@@ -1,0 +1,131 @@
+"""Static (fixed world) job launch: one worker process per slot.
+
+Reference surface: ``horovod/runner/gloo_run.py`` (331 LoC) — compute slot
+assignments, build per-slot commands (local exec or ssh), inject the
+``HOROVOD_*`` env contract, launch all slots on threads, and fail fast: if
+any worker exits non-zero, terminate the rest (gloo_run.py:221-266).
+
+TPU redesign: instead of a Gloo HTTP rendezvous, workers bootstrap against
+the rank-0 native coordinator at ``HOROVOD_CONTROLLER_ADDR/PORT`` (see
+cc/src/operations.cc) — the launcher picks the port and points every worker
+at the first host. The rendezvous KV server is still started and advertised
+(``HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT``) for launcher-level transport
+(run-func results, elastic identity), mirroring the reference contract.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import socket
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from . import safe_shell_exec
+from .hosts import SlotInfo
+
+# Env vars forwarded from the launcher environment to workers, beyond the
+# explicitly injected contract (reference gloo_run.py:65-101 forwards the
+# whole env; we forward everything except per-slot overrides too).
+_SLOT_ENV = ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
+             "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
+             "HOROVOD_HOSTNAME")
+
+SSH_COMMAND_PREFIX = "ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no"
+
+
+def is_local_host(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname(),
+                        socket.getfqdn())
+
+
+def slot_env(slot: SlotInfo, controller_addr: str, controller_port: int,
+             rendezvous_port: Optional[int] = None,
+             base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """The launcher-injected env contract (reference gloo_run.py:65-76)."""
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update({
+        "HOROVOD_RANK": str(slot.rank),
+        "HOROVOD_SIZE": str(slot.size),
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+        "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+        "HOROVOD_HOSTNAME": slot.hostname,
+        "HOROVOD_CONTROLLER_ADDR": controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(controller_port),
+    })
+    if rendezvous_port is not None:
+        env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = controller_addr
+        env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(rendezvous_port)
+    return env
+
+
+def get_run_command(command: Sequence[str], slot: SlotInfo,
+                    env: Dict[str, str]) -> str:
+    """Build the shell command for one slot; remote slots are wrapped in ssh
+    with the env contract inlined (reference gloo_run.py:133-178)."""
+    cmd = " ".join(shlex.quote(c) for c in command)
+    if is_local_host(slot.hostname):
+        return cmd
+    # ssh: env does not propagate, so inline every HOROVOD_* knob (the
+    # launcher-built tuning env included) plus the interpreter basics —
+    # the reference forwards the whole run env the same way
+    # (gloo_run.py:65-101).
+    keys = sorted(k for k in env
+                  if k.startswith("HOROVOD_") or k in ("PATH", "PYTHONPATH"))
+    exported = " ".join(f"{k}={shlex.quote(env[k])}" for k in keys)
+    return (f"{SSH_COMMAND_PREFIX} {slot.hostname} "
+            f"{shlex.quote(f'cd {os.getcwd()} ; env {exported} {cmd}')}")
+
+
+def launch_static(command: Sequence[str], slots: List[SlotInfo],
+                  controller_port: int,
+                  rendezvous_port: Optional[int] = None,
+                  env: Optional[Dict[str, str]] = None,
+                  verbose: int = 0,
+                  prefix_output_with_rank: bool = True) -> None:
+    """Launch every slot, stream output, fail fast on first failure
+    (reference launch_gloo, gloo_run.py:221-266).
+
+    The coordinator (native rank-0 controller) runs inside the rank-0
+    worker; all workers get its address. Raises RuntimeError listing failed
+    ranks if any worker exits non-zero.
+    """
+    controller_addr = slots[0].hostname
+    if is_local_host(controller_addr):
+        controller_addr = "127.0.0.1"
+
+    abort = threading.Event()
+    exit_codes: Dict[int, int] = {}
+    lock = threading.Lock()
+
+    def _run_slot(slot: SlotInfo) -> None:
+        senv = slot_env(slot, controller_addr, controller_port,
+                        rendezvous_port, base_env=env)
+        cmd = get_run_command(command, slot, senv)
+        if verbose >= 2:
+            print(f"[launcher] rank {slot.rank} on {slot.hostname}: {cmd}",
+                  file=sys.stderr)
+        code = safe_shell_exec.execute(
+            cmd, env=senv,
+            index=slot.rank if prefix_output_with_rank else None,
+            events=[abort])
+        with lock:
+            exit_codes[slot.rank] = code
+        if code != 0:
+            abort.set()  # fail fast: kill the other workers
+
+    threads = [threading.Thread(target=_run_slot, args=(s,), daemon=True)
+               for s in slots]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    failures = {r: c for r, c in exit_codes.items() if c != 0}
+    if failures:
+        raise RuntimeError(
+            "horovod_tpu job failed; non-zero exit codes by rank: "
+            + ", ".join(f"{r}→{c}" for r, c in sorted(failures.items())))

@@ -1,0 +1,297 @@
+"""Runner tests (reference analogue: test/single/test_run.py — arg parsing,
+host assignment, command construction; plus end-to-end static launch the
+reference covers in test/integration/test_static_run.py)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import network, secret
+from horovod_tpu.runner import run as hvd_run
+from horovod_tpu.runner.http_server import (
+    KVStoreServer,
+    RendezvousServer,
+    put_data_into_kvstore,
+    read_data_from_kvstore,
+)
+from horovod_tpu.runner.launch import parse_args, _validate
+from horovod_tpu.runner import config_parser, safe_shell_exec
+from horovod_tpu.runner.static_run import get_run_command, slot_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        hs = hosts_mod.parse_hosts("a:2,b:4")
+        assert [(h.hostname, h.slots) for h in hs] == [("a", 2), ("b", 4)]
+
+    def test_parse_hosts_default_slot(self):
+        hs = hosts_mod.parse_hosts("a,b:3")
+        assert [(h.hostname, h.slots) for h in hs] == [("a", 1), ("b", 3)]
+
+    def test_parse_hostfile(self, tmp_path):
+        f = tmp_path / "hostfile"
+        f.write_text("h1 slots=2\n# comment\nh2:3\nh3\n")
+        hs = hosts_mod.parse_host_files(str(f))
+        assert [(h.hostname, h.slots) for h in hs] == \
+            [("h1", 2), ("h2", 3), ("h3", 1)]
+
+    def test_assignment_packs_host_by_host(self):
+        # Reference semantics (hosts.py:100-150): ranks packed host-major.
+        hs = hosts_mod.parse_hosts("a:2,b:2")
+        slots = hosts_mod.get_host_assignments(hs, 4)
+        assert [(s.hostname, s.rank, s.local_rank, s.cross_rank)
+                for s in slots] == [
+            ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)]
+        assert all(s.size == 4 and s.local_size == 2 and s.cross_size == 2
+                   for s in slots)
+
+    def test_assignment_uneven(self):
+        hs = hosts_mod.parse_hosts("a:3,b:1")
+        slots = hosts_mod.get_host_assignments(hs, 4)
+        by_rank = {s.rank: s for s in slots}
+        # local_rank 0 exists on both hosts → cross_size 2
+        assert by_rank[0].cross_size == 2
+        assert by_rank[3].hostname == "b" and by_rank[3].local_size == 1
+        # local ranks 1,2 exist only on host a → cross_size 1
+        assert by_rank[1].cross_size == 1 and by_rank[2].cross_size == 1
+
+    def test_assignment_partial_fill(self):
+        hs = hosts_mod.parse_hosts("a:4,b:4")
+        slots = hosts_mod.get_host_assignments(hs, 6)
+        assert sum(1 for s in slots if s.hostname == "a") == 4
+        assert sum(1 for s in slots if s.hostname == "b") == 2
+
+    def test_assignment_insufficient_slots(self):
+        with pytest.raises(ValueError):
+            hosts_mod.get_host_assignments(hosts_mod.parse_hosts("a:1"), 2)
+
+
+class TestLaunchArgs:
+    def test_parse_basic(self):
+        args = parse_args(["-np", "4", "python", "train.py", "--lr", "0.1"])
+        assert args.np == 4
+        assert args.command == ["python", "train.py", "--lr", "0.1"]
+        assert not args.elastic
+        _validate(args)
+
+    def test_parse_elastic(self):
+        args = parse_args(["-np", "2", "--min-np", "2", "--max-np", "4",
+                           "--host-discovery-script", "./d.sh", "cmd"])
+        assert args.elastic
+        _validate(args)
+
+    def test_missing_np_rejected(self):
+        with pytest.raises(ValueError):
+            _validate(parse_args(["python", "train.py"]))
+
+    def test_missing_command_rejected(self):
+        with pytest.raises(ValueError):
+            _validate(parse_args(["-np", "2"]))
+
+    def test_tuning_flags_to_env(self):
+        args = parse_args(["-np", "2", "--fusion-threshold-mb", "32",
+                           "--cycle-time-ms", "3.5", "--autotune",
+                           "--timeline-filename", "/tmp/t.json",
+                           "--log-level", "debug", "cmd"])
+        env = {}
+        config_parser.set_env_from_args(env, args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HOROVOD_CYCLE_TIME"] == "3.5"
+        assert env["HOROVOD_AUTOTUNE"] == "1"
+        assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+        assert env["HOROVOD_LOG_LEVEL"] == "debug"
+
+    def test_config_file(self, tmp_path):
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(textwrap.dedent("""
+            fusion:
+              threshold-mb: 16
+              cycle-time-ms: 2.5
+            autotune:
+              enabled: true
+            timeline:
+              filename: /tmp/tl.json
+        """))
+        args = parse_args(["-np", "2", "--config-file", str(cfg), "cmd"])
+        config_parser.parse_config_file(str(cfg), args)
+        assert args.fusion_threshold_mb == 16
+        assert args.cycle_time_ms == 2.5
+        assert args.autotune is True
+        assert args.timeline_filename == "/tmp/tl.json"
+
+
+class TestSlotEnv:
+    def test_env_contract(self):
+        slot = hosts_mod.SlotInfo("localhost", 1, 1, 0, 2, 2, 1)
+        env = slot_env(slot, "127.0.0.1", 4567, rendezvous_port=8899,
+                       base_env={})
+        assert env["HOROVOD_RANK"] == "1"
+        assert env["HOROVOD_SIZE"] == "2"
+        assert env["HOROVOD_LOCAL_RANK"] == "1"
+        assert env["HOROVOD_CROSS_SIZE"] == "1"
+        assert env["HOROVOD_CONTROLLER_ADDR"] == "127.0.0.1"
+        assert env["HOROVOD_CONTROLLER_PORT"] == "4567"
+        assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "8899"
+
+    def test_remote_command_uses_ssh(self):
+        slot = hosts_mod.SlotInfo("farhost", 0, 0, 0, 2, 1, 2)
+        env = slot_env(slot, "farhost", 4567, base_env={"PATH": "/bin"})
+        cmd = get_run_command(["python", "t.py"], slot, env)
+        assert cmd.startswith("ssh ")
+        assert "HOROVOD_RANK=0" in cmd
+
+    def test_local_command_plain(self):
+        slot = hosts_mod.SlotInfo("localhost", 0, 0, 0, 1, 1, 1)
+        env = slot_env(slot, "127.0.0.1", 4567, base_env={})
+        cmd = get_run_command(["python", "t.py"], slot, env)
+        assert cmd == "python t.py"
+
+
+class TestSafeShellExec:
+    def test_exit_code_and_output(self, capsys):
+        code = safe_shell_exec.execute("echo hello; exit 3", index=7)
+        assert code == 3
+        assert "[7]hello" in capsys.readouterr().out
+
+    def test_event_kills_process_group(self):
+        ev = threading.Event()
+        t = threading.Timer(0.3, ev.set)
+        t.start()
+        start = time.monotonic()
+        code = safe_shell_exec.execute("sleep 30", events=[ev])
+        assert time.monotonic() - start < 10
+        assert code != 0
+
+
+class TestNetwork:
+    def test_ping_roundtrip(self):
+        key = secret.make_secret_key()
+        svc = network.BasicService("test", key)
+        try:
+            client = network.BasicClient("test", "127.0.0.1", svc.port, key)
+            resp = client.ping()
+            assert resp.service_name == "test"
+        finally:
+            svc.shutdown()
+
+    def test_wrong_key_rejected(self):
+        svc = network.BasicService("test", secret.make_secret_key())
+        try:
+            client = network.BasicClient("test", "127.0.0.1", svc.port,
+                                         b"x" * 32, attempts=1)
+            with pytest.raises((ConnectionError, PermissionError)):
+                client.ping()
+        finally:
+            svc.shutdown()
+
+
+class TestKVStore:
+    def test_put_get_roundtrip(self):
+        kv = KVStoreServer()
+        port = kv.start_server()
+        try:
+            put_data_into_kvstore("127.0.0.1", port, "s", "k", {"a": 1})
+            assert read_data_from_kvstore("127.0.0.1", port, "s", "k") == \
+                {"a": 1}
+        finally:
+            kv.shutdown_server()
+
+    def test_rendezvous_publishes_slots(self):
+        rs = RendezvousServer()
+        rs.start_server()
+        try:
+            slots = hosts_mod.get_host_assignments(
+                hosts_mod.parse_hosts("localhost:2"), 2)
+            rs.init(slots)
+            raw = rs.store.get("rendezvous", "localhost:1")
+            assert raw == b"1:2:1:2:0:1"
+        finally:
+            rs.stop()
+
+
+WORKER_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax; jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+import horovod_tpu as hvd
+hvd.init()
+out = hvd.allreduce(jnp.full((3,), float(hvd.rank())), op=hvd.Sum)
+expected = sum(range(hvd.size()))
+assert np.allclose(out, expected), (out, expected)
+print(f"OK rank={{hvd.rank()}} size={{hvd.size()}}")
+"""
+
+
+class TestEndToEnd:
+    def test_cli_static_run(self, tmp_path):
+        """hvdrun -np 2 python worker.py — full CLI path (reference:
+        test_static_run.py)."""
+        script = tmp_path / "worker.py"
+        script.write_text(WORKER_SCRIPT.format(repo=REPO))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = REPO
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "OK rank=0 size=2" in proc.stdout
+        assert "OK rank=1 size=2" in proc.stdout
+
+    def test_cli_failfast_kills_peers(self, tmp_path):
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys, time\n"
+            "if int(os.environ['HOROVOD_RANK']) == 1: sys.exit(5)\n"
+            "time.sleep(60)\n")
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = REPO
+        start = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             sys.executable, str(script)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode != 0
+        assert time.monotonic() - start < 60  # rank 0 was killed, not waited
+
+    def test_programmatic_run(self):
+        """horovod.run-equivalent (reference: test_interactiverun.py).
+        Launched in a subprocess so worker env stays clean."""
+        driver = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO!r})
+            from horovod_tpu.runner import run
+
+            def fn(base):
+                import jax
+                jax.config.update("jax_platforms", "cpu")
+                import horovod_tpu as hvd
+                import jax.numpy as jnp
+                hvd.init()
+                s = float(hvd.allreduce(jnp.ones(1), op=hvd.Sum)[0])
+                return base + hvd.rank(), s
+
+            results = run(fn, args=(100,), np=2)
+            assert results == [(100, 2.0), (101, 2.0)], results
+            print("RUN_API_OK")
+        """)
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = REPO
+        proc = subprocess.run([sys.executable, "-c", driver], env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "RUN_API_OK" in proc.stdout
