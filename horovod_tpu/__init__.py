@@ -106,6 +106,7 @@ from .parallel.tape import (  # noqa: F401
     value_and_grad,
 )
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
+from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
 
 from jax.sharding import PartitionSpec as _P
 

@@ -216,7 +216,13 @@ class CoreContext:
     # -- handle plumbing --
     def _check_handle(self, handle: int, keepalive) -> "NativeHandle":
         if handle < 0:
-            raise NativeError(self._lib.hvdtpu_last_error().decode())
+            msg = self._lib.hvdtpu_last_error().decode()
+            if not self._lib.hvdtpu_is_initialized():
+                # The background loop aborted under us (peer died /
+                # transport lost): elastic must see this as a rollbackable
+                # HorovodInternalError, not a hard failure.
+                raise NativeShutdownError(msg or "native core aborted")
+            raise NativeError(msg)
         return NativeHandle(self._lib, handle, keepalive)
 
     # -- collectives (async; return NativeHandle) --

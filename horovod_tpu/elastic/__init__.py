@@ -1,0 +1,170 @@
+"""Elastic (fault-tolerant, dynamic world) training.
+
+Reference surface: ``hvd.elastic`` — ``State``/``ObjectState``, the
+``@hvd.elastic.run`` wrapper (common/elastic.py:147-168), ``ElasticSampler``
+— plus the driver-side machinery in ``horovod/runner/elastic/`` (driver,
+discovery, registration, rendezvous, worker notification).
+
+Worker protocol (reference common/elastic.py + rendezvous.py):
+
+1. the launcher spawns the worker with ``HOROVOD_HOSTNAME``,
+   ``HOROVOD_LOCAL_RANK``, ``HOROVOD_ELASTIC=1`` and the elastic driver's
+   RPC coordinates (``HOROVOD_ELASTIC_DRIVER_ADDR/PORT/KEY``);
+2. ``run(func)(state)`` rendezvouses: asks the driver for a slot newer than
+   the last world it saw, exports the ``HOROVOD_RANK/SIZE/...`` contract +
+   native controller address, and calls ``hvd.init()`` — the worker script
+   must NOT call ``hvd.init()`` itself in elastic mode;
+3. ``state.sync()`` broadcasts committed state from the new rank 0;
+4. on ``HorovodInternalError`` (peer died mid-collective): restore to the
+   last commit, shutdown, re-rendezvous, retry;
+   on ``HostsUpdatedInterrupt`` (raised by ``state.commit()`` after a
+   driver notification): keep state, re-rendezvous into the new world.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import socket
+import time
+
+from ..common import basics
+from ..common.exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from . import constants
+from .discovery import (  # noqa: F401
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+    HostUpdateResult,
+)
+from .driver import (  # noqa: F401
+    ElasticDriver,
+    GetSlotRequest,
+    RegisterWorkerAddressRequest,
+)
+from .registration import WorkerStateRegistry  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401
+from .state import JaxState, ObjectState, State  # noqa: F401
+from .worker import notification_manager  # noqa: F401
+
+
+def _driver_client():
+    from ..runner import network
+
+    addr = os.environ["HOROVOD_ELASTIC_DRIVER_ADDR"]
+    port = int(os.environ["HOROVOD_ELASTIC_DRIVER_PORT"])
+    key = bytes.fromhex(os.environ["HOROVOD_ELASTIC_DRIVER_KEY"])
+    client = network.BasicClient("elastic driver service", addr, port, key,
+                                 attempts=3, timeout=10.0)
+    return client, key
+
+
+_last_world_id = [-1]
+
+
+def _rendezvous(client) -> None:
+    """Ask the driver for the next world's slot; export the env contract;
+    init (reference rendezvous.py:37-42 + gloo_run.py:65-76)."""
+    host = os.environ["HOROVOD_HOSTNAME"]
+    local_rank = int(os.environ["HOROVOD_LOCAL_RANK"])
+    deadline = time.monotonic() + constants.ELASTIC_TIMEOUT_SECS
+    while True:
+        resp = client._send(GetSlotRequest(host, local_rank,
+                                           _last_world_id[0] + 1))
+        if resp.status == "ok":
+            break
+        if resp.status == "shutdown":
+            logging.info("driver released this worker — exiting cleanly")
+            raise SystemExit(0)
+        if time.monotonic() > deadline:
+            raise TimeoutError("elastic rendezvous timed out")
+        time.sleep(constants.WORKER_RENDEZVOUS_RETRY_SECS)
+
+    slot = resp.slot
+    os.environ.update({
+        "HOROVOD_RANK": str(slot["rank"]),
+        "HOROVOD_SIZE": str(slot["size"]),
+        "HOROVOD_LOCAL_RANK": str(slot["local_rank"]),
+        "HOROVOD_LOCAL_SIZE": str(slot["local_size"]),
+        "HOROVOD_CROSS_RANK": str(slot["cross_rank"]),
+        "HOROVOD_CROSS_SIZE": str(slot["cross_size"]),
+        "HOROVOD_CONTROLLER_ADDR": resp.controller_addr,
+        "HOROVOD_CONTROLLER_PORT": str(resp.controller_port),
+    })
+    _last_world_id[0] = resp.world_id
+    basics.init()
+
+
+def _register_notification_service(client, key: bytes) -> None:
+    service = notification_manager.init(key)
+    host = os.environ["HOROVOD_HOSTNAME"]
+    local_rank = int(os.environ["HOROVOD_LOCAL_RANK"])
+    addr = "127.0.0.1" if os.environ["HOROVOD_ELASTIC_DRIVER_ADDR"] in (
+        "127.0.0.1", "localhost") else socket.getfqdn()
+    client._send(RegisterWorkerAddressRequest(host, local_rank, addr,
+                                              service.port))
+
+
+def run(func):
+    """Elastic training wrapper (reference common/elastic.py:147-168)::
+
+        @hvd.elastic.run
+        def train(state):
+            for batch_idx in range(state.batch, num_batches):
+                step(state, batches[batch_idx])
+                state.batch = batch_idx
+                state.commit()
+
+        state = hvd.elastic.JaxState(params=params, opt_state=opt_state,
+                                     batch=0)
+        train(state)
+    """
+    from ..cc import NativeError, NativeShutdownError
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        client, key = _driver_client()
+
+        def _reset_world():
+            """Tear down and join the next world incarnation. If a peer
+            dies *during* world formation the native init fails — ask the
+            driver for a yet-newer world and try again (the peer's exit
+            will have triggered a resume)."""
+            deadline = time.monotonic() + constants.ELASTIC_TIMEOUT_SECS
+            while True:
+                if basics.is_initialized():
+                    basics.shutdown()
+                try:
+                    _rendezvous(client)
+                    return
+                except (NativeError, NativeShutdownError) as e:
+                    if time.monotonic() > deadline:
+                        raise
+                    logging.warning(
+                        f"world formation failed ({e}); re-rendezvousing")
+
+        if not basics.is_initialized():
+            _reset_world()
+        # The State registered itself with the notification manager at
+        # construction; the wrapper only has to start the service and hand
+        # its address to the driver.
+        _register_notification_service(client, key)
+        skip_sync = False
+        while True:
+            try:
+                if not skip_sync:
+                    state.sync()
+                return func(state, *args, **kwargs)
+            except (HorovodInternalError, NativeShutdownError) as e:
+                logging.warning(
+                    f"step aborted ({e}); rolling back to last commit")
+                state.restore()
+                skip_sync = False
+            except HostsUpdatedInterrupt as e:
+                logging.info("host set changed — re-rendezvousing")
+                skip_sync = e.skip_sync
+            _reset_world()
+            state.on_reset()
+    return wrapper

@@ -1,0 +1,19 @@
+"""Elastic tuning knobs (reference: horovod/runner/elastic/constants.py).
+
+All overridable via env so integration tests can accelerate discovery the
+same way the reference mocks DISCOVER_HOSTS_FREQUENCY_SECS
+(test/integration/elastic_common.py).
+"""
+
+import os
+
+DISCOVER_HOSTS_FREQUENCY_SECS = float(
+    os.environ.get("HOROVOD_ELASTIC_DISCOVER_HOSTS_FREQUENCY_SECS", "1.0"))
+
+ELASTIC_TIMEOUT_SECS = float(
+    os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+
+START_TIMEOUT_SECS = float(
+    os.environ.get("HOROVOD_ELASTIC_START_TIMEOUT", "600"))
+
+WORKER_RENDEZVOUS_RETRY_SECS = 0.2

@@ -1,0 +1,109 @@
+"""Host discovery for elastic jobs.
+
+Reference surface: ``horovod/runner/elastic/discovery.py`` (164 LoC) —
+``HostDiscoveryScript`` runs a user script that prints ``host[:slots]``
+lines; ``HostManager`` diffs consecutive results, tracks a blacklist, and
+classifies each update as added/removed/mixed (HostUpdateResult).
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import threading
+from typing import Dict, List, Optional, Set
+
+
+class HostUpdateResult:
+    no_update = 0
+    removed = 1
+    added = 2
+    mixed = removed | added
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        """Return {hostname: slots} currently available."""
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user-provided discovery script (reference
+    discovery.py:40-77). Each stdout line is ``host`` or ``host:slots``;
+    ``default_slots`` fills in bare hostnames."""
+
+    def __init__(self, discovery_script: str, default_slots: int = 1):
+        self._script = discovery_script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.check_output(self._script, shell=True, text=True,
+                                      stderr=subprocess.DEVNULL)
+        hosts: Dict[str, int] = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            if ":" in line:
+                host, slots = line.split(":", 1)
+                hosts[host] = int(slots)
+            else:
+                hosts[line] = self._default_slots
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Static host set (reference discovery.py:80-89) — elastic semantics
+    (fault tolerance, blacklist) over a fixed pool."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Tracks current/blacklisted hosts and diffs discovery results
+    (reference discovery.py:92-164)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._current_hosts: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+
+    @property
+    def current_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return {h: s for h, s in self._current_hosts.items()
+                    if h not in self._blacklist}
+
+    def blacklist(self, host: str) -> None:
+        """Reference discovery.py:128-136 — a failed host never returns."""
+        with self._lock:
+            if host not in self._blacklist:
+                logging.warning(f"blacklisting host {host}")
+                self._blacklist.add(host)
+
+    def is_blacklisted(self, host: str) -> bool:
+        with self._lock:
+            return host in self._blacklist
+
+    def update_available_hosts(self) -> int:
+        """Run discovery once; return a HostUpdateResult mask."""
+        new_hosts = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            old = {h: s for h, s in self._current_hosts.items()
+                   if h not in self._blacklist}
+            new = {h: s for h, s in new_hosts.items()
+                   if h not in self._blacklist}
+            self._current_hosts = new_hosts
+        res = HostUpdateResult.no_update
+        for h, s in new.items():
+            if h not in old or old[h] < s:
+                res |= HostUpdateResult.added
+        for h, s in old.items():
+            if h not in new or new[h] < s:
+                res |= HostUpdateResult.removed
+        return res

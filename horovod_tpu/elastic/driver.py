@@ -1,0 +1,367 @@
+"""Elastic driver: discovery loop, dynamic rank assignment, worker lifecycle.
+
+Reference surface: ``horovod/runner/elastic/driver.py`` (309 LoC) —
+``ElasticDriver`` runs a discovery thread (diff host set every
+DISCOVER_HOSTS_FREQUENCY_SECS, notify workers on churn), computes host
+assignments for each world incarnation, spawns one worker per slot, handles
+worker exits (blacklist + resume), and serves rank/size to workers at
+rendezvous (rendezvous.py:37-42 → driver.record_ready).
+
+Redesign: the reference splits rendezvous (HTTP) from notification (RPC);
+here both ride one HMAC-signed RPC service owned by the driver
+(``ElasticDriverService``). Each world incarnation gets a ``world_id`` and a
+fresh native-controller port, so a worker re-rendezvousing after a reset
+can ask for "an assignment newer than the one I had" and stale coordinator
+sockets can never cross-talk between incarnations.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..runner import network
+from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
+from ..runner.network import find_free_port
+from . import constants
+from .discovery import HostManager, HostUpdateResult
+from .registration import FAILURE, SUCCESS, WorkerStateRegistry
+from .worker import WorkerNotificationClient
+
+
+class GetSlotRequest:
+    def __init__(self, host: str, local_rank: int, min_world_id: int = 0):
+        self.host = host
+        self.local_rank = local_rank
+        self.min_world_id = min_world_id
+
+
+class GetSlotResponse:
+    # status ∈ {"ok", "waiting", "shutdown"}
+    def __init__(self, status: str, slot: Optional[dict] = None,
+                 world_id: int = -1, controller_addr: str = "",
+                 controller_port: int = 0):
+        self.status = status
+        self.slot = slot
+        self.world_id = world_id
+        self.controller_addr = controller_addr
+        self.controller_port = controller_port
+
+
+class RegisterWorkerAddressRequest:
+    def __init__(self, host: str, local_rank: int, addr: str, port: int):
+        self.host = host
+        self.local_rank = local_rank
+        self.addr = addr
+        self.port = port
+
+
+class ElasticDriverService(network.BasicService):
+    def __init__(self, key: bytes, driver: "ElasticDriver"):
+        super().__init__("elastic driver service", key)
+        self._driver = driver
+
+    def _handle(self, req, client_address):
+        if isinstance(req, GetSlotRequest):
+            return self._driver.get_slot_info(req.host, req.local_rank,
+                                              req.min_world_id)
+        if isinstance(req, RegisterWorkerAddressRequest):
+            self._driver.register_worker_address(
+                req.host, req.local_rank, req.addr, req.port)
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+
+class ElasticDriver:
+    """Reference driver.py:68-309, minus the HTTP rendezvous split."""
+
+    def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None, verbose: int = 0,
+                 key: Optional[bytes] = None,
+                 controller_addr_override: Optional[str] = None):
+        # controller_addr_override: tests simulating multi-host churn with
+        # fake hostnames on one machine point every worker at 127.0.0.1
+        # (the reference mocks ssh the same way, SURVEY §4).
+        from ..runner import secret
+
+        self._controller_addr_override = controller_addr_override
+        self._min_np = min_np
+        self._max_np = max_np
+        self._verbose = verbose
+        self._host_manager = HostManager(discovery)
+        self._registry = WorkerStateRegistry(self, self._host_manager,
+                                             reset_limit=reset_limit,
+                                             verbose=verbose > 0)
+        self.key = key or secret.make_secret_key()
+        self._service = ElasticDriverService(self.key, self)
+
+        self._lock = threading.RLock()
+        self._world_id = -1
+        self._host_order: List[str] = []
+        self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
+        self._controller_port = 0
+        self._create_worker_fn: Optional[Callable] = None
+        self._live_workers: Dict[Tuple[str, int], threading.Thread] = {}
+        self._released: set = set()  # slots told to exit by a world shrink
+        self._worker_clients: Dict[Tuple[str, int],
+                                   WorkerNotificationClient] = {}
+        self._shutdown = threading.Event()
+        self._finished = threading.Event()
+        self._result_lock = threading.Lock()
+        self._discovery_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ API
+
+    @property
+    def service_port(self) -> int:
+        return self._service.port
+
+    @property
+    def registry(self) -> WorkerStateRegistry:
+        return self._registry
+
+    @property
+    def host_manager(self) -> HostManager:
+        return self._host_manager
+
+    @property
+    def world_id(self) -> int:
+        with self._lock:
+            return self._world_id
+
+    def current_assignments(self) -> List[SlotInfo]:
+        with self._lock:
+            return sorted(self._assignments.values(), key=lambda s: s.rank)
+
+    def start(self, create_worker_fn: Callable[[SlotInfo, int], int]) -> None:
+        """Begin discovery + spawn the first world.
+
+        ``create_worker_fn(slot, world_id)`` runs a worker process to
+        completion and returns its exit code (the launcher passes an
+        ssh/local exec closure; tests pass mocks, same as reference
+        test_elastic_driver.py).
+        """
+        self._create_worker_fn = create_worker_fn
+        self.wait_for_available_slots(self._min_np)
+        self._resume(initial=True)
+        self._discovery_thread = threading.Thread(target=self._discover_loop,
+                                                  daemon=True)
+        self._discovery_thread.start()
+
+    def wait_for_available_slots(self, min_np: int,
+                                 timeout: Optional[float] = None):
+        """Block until discovery yields >= min_np slots (reference
+        driver.py:150-176)."""
+        timeout = timeout if timeout is not None else \
+            constants.START_TIMEOUT_SECS
+        deadline = time.monotonic() + timeout
+        while True:
+            self._host_manager.update_available_hosts()
+            hosts = self._host_manager.current_hosts
+            if sum(hosts.values()) >= min_np:
+                return hosts
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"timed out waiting for {min_np} slots; discovered "
+                    f"{hosts}")
+            time.sleep(constants.DISCOVER_HOSTS_FREQUENCY_SECS)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._finished.set()
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the job to finish; True if at least one worker
+        succeeded and the job wound down."""
+        self._finished.wait(timeout)
+        return (self._registry.count(SUCCESS) > 0
+                and self._registry.count(FAILURE) == 0) or \
+            (self._registry.count(SUCCESS) > 0 and self._shutdown.is_set())
+
+    def shutdown_service(self) -> None:
+        self._service.shutdown()
+
+    # ------------------------------------------------- rendezvous (workers)
+
+    def get_slot_info(self, host: str, local_rank: int,
+                      min_world_id: int = 0) -> GetSlotResponse:
+        with self._lock:
+            if self._shutdown.is_set():
+                return GetSlotResponse("shutdown")
+            if self._world_id < min_world_id:
+                return GetSlotResponse("waiting")
+            slot = self._assignments.get((host, local_rank))
+            if slot is None:
+                # Not in the new world (host shrunk/blacklisted): worker
+                # should exit cleanly. Its clean exit must NOT count as a
+                # training success (it never finished func).
+                self._released.add((host, local_rank))
+                return GetSlotResponse("shutdown")
+            self._registry.record_ready(host, local_rank)
+            rank0_host = next(s.hostname for s in self._assignments.values()
+                              if s.rank == 0)
+            if self._controller_addr_override is not None:
+                addr = self._controller_addr_override
+            else:
+                addr = "127.0.0.1" if _is_local(rank0_host) else rank0_host
+            return GetSlotResponse("ok", slot=slot.__dict__.copy(),
+                                   world_id=self._world_id,
+                                   controller_addr=addr,
+                                   controller_port=self._controller_port)
+
+    def register_worker_address(self, host: str, local_rank: int,
+                                addr: str, port: int) -> None:
+        client = WorkerNotificationClient(
+            "worker notification service", addr, port, self.key,
+            attempts=1, timeout=5.0)
+        with self._lock:
+            self._worker_clients[(host, local_rank)] = client
+
+    # --------------------------------------------------- lifecycle internals
+
+    def on_worker_failure(self, host: str, local_rank: int) -> None:
+        if self._shutdown.is_set() or self._finished.is_set():
+            return
+        if self._registry.reset_limit_reached():
+            logging.error("elastic reset limit reached — shutting down")
+            self.stop()
+            return
+        self._maybe_resume()
+
+    def _discover_loop(self) -> None:
+        while not self._shutdown.is_set():
+            time.sleep(constants.DISCOVER_HOSTS_FREQUENCY_SECS)
+            try:
+                res = self._host_manager.update_available_hosts()
+            except Exception as e:  # discovery script hiccup: retry
+                logging.warning(f"host discovery failed: {e}")
+                continue
+            if res == HostUpdateResult.no_update:
+                continue
+            if self._shutdown.is_set():
+                return
+            if res & HostUpdateResult.added:
+                # New capacity: notify workers so they interrupt at the next
+                # commit; re-assign immediately so re-rendezvous finds the
+                # bigger world (reference driver.py:177-226).
+                self._maybe_resume()
+                self._notify_workers(res)
+            # Pure removal: workers on dead hosts will fail their
+            # collectives (HorovodInternalError → restore + re-rendezvous)
+            # or exit; resume happens via on_worker_failure. A graceful
+            # shrink (host removed but alive) still needs a new world:
+            elif res & HostUpdateResult.removed:
+                self._maybe_resume()
+                self._notify_workers(res)
+
+    def _notify_workers(self, res: int) -> None:
+        with self._lock:
+            clients = dict(self._worker_clients)
+        ts = int(time.time() * 1000)
+        for key, client in clients.items():
+            try:
+                client.notify_hosts_updated(ts, res)
+            except ConnectionError:
+                pass  # worker mid-restart; it will re-rendezvous anyway
+
+    def _maybe_resume(self) -> None:
+        with self._lock:
+            self._resume()
+
+    def _resume(self, initial: bool = False) -> None:
+        """Compute assignments for the next world incarnation and spawn
+        workers for slots without a live process (reference
+        driver.py:292-308 resume + _activate_workers)."""
+        with self._lock:
+            hosts = self._host_manager.current_hosts
+            total = sum(hosts.values())
+            if total < self._min_np:
+                if initial:
+                    raise RuntimeError(
+                        f"cannot start: {total} slots < min_np={self._min_np}")
+                logging.warning(
+                    f"only {total} slots available (< min_np="
+                    f"{self._min_np}); waiting for discovery")
+                return
+            # Previously-assigned hosts keep their order so rank 0 stays on
+            # a SURVIVING host — state.sync() broadcasts from rank 0, and a
+            # brand-new host must never be the state source (reference:
+            # driver.py host_assignment_order).
+            order = [h for h in self._host_order if h in hosts]
+            order += sorted(h for h in hosts if h not in order)
+            self._host_order = order
+            host_infos = [HostInfo(h, hosts[h]) for h in order]
+            slots = get_host_assignments(host_infos, self._min_np,
+                                         self._max_np or total)
+            self._world_id += 1
+            if not initial:
+                self._registry.increment_reset_count()
+            self._registry.reset()
+            self._assignments = {(s.hostname, s.local_rank): s
+                                 for s in slots}
+            # NOTE: probed on the driver machine; for a remote rank-0 host
+            # this is only a good guess — a collision there fails world
+            # formation, and workers retry into the next incarnation
+            # (see find_free_port's caveat).
+            self._controller_port = find_free_port()
+            if self._verbose:
+                logging.info(
+                    f"world {self._world_id}: "
+                    f"{[(s.hostname, s.rank) for s in slots]}")
+            for key, slot in self._assignments.items():
+                if key not in self._live_workers or \
+                        not self._live_workers[key].is_alive():
+                    self._spawn_worker(slot)
+
+    def _spawn_worker(self, slot: SlotInfo) -> None:
+        world_id = self._world_id
+        key = (slot.hostname, slot.local_rank)
+
+        def _run():
+            try:
+                code = self._create_worker_fn(slot, world_id)
+            except Exception:
+                logging.exception(f"worker {key} raised in exec")
+                code = 1
+            self._handle_worker_exit(slot, code)
+
+        t = threading.Thread(target=_run, daemon=True)
+        self._live_workers[key] = t
+        t.start()
+
+    def _handle_worker_exit(self, slot: SlotInfo, code: int) -> None:
+        key = (slot.hostname, slot.local_rank)
+        with self._lock:
+            self._live_workers.pop(key, None)
+            self._worker_clients.pop(key, None)
+        if self._shutdown.is_set():
+            return
+        if key in self._released:
+            # Shrink-released worker: neither success nor failure.
+            self._released.discard(key)
+        elif code == 0:
+            self._registry.record_success(slot.hostname, slot.local_rank)
+        else:
+            self._registry.record_failure(slot.hostname, slot.local_rank)
+        with self._lock:
+            live = sum(1 for t in self._live_workers.values() if t.is_alive())
+        if live == 0:
+            if self._registry.count(SUCCESS) > 0:
+                self._finished.set()
+                self._shutdown.set()
+            elif self._registry.reset_limit_reached() or \
+                    not self._has_any_hosts():
+                self._finished.set()
+                self._shutdown.set()
+            # else: resume already triggered via record_failure
+
+    def _has_any_hosts(self) -> bool:
+        return sum(self._host_manager.current_hosts.values()) > 0
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", socket.gethostname(),
+                        socket.getfqdn())

@@ -1,0 +1,87 @@
+"""Elastic job launch: wires the CLI to the ElasticDriver.
+
+Reference surface: ``horovod/runner/gloo_run.py:282-331``
+(``launch_gloo_elastic``): build the discovery object from
+--host-discovery-script (or fixed hosts), start the driver, and exec one
+worker per slot with the elastic env contract. Worker commands are built
+like static slots, but identity env is (hostname, local_rank) only — the
+rank/size contract arrives later via rendezvous.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, Optional, Sequence
+
+from ..runner import safe_shell_exec
+from ..runner.hosts import SlotInfo, parse_host_files, parse_hosts
+from ..runner.static_run import get_run_command, is_local_host
+from .discovery import FixedHosts, HostDiscoveryScript
+from .driver import ElasticDriver
+
+
+def _worker_env(slot: SlotInfo, driver: ElasticDriver,
+                base_env: Dict[str, str]) -> Dict[str, str]:
+    env = dict(base_env)
+    env.update({
+        "HOROVOD_HOSTNAME": slot.hostname,
+        "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+        "HOROVOD_ELASTIC": "1",
+        "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1"
+        if is_local_host(slot.hostname) else _driver_addr(),
+        "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+        "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+    })
+    return env
+
+
+def _driver_addr() -> str:
+    import socket
+
+    return socket.getfqdn()
+
+
+def make_exec_worker_fn(command: Sequence[str], env: Dict[str, str],
+                        driver: ElasticDriver, verbose: int = 0):
+    """create_worker_fn for ElasticDriver: exec the training command for a
+    slot, return its exit code (reference gloo_run.py:282-320)."""
+
+    def _exec(slot: SlotInfo, world_id: int) -> int:
+        senv = _worker_env(slot, driver, env)
+        cmd = get_run_command(command, slot.hostname, senv)
+        if verbose >= 2:
+            print(f"[elastic] spawn {slot.hostname}:{slot.local_rank} "
+                  f"world {world_id}: {cmd}", file=sys.stderr)
+        return safe_shell_exec.execute(
+            cmd, env=senv, index=f"{slot.hostname}:{slot.local_rank}")
+
+    return _exec
+
+
+def launch_elastic(args, env: Optional[Dict[str, str]] = None) -> None:
+    """CLI entry (reference launch.py:575 _run_elastic →
+    gloo_run_elastic)."""
+    env = dict(env if env is not None else os.environ)
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        default_slots=args.slots or 1)
+    else:
+        hosts = parse_host_files(args.hostfile) if args.hostfile \
+            else parse_hosts(args.hosts)
+        discovery = FixedHosts({h.hostname: h.slots for h in hosts})
+
+    min_np = args.min_np or args.np
+    max_np = args.max_np
+    driver = ElasticDriver(discovery, min_np=min_np, max_np=max_np,
+                           reset_limit=args.reset_limit,
+                           verbose=args.verbose)
+    try:
+        driver.start(make_exec_worker_fn(args.command, env, driver,
+                                         verbose=args.verbose))
+        ok = driver.join()
+        if not ok:
+            raise RuntimeError("elastic job failed (no successful worker)")
+    finally:
+        driver.stop()
+        driver.shutdown_service()

@@ -1,0 +1,94 @@
+"""Worker state registry: driver-side record of worker outcomes.
+
+Reference surface: ``horovod/runner/elastic/registration.py`` (173 LoC) —
+``WorkerStateRegistry`` records each worker's READY/SUCCESS/FAILURE
+transition, blacklists hosts on failure, and triggers ``driver.resume()``
+when failures arrive, bounded by ``reset_limit``.
+
+Redesign note: the reference synchronizes state transitions through a
+breakable barrier sized to the world; here the driver owns worker lifetime
+directly (per-slot exec threads), so the registry only needs atomic
+bookkeeping + the blacklist/resume triggers — the "wait until the world
+settles" logic lives in ``ElasticDriver._maybe_resume``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional, Set
+
+READY = "READY"
+SUCCESS = "SUCCESS"
+FAILURE = "FAILURE"
+
+
+class WorkerStateRegistry:
+    def __init__(self, driver, host_manager,
+                 reset_limit: Optional[int] = None, verbose: bool = False):
+        self._driver = driver
+        self._host_manager = host_manager
+        self._reset_limit = reset_limit
+        self._verbose = verbose
+        self._lock = threading.Lock()
+        self._states: Dict[str, str] = {}  # "host:local_rank" → state
+        self._cumulative: Dict[str, int] = {READY: 0, SUCCESS: 0, FAILURE: 0}
+        self._reset_count = 0
+
+    @property
+    def reset_count(self) -> int:
+        with self._lock:
+            return self._reset_count
+
+    def increment_reset_count(self) -> None:
+        with self._lock:
+            self._reset_count += 1
+
+    def reset_limit_reached(self) -> bool:
+        with self._lock:
+            return (self._reset_limit is not None
+                    and self._reset_count >= self._reset_limit)
+
+    def count(self, state: str) -> int:
+        """Workers currently in ``state`` (this world incarnation)."""
+        with self._lock:
+            return sum(1 for s in self._states.values() if s == state)
+
+    def total_count(self, state: str) -> int:
+        """Cumulative transitions into ``state`` across all incarnations."""
+        with self._lock:
+            return self._cumulative[state]
+
+    def get_recorded_slots(self, state: str) -> Set[str]:
+        with self._lock:
+            return {k for k, s in self._states.items() if s == state}
+
+    def reset(self) -> None:
+        """Clear per-world state before a new assignment round
+        (reference registration.py:63-72)."""
+        with self._lock:
+            self._states.clear()
+
+    def record_ready(self, host: str, local_rank: int) -> None:
+        self._record_state(host, local_rank, READY)
+
+    def record_success(self, host: str, local_rank: int) -> None:
+        self._record_state(host, local_rank, SUCCESS)
+
+    def record_failure(self, host: str, local_rank: int) -> None:
+        # Reference registration.py:105-112: a failure blacklists the host
+        # so the next assignment excludes it.
+        self._host_manager.blacklist(host)
+        self._record_state(host, local_rank, FAILURE)
+        self._driver.on_worker_failure(host, local_rank)
+
+    def _record_state(self, host: str, local_rank: int, state: str) -> None:
+        key = f"{host}:{local_rank}"
+        with self._lock:
+            prev = self._states.get(key)
+            if prev == state:
+                return
+            self._states[key] = state
+            self._cumulative[state] += 1
+        if self._verbose:
+            logging.info(f"worker {key} → {state}")

@@ -1,0 +1,81 @@
+"""ElasticSampler: re-shards remaining samples when the world changes.
+
+Reference surface: ``horovod/torch/elastic/sampler.py`` — a distributed
+sampler that records processed indices; after a reset the *unprocessed*
+remainder of the epoch is re-partitioned over the new world so no sample is
+dropped or double-trained.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional, Set
+
+from ..common import basics
+
+
+class ElasticSampler:
+    def __init__(self, dataset_size: int, shuffle: bool = True,
+                 seed: int = 0, rank: Optional[int] = None,
+                 size: Optional[int] = None):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self._fixed_world = (rank, size) if size is not None else None
+        self.epoch = 0
+        self.processed_indices: Set[int] = set()
+        self._reshard()
+
+    # -- State protocol hooks (registered via state.register_reset_callbacks
+    #    or stored inside an ObjectState) --
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch,
+                "processed_indices": set(self.processed_indices)}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.epoch = state["epoch"]
+        self.processed_indices = set(state["processed_indices"])
+        self._reshard()
+
+    def set_epoch(self, epoch: int) -> None:
+        """New epoch: forget processed set, reshuffle (reference
+        sampler.py set_epoch)."""
+        self.epoch = epoch
+        self.processed_indices = set()
+        self._reshard()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark the batch's global indices processed (call after commit)."""
+        begin = batch_idx * batch_size
+        self.processed_indices.update(self.indices[begin:begin + batch_size])
+
+    def reset(self) -> None:
+        """World changed: re-partition the unprocessed remainder."""
+        self._reshard()
+
+    def _world(self):
+        if self._fixed_world is not None:
+            return self._fixed_world
+        if basics.is_initialized():
+            return basics.rank(), basics.size()
+        return 0, 1
+
+    def _reshard(self) -> None:
+        rank, size = self._world()
+        remaining = [i for i in range(self.dataset_size)
+                     if i not in self.processed_indices]
+        if self.shuffle:
+            random.Random(self.seed + self.epoch).shuffle(remaining)
+        # Truncate so every rank has the same number of batches (the
+        # reference pads instead; truncation keeps steps aligned without
+        # duplicating samples).
+        per_rank = len(remaining) // size
+        self.indices: List[int] = remaining[rank * per_rank:(rank + 1) *
+                                            per_rank] if per_rank else []
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return len(self.indices)

@@ -1,0 +1,165 @@
+"""Elastic state: in-memory checkpoint with commit/restore/sync.
+
+Reference surface: ``horovod/common/elastic.py:60-109`` (``State`` with
+save/restore/sync/commit/check_host_updates + reset callbacks) and
+``ObjectState`` (attr dict synced via ``broadcast_object``); the JAX-native
+``JaxState`` plays the role of ``TorchState``/``TensorFlowState``
+(torch/elastic/state.py:27, tensorflow/elastic.py): pytrees of arrays
+broadcast from the new rank 0 after a reset.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..common.exceptions import HostsUpdatedInterrupt
+from .discovery import HostUpdateResult
+from .worker import notification_manager
+
+
+class State:
+    """Base elastic state (reference common/elastic.py:60-109)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages: "queue.Queue" = queue.Queue()
+        self._last_updated_timestamp = 0
+        self._reset_callbacks: List[Callable[[], None]] = []
+        notification_manager.register_listener(self)
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self._host_messages = queue.Queue()
+        self.reset()
+        for callback in self._reset_callbacks:
+            callback()
+
+    def on_hosts_updated(self, timestamp: int, update_res: int) -> None:
+        self._host_messages.put((timestamp, update_res))
+
+    def commit(self) -> None:
+        """Save + raise HostsUpdatedInterrupt if the world changed
+        (reference common/elastic.py:84-93). Call at the point in the train
+        loop where state is consistent."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Drain pending host updates; raise to trigger a reset."""
+        updated = False
+        res = HostUpdateResult.no_update
+        while not self._host_messages.empty():
+            timestamp, update_res = self._host_messages.get()
+            if timestamp > self._last_updated_timestamp:
+                self._last_updated_timestamp = timestamp
+                updated = True
+                res |= update_res
+        if updated:
+            raise HostsUpdatedInterrupt(res == HostUpdateResult.removed)
+
+    # Overridables
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        pass
+
+
+class ObjectState(State):
+    """Arbitrary picklable attrs, synced by broadcast from rank 0
+    (reference common/elastic.py:112-146)."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None, **kwargs):
+        if bcast_object is None:
+            from ..parallel.functions import broadcast_object
+
+            bcast_object = broadcast_object
+        self._bcast_object = bcast_object
+        self._saved_state: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        super().__init__()
+
+    def save(self) -> None:
+        new_state = {}
+        for k in self._saved_state:
+            new_state[k] = copy.deepcopy(getattr(self, k))
+        self._saved_state = new_state
+
+    def restore(self) -> None:
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0,
+                                        name="elastic.object_state")
+            self._saved_state = synced
+            self.restore()
+
+
+class JaxState(State):
+    """Elastic state for JAX pytrees (params/opt_state/...) + plain attrs.
+
+    Pytree leaves are broadcast tensor-by-tensor from rank 0 on sync()
+    (the reference broadcasts parameters the same way,
+    torch/elastic/state.py:27 + functions.py:30); scalars and other
+    picklables ride one broadcast_object. JAX arrays are immutable, so
+    save() just pins references — no copies.
+    """
+
+    def __init__(self, **kwargs):
+        import jax
+
+        self._tree_keys = [k for k, v in kwargs.items()
+                           if _is_pytree_of_arrays(v)]
+        self._obj_keys = [k for k in kwargs if k not in self._tree_keys]
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._saved: Dict[str, Any] = {}
+        super().__init__()
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: getattr(self, k)
+                       for k in (*self._tree_keys, *self._obj_keys)}
+        # deep-copy the non-array attrs (mutable python state)
+        for k in self._obj_keys:
+            self._saved[k] = copy.deepcopy(self._saved[k])
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v) if k in self._obj_keys else v)
+
+    def sync(self) -> None:
+        from ..parallel.functions import broadcast_object, broadcast_parameters
+
+        for k in self._tree_keys:
+            setattr(self, k, broadcast_parameters(getattr(self, k),
+                                                  root_rank=0))
+        if self._obj_keys:
+            objs = {k: getattr(self, k) for k in self._obj_keys}
+            synced = broadcast_object(objs, root_rank=0,
+                                      name="elastic.jax_state")
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+
+def _is_pytree_of_arrays(value: Any) -> bool:
+    import jax
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(value)
+    return bool(leaves) and all(
+        isinstance(l, (jax.Array, np.ndarray)) for l in leaves)

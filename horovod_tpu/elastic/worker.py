@@ -1,0 +1,84 @@
+"""Worker-side elastic plumbing: notification channel + driver RPC client.
+
+Reference surface: ``horovod/runner/elastic/worker.py`` —
+``WorkerNotificationService`` (runs inside each worker; the driver pushes
+``HostsUpdatedRequest`` when discovery sees churn) and
+``WorkerNotificationManager`` (worker-global registry of listening States).
+The driver-side client lives here too, mirroring
+``WorkerNotificationClient``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional
+
+from ..runner import network
+from .discovery import HostUpdateResult
+
+
+class HostsUpdatedRequest:
+    def __init__(self, timestamp: int, res: int = HostUpdateResult.added):
+        self.timestamp = timestamp
+        self.res = res
+
+
+class WorkerNotificationService(network.BasicService):
+    """Listens inside the worker for driver pushes (reference
+    worker.py:40-74)."""
+
+    def __init__(self, key: bytes, manager: "WorkerNotificationManager"):
+        super().__init__("worker notification service", key)
+        self._manager = manager
+
+    def _handle(self, req, client_address):
+        if isinstance(req, HostsUpdatedRequest):
+            self._manager.handle_hosts_updated(req.timestamp, req.res)
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+
+class WorkerNotificationClient(network.BasicClient):
+    """Driver-side handle to one worker's notification service."""
+
+    def notify_hosts_updated(self, timestamp: int, res: int) -> None:
+        self._send(HostsUpdatedRequest(timestamp, res))
+
+
+class WorkerNotificationManager:
+    """Worker-global singleton: registered States get host-update events
+    (reference worker.py:77-130)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._service: Optional[WorkerNotificationService] = None
+        self._listeners: List[object] = []
+
+    def init(self, key: bytes) -> WorkerNotificationService:
+        with self._lock:
+            if self._service is None:
+                self._service = WorkerNotificationService(key, self)
+            return self._service
+
+    @property
+    def service(self) -> Optional[WorkerNotificationService]:
+        return self._service
+
+    def register_listener(self, state) -> None:
+        with self._lock:
+            self._listeners.append(state)
+
+    def remove_listener(self, state) -> None:
+        with self._lock:
+            if state in self._listeners:
+                self._listeners.remove(state)
+
+    def handle_hosts_updated(self, timestamp: int, res: int) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for state in listeners:
+            state.on_hosts_updated(timestamp, res)
+
+
+notification_manager = WorkerNotificationManager()

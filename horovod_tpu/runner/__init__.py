@@ -16,13 +16,8 @@ from typing import Any, Callable, List, Optional
 from .hosts import get_host_assignments, parse_host_files, parse_hosts
 from .http_server import KVStoreServer
 from .launch import run_commandline  # noqa: F401
+from .network import find_free_port
 from .static_run import launch_static
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
 
 
 def _dumps_call(func, args: tuple, kwargs: dict) -> bytes:
@@ -64,7 +59,10 @@ def run(func: Callable[..., Any],
         host_infos = parse_hosts(f"localhost:{np}")
     slots = get_host_assignments(host_infos, np)
 
-    kv = KVStoreServer()
+    from . import secret
+
+    token = secret.make_secret_key().hex()
+    kv = KVStoreServer(auth_token=token)
     kv_port = kv.start_server()
     kv.store.put("runfunc", "func", _dumps_call(func, args, kwargs))
 
@@ -80,9 +78,10 @@ def run(func: Callable[..., Any],
                addr, str(kv_port)]
     base_env = dict(env if env is not None else os.environ)
     base_env.setdefault("PYTHONPATH", os.pathsep.join(p for p in sys.path if p))
+    base_env["HOROVOD_KV_TOKEN"] = token
 
     try:
-        launch_static(command, slots, controller_port=_free_port(),
+        launch_static(command, slots, controller_port=find_free_port(),
                       rendezvous_port=kv_port, env=base_env, verbose=verbose)
         results: List[Any] = []
         import pickle

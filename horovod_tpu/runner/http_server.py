@@ -29,7 +29,27 @@ class _KVHandler(BaseHTTPRequestHandler):
         key = parts[1] if len(parts) > 1 else ""
         return scope, key
 
+    def _authorized(self) -> bool:
+        """Workers unpickle what they GET here, so every verb requires the
+        job's shared token (same trust model as the HMAC-signed RPC in
+        network.py; the reference's rendezvous relies on network isolation,
+        we don't)."""
+        token = self.server.auth_token  # type: ignore[attr-defined]
+        if token is None:
+            return True
+        import hmac as _hmac
+
+        supplied = self.headers.get("X-Hvd-Auth", "")
+        return _hmac.compare_digest(supplied, token)
+
+    def _deny(self) -> None:
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_GET(self):  # noqa: N802
+        if not self._authorized():
+            return self._deny()
         scope, key = self._split()
         value = self.server.store.get(scope, key)  # type: ignore[attr-defined]
         if value is None:
@@ -43,6 +63,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.wfile.write(value)
 
     def do_PUT(self):  # noqa: N802
+        if not self._authorized():
+            return self._deny()
         scope, key = self._split()
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
@@ -52,6 +74,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):  # noqa: N802
+        if not self._authorized():
+            return self._deny()
         scope, key = self._split()
         self.server.store.delete(scope, key)  # type: ignore[attr-defined]
         self.send_response(200)
@@ -110,16 +134,23 @@ class _Store:
 
 
 class KVStoreServer:
-    """In-process HTTP KV server (reference http_server.py:139-235)."""
+    """In-process HTTP KV server (reference http_server.py:139-235).
 
-    def __init__(self) -> None:
+    ``auth_token``: shared secret required in the ``X-Hvd-Auth`` header of
+    every request (exported to workers as ``HOROVOD_KV_TOKEN``); ``None``
+    disables the check (single-machine tests only).
+    """
+
+    def __init__(self, auth_token: Optional[str] = None) -> None:
         self.store = _Store()
+        self.auth_token = auth_token
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def start_server(self) -> int:
         self._httpd = ThreadingHTTPServer(("0.0.0.0", 0), _KVHandler)
         self._httpd.store = self.store  # type: ignore[attr-defined]
+        self._httpd.auth_token = self.auth_token  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
@@ -145,8 +176,9 @@ class RendezvousServer(KVStoreServer):
     ``<hostname>:<local_rank>``; elastic workers GET it to learn their new
     identity after a reset (elastic/rendezvous.py:37-42)."""
 
-    def __init__(self, verbose: int = 0) -> None:
-        super().__init__()
+    def __init__(self, verbose: int = 0,
+                 auth_token: Optional[str] = None) -> None:
+        super().__init__(auth_token)
         self._verbose = verbose
 
     def init(self, host_alloc_plan) -> None:
@@ -162,13 +194,21 @@ class RendezvousServer(KVStoreServer):
         self.shutdown_server()
 
 
+def _auth_headers() -> dict:
+    import os
+
+    token = os.environ.get("HOROVOD_KV_TOKEN")
+    return {"X-Hvd-Auth": token} if token else {}
+
+
 def read_data_from_kvstore(addr: str, port: int, scope: str, key: str):
     """Poll-free GET helper (reference runner/util/network.py)."""
     import pickle
     import urllib.request
 
     url = f"http://{addr}:{port}/{scope}/{key}"
-    with urllib.request.urlopen(url) as resp:
+    req = urllib.request.Request(url, headers=_auth_headers())
+    with urllib.request.urlopen(req) as resp:
         return pickle.loads(resp.read())
 
 
@@ -178,5 +218,6 @@ def put_data_into_kvstore(addr: str, port: int, scope: str, key: str,
     import urllib.request
 
     url = f"http://{addr}:{port}/{scope}/{key}"
-    req = urllib.request.Request(url, data=pickle.dumps(value), method="PUT")
+    req = urllib.request.Request(url, data=pickle.dumps(value), method="PUT",
+                                 headers=_auth_headers())
     urllib.request.urlopen(req).read()

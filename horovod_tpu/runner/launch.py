@@ -24,20 +24,14 @@ from __future__ import annotations
 
 import argparse
 import os
-import socket
 import sys
 from typing import List, Optional
 
 from . import config_parser
 from .hosts import get_host_assignments, parse_host_files, parse_hosts
 from .http_server import RendezvousServer
+from .network import find_free_port
 from .static_run import launch_static
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
 
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
@@ -45,7 +39,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="hvdrun",
         description="Launch a horovod_tpu distributed job "
                     "(horovodrun-compatible CLI)")
-    parser.add_argument("-v", "--version", action="store_true",
+    parser.add_argument("--version", action="store_true",
                         help="print version and exit")
     parser.add_argument("-np", "--num-proc", type=int, dest="np",
                         help="total number of worker processes")
@@ -53,7 +47,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="host:slots pairs, comma separated")
     parser.add_argument("--hostfile", dest="hostfile",
                         help="mpirun-style hostfile (host slots=N)")
-    parser.add_argument("--verbose", action="count", default=0,
+    parser.add_argument("-v", "--verbose", action="count", default=0,
                         help="-v for launcher logs, -vv for per-slot commands")
     parser.add_argument("--disable-cache", action="store_true",
                         dest="disable_cache",
@@ -137,6 +131,8 @@ def _validate(args) -> None:
         if not args.host_discovery_script and not (args.hosts or args.hostfile):
             raise ValueError(
                 "elastic jobs need --host-discovery-script (or fixed -H)")
+        if args.min_np is None and args.np is None:
+            raise ValueError("elastic jobs need --min-np (or -np)")
     config_parser.validate_config_args(args)
 
 
@@ -157,15 +153,19 @@ def _get_hosts(args, np_: int):
 
 
 def _run_static(args) -> None:
+    from . import secret
+
     hosts = _get_hosts(args, args.np)
     slots = get_host_assignments(hosts, args.np)
     env = _build_env(args)
-    rendezvous = RendezvousServer(verbose=args.verbose)
+    token = secret.make_secret_key().hex()
+    env["HOROVOD_KV_TOKEN"] = token
+    rendezvous = RendezvousServer(verbose=args.verbose, auth_token=token)
     rendezvous_port = rendezvous.start_server()
     rendezvous.init(slots)
     try:
         launch_static(args.command, slots,
-                      controller_port=_free_port(),
+                      controller_port=find_free_port(),
                       rendezvous_port=rendezvous_port,
                       env=env, verbose=args.verbose)
     finally:

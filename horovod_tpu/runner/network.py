@@ -25,6 +25,16 @@ from .secret import DIGEST_LENGTH_BYTES
 _LEN = struct.Struct(">I")
 
 
+def find_free_port() -> int:
+    """Probe a free TCP port on this machine. NOTE: only authoritative for
+    sockets bound locally — a port handed to a *remote* host may be taken
+    there; callers on remote paths must tolerate bind failure (the elastic
+    driver allocates a fresh port per world incarnation for this reason)."""
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
 class PingRequest:
     pass
 

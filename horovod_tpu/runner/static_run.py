@@ -25,13 +25,6 @@ from typing import Dict, List, Optional, Sequence
 from . import safe_shell_exec
 from .hosts import SlotInfo
 
-# Env vars forwarded from the launcher environment to workers, beyond the
-# explicitly injected contract (reference gloo_run.py:65-101 forwards the
-# whole env; we forward everything except per-slot overrides too).
-_SLOT_ENV = ("HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
-             "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
-             "HOROVOD_HOSTNAME")
-
 SSH_COMMAND_PREFIX = "ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no"
 
 
@@ -62,12 +55,13 @@ def slot_env(slot: SlotInfo, controller_addr: str, controller_port: int,
     return env
 
 
-def get_run_command(command: Sequence[str], slot: SlotInfo,
+def get_run_command(command: Sequence[str], hostname: str,
                     env: Dict[str, str]) -> str:
     """Build the shell command for one slot; remote slots are wrapped in ssh
-    with the env contract inlined (reference gloo_run.py:133-178)."""
+    with the env contract inlined (reference gloo_run.py:133-178). Shared by
+    the static and elastic launchers."""
     cmd = " ".join(shlex.quote(c) for c in command)
-    if is_local_host(slot.hostname):
+    if is_local_host(hostname):
         return cmd
     # ssh: env does not propagate, so inline every HOROVOD_* knob (the
     # launcher-built tuning env included) plus the interpreter basics —
@@ -76,8 +70,8 @@ def get_run_command(command: Sequence[str], slot: SlotInfo,
     keys = sorted(k for k in env
                   if k.startswith("HOROVOD_") or k in ("PATH", "PYTHONPATH"))
     exported = " ".join(f"{k}={shlex.quote(env[k])}" for k in keys)
-    return (f"{SSH_COMMAND_PREFIX} {slot.hostname} "
-            f"{shlex.quote(f'cd {os.getcwd()} ; env {exported} {cmd}')}")
+    remote = f"cd {shlex.quote(os.getcwd())} ; env {exported} {cmd}"
+    return f"{SSH_COMMAND_PREFIX} {hostname} {shlex.quote(remote)}"
 
 
 def launch_static(command: Sequence[str], slots: List[SlotInfo],
@@ -104,7 +98,7 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
     def _run_slot(slot: SlotInfo) -> None:
         senv = slot_env(slot, controller_addr, controller_port,
                         rendezvous_port, base_env=env)
-        cmd = get_run_command(command, slot, senv)
+        cmd = get_run_command(command, slot.hostname, senv)
         if verbose >= 2:
             print(f"[launcher] rank {slot.rank} on {slot.hostname}: {cmd}",
                   file=sys.stderr)

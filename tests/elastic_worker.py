@@ -1,0 +1,76 @@
+"""Elastic integration worker (reference analogue: the training scripts in
+test/integration/data/ driven by elastic_common.py).
+
+Trains a toy objective under ``hvd.elastic.run``, logging one JSON line per
+batch to --log-file: {identity, rank, size, batch, value}. Fault injection
+via --exit-at "<hostname>:<local_rank>:<batch>" (os._exit(1), simulating a
+hard crash mid-epoch, reference elastic_common.py --exit-schedule).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log-file", required=True)
+    p.add_argument("--batches", type=int, default=10)
+    p.add_argument("--batch-sleep", type=float, default=0.1)
+    p.add_argument("--exit-at", default=None,
+                   help="hostname:local_rank:batch hard-crash injection")
+    args = p.parse_args()
+
+    identity = (f"{os.environ['HOROVOD_HOSTNAME']}:"
+                f"{os.environ['HOROVOD_LOCAL_RANK']}")
+    exit_at = None
+    if args.exit_at:
+        h, lr, b = args.exit_at.rsplit(":", 2)
+        if identity == f"{h}:{lr}":
+            exit_at = int(b)
+
+    def log(record):
+        record["identity"] = identity
+        with open(args.log_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    @elastic.run
+    def train(state):
+        while state.batch < args.batches:
+            # A real collective every step so peer failure surfaces as
+            # HorovodInternalError and state stays world-consistent.
+            contrib = jnp.full((4,), 1.0)
+            total = hvd.allreduce(contrib, op=hvd.Sum,
+                                  name=f"train.step.{state.batch}")
+            assert np.allclose(total, hvd.size()), (total, hvd.size())
+            state.weights = state.weights + float(total[0])
+            state.batch += 1
+            if exit_at is not None and state.batch == exit_at:
+                os._exit(1)
+            log({"rank": hvd.rank(), "size": hvd.size(),
+                 "batch": state.batch, "weights": state.weights})
+            state.commit()
+            time.sleep(args.batch_sleep)
+
+    state = elastic.ObjectState(batch=0, weights=0.0)
+    train(state)
+    log({"rank": hvd.rank(), "size": hvd.size(), "done": True,
+         "weights": state.weights})
+
+
+if __name__ == "__main__":
+    main()

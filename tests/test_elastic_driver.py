@@ -1,0 +1,280 @@
+"""ElasticDriver unit tests with fake discovery and mocked workers
+(reference: test/single/test_elastic_driver.py — simulates multi-node
+without any cluster)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import (
+    FixedHosts,
+    HostDiscovery,
+    HostDiscoveryScript,
+    HostManager,
+    HostUpdateResult,
+)
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.elastic.registration import FAILURE, SUCCESS
+from horovod_tpu.elastic.sampler import ElasticSampler
+
+
+class MutableDiscovery(HostDiscovery):
+    def __init__(self, hosts):
+        self.hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self):
+        return dict(self.hosts)
+
+
+@pytest.fixture(autouse=True)
+def _fast_discovery(monkeypatch):
+    from horovod_tpu.elastic import constants
+
+    monkeypatch.setattr(constants, "DISCOVER_HOSTS_FREQUENCY_SECS", 0.05)
+
+
+class TestHostManager:
+    def test_update_detects_added_and_removed(self):
+        disc = MutableDiscovery({"a": 2})
+        mgr = HostManager(disc)
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        assert mgr.update_available_hosts() == HostUpdateResult.no_update
+        disc.hosts = {"a": 2, "b": 1}
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        disc.hosts = {"a": 1, "c": 1}
+        res = mgr.update_available_hosts()
+        assert res == HostUpdateResult.mixed
+        disc.hosts = {"a": 1}
+        assert mgr.update_available_hosts() == HostUpdateResult.removed
+
+    def test_blacklist_hides_host(self):
+        disc = MutableDiscovery({"a": 2, "b": 2})
+        mgr = HostManager(disc)
+        mgr.update_available_hosts()
+        mgr.blacklist("b")
+        assert mgr.current_hosts == {"a": 2}
+        # blacklisted host coming back is still hidden
+        assert mgr.update_available_hosts() == HostUpdateResult.no_update
+
+    def test_discovery_script(self, tmp_path):
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho h1:2\necho h2\n")
+        script.chmod(0o755)
+        disc = HostDiscoveryScript(str(script), default_slots=4)
+        assert disc.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
+
+
+def _wait(predicate, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class RecordingWorkers:
+    """create_worker_fn that keeps workers 'running' until told to exit
+    (reference mocks workers the same way)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.spawned = []           # (host, local_rank, world_id)
+        self.exit_codes = {}        # (host, local_rank) → code to return
+        self.events = {}            # (host, local_rank) → Event
+
+    def __call__(self, slot, world_id):
+        key = (slot.hostname, slot.local_rank)
+        with self.lock:
+            self.spawned.append((slot.hostname, slot.local_rank, world_id))
+            ev = self.events.setdefault(key, threading.Event())
+        ev.wait(timeout=30)
+        with self.lock:
+            return self.exit_codes.get(key, 0)
+
+    def finish(self, host, local_rank, code=0):
+        key = (host, local_rank)
+        with self.lock:
+            self.exit_codes[key] = code
+            ev = self.events.setdefault(key, threading.Event())
+        ev.set()
+        with self.lock:
+            self.events[key] = threading.Event()  # re-arm for respawn
+
+
+class TestElasticDriver:
+    def test_initial_world_spawns_all_slots(self):
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 2, "b": 2}), min_np=4)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 4, msg="4 workers")
+            assert driver.world_id == 0
+            slots = driver.current_assignments()
+            assert [s.rank for s in slots] == [0, 1, 2, 3]
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_min_np_not_met_raises(self):
+        driver = ElasticDriver(FixedHosts({"a": 1}), min_np=2)
+        with pytest.raises((RuntimeError, TimeoutError)):
+            driver.wait_for_available_slots(2, timeout=0.2)
+        driver.stop()
+        driver.shutdown_service()
+
+    def test_worker_failure_blacklists_and_resumes(self):
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 2, "b": 1}), min_np=2,
+                               max_np=3)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 3, msg="initial spawn")
+            workers.finish("b", 0, code=1)  # worker on b dies
+            _wait(lambda: driver.host_manager.is_blacklisted("b"),
+                  msg="blacklist")
+            _wait(lambda: driver.world_id == 1, msg="resume")
+            # New world excludes b; a's two live workers keep their slots and
+            # re-rendezvous (no respawn needed).
+            slots = driver.current_assignments()
+            assert {s.hostname for s in slots} == {"a"}
+            assert driver.registry.total_count(FAILURE) == 1
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_host_added_triggers_new_world_and_spawn(self):
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=4)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 1, msg="first worker")
+            disc.hosts = {"a": 1, "b": 1}
+            _wait(lambda: driver.world_id == 1, msg="world grows")
+            _wait(lambda: ("b", 0, 1) in workers.spawned,
+                  msg="worker spawned on b")
+            assert len(driver.current_assignments()) == 2
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_rank0_stays_on_surviving_host(self):
+        """A newly-added host must not become rank 0 (state broadcast
+        source)."""
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"m": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=4)
+        try:
+            driver.start(workers)
+            _wait(lambda: driver.world_id == 0, msg="start")
+            disc.hosts = {"a": 1, "m": 1}  # 'a' sorts before 'm'
+            _wait(lambda: driver.world_id == 1, msg="resume")
+            slots = driver.current_assignments()
+            rank0 = next(s for s in slots if s.rank == 0)
+            assert rank0.hostname == "m"
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_success_finishes_job(self):
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 2}), min_np=2)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            workers.finish("a", 0, code=0)
+            workers.finish("a", 1, code=0)
+            assert driver.join(timeout=10)
+            assert driver.registry.count(SUCCESS) == 2
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_reset_limit_stops_job(self):
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 1, "b": 1, "c": 1}),
+                               min_np=1, max_np=3, reset_limit=1)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 3, msg="spawn")
+            workers.finish("a", 0, code=1)
+            _wait(lambda: driver.world_id == 1, msg="first reset")
+            workers.finish("b", 0, code=1)
+            workers.finish("c", 0, code=1)
+            driver.join(timeout=10)
+            assert driver.registry.reset_count >= 1
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class TestShrinkRelease:
+    def test_released_worker_is_not_a_success(self):
+        """A worker released by a shrink exits 0 but must not mark the job
+        successful (its func never completed)."""
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 1, "b": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=2)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            disc.hosts = {"a": 1}  # graceful shrink: b removed, not failed
+            _wait(lambda: driver.world_id == 1, msg="shrink world")
+            # b's worker re-rendezvouses and is told to shut down
+            resp = driver.get_slot_info("b", 0, min_world_id=1)
+            assert resp.status == "shutdown"
+            workers.finish("b", 0, code=0)
+            _wait(lambda: ("b", 0) not in driver._live_workers,
+                  msg="b exits")
+            assert driver.registry.total_count(SUCCESS) == 0
+            assert not driver.join(timeout=0.5)  # job is NOT finished
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class TestGetSlotProtocol:
+    def test_waiting_then_ok_then_shutdown(self):
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 1, "b": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=2)
+        try:
+            driver.start(workers)
+            # current world is 0: a request for world >= 1 waits
+            resp = driver.get_slot_info("a", 0, min_world_id=1)
+            assert resp.status == "waiting"
+            resp = driver.get_slot_info("a", 0, min_world_id=0)
+            assert resp.status == "ok"
+            assert resp.slot["rank"] in (0, 1)
+            assert resp.controller_port > 0
+            # unknown slot → shutdown signal
+            resp = driver.get_slot_info("zzz", 5, min_world_id=0)
+            assert resp.status == "shutdown"
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class TestElasticSampler:
+    def test_shards_and_records(self):
+        s = ElasticSampler(dataset_size=20, shuffle=False, rank=0, size=1)
+        assert len(s) == 20
+        s.record_batch(0, 5)
+        assert len(s.processed_indices) == 5
+        s.reset()
+        assert len(s) == 15
+        assert set(s.indices).isdisjoint(s.processed_indices)
+
+    def test_state_dict_roundtrip(self):
+        s = ElasticSampler(dataset_size=10, shuffle=False, rank=0, size=1)
+        s.record_batch(0, 4)
+        st = s.state_dict()
+        s2 = ElasticSampler(dataset_size=10, shuffle=False, rank=0, size=1)
+        s2.load_state_dict(st)
+        assert s2.processed_indices == s.processed_indices
+        s2.set_epoch(1)
+        assert s2.processed_indices == set()
+        assert len(s2) == 10

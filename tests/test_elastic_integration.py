@@ -1,0 +1,166 @@
+"""Elastic end-to-end integration (reference:
+test/integration/elastic_common.py + test_elastic.py — real worker
+processes on localhost, scripted host churn, hard-crash fault injection)."""
+
+import json
+import os
+import shlex
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic import constants
+from horovod_tpu.elastic.discovery import HostDiscoveryScript
+from horovod_tpu.elastic.driver import ElasticDriver
+from horovod_tpu.runner import safe_shell_exec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def _read_log(path):
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def _make_local_exec(extra_args, log_file):
+    """create_worker_fn that always executes locally regardless of the
+    (possibly fake) hostname — the reference mocks ssh the same way."""
+
+    def _exec(slot, world_id):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+            "HOROVOD_ELASTIC_DRIVER_PORT": str(_exec.driver.service_port),
+            "HOROVOD_ELASTIC_DRIVER_KEY": _exec.driver.key.hex(),
+            # fail world formation fast so the retry path, not the 120 s
+            # default, bounds test time
+            "HOROVOD_START_TIMEOUT": "30",
+        })
+        cmd = " ".join(shlex.quote(c) for c in [
+            sys.executable, WORKER, "--log-file", log_file, *extra_args])
+        return safe_shell_exec.execute(cmd, env=env)
+
+    return _exec
+
+
+@pytest.fixture(autouse=True)
+def _fast_discovery(monkeypatch):
+    monkeypatch.setattr(constants, "DISCOVER_HOSTS_FREQUENCY_SECS", 0.25)
+
+
+def _run_driver(discovery, exec_fn, min_np, max_np, timeout=240,
+                reset_limit=None):
+    driver = ElasticDriver(discovery, min_np=min_np, max_np=max_np,
+                           reset_limit=reset_limit,
+                           controller_addr_override="127.0.0.1")
+    exec_fn.driver = driver
+    try:
+        driver.start(exec_fn)
+        ok = driver.join(timeout=timeout)
+        return driver, ok
+    finally:
+        driver.stop()
+        driver.shutdown_service()
+
+
+class TestElasticGrowth:
+    def test_world_grows_when_host_added(self, tmp_path):
+        """Start with 1 slot; add a second host mid-run; workers must
+        re-rendezvous into a world of 2 and finish."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("hostA:1\n")
+        script = tmp_path / "discover.sh"
+        script.write_text(f"#!/bin/sh\ncat {hosts_file}\n")
+        script.chmod(0o755)
+        log_file = str(tmp_path / "log.jsonl")
+
+        def _grow():
+            # wait until training is underway, then add capacity
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if len(_read_log(log_file)) >= 2:
+                    hosts_file.write_text("hostA:1\nhostB:1\n")
+                    return
+                time.sleep(0.1)
+
+        grower = threading.Thread(target=_grow, daemon=True)
+        grower.start()
+        exec_fn = _make_local_exec(
+            ["--batches", "14", "--batch-sleep", "0.3"], log_file)
+        driver, ok = _run_driver(HostDiscoveryScript(str(script), 1),
+                                 exec_fn, min_np=1, max_np=2)
+        assert ok, _read_log(log_file)
+        records = _read_log(log_file)
+        sizes = {r["size"] for r in records}
+        assert 1 in sizes and 2 in sizes, sizes
+        done = [r for r in records if r.get("done")]
+        assert len(done) == 2, done
+        # allreduce contract held in both worlds: weights grew by `size`
+        # per batch and every finisher agrees (synced via rank-0 broadcast).
+        assert len({r["weights"] for r in done}) == 1, done
+
+    def test_worker_crash_rolls_back_and_continues(self, tmp_path):
+        """3 slots on 2 (fake) hosts; the hostB worker hard-crashes at batch
+        3. hostB is blacklisted, survivors restore from the last commit and
+        finish in a world of 2."""
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho hostA:2\necho hostB:1\n")
+        script.chmod(0o755)
+        log_file = str(tmp_path / "log.jsonl")
+        exec_fn = _make_local_exec(
+            ["--batches", "10", "--batch-sleep", "0.2",
+             "--exit-at", "hostB:0:3"], log_file)
+        driver, ok = _run_driver(HostDiscoveryScript(str(script), 1),
+                                 exec_fn, min_np=2, max_np=3)
+        assert ok, _read_log(log_file)
+        assert driver.host_manager.is_blacklisted("hostB")
+        records = _read_log(log_file)
+        done = [r for r in records if r.get("done")]
+        assert len(done) == 2, done
+        assert all(r["size"] == 2 for r in done), done
+        # crashed worker must not have logged past its injection point
+        b_records = [r for r in records
+                     if r["identity"] == "hostB:0" and "batch" in r]
+        assert all(r["batch"] < 3 for r in b_records), b_records
+        assert len({r["weights"] for r in done}) == 1, done
+
+
+class TestElasticCLI:
+    def test_hvdrun_elastic_localhost(self, tmp_path):
+        """Full CLI path: hvdrun --min-np 2 --host-discovery-script
+        (reference: test_elastic.py driving _run_elastic)."""
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:2\n")
+        script.chmod(0o755)
+        log_file = str(tmp_path / "log.jsonl")
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = REPO
+        env["HOROVOD_ELASTIC_DISCOVER_HOSTS_FREQUENCY_SECS"] = "0.25"
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner",
+             "--min-np", "2", "--max-np", "2",
+             "--host-discovery-script", str(script),
+             sys.executable, WORKER, "--log-file", log_file,
+             "--batches", "4", "--batch-sleep", "0.05"],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        done = [r for r in _read_log(log_file) if r.get("done")]
+        assert len(done) == 2, _read_log(log_file)
+        assert all(r["size"] == 2 for r in done)

@@ -145,14 +145,14 @@ class TestSlotEnv:
     def test_remote_command_uses_ssh(self):
         slot = hosts_mod.SlotInfo("farhost", 0, 0, 0, 2, 1, 2)
         env = slot_env(slot, "farhost", 4567, base_env={"PATH": "/bin"})
-        cmd = get_run_command(["python", "t.py"], slot, env)
+        cmd = get_run_command(["python", "t.py"], slot.hostname, env)
         assert cmd.startswith("ssh ")
         assert "HOROVOD_RANK=0" in cmd
 
     def test_local_command_plain(self):
         slot = hosts_mod.SlotInfo("localhost", 0, 0, 0, 1, 1, 1)
         env = slot_env(slot, "127.0.0.1", 4567, base_env={})
-        cmd = get_run_command(["python", "t.py"], slot, env)
+        cmd = get_run_command(["python", "t.py"], slot.hostname, env)
         assert cmd == "python t.py"
 
 
@@ -202,6 +202,28 @@ class TestKVStore:
             put_data_into_kvstore("127.0.0.1", port, "s", "k", {"a": 1})
             assert read_data_from_kvstore("127.0.0.1", port, "s", "k") == \
                 {"a": 1}
+        finally:
+            kv.shutdown_server()
+
+    def test_auth_token_required(self):
+        kv = KVStoreServer(auth_token="s3cret")
+        port = kv.start_server()
+        try:
+            import urllib.error
+            import urllib.request
+
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/s/k").read()
+            assert exc.value.code == 403
+            # with the token (as workers get it via HOROVOD_KV_TOKEN):
+            os.environ["HOROVOD_KV_TOKEN"] = "s3cret"
+            try:
+                put_data_into_kvstore("127.0.0.1", port, "s", "k", 42)
+                assert read_data_from_kvstore("127.0.0.1", port, "s",
+                                              "k") == 42
+            finally:
+                del os.environ["HOROVOD_KV_TOKEN"]
         finally:
             kv.shutdown_server()
 
