@@ -1,0 +1,86 @@
+"""PyTorch binding for horovod_tpu.
+
+Reference surface: ``horovod/torch/__init__.py`` — init/rank/size queries,
+handle-based collective ops, DistributedOptimizer with backward hooks,
+broadcast_parameters/broadcast_optimizer_state, Compression, SyncBatchNorm,
+elastic TorchState/ElasticSampler.
+
+Torch here is a host-side framework: its tensors ride the same native C++
+controller + TCP data plane (horovod_tpu/cc/) as the eager JAX API, so torch
+processes participate in the same world as JAX training processes.
+
+Usage (the reference's README recipe)::
+
+    import torch
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    model = ...
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * hvd.size())
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    for batch in loader:
+        optimizer.zero_grad()
+        loss = model(batch).loss
+        loss.backward()
+        optimizer.step()
+"""
+
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    shutdown,
+)
+from ..common import basics as _basics
+
+
+def rank() -> int:
+    """Global rank of this process (reference: torch → horovod_rank)."""
+    return int(_basics.rank())
+
+
+def size() -> int:
+    """World size (reference: torch → horovod_size)."""
+    return int(_basics.size())
+
+
+from .compression import Compression  # noqa: F401,E402
+from .functions import (  # noqa: F401,E402
+    allgather_object,
+    broadcast_object,
+    broadcast_optimizer_state,
+    broadcast_parameters,
+)
+from .mpi_ops import (  # noqa: F401,E402
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allgather_async,
+    allreduce,
+    allreduce_,
+    allreduce_async,
+    allreduce_async_,
+    alltoall,
+    alltoall_async,
+    broadcast,
+    broadcast_,
+    broadcast_async,
+    broadcast_async_,
+    join,
+    poll,
+    synchronize,
+)
+from .optimizer import DistributedOptimizer  # noqa: F401,E402
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
+from . import elastic  # noqa: F401,E402

@@ -1,0 +1,66 @@
+"""Gradient compression for torch tensors.
+
+Reference: ``horovod/torch/compression.py`` — fp16 cast before allreduce,
+cast back after. On TPU-adjacent hosts bf16 is the natural wire format (same
+exponent range as fp32, native MXU dtype), so a ``bf16`` compressor is added
+beyond the reference's fp16.
+"""
+
+import torch
+
+
+class Compressor:
+    """Interface: compress(tensor) -> (tensor, ctx); decompress(tensor, ctx)
+    (reference: compression.py:23-34)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    """Identity (reference: compression.py:37-47)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype: torch.dtype = torch.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tensor.to(ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    """Reference: compression.py:50-69."""
+    wire_dtype = torch.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native wire format (no reference analogue; bf16 keeps fp32's
+    exponent range so gradient overflow handling is unnecessary)."""
+    wire_dtype = torch.bfloat16
+
+
+class Compression:
+    """Namespace mirroring the reference (compression.py:72-78)."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

@@ -1,0 +1,217 @@
+"""Elastic training state for PyTorch.
+
+Reference: ``horovod/torch/elastic/state.py`` (TorchState with per-attribute
+handlers, state.py:27-179) and ``horovod/torch/elastic/sampler.py``
+(ElasticSampler re-sharding remaining samples on world change).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict
+
+import torch
+
+from ..elastic.state import State
+from ..elastic import run as run  # noqa: F401  (hvd.elastic.run parity)
+from . import functions as _fn
+from . import mpi_ops
+
+
+class TorchState(State):
+    """Elastic state holding torch models/optimizers plus scalar attrs
+    (reference: torch/elastic/state.py:27-118). Usage::
+
+        state = hvd.elastic.TorchState(model=model, optimizer=opt, epoch=0)
+
+    ``save``/``restore`` keep in-memory copies; ``sync`` broadcasts from the
+    new rank 0 after a reset.
+    """
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        self._handlers: Dict[str, "_StateHandler"] = {}
+        if model is not None:
+            self._handlers["model"] = _ModelStateHandler(model)
+            self.model = model
+        if optimizer is not None:
+            self._handlers["optimizer"] = _OptimizerStateHandler(optimizer)
+            self.optimizer = optimizer
+        self._obj_attrs = dict(kwargs)
+        for k, v in kwargs.items():
+            if isinstance(v, torch.nn.Module):
+                self._handlers[k] = _ModelStateHandler(v)
+            elif isinstance(v, torch.optim.Optimizer):
+                self._handlers[k] = _OptimizerStateHandler(v)
+            elif hasattr(v, "state_dict") and hasattr(v, "load_state_dict"):
+                self._handlers[k] = _SamplerStateHandler(v)
+            setattr(self, k, v)
+        self._saved_obj_state = {}
+        super().__init__()
+        self.save()
+
+    def _plain_keys(self):
+        return [k for k in self._obj_attrs if k not in self._handlers]
+
+    def save(self) -> None:
+        for handler in self._handlers.values():
+            handler.save()
+        self._saved_obj_state = {
+            k: copy.deepcopy(getattr(self, k)) for k in self._plain_keys()}
+
+    def restore(self) -> None:
+        for handler in self._handlers.values():
+            handler.restore()
+        for k, v in self._saved_obj_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        for handler in self._handlers.values():
+            handler.sync()
+        plain = {k: getattr(self, k) for k in self._plain_keys()}
+        if plain:
+            synced = _fn.broadcast_object(plain, root_rank=0,
+                                          name="elastic.torch_state")
+            for k, v in synced.items():
+                setattr(self, k, v)
+        self.save()
+
+    def __setattr__(self, name, value):
+        # Keep handlers pointed at replaced modules/optimizers
+        # (reference: state.py:96-108 __setattr__ hook).
+        if not name.startswith("_") and hasattr(self, "_handlers") \
+                and name in self._handlers:
+            self._handlers[name].set_value(value)
+        super().__setattr__(name, value)
+
+
+class _StateHandler:
+    def __init__(self, value):
+        self.value = value
+
+    def set_value(self, value):
+        self.value = value
+        self.save()
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+
+class _ModelStateHandler(_StateHandler):
+    """Reference: torch/elastic/state.py:121-140."""
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        _fn.broadcast_parameters(self.value.state_dict(), root_rank=0)
+        self.save()
+
+
+class _OptimizerStateHandler(_StateHandler):
+    """Reference: torch/elastic/state.py:143-160."""
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        _fn.broadcast_optimizer_state(self.value, root_rank=0)
+        self.save()
+
+
+class _SamplerStateHandler(_StateHandler):
+    """Reference: torch/elastic/state.py:163-179."""
+
+    def save(self):
+        self._saved_state = copy.deepcopy(self.value.state_dict())
+
+    def restore(self):
+        self.value.load_state_dict(copy.deepcopy(self._saved_state))
+
+    def sync(self):
+        state = _fn.broadcast_object(self.value.state_dict(), root_rank=0,
+                                     name="elastic.sampler_state")
+        self.value.load_state_dict(state)
+        self.save()
+
+
+class ElasticSampler(torch.utils.data.Sampler):
+    """Distributed sampler that re-shards *remaining* (unprocessed) samples
+    when the world changes (reference: torch/elastic/sampler.py)."""
+
+    def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices = set()
+        self.num_replicas = 0
+        self.rank = 0
+        self.remaining_indices = []
+        self.num_samples = 0
+        self.total_size = 0
+        self.reset()
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices = set()
+        self.reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark a batch consumed so a post-reset reshard skips it."""
+        processed = self.indices[batch_idx * batch_size:
+                                 (batch_idx + 1) * batch_size]
+        self.processed_indices.update(processed)
+
+    def state_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "processed_indices": self.processed_indices,
+        }
+
+    def load_state_dict(self, state_dict: dict) -> None:
+        self.epoch = state_dict["epoch"]
+        self.processed_indices = set(state_dict["processed_indices"])
+        self.reset()
+
+    def reset(self) -> None:
+        self.num_replicas = mpi_ops._world() \
+            if _initialized() else 1
+        self.rank = mpi_ops.rank() if _initialized() else 0
+
+        remaining = [idx for idx in range(len(self.dataset))
+                     if idx not in self.processed_indices]
+        if self.shuffle:
+            g = torch.Generator()
+            g.manual_seed(self.seed + self.epoch)
+            perm = torch.randperm(len(remaining), generator=g).tolist()
+            remaining = [remaining[i] for i in perm]
+        self.remaining_indices = remaining
+
+        self.num_samples = len(self.remaining_indices) // self.num_replicas
+        self.total_size = self.num_samples * self.num_replicas
+        shard = self.remaining_indices[:self.total_size]
+        self.indices = shard[self.rank:self.total_size:self.num_replicas]
+
+    def __iter__(self):
+        return iter(self.indices)
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+
+def _initialized() -> bool:
+    from ..common import basics
+
+    return basics.is_initialized()

@@ -1,0 +1,137 @@
+"""State synchronization helpers for PyTorch.
+
+Reference: ``horovod/torch/functions.py`` — ``broadcast_parameters``
+(functions.py:30-68), ``broadcast_optimizer_state`` (functions.py:70-160),
+``broadcast_object`` / ``allgather_object`` via cloudpickle→byte tensor.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, List
+
+import numpy as np
+import torch
+
+from . import mpi_ops
+from .mpi_ops import broadcast_, synchronize, broadcast_async_
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast model parameters from ``root_rank`` to every rank, in
+    place. Accepts a ``state_dict`` or an iterable of ``(name, tensor)``
+    (reference: functions.py:30-68)."""
+    if isinstance(params, dict):
+        params = sorted(params.items())
+    elif isinstance(params, list):
+        params = sorted(params)
+    else:
+        params = sorted(list(params))
+
+    handles = []
+    for name, p in params:
+        if p is None:
+            continue
+        if not torch.is_tensor(p):
+            raise ValueError(f"invalid param type {type(p)} for {name}")
+        handles.append(broadcast_async_(p, root_rank, name=f"bp.{name}"))
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast an optimizer's full state from ``root_rank`` (reference:
+    functions.py:70-160 — tensors broadcast in place, non-tensor scalars
+    shipped as pickled objects so freshly-constructed optimizers on other
+    ranks match the root exactly)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+
+    # Newly constructed optimizers have empty state: run a dummy step on
+    # zero grads first so every rank has state entries to receive into
+    # (the reference's trick, functions.py:86-107).
+    if not state_dict["state"]:
+        for group in optimizer.param_groups:
+            for p in group["params"]:
+                if p.requires_grad and p.grad is None:
+                    p.grad = p.data.new_zeros(p.shape)
+        optimizer.step()
+        state_dict = optimizer.state_dict()
+
+    params = []
+    scalars = {}
+    for pid, pstate in state_dict["state"].items():
+        for key, value in pstate.items():
+            name = f"os.{pid}.{key}"
+            if torch.is_tensor(value):
+                params.append((name, value))
+            else:
+                scalars[name] = value
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key, value in group.items():
+            if key == "params":
+                continue
+            scalars[f"og.{gi}.{key}"] = value
+
+    broadcast_parameters(params, root_rank)
+    scalars = broadcast_object(scalars, root_rank, name="opt_scalars")
+
+    for pid, pstate in state_dict["state"].items():
+        for key in list(pstate.keys()):
+            name = f"os.{pid}.{key}"
+            if name in scalars:
+                pstate[key] = scalars[name]
+    for gi, group in enumerate(state_dict["param_groups"]):
+        for key in list(group.keys()):
+            name = f"og.{gi}.{key}"
+            if name in scalars:
+                group[key] = scalars[name]
+    optimizer.load_state_dict(state_dict)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
+    """Pickle ``obj`` on the root and broadcast it (reference:
+    functions.py:122-160 tensorflow analogue functions.py:59-134 — size
+    broadcast first, then the payload as a byte tensor)."""
+    name = name or "broadcast_object"
+    if mpi_ops._world() == 1:
+        return obj
+    if mpi_ops.rank() == root_rank:
+        buf = io.BytesIO()
+        torch.save(obj, buf)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
+    else:
+        payload = np.empty(0, dtype=np.uint8)
+    sz = torch.tensor([len(payload)], dtype=torch.int64)
+    broadcast_(sz, root_rank, name=f"{name}.sz")
+    t = torch.empty(int(sz.item()), dtype=torch.uint8)
+    if mpi_ops.rank() == root_rank:
+        t.copy_(torch.from_numpy(payload))
+    broadcast_(t, root_rank, name=f"{name}.data")
+    buf = io.BytesIO(t.numpy().tobytes())
+    return torch.load(buf, weights_only=False)
+
+
+def allgather_object(obj: Any, name: str = None) -> List[Any]:
+    """Gather a picklable object from every rank (reference:
+    tensorflow/functions.py:136-177; torch parity added in v0.21)."""
+    name = name or "allgather_object"
+    if mpi_ops._world() == 1:
+        return [obj]
+    buf = io.BytesIO()
+    torch.save(obj, buf)
+    payload = torch.from_numpy(
+        np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
+    gathered = mpi_ops.synchronize(
+        mpi_ops.allgather_async(payload, name=f"{name}.data"))
+    sizes = mpi_ops.synchronize(mpi_ops.allgather_async(
+        torch.tensor([payload.numel()], dtype=torch.int64),
+        name=f"{name}.sz"))
+    out, offset = [], 0
+    for s in sizes.tolist():
+        chunk = gathered[offset:offset + s]
+        out.append(torch.load(io.BytesIO(chunk.numpy().tobytes()),
+                              weights_only=False))
+        offset += s
+    return out

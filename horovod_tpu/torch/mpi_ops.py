@@ -1,0 +1,434 @@
+"""PyTorch collective ops over the native control plane.
+
+Reference surface: ``horovod/torch/mpi_ops.py`` (handle-based async API,
+mpi_ops.py:66-161) backed by ``torch/mpi_ops_v2.cc`` — per-dtype enqueue
+functions returning integer handles, ``synchronize`` blocking on the
+HandleManager.
+
+TPU-native redesign: torch is a *host* framework here (the compute path is
+JAX/XLA); torch tensors ride the same native C++ controller + TCP data plane
+(horovod_tpu/cc/) the eager JAX API uses, so a torch data-loading or
+fine-tuning script interoperates with JAX training processes in the same
+world. Tensors cross the boundary as zero-copy numpy views wherever torch
+allows it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+try:
+    import torch
+except ImportError as e:  # pragma: no cover - torch is in the image
+    raise ImportError(
+        "horovod_tpu.torch requires pytorch (install torch)") from e
+
+from ..common import basics
+from ..common.exceptions import DuplicateTensorNameError
+from ..ops import collective_ops as C
+from ..ops.collective_ops import ReduceOp
+
+# Reduce op handles (reference: torch/mpi_ops.py:40-48 re-exports the op
+# constants from the native module).
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+__all__ = [
+    "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+    "allgather", "allgather_async",
+    "broadcast", "broadcast_", "broadcast_async", "broadcast_async_",
+    "alltoall", "alltoall_async",
+    "join", "poll", "synchronize",
+]
+
+
+# --------------------------------------------------------------------------
+# torch <-> numpy bridges
+# --------------------------------------------------------------------------
+
+
+def _to_numpy(tensor: "torch.Tensor") -> np.ndarray:
+    """Contiguous numpy view of a torch tensor (zero-copy when possible;
+    bf16 goes over the wire bit-exact via ml_dtypes)."""
+    t = tensor.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    t = t.contiguous()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _from_numpy(arr: np.ndarray, like: "torch.Tensor") -> "torch.Tensor":
+    if like.dtype == torch.bfloat16:
+        out = torch.from_numpy(np.ascontiguousarray(arr.view(np.int16)))
+        return out.view(torch.bfloat16).to(like.device)
+    return torch.from_numpy(np.ascontiguousarray(arr)).to(like.device)
+
+
+# --------------------------------------------------------------------------
+# Handle manager (reference: torch/handle_manager.{h,cc} — int handles map to
+# in-flight collectives; synchronize pops and blocks).
+# --------------------------------------------------------------------------
+
+
+class _TorchHandleManager:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries = {}
+        self._names = set()
+        self._next = 0
+
+    def allocate(self, finisher, native_handle=None,
+                 name: Optional[str] = None) -> int:
+        with self._lock:
+            if name is not None:
+                if name in self._names:
+                    raise DuplicateTensorNameError(
+                        f"Tensor name {name!r} already in an in-flight "
+                        "collective (reference: DUPLICATE_NAME_ERROR, "
+                        "common.h:163)")
+                self._names.add(name)
+            h = self._next
+            self._next += 1
+            self._entries[h] = (finisher, native_handle, name)
+            return h
+
+    def poll(self, handle: int) -> bool:
+        with self._lock:
+            entry = self._entries.get(handle)
+        if entry is None:
+            return True  # finished handles report done (handle_manager.cc)
+        _, native, _ = entry
+        return True if native is None else bool(native.poll())
+
+    def wait_and_clear(self, handle: int):
+        with self._lock:
+            if handle not in self._entries:
+                raise ValueError(f"unknown or already-synchronized handle "
+                                 f"{handle}")
+            finisher, native, name = self._entries.pop(handle)
+            if name is not None:
+                self._names.discard(name)
+        return finisher()
+
+
+_handles = _TorchHandleManager()
+
+
+def poll(handle: int) -> bool:
+    """True when the collective behind ``handle`` completed
+    (reference: torch/mpi_ops.py:88-99)."""
+    return _handles.poll(handle)
+
+
+def synchronize(handle: int) -> "torch.Tensor":
+    """Block until the collective completes, return its output tensor
+    (reference: torch/mpi_ops.py:101-127)."""
+    return _handles.wait_and_clear(handle)
+
+
+def _world() -> int:
+    s = basics._require_init()
+    return s.controller.size() if s.controller is not None else s.process_count
+
+
+def _ctrl_ctx():
+    return C._eager_ctx()
+
+
+# --------------------------------------------------------------------------
+# allreduce
+# --------------------------------------------------------------------------
+
+
+def _start_allreduce(tensor, output, op, name, prescale_factor,
+                     postscale_factor):
+    """Dispatch; returns (finisher, native_handle)."""
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "torch.allreduce")
+    if world == 1:
+        scale = prescale_factor * postscale_factor
+        if op == Product or scale == 1.0:
+            result = tensor.detach().clone()
+        else:
+            result = tensor.detach() * scale
+        if op in (Average, Sum, Min, Max, Adasum):
+            pass  # identity over a world of one (modulo scaling above)
+
+        def finish():
+            output.copy_(result)
+            return output
+        return finish, None
+    opmap = {Sum: ctrl.SUM, Average: ctrl.SUM, Min: ctrl.MIN, Max: ctrl.MAX,
+             Product: ctrl.PRODUCT, Adasum: ctrl.ADASUM}
+    post = postscale_factor / world if op == Average else postscale_factor
+    # The native core reduces in place on the wire buffer; feed it the
+    # *output* tensor's storage (a clone for the out-of-place variant, the
+    # input itself for the in-place one) so inputs are never clobbered.
+    native = ctrl.allreduce_async(
+        _to_numpy(output), opname, op=opmap[op],
+        prescale=float(prescale_factor), postscale=float(post))
+
+    def finish():
+        out = native.wait()
+        output.copy_(_from_numpy(out, output).view(output.shape))
+        return output
+    return finish, native
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """Async allreduce into a fresh output tensor; returns a handle
+    (reference: torch/mpi_ops.py:119-161)."""
+    op = _normalize_op(average, op)
+    output = tensor.detach().clone()
+    finish, native = _start_allreduce(tensor, output, op, name,
+                                      prescale_factor, postscale_factor)
+    return _handles.allocate(finish, native, name)
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0) -> int:
+    """In-place async allreduce (reference: torch/mpi_ops.py:223-259)."""
+    op = _normalize_op(average, op)
+    finish, native = _start_allreduce(tensor, tensor.data, op, name,
+                                      prescale_factor, postscale_factor)
+    return _handles.allocate(lambda: (finish(), tensor)[1], native, name)
+
+
+class _HorovodAllreduce(torch.autograd.Function):
+    """Differentiable allreduce (reference: HorovodAllreduce in
+    torch/mpi_ops.py:163-179 — the gradient of an allreduce is an allreduce
+    of the gradient with the same op)."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, prescale_factor, postscale_factor):
+        ctx.op = op
+        ctx.prescale_factor = prescale_factor
+        ctx.postscale_factor = postscale_factor
+        return synchronize(allreduce_async(
+            tensor, op=op, name=name, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allreduce_async(
+            grad_output, op=ctx.op, prescale_factor=ctx.prescale_factor,
+            postscale_factor=ctx.postscale_factor))
+        return grad, None, None, None, None
+
+
+def allreduce(tensor, average=None, name=None, compression=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0) -> "torch.Tensor":
+    """Synchronous, differentiable allreduce (reference:
+    torch/mpi_ops.py:181-221)."""
+    from .compression import Compression
+
+    op = _normalize_op(average, op)
+    compression = compression or Compression.none
+    compressed, cctx = compression.compress(tensor)
+    reduced = _HorovodAllreduce.apply(compressed, op, name, prescale_factor,
+                                      postscale_factor)
+    return compression.decompress(reduced, cctx)
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0) -> "torch.Tensor":
+    """Synchronous in-place allreduce (reference: torch/mpi_ops.py:261-292)."""
+    return synchronize(allreduce_async_(
+        tensor, average, name, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor))
+
+
+def _normalize_op(average, op):
+    """Reconcile the legacy ``average=`` flag with ``op=`` (reference:
+    torch/mpi_ops.py:52-64 handle_average_backwards_compatibility)."""
+    if average is not None and op is not None:
+        raise ValueError("both average and op are specified")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+# --------------------------------------------------------------------------
+# allgather
+# --------------------------------------------------------------------------
+
+
+def _start_allgather(tensor, name):
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "torch.allgather")
+    if world == 1:
+        result = tensor.detach().clone()
+        return (lambda: result), None
+    native = ctrl.allgather_async(
+        np.ascontiguousarray(_to_numpy(tensor)), opname)
+
+    def finish():
+        return _from_numpy(native.wait(), tensor)
+    return finish, native
+
+
+def allgather_async(tensor, name=None) -> int:
+    """Async first-dim concatenation across ranks (reference:
+    torch/mpi_ops.py:294-317); ranks may differ in dim 0."""
+    finish, native = _start_allgather(tensor, name)
+    return _handles.allocate(finish, native, name)
+
+
+class _HorovodAllgather(torch.autograd.Function):
+    """Reference: HorovodAllgather (torch/mpi_ops.py) — backward allreduces
+    the gradient and slices out this rank's segment."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.dim0 = tensor.shape[0] if tensor.dim() > 0 else 1
+        return synchronize(allgather_async(tensor, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allreduce_async(grad_output, op=Sum))
+        dims = synchronize(allgather_async(
+            torch.tensor([ctx.dim0], dtype=torch.int64)))
+        r = rank()
+        offset = int(dims[:r].sum().item()) if r > 0 else 0
+        return grad.narrow(0, offset, ctx.dim0), None
+
+
+def allgather(tensor, name=None) -> "torch.Tensor":
+    """Synchronous, differentiable allgather (reference:
+    torch/mpi_ops.py:319-343)."""
+    return _HorovodAllgather.apply(tensor, name)
+
+
+# --------------------------------------------------------------------------
+# broadcast
+# --------------------------------------------------------------------------
+
+
+def _start_broadcast(tensor, output, root_rank, name):
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "torch.broadcast")
+    if world == 1:
+        result = tensor.detach().clone()
+
+        def finish():
+            output.copy_(result)
+            return output
+        return finish, None
+    native = ctrl.broadcast_async(_to_numpy(output), opname, root=root_rank)
+
+    def finish():
+        output.copy_(_from_numpy(native.wait(), output).view(output.shape))
+        return output
+    return finish, native
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    """Reference: torch/mpi_ops.py:345-369."""
+    output = tensor.detach().clone()
+    finish, native = _start_broadcast(tensor, output, root_rank, name)
+    return _handles.allocate(finish, native, name)
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    """In-place async broadcast (reference: torch/mpi_ops.py:399-424)."""
+    finish, native = _start_broadcast(tensor, tensor.data, root_rank, name)
+    return _handles.allocate(lambda: (finish(), tensor)[1], native, name)
+
+
+class _HorovodBroadcast(torch.autograd.Function):
+    """Reference: HorovodBroadcast — backward sums gradients to the root,
+    zeros elsewhere."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor, root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        grad = synchronize(allreduce_async(grad_output, op=Sum))
+        if rank() != ctx.root_rank:
+            grad = grad * 0
+        return grad, None, None
+
+
+def broadcast(tensor, root_rank, name=None) -> "torch.Tensor":
+    """Synchronous, differentiable broadcast (reference:
+    torch/mpi_ops.py:371-397)."""
+    return _HorovodBroadcast.apply(tensor, root_rank, name)
+
+
+def broadcast_(tensor, root_rank, name=None) -> "torch.Tensor":
+    """Synchronous in-place broadcast (reference: torch/mpi_ops.py:426-450)."""
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+# --------------------------------------------------------------------------
+# alltoall
+# --------------------------------------------------------------------------
+
+
+def _start_alltoall(tensor, splits, name):
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "torch.alltoall")
+    if world == 1:
+        result = tensor.detach().clone()
+        rsplits = torch.tensor(
+            [tensor.shape[0] if tensor.dim() > 0 else 1], dtype=torch.int32)
+        return (lambda: (result, rsplits)), None
+    sp = None if splits is None else [int(x) for x in splits]
+    native = ctrl.alltoall_async(
+        np.ascontiguousarray(_to_numpy(tensor)), opname, splits=sp)
+
+    def finish():
+        out = native.wait()
+        return (_from_numpy(out, tensor),
+                torch.from_numpy(np.asarray(native.recv_splits(),
+                                            dtype=np.int32)))
+    return finish, native
+
+
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    """Async alltoall with optional uneven splits (reference:
+    torch/mpi_ops.py:452-487)."""
+    finish, native = _start_alltoall(tensor, splits, name)
+    return _handles.allocate(finish, native, name)
+
+
+def alltoall(tensor, splits=None, name=None):
+    """Synchronous alltoall; returns (output, received_splits) (reference:
+    torch/mpi_ops.py:489-518)."""
+    return synchronize(alltoall_async(tensor, splits, name))
+
+
+# --------------------------------------------------------------------------
+# join
+# --------------------------------------------------------------------------
+
+
+def join(device=-1) -> int:
+    """Signal that this rank has no more tensors to reduce; blocks until all
+    ranks join and returns the last joined rank (reference:
+    torch/mpi_ops.py:520-548; JoinOp collective_operations.cc:256-264).
+    ``device`` is accepted for API parity (the reference uses it to place the
+    zero-fill tensors on a GPU)."""
+    return C.join()
+
+
+def rank() -> int:
+    return int(basics.rank())

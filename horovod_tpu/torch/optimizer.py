@@ -1,0 +1,279 @@
+"""DistributedOptimizer for PyTorch.
+
+Reference: ``horovod/torch/optimizer.py`` — dynamically subclasses the
+wrapped optimizer; registers per-parameter gradient-accumulation hooks that
+fire ``allreduce_async_`` as gradients become ready during ``backward()``;
+``step()`` synchronizes all outstanding handles before applying updates
+(optimizer.py:103-200).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import torch
+
+from ..common import basics
+from .compression import Compression
+from . import mpi_ops
+from .mpi_ops import Average, Adasum, Sum
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Mixin body copied onto a dynamic subclass of the user's optimizer
+    class (reference: optimizer.py:29-101 __init__ structure)."""
+
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step=1,
+                 op=Average,
+                 gradient_predivide_factor=1.0):
+        super(self.__class__, self).__init__(params)
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [(f"allreduce.noname.{i}.{j}", v)
+                                for i, group in enumerate(self.param_groups)
+                                for j, v in enumerate(group["params"])]
+        # Guard against duplicate names (reference: optimizer.py:47-62).
+        all_params = {id(v) for group in self.param_groups
+                      for v in group["params"]}
+        named = {id(v) for _, v in named_parameters}
+        if len(named_parameters) != len(named):
+            raise ValueError("named_parameters contains duplicate parameters")
+        unnamed = all_params - named
+        if unnamed and named_parameters:
+            pass  # reference tolerates partially named models
+
+        self._parameter_names = {id(v): k for k, v in named_parameters}
+        self._compression = compression
+        self.op = op
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.backward_passes_per_step = backward_passes_per_step
+        self._handles = {}
+        self._grad_accs = []
+        self._requires_update = set()
+        self._synchronized = False
+        self._should_synchronize = True
+        self._allreduce_delay = {}
+        if mpi_ops._world() > 1:
+            self._register_hooks()
+
+    # -- hook plumbing (reference: optimizer.py:103-149) --
+
+    def _register_hooks(self):
+        for param_group in self.param_groups:
+            for p in param_group["params"]:
+                if p.requires_grad:
+                    self._requires_update.add(p)
+                    self._allreduce_delay[p] = self.backward_passes_per_step
+                    if hasattr(p, "register_post_accumulate_grad_hook"):
+                        p.register_post_accumulate_grad_hook(
+                            self._make_post_hook(p))
+                    else:  # pragma: no cover - older torch
+                        p_tmp = p.expand_as(p)
+                        grad_acc = p_tmp.grad_fn.next_functions[0][0]
+                        grad_acc.register_hook(self._make_hook(p))
+                        self._grad_accs.append(grad_acc)
+
+    def _make_post_hook(self, p):
+        def hook(param):
+            self._on_grad_ready(p)
+        return hook
+
+    def _make_hook(self, p):
+        def hook(*ignore):
+            self._on_grad_ready(p)
+        return hook
+
+    def _on_grad_ready(self, p):
+        if p in self._handles and self._handles[p][0] is not None:
+            if self._allreduce_delay[p] <= 0:
+                raise AssertionError(
+                    "Gradients were computed more than "
+                    "backward_passes_per_step times before call to step(). "
+                    "Increase backward_passes_per_step to accumulate "
+                    "gradients locally.")  # reference: optimizer.py:135-141
+        assert not p.grad.requires_grad
+        assert self._allreduce_delay[p] > 0
+        handle, ctx = None, None
+        self._allreduce_delay[p] -= 1
+        if self._allreduce_delay[p] == 0:
+            handle, ctx = self._allreduce_grad_async(p)
+        self._handles[p] = (handle, ctx)
+
+    def _allreduce_grad_async(self, p):
+        """Reference: optimizer.py:114-131 — prescale by 1/predivide for
+        Average (so the wire carries predivided sums), fire async in-place
+        allreduce on the (compressed) gradient."""
+        name = self._parameter_names.get(id(p))
+        tensor = p.grad
+        tensor_compressed, ctx = self._compression.compress(tensor)
+        if self.op == Average:
+            prescale = 1.0 / self.gradient_predivide_factor
+            postscale = self.gradient_predivide_factor
+            handle = mpi_ops.allreduce_async_(
+                tensor_compressed, name=name, op=Average,
+                prescale_factor=prescale, postscale_factor=postscale)
+        else:
+            handle = mpi_ops.allreduce_async_(
+                tensor_compressed, name=name, op=self.op)
+        return handle, (tensor_compressed, ctx)
+
+    # -- synchronization (reference: optimizer.py:151-200) --
+
+    def synchronize(self):
+        """Wait for all outstanding allreduces; decompress results back into
+        ``p.grad`` (reference: optimizer.py:151-167)."""
+        missing = [p for p in self._requires_update
+                   if p not in self._handles and p.grad is not None]
+        for p in missing:
+            # step() without a full backward (e.g. joined rank): reduce now.
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
+            if handle is None:
+                continue
+            output = mpi_ops.synchronize(handle)
+            self._allreduce_delay[p] = self.backward_passes_per_step
+            if ctx is not None:
+                tensor_compressed, cctx = ctx
+                p.grad.copy_(
+                    self._compression.decompress(output, cctx)
+                    .to(p.grad.dtype))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """User already called synchronize(); don't repeat it inside step()
+        (reference: optimizer.py:169-181)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                import warnings
+
+                warnings.warn(
+                    "optimizer.step() called without a prior backward; "
+                    "called synchronize() twice (reference warning, "
+                    "optimizer.py:185-192)")
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize(). "
+                "This is prohibited as it can cause a race condition. "
+                "(reference: optimizer.py:202-207)")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1,
+                         op=Average,
+                         gradient_predivide_factor=1.0):
+    """Wrap a torch optimizer so gradients are averaged across ranks before
+    ``step()`` (reference: torch/optimizer.py:387-445).
+
+    Returns an instance of a dynamically created class that inherits from
+    the wrapped optimizer's class, so ``isinstance`` checks and LR schedulers
+    keep working (the reference's exact trick, optimizer.py:420-445).
+    """
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op == Adasum:
+        # Adasum-as-optimizer-op needs the delta-optimizer formulation
+        # (reference: _DistributedAdasumOptimizer, optimizer.py:210-384).
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step)
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op, gradient_predivide_factor)
+
+
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Adasum delta optimizer (reference: optimizer.py:210-384): each rank
+    applies the local step to a scratch copy, then Adasum-combines the
+    *delta* (new - old) across ranks and applies the combined delta to the
+    start point. Convergence-preserving mixing without a learning-rate
+    rescale."""
+
+    def __init__(self, params, named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [(f"allreduce.noname.{i}.{j}", v)
+                                for i, group in enumerate(self.param_groups)
+                                for j, v in enumerate(group["params"])]
+        self._parameter_names = {id(v): k for k, v in named_parameters}
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+        self._handles = {}
+        self._starting_models = {}
+        self._synchronized = False
+        self._should_synchronize = True
+
+    def _compute_delta(self, p, start):
+        return p.data - start
+
+    def synchronize(self):
+        for p, (handle, start) in list(self._handles.items()):
+            output = mpi_ops.synchronize(handle)
+            p.data.copy_(start + output.to(p.dtype))
+        self._handles.clear()
+        self._synchronized = True
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        # Run the local optimizer step first, then Adasum the deltas.
+        starts = {}
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    starts[p] = p.data.clone()
+        loss = super(self.__class__, self).step(closure)
+        if mpi_ops._world() > 1:
+            for p, start in starts.items():
+                delta = self._compute_delta(p, start)
+                p.data.copy_(start)
+                name = self._parameter_names.get(id(p))
+                handle = mpi_ops.allreduce_async(
+                    delta, name=name, op=Adasum)
+                self._handles[p] = (handle, start)
+            if self._should_synchronize:
+                self.synchronize()
+            self._synchronized = False
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "zero_grad() called with outstanding Adasum handles")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)

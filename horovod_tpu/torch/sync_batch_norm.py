@@ -1,0 +1,131 @@
+"""Synchronous batch normalization across ranks for PyTorch.
+
+Reference: ``horovod/torch/sync_batch_norm.py`` — a ``_BatchNorm`` subclass
+whose training-mode forward computes batch statistics over the *global*
+batch via collectives, with a custom autograd Function for the backward
+reduction (sync_batch_norm.py:29-199).
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from . import mpi_ops
+from .mpi_ops import Sum
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Applies BatchNorm over the global (cross-rank) batch (reference:
+    sync_batch_norm.py:29-110)."""
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        if not self.training or mpi_ops._world() == 1:
+            # Eval mode / single rank: plain batch norm
+            # (reference: sync_batch_norm.py:97-103).
+            return F.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, self.training, self.momentum, self.eps)
+        if self.momentum is None:
+            exponential_average_factor = 0.0
+        else:
+            exponential_average_factor = self.momentum
+        if self.training and self.track_running_stats:
+            self.num_batches_tracked += 1
+            if self.momentum is None:
+                exponential_average_factor = \
+                    1.0 / float(self.num_batches_tracked)
+        return _SyncBatchNorm.apply(
+            input, self.weight, self.bias, self.running_mean,
+            self.running_var, self.eps, exponential_average_factor)
+
+
+class _SyncBatchNorm(torch.autograd.Function):
+    """Reference: sync_batch_norm.py:113-199 — forward allgathers per-rank
+    mean/invstd/count; here the equivalent sufficient statistics (sum,
+    sqsum, count) ride one fused allreduce, which is the TPU-shaped version
+    of the same reduction."""
+
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum):
+        dims = [0] + list(range(2, input.dim()))
+        n_local = input.numel() // input.size(1)
+        stats = torch.cat([
+            input.sum(dims).float(),
+            (input * input).sum(dims).float(),
+            torch.tensor([float(n_local)]),
+        ])
+        stats = mpi_ops.allreduce(stats, op=Sum, name="sync_bn.fwd_stats")
+        c = input.size(1)
+        count = stats[-1]
+        mean = stats[:c] / count
+        var = stats[c:2 * c] / count - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        if running_mean is not None:
+            unbiased_var = var * (count / (count - 1).clamp(min=1))
+            running_mean.mul_(1 - momentum).add_(mean, alpha=momentum)
+            running_var.mul_(1 - momentum).add_(unbiased_var, alpha=momentum)
+
+        ctx.save_for_backward(input, weight, mean, invstd, count)
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xhat = (input - mean.view(shape).to(input.dtype)) * \
+            invstd.view(shape).to(input.dtype)
+        out = xhat
+        if weight is not None:
+            out = out * weight.view(shape)
+        if bias is not None:
+            out = out + bias.view(shape)
+        ctx.xhat = None  # recomputed in backward from saved stats
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        input, weight, mean, invstd, count = ctx.saved_tensors
+        dims = [0] + list(range(2, input.dim()))
+        c = input.size(1)
+        shape = [1, c] + [1] * (input.dim() - 2)
+        xmu = input - mean.view(shape).to(input.dtype)
+
+        # Local weight/bias grads (world-averaged later by the
+        # DistributedOptimizer like any other parameter grad).
+        grad_weight = None
+        if weight is not None and ctx.needs_input_grad[1]:
+            grad_weight = (grad_output * xmu *
+                           invstd.view(shape).to(input.dtype)).sum(dims)
+        grad_bias = None
+        if ctx.needs_input_grad[2]:
+            grad_bias = grad_output.sum(dims)
+
+        # Global reduction of dy statistics (reference:
+        # sync_batch_norm.py:163-199 allreduces sum_dy / sum_dy_xmu).
+        red = torch.cat([
+            grad_output.sum(dims).float(),
+            (grad_output * xmu).sum(dims).float(),
+        ])
+        red = mpi_ops.allreduce(red, op=Sum, name="sync_bn.bwd_stats")
+        sum_dy = red[:c]
+        sum_dy_xmu = red[c:]
+
+        w = weight.view(shape).to(input.dtype) if weight is not None else 1.0
+        iv = invstd.view(shape).to(input.dtype)
+        m = count.to(input.dtype)
+        grad_input = w * iv * (
+            grad_output
+            - (sum_dy.view(shape).to(input.dtype) / m)
+            - xmu * (iv ** 2) *
+            (sum_dy_xmu.view(shape).to(input.dtype) / m))
+        return grad_input, grad_weight, grad_bias, None, None, None, None
