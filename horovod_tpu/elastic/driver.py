@@ -159,7 +159,12 @@ class ElasticDriver:
             constants.START_TIMEOUT_SECS
         deadline = time.monotonic() + timeout
         while True:
-            self._host_manager.update_available_hosts()
+            try:
+                self._host_manager.update_available_hosts()
+            except Exception as e:
+                # Transient discovery-script failure (same tolerance as
+                # _discover_loop): keep retrying until the deadline.
+                logging.warning(f"host discovery failed during startup: {e}")
             hosts = self._host_manager.current_hosts
             if sum(hosts.values()) >= min_np:
                 return hosts
@@ -177,9 +182,10 @@ class ElasticDriver:
         """Wait for the job to finish; True if at least one worker
         succeeded and the job wound down."""
         self._finished.wait(timeout)
-        return (self._registry.count(SUCCESS) > 0
+        return (self._registry.total_count(SUCCESS) > 0
                 and self._registry.count(FAILURE) == 0) or \
-            (self._registry.count(SUCCESS) > 0 and self._shutdown.is_set())
+            (self._registry.total_count(SUCCESS) > 0
+             and self._shutdown.is_set())
 
     def shutdown_service(self) -> None:
         self._service.shutdown()
@@ -243,19 +249,14 @@ class ElasticDriver:
                 continue
             if self._shutdown.is_set():
                 return
-            if res & HostUpdateResult.added:
-                # New capacity: notify workers so they interrupt at the next
-                # commit; re-assign immediately so re-rendezvous finds the
-                # bigger world (reference driver.py:177-226).
-                self._maybe_resume()
-                self._notify_workers(res)
-            # Pure removal: workers on dead hosts will fail their
-            # collectives (HorovodInternalError → restore + re-rendezvous)
-            # or exit; resume happens via on_worker_failure. A graceful
-            # shrink (host removed but alive) still needs a new world:
-            elif res & HostUpdateResult.removed:
-                self._maybe_resume()
-                self._notify_workers(res)
+            # Any churn (added capacity or a graceful shrink) needs a new
+            # world: re-assign immediately so re-rendezvous finds it, and
+            # notify workers so they interrupt at the next commit
+            # (reference driver.py:177-226). Workers on *dead* hosts
+            # additionally fail their collectives (HorovodInternalError →
+            # restore + re-rendezvous) via on_worker_failure.
+            self._maybe_resume()
+            self._notify_workers(res)
 
     def _notify_workers(self, res: int) -> None:
         with self._lock:
@@ -276,6 +277,14 @@ class ElasticDriver:
         workers for slots without a live process (reference
         driver.py:292-308 resume + _activate_workers)."""
         with self._lock:
+            if self._registry.total_count(SUCCESS) > 0:
+                # A worker already finished training successfully: the job
+                # is winding down. Building a new world here would erase
+                # the success record and respawn finished slots, re-running
+                # training from scratch.
+                logging.info("skipping resume: job already has a "
+                             "successful worker; winding down")
+                return
             hosts = self._host_manager.current_hosts
             total = sum(hosts.values())
             if total < self._min_np:
@@ -337,11 +346,22 @@ class ElasticDriver:
         with self._lock:
             self._live_workers.pop(key, None)
             self._worker_clients.pop(key, None)
+            released = key in self._released
+            self._released.discard(key)
         if self._shutdown.is_set():
             return
-        if key in self._released:
-            # Shrink-released worker: neither success nor failure.
-            self._released.discard(key)
+        if released:
+            # Shrink-released worker: neither success nor failure. If the
+            # host flapped (removed then re-added) its slot may already be
+            # assigned in a newer world that _resume skipped while this
+            # process was still alive — spawn it now or the new world
+            # never forms.
+            with self._lock:
+                slot_now = self._assignments.get(key)
+                if slot_now is not None and key not in self._live_workers \
+                        and not self._shutdown.is_set():
+                    self._spawn_worker(slot_now)
+                    return
         elif code == 0:
             self._registry.record_success(slot.hostname, slot.local_rank)
         else:
@@ -349,7 +369,9 @@ class ElasticDriver:
         with self._lock:
             live = sum(1 for t in self._live_workers.values() if t.is_alive())
         if live == 0:
-            if self._registry.count(SUCCESS) > 0:
+            # total_count: a success must end the job even if a later
+            # world-reset cleared the per-incarnation states.
+            if self._registry.total_count(SUCCESS) > 0:
                 self._finished.set()
                 self._shutdown.set()
             elif self._registry.reset_limit_reached() or \

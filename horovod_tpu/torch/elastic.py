@@ -13,6 +13,7 @@ from typing import Dict
 import torch
 
 from ..elastic.state import State
+from ..elastic.sampler import ElasticSampler as _CoreElasticSampler
 from ..elastic import run as run  # noqa: F401  (hvd.elastic.run parity)
 from . import functions as _fn
 from . import mpi_ops
@@ -146,72 +147,29 @@ class _SamplerStateHandler(_StateHandler):
         self.save()
 
 
-class ElasticSampler(torch.utils.data.Sampler):
+class ElasticSampler(_CoreElasticSampler, torch.utils.data.Sampler):
     """Distributed sampler that re-shards *remaining* (unprocessed) samples
-    when the world changes (reference: torch/elastic/sampler.py)."""
+    when the world changes (reference: torch/elastic/sampler.py).
+
+    Thin torch-Sampler adapter over the framework-neutral
+    :class:`horovod_tpu.elastic.sampler.ElasticSampler` — one resharding
+    implementation, two framework surfaces.
+    """
 
     def __init__(self, dataset, shuffle: bool = True, seed: int = 0):
         self.dataset = dataset
-        self.shuffle = shuffle
-        self.seed = seed
-        self.epoch = 0
-        self.processed_indices = set()
-        self.num_replicas = 0
-        self.rank = 0
-        self.remaining_indices = []
-        self.num_samples = 0
-        self.total_size = 0
-        self.reset()
+        _CoreElasticSampler.__init__(self, len(dataset), shuffle=shuffle,
+                                     seed=seed)
 
-    def set_epoch(self, epoch: int) -> None:
-        self.epoch = epoch
-        self.processed_indices = set()
-        self.reset()
+    def _world(self):
+        # Torch ranks are *processes* (the reference's model), not mesh
+        # chips: shard over the eager/process world, unlike the JAX
+        # sampler which shards batches across chips.
+        from ..common import basics
 
-    def record_batch(self, batch_idx: int, batch_size: int) -> None:
-        """Mark a batch consumed so a post-reset reshard skips it."""
-        processed = self.indices[batch_idx * batch_size:
-                                 (batch_idx + 1) * batch_size]
-        self.processed_indices.update(processed)
-
-    def state_dict(self) -> dict:
-        return {
-            "epoch": self.epoch,
-            "processed_indices": self.processed_indices,
-        }
-
-    def load_state_dict(self, state_dict: dict) -> None:
-        self.epoch = state_dict["epoch"]
-        self.processed_indices = set(state_dict["processed_indices"])
-        self.reset()
-
-    def reset(self) -> None:
-        self.num_replicas = mpi_ops._world() \
-            if _initialized() else 1
-        self.rank = mpi_ops.rank() if _initialized() else 0
-
-        remaining = [idx for idx in range(len(self.dataset))
-                     if idx not in self.processed_indices]
-        if self.shuffle:
-            g = torch.Generator()
-            g.manual_seed(self.seed + self.epoch)
-            perm = torch.randperm(len(remaining), generator=g).tolist()
-            remaining = [remaining[i] for i in perm]
-        self.remaining_indices = remaining
-
-        self.num_samples = len(self.remaining_indices) // self.num_replicas
-        self.total_size = self.num_samples * self.num_replicas
-        shard = self.remaining_indices[:self.total_size]
-        self.indices = shard[self.rank:self.total_size:self.num_replicas]
-
-    def __iter__(self):
-        return iter(self.indices)
-
-    def __len__(self) -> int:
-        return self.num_samples
-
-
-def _initialized() -> bool:
-    from ..common import basics
-
-    return basics.is_initialized()
+        if not basics.is_initialized():
+            return 0, 1
+        s = basics._require_init()
+        if s.controller is not None:
+            return s.controller.rank(), s.controller.size()
+        return s.process_index, s.process_count

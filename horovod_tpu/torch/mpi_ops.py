@@ -89,8 +89,10 @@ class _TorchHandleManager:
         self._names = set()
         self._next = 0
 
-    def allocate(self, finisher, native_handle=None,
-                 name: Optional[str] = None) -> int:
+    def allocate(self, starter, name: Optional[str] = None) -> int:
+        """Reserve the name FIRST, then dispatch via ``starter()`` — a
+        duplicate name must be rejected before anything reaches the native
+        core, or the orphaned in-flight collective is never waited on."""
         with self._lock:
             if name is not None:
                 if name in self._names:
@@ -99,6 +101,14 @@ class _TorchHandleManager:
                         "collective (reference: DUPLICATE_NAME_ERROR, "
                         "common.h:163)")
                 self._names.add(name)
+        try:
+            finisher, native_handle = starter()
+        except BaseException:
+            if name is not None:
+                with self._lock:
+                    self._names.discard(name)
+            raise
+        with self._lock:
             h = self._next
             self._next += 1
             self._entries[h] = (finisher, native_handle, name)
@@ -139,8 +149,7 @@ def synchronize(handle: int) -> "torch.Tensor":
 
 
 def _world() -> int:
-    s = basics._require_init()
-    return s.controller.size() if s.controller is not None else s.process_count
+    return C._eager_world()
 
 
 def _ctrl_ctx():
@@ -158,13 +167,12 @@ def _start_allreduce(tensor, output, op, name, prescale_factor,
     ctrl, world = _ctrl_ctx()
     opname = C._eager_name(name, "torch.allreduce")
     if world == 1:
+        # Every op is identity over a world of one modulo the pre/postscale
+        # factors, which the native core applies around the reduction for
+        # all ops — match that here so numerics don't depend on world size.
         scale = prescale_factor * postscale_factor
-        if op == Product or scale == 1.0:
-            result = tensor.detach().clone()
-        else:
-            result = tensor.detach() * scale
-        if op in (Average, Sum, Min, Max, Adasum):
-            pass  # identity over a world of one (modulo scaling above)
+        result = tensor.detach().clone() if scale == 1.0 \
+            else tensor.detach() * scale
 
         def finish():
             output.copy_(result)
@@ -191,20 +199,23 @@ def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0) -> int:
     """Async allreduce into a fresh output tensor; returns a handle
     (reference: torch/mpi_ops.py:119-161)."""
-    op = _normalize_op(average, op)
+    rop = _normalize_op(average, op)
     output = tensor.detach().clone()
-    finish, native = _start_allreduce(tensor, output, op, name,
-                                      prescale_factor, postscale_factor)
-    return _handles.allocate(finish, native, name)
+    return _handles.allocate(
+        lambda: _start_allreduce(tensor, output, rop, name,
+                                 prescale_factor, postscale_factor), name)
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None,
                      prescale_factor=1.0, postscale_factor=1.0) -> int:
     """In-place async allreduce (reference: torch/mpi_ops.py:223-259)."""
-    op = _normalize_op(average, op)
-    finish, native = _start_allreduce(tensor, tensor.data, op, name,
-                                      prescale_factor, postscale_factor)
-    return _handles.allocate(lambda: (finish(), tensor)[1], native, name)
+    rop = _normalize_op(average, op)
+
+    def starter():
+        finish, native = _start_allreduce(tensor, tensor.data, rop, name,
+                                          prescale_factor, postscale_factor)
+        return (lambda: (finish(), tensor)[1]), native
+    return _handles.allocate(starter, name)
 
 
 class _HorovodAllreduce(torch.autograd.Function):
@@ -285,8 +296,7 @@ def _start_allgather(tensor, name):
 def allgather_async(tensor, name=None) -> int:
     """Async first-dim concatenation across ranks (reference:
     torch/mpi_ops.py:294-317); ranks may differ in dim 0."""
-    finish, native = _start_allgather(tensor, name)
-    return _handles.allocate(finish, native, name)
+    return _handles.allocate(lambda: _start_allgather(tensor, name), name)
 
 
 class _HorovodAllgather(torch.autograd.Function):
@@ -340,14 +350,18 @@ def _start_broadcast(tensor, output, root_rank, name):
 def broadcast_async(tensor, root_rank, name=None) -> int:
     """Reference: torch/mpi_ops.py:345-369."""
     output = tensor.detach().clone()
-    finish, native = _start_broadcast(tensor, output, root_rank, name)
-    return _handles.allocate(finish, native, name)
+    return _handles.allocate(
+        lambda: _start_broadcast(tensor, output, root_rank, name), name)
 
 
 def broadcast_async_(tensor, root_rank, name=None) -> int:
     """In-place async broadcast (reference: torch/mpi_ops.py:399-424)."""
-    finish, native = _start_broadcast(tensor, tensor.data, root_rank, name)
-    return _handles.allocate(lambda: (finish(), tensor)[1], native, name)
+
+    def starter():
+        finish, native = _start_broadcast(tensor, tensor.data, root_rank,
+                                          name)
+        return (lambda: (finish(), tensor)[1]), native
+    return _handles.allocate(starter, name)
 
 
 class _HorovodBroadcast(torch.autograd.Function):
@@ -406,8 +420,8 @@ def _start_alltoall(tensor, splits, name):
 def alltoall_async(tensor, splits=None, name=None) -> int:
     """Async alltoall with optional uneven splits (reference:
     torch/mpi_ops.py:452-487)."""
-    finish, native = _start_alltoall(tensor, splits, name)
-    return _handles.allocate(finish, native, name)
+    return _handles.allocate(
+        lambda: _start_alltoall(tensor, splits, name), name)
 
 
 def alltoall(tensor, splits=None, name=None):

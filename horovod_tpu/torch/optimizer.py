@@ -37,15 +37,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             named_parameters = [(f"allreduce.noname.{i}.{j}", v)
                                 for i, group in enumerate(self.param_groups)
                                 for j, v in enumerate(group["params"])]
-        # Guard against duplicate names (reference: optimizer.py:47-62).
+        # Guard against duplicate and missing names (reference:
+        # optimizer.py:47-62): an unnamed parameter would fall back to an
+        # arrival-order auto name, which silently mismatches tensors across
+        # ranks if hook firing order ever differs.
         all_params = {id(v) for group in self.param_groups
                       for v in group["params"]}
         named = {id(v) for _, v in named_parameters}
         if len(named_parameters) != len(named):
             raise ValueError("named_parameters contains duplicate parameters")
         unnamed = all_params - named
-        if unnamed and named_parameters:
-            pass  # reference tolerates partially named models
+        if unnamed:
+            raise ValueError(
+                f"named_parameters is missing {len(unnamed)} parameter(s) "
+                "managed by the optimizer; pass model.named_parameters() "
+                "covering every optimized parameter")
 
         self._parameter_names = {id(v): k for k, v in named_parameters}
         self._compression = compression
@@ -133,9 +139,14 @@ class _DistributedOptimizer(torch.optim.Optimizer):
             # step() without a full backward (e.g. joined rank): reduce now.
             self._allreduce_delay[p] = self.backward_passes_per_step
             self._handles[p] = self._allreduce_grad_async(p)
+        # Flush params still mid-accumulation (handle None): step() means
+        # the accumulation window ends now, so the partial sum must be
+        # reduced — skipping it would apply an un-reduced gradient and
+        # leave the delay counter torn (reference: optimizer.py:155-160).
         for p, (handle, ctx) in list(self._handles.items()):
             if handle is None:
-                continue
+                self._handles[p] = self._allreduce_grad_async(p)
+        for p, (handle, ctx) in list(self._handles.items()):
             output = mpi_ops.synchronize(handle)
             self._allreduce_delay[p] = self.backward_passes_per_step
             if ctx is not None:

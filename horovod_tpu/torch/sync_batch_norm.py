@@ -32,21 +32,22 @@ class SyncBatchNorm(_BatchNorm):
 
     def forward(self, input):
         self._check_input_dim(input)
-        if not self.training or mpi_ops._world() == 1:
-            # Eval mode / single rank: plain batch norm
-            # (reference: sync_batch_norm.py:97-103).
-            return F.batch_norm(
-                input, self.running_mean, self.running_var, self.weight,
-                self.bias, self.training, self.momentum, self.eps)
-        if self.momentum is None:
-            exponential_average_factor = 0.0
-        else:
-            exponential_average_factor = self.momentum
+        # momentum=None means cumulative moving average; resolve it to a
+        # concrete factor for BOTH paths (F.batch_norm rejects None).
+        exponential_average_factor = \
+            0.0 if self.momentum is None else self.momentum
         if self.training and self.track_running_stats:
             self.num_batches_tracked += 1
             if self.momentum is None:
                 exponential_average_factor = \
                     1.0 / float(self.num_batches_tracked)
+        if not self.training or mpi_ops._world() == 1:
+            # Eval mode / single rank: plain batch norm
+            # (reference: sync_batch_norm.py:97-103).
+            return F.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, self.training, exponential_average_factor,
+                self.eps)
         return _SyncBatchNorm.apply(
             input, self.weight, self.bias, self.running_mean,
             self.running_var, self.eps, exponential_average_factor)

@@ -236,6 +236,80 @@ class TestShrinkRelease:
             driver.shutdown_service()
 
 
+class TestWindDown:
+    def test_late_failure_does_not_restart_finished_job(self):
+        """Once any worker has succeeded, a failure elsewhere must wind the
+        job down — not erase the success record and respawn the finished
+        slot (which would re-run training from scratch)."""
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 1, "b": 1}), min_np=1,
+                               max_np=2)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            workers.finish("a", 0, code=0)   # a finishes training
+            _wait(lambda: driver.registry.total_count(SUCCESS) == 1,
+                  msg="success recorded")
+            workers.finish("b", 0, code=1)   # b then crashes
+            assert driver.join(timeout=10)   # job ends successfully
+            # a:0 must NOT have been respawned into a new world
+            assert len([s for s in workers.spawned if s[0] == "a"]) == 1
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class TestHostFlap:
+    def test_readded_host_respawns_after_released_worker_exits(self):
+        """Host removed then re-added while its released worker is still
+        exiting: the slot must be spawned when the old process goes away,
+        or the new world never forms."""
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 1, "b": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=2)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            disc.hosts = {"a": 1}            # b removed
+            _wait(lambda: driver.world_id == 1, msg="shrink world")
+            resp = driver.get_slot_info("b", 0, min_world_id=1)
+            assert resp.status == "shutdown"  # b's worker is released
+            disc.hosts = {"a": 1, "b": 1}    # b flaps back
+            _wait(lambda: driver.world_id == 2, msg="regrow world")
+            # old b worker still alive → not respawned yet
+            assert ("b", 0, 2) not in workers.spawned
+            workers.finish("b", 0, code=0)   # released worker finally exits
+            _wait(lambda: ("b", 0, 2) in workers.spawned,
+                  msg="slot respawned after flap")
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class FlakyDiscovery(HostDiscovery):
+    def __init__(self, hosts, failures=1):
+        self.hosts = dict(hosts)
+        self.failures = failures
+
+    def find_available_hosts_and_slots(self):
+        if self.failures > 0:
+            self.failures -= 1
+            raise RuntimeError("transient discovery blip")
+        return dict(self.hosts)
+
+
+class TestStartupDiscovery:
+    def test_transient_blip_during_startup_is_retried(self):
+        driver = ElasticDriver(FlakyDiscovery({"a": 2}, failures=2),
+                               min_np=2)
+        try:
+            hosts = driver.wait_for_available_slots(2, timeout=10)
+            assert hosts == {"a": 2}
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
 class TestGetSlotProtocol:
     def test_waiting_then_ok_then_shutdown(self):
         workers = RecordingWorkers()

@@ -34,6 +34,14 @@ class TestOpsSingleProcess:
         out = hvd_torch.allreduce(t, op=hvd_torch.Sum, prescale_factor=3.0)
         assert torch.allclose(out, torch.full((4,), 3.0))
 
+    def test_allreduce_product_scaling(self):
+        # Pre/postscale must apply for op=Product at np=1 too (the native
+        # core applies them around the reduction for every op).
+        t = torch.full((4,), 2.0)
+        out = hvd_torch.allreduce(t, op=hvd_torch.Product,
+                                  prescale_factor=2.0)
+        assert torch.allclose(out, torch.full((4,), 4.0))
+
     def test_allreduce_inplace(self):
         t = torch.ones(4)
         out = hvd_torch.allreduce_(t, op=hvd_torch.Sum, postscale_factor=2.0)
@@ -161,6 +169,14 @@ class TestDistributedOptimizer:
                 torch.optim.SGD(model.parameters(), lr=0.1),
                 named_parameters=p + p)
 
+    def test_missing_named_parameters_rejected(self):
+        model = torch.nn.Linear(2, 2)
+        partial = list(model.named_parameters())[:1]
+        with pytest.raises(ValueError, match="missing"):
+            hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=partial)
+
     def test_predivide_requires_average(self):
         model = torch.nn.Linear(2, 2)
         with pytest.raises(ValueError):
@@ -219,6 +235,18 @@ class TestSyncBatchNorm:
         x = torch.randn(2, 4)
         out = sbn(x)
         assert out.shape == x.shape
+
+    def test_momentum_none_cumulative(self):
+        # momentum=None = cumulative moving average; must not crash and
+        # must track num_batches
+        sbn = hvd_torch.SyncBatchNorm(4, momentum=None)
+        bn = torch.nn.BatchNorm2d(4, momentum=None)
+        x = torch.randn(8, 4, 3, 3)
+        assert torch.allclose(sbn(x), bn(x), atol=1e-5)
+        assert sbn.num_batches_tracked.item() == 1
+        assert torch.allclose(sbn.running_mean, bn.running_mean, atol=1e-5)
+        sbn.eval()
+        sbn(x)  # eval with momentum=None must not crash either
 
     def test_rejects_1d(self):
         sbn = hvd_torch.SyncBatchNorm(4)

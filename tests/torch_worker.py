@@ -133,6 +133,28 @@ def main():
     assert torch.allclose(model.weight, expect_w, atol=1e-5), \
         (model.weight, expect_w)
 
+    # -- backward_passes_per_step: step() mid-accumulation must flush the
+    # partial gradient through an allreduce (not apply it un-reduced) --
+    torch.manual_seed(0)
+    model_a = torch.nn.Linear(3, 1, bias=False)
+    opt_a = hvd.DistributedOptimizer(
+        torch.optim.SGD(model_a.parameters(), lr=1.0),
+        named_parameters=model_a.named_parameters(),
+        backward_passes_per_step=2)
+    w0 = model_a.weight.detach().clone()
+    xa = torch.full((1, 3), float(rank + 1))
+    opt_a.zero_grad()
+    model_a(xa).sum().backward()   # only ONE of the two expected passes
+    opt_a.step()                   # must flush + reduce the partial grad
+    expect_w = w0 - torch.full((1, 3), mean_x)
+    assert torch.allclose(model_a.weight, expect_w, atol=1e-5), \
+        (model_a.weight, expect_w)
+    # delay counter must be fully re-armed: two more backwards then step
+    opt_a.zero_grad()
+    model_a(xa).sum().backward()
+    model_a(xa).sum().backward()
+    opt_a.step()
+
     # -- broadcast_optimizer_state --
     inner = torch.optim.SGD(model.parameters(), lr=0.5, momentum=0.9)
     loss = model(x).sum()
