@@ -98,6 +98,11 @@ from .parallel.functions import (  # noqa: F401
     broadcast_variables,
 )
 from .parallel.optimizer import DistributedOptimizer  # noqa: F401
+from .parallel.sequence import (  # noqa: F401
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from .parallel.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from .parallel.tape import (  # noqa: F401
     DistributedGradientTape,

@@ -197,6 +197,14 @@ class ElasticDriver:
         with self._lock:
             if self._shutdown.is_set():
                 return GetSlotResponse("shutdown")
+            if self._registry.total_count(SUCCESS) > 0:
+                # Winding down: a worker already finished training. A
+                # re-rendezvousing worker (interrupted survivor, flapped
+                # host) must exit cleanly, not wait for a world that will
+                # never form. Mark released so its exit is neither success
+                # nor failure.
+                self._released.add((host, local_rank))
+                return GetSlotResponse("shutdown")
             if self._world_id < min_world_id:
                 return GetSlotResponse("waiting")
             slot = self._assignments.get((host, local_rank))
@@ -249,6 +257,10 @@ class ElasticDriver:
                 continue
             if self._shutdown.is_set():
                 return
+            if self._registry.total_count(SUCCESS) > 0:
+                # Winding down after a success: don't interrupt the
+                # remaining workers — let them finish naturally.
+                continue
             # Any churn (added capacity or a graceful shrink) needs a new
             # world: re-assign immediately so re-rendezvous finds it, and
             # notify workers so they interrupt at the next commit
@@ -359,7 +371,8 @@ class ElasticDriver:
             with self._lock:
                 slot_now = self._assignments.get(key)
                 if slot_now is not None and key not in self._live_workers \
-                        and not self._shutdown.is_set():
+                        and not self._shutdown.is_set() \
+                        and self._registry.total_count(SUCCESS) == 0:
                     self._spawn_worker(slot_now)
                     return
         elif code == 0:

@@ -1,6 +1,7 @@
 """Model zoo for benchmarks and examples (reference benchmarks use
 tf.keras.applications ResNet50 et al., docs/benchmarks.rst)."""
 
+from .gpt import GPT, GPTConfig, gpt_small, gpt_tiny  # noqa: F401
 from .mnist import MnistNet  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,

@@ -1,0 +1,145 @@
+"""GPT-style decoder-only transformer with first-class sequence parallelism.
+
+No counterpart exists in the reference (it is a CNN-era data-parallel
+framework, SURVEY §5.7); this is the long-context flagship of the TPU
+build. TPU-first choices:
+
+* bfloat16 activations, fp32 params/softmax statistics (MXU-native),
+* pre-norm blocks, GELU MLP, learned positional embeddings,
+* attention is pluggable: ``dense`` (single chip), ``ring``
+  (ppermute ring over the mesh axis — O(T/n) sequence memory/chip), or
+  ``ulysses`` (all-to-all head exchange) from
+  :mod:`horovod_tpu.parallel.sequence`,
+* optional ``remat`` per block (jax.checkpoint) to trade FLOPs for HBM,
+* everything is static-shaped, scan-free python loops over layers so XLA
+  fuses each block independently.
+
+Under sequence parallelism, ``__call__`` must run inside ``jax.shard_map``
+with ``tokens`` sharded on the sequence axis; positional embeddings are
+offset by the chip's shard index automatically.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..common.basics import LOCAL_AXIS
+from ..parallel import sequence as seqpar
+
+
+@dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dtype: jnp.dtype = jnp.bfloat16
+    attention: str = "dense"          # dense | ring | ulysses
+    seq_axis: str = LOCAL_AXIS        # mesh axis carrying the sequence
+    remat: bool = False
+    embed_init_std: float = 0.02
+
+
+class _Attention(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        B, T, C = x.shape
+        H = cfg.num_heads
+        D = C // H
+        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="qkv",
+                       kernel_init=nn.initializers.normal(0.02))(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D)
+        k = k.reshape(B, T, H, D)
+        v = v.reshape(B, T, H, D)
+        if cfg.attention == "ring":
+            out = seqpar.ring_attention(q, k, v, axis=cfg.seq_axis,
+                                        causal=True)
+        elif cfg.attention == "ulysses":
+            out = seqpar.ulysses_attention(q, k, v, axis=cfg.seq_axis,
+                                           causal=True)
+        else:
+            out = seqpar.dense_attention(q, k, v, causal=True)
+        out = out.reshape(B, T, C)
+        return nn.Dense(C, dtype=cfg.dtype, name="proj",
+                        kernel_init=nn.initializers.normal(
+                            0.02 / (2 * cfg.num_layers) ** 0.5))(out)
+
+
+class _MLP(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = nn.Dense(cfg.d_ff, dtype=cfg.dtype,
+                     kernel_init=nn.initializers.normal(0.02))(x)
+        x = nn.gelu(x)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                        kernel_init=nn.initializers.normal(
+                            0.02 / (2 * cfg.num_layers) ** 0.5))(x)
+
+
+class _Block(nn.Module):
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        x = x + _Attention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
+        x = x + _MLP(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+        return x
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. Returns logits [B, T_local, vocab]."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        B, T_local = tokens.shape
+        wte = self.param("wte", nn.initializers.normal(cfg.embed_init_std),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(cfg.embed_init_std),
+                         (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        if cfg.attention in ("ring", "ulysses"):
+            # Sequence is sharded: offset positions by the shard index.
+            pos = seqpar.seq_shard_positions(T_local, cfg.seq_axis)
+        else:
+            pos = jnp.arange(T_local)
+        x = (wte[tokens] + wpe[pos][None]).astype(cfg.dtype)
+        block = _Block
+        if cfg.remat:
+            block = nn.remat(_Block)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"h{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Tied embedding head, fp32 logits for a stable softmax.
+        return jnp.einsum("btc,vc->btv", x.astype(jnp.float32), wte)
+
+
+def gpt_small(**overrides) -> GPTConfig:
+    """GPT-2-small scale (124M)."""
+    return GPTConfig(**{**dict(num_layers=12, num_heads=12, d_model=768,
+                               d_ff=3072), **overrides})
+
+
+def gpt_tiny(**overrides) -> GPTConfig:
+    """Test/dryrun scale."""
+    return GPTConfig(**{**dict(vocab_size=128, num_layers=2, num_heads=4,
+                               d_model=64, d_ff=128, max_seq_len=256),
+                        **overrides})

@@ -1,0 +1,194 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference framework has **no** sequence parallelism (SURVEY §5.7): its
+only sequence-adjacent machinery is `alltoall` with uneven splits
+(operations.cc:1031-1092), which is exactly the primitive Ulysses-style SP
+is built from. This module makes long-context first-class on TPU:
+
+- :func:`ring_attention` — blockwise (flash-style) attention where K/V
+  blocks rotate around the mesh axis via ``lax.ppermute`` while each chip
+  streams softmax statistics; sequence memory per chip is O(T/n), and the
+  rotation rides the ICI ring.
+- :func:`ulysses_attention` — ``lax.all_to_all`` re-shards from
+  sequence-parallel to head-parallel layout, runs exact local attention on
+  each chip's head slice, and re-shards back (the reference's
+  MPI_Alltoallv analogue compiled into the XLA program).
+
+Both are drop-in attention functions for use inside ``jax.shard_map`` over
+the Horovod mesh with the sequence dimension sharded on ``axis``.
+Layouts are ``[batch, seq_local, heads, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common.basics import LOCAL_AXIS
+
+_NEG_INF = -1e30  # finite mask value: keeps running-max arithmetic NaN-free
+
+
+def _axis_size(axis) -> int:
+    """Static size of a bound mesh axis (python int at trace time).
+    Unbound axes (tracing outside shard_map, e.g. model.init) count as 1 —
+    the shard IS the full sequence there, so callers fall back to dense."""
+    from jax._src.core import get_axis_env
+
+    sizes = get_axis_env().axis_sizes
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    n = 1
+    for a in names:
+        n *= sizes.get(a, 1)
+    return n
+
+
+def dense_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    q_offset=0, k_offset=0):
+    """Reference (non-parallel) scaled-dot-product attention.
+
+    ``q_offset``/``k_offset`` are the global positions of the first query /
+    key token — needed for causal masking when q and k are shards of a
+    longer sequence.
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else D ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(Tq)
+        kpos = k_offset + jnp.arange(Tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
+def ring_attention(q, k, v, *, axis=LOCAL_AXIS, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Exact attention over a sequence sharded on ``axis``.
+
+    Each of the n chips holds a contiguous [B, T/n, H, D] block. K/V blocks
+    rotate n times around the ring (``lax.ppermute`` over ICI neighbours);
+    the local Q block accumulates output with streaming (flash) softmax —
+    running max ``m``, normalizer ``l``, and unnormalized output ``o`` —
+    so the full [T, T] score matrix never materializes and per-chip memory
+    stays O(T/n · T/n) per step.
+
+    Communication is overlapped with compute by XLA: the ppermute for step
+    i+1 is independent of step i's einsum, so the collective-permute DMA
+    runs concurrently with the MXU work.
+    """
+    B, T_local, H, D = q.shape
+    n = _axis_size(axis)
+    if n == 1:
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    scale = scale if scale is not None else D ** -0.5
+    my = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]  # ring: block i → chip i+1
+
+    qpos = my * T_local + jnp.arange(T_local)
+
+    def step(carry, i):
+        o, m, l, k_blk, v_blk = carry
+        # Which chip's block do we currently hold? Blocks travel +1 per
+        # step, so after i rotations we hold the block of chip (my - i).
+        src = (my - i) % n
+        kpos = src * T_local + jnp.arange(T_local)
+
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            mask = qpos[:, None] >= kpos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+
+        m_cur = jnp.max(s, axis=-1)                      # [B,H,Tq]
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)                       # rescale old
+        p = jnp.exp(s - m_new[..., None])                # [B,H,Tq,Tk]
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
+
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (o_new, m_new, l_new, k_blk, v_blk), None
+
+    # Accumulators must carry the union of the ring axis' varying type and
+    # whatever axes q/k/v already vary over (e.g. a data-parallel batch
+    # axis), or the scan carry types won't match.
+    from ..ops.collective_ops import _vma
+
+    ring_axes = {axis} if isinstance(axis, str) else set(axis)
+    axes_t = tuple(sorted(ring_axes | _vma(q) | _vma(k) | _vma(v)))
+
+    def _vary(x):
+        return lax.pcast(x, axes_t, to="varying")
+
+    o0 = _vary(jnp.zeros((B, H, T_local, D), jnp.float32))
+    m0 = _vary(jnp.full((B, H, T_local), _NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((B, H, T_local), jnp.float32))
+    # scan (not fori_loop/while) so the rotation is reverse-differentiable
+    # — the backward pass replays the ring with transposed ppermutes.
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    # Causal rows always see at least their own token, so l > 0.
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis=LOCAL_AXIS, causal: bool = True,
+                      scale: Optional[float] = None, attn_fn=None):
+    """Ulysses-style sequence parallelism via all-to-all head exchange.
+
+    Input is sequence-sharded [B, T/n, H, D]; ``lax.all_to_all`` re-shards
+    to head-sharded [B, T, H/n, D] (each chip gets the FULL sequence for a
+    slice of heads), exact attention runs locally, and a second all-to-all
+    restores the sequence-sharded layout. Two all-to-alls per attention
+    call versus ring's n ppermutes — better when heads ≥ chips and the
+    alltoall bisection bandwidth is high (ICI), which is the TPU case.
+
+    ``attn_fn(q, k, v)`` may override the local attention (e.g. a pallas
+    flash kernel); default is :func:`dense_attention`.
+    """
+    B, T_local, H, D = q.shape
+    n = _axis_size(axis)
+    if n == 1:
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    if H % n != 0:
+        raise ValueError(f"heads {H} not divisible by axis size {n}")
+
+    # [B, T/n, H, D] → [B, T, H/n, D]: split heads across chips, gather seq
+    def scatter_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    if attn_fn is None:
+        out = dense_attention(qf, kf, vf, causal=causal, scale=scale)
+    else:
+        out = attn_fn(qf, kf, vf)
+    return gather_heads(out)
+
+
+def seq_shard_positions(T_local: int, axis=LOCAL_AXIS):
+    """Global token positions of this chip's sequence shard (for positional
+    embeddings under sequence parallelism). Outside ``shard_map`` (e.g.
+    ``model.init`` tracing an unsharded dummy) the axis is unbound and the
+    shard is the whole sequence: positions start at 0."""
+    from jax._src.core import get_axis_env
+
+    bound = get_axis_env().axis_sizes
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    if not all(a in bound for a in names):
+        return jnp.arange(T_local)
+    return lax.axis_index(axis) * T_local + jnp.arange(T_local)

@@ -16,7 +16,6 @@ from ..elastic.state import State
 from ..elastic.sampler import ElasticSampler as _CoreElasticSampler
 from ..elastic import run as run  # noqa: F401  (hvd.elastic.run parity)
 from . import functions as _fn
-from . import mpi_ops
 
 
 class TorchState(State):

@@ -259,6 +259,32 @@ class TestWindDown:
             driver.shutdown_service()
 
 
+class TestWindDownRendezvous:
+    def test_rerendezvous_after_success_gets_shutdown(self):
+        """A worker re-rendezvousing after another worker succeeded must be
+        told to shut down (not wait forever for a world that will never
+        form), and its clean exit is neither success nor failure."""
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 1, "b": 1})
+        driver = ElasticDriver(disc, min_np=1, max_np=2)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            workers.finish("a", 0, code=0)
+            _wait(lambda: driver.registry.total_count(SUCCESS) == 1,
+                  msg="success")
+            resp = driver.get_slot_info("b", 0, min_world_id=1)
+            assert resp.status == "shutdown"
+            workers.finish("b", 0, code=0)
+            assert driver.join(timeout=10)
+            assert driver.registry.total_count(FAILURE) == 0
+            # b's post-success clean exit must not double-count as success
+            assert driver.registry.total_count(SUCCESS) == 1
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
 class TestHostFlap:
     def test_readded_host_respawns_after_released_worker_exits(self):
         """Host removed then re-added while its released worker is still
