@@ -39,6 +39,12 @@ API surface parity map (reference file → here):
   SyncBatchNorm                       → parallel/sync_batch_norm.py
   elastic State/run                   → elastic/
   horovodrun launcher                 → runner/
+  horovod.torch                       → torch/ (mpi_ops, optimizer, ...)
+  horovod.tensorflow                  → tensorflow/ (ops, tape, optimizer)
+  horovod.keras / tensorflow.keras    → keras/, _keras/, tensorflow/keras/
+  horovod.mxnet                       → mxnet/ (gated: MXNet is EOL)
+  (no reference analogue)             → parallel/sequence.py (ring/Ulysses
+                                        attention), models/gpt.py
 """
 
 from .common.basics import (  # noqa: F401

@@ -1,0 +1,134 @@
+"""Shared Keras implementation (reference: horovod/_keras/__init__.py —
+the backend-neutral guts used by both horovod.keras and
+horovod.tensorflow.keras).
+
+Targets Keras 3: the distributed optimizer overrides ``apply`` (which
+``apply_gradients`` funnels into), and state broadcast works on the
+framework-neutral ``variable.assign``/numpy surface so it runs under any
+Keras backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..common import basics as _basics
+from ..ops import collective_ops as C
+from ..ops.collective_ops import ReduceOp
+
+
+def _world() -> int:
+    return C._eager_world()
+
+
+def _allreduce_numpy(arr: np.ndarray, op=ReduceOp.AVERAGE,
+                     name=None) -> np.ndarray:
+    ctrl, world = C._eager_ctx()
+    if world == 1:
+        return arr
+    opmap = {ReduceOp.SUM: ctrl.SUM, ReduceOp.AVERAGE: ctrl.SUM}
+    post = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
+    out = np.asarray(ctrl.allreduce_async(
+        np.ascontiguousarray(arr), C._eager_name(name, "keras.allreduce"),
+        op=opmap[op], postscale=post).wait())
+    return out.reshape(arr.shape)  # wire promotes scalars to rank 1
+
+
+def _broadcast_numpy(arr: np.ndarray, root_rank=0, name=None) -> np.ndarray:
+    ctrl, world = C._eager_ctx()
+    if world == 1:
+        return arr
+    out = np.asarray(ctrl.broadcast_async(
+        np.ascontiguousarray(arr), C._eager_name(name, "keras.broadcast"),
+        root=root_rank).wait())
+    return out.reshape(arr.shape)  # wire promotes scalars to rank 1
+
+
+def broadcast_model_state(model, root_rank: int = 0) -> None:
+    """Broadcast model weights AND optimizer slot variables from root
+    (reference: callbacks.py BroadcastGlobalVariablesCallback +
+    functions.py broadcast_variables)."""
+    weights = model.get_weights()
+    model.set_weights([
+        _broadcast_numpy(np.asarray(w), root_rank, name=f"kw.{i}")
+        for i, w in enumerate(weights)])
+    opt = getattr(model, "optimizer", None)
+    if opt is not None and getattr(opt, "variables", None):
+        for i, var in enumerate(opt.variables):
+            var.assign(_broadcast_numpy(np.asarray(var), root_rank,
+                                        name=f"kov.{i}"))
+
+
+def create_distributed_optimizer(optimizer, compression=None,
+                                 op=ReduceOp.AVERAGE,
+                                 prescale_factor=1.0, postscale_factor=1.0):
+    """Dynamically subclass the wrapped Keras optimizer so isinstance
+    checks and serialization keep working (the reference's exact approach,
+    _keras/__init__.py:25-85), overriding gradient application to
+    allreduce first."""
+    import keras
+
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError("op must be Average or Sum for Keras optimizers")
+
+    wire_np_dtype = None
+    wire = getattr(compression, "wire_dtype", None)
+    if wire is not None:
+        if "bfloat16" in str(wire):
+            import ml_dtypes
+
+            wire_np_dtype = np.dtype(ml_dtypes.bfloat16)
+        else:
+            wire_np_dtype = np.dtype(np.float16)
+
+    class _Dist(optimizer.__class__):
+        """Keras 3 funnels apply_gradients → apply, so overriding ``apply``
+        alone covers both entry points (and avoids double reduction)."""
+
+        _hvd_wrapped = True
+
+        def apply(self, grads, trainable_variables=None, **kwargs):
+            grads = self._hvd_allreduce(grads)
+            return super().apply(grads, trainable_variables, **kwargs)
+
+        def _hvd_allreduce(self, grads):
+            if _world() == 1:
+                return grads
+            import keras.ops as K
+
+            def reduce_np(arr, i):
+                arr = np.asarray(arr)
+                restore = None
+                if wire_np_dtype is not None and \
+                        np.issubdtype(arr.dtype, np.floating):
+                    restore = arr.dtype
+                    arr = arr.astype(wire_np_dtype)
+                red = _allreduce_numpy(arr, op=op, name=f"kgrad.{i}")
+                return red.astype(restore) if restore is not None else red
+
+            # Under the TF backend Keras compiles train_step into a
+            # tf.function; host collectives must escape the graph.
+            in_tf_graph = False
+            if keras.backend.backend() == "tensorflow":
+                import tensorflow as tf
+
+                in_tf_graph = not tf.executing_eagerly()
+            out = []
+            for i, g in enumerate(grads):
+                if g is None:
+                    out.append(None)
+                elif in_tf_graph:
+                    import tensorflow as tf
+
+                    y = tf.py_function(
+                        lambda t, idx=i: tf.convert_to_tensor(
+                            reduce_np(t.numpy(), idx)), [g], g.dtype)
+                    y.set_shape(g.shape)
+                    out.append(y)
+                else:
+                    out.append(K.convert_to_tensor(reduce_np(g, i)))
+            return out
+
+    _Dist.__name__ = optimizer.__class__.__name__
+    cfg = optimizer.get_config()
+    return _Dist.from_config(cfg)

@@ -1,0 +1,138 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py:22-192).
+
+Backend-neutral (Keras 3): state moves through numpy + the native control
+plane, so these work under the TF, JAX, or torch Keras backends.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:
+    import keras
+except ImportError as e:  # pragma: no cover
+    raise ImportError("horovod_tpu keras callbacks require keras") from e
+
+from ..common import basics as _basics
+from ..ops.collective_ops import ReduceOp
+from . import _allreduce_numpy, _world, broadcast_model_state
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast initial model + optimizer state from root_rank on the
+    first batch (reference: _keras/callbacks.py:22-45 — first batch, not
+    train_begin, so freshly-created optimizer slots are included)."""
+
+    def __init__(self, root_rank: int = 0):
+        super().__init__()
+        self.root_rank = root_rank
+        self.broadcast_done = False
+
+    def on_train_batch_end(self, batch, logs=None):
+        # After the first step the optimizer slots exist on every rank;
+        # broadcasting now aligns both weights and slots before step 2
+        # (the reference hooks the first batch for the same reason).
+        if self.broadcast_done:
+            return
+        broadcast_model_state(self.model, self.root_rank)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch metrics over ranks (reference:
+    _keras/callbacks.py:48-87), so rank-0 logging/checkpointing sees global
+    metrics."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is None or _world() == 1:
+            return
+        keys = sorted(k for k, v in logs.items()
+                      if np.isscalar(v) or getattr(v, "shape", None) == ())
+        if not keys:
+            return
+        vals = np.array([float(logs[k]) for k in keys], dtype=np.float64)
+        avg = _allreduce_numpy(vals, op=ReduceOp.AVERAGE,
+                               name=f"metric_avg.{epoch}")
+        for k, v in zip(keys, np.asarray(avg)):
+            logs[k] = float(v)
+
+
+class LearningRateWarmupCallback(keras.callbacks.Callback):
+    """Linear LR warmup from base_lr to base_lr*size over warmup_epochs
+    (reference: _keras/callbacks.py:90-152, implementing the Goyal et al.
+    gradual-warmup rule the reference documents)."""
+
+    def __init__(self, initial_lr: float, warmup_epochs: int = 5,
+                 steps_per_epoch: int = None, verbose: int = 0):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.verbose = verbose
+        self.current_epoch = 0
+        self._steps_seen = 0
+
+    def _size(self):
+        return _world()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        self._steps_seen = 0
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.current_epoch >= self.warmup_epochs:
+            return
+        steps = self.steps_per_epoch or (
+            self.params.get("steps") if self.params else None) or 1
+        progress = min(1.0, (self.current_epoch + batch / steps)
+                       / self.warmup_epochs)
+        size = self._size()
+        multiplier = 1.0 + progress * (size - 1.0)
+        self.model.optimizer.learning_rate = self.initial_lr * multiplier
+        self._steps_seen += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        if epoch == self.warmup_epochs - 1 and self.verbose and \
+                int(_basics.rank()) == 0:
+            print(f"warmup complete: lr -> "
+                  f"{self.initial_lr * self._size():.6g}")
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the LR by ``multiplier(epoch)`` within [start_epoch,
+    end_epoch) (reference: _keras/callbacks.py:155-192)."""
+
+    def __init__(self, initial_lr: float, multiplier, start_epoch: int = 0,
+                 end_epoch: int = None, staircase: bool = True,
+                 steps_per_epoch: int = None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch):
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self.model.optimizer.learning_rate = \
+                self.initial_lr * self.multiplier(epoch)
+
+    def on_train_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self.current_epoch):
+            return
+        steps = self.steps_per_epoch or (
+            self.params.get("steps") if self.params else None) or 1
+        frac_epoch = self.current_epoch + batch / steps
+        self.model.optimizer.learning_rate = \
+            self.initial_lr * self.multiplier(frac_epoch)

@@ -1,0 +1,65 @@
+"""Keras high-level API (reference: horovod/keras/__init__.py:1-162).
+
+Usage (the reference's recipe)::
+
+    import horovod_tpu.keras as hvd
+
+    hvd.init()
+    model = ...
+    opt = keras.optimizers.SGD(learning_rate=0.01 * hvd.size())
+    model.compile(optimizer=hvd.DistributedOptimizer(opt), loss=...)
+    model.fit(x, y, callbacks=[
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+    ], verbose=1 if hvd.rank() == 0 else 0)
+"""
+
+from ..common import basics as _basics
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    shutdown,
+)
+from ..ops.collective_ops import ReduceOp
+from .._keras import (  # noqa: F401
+    broadcast_model_state,
+    create_distributed_optimizer,
+)
+from . import callbacks  # noqa: F401
+from . import elastic  # noqa: F401
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+
+
+def rank() -> int:
+    return int(_basics.rank())
+
+
+def size() -> int:
+    return int(_basics.size())
+
+
+def DistributedOptimizer(optimizer, compression=None, op=Average,
+                         prescale_factor=1.0, postscale_factor=1.0):
+    """Wrap a Keras optimizer so gradient application averages across
+    ranks (reference: keras/__init__.py DistributedOptimizer →
+    _keras/__init__.py:25-85)."""
+    return create_distributed_optimizer(optimizer, compression, op,
+                                        prescale_factor, postscale_factor)
+
+
+def broadcast_global_variables(root_rank: int = 0, model=None) -> None:
+    """Reference: keras/__init__.py broadcast_global_variables — prefer the
+    BroadcastGlobalVariablesCallback; this form needs the model passed
+    explicitly (there is no TF1 global-collection equivalent)."""
+    if model is None:
+        raise ValueError(
+            "pass model= (no global-variable collection exists in Keras 3)")
+    broadcast_model_state(model, root_rank)

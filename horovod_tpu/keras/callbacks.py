@@ -1,0 +1,9 @@
+"""Public callbacks surface (reference: horovod/keras/callbacks.py — thin
+re-export of the shared _keras implementations)."""
+
+from .._keras.callbacks import (  # noqa: F401
+    BroadcastGlobalVariablesCallback,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+)

@@ -1,0 +1,57 @@
+"""Elastic state for Keras models (reference: horovod/tensorflow/elastic.py
+TensorFlowKerasState — weights + optimizer slots synced from the new rank 0
+after a reset)."""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+from ..elastic.state import State
+from ..elastic import run as run  # noqa: F401  (hvd.elastic.run parity)
+from .._keras import broadcast_model_state, _broadcast_numpy
+
+
+class KerasState(State):
+    """Holds a Keras model (+ arbitrary picklable attrs). ``save`` keeps an
+    in-memory weight copy, ``restore`` rolls back to it, ``sync``
+    broadcasts weights/optimizer slots from rank 0."""
+
+    def __init__(self, model, **kwargs):
+        self.model = model
+        self._obj_keys = list(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._saved_weights = None
+        self._saved_objs = {}
+        super().__init__()
+        self.save()
+
+    def save(self) -> None:
+        self._saved_weights = [np.copy(w) for w in self.model.get_weights()]
+        self._saved_objs = {k: copy.deepcopy(getattr(self, k))
+                            for k in self._obj_keys}
+
+    def restore(self) -> None:
+        if self._saved_weights is not None:
+            self.model.set_weights(self._saved_weights)
+        for k, v in self._saved_objs.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        broadcast_model_state(self.model, root_rank=0)
+        if self._obj_keys:
+            import cloudpickle
+
+            payload = cloudpickle.dumps(
+                {k: getattr(self, k) for k in self._obj_keys})
+            arr = np.frombuffer(payload, dtype=np.uint8).copy()
+            sz = _broadcast_numpy(np.array([len(arr)], dtype=np.int64),
+                                  name="keras_state.sz")
+            buf = arr if len(arr) == int(sz[0]) \
+                else np.zeros(int(sz[0]), dtype=np.uint8)
+            data = _broadcast_numpy(buf, name="keras_state.data")
+            for k, v in cloudpickle.loads(bytes(data)).items():
+                setattr(self, k, v)
+        self.save()

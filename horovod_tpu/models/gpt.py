@@ -22,10 +22,8 @@ offset by the chip's shard index automatically.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 
 from ..common.basics import LOCAL_AXIS
@@ -118,9 +116,18 @@ class GPT(nn.Module):
                          (cfg.max_seq_len, cfg.d_model), jnp.float32)
         if cfg.attention in ("ring", "ulysses"):
             # Sequence is sharded: offset positions by the shard index.
+            n_shards = seqpar._axis_size(cfg.seq_axis)
             pos = seqpar.seq_shard_positions(T_local, cfg.seq_axis)
         else:
+            n_shards = 1
             pos = jnp.arange(T_local)
+        if T_local * n_shards > cfg.max_seq_len:
+            # JAX gathers clamp out-of-bounds indices under jit, which
+            # would silently reuse the last positional embedding — fail
+            # loudly instead.
+            raise ValueError(
+                f"global sequence length {T_local * n_shards} exceeds "
+                f"max_seq_len={cfg.max_seq_len}")
         x = (wte[tokens] + wpe[pos][None]).astype(cfg.dtype)
         block = _Block
         if cfg.remat:

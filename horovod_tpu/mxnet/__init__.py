@@ -1,0 +1,22 @@
+"""MXNet binding gate.
+
+The reference ships an MXNet binding (horovod/mxnet/: NDArray adapters,
+DistributedOptimizer, gluon DistributedTrainer, broadcast_parameters —
+mxnet/__init__.py:39-140). MXNet reached end-of-life upstream and is not in
+this image; the binding surface is declared here so `import
+horovod_tpu.mxnet` fails with guidance instead of AttributeError soup.
+
+If mxnet is installed, the same recipe as the torch binding applies:
+NDArray ↔ numpy is zero-copy on CPU, and collectives ride the native
+control plane (horovod_tpu/cc/). Contributions would mirror
+horovod_tpu/torch/{mpi_ops,optimizer,functions}.py.
+"""
+
+try:
+    import mxnet  # noqa: F401
+except ImportError as e:
+    raise ImportError(
+        "horovod_tpu.mxnet requires mxnet, which is not installed (MXNet "
+        "is EOL upstream). Use the JAX (horovod_tpu), PyTorch "
+        "(horovod_tpu.torch), TensorFlow (horovod_tpu.tensorflow), or "
+        "Keras (horovod_tpu.keras) surfaces instead.") from e

@@ -21,14 +21,13 @@ Layouts are ``[batch, seq_local, heads, head_dim]``.
 
 from __future__ import annotations
 
-import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..common.basics import LOCAL_AXIS
+from ..common.basics import LOCAL_AXIS, _bound_axes
 
 _NEG_INF = -1e30  # finite mask value: keeps running-max arithmetic NaN-free
 
@@ -37,13 +36,12 @@ def _axis_size(axis) -> int:
     """Static size of a bound mesh axis (python int at trace time).
     Unbound axes (tracing outside shard_map, e.g. model.init) count as 1 —
     the shard IS the full sequence there, so callers fall back to dense."""
-    from jax._src.core import get_axis_env
-
-    sizes = get_axis_env().axis_sizes
+    bound = _bound_axes()
     names = (axis,) if isinstance(axis, str) else tuple(axis)
     n = 1
     for a in names:
-        n *= sizes.get(a, 1)
+        if a in bound:
+            n *= int(lax.axis_size(a))
     return n
 
 
@@ -185,9 +183,7 @@ def seq_shard_positions(T_local: int, axis=LOCAL_AXIS):
     embeddings under sequence parallelism). Outside ``shard_map`` (e.g.
     ``model.init`` tracing an unsharded dummy) the axis is unbound and the
     shard is the whole sequence: positions start at 0."""
-    from jax._src.core import get_axis_env
-
-    bound = get_axis_env().axis_sizes
+    bound = _bound_axes()
     names = (axis,) if isinstance(axis, str) else tuple(axis)
     if not all(a in bound for a in names):
         return jnp.arange(T_local)

@@ -1,0 +1,333 @@
+"""TensorFlow binding for horovod_tpu.
+
+Reference surface: ``horovod/tensorflow/__init__.py`` — eager/tf.function
+collectives, ``DistributedGradientTape`` (tensorflow/__init__.py:511-576),
+``DistributedOptimizer`` (435-508), ``broadcast_variables`` (functions.py:47),
+``broadcast_object`` (functions.py:59-134).
+
+TPU-native redesign: TF is a host-side framework here (the compiled TPU
+path is JAX); TF tensors ride the same native C++ controller + TCP data
+plane as the eager JAX and torch APIs, so TF, torch, and JAX processes can
+participate in one world. Gradient aggregation happens in eager Python (the
+reference's AsyncOpKernels + background thread are unnecessary: the native
+core already overlaps fused collectives internally).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, List, Optional
+
+import numpy as np
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.tensorflow requires tensorflow; install it or use the "
+        "JAX (horovod_tpu) / PyTorch (horovod_tpu.torch) surfaces") from e
+
+from ..common import basics as _basics
+from ..common.basics import (  # noqa: F401
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    shutdown,
+)
+from ..ops import collective_ops as C
+from ..ops.collective_ops import ReduceOp
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def rank() -> int:
+    return int(_basics.rank())
+
+
+def size() -> int:
+    return int(_basics.size())
+
+
+# --------------------------------------------------------------------------
+# Collective ops on tf.Tensors (reference: tensorflow/mpi_ops.py). Sync
+# eager ops; usable inside tf.function through tf.py_function.
+# --------------------------------------------------------------------------
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    if isinstance(tensor, tf.Tensor) or isinstance(tensor, tf.Variable):
+        arr = tensor.numpy()
+    else:
+        arr = np.asarray(tensor)
+    if arr.dtype == np.dtype("O"):
+        raise TypeError(f"unsupported tensor dtype {arr.dtype}")
+    return np.ascontiguousarray(arr)
+
+
+def _eager_world():
+    return C._eager_ctx()
+
+
+def allreduce(tensor, average=None, name=None, compression=None,
+              op=None, prescale_factor=1.0, postscale_factor=1.0):
+    """Synchronous, differentiable allreduce (reference:
+    tensorflow/__init__.py:53-153; gradient = allreduce of the gradient)."""
+    from .compression import Compression
+
+    rop = _normalize_op(average, op)
+    compression = compression or Compression.none
+
+    @tf.custom_gradient
+    def _op(x):
+        y = _maybe_py_function(
+            lambda t: _allreduce_eager(t, rop, name, prescale_factor,
+                                       postscale_factor, compression),
+            x, x.dtype, x.shape)
+
+        def grad(dy):
+            return _maybe_py_function(
+                lambda t: _allreduce_eager(t, rop, None, prescale_factor,
+                                           postscale_factor, compression),
+                dy, dy.dtype, dy.shape)
+        return y, grad
+
+    return _op(tf.convert_to_tensor(tensor))
+
+
+def _maybe_py_function(fn, x, out_dtype, out_shape):
+    """Run ``fn`` eagerly, or via tf.py_function when tracing inside a
+    tf.function (reference analogue: the AsyncOpKernel boundary in
+    tensorflow/mpi_ops.cc — host-side work escapes the graph)."""
+    if tf.executing_eagerly():
+        return fn(x)
+    y = tf.py_function(fn, [x], out_dtype)
+    if out_shape is not None:
+        y.set_shape(out_shape)
+    return y
+
+
+def _allreduce_eager(x, rop, name, prescale_factor, postscale_factor,
+                     compression):
+    ctrl, world = _eager_world()
+    compressed, cctx = compression.compress(x)
+    if world == 1:
+        scale = prescale_factor * postscale_factor
+        out = compressed if scale == 1.0 else compressed * scale
+    else:
+        opmap = {Sum: ctrl.SUM, Average: ctrl.SUM, Min: ctrl.MIN,
+                 Max: ctrl.MAX, Product: ctrl.PRODUCT, Adasum: ctrl.ADASUM}
+        post = postscale_factor / world if rop == Average \
+            else postscale_factor
+        arr = ctrl.allreduce_async(
+            _to_numpy(compressed), C._eager_name(name, "tf.allreduce"),
+            op=opmap[rop], prescale=float(prescale_factor),
+            postscale=float(post)).wait()
+        out = tf.convert_to_tensor(arr)
+    return compression.decompress(out, cctx)
+
+
+def _normalize_op(average, op):
+    """Reference: handle_average_backwards_compatibility."""
+    if average is not None and op is not None:
+        raise ValueError("both average and op are specified")
+    if op is not None:
+        return op
+    if average is False:
+        return Sum
+    return Average
+
+
+def allgather(tensor, name=None):
+    """First-dim concatenation across ranks (reference:
+    tensorflow/mpi_ops.py allgather); ragged dim 0 allowed."""
+    x = tf.convert_to_tensor(tensor)
+
+    def fn(t):
+        ctrl, world = _eager_world()
+        if world == 1:
+            return tf.identity(t)
+        arr = ctrl.allgather_async(
+            _to_numpy(t), C._eager_name(name, "tf.allgather")).wait()
+        return tf.convert_to_tensor(arr)
+
+    out_shape = tf.TensorShape([None]).concatenate(x.shape[1:]) \
+        if x.shape.rank else x.shape
+    return _maybe_py_function(fn, x, x.dtype, out_shape)
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    """Reference: tensorflow/mpi_ops.py broadcast."""
+    x = tf.convert_to_tensor(tensor)
+
+    def fn(t):
+        ctrl, world = _eager_world()
+        if world == 1:
+            return tf.identity(t)
+        arr = ctrl.broadcast_async(
+            _to_numpy(t), C._eager_name(name, "tf.broadcast"),
+            root=root_rank).wait()
+        return tf.convert_to_tensor(arr)
+
+    return _maybe_py_function(fn, x, x.dtype, x.shape)
+
+
+def alltoall(tensor, splits=None, name=None):
+    """Returns (output, received_splits) (reference:
+    tensorflow/mpi_ops.py alltoall)."""
+    ctrl, world = _eager_world()
+    x = tf.convert_to_tensor(tensor)
+    if world == 1:
+        n = int(x.shape[0]) if x.shape.rank else 1
+        return tf.identity(x), tf.constant([n], dtype=tf.int32)
+    sp = None if splits is None else [int(s) for s in np.asarray(splits)]
+    h = ctrl.alltoall_async(_to_numpy(x),
+                            C._eager_name(name, "tf.alltoall"), splits=sp)
+    out = h.wait()
+    return (tf.convert_to_tensor(out),
+            tf.constant(np.asarray(h.recv_splits(), dtype=np.int32)))
+
+
+def join() -> int:
+    """Reference: tensorflow/mpi_ops.py join."""
+    return C.join()
+
+
+# --------------------------------------------------------------------------
+# Variable/state broadcast (reference: tensorflow/functions.py)
+# --------------------------------------------------------------------------
+
+
+def broadcast_variables(variables, root_rank: int = 0) -> None:
+    """Assign every variable its root-rank value, in place (reference:
+    functions.py:47-57 broadcast_variables)."""
+    for i, var in enumerate(variables):
+        name = getattr(var, "name", None) or f"var.{i}"
+        var.assign(broadcast(var, root_rank, name=f"bv.{name}"))
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle on root, ship as a byte tensor (reference:
+    functions.py:59-134)."""
+    import cloudpickle
+
+    name = name or "tf.broadcast_object"
+    ctrl, world = _eager_world()
+    if world == 1:
+        return obj
+    if rank() == root_rank:
+        payload = np.frombuffer(cloudpickle.dumps(obj),
+                                dtype=np.uint8).copy()
+    else:
+        payload = np.empty(0, dtype=np.uint8)
+    sz = ctrl.broadcast_async(
+        np.array([len(payload)], dtype=np.int64), f"{name}.sz",
+        root=root_rank).wait()
+    buf = payload if rank() == root_rank \
+        else np.zeros(int(sz[0]), dtype=np.uint8)
+    data = ctrl.broadcast_async(buf, f"{name}.data", root=root_rank).wait()
+    return cloudpickle.loads(bytes(np.asarray(data)))
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Reference: functions.py:136-177."""
+    import cloudpickle
+
+    name = name or "tf.allgather_object"
+    ctrl, world = _eager_world()
+    if world == 1:
+        return [obj]
+    payload = np.frombuffer(cloudpickle.dumps(obj), dtype=np.uint8).copy()
+    sizes = ctrl.allgather_async(
+        np.array([len(payload)], dtype=np.int64), f"{name}.sz").wait()
+    data = ctrl.allgather_async(payload, f"{name}.data").wait()
+    out, off = [], 0
+    for s in np.asarray(sizes).tolist():
+        out.append(cloudpickle.loads(bytes(np.asarray(
+            data[off:off + s]))))
+        off += s
+    return out
+
+
+# --------------------------------------------------------------------------
+# DistributedGradientTape (reference: tensorflow/__init__.py:511-576)
+# --------------------------------------------------------------------------
+
+
+class _DistributedGradientTape:
+    def __init__(self, tape, compression, op, prescale_factor,
+                 postscale_factor):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+        self._prescale = prescale_factor
+        self._postscale = postscale_factor
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *args):
+        return self._tape.__exit__(*args)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        return _allreduce_grads(grads, self._compression, self._op,
+                                self._prescale, self._postscale)
+
+
+def _allreduce_grads(grads, compression, op, prescale, postscale):
+    out = []
+    for i, g in enumerate(grads):
+        if g is None:
+            out.append(None)
+        elif isinstance(g, tf.IndexedSlices):
+            # Sparse path: allgather values+indices (reference:
+            # tensorflow/__init__.py:91-107).
+            out.append(tf.IndexedSlices(
+                allgather(g.values, name=f"grad.{i}.values"),
+                allgather(g.indices, name=f"grad.{i}.indices"),
+                dense_shape=g.dense_shape))
+        else:
+            out.append(allreduce(
+                g, op=op, name=f"grad.{i}", compression=compression,
+                prescale_factor=prescale, postscale_factor=postscale))
+    return out
+
+
+def DistributedGradientTape(gradtape, compression=None, op=Average,
+                            prescale_factor=1.0, postscale_factor=1.0):
+    """Wrap tf.GradientTape so gradient() allreduces (reference:
+    tensorflow/__init__.py:530-576)."""
+    from .compression import Compression
+
+    return _DistributedGradientTape(
+        gradtape, compression or Compression.none, op, prescale_factor,
+        postscale_factor)
+
+
+def DistributedOptimizer(optimizer, name=None, compression=None, op=Average,
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         backward_passes_per_step=1):
+    """Wrap a Keras optimizer so apply_gradients() averages gradients
+    across ranks first (reference: tensorflow/__init__.py:435-508 +
+    _keras/__init__.py:25-85 create_distributed_optimizer)."""
+    from .compression import Compression
+    from .._keras import create_distributed_optimizer
+
+    return create_distributed_optimizer(
+        optimizer, compression or Compression.none, op, prescale_factor,
+        postscale_factor)

@@ -1,0 +1,53 @@
+"""Gradient compression for TF tensors (reference:
+horovod/tensorflow/compression.py:46-64 — fp16 cast before allreduce)."""
+
+import tensorflow as tf
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = tf.float16
+
+    @classmethod
+    def compress(cls, tensor):
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor if ctx is None else tf.cast(tensor, ctx)
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = tf.float16
+
+
+class BF16Compressor(_CastCompressor):
+    """TPU-native wire format (fp32 exponent range, MXU dtype)."""
+    wire_dtype = tf.bfloat16
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

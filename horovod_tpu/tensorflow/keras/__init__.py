@@ -1,0 +1,24 @@
+"""horovod.tensorflow.keras parity namespace (reference:
+horovod/tensorflow/keras/__init__.py — same surface as horovod.keras, for
+scripts that import the tf.keras-flavored path)."""
+
+from ...keras import (  # noqa: F401
+    Average,
+    DistributedOptimizer,
+    Sum,
+    broadcast_global_variables,
+    broadcast_model_state,
+    callbacks,
+    create_distributed_optimizer,
+    cross_rank,
+    cross_size,
+    init,
+    is_homogeneous,
+    is_initialized,
+    local_rank,
+    local_size,
+    mpi_threads_supported,
+    rank,
+    shutdown,
+    size,
+)

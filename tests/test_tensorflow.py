@@ -1,0 +1,253 @@
+"""TensorFlow/Keras binding tests (reference analogue:
+test/parallel/test_tensorflow.py + test_keras.py, SURVEY §4): single-process
+semantics plus real multi-process workers over localhost TCP."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+keras = pytest.importorskip("keras")
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "tf_worker.py")
+
+
+class TestOpsSingleProcess:
+    def test_allreduce_identity(self):
+        t = tf.range(6, dtype=tf.float32)
+        assert np.allclose(hvd_tf.allreduce(t).numpy(), t.numpy())
+
+    def test_allreduce_scaling(self):
+        out = hvd_tf.allreduce(tf.ones([4]), op=hvd_tf.Sum,
+                               prescale_factor=3.0)
+        assert np.allclose(out.numpy(), 3.0)
+
+    def test_allreduce_grad(self):
+        x = tf.Variable(tf.ones([3]))
+        with tf.GradientTape() as tape:
+            y = tf.reduce_sum(hvd_tf.allreduce(x))
+        g = tape.gradient(y, x)
+        assert np.allclose(g.numpy(), 1.0)
+
+    def test_allreduce_in_tf_function(self):
+        @tf.function
+        def f(t):
+            return hvd_tf.allreduce(t, op=hvd_tf.Sum)
+
+        assert np.allclose(f(tf.ones([4])).numpy(), 1.0)
+
+    def test_average_op_conflict(self):
+        with pytest.raises(ValueError):
+            hvd_tf.allreduce(tf.ones([2]), average=True, op=hvd_tf.Sum)
+
+    def test_allgather_identity(self):
+        t = tf.random.normal([3, 2])
+        assert np.allclose(hvd_tf.allgather(t).numpy(), t.numpy())
+
+    def test_broadcast_identity(self):
+        t = tf.random.normal([4])
+        assert np.allclose(hvd_tf.broadcast(t, 0).numpy(), t.numpy())
+
+    def test_alltoall_identity(self):
+        t = tf.range(4, dtype=tf.float32)
+        out, splits = hvd_tf.alltoall(t)
+        assert np.allclose(out.numpy(), t.numpy())
+        assert list(splits.numpy()) == [4]
+
+    def test_broadcast_variables(self):
+        v = tf.Variable(tf.ones([3]))
+        hvd_tf.broadcast_variables([v], root_rank=0)
+        assert np.allclose(v.numpy(), 1.0)
+
+    def test_broadcast_object(self):
+        assert hvd_tf.broadcast_object({"a": 1}) == {"a": 1}
+
+    def test_allgather_object(self):
+        assert hvd_tf.allgather_object(7) == [7]
+
+    def test_join(self):
+        assert hvd_tf.join() == 0
+
+    def test_compression_fp16(self):
+        from horovod_tpu.tensorflow.compression import Compression
+
+        t = tf.random.normal([8])
+        c, ctx = Compression.fp16.compress(t)
+        assert c.dtype == tf.float16
+        d = Compression.fp16.decompress(c, ctx)
+        assert d.dtype == tf.float32
+
+
+class TestDistributedGradientTape:
+    def test_wraps_and_computes(self):
+        w = tf.Variable(tf.ones([3, 1]))
+        x = tf.ones([2, 3])
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(tf.matmul(x, w))
+        (g,) = tape.gradient(loss, [w])
+        assert np.allclose(g.numpy(), 2.0)
+
+    def test_sparse_indexedslices(self):
+        emb = tf.Variable(tf.random.normal([10, 4]))
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            rows = tf.gather(emb, [1, 3])
+            loss = tf.reduce_sum(rows)
+        (g,) = tape.gradient(loss, [emb])
+        assert isinstance(g, tf.IndexedSlices)
+        assert g.values.shape[0] == 2
+
+
+class TestKerasOptimizer:
+    def test_wraps_class_and_trains(self):
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.layers.Input(shape=(4,)),
+            keras.layers.Dense(8, activation="tanh"),
+            keras.layers.Dense(1),
+        ])
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.1))
+        assert isinstance(opt, keras.optimizers.SGD)
+        model.compile(optimizer=opt, loss="mse")
+        xs = np.random.RandomState(0).randn(64, 4).astype(np.float32)
+        ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+        hist = model.fit(xs, ys, batch_size=16, epochs=3, verbose=0)
+        assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    def test_serialization_roundtrip(self):
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.Adam(learning_rate=3e-4))
+        cfg = opt.get_config()
+        assert abs(cfg["learning_rate"] - 3e-4) < 1e-9
+
+
+class TestKerasCallbacks:
+    def _model(self):
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.layers.Input(shape=(2,)),
+            keras.layers.Dense(1),
+        ])
+        model.compile(optimizer=keras.optimizers.SGD(learning_rate=0.01),
+                      loss="mse")
+        return model
+
+    def test_broadcast_callback_world1(self):
+        model = self._model()
+        xs = np.random.randn(8, 2).astype(np.float32)
+        ys = np.zeros((8, 1), np.float32)
+        model.fit(xs, ys, epochs=1, verbose=0, callbacks=[
+            hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)])
+
+    def test_metric_average_world1(self):
+        model = self._model()
+        xs = np.random.randn(8, 2).astype(np.float32)
+        ys = np.zeros((8, 1), np.float32)
+        model.fit(xs, ys, epochs=1, verbose=0, callbacks=[
+            hvd_keras.callbacks.MetricAverageCallback()])
+
+    def test_warmup_reaches_target(self):
+        model = self._model()
+        cb = hvd_keras.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.01, warmup_epochs=2, steps_per_epoch=4)
+        cb.set_model(model)
+        cb.on_epoch_begin(0)
+        cb.on_train_batch_begin(0)
+        lr0 = float(np.asarray(model.optimizer.learning_rate))
+        cb.on_epoch_begin(1)
+        cb.on_train_batch_begin(3)
+        lr1 = float(np.asarray(model.optimizer.learning_rate))
+        # world of one: multiplier stays 1.0 throughout
+        assert lr0 == pytest.approx(0.01)
+        assert lr1 == pytest.approx(0.01)
+
+    def test_schedule_staircase(self):
+        model = self._model()
+        cb = hvd_keras.callbacks.LearningRateScheduleCallback(
+            initial_lr=0.1, multiplier=lambda e: 0.1 ** e, start_epoch=0)
+        cb.set_model(model)
+        cb.on_epoch_begin(0)
+        assert float(np.asarray(
+            model.optimizer.learning_rate)) == pytest.approx(0.1)
+        cb.on_epoch_begin(2)
+        assert float(np.asarray(
+            model.optimizer.learning_rate)) == pytest.approx(0.001)
+
+
+class TestKerasElastic:
+    def test_state_save_restore(self):
+        model = self._make()
+        state = hvd_keras.elastic.KerasState(model, epoch=3)
+        w0 = [np.copy(w) for w in model.get_weights()]
+        model.set_weights([w * 0 + 99.0 for w in model.get_weights()])
+        state.epoch = 7
+        state.restore()
+        for a, b in zip(model.get_weights(), w0):
+            assert np.allclose(a, b)
+        assert state.epoch == 3
+
+    @staticmethod
+    def _make():
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.layers.Input(shape=(2,)),
+            keras.layers.Dense(1),
+        ])
+        model.compile(optimizer="sgd", loss="mse")
+        return model
+
+
+class TestMXNetGate:
+    def test_informative_import_error(self):
+        with pytest.raises(ImportError, match="mxnet"):
+            import horovod_tpu.mxnet  # noqa: F401
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_world(n, timeout=420):
+    port = _free_port()
+    procs = []
+    for r in range(n):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": str(n),
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs, ok = [], True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            ok = False
+        outs.append(out)
+        ok = ok and p.returncode == 0
+    assert ok, "tf worker failures:\n" + "\n----\n".join(outs)
+
+
+class TestMultiProcess:
+    def test_world_2(self):
+        _run_world(2)

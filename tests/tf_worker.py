@@ -1,0 +1,121 @@
+"""Worker for multi-process TensorFlow/Keras binding tests (reference
+analogue: `mpirun -np 2 pytest test_tensorflow.py`, SURVEY §4)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import tensorflow as tf  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == size
+
+    # -- allreduce (average default / sum / scaling) --
+    out = hvd.allreduce(tf.fill([4], float(rank)))
+    assert np.allclose(out.numpy(), sum(range(size)) / size)
+    out = hvd.allreduce(tf.ones([4]), op=hvd.Sum, prescale_factor=2.0,
+                        postscale_factor=0.5)
+    assert np.allclose(out.numpy(), size)
+
+    # gradient through allreduce
+    x = tf.Variable(tf.fill([3], float(rank)))
+    with tf.GradientTape() as tape:
+        y = tf.reduce_sum(hvd.allreduce(x, op=hvd.Sum))
+    g = tape.gradient(y, x)
+    assert np.allclose(g.numpy(), size), g.numpy()
+
+    # -- allreduce inside tf.function (graph mode via py_function) --
+    @tf.function
+    def graph_reduce(t):
+        return hvd.allreduce(t, op=hvd.Sum)
+
+    out = graph_reduce(tf.ones([5]))
+    assert np.allclose(out.numpy(), size)
+
+    # -- allgather (ragged) / broadcast / alltoall --
+    g = hvd.allgather(tf.fill([rank + 1, 2], float(rank)))
+    assert g.shape[0] == sum(r + 1 for r in range(size))
+    out = hvd.broadcast(tf.fill([4], float(rank)), root_rank=0)
+    assert np.allclose(out.numpy(), 0.0)
+    out, splits = hvd.alltoall(tf.range(size * 2, dtype=tf.float32))
+    assert out.shape[0] == size * 2 and list(splits.numpy()) == [2] * size
+
+    # -- broadcast_variables / broadcast_object / allgather_object --
+    v = tf.Variable(tf.fill([3], float(rank + 1)))
+    hvd.broadcast_variables([v], root_rank=0)
+    assert np.allclose(v.numpy(), 1.0)
+    obj = hvd.broadcast_object({"r": rank}, root_rank=0)
+    assert obj["r"] == 0
+    objs = hvd.allgather_object(rank)
+    assert objs == list(range(size))
+
+    # -- DistributedGradientTape: ranks converge identically --
+    tf.random.set_seed(0)
+    w = tf.Variable(tf.ones([3, 1]))
+    xb = tf.fill([1, 3], float(rank + 1))
+    with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+        loss = tf.reduce_sum(tf.matmul(xb, w))
+    (gw,) = tape.gradient(loss, [w])
+    mean_x = np.mean([r + 1 for r in range(size)])
+    assert np.allclose(gw.numpy(), mean_x), gw.numpy()
+
+    # -- Keras: DistributedOptimizer + callbacks through model.fit --
+    import keras
+
+    import horovod_tpu.keras as hvdk
+
+    keras.utils.set_random_seed(1234 + rank)  # intentionally different init
+    model = keras.Sequential([
+        keras.layers.Input(shape=(4,)),
+        keras.layers.Dense(8, activation="tanh"),
+        keras.layers.Dense(1),
+    ])
+    opt = hvdk.DistributedOptimizer(keras.optimizers.SGD(learning_rate=0.05))
+    model.compile(optimizer=opt, loss="mse")
+
+    rs = np.random.RandomState(100 + rank)  # different data per rank
+    xs = rs.randn(64, 4).astype(np.float32)
+    ys = xs.sum(axis=1, keepdims=True).astype(np.float32)
+    hist = model.fit(
+        xs, ys, batch_size=16, epochs=3, verbose=0,
+        callbacks=[
+            hvdk.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvdk.callbacks.MetricAverageCallback(),
+        ])
+    losses = hist.history["loss"]
+    assert losses[-1] < losses[0], losses
+
+    # Weights must be identical across ranks after synchronized training
+    flat = np.concatenate([w.flatten() for w in model.get_weights()])
+    gathered = hvd.allgather(tf.constant(flat[None, :]))
+    assert np.allclose(gathered.numpy()[0], gathered.numpy()[-1],
+                       atol=1e-5), "keras ranks diverged"
+
+    # MetricAverageCallback averaged the logged loss across ranks: all
+    # ranks log the same value
+    lv = hvd.allgather(tf.constant([[losses[-1]]]))
+    assert np.allclose(lv.numpy()[0], lv.numpy()[-1]), lv.numpy()
+
+    # -- KerasState sync --
+    state = hvdk.elastic.KerasState(model, epoch=rank)
+    state.sync()
+    assert state.epoch == 0
+
+    hvd.shutdown()
+    print(f"rank {rank}: tf worker OK")
+
+
+if __name__ == "__main__":
+    main()
