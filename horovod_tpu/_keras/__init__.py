@@ -22,15 +22,17 @@ def _world() -> int:
 
 
 def _allreduce_numpy(arr: np.ndarray, op=ReduceOp.AVERAGE,
-                     name=None) -> np.ndarray:
+                     name=None, prescale: float = 1.0,
+                     postscale: float = 1.0) -> np.ndarray:
     ctrl, world = C._eager_ctx()
     if world == 1:
-        return arr
+        scale = prescale * postscale
+        return arr if scale == 1.0 else arr * arr.dtype.type(scale)
     opmap = {ReduceOp.SUM: ctrl.SUM, ReduceOp.AVERAGE: ctrl.SUM}
-    post = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
+    post = postscale / world if op == ReduceOp.AVERAGE else postscale
     out = np.asarray(ctrl.allreduce_async(
         np.ascontiguousarray(arr), C._eager_name(name, "keras.allreduce"),
-        op=opmap[op], postscale=post).wait())
+        op=opmap[op], prescale=prescale, postscale=post).wait())
     return out.reshape(arr.shape)  # wire promotes scalars to rank 1
 
 
@@ -103,7 +105,9 @@ def create_distributed_optimizer(optimizer, compression=None,
                         np.issubdtype(arr.dtype, np.floating):
                     restore = arr.dtype
                     arr = arr.astype(wire_np_dtype)
-                red = _allreduce_numpy(arr, op=op, name=f"kgrad.{i}")
+                red = _allreduce_numpy(arr, op=op, name=f"kgrad.{i}",
+                                       prescale=prescale_factor,
+                                       postscale=postscale_factor)
                 return red.astype(restore) if restore is not None else red
 
             # Under the TF backend Keras compiles train_step into a

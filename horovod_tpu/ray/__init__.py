@@ -1,0 +1,226 @@
+"""Ray integration (reference: horovod/ray/, SURVEY §2.5).
+
+``RayExecutor`` runs a horovod_tpu world on Ray actors; the
+``Coordinator`` (reference: ray/runner.py:178-248) collects each worker's
+hostname, assigns ranks host-grouped (so local ranks share ICI), and
+builds the launcher env contract. ``ElasticRayExecutor`` (reference:
+ray/elastic.py:61) couples the elastic driver to Ray's cluster state
+through ``RayHostDiscovery``.
+
+ray is not bundled: actor machinery is gated at call time, while the
+Coordinator's assignment logic stays importable and unit-testable.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostDiscovery
+
+
+def _require_ray():
+    try:
+        import ray
+
+        return ray
+    except ImportError as e:
+        raise ImportError(
+            "horovod_tpu.ray requires ray; install ray or use "
+            "horovod_tpu.runner / horovod_tpu.spark") from e
+
+
+class Coordinator:
+    """Rank assignment + env contract from worker hostnames (reference:
+    ray/runner.py:178-248 — the part of RayExecutor that does not touch
+    ray itself)."""
+
+    def __init__(self):
+        self.hostnames_by_rank: "OrderedDict[str, List[int]]" = OrderedDict()
+        self._world_size = 0
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    def register(self, hostname: str, world_rank: int) -> None:
+        self.hostnames_by_rank.setdefault(hostname, []).append(world_rank)
+        self._world_size += 1
+
+    def finalize_registration(self) -> Dict[int, Dict[str, str]]:
+        """Env dict per world rank (reference: runner.py:218-248 —
+        HOROVOD_RANK/SIZE/LOCAL/CROSS per worker, host-grouped so chips on
+        one node get consecutive local ranks)."""
+        envs: Dict[int, Dict[str, str]] = {}
+        cross_size = len(self.hostnames_by_rank)
+        for cross_rank, (host, ranks) in enumerate(
+                self.hostnames_by_rank.items()):
+            for local_rank, world_rank in enumerate(sorted(ranks)):
+                envs[world_rank] = {
+                    "HOROVOD_RANK": str(world_rank),
+                    "HOROVOD_SIZE": str(self._world_size),
+                    "HOROVOD_LOCAL_RANK": str(local_rank),
+                    "HOROVOD_LOCAL_SIZE": str(len(ranks)),
+                    "HOROVOD_CROSS_RANK": str(cross_rank),
+                    "HOROVOD_CROSS_SIZE": str(cross_size),
+                    "HOROVOD_HOSTNAME": host,
+                }
+        return envs
+
+    def establish_rendezvous(self, controller_addr: str,
+                             controller_port: int) -> Dict[str, str]:
+        """Controller coordinates shared by every worker (reference:
+        runner.py establishes the gloo rendezvous env the same way)."""
+        return {
+            "HOROVOD_CONTROLLER_ADDR": controller_addr,
+            "HOROVOD_CONTROLLER_PORT": str(controller_port),
+        }
+
+
+class RayExecutor:
+    """Run a horovod_tpu job on Ray actors (reference: ray/runner.py:250-482
+    — start/run/run_remote/execute/shutdown)."""
+
+    def __init__(self, num_workers: int = 1, cpus_per_worker: int = 1,
+                 use_current_placement_group: bool = True):
+        self.num_workers = num_workers
+        self.cpus_per_worker = cpus_per_worker
+        self.use_current_placement_group = use_current_placement_group
+        self.workers: List[Any] = []
+        self._coordinator = Coordinator()
+
+    def start(self) -> None:
+        """Create worker actors and wire the env contract (reference:
+        runner.py:250-340)."""
+        ray = _require_ray()
+
+        @ray.remote(num_cpus=self.cpus_per_worker)
+        class _Worker:
+            def hostname(self):
+                import socket
+
+                return socket.gethostbyname(socket.gethostname())
+
+            def set_env(self, env):
+                import os
+
+                os.environ.update(env)
+                return True
+
+            def execute(self, fn, args, kwargs):
+                return fn(*(args or ()), **(kwargs or {}))
+
+        self.workers = [_Worker.remote() for _ in range(self.num_workers)]
+        hostnames = ray.get([w.hostname.remote() for w in self.workers])
+        for rank, host in enumerate(hostnames):
+            self._coordinator.register(host, rank)
+        envs = self._coordinator.finalize_registration()
+
+        from ..runner.network import find_free_port
+
+        rendezvous = self._coordinator.establish_rendezvous(
+            hostnames[0], find_free_port())
+        ray.get([
+            w.set_env.remote({**envs[rank], **rendezvous})
+            for rank, w in enumerate(self.workers)])
+
+    def run(self, fn: Callable, args=None, kwargs=None) -> List[Any]:
+        """Execute ``fn`` on every worker; rank-ordered results
+        (reference: runner.py:380-420)."""
+        ray = _require_ray()
+        return ray.get([w.execute.remote(fn, args, kwargs)
+                        for w in self.workers])
+
+    def execute(self, fn: Callable) -> List[Any]:
+        """Reference: runner.py execute(fn) — fn receives the worker."""
+        return self.run(lambda: fn(None))
+
+    def shutdown(self) -> None:
+        ray = _require_ray()
+        for w in self.workers:
+            ray.kill(w)
+        self.workers = []
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Elastic host discovery from Ray cluster state (reference:
+    ray/elastic.py:36-60)."""
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        ray = _require_ray()
+        hosts: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive"):
+                continue
+            res = node.get("Resources", {})
+            if self.use_gpu:
+                slots = int(res.get("GPU", 0)) // self.gpus_per_slot
+            else:
+                slots = int(res.get("CPU", 0)) // self.cpus_per_slot
+            if slots > 0:
+                hosts[node["NodeManagerAddress"]] = slots
+        return hosts
+
+
+class ElasticRayExecutor:
+    """Elastic executor over Ray actors (reference: ray/elastic.py:61-300):
+    couples the ElasticDriver + RayHostDiscovery, spawning a worker actor
+    per slot through the driver's create_worker_fn."""
+
+    def __init__(self, min_np: int = 1, max_np: Optional[int] = None,
+                 reset_limit: Optional[int] = None,
+                 use_gpu: bool = False, cpus_per_slot: int = 1):
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.discovery = RayHostDiscovery(use_gpu=use_gpu,
+                                          cpus_per_slot=cpus_per_slot)
+        self.driver = None
+
+    def start(self) -> None:
+        _require_ray()
+        from ..elastic.driver import ElasticDriver
+
+        self.driver = ElasticDriver(
+            self.discovery, min_np=self.min_np, max_np=self.max_np,
+            reset_limit=self.reset_limit)
+
+    def run(self, worker_fn: Callable) -> None:
+        """Launch `worker_fn` per slot as Ray actors under the elastic
+        driver (reference: elastic.py:200-300)."""
+        ray = _require_ray()
+        if self.driver is None:
+            self.start()
+
+        @ray.remote
+        def _slot_main(env, fn):
+            import os
+
+            os.environ.update(env)
+            return fn()
+
+        def create_worker(slot, world_id):
+            envs = {
+                "HOROVOD_RANK": str(slot.rank),
+                "HOROVOD_SIZE": str(slot.world_size),
+                "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+                "HOROVOD_LOCAL_SIZE": str(slot.local_size),
+                "HOROVOD_CROSS_RANK": str(slot.cross_rank),
+                "HOROVOD_CROSS_SIZE": str(slot.cross_size),
+                "HOROVOD_HOSTNAME": slot.hostname,
+                "HOROVOD_ELASTIC": "1",
+            }
+            try:
+                ray.get(_slot_main.remote(envs, worker_fn))
+                return 0
+            except Exception:
+                return 1
+
+        self.driver.start(create_worker)
+        self.driver.join()

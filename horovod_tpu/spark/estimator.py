@@ -1,0 +1,237 @@
+"""Spark ML estimators (reference: horovod/spark/keras/estimator.py:105 and
+spark/torch/estimator.py — fit(df) trains a distributed model on a Spark
+DataFrame and returns a transformer holding the trained model).
+
+TPU-native simplification: the reference materializes the DataFrame to
+Parquet and feeds it back through Petastorm readers (spark/common/util.py).
+Here each barrier task reads its own partition slice directly
+(df → per-rank numpy via mapPartitions) — no Petastorm dependency, and the
+feed path stays host-side numpy, which is what the TPU input pipeline
+wants anyway. The estimator params mirror the reference's surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from .store import Store
+
+
+class _EstimatorParams:
+    """Shared param validation (reference: spark/common/params.py)."""
+
+    def __init__(self, model=None, store: Optional[Store] = None,
+                 feature_cols: Optional[List[str]] = None,
+                 label_cols: Optional[List[str]] = None,
+                 batch_size: int = 32, epochs: int = 1,
+                 num_proc: Optional[int] = None,
+                 verbose: int = 1, run_id: Optional[str] = None,
+                 loss=None, optimizer=None):
+        if model is None:
+            raise ValueError("model is required")
+        if not feature_cols or not label_cols:
+            raise ValueError("feature_cols and label_cols are required")
+        self.model = model
+        self.store = store
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = int(batch_size)
+        self.epochs = int(epochs)
+        self.num_proc = num_proc
+        self.verbose = verbose
+        self.run_id = run_id or "run_1"
+        self.loss = loss
+        self.optimizer = optimizer
+
+
+class _ModelTransformer:
+    """Minimal Spark-ML-style transformer returned by fit() (reference:
+    keras/estimator.py KerasModel / torch/estimator.py TorchModel)."""
+
+    def __init__(self, model, feature_cols: List[str],
+                 label_cols: List[str], predict_fn: Callable):
+        self.model = model
+        self.feature_cols = feature_cols
+        self.label_cols = label_cols
+        self._predict_fn = predict_fn
+
+    def transform(self, df):
+        """Append prediction columns to ``df`` (driver-side batch predict;
+        the reference uses a pandas UDF — same contract)."""
+        import numpy as np
+        import pandas as pd
+
+        pdf = df.toPandas() if hasattr(df, "toPandas") else pd.DataFrame(df)
+        feats = np.asarray(pdf[self.feature_cols].values, dtype="float32")
+        preds = self._predict_fn(self.model, feats)
+        pdf = pdf.copy()
+        pdf["prediction"] = list(np.asarray(preds).reshape(len(pdf), -1))
+        return pdf
+
+
+def _collect_partition_numpy(df, feature_cols, label_cols, num_proc):
+    """df → list of (features, labels) numpy shards, one per rank."""
+    import numpy as np
+
+    rows = df.select(*feature_cols, *label_cols).collect()
+    feats = np.asarray([[r[c] for c in feature_cols] for r in rows],
+                       dtype="float32")
+    labels = np.asarray([[r[c] for c in label_cols] for r in rows],
+                        dtype="float32")
+    shards = []
+    per = max(1, len(rows) // num_proc)
+    for i in range(num_proc):
+        lo = i * per
+        hi = len(rows) if i == num_proc - 1 else (i + 1) * per
+        shards.append((feats[lo:hi], labels[lo:hi]))
+    return shards
+
+
+class KerasEstimator(_EstimatorParams):
+    """Keras estimator (reference: spark/keras/estimator.py:105-544).
+
+    ``fit(df)`` runs a barrier-stage horovod_tpu job: every rank trains the
+    Keras model on its shard with the distributed optimizer + broadcast
+    callbacks; rank 0's weights come back in the returned transformer.
+    """
+
+    def fit(self, df) -> _ModelTransformer:
+        from . import run as spark_run
+
+        num_proc = self.num_proc or 2
+        shards = _collect_partition_numpy(df, self.feature_cols,
+                                          self.label_cols, num_proc)
+        model_bytes = _serialize_keras(self.model)
+        loss = self.loss or "mse"
+        lr_opt = self.optimizer
+        batch_size, epochs = self.batch_size, self.epochs
+
+        def _train():
+            import numpy as np
+
+            import horovod_tpu.keras as hvd
+
+            hvd.init()
+            model = _deserialize_keras(model_bytes)
+            import keras
+
+            opt = lr_opt or keras.optimizers.Adam()
+            model.compile(optimizer=hvd.DistributedOptimizer(opt),
+                          loss=loss)
+            x, y = shards[hvd.rank()]
+            model.fit(x, y, batch_size=batch_size, epochs=epochs,
+                      verbose=0, callbacks=[
+                          hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                          hvd.callbacks.MetricAverageCallback(),
+                      ])
+            return [np.asarray(w) for w in model.get_weights()]
+
+        results = spark_run(_train, num_proc=num_proc)
+        self.model.set_weights(results[0])
+        if self.store is not None:
+            ckpt = self.store.get_checkpoint_path(self.run_id)
+            self.store.write(ckpt + "/model.keras",
+                             _serialize_keras(self.model))
+        return _ModelTransformer(
+            self.model, self.feature_cols, self.label_cols,
+            lambda m, f: m.predict(f, verbose=0))
+
+
+class TorchEstimator(_EstimatorParams):
+    """Torch estimator (reference: spark/torch/estimator.py:450)."""
+
+    def fit(self, df) -> _ModelTransformer:
+        import io
+
+        import torch
+
+        from . import run as spark_run
+
+        num_proc = self.num_proc or 2
+        shards = _collect_partition_numpy(df, self.feature_cols,
+                                          self.label_cols, num_proc)
+        buf = io.BytesIO()
+        torch.save(self.model, buf)
+        model_bytes = buf.getvalue()
+        loss_fn = self.loss or torch.nn.functional.mse_loss
+        batch_size, epochs = self.batch_size, self.epochs
+        opt_factory = self.optimizer or (
+            lambda params: torch.optim.Adam(params))
+
+        def _train():
+            import io as _io
+
+            import torch as T
+
+            import horovod_tpu.torch as hvd
+
+            hvd.init()
+            model = T.load(_io.BytesIO(model_bytes), weights_only=False)
+            opt = opt_factory(model.parameters())
+            opt = hvd.DistributedOptimizer(
+                opt, named_parameters=model.named_parameters())
+            hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+            x, y = shards[hvd.rank()]
+            xt, yt = T.from_numpy(x), T.from_numpy(y)
+            for _ in range(epochs):
+                for i in range(0, len(xt), batch_size):
+                    opt.zero_grad()
+                    out = model(xt[i:i + batch_size])
+                    loss = loss_fn(out, yt[i:i + batch_size])
+                    loss.backward()
+                    opt.step()
+            return {k: v.numpy() for k, v in model.state_dict().items()}
+
+        results = spark_run(_train, num_proc=num_proc)
+        import torch as T
+
+        self.model.load_state_dict(
+            {k: T.from_numpy(v) for k, v in results[0].items()})
+        return _ModelTransformer(
+            self.model, self.feature_cols, self.label_cols,
+            lambda m, f: m(__import__("torch").from_numpy(f))
+            .detach().numpy())
+
+
+def _serialize_keras(model) -> bytes:
+    import io
+
+    import keras
+
+    buf = io.BytesIO()
+    try:
+        keras.saving.save_model(model, buf, save_format="keras")
+        return buf.getvalue()
+    except TypeError:
+        # Older keras: save to a temp file path
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".keras")
+        os.close(fd)
+        try:
+            model.save(path)
+            with open(path, "rb") as f:
+                return f.read()
+        finally:
+            os.unlink(path)
+
+
+def _deserialize_keras(data: bytes):
+    import io
+    import os
+    import tempfile
+
+    import keras
+
+    try:
+        return keras.saving.load_model(io.BytesIO(data))
+    except TypeError:
+        fd, path = tempfile.mkstemp(suffix=".keras")
+        os.close(fd)
+        try:
+            with open(path, "wb") as f:
+                f.write(data)
+            return keras.saving.load_model(path)
+        finally:
+            os.unlink(path)

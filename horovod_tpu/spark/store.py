@@ -1,0 +1,140 @@
+"""Artifact stores for Spark estimators (reference:
+horovod/spark/common/store.py:30-480 — Store/LocalStore/HDFSStore manage
+train-data, checkpoint, and run-output locations)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class Store:
+    """Base artifact store (reference: store.py:30-120)."""
+
+    def get_train_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_test_data_path(self, idx=None) -> str:
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Pick a store from the path scheme (reference: store.py:99-110)."""
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path, *args, **kwargs)
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class LocalStore(Store):
+    """Filesystem-backed store (reference: store.py:123-230 — the default
+    for single-node and NFS setups)."""
+
+    def __init__(self, prefix_path: str):
+        self.prefix_path = os.path.abspath(prefix_path)
+        os.makedirs(self.prefix_path, exist_ok=True)
+
+    def _sub(self, *parts: str) -> str:
+        p = os.path.join(self.prefix_path, *parts)
+        os.makedirs(os.path.dirname(p) if "." in os.path.basename(p)
+                    else p, exist_ok=True)
+        return p
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_train_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_val_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_test_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+class HDFSStore(Store):
+    """HDFS-backed store (reference: store.py:233-480). Requires pyarrow's
+    HadoopFileSystem; gated at construction."""
+
+    def __init__(self, prefix_path: str, host: Optional[str] = None,
+                 port: Optional[int] = None, user: Optional[str] = None):
+        try:
+            from pyarrow import fs as pafs
+        except ImportError as e:
+            raise ImportError(
+                "HDFSStore requires pyarrow with HDFS support") from e
+        self.prefix_path = prefix_path
+        self._fs = pafs.HadoopFileSystem(
+            host=host or "default", port=port or 0, user=user)
+
+    def _sub(self, *parts: str) -> str:
+        base = self.prefix_path.rstrip("/")
+        return "/".join([base, *parts])
+
+    def get_train_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_train_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_val_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_val_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_test_data_path(self, idx=None) -> str:
+        return self._sub("intermediate_test_data" +
+                         (f".{idx}" if idx is not None else ""))
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id, "checkpoint")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id, "logs")
+
+    def exists(self, path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        info = self._fs.get_file_info([path.replace("hdfs://", "")])[0]
+        return info.type != pafs.FileType.NotFound
+
+    def read(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path.replace("hdfs://", "")) as f:
+            return f.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        with self._fs.open_output_stream(path.replace("hdfs://", "")) as f:
+            f.write(data)

@@ -1,0 +1,115 @@
+"""Spark/Ray platform integration tests (reference analogue:
+test/integration/test_spark.py + test/single/test_ray.py — run without a
+real cluster by exercising the pure coordination logic and gating)."""
+
+import pytest
+
+from horovod_tpu.ray import Coordinator, RayExecutor, RayHostDiscovery
+from horovod_tpu.spark import build_task_env
+from horovod_tpu.spark.store import LocalStore, Store
+from horovod_tpu.spark.estimator import (
+    KerasEstimator,
+    TorchEstimator,
+    _EstimatorParams,
+)
+
+
+class TestSparkTaskEnv:
+    def test_single_host(self):
+        env = build_task_env(1, ["h1", "h1", "h1"], 9000)
+        assert env["HOROVOD_RANK"] == "1"
+        assert env["HOROVOD_SIZE"] == "3"
+        assert env["HOROVOD_LOCAL_RANK"] == "1"
+        assert env["HOROVOD_LOCAL_SIZE"] == "3"
+        assert env["HOROVOD_CROSS_RANK"] == "0"
+        assert env["HOROVOD_CROSS_SIZE"] == "1"
+        assert env["HOROVOD_CONTROLLER_ADDR"] == "h1"
+        assert env["HOROVOD_CONTROLLER_PORT"] == "9000"
+
+    def test_multi_host_grouping(self):
+        addrs = ["a", "a", "b", "b"]
+        env2 = build_task_env(2, addrs, 9000)
+        assert env2["HOROVOD_LOCAL_RANK"] == "0"
+        assert env2["HOROVOD_CROSS_RANK"] == "1"
+        assert env2["HOROVOD_CROSS_SIZE"] == "2"
+        env3 = build_task_env(3, addrs, 9000)
+        assert env3["HOROVOD_LOCAL_RANK"] == "1"
+        # controller always lives with rank 0's host
+        assert env3["HOROVOD_CONTROLLER_ADDR"] == "a"
+
+    def test_base_env_preserved(self):
+        env = build_task_env(0, ["h"], 1, base_env={"FOO": "bar"})
+        assert env["FOO"] == "bar"
+
+
+class TestSparkGating:
+    def test_run_requires_pyspark(self):
+        import horovod_tpu.spark as sp
+
+        with pytest.raises(ImportError, match="pyspark"):
+            sp.run(lambda: None, num_proc=2)
+
+    def test_estimator_param_validation(self):
+        with pytest.raises(ValueError, match="model"):
+            _EstimatorParams(model=None, feature_cols=["x"],
+                             label_cols=["y"])
+        with pytest.raises(ValueError, match="feature_cols"):
+            _EstimatorParams(model=object(), feature_cols=None,
+                             label_cols=["y"])
+
+
+class TestLocalStore:
+    def test_paths_and_io(self, tmp_path):
+        store = LocalStore(str(tmp_path / "artifacts"))
+        ckpt = store.get_checkpoint_path("run_7")
+        assert "run_7" in ckpt
+        store.write(ckpt + "/weights.bin", b"abc123")
+        assert store.exists(ckpt + "/weights.bin")
+        assert store.read(ckpt + "/weights.bin") == b"abc123"
+        assert store.get_train_data_path(0).endswith(
+            "intermediate_train_data.0")
+
+    def test_create_picks_local(self, tmp_path):
+        s = Store.create(str(tmp_path / "x"))
+        assert isinstance(s, LocalStore)
+
+
+class TestRayCoordinator:
+    def test_single_node(self):
+        c = Coordinator()
+        for r in range(4):
+            c.register("n1", r)
+        envs = c.finalize_registration()
+        assert c.world_size == 4
+        assert envs[2]["HOROVOD_LOCAL_RANK"] == "2"
+        assert envs[2]["HOROVOD_CROSS_SIZE"] == "1"
+
+    def test_multi_node_host_grouping(self):
+        c = Coordinator()
+        c.register("n1", 0)
+        c.register("n1", 1)
+        c.register("n2", 2)
+        c.register("n2", 3)
+        envs = c.finalize_registration()
+        assert envs[3]["HOROVOD_LOCAL_RANK"] == "1"
+        assert envs[3]["HOROVOD_CROSS_RANK"] == "1"
+        assert envs[3]["HOROVOD_LOCAL_SIZE"] == "2"
+        assert envs[0]["HOROVOD_SIZE"] == "4"
+
+    def test_rendezvous_env(self):
+        c = Coordinator()
+        env = c.establish_rendezvous("10.0.0.1", 12345)
+        assert env == {"HOROVOD_CONTROLLER_ADDR": "10.0.0.1",
+                       "HOROVOD_CONTROLLER_PORT": "12345"}
+
+
+class TestRayGating:
+    def test_executor_requires_ray(self):
+        ex = RayExecutor(num_workers=2)
+        with pytest.raises(ImportError, match="ray"):
+            ex.start()
+
+    def test_discovery_requires_ray(self):
+        d = RayHostDiscovery()
+        with pytest.raises(ImportError, match="ray"):
+            d.find_available_hosts_and_slots()
