@@ -58,9 +58,11 @@ class MetricAverageCallback(keras.callbacks.Callback):
 
 
 class LearningRateWarmupCallback(keras.callbacks.Callback):
-    """Linear LR warmup from base_lr to base_lr*size over warmup_epochs
-    (reference: _keras/callbacks.py:90-152, implementing the Goyal et al.
-    gradual-warmup rule the reference documents)."""
+    """Linear LR warmup from ``initial_lr / size`` up to ``initial_lr``
+    over warmup_epochs (reference: _keras/callbacks.py:90-152, the Goyal et
+    al. gradual-warmup rule). As in the reference, ``initial_lr`` is the
+    *already size-scaled* learning rate the script configured — warmup ramps
+    up to it, never beyond it."""
 
     def __init__(self, initial_lr: float, warmup_epochs: int = 5,
                  steps_per_epoch: int = None, verbose: int = 0):
@@ -87,15 +89,16 @@ class LearningRateWarmupCallback(keras.callbacks.Callback):
         progress = min(1.0, (self.current_epoch + batch / steps)
                        / self.warmup_epochs)
         size = self._size()
-        multiplier = 1.0 + progress * (size - 1.0)
+        # Reference multiplier (_keras/callbacks.py:139-143):
+        # 1/size * (progress*(size-1) + 1) — from 1/size up to 1.
+        multiplier = (1.0 + progress * (size - 1.0)) / size
         self.model.optimizer.learning_rate = self.initial_lr * multiplier
         self._steps_seen += 1
 
     def on_epoch_end(self, epoch, logs=None):
         if epoch == self.warmup_epochs - 1 and self.verbose and \
                 int(_basics.rank()) == 0:
-            print(f"warmup complete: lr -> "
-                  f"{self.initial_lr * self._size():.6g}")
+            print(f"warmup complete: lr -> {self.initial_lr:.6g}")
 
 
 class LearningRateScheduleCallback(keras.callbacks.Callback):

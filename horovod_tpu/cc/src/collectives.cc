@@ -183,6 +183,35 @@ Status RingAllreduce(Transport& t, void* buf, int64_t count, DataType dt,
   return Status::OK();
 }
 
+namespace {
+
+// Ring allgather of variable-sized byte blocks within an arbitrary rank
+// group (block b lives at out[offs[b]..offs[b+1]]; my_idx's block must be
+// filled before the call). The cross-host leg of HierarchicalAllgatherV runs
+// this over the leader group; AllgatherV is the full-world specialization.
+Status RingAllgatherBlocks(Transport& t, char* out,
+                           const std::vector<int64_t>& offs,
+                           const std::vector<int64_t>& block_bytes,
+                           const std::vector<int>& group, int my_idx) {
+  int n = static_cast<int>(group.size());
+  if (n <= 1) return Status::OK();
+  int right = group[(my_idx + 1) % n];
+  int left = group[(my_idx - 1 + n) % n];
+  for (int s = 0; s < n - 1; ++s) {
+    int send_b = ((my_idx - s) % n + n) % n;
+    int recv_b = ((my_idx - s - 1) % n + n) % n;
+    if (!t.RingExchange(right, out + offs[send_b],
+                        static_cast<size_t>(block_bytes[send_b]), left,
+                        out + offs[recv_b],
+                        static_cast<size_t>(block_bytes[recv_b]))) {
+      return Status::UnknownError("ring allgather: peer connection lost");
+    }
+  }
+  return Status::OK();
+}
+
+}  // namespace
+
 Status AllgatherV(Transport& t, const void* in, int64_t in_bytes,
                   const std::vector<int64_t>& bytes_per_rank,
                   std::vector<char>* out) {
@@ -231,6 +260,159 @@ Status Broadcast(Transport& t, void* buf, int64_t bytes, int root) {
       if (!t.RecvFromRank(src, buf, static_cast<size_t>(bytes))) {
         return Status::UnknownError("broadcast: peer connection lost");
       }
+    }
+  }
+  return Status::OK();
+}
+
+Status HierarchicalAllreduce(Transport& t, void* buf, int64_t count,
+                             DataType dt, ReduceOp op, const Topology& topo) {
+  if (!topo.Hierarchical(t.size(), t.rank()) || count == 0) {
+    return RingAllreduce(t, buf, count, dt, op);
+  }
+  size_t es = DataTypeSize(dt);
+  int leader = topo.cross_rank * topo.local_size;  // local_rank 0 on my host
+  bool is_leader = topo.local_rank == 0;
+
+  // 1. Intra-host reduce to the leader (loopback TCP; the reference's
+  //    intra-node NCCL ReduceScatter leg, nccl_operations.cc:232-242).
+  if (is_leader) {
+    std::vector<char> tmp(static_cast<size_t>(count) * es);
+    for (int lr = 1; lr < topo.local_size; ++lr) {
+      if (!t.RecvFromRank(leader + lr, tmp.data(), tmp.size())) {
+        return Status::UnknownError("hier allreduce: local peer lost");
+      }
+      ReduceInto(buf, tmp.data(), count, dt, op);
+    }
+  } else {
+    if (!t.SendToRank(leader, buf, static_cast<size_t>(count) * es)) {
+      return Status::UnknownError("hier allreduce: leader lost");
+    }
+  }
+
+  // 2. Ring allreduce among leaders — the only cross-host traffic
+  //    (reference: the parallel cross-node MPI_Allreduce leg,
+  //    nccl_operations.cc:244-307).
+  if (is_leader) {
+    int size = topo.cross_size, rank = topo.cross_rank;
+    auto chunk_count = [&](int c) {
+      return count / size + (c < count % size ? 1 : 0);
+    };
+    std::vector<int64_t> offs(static_cast<size_t>(size) + 1, 0);
+    for (int c = 0; c < size; ++c) offs[c + 1] = offs[c] + chunk_count(c);
+    int right = ((rank + 1) % size) * topo.local_size;
+    int left = ((rank - 1 + size) % size) * topo.local_size;
+    char* base = static_cast<char*>(buf);
+    std::vector<char> recv_tmp(static_cast<size_t>(chunk_count(0)) * es);
+    for (int s = 0; s < size - 1; ++s) {
+      int send_c = ((rank - s) % size + size) % size;
+      int recv_c = ((rank - s - 1) % size + size) % size;
+      int64_t sc = chunk_count(send_c), rc = chunk_count(recv_c);
+      if (!t.RingExchange(right, base + offs[send_c] * es,
+                          static_cast<size_t>(sc) * es, left, recv_tmp.data(),
+                          static_cast<size_t>(rc) * es)) {
+        return Status::UnknownError("hier allreduce: cross peer lost");
+      }
+      ReduceInto(base + offs[recv_c] * es, recv_tmp.data(), rc, dt, op);
+    }
+    for (int s = 0; s < size - 1; ++s) {
+      int send_c = ((rank + 1 - s) % size + size) % size;
+      int recv_c = ((rank - s) % size + size) % size;
+      if (!t.RingExchange(right, base + offs[send_c] * es,
+                          static_cast<size_t>(chunk_count(send_c)) * es, left,
+                          base + offs[recv_c] * es,
+                          static_cast<size_t>(chunk_count(recv_c)) * es)) {
+        return Status::UnknownError("hier allreduce: cross peer lost");
+      }
+    }
+  }
+
+  // 3. Intra-host broadcast of the reduced buffer (the reference's
+  //    intra-node ncclAllgather leg).
+  if (is_leader) {
+    for (int lr = 1; lr < topo.local_size; ++lr) {
+      if (!t.SendToRank(leader + lr, buf, static_cast<size_t>(count) * es)) {
+        return Status::UnknownError("hier allreduce: local peer lost");
+      }
+    }
+  } else {
+    if (!t.RecvFromRank(leader, buf, static_cast<size_t>(count) * es)) {
+      return Status::UnknownError("hier allreduce: leader lost");
+    }
+  }
+  return Status::OK();
+}
+
+Status HierarchicalAllgatherV(Transport& t, const void* in, int64_t in_bytes,
+                              const std::vector<int64_t>& bytes_per_rank,
+                              std::vector<char>* out, const Topology& topo) {
+  int size = t.size(), rank = t.rank();
+  if (!topo.Hierarchical(size, rank)) {
+    return AllgatherV(t, in, in_bytes, bytes_per_rank, out);
+  }
+  std::vector<int64_t> offs(static_cast<size_t>(size) + 1, 0);
+  for (int i = 0; i < size; ++i) offs[i + 1] = offs[i] + bytes_per_rank[i];
+  int64_t total = offs[size];
+  out->resize(static_cast<size_t>(total));
+  if (bytes_per_rank[rank] != in_bytes) {
+    return Status::InvalidArgument("hier allgatherv: local size mismatch");
+  }
+  std::memcpy(out->data() + offs[rank], in, static_cast<size_t>(in_bytes));
+
+  int leader = topo.cross_rank * topo.local_size;
+  bool is_leader = topo.local_rank == 0;
+
+  // 1. Intra-host gather into the leader's buffer at final offsets
+  //    (reference: node leaders assemble through POSIX shared memory,
+  //    mpi_operations.cc:213-246).
+  if (is_leader) {
+    for (int lr = 1; lr < topo.local_size; ++lr) {
+      int r = leader + lr;
+      if (bytes_per_rank[r] > 0 &&
+          !t.RecvFromRank(r, out->data() + offs[r],
+                          static_cast<size_t>(bytes_per_rank[r]))) {
+        return Status::UnknownError("hier allgather: local peer lost");
+      }
+    }
+  } else if (in_bytes > 0) {
+    if (!t.SendToRank(leader, in, static_cast<size_t>(in_bytes))) {
+      return Status::UnknownError("hier allgather: leader lost");
+    }
+  }
+
+  // 2. Ring allgather of per-host superblocks among leaders — the only
+  //    cross-host traffic (reference: MPI_Allgatherv over node leaders,
+  //    mpi_operations.cc:248-259). Host h's superblock is the contiguous
+  //    range [offs[h*ls], offs[(h+1)*ls]) thanks to host-major rank packing.
+  if (is_leader) {
+    int ls = topo.local_size, cs = topo.cross_size;
+    std::vector<int64_t> hoffs(static_cast<size_t>(cs) + 1, 0);
+    std::vector<int64_t> hbytes(static_cast<size_t>(cs), 0);
+    std::vector<int> group(static_cast<size_t>(cs));
+    for (int h = 0; h < cs; ++h) {
+      hoffs[h] = offs[static_cast<size_t>(h) * ls];
+      hbytes[h] = offs[static_cast<size_t>(h + 1) * ls] -
+                  offs[static_cast<size_t>(h) * ls];
+      group[h] = h * ls;
+    }
+    hoffs[cs] = total;
+    Status s = RingAllgatherBlocks(t, out->data(), hoffs, hbytes, group,
+                                   topo.cross_rank);
+    if (!s.ok()) return s;
+  }
+
+  // 3. Intra-host broadcast of the assembled result (reference: non-leader
+  //    ranks read the shared-memory window, mpi_operations.cc:261-276).
+  if (is_leader) {
+    for (int lr = 1; lr < topo.local_size; ++lr) {
+      if (!t.SendToRank(leader + lr, out->data(),
+                        static_cast<size_t>(total))) {
+        return Status::UnknownError("hier allgather: local peer lost");
+      }
+    }
+  } else {
+    if (!t.RecvFromRank(leader, out->data(), static_cast<size_t>(total))) {
+      return Status::UnknownError("hier allgather: leader lost");
     }
   }
   return Status::OK();

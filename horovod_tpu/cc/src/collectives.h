@@ -32,6 +32,35 @@ Status AllgatherV(Transport& t, const void* in, int64_t in_bytes,
                   const std::vector<int64_t>& bytes_per_rank,
                   std::vector<char>* out);
 
+// Host/chip topology for hierarchical decompositions. Ranks are host-major:
+// rank = cross_rank * local_size + local_rank (the launcher's packing,
+// reference hosts.py:100-150), leaders are the local_rank-0 ranks.
+struct Topology {
+  int local_rank = 0, local_size = 1, cross_rank = 0, cross_size = 1;
+  bool Hierarchical(int world_size, int world_rank) const {
+    return local_size > 1 && cross_size > 1 &&
+           local_size * cross_size == world_size &&
+           cross_rank * local_size + local_rank == world_rank;
+  }
+};
+
+// Hierarchical allreduce: intra-host reduce to the local leader → ring
+// allreduce among leaders (the only cross-host traffic) → intra-host
+// broadcast. Reference: NCCLHierarchicalAllreduce's intra-RS → cross-AR →
+// intra-AG decomposition (nccl_operations.cc:190-380) with the intra legs on
+// loopback TCP standing in for NCCL/shared memory.
+Status HierarchicalAllreduce(Transport& t, void* buf, int64_t count,
+                             DataType dt, ReduceOp op, const Topology& topo);
+
+// Hierarchical allgatherv: intra-host gather to the local leader →
+// ring allgather of per-host superblocks among leaders → intra-host
+// broadcast of the assembled result. Reference: MPIHierarchicalAllgather
+// (mpi_operations.cc:180-280; node leaders gather through shared memory,
+// cross leg over MPI).
+Status HierarchicalAllgatherV(Transport& t, const void* in, int64_t in_bytes,
+                              const std::vector<int64_t>& bytes_per_rank,
+                              std::vector<char>* out, const Topology& topo);
+
 // Broadcast `bytes` from `root` (binomial tree, log2(size) rounds).
 Status Broadcast(Transport& t, void* buf, int64_t bytes, int root);
 

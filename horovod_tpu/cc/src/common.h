@@ -137,6 +137,8 @@ uint16_t FloatToFp16(float f);
 #define HVDTPU_ENV_CONTROLLER_ADDR "HOROVOD_CONTROLLER_ADDR"
 #define HVDTPU_ENV_CONTROLLER_PORT "HOROVOD_CONTROLLER_PORT"
 #define HVDTPU_ENV_FUSION_THRESHOLD "HOROVOD_FUSION_THRESHOLD"
+#define HVDTPU_ENV_HIERARCHICAL_ALLREDUCE "HOROVOD_HIERARCHICAL_ALLREDUCE"
+#define HVDTPU_ENV_HIERARCHICAL_ALLGATHER "HOROVOD_HIERARCHICAL_ALLGATHER"
 #define HVDTPU_ENV_CYCLE_TIME "HOROVOD_CYCLE_TIME"
 #define HVDTPU_ENV_CACHE_CAPACITY "HOROVOD_CACHE_CAPACITY"
 #define HVDTPU_ENV_TIMELINE "HOROVOD_TIMELINE"

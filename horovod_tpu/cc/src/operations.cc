@@ -51,6 +51,10 @@ struct Global {
 
   std::atomic<int64_t> fusion_threshold{64 * 1024 * 1024};
   std::atomic<int64_t> cycle_time_us{1000};
+  // Hierarchical decomposition knobs (reference: operations.cc:463-487);
+  // atomics so the autotuner can flip them between cycles.
+  std::atomic<bool> hierarchical_allreduce{false};
+  std::atomic<bool> hierarchical_allgather{false};
   std::atomic<bool> shutdown_requested{false};
   std::atomic<bool> loop_running{false};
 
@@ -162,9 +166,22 @@ void FillIdentity(void* buf, int64_t count, DataType dt, ReduceOp op) {
   }
 }
 
+collectives::Topology MakeTopology(const Global& gs) {
+  collectives::Topology topo;
+  topo.local_rank = gs.local_rank;
+  topo.local_size = gs.local_size;
+  topo.cross_rank = gs.cross_rank;
+  topo.cross_size = gs.cross_size;
+  return topo;
+}
+
 Status RunAllreduceWire(Global& gs, void* buf, int64_t count, DataType dt,
                         ReduceOp op) {
   if (op != ReduceOp::ADASUM) {
+    if (gs.hierarchical_allreduce.load()) {
+      return collectives::HierarchicalAllreduce(*gs.transport, buf, count,
+                                                dt, op, MakeTopology(gs));
+    }
     return collectives::RingAllreduce(*gs.transport, buf, count, dt, op);
   }
   // Adasum: widen 16-bit floats to f32 for the dot-product math
@@ -293,8 +310,13 @@ void PerformOperation(Global& gs, const Response& resp) {
       std::vector<char>* out = e ? &e->output : &scratch;
       const void* in = e ? e->data : nullptr;
       int64_t in_bytes = e ? bytes_per_rank[gs.rank] : 0;
-      Status s = collectives::AllgatherV(*gs.transport, in, in_bytes,
-                                         bytes_per_rank, out);
+      Status s =
+          gs.hierarchical_allgather.load()
+              ? collectives::HierarchicalAllgatherV(*gs.transport, in,
+                                                    in_bytes, bytes_per_rank,
+                                                    out, MakeTopology(gs))
+              : collectives::AllgatherV(*gs.transport, in, in_bytes,
+                                        bytes_per_rank, out);
       gs.timeline.End(lane);
       if (e) e->MarkDone(s);
       break;
@@ -490,6 +512,31 @@ int hvdtpu_init(void) {
 
   gs->fusion_threshold.store(
       EnvInt64(HVDTPU_ENV_FUSION_THRESHOLD, 64 * 1024 * 1024));
+  gs->hierarchical_allreduce.store(
+      EnvBool(HVDTPU_ENV_HIERARCHICAL_ALLREDUCE, false));
+  gs->hierarchical_allgather.store(
+      EnvBool(HVDTPU_ENV_HIERARCHICAL_ALLGATHER, false));
+  if (gs->hierarchical_allreduce.load() || gs->hierarchical_allgather.load()) {
+    // Fail fast on a rank layout the hierarchical decomposition cannot
+    // honor. The check must not silently fall back per-rank: ranks whose
+    // identity happens to satisfy it would take the hierarchical path
+    // while others go flat — mixed protocols on one transport deadlock
+    // mid-collective. Dying at init on any rank kills the job cleanly.
+    if (gs->local_size < 1 || gs->cross_size < 1 ||
+        gs->local_size * gs->cross_size != gs->size ||
+        gs->cross_rank * gs->local_size + gs->local_rank != gs->rank) {
+      HVDTPU_LOG(ERROR)
+          << "hierarchical collectives require host-major rank "
+                << "packing (rank = cross_rank*local_size + local_rank and "
+                << "local_size*cross_size == size); got rank=" << gs->rank
+                << " size=" << gs->size << " local=" << gs->local_rank << "/"
+                << gs->local_size << " cross=" << gs->cross_rank << "/"
+                << gs->cross_size
+                << ". Fix the launcher env or unset "
+                << "HOROVOD_HIERARCHICAL_ALLREDUCE/ALLGATHER.";
+      return 1;
+    }
+  }
   // HOROVOD_CYCLE_TIME is milliseconds in the reference (default 5,
   // operations.cc:445); host TCP negotiation is cheap so default 1 ms.
   gs->cycle_time_us.store(static_cast<int64_t>(

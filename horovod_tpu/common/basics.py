@@ -92,7 +92,9 @@ def _build_mesh(
     or to re-slice a multi-slice pod.
     """
     if devices is None:
-        devices = jax.devices()
+        from .backend import acquire_devices
+
+        devices = acquire_devices()
     devices = list(devices)
     if mesh_shape is not None:
         cross, local = mesh_shape

@@ -253,6 +253,10 @@ def allreduce(
                 )
             red = _reduce_in_jit(compressed, op, axes_t, bool(hierarchical))
     else:
+        if hierarchical is not None:
+            raise ValueError(
+                "allreduce(hierarchical=...) is only supported in-jit; set "
+                "HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
         red = _eager_allreduce(compressed, op, name)
     red = compression.decompress(red, ctx)
     return _scale(red, postscale_factor)
@@ -266,7 +270,8 @@ def grouped_allreduce(tensors: Sequence, **kwargs):
     return [allreduce(t, **kwargs) for t in tensors]
 
 
-def allgather(tensor, *, name: Optional[str] = None, axes=None):
+def allgather(tensor, *, name: Optional[str] = None, axes=None,
+              hierarchical: Optional[bool] = None):
     """Gather tensors from all ranks, concatenated along dim 0.
 
     Reference: hvd.allgather (torch/mpi_ops.py:230-291). The reference
@@ -274,6 +279,15 @@ def allgather(tensor, *, name: Optional[str] = None, axes=None):
     shapes are static, so in-jit all shards must share a shape — ragged
     gathers belong on the eager path (allgather_object in
     parallel/functions.py covers the reference's ragged use cases).
+
+    ``hierarchical`` (default: the ``HOROVOD_HIERARCHICAL_ALLGATHER`` knob,
+    reference operations.cc:463-472 / MPIHierarchicalAllgather,
+    mpi_operations.cc:180-280) decomposes the world gather into an intra-host
+    gather over ICI followed by a cross-host gather of per-host superblocks
+    over DCN. Host-major rank packing makes the two orderings identical, so
+    numerics match the flat gather exactly. The eager path honors the same
+    knob inside the native core (cc/src/collectives.cc
+    HierarchicalAllgatherV).
     """
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
@@ -282,7 +296,26 @@ def allgather(tensor, *, name: Optional[str] = None, axes=None):
             # Equal contribution from every rank: the gather is a local tile.
             reps = (_world_size(axes_t),) + (1,) * (tensor.ndim - 1)
             return jnp.tile(tensor, reps)
+        if hierarchical is None:
+            hierarchical = (basics.is_initialized()
+                            and basics.config().hierarchical_allgather)
+        # Exact tuple match: the two-stage decomposition reproduces the
+        # cross-major concatenation of axes=(cross, local); a reversed axes
+        # tuple means local-major order and must stay on the flat path.
+        if hierarchical and axes_t == HVD_AXES:
+            # Local (ICI) gather first, then cross (DCN) gather of the
+            # per-host superblocks; rank order = (cross, local) lex order =
+            # the flat gather's order.
+            local = lax.all_gather(tensor, LOCAL_AXIS, axis=0, tiled=True)
+            return lax.all_gather(local, CROSS_AXIS, axis=0, tiled=True)
         return lax.all_gather(tensor, axes_t, axis=0, tiled=True)
+    if hierarchical is not None:
+        # The eager data plane takes its hierarchical decision from the
+        # process-wide HOROVOD_HIERARCHICAL_ALLGATHER knob inside the
+        # native core; a per-call override cannot be honored there.
+        raise ValueError(
+            "allgather(hierarchical=...) is only supported in-jit; set "
+            "HOROVOD_HIERARCHICAL_ALLGATHER for the eager path")
     return _eager_allgather(tensor, name)
 
 

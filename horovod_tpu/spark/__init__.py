@@ -125,15 +125,48 @@ def run_elastic(fn: Callable[..., Any],
                 num_proc: Optional[int] = None,
                 min_np: Optional[int] = None,
                 max_np: Optional[int] = None,
+                reset_limit: Optional[int] = None,
                 verbose: int = 0) -> List[Any]:
-    """Elastic variant (reference: spark/runner.py:303+). Spark barrier
-    stages are gang-scheduled and cannot grow mid-stage, so elasticity maps
-    to Spark's own stage retry: a failed stage is resubmitted with the
-    current executor set, and ``fn`` is expected to be wrapped in
-    ``hvd.elastic.run`` with committed state for fast recovery."""
+    """Run ``fn`` elastically on Spark executors (reference:
+    horovod.spark.run_elastic, spark/runner.py:303-417).
+
+    Architecture (mirroring the reference's task-service design): a
+    non-barrier stage of ``max_np`` long-lived tasks registers with a
+    driver-side :class:`~horovod_tpu.spark.elastic.TaskDispatcher`; the
+    :class:`~horovod_tpu.elastic.driver.ElasticDriver` discovers hosts from
+    the live-task registry and execs workers *through* the tasks as
+    subprocesses. Executor loss ages the host out of discovery and triggers
+    the normal elastic reshuffle. ``fn`` must use ``hvd.elastic.run`` with
+    committed state, exactly as in the reference.
+
+    Returns the final world's rank-ordered ``fn`` results.
+    """
     _require_pyspark()
-    return run(fn, args=args, kwargs=kwargs, num_proc=num_proc,
-               verbose=verbose)
+    import threading
+
+    from pyspark.sql import SparkSession
+
+    from .elastic import run_elastic_core, task_loop
+
+    spark = SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    if num_proc is None:
+        num_proc = max(1, int(sc.defaultParallelism))
+    n_tasks = max_np or num_proc
+
+    def launch_tasks(fn_blob, addr, port, key):
+        def _task(_):
+            yield task_loop(addr, port, key, fn_blob)
+
+        rdd = sc.parallelize(range(n_tasks), n_tasks)
+        t = threading.Thread(
+            target=lambda: rdd.mapPartitions(_task).collect(), daemon=True)
+        t.start()
+        return t
+
+    return run_elastic_core(
+        launch_tasks, fn, args=args, kwargs=kwargs, num_proc=num_proc,
+        min_np=min_np, max_np=max_np, reset_limit=reset_limit)
 
 
 from .estimator import KerasEstimator, TorchEstimator  # noqa: F401,E402

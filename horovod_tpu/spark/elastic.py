@@ -1,0 +1,403 @@
+"""Elastic Spark jobs (reference: horovod.spark.run_elastic,
+spark/runner.py:303-417).
+
+The reference runs `num_proc` long-lived Spark tasks, each hosting a task
+service; the gloo elastic launcher then execs workers *through* those task
+services, with host discovery reading the set of live tasks
+(spark/driver/host_discovery.py). This module is the same architecture on
+horovod_tpu's primitives:
+
+- ``TaskDispatcher`` — an HMAC RPC service on the Spark driver. Spark tasks
+  register (host), then poll for commands; the ElasticDriver's
+  ``create_worker_fn`` dispatches a "spawn worker for slot X" command to an
+  idle task on the right host and blocks until the task reports the worker's
+  exit code (the role of the reference's ``SparkTaskService.run_command``).
+- ``SparkTaskDiscovery`` — elastic host discovery = hosts with live
+  registered tasks (reference: host_discovery.py). A task that stops
+  polling (executor lost) ages out, so Spark executor loss shows up as a
+  host-removed event and triggers the normal elastic reshuffle.
+- ``task_loop`` — runs inside each Spark task: register → poll → spawn the
+  worker **subprocess** (crashes must kill the worker, not the task) →
+  report rc (+ pickled fn result) → repeat until shutdown.
+- ``run_elastic`` — the thin pyspark wrapper: launch the task stage in a
+  background thread and drive ``ElasticDriver`` over the dispatcher. The
+  pyspark-free core (``run_elastic_core``) is what the tests exercise with
+  plain subprocess "tasks", mirroring the reference's mocked-ssh strategy
+  (SURVEY §4).
+
+Workers receive only identity + driver-service env (hostname, local_rank,
+HOROVOD_ELASTIC_DRIVER_ADDR/PORT/KEY); rank/size arrive via rendezvous, so
+resizes stay correct. ``fn`` is expected to use ``hvd.elastic.run`` with
+committed state, as in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..elastic.driver import ElasticDriver
+from ..elastic.discovery import HostDiscovery
+from ..runner import network, secret
+
+_POLL_INTERVAL_SECS = 0.2
+_TASK_STALE_SECS = 10.0
+
+
+# ---------------------------------------------------------------- wire types
+
+
+class RegisterTaskRequest:
+    def __init__(self, host: str):
+        self.host = host
+
+
+class RegisterTaskResponse:
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+
+
+class PollCommandRequest:
+    def __init__(self, task_id: int):
+        self.task_id = task_id
+
+
+class CommandResponse:
+    # command ∈ None | {"type": "spawn", "command_id": int, "env": dict}
+    #         | {"type": "shutdown"}
+    def __init__(self, command: Optional[dict]):
+        self.command = command
+
+
+class ReportResultRequest:
+    def __init__(self, task_id: int, command_id: int, rc: int,
+                 result: Optional[bytes] = None):
+        self.task_id = task_id
+        self.command_id = command_id
+        self.rc = rc
+        self.result = result
+
+
+# ---------------------------------------------------------------- dispatcher
+
+
+class _TaskState:
+    def __init__(self, host: str):
+        self.host = host
+        self.last_seen = time.monotonic()
+        self.queue: List[dict] = []
+        self.busy = False
+
+
+class TaskDispatcher(network.BasicService):
+    """Driver-side command dispatch to registered Spark tasks."""
+
+    def __init__(self, key: Optional[bytes] = None):
+        self.key = key or secret.make_secret_key()
+        super().__init__("spark task dispatcher", self.key)
+        self._lock = threading.Condition()
+        self._tasks: Dict[int, _TaskState] = {}
+        self._next_task = 0
+        self._next_command = 0
+        self._results: Dict[int, Tuple[int, Optional[bytes]]] = {}
+        self._shutdown = False
+
+    # -- RPC ----------------------------------------------------------------
+
+    def _handle(self, req, client_address):
+        if isinstance(req, RegisterTaskRequest):
+            with self._lock:
+                tid = self._next_task
+                self._next_task += 1
+                self._tasks[tid] = _TaskState(req.host)
+                self._lock.notify_all()
+            return RegisterTaskResponse(tid)
+        if isinstance(req, PollCommandRequest):
+            with self._lock:
+                t = self._tasks.get(req.task_id)
+                if t is None:
+                    return CommandResponse({"type": "shutdown"})
+                t.last_seen = time.monotonic()
+                if self._shutdown:
+                    return CommandResponse({"type": "shutdown"})
+                if t.queue:
+                    return CommandResponse(t.queue.pop(0))
+                return CommandResponse(None)
+        if isinstance(req, ReportResultRequest):
+            with self._lock:
+                t = self._tasks.get(req.task_id)
+                if t is not None:
+                    t.busy = False
+                    t.last_seen = time.monotonic()
+                self._results[req.command_id] = (req.rc, req.result)
+                self._lock.notify_all()
+            return network.AckResponse()
+        return super()._handle(req, client_address)
+
+    # -- driver-side API ----------------------------------------------------
+
+    def hosts(self) -> Dict[str, int]:
+        """Live hosts → slot counts (tasks that polled recently)."""
+        now = time.monotonic()
+        with self._lock:
+            out: Dict[str, int] = {}
+            for t in self._tasks.values():
+                if now - t.last_seen <= _TASK_STALE_SECS:
+                    out[t.host] = out.get(t.host, 0) + 1
+            return out
+
+    def wait_for_tasks(self, count: int, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self._tasks) < count:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._lock.wait(remain)
+            return True
+
+    def dispatch(self, host: str, env: Dict[str, str],
+                 timeout: Optional[float] = None) -> Tuple[int, Any]:
+        """Run a worker with ``env`` on an idle task at ``host``; block for
+        its exit code. Returns (rc, unpickled fn result or None)."""
+        cid = None
+        task = None
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._shutdown:
+                    return 1, None
+                now = time.monotonic()
+                for t in self._tasks.values():
+                    if (t.host == host and not t.busy
+                            and now - t.last_seen <= _TASK_STALE_SECS):
+                        task = t
+                        break
+                if task is not None:
+                    break
+                remain = 5.0 if deadline is None else deadline - now
+                if remain <= 0:
+                    return 1, None
+                self._lock.wait(min(remain, 1.0))
+            cid = self._next_command
+            self._next_command += 1
+            task.busy = True
+            task.queue.append({"type": "spawn", "command_id": cid,
+                               "env": dict(env)})
+            while cid not in self._results:
+                if self._shutdown:
+                    return 1, None
+                # A task that stopped polling (lost executor) never reports;
+                # surface that as a failed worker so the driver reshuffles.
+                if (time.monotonic() - task.last_seen > _TASK_STALE_SECS
+                        and cid not in self._results):
+                    task.busy = False
+                    return 1, None
+                self._lock.wait(1.0)
+            rc, blob = self._results.pop(cid)
+        result = pickle.loads(blob) if (rc == 0 and blob) else None
+        return rc, result
+
+    def shutdown_tasks(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+
+class SparkTaskDiscovery(HostDiscovery):
+    """Host discovery from the dispatcher's live-task registry (reference:
+    spark/driver/host_discovery.py — hosts of running Spark tasks)."""
+
+    def __init__(self, dispatcher: TaskDispatcher):
+        self._dispatcher = dispatcher
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return self._dispatcher.hosts()
+
+
+# ---------------------------------------------------------------- task side
+
+
+def _spawn_worker(fn_path: str, env: Dict[str, str]) -> Tuple[int, bytes]:
+    """Run the pickled fn in a subprocess with the worker env; return
+    (rc, pickled result bytes)."""
+    out_path = tempfile.mktemp(prefix="hvd_spark_res_")
+    child = (
+        "import sys, pickle\n"
+        "import cloudpickle\n"
+        f"fn, args, kwargs = cloudpickle.load(open({fn_path!r}, 'rb'))\n"
+        "res = fn(*args, **kwargs)\n"
+        f"pickle.dump(res, open({out_path!r}, 'wb'))\n")
+    full_env = dict(os.environ)
+    full_env.update(env)
+    try:
+        proc = subprocess.run([sys.executable, "-c", child], env=full_env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stdout.decode(errors="replace")[-4000:])
+            return proc.returncode, b""
+        with open(out_path, "rb") as f:
+            return 0, f.read()
+    finally:
+        if os.path.exists(out_path):
+            os.unlink(out_path)
+
+
+def task_loop(dispatcher_addr: str, dispatcher_port: int, key: bytes,
+              fn_blob: bytes, hostname: Optional[str] = None) -> int:
+    """Body of one long-lived Spark task (reference: the task service loop,
+    spark/task/task_service.py): register, poll, exec workers, until the
+    driver says shutdown. Returns the number of workers executed."""
+    import socket as _socket
+
+    host = hostname or _socket.gethostbyname(_socket.gethostname())
+    client = network.BasicClient("spark task dispatcher", dispatcher_addr,
+                                 dispatcher_port, key, attempts=5,
+                                 timeout=10.0)
+    tid = client._send(RegisterTaskRequest(host)).task_id
+
+    fd, fn_path = tempfile.mkstemp(prefix="hvd_spark_fn_")
+    with os.fdopen(fd, "wb") as f:
+        f.write(fn_blob)
+    executed = 0
+    # The worker runs in a thread so this loop keeps polling — the poll IS
+    # the liveness heartbeat the dispatcher uses to distinguish "busy" from
+    # "executor lost"; a blocking exec here would read as a dead task.
+    worker: List = []  # [(command_id, thread, result_box)]
+    try:
+        while True:
+            if worker:
+                cid, th, box = worker[0]
+                if not th.is_alive():
+                    worker.pop(0)
+                    rc, result = box[0]
+                    client._send(ReportResultRequest(tid, cid, rc, result))
+                    continue
+            resp = client._send(PollCommandRequest(tid))
+            cmd = resp.command
+            if cmd is None:
+                time.sleep(_POLL_INTERVAL_SECS)
+                continue
+            if cmd["type"] == "shutdown":
+                # Let an in-flight worker finish before exiting (the driver
+                # only shuts tasks down after driver.join()).
+                if worker:
+                    cid, th, box = worker.pop(0)
+                    th.join()
+                    rc, result = box[0]
+                    client._send(ReportResultRequest(tid, cid, rc, result))
+                return executed
+            box = [(1, b"")]
+
+            def _run(env=cmd["env"], box=box):
+                box[0] = _spawn_worker(fn_path, env)
+
+            th = threading.Thread(target=_run, daemon=True)
+            th.start()
+            worker.append((cmd["command_id"], th, box))
+            executed += 1
+    finally:
+        os.unlink(fn_path)
+
+
+# ---------------------------------------------------------------- driver side
+
+
+def run_elastic_core(
+    launch_tasks: Callable[[bytes, str, int, bytes], Any],
+    fn: Callable[..., Any],
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    num_proc: int = 2,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    reset_limit: Optional[int] = None,
+    driver_addr: Optional[str] = None,
+    controller_addr_override: Optional[str] = None,
+    start_timeout: float = 60.0,
+) -> List[Any]:
+    """pyspark-free elastic job core. ``launch_tasks(fn_blob, addr, port,
+    key)`` must start the long-lived tasks (Spark stage, subprocesses, ...)
+    and return an object with ``join()``."""
+    import cloudpickle
+
+    kwargs = kwargs or {}
+    fn_blob = cloudpickle.dumps((fn, args, kwargs))
+    dispatcher = TaskDispatcher()
+    if driver_addr is None:
+        import socket as _socket
+
+        driver_addr = _socket.gethostbyname(_socket.gethostname())
+
+    handle = launch_tasks(fn_blob, driver_addr, dispatcher.port,
+                          dispatcher.key)
+    if not dispatcher.wait_for_tasks(min_np or num_proc,
+                                     timeout=start_timeout):
+        dispatcher.shutdown_tasks()
+        raise RuntimeError(
+            f"only {len(dispatcher.hosts())} spark tasks registered within "
+            f"{start_timeout}s (need {min_np or num_proc})")
+
+    driver = ElasticDriver(
+        SparkTaskDiscovery(dispatcher),
+        min_np=min_np or num_proc, max_np=max_np,
+        reset_limit=reset_limit,
+        controller_addr_override=controller_addr_override)
+    # Keyed by slot identity (host, local_rank): a worker process can span
+    # several world incarnations (survivors re-rendezvous in place), so its
+    # spawn-time world_id/rank may be stale by the time it returns.
+    results: Dict[Tuple[str, int], Any] = {}
+    results_lock = threading.Lock()
+    service_env = {
+        "HOROVOD_ELASTIC_DRIVER_ADDR": driver_addr,
+        "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+        "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+    }
+
+    def create_worker(slot, world_id):
+        env = {
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_ELASTIC": "1",
+            **service_env,
+        }
+        rc, result = dispatcher.dispatch(slot.hostname, env)
+        if rc == 0:
+            with results_lock:
+                results[(slot.hostname, slot.local_rank)] = result
+        return rc
+
+    final_slots = []
+    try:
+        driver.start(create_worker)
+        ok = driver.join()
+        final_slots = driver.current_assignments()
+        if not ok:
+            raise RuntimeError("elastic spark job failed "
+                               "(no successful worker)")
+    finally:
+        driver.stop()
+        driver.shutdown_service()
+        dispatcher.shutdown_tasks()
+        try:
+            handle.join()
+        except Exception:  # pragma: no cover - task teardown is best-effort
+            pass
+        dispatcher.shutdown()
+
+    with results_lock:
+        # Final world's rank-ordered results (reference run_elastic returns
+        # per-rank fn results the same way).
+        out = [(s.rank, results[(s.hostname, s.local_rank)])
+               for s in final_slots
+               if (s.hostname, s.local_rank) in results]
+        if not out and results:
+            return list(results.values())
+        return [v for _, v in sorted(out)]

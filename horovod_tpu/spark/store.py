@@ -100,8 +100,24 @@ class HDFSStore(Store):
             raise ImportError(
                 "HDFSStore requires pyarrow with HDFS support") from e
         self.prefix_path = prefix_path
+        self._conn = (host or "default", port or 0, user)
         self._fs = pafs.HadoopFileSystem(
-            host=host or "default", port=port or 0, user=user)
+            host=self._conn[0], port=self._conn[1], user=self._conn[2])
+
+    # The pyarrow filesystem handle is not picklable; estimators ship the
+    # Store to executors (reference store.py does the same dance via
+    # __getstate__), so reconnect on unpickle.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_fs", None)
+        return state
+
+    def __setstate__(self, state):
+        from pyarrow import fs as pafs
+
+        self.__dict__.update(state)
+        self._fs = pafs.HadoopFileSystem(
+            host=self._conn[0], port=self._conn[1], user=self._conn[2])
 
     def _sub(self, *parts: str) -> str:
         base = self.prefix_path.rstrip("/")

@@ -296,9 +296,19 @@ def _allreduce_grads(grads, compression, op, prescale, postscale):
             out.append(None)
         elif isinstance(g, tf.IndexedSlices):
             # Sparse path: allgather values+indices (reference:
-            # tensorflow/__init__.py:91-107).
+            # tensorflow/__init__.py:91-107). Average divides the gathered
+            # values by world size so sparse grads match dense scaling
+            # (reference :107); Adasum is rejected for sparse grads
+            # (reference :87-90).
+            if op == Adasum:
+                raise NotImplementedError(
+                    "The Adasum reduction does not support sparse "
+                    "(IndexedSlices) gradients.")
+            values = allgather(g.values, name=f"grad.{i}.values")
+            if op == Average:
+                values = values / size()
             out.append(tf.IndexedSlices(
-                allgather(g.values, name=f"grad.{i}.values"),
+                values,
                 allgather(g.indices, name=f"grad.{i}.indices"),
                 dense_shape=g.dense_shape))
         else:

@@ -67,7 +67,8 @@ class _SyncBatchNorm(torch.autograd.Function):
         stats = torch.cat([
             input.sum(dims).float(),
             (input * input).sum(dims).float(),
-            torch.tensor([float(n_local)]),
+            torch.tensor([float(n_local)], dtype=torch.float32,
+                         device=input.device),
         ])
         stats = mpi_ops.allreduce(stats, op=Sum, name="sync_bn.fwd_stats")
         c = input.size(1)

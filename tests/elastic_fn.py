@@ -1,0 +1,73 @@
+"""Shared elastic worker-fn factory for platform-integration tests
+(Ray/Spark). The closure is cloudpickled into task subprocesses; callers
+register this module for by-value pickling so the child needs no import
+path back to tests/."""
+
+
+def make_worker_fn(log_file, batches, exit_at=None, batch_sleep=0.15):
+    """Elastic worker body: trains a toy loop under hvd.elastic.run with a
+    real collective per step, logging JSON lines (the reference's
+    integration worker pattern, elastic_common.py). Returns the final
+    committed batch count."""
+
+    def _worker():
+        import json as _json
+        import os as _os
+        import time as _time
+
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu import elastic
+
+        identity = (f"{_os.environ['HOROVOD_HOSTNAME']}:"
+                    f"{_os.environ['HOROVOD_LOCAL_RANK']}")
+        crash_at = None
+        if exit_at:
+            h, lr, b = exit_at.rsplit(":", 2)
+            if identity == f"{h}:{lr}":
+                crash_at = int(b)
+
+        def log(rec):
+            rec["identity"] = identity
+            with open(log_file, "a") as f:
+                f.write(_json.dumps(rec) + "\n")
+
+        @elastic.run
+        def train(state):
+            while state.batch < batches:
+                total = hvd.allreduce(jnp.full((4,), 1.0), op=hvd.Sum,
+                                      name=f"el.{state.batch}")
+                assert np.allclose(total, hvd.size())
+                state.batch += 1
+                if crash_at is not None and state.batch == crash_at:
+                    _os._exit(1)
+                log({"rank": int(hvd.rank()), "size": int(hvd.size()),
+                     "batch": int(state.batch)})
+                state.commit()
+                _time.sleep(batch_sleep)
+
+        state = elastic.ObjectState(batch=0)
+        train(state)
+        log({"rank": int(hvd.rank()), "size": int(hvd.size()), "done": True})
+        return int(state.batch)
+
+    return _worker
+
+
+def read_log(path):
+    import json
+    import os
+
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            if line.strip():
+                out.append(json.loads(line.strip()))
+    return out

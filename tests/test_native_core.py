@@ -114,7 +114,8 @@ class TestSingleProcess:
         assert "ALLREDUCE" in names or "TCP_ALLREDUCE" in names
 
 
-def _run_world(n, extra_env=None, timeout=120, worker=WORKER):
+def _run_world(n, extra_env=None, timeout=120, worker=WORKER,
+               local_size=None):
     port = _free_port()
     procs = []
     for r in range(n):
@@ -127,6 +128,15 @@ def _run_world(n, extra_env=None, timeout=120, worker=WORKER):
             "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
             "HOROVOD_CONTROLLER_PORT": str(port),
         })
+        if local_size is not None:
+            # Emulated multi-host topology: host-major rank packing
+            # (reference hosts.py:100-150).
+            env.update({
+                "HOROVOD_LOCAL_RANK": str(r % local_size),
+                "HOROVOD_LOCAL_SIZE": str(local_size),
+                "HOROVOD_CROSS_RANK": str(r // local_size),
+                "HOROVOD_CROSS_SIZE": str(n // local_size),
+            })
         env.update(extra_env or {})
         procs.append(subprocess.Popen(
             [sys.executable, worker], env=env,
@@ -154,6 +164,26 @@ class TestMultiProcess:
         # Odd world + tiny fusion threshold forces multi-buffer fusion
         # rounds and non-divisible ring chunks.
         _run_world(3, {"HOROVOD_FUSION_THRESHOLD": str(256)})
+
+    def test_hierarchical_2x2(self):
+        # Full worker assertion suite with hierarchical allreduce+allgather
+        # enabled on an emulated 2-host x 2-chip topology: numerics must be
+        # identical to the flat ring paths (reference:
+        # NCCLHierarchicalAllreduce nccl_operations.cc:190-380,
+        # MPIHierarchicalAllgather mpi_operations.cc:180-280).
+        _run_world(4, {
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+        }, local_size=2)
+
+    def test_hierarchical_3x2_small_fusion(self):
+        # Non-power-of-2 host count + tiny fusion buffers: uneven cross-ring
+        # chunks through the hierarchical legs.
+        _run_world(6, {
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_HIERARCHICAL_ALLGATHER": "1",
+            "HOROVOD_FUSION_THRESHOLD": str(256),
+        }, local_size=2)
 
     def test_autotune_smoke(self):
         _run_world(2, {

@@ -96,6 +96,28 @@ class TestRayCoordinator:
         assert envs[3]["HOROVOD_LOCAL_SIZE"] == "2"
         assert envs[0]["HOROVOD_SIZE"] == "4"
 
+    def test_interleaved_registration_renumbered_host_major(self):
+        """PACK scheduling can interleave hosts in registration order; the
+        coordinator must renumber world ranks host-major so
+        rank == cross_rank*local_size + local_rank holds (the invariant
+        hierarchical collectives and the native fail-fast check rely on)."""
+        c = Coordinator()
+        c.register("n1", 0)
+        c.register("n2", 1)
+        c.register("n1", 2)
+        c.register("n2", 3)
+        envs = c.finalize_registration()
+        for reg_id, env in envs.items():
+            rank = int(env["HOROVOD_RANK"])
+            assert rank == (int(env["HOROVOD_CROSS_RANK"])
+                            * int(env["HOROVOD_LOCAL_SIZE"])
+                            + int(env["HOROVOD_LOCAL_RANK"])), env
+        # n1 got ranks 0,1 (reg ids 0,2); n2 got 2,3 (reg ids 1,3)
+        assert envs[0]["HOROVOD_RANK"] == "0"
+        assert envs[2]["HOROVOD_RANK"] == "1"
+        assert envs[1]["HOROVOD_RANK"] == "2"
+        assert envs[3]["HOROVOD_RANK"] == "3"
+
     def test_rendezvous_env(self):
         c = Coordinator()
         env = c.establish_rendezvous("10.0.0.1", 12345)

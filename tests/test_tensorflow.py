@@ -104,6 +104,30 @@ class TestDistributedGradientTape:
         assert isinstance(g, tf.IndexedSlices)
         assert g.values.shape[0] == 2
 
+    def test_sparse_average_scales_by_size(self, monkeypatch):
+        """Average must divide gathered sparse values by world size so
+        sparse grads match dense scaling (reference
+        tensorflow/__init__.py:107; ADVICE r1)."""
+        import horovod_tpu.tensorflow as mod
+
+        monkeypatch.setattr(mod, "size", lambda: 4)
+        emb = tf.Variable(tf.ones([10, 4]))
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            rows = tf.gather(emb, [1, 3])
+            loss = tf.reduce_sum(rows)
+        (g,) = tape.gradient(loss, [emb])
+        # world-1 allgather is identity, so values = raw/4.
+        assert np.allclose(g.values.numpy(), 0.25)
+
+    def test_sparse_adasum_rejected(self):
+        emb = tf.Variable(tf.ones([10, 4]))
+        with pytest.raises(NotImplementedError):
+            with hvd_tf.DistributedGradientTape(
+                    tf.GradientTape(), op=hvd_tf.Adasum) as tape:
+                rows = tf.gather(emb, [1, 3])
+                loss = tf.reduce_sum(rows)
+            tape.gradient(loss, [emb])
+
 
 class TestKerasOptimizer:
     def test_wraps_class_and_trains(self):
@@ -153,6 +177,23 @@ class TestKerasCallbacks:
         ys = np.zeros((8, 1), np.float32)
         model.fit(xs, ys, epochs=1, verbose=0, callbacks=[
             hvd_keras.callbacks.MetricAverageCallback()])
+
+    def test_warmup_semantics_size4(self, monkeypatch):
+        """Reference semantics (_keras/callbacks.py:139-143): warm from
+        initial_lr/size up to initial_lr (the size-scaled LR the user set)."""
+        model = self._model()
+        cb = hvd_keras.callbacks.LearningRateWarmupCallback(
+            initial_lr=0.4, warmup_epochs=2, steps_per_epoch=4)
+        monkeypatch.setattr(cb, "_size", lambda: 4)
+        cb.set_model(model)
+        cb.on_epoch_begin(0)
+        cb.on_train_batch_begin(0)
+        lr0 = float(np.asarray(model.optimizer.learning_rate))
+        cb.on_epoch_begin(1)
+        cb.on_train_batch_begin(4)  # progress = (1 + 4/4)/2 = 1.0
+        lr1 = float(np.asarray(model.optimizer.learning_rate))
+        assert lr0 == pytest.approx(0.4 / 4)
+        assert lr1 == pytest.approx(0.4)
 
     def test_warmup_reaches_target(self):
         model = self._model()
