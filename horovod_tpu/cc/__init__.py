@@ -81,6 +81,9 @@ def build(force: bool = False) -> str:
             if not force and _up_to_date():  # another process built it
                 return _LIB_PATH
             jobs = os.cpu_count() or 2
+            if force:
+                subprocess.run(["make", "-C", _HERE, "clean"],
+                               capture_output=True, text=True)
             proc = subprocess.run(
                 ["make", "-C", _HERE, f"-j{jobs}"],
                 capture_output=True, text=True)

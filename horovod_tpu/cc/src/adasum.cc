@@ -1,41 +1,125 @@
 #include "adasum.h"
 
 #include <cmath>
+#include <cstring>
 #include <vector>
 
 namespace hvdtpu {
 
 namespace {
 
+// Vector-halving distance-doubling Adasum (reference: FusedAllreduce,
+// adasum.h:196+). Per level d the pair (rank, rank^d) splits the current
+// segment: the lower rank keeps the first half, the higher the second, and
+// they exchange the halves they give up — so per-level traffic *halves*
+// (total ≈ 2n per rank across all levels) instead of the full vector every
+// level as in plain recursive doubling. The adasum coefficients need dot
+// products of the *logical* full vectors, whose pieces are spread over the
+// 2d-rank block; a 3-double recursive-doubling allreduce within the block
+// assembles them (the reference's SumAllreduceWithComm over
+// reduction_comms_, adasum.h:271+).
 template <typename T>
-Status AdasumTyped(Transport& t, T* mine, int64_t count) {
+Status VhddTyped(Transport& t, T* mine, int64_t count) {
   int size = t.size(), rank = t.rank();
-  std::vector<T> theirs(static_cast<size_t>(count));
-  for (int d = 1; d < size; d <<= 1) {
+  int levels = 0;
+  while ((1 << levels) < size) ++levels;
+
+  int64_t start = 0, len = count;
+  std::vector<int64_t> starts(static_cast<size_t>(levels));
+  std::vector<int64_t> lens(static_cast<size_t>(levels));
+  std::vector<T> recv;
+
+  // Halving phase: after level l each rank holds its combined piece of the
+  // block's logical vector.
+  for (int l = 0; l < levels; ++l) {
+    int d = 1 << l;
     int partner = rank ^ d;
-    if (!t.RingExchange(partner, mine, static_cast<size_t>(count) * sizeof(T),
-                        partner, theirs.data(),
-                        static_cast<size_t>(count) * sizeof(T))) {
-      return Status::UnknownError("adasum: peer connection lost");
+    bool lower = (rank & d) == 0;
+    int64_t len_a = len - len / 2;  // first half (kept by the lower rank)
+    int64_t len_b = len / 2;
+    starts[static_cast<size_t>(l)] = start;
+    lens[static_cast<size_t>(l)] = len;
+
+    const T* send_ptr;
+    int64_t send_len, keep_off, keep_len;
+    if (lower) {
+      send_ptr = mine + start + len_a;
+      send_len = len_b;
+      keep_off = start;
+      keep_len = len_a;
+    } else {
+      send_ptr = mine + start;
+      send_len = len_a;
+      keep_off = start + len_a;
+      keep_len = len_b;
     }
-    // Deterministic orientation: the lower rank's buffer is `a`
-    // (reference dispatches the same way so both sides compute the
-    // identical combine, adasum.h:101-141).
-    const T* a = (rank & d) == 0 ? mine : theirs.data();
-    const T* b = (rank & d) == 0 ? theirs.data() : mine;
-    double dot = 0.0, na = 0.0, nb = 0.0;
-    for (int64_t i = 0; i < count; ++i) {
+    recv.resize(static_cast<size_t>(keep_len));
+    if (!t.RingExchange(partner, send_ptr,
+                        static_cast<size_t>(send_len) * sizeof(T), partner,
+                        recv.data(),
+                        static_cast<size_t>(keep_len) * sizeof(T))) {
+      return Status::UnknownError("adasum vhdd: peer connection lost");
+    }
+
+    // Deterministic orientation: the lower rank's vector is `a`
+    // (reference dispatches the same way, adasum.h:101-141).
+    const T* a = lower ? mine + keep_off : recv.data();
+    const T* b = lower ? recv.data() : mine + keep_off;
+    double p[3] = {0.0, 0.0, 0.0};  // dot, |a|^2, |b|^2 (partial)
+    for (int64_t i = 0; i < keep_len; ++i) {
       double ai = static_cast<double>(a[i]), bi = static_cast<double>(b[i]);
-      dot += ai * bi;
-      na += ai * ai;
-      nb += bi * bi;
+      p[0] += ai * bi;
+      p[1] += ai * ai;
+      p[2] += bi * bi;
     }
-    double acoef = na <= 0.0 ? 1.0 : 1.0 - dot / (2.0 * na);
-    double bcoef = nb <= 0.0 ? 1.0 : 1.0 - dot / (2.0 * nb);
-    for (int64_t i = 0; i < count; ++i) {
-      mine[i] = static_cast<T>(acoef * static_cast<double>(a[i]) +
-                               bcoef * static_cast<double>(b[i]));
+    // Block-wide partial sums: recursive doubling over the 2d block.
+    for (int s = 1; s < 2 * d; s <<= 1) {
+      int p2 = rank ^ s;
+      double theirs[3];
+      if (!t.RingExchange(p2, p, sizeof(p), p2, theirs, sizeof(theirs))) {
+        return Status::UnknownError("adasum vhdd: peer connection lost");
+      }
+      p[0] += theirs[0];
+      p[1] += theirs[1];
+      p[2] += theirs[2];
     }
+    double acoef = p[1] <= 0.0 ? 1.0 : 1.0 - p[0] / (2.0 * p[1]);
+    double bcoef = p[2] <= 0.0 ? 1.0 : 1.0 - p[0] / (2.0 * p[2]);
+    T* dst = mine + keep_off;
+    for (int64_t i = 0; i < keep_len; ++i) {
+      dst[i] = static_cast<T>(acoef * static_cast<double>(a[i]) +
+                              bcoef * static_cast<double>(b[i]));
+    }
+    start = keep_off;
+    len = keep_len;
+  }
+
+  // Doubling phase: walk the levels back, swapping combined pieces so every
+  // rank reassembles the full vector (the allgather half of VHDD).
+  for (int l = levels - 1; l >= 0; --l) {
+    int d = 1 << l;
+    int partner = rank ^ d;
+    bool lower = (rank & d) == 0;
+    int64_t pstart = starts[static_cast<size_t>(l)];
+    int64_t plen = lens[static_cast<size_t>(l)];
+    int64_t len_a = plen - plen / 2;
+    T* recv_ptr;
+    int64_t recv_len;
+    if (lower) {
+      recv_ptr = mine + pstart + len_a;
+      recv_len = plen / 2;
+    } else {
+      recv_ptr = mine + pstart;
+      recv_len = len_a;
+    }
+    if (!t.RingExchange(partner, mine + start,
+                        static_cast<size_t>(len) * sizeof(T), partner,
+                        recv_ptr,
+                        static_cast<size_t>(recv_len) * sizeof(T))) {
+      return Status::UnknownError("adasum vhdd: peer connection lost");
+    }
+    start = pstart;
+    len = plen;
   }
   return Status::OK();
 }
@@ -52,9 +136,9 @@ Status AdasumAllreduce(Transport& t, void* buf, int64_t count, DataType dt) {
   if (size == 1 || count == 0) return Status::OK();
   switch (dt) {
     case DataType::HVDTPU_FLOAT32:
-      return AdasumTyped(t, static_cast<float*>(buf), count);
+      return VhddTyped(t, static_cast<float*>(buf), count);
     case DataType::HVDTPU_FLOAT64:
-      return AdasumTyped(t, static_cast<double*>(buf), count);
+      return VhddTyped(t, static_cast<double*>(buf), count);
     default:
       return Status::InvalidArgument(
           "Adasum host path supports float32/float64 buffers.");

@@ -391,15 +391,40 @@ ResponseList Controller::CoordinatorCycle(std::vector<RequestList> rank_lists,
   final_list.shutdown = shutdown_latch_;
 
   if (autotune_hook) {
-    int64_t fuse = 0;
-    double cyc = 0.0;
-    if (autotune_hook(final_list.responses, &fuse, &cyc)) {
+    TunedParamsWire tuned;
+    if (autotune_hook(final_list.responses, &tuned)) {
       final_list.has_tuned_params = true;
-      final_list.tuned_fusion_threshold = fuse;
-      final_list.tuned_cycle_time_ms = cyc;
+      final_list.tuned_fusion_threshold = tuned.fusion_threshold;
+      final_list.tuned_cycle_time_ms = tuned.cycle_time_ms;
+      final_list.tuned_flags =
+          tuned.has_flags ? static_cast<uint8_t>(tuned.flags | 0x80) : 0;
     }
   }
   return final_list;
+}
+
+void Controller::set_cache_enabled(bool enabled) {
+  if (enabled == cache_enabled_) return;
+  cache_enabled_ = enabled;
+  HVDTPU_LOG(DEBUG) << "cache_enabled -> " << enabled;
+  if (!enabled) {
+    // Requests parked waiting for their cache bit to fire globally would
+    // stall forever once no rank votes bits: push them back into the
+    // negotiated (uncached) stream next cycle.
+    for (auto& kv : pending_cached_) {
+      resend_uncached_.push_back(std::move(kv.second));
+    }
+    pending_cached_.clear();
+    my_invalid_bits_.clear();
+  } else {
+    // Drop stale entries on re-enable. The toggle is cycle-synchronous but
+    // tensor *submission* is not: with stale bits, a rank popping a tensor
+    // just after the toggle would classify it HIT while a rank that popped
+    // it just before (cache off) negotiated it uncached — mixed
+    // classifications for one tensor deadlock both sides. An empty cache
+    // makes the first post-toggle classification MISS everywhere.
+    cache_->clear();
+  }
 }
 
 void Controller::ApplyResponseList(const ResponseList& final_list,
@@ -428,6 +453,7 @@ void Controller::ApplyResponseList(const ResponseList& final_list,
       continue;
     }
     if (!IsDataResponse(resp.response_type)) continue;
+    if (!cache_enabled_) continue;  // tuned off: don't fill
     if (resp.tensor_names.size() == 1) {
       if (!resp.cache_shape.empty()) cache_->put(resp);
     } else {
@@ -446,6 +472,10 @@ void Controller::ApplyResponseList(const ResponseList& final_list,
   if (final_list.has_tuned_params) {
     out->tuned_fusion_threshold = final_list.tuned_fusion_threshold;
     out->tuned_cycle_time_ms = final_list.tuned_cycle_time_ms;
+    if (final_list.tuned_flags & 0x80) {
+      out->has_tuned_flags = true;
+      out->tuned_flags = final_list.tuned_flags & 0x7f;
+    }
   }
   out->responses = final_list.responses;
   out->shutdown = final_list.shutdown;
@@ -466,6 +496,13 @@ Controller::CycleResult Controller::RunCycle(bool request_shutdown,
     if (req.request_type == Request::JOIN) {
       self_joined_ = true;
       mine.joined = true;
+      mine.requests.push_back(std::move(req));
+      continue;
+    }
+    if (!cache_enabled_) {
+      // Cache tuned off: everything negotiates as a miss; no bits are
+      // consulted, voted, or filled, so the distributed bit tables stay
+      // frozen (and consistent) until the cache is re-enabled.
       mine.requests.push_back(std::move(req));
       continue;
     }

@@ -30,6 +30,15 @@
 
 namespace hvdtpu {
 
+// Tuned-parameter set as it rides the cycle broadcast (filled by the
+// autotune hook; operations.cc converts from ParameterManager::TunedParams).
+struct TunedParamsWire {
+  int64_t fusion_threshold = 0;
+  double cycle_time_ms = 0.0;
+  bool has_flags = false;
+  uint8_t flags = 0;  // bit0 cache, bit1 hier_ar, bit2 hier_ag
+};
+
 class Controller {
  public:
   Controller(int rank, int size, Transport* transport, TensorQueue* queue,
@@ -48,6 +57,8 @@ class Controller {
     bool transport_failure = false;
     int64_t tuned_fusion_threshold = 0;   // nonzero → apply
     double tuned_cycle_time_ms = 0.0;     // nonzero → apply
+    bool has_tuned_flags = false;
+    uint8_t tuned_flags = 0;  // bit0 cache, bit1 hier_ar, bit2 hier_ag
   };
 
   // One negotiation cycle (reference: ComputeResponseList,
@@ -63,8 +74,16 @@ class Controller {
   // responses; returns true + new params when a new setting should be
   // broadcast (reference: parameter_manager.Update / SynchronizeParameters,
   // operations.cc:614-621, controller.cc:34-48).
-  std::function<bool(const std::vector<Response>&, int64_t*, double*)>
+  std::function<bool(const std::vector<Response>&, TunedParamsWire*)>
       autotune_hook;
+
+  // Response-cache on/off switch, tuned at runtime by the autotuner
+  // (reference: PARAMETER cache_enabled_, parameter_manager.cc:51-74).
+  // Every rank applies the toggle at the same cycle boundary (it ships in
+  // the ResponseList broadcast), so the distributed cache-bit tables stay
+  // consistent: while disabled no rank consults or fills the cache.
+  void set_cache_enabled(bool enabled);
+  bool cache_enabled() const { return cache_enabled_; }
 
  private:
   // -- coordinator state --
@@ -98,6 +117,7 @@ class Controller {
   std::vector<uint32_t> my_invalid_bits_;
   // Requests to send as uncached next cycle (post-eviction resubmits).
   std::vector<Request> resend_uncached_;
+  bool cache_enabled_ = true;
 
   int rank_;
   int size_;

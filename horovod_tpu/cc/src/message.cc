@@ -110,6 +110,7 @@ void ResponseList::Serialize(WireWriter& w) const {
   w.u8(has_tuned_params ? 1 : 0);
   w.i64(tuned_fusion_threshold);
   w.f64(tuned_cycle_time_ms);
+  w.u8(tuned_flags);
   w.i32(static_cast<int32_t>(responses.size()));
   for (const auto& p : responses) p.Serialize(w);
 }
@@ -121,6 +122,7 @@ ResponseList ResponseList::Deserialize(WireReader& r) {
   l.has_tuned_params = r.u8() != 0;
   l.tuned_fusion_threshold = r.i64();
   l.tuned_cycle_time_ms = r.f64();
+  l.tuned_flags = r.u8();
   int32_t n = r.i32();
   l.responses.reserve(static_cast<size_t>(n));
   for (int32_t i = 0; i < n; ++i)

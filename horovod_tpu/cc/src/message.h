@@ -107,6 +107,9 @@ struct ResponseList {
   bool has_tuned_params = false;
   int64_t tuned_fusion_threshold = 0;
   double tuned_cycle_time_ms = 0.0;
+  // bit0 cache_enabled, bit1 hierarchical_allreduce,
+  // bit2 hierarchical_allgather (valid when has_tuned_params).
+  uint8_t tuned_flags = 0;
 
   void Serialize(WireWriter& w) const;
   static ResponseList Deserialize(WireReader& r);

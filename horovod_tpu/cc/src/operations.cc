@@ -442,6 +442,14 @@ void BackgroundLoop(Global* gs) {
       gs->cycle_time_us.store(
           static_cast<int64_t>(cycle.tuned_cycle_time_ms * 1000));
     }
+    if (cycle.has_tuned_flags) {
+      // Applied on every rank at the same cycle boundary (the flags ride
+      // the ResponseList broadcast), so cache state and collective
+      // algorithm stay globally consistent.
+      gs->controller->set_cache_enabled((cycle.tuned_flags & 1) != 0);
+      gs->hierarchical_allreduce.store((cycle.tuned_flags & 2) != 0);
+      gs->hierarchical_allgather.store((cycle.tuned_flags & 4) != 0);
+    }
     int64_t bytes_this_cycle = 0;
     for (const Response& r : cycle.responses) {
       PerformOperation(*gs, r);
@@ -573,9 +581,18 @@ int hvdtpu_init(void) {
       gs->rank == 0 ? &gs->timeline : nullptr);
 
   if (EnvBool(HVDTPU_ENV_AUTOTUNE, false) && gs->rank == 0) {
+    // Hierarchical knobs enter the search space only on a topology that
+    // can honor them. Rank 0's view stands for all ranks: every launcher
+    // derives the env from host-major get_host_assignments, and
+    // explicitly-set flags are validated per-rank at init above.
+    collectives::Topology topo = MakeTopology(*gs);
+    bool tune_hier = topo.Hierarchical(gs->size, gs->rank);
     gs->parameter_manager.Initialize(
         gs->fusion_threshold.load(),
         gs->cycle_time_us.load() / 1000.0,
+        /*cache_enabled=*/true,
+        gs->hierarchical_allreduce.load(),
+        gs->hierarchical_allgather.load(), tune_hier,
         EnvString(HVDTPU_ENV_AUTOTUNE_LOG, ""),
         EnvInt64(HVDTPU_ENV_AUTOTUNE_WARMUP_SAMPLES, 3),
         EnvInt64(HVDTPU_ENV_AUTOTUNE_STEPS_PER_SAMPLE, 10),
@@ -583,9 +600,18 @@ int hvdtpu_init(void) {
         EnvDouble(HVDTPU_ENV_AUTOTUNE_GAUSSIAN_PROCESS_NOISE, 0.8));
     Global* raw = gs.get();
     gs->controller->autotune_hook =
-        [raw](const std::vector<Response>& responses, int64_t* fuse,
-              double* cyc) {
-          return raw->parameter_manager.Update(responses, fuse, cyc);
+        [raw](const std::vector<Response>& responses,
+              TunedParamsWire* out) {
+          TunedParams p;
+          if (!raw->parameter_manager.Update(responses, &p)) return false;
+          out->fusion_threshold = p.fusion_threshold;
+          out->cycle_time_ms = p.cycle_time_ms;
+          out->has_flags = p.has_flags;
+          out->flags = static_cast<uint8_t>(
+              (p.cache_enabled ? 1 : 0) |
+              (p.hierarchical_allreduce ? 2 : 0) |
+              (p.hierarchical_allgather ? 4 : 0));
+          return true;
         };
   }
 

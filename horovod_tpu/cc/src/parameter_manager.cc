@@ -15,17 +15,27 @@ constexpr double kMinFusionLog = 10.0;  // 2^10 = 1 KB
 constexpr double kMaxFusionLog = 28.0;  // 2^28 = 256 MB
 constexpr double kMinCycleLog = -1.0;   // 2^-1 = 0.5 ms
 constexpr double kMaxCycleLog = 5.64;   // ~50 ms
+constexpr int kDims = 5;  // fusion, cycle, cache, hier_ar, hier_ag
 }  // namespace
 
 void ParameterManager::Initialize(int64_t fusion_threshold,
-                                  double cycle_time_ms,
+                                  double cycle_time_ms, bool cache_enabled,
+                                  bool hierarchical_allreduce,
+                                  bool hierarchical_allgather,
+                                  bool tune_hierarchical,
                                   const std::string& log_path,
                                   int64_t warmup_samples,
                                   int64_t cycles_per_sample,
                                   int64_t max_samples, double gp_noise) {
   active_ = true;
-  current_fusion_ = best_fusion_ = fusion_threshold;
-  current_cycle_ = best_cycle_ = cycle_time_ms;
+  current_.fusion_threshold = fusion_threshold;
+  current_.cycle_time_ms = cycle_time_ms;
+  current_.has_flags = true;
+  current_.cache_enabled = cache_enabled;
+  current_.hierarchical_allreduce = hierarchical_allreduce;
+  current_.hierarchical_allgather = hierarchical_allgather;
+  best_ = current_;
+  tune_hierarchical_ = tune_hierarchical;
   warmup_samples_ = warmup_samples;
   cycles_per_sample_ = cycles_per_sample;
   max_samples_ = max_samples;
@@ -34,7 +44,10 @@ void ParameterManager::Initialize(int64_t fusion_threshold,
   if (!log_path.empty()) {
     log_ = std::fopen(log_path.c_str(), "w");
     if (log_ != nullptr) {
-      std::fprintf(log_, "fusion_threshold_bytes,cycle_time_ms,score_bytes_per_sec\n");
+      std::fprintf(log_,
+                   "fusion_threshold_bytes,cycle_time_ms,cache_enabled,"
+                   "hierarchical_allreduce,hierarchical_allgather,"
+                   "score_bytes_per_sec\n");
     }
   }
 }
@@ -47,20 +60,29 @@ void ParameterManager::RecordBytes(int64_t bytes) {
   bytes_accum_ += bytes;
 }
 
-std::vector<double> ParameterManager::ToUnit(int64_t fusion,
-                                             double cycle) const {
-  double f = std::log2(std::max<double>(1.0, static_cast<double>(fusion)));
-  double c = std::log2(std::max(1e-3, cycle));
+std::vector<double> ParameterManager::ToUnit(const TunedParams& p) const {
+  double f = std::log2(
+      std::max<double>(1.0, static_cast<double>(p.fusion_threshold)));
+  double c = std::log2(std::max(1e-3, p.cycle_time_ms));
+  // Booleans sit at 0.25/0.75 so the GP sees them well inside the box.
   return {(f - kMinFusionLog) / (kMaxFusionLog - kMinFusionLog),
-          (c - kMinCycleLog) / (kMaxCycleLog - kMinCycleLog)};
+          (c - kMinCycleLog) / (kMaxCycleLog - kMinCycleLog),
+          p.cache_enabled ? 0.75 : 0.25,
+          p.hierarchical_allreduce ? 0.75 : 0.25,
+          p.hierarchical_allgather ? 0.75 : 0.25};
 }
 
-void ParameterManager::FromUnit(const std::vector<double>& u,
-                                int64_t* fusion, double* cycle) const {
+TunedParams ParameterManager::FromUnit(const std::vector<double>& u) const {
+  TunedParams p;
   double f = kMinFusionLog + u[0] * (kMaxFusionLog - kMinFusionLog);
   double c = kMinCycleLog + u[1] * (kMaxCycleLog - kMinCycleLog);
-  *fusion = static_cast<int64_t>(std::pow(2.0, f));
-  *cycle = std::pow(2.0, c);
+  p.fusion_threshold = static_cast<int64_t>(std::pow(2.0, f));
+  p.cycle_time_ms = std::pow(2.0, c);
+  p.has_flags = true;
+  p.cache_enabled = u[2] >= 0.5;
+  p.hierarchical_allreduce = tune_hierarchical_ && u[3] >= 0.5;
+  p.hierarchical_allgather = tune_hierarchical_ && u[4] >= 0.5;
+  return p;
 }
 
 void ParameterManager::ProposeNext() {
@@ -78,7 +100,7 @@ void ParameterManager::ProposeNext() {
     yn[i] = (ys_[i] - mean) / sd;
     best_n = std::max(best_n, yn[i]);
   }
-  GaussianProcess gp(2, 0.3, gp_noise_);
+  GaussianProcess gp(kDims, 0.3, gp_noise_);
   bool fitted = gp.Fit(xs_, yn);
 
   auto rnd = [this]() {
@@ -89,11 +111,20 @@ void ParameterManager::ProposeNext() {
     return static_cast<double>((rng_state_ * 0x2545F4914F6CDD1Dull) >> 11) /
            static_cast<double>(1ull << 53);
   };
-  std::vector<double> best_x = {rnd(), rnd()};
+  auto sample = [&]() {
+    std::vector<double> x(kDims);
+    for (int i = 0; i < kDims; ++i) x[i] = rnd();
+    if (!tune_hierarchical_) {
+      x[3] = 0.25;
+      x[4] = 0.25;
+    }
+    return x;
+  };
+  std::vector<double> best_x = sample();
   if (fitted) {
     double best_ei = -1.0;
     for (int i = 0; i < 1000; ++i) {
-      std::vector<double> cand = {rnd(), rnd()};
+      std::vector<double> cand = sample();
       double ei = gp.ExpectedImprovement(cand, best_n);
       if (ei > best_ei) {
         best_ei = ei;
@@ -101,18 +132,17 @@ void ParameterManager::ProposeNext() {
       }
     }
   }
-  FromUnit(best_x, &current_fusion_, &current_cycle_);
+  current_ = FromUnit(best_x);
   pending_broadcast_ = true;
 }
 
 bool ParameterManager::Update(const std::vector<Response>& responses,
-                              int64_t* fusion_out, double* cycle_out) {
+                              TunedParams* out) {
   if (!active_ || done_) return false;
   if (pending_broadcast_) {
     // Ship the newly proposed params this cycle.
     pending_broadcast_ = false;
-    *fusion_out = current_fusion_;
-    *cycle_out = current_cycle_;
+    *out = current_;
     return true;
   }
   cycles_in_window_++;
@@ -132,28 +162,31 @@ bool ParameterManager::Update(const std::vector<Response>& responses,
   if (samples_done_ <= warmup_samples_) return false;
 
   if (log_ != nullptr) {
-    std::fprintf(log_, "%lld,%.3f,%.1f\n",
-                 static_cast<long long>(current_fusion_), current_cycle_,
-                 score);
+    std::fprintf(log_, "%lld,%.3f,%d,%d,%d,%.1f\n",
+                 static_cast<long long>(current_.fusion_threshold),
+                 current_.cycle_time_ms, current_.cache_enabled ? 1 : 0,
+                 current_.hierarchical_allreduce ? 1 : 0,
+                 current_.hierarchical_allgather ? 1 : 0, score);
     std::fflush(log_);
   }
-  xs_.push_back(ToUnit(current_fusion_, current_cycle_));
+  xs_.push_back(ToUnit(current_));
   ys_.push_back(score);
   if (score > best_score_) {
     best_score_ = score;
-    best_fusion_ = current_fusion_;
-    best_cycle_ = current_cycle_;
+    best_ = current_;
   }
   if (static_cast<int64_t>(ys_.size()) >= max_samples_) {
     // Converge: lock in the best seen configuration.
     done_ = true;
-    current_fusion_ = best_fusion_;
-    current_cycle_ = best_cycle_;
+    current_ = best_;
     HVDTPU_LOG(INFO) << "autotune converged: fusion_threshold="
-                     << best_fusion_ << " cycle_time_ms=" << best_cycle_
+                     << best_.fusion_threshold
+                     << " cycle_time_ms=" << best_.cycle_time_ms
+                     << " cache=" << best_.cache_enabled
+                     << " hier_allreduce=" << best_.hierarchical_allreduce
+                     << " hier_allgather=" << best_.hierarchical_allgather
                      << " (best " << best_score_ / 1e6 << " MB/s)";
-    *fusion_out = best_fusion_;
-    *cycle_out = best_cycle_;
+    *out = best_;
     return true;
   }
   ProposeNext();

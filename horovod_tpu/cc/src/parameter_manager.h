@@ -1,13 +1,20 @@
-// Autotuner for fusion threshold + cycle time.
+// Autotuner for fusion threshold, cycle time, response-cache enablement,
+// and the hierarchical allreduce/allgather switches.
 //
 // Reference: horovod/common/parameter_manager.{h,cc} — the coordinator
-// scores each sample window by bytes/sec, proposes the next (fusion
-// threshold, cycle time) point by Bayesian optimization, and broadcasts
-// tuned values to the workers inside the negotiation round
-// (SynchronizeParameters, controller.cc:34-48; update loop
-// operations.cc:614-621). Knobs: HOROVOD_AUTOTUNE,
+// scores each sample window by bytes/sec and proposes the next point by
+// Bayesian optimization over the *mixed* space of two numeric knobs
+// (fusion threshold, cycle time — parameter_manager.cc:75-96) and three
+// categorical ones (cache enabled, hierarchical allreduce/allgather —
+// parameter_manager.cc:51-74), then broadcasts tuned values to the workers
+// inside the negotiation round (SynchronizeParameters, controller.cc:34-48;
+// update loop operations.cc:614-621). Knobs: HOROVOD_AUTOTUNE,
 // HOROVOD_AUTOTUNE_LOG, warmup samples, steps per sample, max samples,
 // GP noise (common.h:68-73).
+//
+// The categoricals ride the same GP as relaxed [0,1] coordinates
+// thresholded at 0.5 — the standard continuous relaxation, standing in for
+// the reference's CategoricalParameter grid wrapping.
 #ifndef HVDTPU_PARAMETER_MANAGER_H
 #define HVDTPU_PARAMETER_MANAGER_H
 
@@ -22,9 +29,22 @@
 
 namespace hvdtpu {
 
+// One proposed/converged configuration (reference: the Params struct
+// broadcast by SynchronizeParameters).
+struct TunedParams {
+  int64_t fusion_threshold = 0;  // 0 = unset
+  double cycle_time_ms = 0.0;    // 0 = unset
+  bool has_flags = false;
+  bool cache_enabled = true;
+  bool hierarchical_allreduce = false;
+  bool hierarchical_allgather = false;
+};
+
 class ParameterManager {
  public:
   void Initialize(int64_t fusion_threshold, double cycle_time_ms,
+                  bool cache_enabled, bool hierarchical_allreduce,
+                  bool hierarchical_allgather, bool tune_hierarchical,
                   const std::string& log_path, int64_t warmup_samples,
                   int64_t cycles_per_sample, int64_t max_samples,
                   double gp_noise);
@@ -37,21 +57,20 @@ class ParameterManager {
 
   // Decision point, called from the coordinator cycle. Returns true when
   // new parameters should be broadcast this cycle.
-  bool Update(const std::vector<Response>& responses, int64_t* fusion_out,
-              double* cycle_out);
+  bool Update(const std::vector<Response>& responses, TunedParams* out);
 
-  int64_t best_fusion_threshold() const { return best_fusion_; }
-  double best_cycle_time_ms() const { return best_cycle_; }
+  int64_t best_fusion_threshold() const { return best_.fusion_threshold; }
+  double best_cycle_time_ms() const { return best_.cycle_time_ms; }
 
  private:
-  // Normalized [0,1]^2 <-> (log fusion bytes, log cycle ms).
-  std::vector<double> ToUnit(int64_t fusion, double cycle) const;
-  void FromUnit(const std::vector<double>& u, int64_t* fusion,
-                double* cycle) const;
+  // Normalized [0,1]^5 <-> (log fusion, log cycle, cache, hier_ar, hier_ag).
+  std::vector<double> ToUnit(const TunedParams& p) const;
+  TunedParams FromUnit(const std::vector<double>& u) const;
   void ProposeNext();
 
   bool active_ = false;
   bool done_ = false;
+  bool tune_hierarchical_ = false;
   std::FILE* log_ = nullptr;
 
   int64_t warmup_samples_ = 3;
@@ -59,10 +78,8 @@ class ParameterManager {
   int64_t max_samples_ = 20;
   double gp_noise_ = 0.8;
 
-  int64_t current_fusion_ = 64 << 20;
-  double current_cycle_ = 1.0;
-  int64_t best_fusion_ = 64 << 20;
-  double best_cycle_ = 1.0;
+  TunedParams current_;
+  TunedParams best_;
   double best_score_ = 0.0;
 
   int64_t bytes_accum_ = 0;

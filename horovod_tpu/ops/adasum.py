@@ -20,8 +20,14 @@ half.h:142); bf16 accumulation would destroy the scaling coefficients.
 
 The gathered tree combine is numerically identical to VHDD's recursive
 halving (same pairing order) and turns into pure MXU/VPU work after one
-gather. A distributed ppermute-based VHDD is a later optimization for
-tensors too large to gather.
+gather — but it holds a P× copy of the tensor on every chip. For tensors
+where that blow-up matters (``size * P >= GATHER_THRESHOLD_ELEMS``, power-
+of-two worlds) :func:`_vhdd_allreduce` runs the reference's actual
+distributed VHDD in-jit: per level, pairs exchange *half* their current
+segment via ``lax.ppermute``, the level's dot/norm partials are assembled
+with one tiny all_gather, and the final reassembly is a single psum of
+disjointly-placed shards (which also re-establishes replication for the
+sharding checker). Per-chip memory stays O(n), traffic ≈ 2n total.
 """
 
 from __future__ import annotations
@@ -75,6 +81,78 @@ def _tree_combine(stack: jax.Array) -> jax.Array:
     return stack[0]
 
 
+# Use the distributed VHDD once the gathered stack (elements x world size)
+# would cross this many elements (64M f32 = 256 MB of gather buffer).
+GATHER_THRESHOLD_ELEMS = 64 * 1024 * 1024
+
+
+def _bit_reverse(i: int, bits: int) -> int:
+    out = 0
+    for j in range(bits):
+        out |= ((i >> j) & 1) << (bits - 1 - j)
+    return out
+
+
+def _vhdd_allreduce(tensor: jax.Array, axes_t: Tuple[str, ...]) -> jax.Array:
+    """Distributed vector-halving distance-doubling Adasum (reference:
+    FusedAllreduce, adasum.h:196+), in-jit over the mesh axes.
+
+    Level l (distance d=2^l): pair (r, r^d) splits its current segment —
+    the lower rank keeps the first half — and the halves travel by
+    ``ppermute``. The level's global dot/|a|²/|b|² are assembled from
+    per-rank partials with a 3-float all_gather masked to the 2d-rank block
+    (the reference's SumAllreduceWithComm over reduction_comms_). After
+    log2(P) levels rank r owns the combined block ``bitrev(r)``; one psum
+    of disjointly-placed shards reassembles the replicated result.
+    """
+    P = C._world_size(axes_t)
+    levels = P.bit_length() - 1
+    rank = lax.axis_index(axes_t)
+    orig_dtype, orig_shape = tensor.dtype, tensor.shape
+    flat = tensor.astype(jnp.float32).ravel()
+    n0 = flat.shape[0]
+    n = ((n0 + P - 1) // P) * P  # zero-pad: zeros are inert in dot/norms
+    flat = jnp.pad(flat, (0, n - n0))
+
+    seg = flat
+    ids = jnp.arange(P)
+    for l in range(levels):
+        d = 1 << l
+        half = seg.shape[0] // 2
+        lower = (rank & d) == 0
+        first, second = seg[:half], seg[half:]
+        send = jnp.where(lower, second, first)
+        kept = jnp.where(lower, first, second)
+        perm = [(r, r ^ d) for r in range(P)]
+        recv = lax.ppermute(send, axes_t, perm)
+        a = jnp.where(lower, kept, recv)
+        b = jnp.where(lower, recv, kept)
+        partial = jnp.stack(
+            [jnp.vdot(a, b), jnp.vdot(a, a), jnp.vdot(b, b)])
+        allp = lax.all_gather(partial, axes_t, axis=0)  # (P, 3)
+        block = (ids >> (l + 1)) == (rank >> (l + 1))
+        dot, na, nb = jnp.sum(
+            jnp.where(block[:, None], allp, 0.0), axis=0)
+        acoef = jnp.where(na > 0,
+                          1.0 - dot / (2.0 * jnp.where(na > 0, na, 1.0)),
+                          1.0)
+        bcoef = jnp.where(nb > 0,
+                          1.0 - dot / (2.0 * jnp.where(nb > 0, nb, 1.0)),
+                          1.0)
+        seg = acoef * a + bcoef * b
+
+    # Rank r's shard is logical block bitrev(r): place it there and psum the
+    # disjoint shards — one collective that also yields a replicated output.
+    shard_len = n // P
+    brev = rank * 0
+    for j in range(levels):
+        brev = brev | (((rank >> j) & 1) << (levels - 1 - j))
+    full = jnp.zeros((n,), jnp.float32)
+    full = lax.dynamic_update_slice_in_dim(full, seg, brev * shard_len, 0)
+    out = lax.psum(full, axes_t)
+    return out[:n0].reshape(orig_shape).astype(orig_dtype)
+
+
 def adasum_allreduce(
     tensor: jax.Array,
     *,
@@ -99,6 +177,13 @@ def adasum_allreduce(
         # Eager path: the native core runs recursive-doubling Adasum over
         # the process world (cc/src/adasum.cc).
         out = C._eager_allreduce(tensor, C.ReduceOp.ADASUM)
+        return C._scale(out, postscale_factor)
+    world = C._world_size(axes_t)
+    if (world & (world - 1)) == 0 and world > 1 and \
+            tensor.size * world >= GATHER_THRESHOLD_ELEMS:
+        # Large tensor on a power-of-two world: distributed VHDD keeps
+        # per-chip memory at O(n) instead of the gather's O(n*P).
+        out = _vhdd_allreduce(tensor, axes_t)
         return C._scale(out, postscale_factor)
     ctx = None
     if compression is not None:

@@ -341,3 +341,10 @@ def DistributedOptimizer(optimizer, name=None, compression=None, op=Average,
     return create_distributed_optimizer(
         optimizer, compression or Compression.none, op, prescale_factor,
         postscale_factor)
+
+
+# Late imports: these modules import names from this package
+# (reference keeps the same layout: tensorflow/sync_batch_norm.py and
+# tensorflow/elastic.py are sibling modules re-exported here).
+from .sync_batch_norm import SyncBatchNormalization  # noqa: F401,E402
+from . import elastic  # noqa: F401,E402

@@ -1,0 +1,62 @@
+"""Elastic state for TensorFlow (reference: horovod/tensorflow/elastic.py).
+
+``TensorFlowState`` snapshots a list of ``tf.Variable`` in memory
+(save/restore) and syncs them from the new rank 0 after a world change;
+``TensorFlowKerasState`` wraps a Keras model + optimizer the same way
+(reference: TensorFlowKerasState, tensorflow/elastic.py:120+). Both carry
+arbitrary picklable attrs through the ObjectState machinery, exactly like
+the torch and JAX states (torch/elastic/state.py:27, elastic/state.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError("horovod_tpu.tensorflow.elastic requires tensorflow"
+                      ) from e
+
+from ..elastic.state import ObjectState
+from . import broadcast, broadcast_object
+
+
+class TensorFlowState(ObjectState):
+    """State of a list of tf.Variables + picklable attrs (reference:
+    tensorflow/elastic.py TensorFlowState)."""
+
+    def __init__(self, variables: Optional[List[tf.Variable]] = None,
+                 **kwargs):
+        self.variables = list(variables or [])
+        self._saved_variables: List = []
+        super().__init__(bcast_object=broadcast_object, **kwargs)
+        self.save()
+
+    def save(self) -> None:
+        self._saved_variables = [v.numpy() for v in self.variables]
+        super().save()
+
+    def restore(self) -> None:
+        for v, saved in zip(self.variables, self._saved_variables):
+            v.assign(saved)
+        super().restore()
+
+    def sync(self) -> None:
+        for i, v in enumerate(self.variables):
+            v.assign(broadcast(v, root_rank=0, name=f"tf_state.var.{i}"))
+        super().sync()
+        self._saved_variables = [v.numpy() for v in self.variables]
+
+
+class TensorFlowKerasState(TensorFlowState):
+    """State of a Keras model (+ optional optimizer) + attrs (reference:
+    tensorflow/elastic.py TensorFlowKerasState:120+)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        variables = list(model.variables)
+        if optimizer is not None:
+            variables += list(optimizer.variables)
+        super().__init__(variables=variables, **kwargs)

@@ -1,0 +1,78 @@
+"""Cross-rank synchronized batch normalization for TensorFlow/Keras.
+
+Reference: ``horovod/tensorflow/sync_batch_norm.py:65`` —
+``SyncBatchNormalization`` overrides the moment computation so batch
+statistics are computed over the *global* batch (allgather of per-rank
+mean/var there). Here the equivalent sufficient statistics (sum, sum of
+squares, count) ride one fused allreduce — the same reduction the torch
+binding uses (horovod_tpu/torch/sync_batch_norm.py) and the TPU-shaped
+version of the math.
+
+Built on Keras 3 (`keras.layers.BatchNormalization` subclass): inference
+and world-size-1 fall straight through to the stock layer; in distributed
+training the normalization moments and the moving-average updates use the
+cross-rank statistics, so every rank normalizes identically.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as tf
+except ImportError as e:  # pragma: no cover
+    raise ImportError(
+        "horovod_tpu.tensorflow.SyncBatchNormalization requires tensorflow"
+    ) from e
+
+import keras
+
+from . import Sum, allreduce, size
+
+
+class SyncBatchNormalization(keras.layers.BatchNormalization):
+    """BatchNormalization with cross-rank synchronized statistics."""
+
+    def call(self, inputs, training=None, mask=None):
+        if not training or size() == 1:
+            return super().call(inputs, training=training, mask=mask)
+
+        x = tf.cast(inputs, self.compute_dtype)
+        ndim = len(x.shape)
+        axis = self.axis if self.axis >= 0 else ndim + self.axis
+        red_axes = [i for i in range(ndim) if i != axis]
+        c = x.shape[axis]
+
+        n_local = tf.cast(tf.size(x) / c, tf.float32)
+        local_sum = tf.cast(tf.reduce_sum(x, axis=red_axes), tf.float32)
+        local_sqsum = tf.cast(
+            tf.reduce_sum(tf.square(x), axis=red_axes), tf.float32)
+        stats = tf.concat(
+            [local_sum, local_sqsum, tf.reshape(n_local, [1])], axis=0)
+        stats = allreduce(stats, op=Sum, name=f"sync_bn.{self.name}.stats")
+        count = stats[-1]
+        mean = stats[:c] / count
+        var = stats[c:2 * c] / count - tf.square(mean)
+
+        # Moving averages from the global moments (unbiased variance, as the
+        # stock layer uses for the moving estimate).
+        unbiased = var * count / tf.maximum(count - 1.0, 1.0)
+        m = tf.cast(self.momentum, tf.float32)
+        self.moving_mean.assign(
+            tf.cast(self.moving_mean, tf.float32) * m + mean * (1.0 - m))
+        self.moving_variance.assign(
+            tf.cast(self.moving_variance, tf.float32) * m
+            + unbiased * (1.0 - m))
+
+        shape = [1] * ndim
+        shape[axis] = c
+        mean_b = tf.reshape(tf.cast(mean, self.compute_dtype), shape)
+        inv = tf.reshape(
+            tf.cast(tf.math.rsqrt(var + self.epsilon), self.compute_dtype),
+            shape)
+        out = (x - mean_b) * inv
+        if self.scale:
+            out = out * tf.reshape(tf.cast(self.gamma, self.compute_dtype),
+                                   shape)
+        if self.center:
+            out = out + tf.reshape(tf.cast(self.beta, self.compute_dtype),
+                                   shape)
+        return tf.cast(out, inputs.dtype)

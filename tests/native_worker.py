@@ -118,6 +118,31 @@ def main():
                                   op=ctx.ADASUM).wait()
         assert np.allclose(out, 1.0, atol=1e-5)
 
+        # numerics vs the pairwise-tree reference formula (adasum.h:73-141)
+        # on rank-distinct vectors with an odd length, so the VHDD halving
+        # hits uneven splits (reference: test_adasum_* numerics checks).
+        def adasum_ref(vecs):
+            vecs = [v.astype(np.float64) for v in vecs]
+            while len(vecs) > 1:
+                nxt = []
+                for i in range(0, len(vecs), 2):
+                    a, b = vecs[i], vecs[i + 1]
+                    dot, na, nb = float(a @ b), float(a @ a), float(b @ b)
+                    ac = 1.0 if na <= 0 else 1.0 - dot / (2 * na)
+                    bc = 1.0 if nb <= 0 else 1.0 - dot / (2 * nb)
+                    nxt.append(ac * a + bc * b)
+                vecs = nxt
+            return vecs[0]
+
+        def contrib(r):
+            return (np.sin(np.arange(13) + r) + r).astype(np.float32)
+
+        out = ctx.allreduce_async(contrib(rank).copy(), "ads_num",
+                                  op=ctx.ADASUM).wait()
+        expected = adasum_ref([contrib(r) for r in range(size)])
+        assert np.allclose(out, expected, rtol=1e-4, atol=1e-5), \
+            (out, expected)
+
     # large buffer: ring chunks far beyond kernel socket buffers must not
     # deadlock (regression: blocking send() in the bidirectional exchange)
     big = np.ones(8 << 20, np.float32)  # 32 MB
@@ -146,6 +171,24 @@ def main():
             jh = ctx.join_async()
         jh.wait()
         assert jh.join_result() >= 0
+
+    if os.environ.get("HOROVOD_AUTOTUNE") == "1":
+        # Drive enough traffic for the tuner to sample, propose, and (with
+        # the test's small max-samples) converge; then verify the tuned
+        # values propagated identically to every rank (reference:
+        # SynchronizeParameters broadcasts the Params struct to workers,
+        # controller.cc:34-48).
+        import time as _time
+
+        for i in range(150):
+            ctx.allreduce_async(np.ones(2048, np.float32), f"at{i}").wait()
+        ctx.barrier()
+        _time.sleep(0.3)  # let the final broadcast's application land
+        ft = np.array([[float(ctx.fusion_threshold())]], np.float64)
+        g = ctx.allgather_async(ft, "at_sync").wait()
+        assert g.shape == (size, 1)
+        assert np.all(g == g[0]), f"tuned fusion thresholds diverge: {g}"
+        assert 1024 <= g[0, 0] <= 256 * 1024 * 1024, g
 
     ctx.barrier()
     ctx.close()

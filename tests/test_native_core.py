@@ -186,11 +186,26 @@ class TestMultiProcess:
         }, local_size=2)
 
     def test_autotune_smoke(self):
+        # Small sample budget so the tuner converges inside the worker's
+        # autotune traffic loop; the worker then asserts the tuned params
+        # propagated identically to every rank.
         _run_world(2, {
             "HOROVOD_AUTOTUNE": "1",
             "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
             "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "4",
         })
+
+    def test_autotune_hierarchical_topology(self):
+        # On a 2x2 topology the hierarchical flags join the search space;
+        # the run must stay correct whichever way the tuner flips them
+        # mid-stream (all the worker's numeric assertions still hold).
+        _run_world(4, {
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_WARMUP_SAMPLES": "1",
+            "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE": "2",
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES": "6",
+        }, local_size=2, timeout=180)
 
 
 class TestEagerPythonAPI:

@@ -129,6 +129,64 @@ class TestDistributedGradientTape:
             tape.gradient(loss, [emb])
 
 
+class TestSyncBatchNorm:
+    def test_matches_stock_bn_world1(self, monkeypatch):
+        """World-1 allreduce is identity, so the synchronized path must
+        reproduce the stock layer's training output exactly (forced onto
+        the sync path by faking size=2)."""
+        from horovod_tpu.tensorflow import sync_batch_norm as sbn_mod
+
+        monkeypatch.setattr(sbn_mod, "size", lambda: 2)
+        rs = np.random.RandomState(0)
+        x = tf.constant(rs.randn(8, 5).astype(np.float32))
+        sbn = hvd_tf.SyncBatchNormalization(momentum=0.9, epsilon=1e-3)
+        ref = keras.layers.BatchNormalization(momentum=0.9, epsilon=1e-3)
+        sbn.build(x.shape)
+        ref.build(x.shape)
+        out = sbn(x, training=True)
+        expect = ref(x, training=True)
+        assert np.allclose(out.numpy(), expect.numpy(), atol=1e-5)
+        assert np.allclose(np.asarray(sbn.moving_mean),
+                           np.asarray(ref.moving_mean), atol=1e-5)
+        assert np.allclose(np.asarray(sbn.moving_variance),
+                           np.asarray(ref.moving_variance), atol=1e-5)
+
+    def test_inference_uses_moving_stats(self):
+        x = tf.constant(np.random.RandomState(1).randn(4, 3)
+                        .astype(np.float32))
+        sbn = hvd_tf.SyncBatchNormalization()
+        out = sbn(x, training=False)
+        # moving stats are identity at init: output ~= x (eps shift only)
+        assert np.allclose(out.numpy(), x.numpy(), atol=1e-2)
+
+
+class TestTensorFlowState:
+    def test_save_restore_sync_world1(self):
+        v = tf.Variable([1.0, 2.0])
+        st = hvd_tf.elastic.TensorFlowState(variables=[v], epoch=3)
+        v.assign([9.0, 9.0])
+        st.epoch = 7
+        st.restore()
+        assert np.allclose(v.numpy(), [1.0, 2.0])
+        assert st.epoch == 3
+        v.assign([5.0, 5.0])
+        st.epoch = 4
+        st.save()
+        st.sync()  # world 1: broadcast is identity
+        assert np.allclose(v.numpy(), [5.0, 5.0])
+        assert st.epoch == 4
+
+    def test_keras_state_wraps_model(self):
+        model = keras.Sequential([keras.layers.Input(shape=(2,)),
+                                  keras.layers.Dense(1)])
+        st = hvd_tf.elastic.TensorFlowKerasState(model, epoch=0)
+        w0 = [w.copy() for w in model.get_weights()]
+        model.set_weights([w + 1.0 for w in w0])
+        st.restore()
+        for a, b in zip(model.get_weights(), w0):
+            assert np.allclose(a, b)
+
+
 class TestKerasOptimizer:
     def test_wraps_class_and_trains(self):
         keras.utils.set_random_seed(0)

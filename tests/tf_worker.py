@@ -113,6 +113,36 @@ def main():
     state.sync()
     assert state.epoch == 0
 
+    # -- SyncBatchNormalization: per-rank shard stats must equal the
+    # big-batch moments (reference: test_horovod_sync_batch_norm) --
+    rs = np.random.RandomState(7)
+    full = rs.randn(size * 4, 6).astype(np.float32) * 2.0 + 1.0
+    shard = tf.constant(full[rank * 4:(rank + 1) * 4])
+    sbn = hvd.SyncBatchNormalization(momentum=0.5, epsilon=1e-5)
+    sbn.build(shard.shape)
+    out = sbn(shard, training=True)
+    gmean = full.mean(axis=0)
+    gvar = full.var(axis=0)
+    expect = (full[rank * 4:(rank + 1) * 4] - gmean) / \
+        np.sqrt(gvar + 1e-5)
+    assert np.allclose(out.numpy(), expect, atol=1e-4), "sync BN moments"
+    n = full.shape[0]
+    unbiased = gvar * n / (n - 1)
+    assert np.allclose(np.asarray(sbn.moving_mean), 0.5 * gmean,
+                       atol=1e-4)
+    assert np.allclose(np.asarray(sbn.moving_variance),
+                       0.5 + 0.5 * unbiased, atol=1e-4)
+
+    # -- TensorFlowState: sync pulls rank-0 values everywhere --
+    v = tf.Variable(tf.fill([3], float(rank)))
+    tstate = hvd.elastic.TensorFlowState(variables=[v], batch=rank)
+    tstate.sync()
+    assert np.allclose(v.numpy(), 0.0), v.numpy()
+    assert tstate.batch == 0
+    v.assign(tf.fill([3], 99.0))
+    tstate.restore()
+    assert np.allclose(v.numpy(), 0.0), v.numpy()
+
     hvd.shutdown()
     print(f"rank {rank}: tf worker OK")
 
