@@ -45,8 +45,12 @@ class TensorFlowState(ObjectState):
     def sync(self) -> None:
         for i, v in enumerate(self.variables):
             v.assign(broadcast(v, root_rank=0, name=f"tf_state.var.{i}"))
-        super().sync()
+        # Snapshot the broadcast values BEFORE ObjectState.sync(): its attr
+        # sync ends in a polymorphic self.restore(), which re-assigns the
+        # variables from _saved_variables — if that still held the pre-sync
+        # local snapshot, the just-broadcast values would be clobbered.
         self._saved_variables = [v.numpy() for v in self.variables]
+        super().sync()
 
 
 class TensorFlowKerasState(TensorFlowState):

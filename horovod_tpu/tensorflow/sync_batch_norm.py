@@ -52,15 +52,16 @@ class SyncBatchNormalization(keras.layers.BatchNormalization):
         mean = stats[:c] / count
         var = stats[c:2 * c] / count - tf.square(mean)
 
-        # Moving averages from the global moments (unbiased variance, as the
-        # stock layer uses for the moving estimate).
-        unbiased = var * count / tf.maximum(count - 1.0, 1.0)
+        # Moving averages from the global moments. The stock Keras layer
+        # feeds the *biased* batch variance (tf.nn.moments output) into the
+        # moving estimate, so the synchronized layer must too — world-1 must
+        # match keras.layers.BatchNormalization exactly.
         m = tf.cast(self.momentum, tf.float32)
         self.moving_mean.assign(
             tf.cast(self.moving_mean, tf.float32) * m + mean * (1.0 - m))
         self.moving_variance.assign(
             tf.cast(self.moving_variance, tf.float32) * m
-            + unbiased * (1.0 - m))
+            + var * (1.0 - m))
 
         shape = [1] * ndim
         shape[axis] = c

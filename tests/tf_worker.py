@@ -126,12 +126,13 @@ def main():
     expect = (full[rank * 4:(rank + 1) * 4] - gmean) / \
         np.sqrt(gvar + 1e-5)
     assert np.allclose(out.numpy(), expect, atol=1e-4), "sync BN moments"
-    n = full.shape[0]
-    unbiased = gvar * n / (n - 1)
+    # Moving variance uses the *biased* global variance — the stock Keras
+    # layer's convention, and what test_tensorflow.py's world-1 parity
+    # test asserts.
     assert np.allclose(np.asarray(sbn.moving_mean), 0.5 * gmean,
                        atol=1e-4)
     assert np.allclose(np.asarray(sbn.moving_variance),
-                       0.5 + 0.5 * unbiased, atol=1e-4)
+                       0.5 + 0.5 * gvar, atol=1e-4)
 
     # -- TensorFlowState: sync pulls rank-0 values everywhere --
     v = tf.Variable(tf.fill([3], float(rank)))
