@@ -1,0 +1,51 @@
+"""Byte-payload transport over the eager/native collectives.
+
+One wire protocol shared by every host-framework binding's
+``broadcast_object`` / ``allgather_object`` (torch, mxnet; reference:
+horovod/torch/functions.py:122-160, mxnet/functions.py): payloads ride as
+numpy uint8 buffers — a size broadcast first, then the data — so each
+binding only supplies its serializer (torch.save vs pickle) and never
+re-implements the framing.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..ops import collective_ops as C
+
+
+def broadcast_bytes(data: Optional[bytes], root_rank: int,
+                    name: str) -> bytes:
+    """Broadcast ``data`` from ``root_rank``; non-root ranks pass anything
+    (ignored) and receive the root's bytes. World-1 returns ``data``."""
+    ctrl, world = C._eager_ctx()
+    if world == 1:
+        return data if data is not None else b""
+    is_root = ctrl.rank() == root_rank
+    payload = np.frombuffer(data, dtype=np.uint8).copy() \
+        if is_root and data is not None else np.empty(0, np.uint8)
+    sz = ctrl.broadcast_async(np.array([len(payload)], np.int64),
+                              f"{name}.sz", root=root_rank).wait()
+    buf = payload if is_root else np.empty(int(sz[0]), np.uint8)
+    out = ctrl.broadcast_async(buf, f"{name}.data", root=root_rank).wait()
+    return out.tobytes()
+
+
+def allgather_bytes(data: bytes, name: str) -> List[bytes]:
+    """Gather every rank's bytes; returns them rank-ordered. World-1
+    returns ``[data]``."""
+    ctrl, world = C._eager_ctx()
+    if world == 1:
+        return [data]
+    payload = np.frombuffer(data, dtype=np.uint8).copy()
+    gathered = ctrl.allgather_async(payload, f"{name}.data").wait()
+    sizes = ctrl.allgather_async(np.array([len(payload)], np.int64),
+                                 f"{name}.sz").wait()
+    out, offset = [], 0
+    for s in sizes.tolist():
+        out.append(gathered[offset:offset + int(s)].tobytes())
+        offset += int(s)
+    return out

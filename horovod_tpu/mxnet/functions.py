@@ -1,0 +1,38 @@
+"""Object broadcast/gather helpers for MXNet.
+
+Reference: ``horovod/mxnet/functions.py`` — ``broadcast_object`` /
+``allgather_object`` ship pickled payloads as byte NDArrays. Here the
+framing rides the shared byte-transport protocol
+(``horovod_tpu/common/object_transport.py``); this module only supplies the
+pickle serializer.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, List, Optional
+
+from ..common.object_transport import allgather_bytes, broadcast_bytes
+from ..ops import collective_ops as C
+from . import mpi_ops
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None) -> Any:
+    """Pickle ``obj`` on the root and broadcast it (reference:
+    mxnet/functions.py broadcast_object)."""
+    name = name or "mx.broadcast_object"
+    if C._eager_world() == 1:
+        return obj
+    data = pickle.dumps(obj) if mpi_ops.rank() == root_rank else None
+    return pickle.loads(broadcast_bytes(data, root_rank, name))
+
+
+def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
+    """Gather a picklable object from every rank (reference:
+    mxnet/functions.py allgather_object)."""
+    name = name or "mx.allgather_object"
+    if C._eager_world() == 1:
+        return [obj]
+    return [pickle.loads(b) for b in
+            allgather_bytes(pickle.dumps(obj), name)]

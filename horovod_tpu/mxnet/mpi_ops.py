@@ -1,0 +1,200 @@
+"""MXNet collective ops over the native control plane.
+
+Reference surface: ``horovod/mxnet/mpi_ops.py`` (allreduce/allreduce_:54-143,
+allgather:145-183, broadcast/broadcast_:185-259, alltoall:261-300) backed by
+``mxnet/mpi_ops.cc:426`` per-dtype C++ ops pushed onto the MXNet engine.
+
+TPU-native redesign: like torch (horovod_tpu/torch/mpi_ops.py), mxnet is a
+*host* framework here — NDArrays cross into numpy and ride the same native
+C++ controller + TCP data plane (horovod_tpu/cc/) the eager JAX API uses, so
+an mxnet script participates in the same world as JAX/torch processes. The
+reference's engine-async dispatch (return immediately, engine tracks the
+write dependency) is replaced by synchronous completion: the native
+background loop already overlaps negotiation with compute, and NDArray has
+no external dependency-tracking hook to attach to.
+
+``priority`` is accepted for API parity and forwarded as a negotiation-order
+hint only (the reference uses it to order engine pushes).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..common import basics
+from ..ops import collective_ops as C
+from ..ops.collective_ops import ReduceOp
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+__all__ = [
+    "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "allreduce", "allreduce_", "allgather", "broadcast", "broadcast_",
+    "alltoall", "rank", "size", "local_rank", "local_size",
+]
+
+
+def rank() -> int:
+    """Process rank in the eager/native world (mxnet is a host framework:
+    one rank per worker process, like the torch binding — NOT the
+    single-controller SPMD device count ``basics.size()`` reports).
+    Without a native controller the jax.distributed process index is the
+    rank, keeping rank()/size() mutually consistent with ``_eager_world``'s
+    process_count fallback."""
+    s = basics._require_init()
+    return int(s.controller.rank()) if s.controller is not None \
+        else int(s.process_index)
+
+
+def size() -> int:
+    """World size of the eager/native world (see ``rank``)."""
+    return int(C._eager_world())
+
+
+def local_rank() -> int:
+    ctrl = C._controller()
+    return int(ctrl.local_rank()) if ctrl is not None else 0
+
+
+def local_size() -> int:
+    ctrl = C._controller()
+    return int(ctrl.local_size()) if ctrl is not None else 1
+
+
+# --------------------------------------------------------------------------
+# NDArray <-> numpy bridge
+# --------------------------------------------------------------------------
+
+
+def _nd():
+    import mxnet as mx
+
+    return mx.nd
+
+
+def _to_numpy(tensor) -> np.ndarray:
+    """Materialize an NDArray as a contiguous numpy array. ``asnumpy()``
+    waits on the engine, so every pending mutation is visible."""
+    return np.ascontiguousarray(tensor.asnumpy())
+
+
+def _write_back(tensor, arr: np.ndarray):
+    """Write a numpy result into an existing NDArray in place."""
+    tensor[:] = arr.reshape(tensor.shape)
+    return tensor
+
+
+def _ctrl_ctx():
+    return C._eager_ctx()
+
+
+# --------------------------------------------------------------------------
+# allreduce
+# --------------------------------------------------------------------------
+
+
+def _allreduce_numpy(arr: np.ndarray, average: bool, name: Optional[str],
+                     prescale_factor: float, postscale_factor: float
+                     ) -> np.ndarray:
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "mx.allreduce")
+    if world == 1:
+        scale = prescale_factor * postscale_factor
+        return arr if scale == 1.0 else arr * scale
+    post = postscale_factor / world if average else postscale_factor
+    handle = ctrl.allreduce_async(arr, opname, op=ctrl.SUM,
+                                  prescale=float(prescale_factor),
+                                  postscale=float(post))
+    return handle.wait()
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None,
+              priority: int = 0, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Allreduce into a fresh NDArray (reference: mxnet/mpi_ops.py:54-101)."""
+    out = _allreduce_numpy(_to_numpy(tensor), average, name,
+                           prescale_factor, postscale_factor)
+    return _nd().array(out.reshape(tensor.shape), dtype=out.dtype)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None,
+               priority: int = 0, prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0):
+    """In-place allreduce (reference: mxnet/mpi_ops.py:103-143)."""
+    out = _allreduce_numpy(_to_numpy(tensor), average, name,
+                           prescale_factor, postscale_factor)
+    return _write_back(tensor, out)
+
+
+# --------------------------------------------------------------------------
+# allgather
+# --------------------------------------------------------------------------
+
+
+def allgather(tensor, name: Optional[str] = None, priority: int = 0):
+    """First-dim concatenation across ranks; ranks may differ in dim 0
+    (reference: mxnet/mpi_ops.py:145-183)."""
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "mx.allgather")
+    arr = _to_numpy(tensor)
+    if world == 1:
+        return _nd().array(arr, dtype=arr.dtype)
+    out = ctrl.allgather_async(arr, opname).wait()
+    return _nd().array(out, dtype=out.dtype)
+
+
+# --------------------------------------------------------------------------
+# broadcast
+# --------------------------------------------------------------------------
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              priority: int = 0):
+    """Broadcast into a fresh NDArray (reference: mxnet/mpi_ops.py:185-226)."""
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "mx.broadcast")
+    arr = _to_numpy(tensor)
+    if world > 1:
+        arr = ctrl.broadcast_async(arr, opname, root=root_rank).wait()
+    return _nd().array(arr.reshape(tensor.shape), dtype=arr.dtype)
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               priority: int = 0):
+    """In-place broadcast (reference: mxnet/mpi_ops.py:228-259)."""
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "mx.broadcast")
+    if world == 1:
+        return tensor
+    out = ctrl.broadcast_async(_to_numpy(tensor), opname,
+                               root=root_rank).wait()
+    return _write_back(tensor, out)
+
+
+# --------------------------------------------------------------------------
+# alltoall
+# --------------------------------------------------------------------------
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             priority: int = 0):
+    """Alltoall with optional uneven splits; returns the output NDArray
+    (reference: mxnet/mpi_ops.py:261-300)."""
+    ctrl, world = _ctrl_ctx()
+    opname = C._eager_name(name, "mx.alltoall")
+    arr = _to_numpy(tensor)
+    if world == 1:
+        return _nd().array(arr, dtype=arr.dtype)
+    sp: Optional[List[int]] = None
+    if splits is not None:
+        sp = [int(x) for x in
+              (splits.asnumpy() if hasattr(splits, "asnumpy") else splits)]
+    out = ctrl.alltoall_async(arr, opname, splits=sp).wait()
+    return _nd().array(out, dtype=out.dtype)

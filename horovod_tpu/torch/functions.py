@@ -10,11 +10,10 @@ from __future__ import annotations
 import io
 from typing import Any, List
 
-import numpy as np
 import torch
 
 from . import mpi_ops
-from .mpi_ops import broadcast_, synchronize, broadcast_async_
+from .mpi_ops import synchronize, broadcast_async_
 
 
 def broadcast_parameters(params, root_rank: int = 0) -> None:
@@ -90,48 +89,36 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     optimizer.load_state_dict(state_dict)
 
 
+def _torch_dumps(obj: Any) -> bytes:
+    buf = io.BytesIO()
+    torch.save(obj, buf)
+    return buf.getvalue()
+
+
+def _torch_loads(data: bytes) -> Any:
+    return torch.load(io.BytesIO(data), weights_only=False)
+
+
 def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
-    """Pickle ``obj`` on the root and broadcast it (reference:
-    functions.py:122-160 tensorflow analogue functions.py:59-134 — size
-    broadcast first, then the payload as a byte tensor)."""
+    """torch.save ``obj`` on the root and broadcast it (reference:
+    functions.py:122-160 — size broadcast first, then the payload; framing
+    shared with the other host bindings via common/object_transport.py)."""
+    from ..common.object_transport import broadcast_bytes
+
     name = name or "broadcast_object"
     if mpi_ops._world() == 1:
         return obj
-    if mpi_ops.rank() == root_rank:
-        buf = io.BytesIO()
-        torch.save(obj, buf)
-        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8).copy()
-    else:
-        payload = np.empty(0, dtype=np.uint8)
-    sz = torch.tensor([len(payload)], dtype=torch.int64)
-    broadcast_(sz, root_rank, name=f"{name}.sz")
-    t = torch.empty(int(sz.item()), dtype=torch.uint8)
-    if mpi_ops.rank() == root_rank:
-        t.copy_(torch.from_numpy(payload))
-    broadcast_(t, root_rank, name=f"{name}.data")
-    buf = io.BytesIO(t.numpy().tobytes())
-    return torch.load(buf, weights_only=False)
+    data = _torch_dumps(obj) if mpi_ops.rank() == root_rank else None
+    return _torch_loads(broadcast_bytes(data, root_rank, name))
 
 
 def allgather_object(obj: Any, name: str = None) -> List[Any]:
     """Gather a picklable object from every rank (reference:
     tensorflow/functions.py:136-177; torch parity added in v0.21)."""
+    from ..common.object_transport import allgather_bytes
+
     name = name or "allgather_object"
     if mpi_ops._world() == 1:
         return [obj]
-    buf = io.BytesIO()
-    torch.save(obj, buf)
-    payload = torch.from_numpy(
-        np.frombuffer(buf.getvalue(), dtype=np.uint8).copy())
-    gathered = mpi_ops.synchronize(
-        mpi_ops.allgather_async(payload, name=f"{name}.data"))
-    sizes = mpi_ops.synchronize(mpi_ops.allgather_async(
-        torch.tensor([payload.numel()], dtype=torch.int64),
-        name=f"{name}.sz"))
-    out, offset = [], 0
-    for s in sizes.tolist():
-        chunk = gathered[offset:offset + s]
-        out.append(torch.load(io.BytesIO(chunk.numpy().tobytes()),
-                              weights_only=False))
-        offset += s
-    return out
+    return [_torch_loads(b) for b in
+            allgather_bytes(_torch_dumps(obj), name)]

@@ -1,0 +1,179 @@
+"""Minimal in-process stand-in for ``mxnet``, pinning the exact API surface
+``horovod_tpu.mxnet`` touches (the same test strategy as ``fake_ray.py``:
+MXNet is EOL and not installable in this image, so the binding is exercised
+against a faithful shim of the real mxnet 1.9 interfaces).
+
+Pinned surfaces (each attribute below exists with the same name/shape in
+real mxnet):
+
+- ``mx.nd.array(arr, dtype=None)`` -> NDArray with ``asnumpy()``,
+  ``__setitem__`` (slice assignment), ``shape``, ``dtype``
+- ``mx.optimizer.Optimizer`` base with ``rescale_grad``; ``mx.optimizer.SGD``
+  with ``update(index, weight, grad, state)`` applying
+  ``weight -= lr * rescale_grad * grad``
+- ``mx.gluon.Trainer(params, optimizer, optimizer_params, kvstore)`` with
+  ``_params``, ``_scale``, ``_allreduce_grads()``, ``step(batch_size)``
+- ``mx.gluon.parameter.Parameter`` with ``data()``, ``list_grad()``,
+  ``grad_req``, ``_init_impl``; ``DeferredInitializationError``;
+  ``ParameterDict`` (a plain dict subclass, as in mxnet 1.x)
+
+``install()`` registers the shim as ``sys.modules['mxnet']`` (plus the
+``mxnet.gluon.parameter`` submodule path) so ``import mxnet`` inside the
+binding resolves here.
+"""
+
+import sys
+import types
+
+import numpy as np
+
+
+class NDArray:
+    def __init__(self, data, dtype=None):
+        self._data = np.array(data, dtype=dtype)
+
+    def asnumpy(self):
+        return self._data.copy()
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        self._data[key] = value
+
+    def __getitem__(self, key):
+        return NDArray(self._data[key])
+
+    @property
+    def shape(self):
+        return self._data.shape
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    def __repr__(self):
+        return f"NDArray({self._data!r})"
+
+
+def _nd_array(arr, dtype=None):
+    return NDArray(arr, dtype=dtype)
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.01, rescale_grad=1.0):
+        self.lr = learning_rate
+        self.rescale_grad = rescale_grad
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self._lr_mult = args_lr_mult
+
+    def set_wd_mult(self, args_wd_mult):
+        self._wd_mult = args_wd_mult
+
+
+class SGD(Optimizer):
+    def update(self, index, weight, grad, state):
+        weight[:] = weight.asnumpy() - self.lr * (self.rescale_grad *
+                                                  grad.asnumpy())
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+class Parameter:
+    def __init__(self, name, shape=None, grad_req="write"):
+        self.name = name
+        self.shape = shape
+        self.grad_req = grad_req
+        self._data = None
+        self._grad = None
+
+    def initialize(self, value):
+        """Materialize the parameter (real mxnet routes this through
+        ``_init_impl``, which horovod wraps for deferred-init broadcast)."""
+        self._init_impl(value)
+
+    def _init_impl(self, value):
+        self._data = NDArray(value)
+        self._grad = NDArray(np.zeros_like(self._data._data))
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(
+                f"Parameter {self.name} has not been initialized")
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+
+class ParameterDict(dict):
+    pass
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device"):
+        if isinstance(params, dict):
+            params = [params[k] for k in sorted(params)]
+        self._params = list(params)
+        if isinstance(optimizer, str):
+            opts = dict(optimizer_params or {})
+            assert optimizer == "sgd", optimizer
+            optimizer = SGD(**opts)
+        self._optimizer = optimizer
+        self._scale = 1.0
+
+    def _allreduce_grads(self):
+        pass  # kvstore push/pull in real gluon; horovod overrides
+
+    def step(self, batch_size):
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                self._optimizer.update(i, param.data(), param.list_grad()[0],
+                                       None)
+
+
+def install():
+    """Register the shim as ``mxnet`` in sys.modules."""
+    mx = types.ModuleType("mxnet")
+    nd = types.ModuleType("mxnet.nd")
+    nd.array = _nd_array
+    nd.NDArray = NDArray
+    optimizer = types.ModuleType("mxnet.optimizer")
+    optimizer.Optimizer = Optimizer
+    optimizer.SGD = SGD
+    gluon = types.ModuleType("mxnet.gluon")
+    parameter = types.ModuleType("mxnet.gluon.parameter")
+    parameter.Parameter = Parameter
+    parameter.ParameterDict = ParameterDict
+    parameter.DeferredInitializationError = DeferredInitializationError
+    gluon.Trainer = Trainer
+    gluon.parameter = parameter
+    mx.nd = nd
+    mx.optimizer = optimizer
+    mx.gluon = gluon
+    sys.modules["mxnet"] = mx
+    sys.modules["mxnet.nd"] = nd
+    sys.modules["mxnet.optimizer"] = optimizer
+    sys.modules["mxnet.gluon"] = gluon
+    sys.modules["mxnet.gluon.parameter"] = parameter
+    return mx

@@ -1,0 +1,103 @@
+"""Worker for multi-process MXNet binding tests (reference analogue:
+``mpirun -np 2 pytest test_mxnet.py``, SURVEY §4). Runs against the
+fake-mxnet shim (tests/fake_mxnet.py) over the real native TCP data plane."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import fake_mxnet  # noqa: E402
+
+mx = fake_mxnet.install()
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.rank() == rank and hvd.size() == size
+
+    # -- allreduce: average (default), sum, in-place, prescale --
+    t = mx.nd.array(np.full(4, float(rank), np.float32))
+    out = hvd.allreduce(t)
+    assert np.allclose(out.asnumpy(), sum(range(size)) / size), out
+    assert np.allclose(t.asnumpy(), rank), "input mutated"
+
+    hvd.allreduce_(t, average=False, prescale_factor=2.0,
+                   postscale_factor=0.5)
+    assert np.allclose(t.asnumpy(), float(sum(range(size)))), t
+
+    # -- allgather with per-rank dim0 --
+    g = hvd.allgather(mx.nd.array(np.full((rank + 1, 2), rank, np.float32)))
+    expect = np.concatenate([np.full((r + 1, 2), r) for r in range(size)])
+    assert np.allclose(g.asnumpy(), expect), g
+
+    # -- broadcast in/out of place --
+    b = hvd.broadcast(mx.nd.array(np.full(3, rank, np.float32)), root_rank=1)
+    assert np.allclose(b.asnumpy(), 1.0), b
+    b2 = mx.nd.array(np.full(3, rank, np.float32))
+    hvd.broadcast_(b2, root_rank=0)
+    assert np.allclose(b2.asnumpy(), 0.0), b2
+
+    # -- alltoall, even splits --
+    a = hvd.alltoall(mx.nd.array(np.arange(size * 2, dtype=np.float32)
+                                 + 100 * rank))
+    expect = np.concatenate([np.arange(2) + 2 * rank + 100 * r
+                             for r in range(size)])
+    assert np.allclose(a.asnumpy(), expect), a
+
+    # -- broadcast_object / allgather_object --
+    obj = hvd.broadcast_object({"epoch": rank, "tag": f"r{rank}"},
+                               root_rank=0)
+    assert obj == {"epoch": 0, "tag": "r0"}, obj
+    objs = hvd.allgather_object(("rank", rank))
+    assert objs == [("rank", r) for r in range(size)], objs
+
+    # -- DistributedOptimizer: grads summed, average folded in rescale --
+    w = mx.nd.array(np.ones(3, np.float32))
+    grad = mx.nd.array(np.full(3, float(rank + 1), np.float32))
+    opt = hvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=1.0))
+    opt.update(0, w, grad, None)
+    # rescale_grad = 1/size, grads summed -> effective grad = mean(rank+1)
+    mean_grad = sum(r + 1 for r in range(size)) / size
+    assert np.allclose(w.asnumpy(), 1.0 - mean_grad), w
+    # every rank's weight identical after the update
+    gathered = hvd.allgather(mx.nd.array(w.asnumpy()[None, :]))
+    gn = gathered.asnumpy()
+    assert np.allclose(gn[0], gn[-1]), gn
+
+    # -- DistributedTrainer over gluon parameters --
+    p = mx.gluon.parameter.Parameter("dense0_weight")
+    p.initialize(np.ones(4, np.float32) * (rank + 5))
+    trainer = hvd.DistributedTrainer([p], "sgd",
+                                     {"learning_rate": 0.5})
+    hvd.broadcast_parameters({"dense0_weight": p}, root_rank=0)
+    assert np.allclose(p.data().asnumpy(), 5.0), p.data()
+    p.list_grad()[0][:] = np.full(4, float(rank), np.float32)
+    trainer.step(batch_size=1)
+    # scale=1/size, grads summed -> w = 5 - 0.5 * mean(rank) everywhere
+    expect_w = 5.0 - 0.5 * (sum(range(size)) / size)
+    assert np.allclose(p.data().asnumpy(), expect_w), p.data()
+
+    # -- deferred-init parameter: broadcast injected after materialize --
+    d = mx.gluon.parameter.Parameter("late_weight")
+    hvd.broadcast_parameters({"late_weight": d}, root_rank=0)
+    d.initialize(np.full(2, float(rank + 7), np.float32))
+    assert np.allclose(d.data().asnumpy(), 7.0), d.data()
+
+    hvd.shutdown()
+    print(f"rank {rank}: mxnet worker OK")
+
+
+if __name__ == "__main__":
+    main()
