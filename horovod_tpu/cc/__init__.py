@@ -107,6 +107,8 @@ def load():
         lib.hvdtpu_init.restype = ctypes.c_int
         lib.hvdtpu_shutdown.restype = None
         lib.hvdtpu_is_initialized.restype = ctypes.c_int
+        lib.hvdtpu_controller_port.restype = ctypes.c_int
+        lib.hvdtpu_clear_controller_port.restype = None
         lib.hvdtpu_last_error.restype = ctypes.c_char_p
         for f in ("rank", "size", "local_rank", "local_size", "cross_rank",
                   "cross_size"):
@@ -190,11 +192,55 @@ class CoreContext:
     # Reduce op codes (common.h ReduceOp).
     SUM, MIN, MAX, PRODUCT, ADASUM = 0, 1, 2, 3, 4
 
-    def __init__(self) -> None:
+    def __init__(self, bound_port_callback=None) -> None:
+        """``bound_port_callback(port)``: invoked from a watcher thread as
+        soon as the rank-0 coordinator's control server has bound its
+        (possibly OS-assigned, HOROVOD_CONTROLLER_PORT=0) port — while
+        ``hvdtpu_init`` is still blocked accepting peers. The elastic
+        rendezvous uses it to report the real port to the driver so peers
+        can learn where to connect (race-free port allocation on the
+        rank-0 host, not a driver-side guess)."""
         self._lib = load()
-        if self._lib.hvdtpu_init() != 0:
-            raise NativeError(
-                self._lib.hvdtpu_last_error().decode() or "init failed")
+        watcher = None
+        done = threading.Event()
+        if bound_port_callback is not None:
+            # Clear any previous incarnation's published port BEFORE the
+            # watcher starts (still single-threaded here): a stale value
+            # would be reported with the CURRENT world_id, sending every
+            # peer to a dead listener until ELASTIC_TIMEOUT.
+            self._lib.hvdtpu_clear_controller_port()
+
+            def _watch():
+                while not done.is_set():
+                    port = self._lib.hvdtpu_controller_port()
+                    if port > 0:
+                        try:
+                            bound_port_callback(port)
+                        except Exception:
+                            # A lost report must not kill the watcher
+                            # silently; formation will time out and the
+                            # elastic retry path takes over.
+                            import logging
+
+                            logging.exception(
+                                "controller bound-port report failed")
+                        return
+                    done.wait(0.01)
+
+            watcher = threading.Thread(target=_watch, daemon=True)
+            watcher.start()
+        try:
+            if self._lib.hvdtpu_init() != 0:
+                raise NativeError(
+                    self._lib.hvdtpu_last_error().decode() or "init failed")
+        finally:
+            done.set()
+            if watcher is not None:
+                watcher.join(timeout=5.0)
+
+    def controller_port(self) -> int:
+        """Bound control-server port (0 unless this rank coordinates)."""
+        return int(self._lib.hvdtpu_controller_port())
 
     # -- world queries --
     def rank(self) -> int: return self._lib.hvdtpu_rank()

@@ -124,6 +124,24 @@ Status VhddTyped(Transport& t, T* mine, int64_t count) {
   return Status::OK();
 }
 
+// Widen a 16-bit buffer to fp32, run VHDD there, narrow back. The Adasum
+// coefficients need fp32-accurate dot products — accumulating them in bf16
+// would destroy the scaling — and the wire cost of the widened exchange is
+// acceptable on the host path (the reference's AVX fp16 dispatch does the
+// same convert-combine-convert per pair, adasum.h:101-141 + half.h:142;
+// in-repo precedent: Reduce16, collectives.cc:33).
+Status Vhdd16(Transport& t, uint16_t* buf, int64_t count,
+              float (*to_f)(uint16_t), uint16_t (*from_f)(float)) {
+  std::vector<float> wide(static_cast<size_t>(count));
+  for (int64_t i = 0; i < count; ++i) wide[static_cast<size_t>(i)] =
+      to_f(buf[i]);
+  Status s = VhddTyped(t, wide.data(), count);
+  if (!s.ok()) return s;
+  for (int64_t i = 0; i < count; ++i) buf[i] =
+      from_f(wide[static_cast<size_t>(i)]);
+  return Status::OK();
+}
+
 }  // namespace
 
 Status AdasumAllreduce(Transport& t, void* buf, int64_t count, DataType dt) {
@@ -139,9 +157,16 @@ Status AdasumAllreduce(Transport& t, void* buf, int64_t count, DataType dt) {
       return VhddTyped(t, static_cast<float*>(buf), count);
     case DataType::HVDTPU_FLOAT64:
       return VhddTyped(t, static_cast<double*>(buf), count);
+    case DataType::HVDTPU_BFLOAT16:
+      return Vhdd16(t, static_cast<uint16_t*>(buf), count, Bf16ToFloat,
+                    FloatToBf16);
+    case DataType::HVDTPU_FLOAT16:
+      return Vhdd16(t, static_cast<uint16_t*>(buf), count, Fp16ToFloat,
+                    FloatToFp16);
     default:
       return Status::InvalidArgument(
-          "Adasum host path supports float32/float64 buffers.");
+          "Adasum host path supports float16/bfloat16/float32/float64 "
+          "buffers.");
   }
 }
 

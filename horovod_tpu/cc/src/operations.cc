@@ -632,11 +632,21 @@ void hvdtpu_shutdown(void) {
   if (local->background.joinable()) local->background.join();
   local->timeline.Shutdown();
   AbortEverything(*local, Status::Aborted("Horovod has been shut down"));
+  ResetBoundControlPort();
 }
+
+void hvdtpu_clear_controller_port(void) { ResetBoundControlPort(); }
 
 int hvdtpu_is_initialized(void) {
   std::lock_guard<std::mutex> l(g_mu);
   return g && g->loop_running.load() ? 1 : 0;
+}
+
+int hvdtpu_controller_port(void) {
+  // Deliberately lock-free: called from a watcher thread WHILE hvdtpu_init
+  // holds g_mu blocked in world formation — that is the whole point (the
+  // coordinator publishes its OS-assigned port before accepting peers).
+  return BoundControlPort();
 }
 
 const char* hvdtpu_last_error(void) {

@@ -1,6 +1,7 @@
 #include "transport.h"
 
 #include <arpa/inet.h>
+#include <atomic>
 #include <netinet/in.h>
 #include <poll.h>
 #include <string.h>
@@ -37,6 +38,14 @@ std::string LocalAddrOf(const TcpSocket& sock) {
 
 }  // namespace
 
+namespace {
+std::atomic<int> g_bound_control_port{0};
+}  // namespace
+
+int BoundControlPort() { return g_bound_control_port.load(); }
+
+void ResetBoundControlPort() { g_bound_control_port.store(0); }
+
 Transport::~Transport() = default;
 
 std::unique_ptr<Transport> Transport::Create(int rank, int size,
@@ -44,6 +53,7 @@ std::unique_ptr<Transport> Transport::Create(int rank, int size,
                                              int coord_port,
                                              double timeout_secs) {
   std::unique_ptr<Transport> t(new Transport(rank, size));
+  g_bound_control_port.store(0);  // fresh world incarnation
   if (size == 1) return t;  // no wires needed
   if (!t->data_server_.Listen(0)) {
     HVDTPU_LOG(ERROR) << "failed to open data-plane listener";
@@ -60,6 +70,10 @@ bool Transport::SetupCoordinator(int coord_port, double timeout_secs) {
     HVDTPU_LOG(ERROR) << "coordinator failed to listen on port " << coord_port;
     return false;
   }
+  // Publish the actually-bound port (meaningful when coord_port was 0)
+  // BEFORE blocking in Accept: the elastic rank-0 worker's watcher thread
+  // reads it and reports to the driver so peers can connect.
+  g_bound_control_port.store(control_server_.port());
   control_.resize(static_cast<size_t>(size_));
   std::vector<std::string> addrs(static_cast<size_t>(size_), "127.0.0.1");
   std::vector<int> ports(static_cast<size_t>(size_), 0);

@@ -19,6 +19,21 @@
 
 namespace hvdtpu {
 
+// Actual port the coordinator's control server bound, readable from other
+// threads while Transport::Create is still blocked accepting workers. This
+// is what makes elastic port allocation race-free: rank 0 listens on port 0
+// (OS-assigned on ITS host), a watcher thread reads the bound port here and
+// reports it to the elastic driver, and only then do peers learn where to
+// connect (reference analogue: the gloo rendezvous store's host:port
+// registration, gloo_context.cc:49-84).
+int BoundControlPort();
+
+// Zero the published port. Called before starting a bound-port watcher and
+// on shutdown, so a previous incarnation's port can never be mistaken for
+// the next world's (same-process re-coordination is the elastic norm:
+// host order keeps rank 0 on a surviving host).
+void ResetBoundControlPort();
+
 class Transport {
  public:
   // rank 0 listens on `coord_port`; workers connect to

@@ -114,6 +114,19 @@ def _build_mesh(
     return Mesh(grid, HVD_AXES)
 
 
+# Optional hook invoked (from a watcher thread) with the rank-0 controller's
+# actually-bound port once its listener is up, while world formation is
+# still in progress. Set by the elastic rendezvous before init() so the
+# OS-assigned port (HOROVOD_CONTROLLER_PORT=0) can be reported to the
+# elastic driver — port allocation happens on the rank-0 host, never as a
+# driver-side free-port guess.
+_controller_port_callback = [None]
+
+
+def set_controller_port_callback(fn) -> None:
+    _controller_port_callback[0] = fn
+
+
 def init(
     comm=None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -156,7 +169,8 @@ def init(
                 and cfg.controller != "none"):
             from .. import cc
 
-            _state.controller = cc.CoreContext()
+            _state.controller = cc.CoreContext(
+                bound_port_callback=_controller_port_callback[0])
             if _state.process_count == 1:
                 # Process-world mode (no jax.distributed): each worker
                 # process is one Horovod rank, exactly the reference's

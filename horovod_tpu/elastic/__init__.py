@@ -43,6 +43,7 @@ from .driver import (  # noqa: F401
     ElasticDriver,
     GetSlotRequest,
     RegisterWorkerAddressRequest,
+    SetControllerPortRequest,
 )
 from .registration import WorkerStateRegistry  # noqa: F401
 from .sampler import ElasticSampler  # noqa: F401
@@ -94,7 +95,22 @@ def _rendezvous(client) -> None:
         "HOROVOD_CONTROLLER_PORT": str(resp.controller_port),
     })
     _last_world_id[0] = resp.world_id
-    basics.init()
+    if slot["rank"] == 0 and slot["size"] > 1 and resp.controller_port == 0:
+        # This worker coordinates: bind an OS-assigned port on THIS host
+        # (HOROVOD_CONTROLLER_PORT=0 → native Listen(0)) and report it to
+        # the driver the moment the listener is up, so waiting peers can
+        # rendezvous. Race-free by construction — the port is allocated by
+        # the kernel of the host that uses it.
+        world_id = resp.world_id
+        basics.set_controller_port_callback(
+            lambda port: client._send(SetControllerPortRequest(world_id,
+                                                               port)))
+    else:
+        basics.set_controller_port_callback(None)
+    try:
+        basics.init()
+    finally:
+        basics.set_controller_port_callback(None)
 
 
 def _register_notification_service(client, key: bytes) -> None:

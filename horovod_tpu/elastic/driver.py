@@ -25,7 +25,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..runner import network
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
-from ..runner.network import find_free_port
 from . import constants
 from .discovery import HostManager, HostUpdateResult
 from .registration import FAILURE, SUCCESS, WorkerStateRegistry
@@ -59,6 +58,15 @@ class RegisterWorkerAddressRequest:
         self.port = port
 
 
+class SetControllerPortRequest:
+    """Rank-0 worker reporting the controller port it actually bound
+    (OS-assigned on its own host) for world ``world_id``."""
+
+    def __init__(self, world_id: int, port: int):
+        self.world_id = world_id
+        self.port = port
+
+
 class ElasticDriverService(network.BasicService):
     def __init__(self, key: bytes, driver: "ElasticDriver"):
         super().__init__("elastic driver service", key)
@@ -71,6 +79,9 @@ class ElasticDriverService(network.BasicService):
         if isinstance(req, RegisterWorkerAddressRequest):
             self._driver.register_worker_address(
                 req.host, req.local_rank, req.addr, req.port)
+            return network.AckResponse()
+        if isinstance(req, SetControllerPortRequest):
+            self._driver.set_controller_port(req.world_id, req.port)
             return network.AckResponse()
         return super()._handle(req, client_address)
 
@@ -206,7 +217,23 @@ class ElasticDriver:
                 self._released.add((host, local_rank))
                 return GetSlotResponse("shutdown")
             if self._world_id < min_world_id:
-                return GetSlotResponse("waiting")
+                if (min_world_id == self._world_id + 1
+                        and (host, local_rank) in self._assignments
+                        and (host, local_rank) not in self._released):
+                    # A current-world assignee demanding a NEWER world is
+                    # reporting that formation of the current world failed
+                    # under it (native init timeout / peer lost mid-setup).
+                    # Without this the job deadlocks: the driver sees no
+                    # exits, never resumes, and every worker waits out
+                    # ELASTIC_TIMEOUT. Build the next incarnation now.
+                    # Concurrent reports can't storm: the first bump
+                    # satisfies everyone else's min_world_id.
+                    logging.warning(
+                        f"worker {host}:{local_rank} reports failed "
+                        f"formation of world {self._world_id}; resuming")
+                    self._resume()
+                if self._world_id < min_world_id:
+                    return GetSlotResponse("waiting")
             slot = self._assignments.get((host, local_rank))
             if slot is None:
                 # Not in the new world (host shrunk/blacklisted): worker
@@ -214,6 +241,13 @@ class ElasticDriver:
                 # training success (it never finished func).
                 self._released.add((host, local_rank))
                 return GetSlotResponse("shutdown")
+            # Controller port protocol: rank 0 binds port 0 on ITS host and
+            # reports it via SetControllerPortRequest; everyone else waits
+            # here until that report lands. No driver-side free-port guess
+            # can race with the rank-0 host's port space.
+            if slot.rank != 0 and slot.size > 1 and \
+                    self._controller_port == 0:
+                return GetSlotResponse("waiting")
             self._registry.record_ready(host, local_rank)
             rank0_host = next(s.hostname for s in self._assignments.values()
                               if s.rank == 0)
@@ -225,6 +259,14 @@ class ElasticDriver:
                                    world_id=self._world_id,
                                    controller_addr=addr,
                                    controller_port=self._controller_port)
+
+    def set_controller_port(self, world_id: int, port: int) -> None:
+        """Record the controller port rank 0 bound for ``world_id``;
+        ignored if the world has already moved on (a stale incarnation's
+        report must not poison the current one)."""
+        with self._lock:
+            if world_id == self._world_id:
+                self._controller_port = port
 
     def register_worker_address(self, host: str, local_rank: int,
                                 addr: str, port: int) -> None:
@@ -323,11 +365,12 @@ class ElasticDriver:
             self._registry.reset()
             self._assignments = {(s.hostname, s.local_rank): s
                                  for s in slots}
-            # NOTE: probed on the driver machine; for a remote rank-0 host
-            # this is only a good guess — a collision there fails world
-            # formation, and workers retry into the next incarnation
-            # (see find_free_port's caveat).
-            self._controller_port = find_free_port()
+            # Port 0 = "not yet known": the rank-0 worker of this world
+            # binds an OS-assigned port on ITS host and reports it back via
+            # SetControllerPortRequest; peers wait in get_slot_info until
+            # then. (Round-2 flaw: find_free_port() probed the DRIVER's
+            # port space for a socket that binds on the rank-0 worker.)
+            self._controller_port = 0
             if self._verbose:
                 logging.info(
                     f"world {self._world_id}: "

@@ -343,16 +343,82 @@ class TestGetSlotProtocol:
         driver = ElasticDriver(disc, min_np=1, max_np=2)
         try:
             driver.start(workers)
-            # current world is 0: a request for world >= 1 waits
-            resp = driver.get_slot_info("a", 0, min_world_id=1)
+            # current world is 0: a NON-assignee request for world >= 1
+            # waits (an assignee's would be a formation-failure report and
+            # bump the world — covered below)
+            resp = driver.get_slot_info("zzz", 5, min_world_id=1)
             assert resp.status == "waiting"
-            resp = driver.get_slot_info("a", 0, min_world_id=0)
+            # rank 0's slot: ok immediately, controller_port=0 = "you bind"
+            rank0_host = next(
+                s.hostname for s in driver.current_assignments()
+                if s.rank == 0)
+            other_host = "b" if rank0_host == "a" else "a"
+            resp = driver.get_slot_info(rank0_host, 0, min_world_id=0)
             assert resp.status == "ok"
-            assert resp.slot["rank"] in (0, 1)
-            assert resp.controller_port > 0
+            assert resp.slot["rank"] == 0
+            assert resp.controller_port == 0
             # unknown slot → shutdown signal
             resp = driver.get_slot_info("zzz", 5, min_world_id=0)
             assert resp.status == "shutdown"
+            # non-rank-0 waits until rank 0 reports its bound port
+            resp = driver.get_slot_info(other_host, 0, min_world_id=0)
+            assert resp.status == "waiting"
+            driver.set_controller_port(driver.world_id, 45678)
+            resp = driver.get_slot_info(other_host, 0, min_world_id=0)
+            assert resp.status == "ok"
+            assert resp.controller_port == 45678
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_failed_formation_report_builds_next_world(self):
+        """A current-world assignee asking for world+1 signals that
+        formation failed under it; the driver must build the next
+        incarnation instead of letting every worker wait out
+        ELASTIC_TIMEOUT (the round-2 'timeout-into-next-incarnation'
+        deadlock)."""
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 2})
+        driver = ElasticDriver(disc, min_np=2)
+        try:
+            driver.start(workers)
+            wid = driver.world_id
+            resp = driver.get_slot_info("a", 0, min_world_id=wid + 1)
+            assert driver.world_id == wid + 1
+            assert resp.status in ("ok", "waiting")
+            # non-assignees and released slots must NOT bump the world
+            driver.get_slot_info("zzz", 9, min_world_id=driver.world_id + 1)
+            assert driver.world_id == wid + 1
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_controller_port_allocated_on_worker_not_driver(self,
+                                                            monkeypatch):
+        """The round-2 flaw: the driver probed ITS OWN port space for a
+        socket that binds on the rank-0 worker host. Now the driver never
+        probes — even with find_free_port broken, worlds form, and a stale
+        incarnation's report cannot poison a newer world."""
+        from horovod_tpu.runner import network
+
+        def _boom():
+            raise AssertionError("driver must not probe local ports")
+
+        monkeypatch.setattr(network, "find_free_port", _boom)
+        workers = RecordingWorkers()
+        disc = MutableDiscovery({"a": 2})
+        driver = ElasticDriver(disc, min_np=2)
+        try:
+            driver.start(workers)  # would raise if the driver probed
+            wid = driver.world_id
+            driver.set_controller_port(wid - 1, 11111)  # stale: ignored
+            resp = driver.get_slot_info("a", 1, min_world_id=0)
+            if resp.slot is not None and resp.slot["rank"] != 0:
+                assert resp.status == "waiting"
+            driver.set_controller_port(wid, 22222)
+            resp = driver.get_slot_info("a", 1, min_world_id=0)
+            assert resp.status == "ok"
+            assert resp.controller_port == 22222
         finally:
             driver.stop()
             driver.shutdown_service()
