@@ -184,15 +184,6 @@ def broadcast_parameters(params, root_rank: int = 0, prefix=None) -> None:
                                               prefix + str(name))
             p._init_impl = types.MethodType(new_init, p)
 
-    # Start every broadcast before waiting on any (the torch binding's
-    # batched shape, torch/functions.py:30-40) — N serialized
-    # negotiate+transfer round trips collapse into one pipelined batch.
-    from ..ops import collective_ops as _C
-    from .mpi_ops import _to_numpy, _write_back
+    from .mpi_ops import batched_broadcast_
 
-    ctrl, world = _C._eager_ctx()
-    handles = [(tensor, ctrl.broadcast_async(_to_numpy(tensor), name,
-                                             root=root_rank))
-               for tensor, name in zip(tensors, names)]
-    for tensor, handle in handles:
-        _write_back(tensor, handle.wait())
+    batched_broadcast_(list(zip(tensors, names)), root_rank)

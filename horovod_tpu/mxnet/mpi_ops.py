@@ -178,6 +178,20 @@ def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
     return _write_back(tensor, out)
 
 
+def batched_broadcast_(tensors_and_names, root_rank: int) -> None:
+    """Start every broadcast before waiting on any (the torch binding's
+    batched shape, torch/functions.py:30-40): N serialized
+    negotiate+transfer round trips collapse into one pipelined batch."""
+    ctrl, world = _ctrl_ctx()
+    if world == 1:
+        return
+    handles = [(tensor, ctrl.broadcast_async(_to_numpy(tensor), name,
+                                             root=root_rank))
+               for tensor, name in tensors_and_names]
+    for tensor, handle in handles:
+        _write_back(tensor, handle.wait())
+
+
 # --------------------------------------------------------------------------
 # alltoall
 # --------------------------------------------------------------------------

@@ -27,7 +27,9 @@ distributed VHDD in-jit: per level, pairs exchange *half* their current
 segment via ``lax.ppermute``, the level's dot/norm partials are assembled
 with one tiny all_gather, and the final reassembly is a single psum of
 disjointly-placed shards (which also re-establishes replication for the
-sharding checker). Per-chip memory stays O(n), traffic ≈ 2n total.
+sharding checker). Per-chip memory stays O(n); traffic is ≈3n total
+(≈n halving + ≈2n psum reassembly — see :func:`_vhdd_allreduce` for why
+the ≈n all_gather reassembly loses under JAX's VMA model).
 """
 
 from __future__ import annotations
@@ -104,6 +106,14 @@ def _vhdd_allreduce(tensor: jax.Array, axes_t: Tuple[str, ...]) -> jax.Array:
     (the reference's SumAllreduceWithComm over reduction_comms_). After
     log2(P) levels rank r owns the combined block ``bitrev(r)``; one psum
     of disjointly-placed shards reassembles the replicated result.
+
+    Traffic: ≈3n per rank — ≈n for the halving phase plus ≈2n for the psum
+    reassembly. The textbook VHDD doubling phase (or an all_gather of the
+    n/P shards) would cost only ≈n, but under JAX's VMA model (jax 0.9)
+    every all_gather/ppermute result is statically device-varying with no
+    zero-cost way to assert replication, so clearing it costs ≥n more;
+    psum is replicated by construction. Memory stays O(n) per chip either
+    way, which is what this path exists for.
     """
     P = C._world_size(axes_t)
     levels = P.bit_length() - 1
@@ -142,7 +152,12 @@ def _vhdd_allreduce(tensor: jax.Array, axes_t: Tuple[str, ...]) -> jax.Array:
         seg = acoef * a + bcoef * b
 
     # Rank r's shard is logical block bitrev(r): place it there and psum the
-    # disjoint shards — one collective that also yields a replicated output.
+    # disjoint shards. An ``all_gather`` of the n/P shards would move only
+    # ~n (vs the psum's ~2n), but in JAX's VMA model (jax 0.9) every
+    # all_gather/ppermute result is statically device-varying and there is
+    # no zero-cost "assert replicated": clearing it needs a pbroadcast
+    # (+n on TPU) or masked psum (+2n), netting nothing. psum is the one
+    # reassembly that is replicated *by construction*.
     shard_len = n // P
     brev = rank * 0
     for j in range(levels):

@@ -143,6 +143,24 @@ def main():
         assert np.allclose(out, expected, rtol=1e-4, atol=1e-5), \
             (out, expected)
 
+        # bf16/fp16: TPU-native gradient dtypes ride the widen-to-fp32
+        # software path (adasum.cc Vhdd16); coefficients stay fp32-accurate
+        # so only the final rounding differs from the fp32 result.
+        import ml_dtypes
+
+        out16 = ctx.allreduce_async(
+            contrib(rank).astype(ml_dtypes.bfloat16), "ads_bf16",
+            op=ctx.ADASUM).wait()
+        assert out16.dtype == ml_dtypes.bfloat16, out16.dtype
+        assert np.allclose(out16.astype(np.float32), expected,
+                           rtol=2e-2, atol=2e-2), (out16, expected)
+        out16 = ctx.allreduce_async(
+            contrib(rank).astype(np.float16), "ads_fp16",
+            op=ctx.ADASUM).wait()
+        assert out16.dtype == np.float16, out16.dtype
+        assert np.allclose(out16.astype(np.float32), expected,
+                           rtol=5e-3, atol=5e-3), (out16, expected)
+
     # large buffer: ring chunks far beyond kernel socket buffers must not
     # deadlock (regression: blocking send() in the bidirectional exchange)
     big = np.ones(8 << 20, np.float32)  # 32 MB
