@@ -323,11 +323,10 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
               axes=None):
     """Broadcast ``tensor`` from ``root_rank`` to all ranks.
 
-    Reference: hvd.broadcast (torch/mpi_ops.py:293-344). On TPU this lowers
-    to the native CollectiveBroadcast HLO (``lax.pbroadcast``); on backends
-    without that lowering it falls back to a masked ``psum`` (one
-    collective, no size× gather blow-up): every rank contributes zeros
-    except the root.
+    Reference: hvd.broadcast (torch/mpi_ops.py:293-344). Lowers to a masked
+    ``psum`` on every platform (one collective, no size× gather blow-up):
+    every rank contributes zeros except the root. See the in-body comment
+    for why the per-platform CollectiveBroadcast lowering was dropped.
     """
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
@@ -340,14 +339,20 @@ def broadcast(tensor, root_rank: int = 0, *, name: Optional[str] = None,
     if bool_in:
         wire = wire.astype(jnp.uint8)
 
-    def _native(w):
-        return lax.pbroadcast(w, axes_t, root_rank)
-
-    def _masked(w):
-        mask = (lax.axis_index(axes_t) == root_rank).astype(w.dtype)
-        return lax.psum(w * mask, axes_t)
-
-    out = lax.platform_dependent(wire, tpu=_native, default=_masked)
+    # Masked psum on every platform: each rank contributes zeros except the
+    # root, one collective, no size-x gather blow-up — and the result is
+    # replicated BY CONSTRUCTION in JAX's VMA model. The per-platform
+    # CollectiveBroadcast lowering (lax.pbroadcast) was dropped: its result
+    # stays statically device-varying under jax 0.9, so selecting between
+    # the two via lax.platform_dependent builds a switch with VMA-divergent
+    # branches, which fails abstract evaluation under jit for any
+    # device-varying operand (XLA on TPU still lowers the masked AllReduce
+    # onto ICI).
+    # Select, not multiply: NaN/Inf in a non-root payload (e.g. an elastic
+    # rejoin whose own params diverged) would survive `wire * 0` and poison
+    # the sum on every rank.
+    is_root = lax.axis_index(axes_t) == root_rank
+    out = lax.psum(jnp.where(is_root, wire, jnp.zeros_like(wire)), axes_t)
     if bool_in:
         out = out.astype(jnp.bool_)
     return out
