@@ -68,12 +68,19 @@ _last_world_id = [-1]
 def _rendezvous(client) -> None:
     """Ask the driver for the next world's slot; export the env contract;
     init (reference rendezvous.py:37-42 + gloo_run.py:65-76)."""
+    from ..runner import nic
+
     host = os.environ["HOROVOD_HOSTNAME"]
     local_rank = int(os.environ["HOROVOD_LOCAL_RANK"])
+    try:
+        ifaces = nic.list_interfaces()
+    except Exception:  # NIC introspection must never block rendezvous
+        ifaces = None
     deadline = time.monotonic() + constants.ELASTIC_TIMEOUT_SECS
     while True:
         resp = client._send(GetSlotRequest(host, local_rank,
-                                           _last_world_id[0] + 1))
+                                           _last_world_id[0] + 1,
+                                           ifaces=ifaces))
         if resp.status == "ok":
             break
         if resp.status == "shutdown":

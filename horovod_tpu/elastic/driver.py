@@ -32,10 +32,14 @@ from .worker import WorkerNotificationClient
 
 
 class GetSlotRequest:
-    def __init__(self, host: str, local_rank: int, min_world_id: int = 0):
+    def __init__(self, host: str, local_rank: int, min_world_id: int = 0,
+                 ifaces=None):
         self.host = host
         self.local_rank = local_rank
         self.min_world_id = min_world_id
+        # [(ifname, ipv4)] of the requesting host (NIC registration,
+        # reference driver_service.py:260); optional for compatibility.
+        self.ifaces = ifaces
 
 
 class GetSlotResponse:
@@ -74,8 +78,9 @@ class ElasticDriverService(network.BasicService):
 
     def _handle(self, req, client_address):
         if isinstance(req, GetSlotRequest):
-            return self._driver.get_slot_info(req.host, req.local_rank,
-                                              req.min_world_id)
+            return self._driver.get_slot_info(
+                req.host, req.local_rank, req.min_world_id,
+                ifaces=getattr(req, "ifaces", None))
         if isinstance(req, RegisterWorkerAddressRequest):
             self._driver.register_worker_address(
                 req.host, req.local_rank, req.addr, req.port)
@@ -112,6 +117,9 @@ class ElasticDriver:
         self._lock = threading.RLock()
         self._world_id = -1
         self._host_order: List[str] = []
+        # host -> [(ifname, ipv4)] as registered at rendezvous (NIC
+        # discovery, reference driver_service.py:260).
+        self._host_ifaces: Dict[str, list] = {}
         self._assignments: Dict[Tuple[str, int], SlotInfo] = {}
         self._controller_port = 0
         self._create_worker_fn: Optional[Callable] = None
@@ -204,8 +212,11 @@ class ElasticDriver:
     # ------------------------------------------------- rendezvous (workers)
 
     def get_slot_info(self, host: str, local_rank: int,
-                      min_world_id: int = 0) -> GetSlotResponse:
+                      min_world_id: int = 0,
+                      ifaces=None) -> GetSlotResponse:
         with self._lock:
+            if ifaces:
+                self._host_ifaces[host] = [tuple(i) for i in ifaces]
             if self._shutdown.is_set():
                 return GetSlotResponse("shutdown")
             if self._registry.total_count(SUCCESS) > 0:
@@ -254,11 +265,33 @@ class ElasticDriver:
             if self._controller_addr_override is not None:
                 addr = self._controller_addr_override
             else:
-                addr = "127.0.0.1" if _is_local(rank0_host) else rank0_host
+                addr = self._nic_controller_addr(rank0_host, host) or (
+                    "127.0.0.1" if _is_local(rank0_host) else rank0_host)
             return GetSlotResponse("ok", slot=slot.__dict__.copy(),
                                    world_id=self._world_id,
                                    controller_addr=addr,
                                    controller_port=self._controller_port)
+
+    def _nic_controller_addr(self, rank0_host: str,
+                             requester_host: str) -> Optional[str]:
+        """Rank-0's address on an interface common to rank-0's host and
+        the REQUESTER's host (reference driver_service.py interface
+        intersection), or None when either side hasn't registered NICs or
+        there is no usable intersection. Pairwise, not world-wide: the
+        controller listens on INADDR_ANY, so each worker only needs an
+        address it can route itself — and a world-wide gate would hand
+        early requesters the hostname heuristic whenever a slow host had
+        not yet registered (exactly the unresolvable-hostname case this
+        feature fixes)."""
+        from ..runner import nic
+
+        rank0_ifaces = self._host_ifaces.get(rank0_host)
+        req_ifaces = self._host_ifaces.get(requester_host)
+        if not rank0_ifaces or not req_ifaces:
+            return None
+        per_host = {rank0_host: rank0_ifaces, requester_host: req_ifaces}
+        return nic.select_controller_addr(rank0_ifaces, per_host,
+                                          allow=nic.iface_filter_from_env())
 
     def set_controller_port(self, world_id: int, port: int) -> None:
         """Record the controller port rank 0 bound for ``world_id``;

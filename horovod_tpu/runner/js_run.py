@@ -1,0 +1,158 @@
+"""jsrun launch path for LSF clusters.
+
+Reference surface: ``horovod/runner/js_run.py`` — ``js_run`` builds a
+``jsrun`` command (ERF rankfile binding, per-rank stdio capture) and execs
+it; ``generate_jsrun_rankfile`` writes the explicit-resource-file mapping
+ranks to hosts/cpus (js_run.py:100-146).
+
+TPU-native redesign: the reference routes jsrun through the MPI controller
+(``--smpiargs``); this framework has no MPI — jsrun is purely the process
+*placer*. Each spawned worker derives the HOROVOD_* env contract from
+jsrun's own ``JSM_NAMESPACE_{RANK,SIZE,LOCAL_RANK}`` variables (bridged in
+``common/basics.init``), and the native controller rendezvouses on the
+first compute host of the allocation, so no rankfile-side env plumbing is
+needed.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import lsf
+
+# Fixed rendezvous port for jsrun-placed workers: every process of a fresh
+# LSF allocation computes the same (host, port) with no launcher RPC. The
+# reference's MPI controller needs no such port; ours does (native TCP
+# star). Overridable via HOROVOD_CONTROLLER_PORT.
+DEFAULT_CONTROLLER_PORT = 42223
+
+
+def is_jsrun_installed() -> bool:
+    """True if the jsrun binary is on PATH (reference js_run.py:44-46)."""
+    return shutil.which("jsrun") is not None
+
+
+def validate_host_slots(hosts: Dict[str, int], num_proc: int,
+                        max_slots_per_host: Optional[int] = None
+                        ) -> List[Tuple[str, int]]:
+    """Truncate an ordered {host: slots} map to exactly ``num_proc`` slots
+    (reference js_run.py:109-126: verify-and-truncate against the
+    allocation)."""
+    validated: List[Tuple[str, int]] = []
+    remaining = num_proc
+    for host, slots in hosts.items():
+        if max_slots_per_host is not None and slots > max_slots_per_host:
+            raise ValueError(
+                f"host {host!r} requests {slots} slots, above the "
+                f"per-host limit {max_slots_per_host}")
+        take = min(slots, remaining)
+        if take > 0:
+            validated.append((host, take))
+            remaining -= take
+        if remaining == 0:
+            break
+    if remaining != 0:
+        raise ValueError(
+            f"not enough slots on the hosts to fulfill the {num_proc} "
+            f"requested")
+    return validated
+
+
+def generate_jsrun_rankfile(hosts: Dict[str, int], num_proc: int,
+                            cpus_per_slot: int = 4,
+                            path: Optional[str] = None) -> str:
+    """Write an ERF rankfile mapping rank r to its host and a disjoint cpu
+    range (reference js_run.py:100-146; cpu width comes from
+    ``cpus_per_slot`` instead of the CSM core/gpu query — no CSM on TPU
+    clusters)."""
+    validated = validate_host_slots(hosts, num_proc)
+    if path is None:
+        fd, path = tempfile.mkstemp(prefix="hvdtpu_erf_", text=True)
+        os.close(fd)
+    with open(path, "w") as f:
+        f.write("overlapping_rs: allow\n")
+        f.write("cpu_index_using: logical\n")
+        rank = 0
+        for host, slots in validated:
+            cpu = 0
+            f.write("\n")
+            for _ in range(slots):
+                f.write(f"rank: {rank}: {{ hostname: {host}; "
+                        f"cpu: {{{cpu}-{cpu + cpus_per_slot - 1}}} ; "
+                        f"mem: * }}\n")
+                rank += 1
+                cpu += cpus_per_slot
+    return path
+
+
+def build_jsrun_command(command: Sequence[str],
+                        env: Optional[Dict[str, str]] = None,
+                        num_proc: Optional[int] = None,
+                        hosts: Optional[Dict[str, int]] = None,
+                        cpus_per_slot: int = 4,
+                        output_filename: Optional[str] = None,
+                        binding_args: Optional[str] = None,
+                        rankfile_path: Optional[str] = None) -> str:
+    """Synthesize the full jsrun command line (reference js_run.py:49-98,
+    minus the MPI ``--smpiargs`` leg).
+
+    The worker env contract (controller host/port + any HOROVOD_* knobs)
+    rides an ``env`` prefix inside the per-rank command; rank identity
+    comes from jsrun's JSM_NAMESPACE_* variables at worker start.
+    """
+    hosts = hosts if hosts is not None else lsf.get_compute_hosts_and_slots()
+    num_proc = num_proc if num_proc is not None else sum(hosts.values())
+
+    if binding_args is None:
+        rf = generate_jsrun_rankfile(hosts, num_proc,
+                                     cpus_per_slot=cpus_per_slot,
+                                     path=rankfile_path)
+        binding_args = f"--erf_input {rf}"
+
+    worker_env = dict(env or {})
+    first_host = next(iter(validate_host_slots(hosts, num_proc)))[0]
+    # Launcher-exported HOROVOD_CONTROLLER_* beat the defaults (the env
+    # prefix below overrides jsrun's inherited environment, so the
+    # operator's escape hatch must be honored here).
+    worker_env.setdefault(
+        "HOROVOD_CONTROLLER_ADDR",
+        os.environ.get("HOROVOD_CONTROLLER_ADDR", first_host))
+    worker_env.setdefault(
+        "HOROVOD_CONTROLLER_PORT",
+        os.environ.get("HOROVOD_CONTROLLER_PORT",
+                       str(DEFAULT_CONTROLLER_PORT)))
+    worker_env.setdefault("HOROVOD_SIZE", str(num_proc))
+    env_prefix = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(worker_env.items()))
+
+    stdio = ""
+    if output_filename:
+        stdio = (f"--stdio_stdout {shlex.quote(output_filename)} "
+                 f"--stdio_stderr {shlex.quote(output_filename)} ")
+    cmd = " ".join(shlex.quote(c) for c in command)
+    return (f"jsrun {binding_args} {stdio}"
+            f"env {env_prefix} {cmd}").strip()
+
+
+def js_run(command: Sequence[str], env: Optional[Dict[str, str]] = None,
+           num_proc: Optional[int] = None,
+           hosts: Optional[Dict[str, int]] = None,
+           verbose: int = 0,
+           output_filename: Optional[str] = None) -> int:
+    """Build and exec the jsrun command (reference js_run.py:49-98)."""
+    from . import safe_shell_exec
+
+    if not is_jsrun_installed():
+        raise RuntimeError(
+            "jsrun not found on PATH. Run on an LSF cluster with jsrun "
+            "installed, or use the default ssh/local launcher.")
+    jsrun_cmd = build_jsrun_command(command, env=env, num_proc=num_proc,
+                                    hosts=hosts,
+                                    output_filename=output_filename)
+    if verbose >= 2:
+        print(jsrun_cmd)
+    return safe_shell_exec.execute(jsrun_cmd, env=dict(os.environ))

@@ -109,6 +109,15 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          help="slots per discovered host (elastic)")
     elastic.add_argument("--reset-limit", type=int, dest="reset_limit")
 
+    lsf_grp = parser.add_argument_group("lsf")
+    lsf_grp.add_argument("--jsrun", action="store_true", dest="use_jsrun",
+                         help="place workers with jsrun (LSF clusters; "
+                              "np/hosts auto-derived from the allocation)")
+    parser.add_argument("--network-interface", dest="network_interface",
+                        help="comma-separated NIC names the controller "
+                             "address may use (reference: horovodrun "
+                             "--network-interface / HOROVOD_GLOO_IFACE)")
+
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="the training command to launch")
     args = parser.parse_args(argv)
@@ -118,11 +127,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 
 def _validate(args) -> None:
+    from . import lsf
+
     if args.version:
         return
     if not args.command:
         raise ValueError("no command to run — usage: hvdrun -np N <command>")
+    if getattr(args, "use_jsrun", False) and args.elastic:
+        raise ValueError(
+            "--jsrun places a fixed-size job; elastic flags "
+            "(--min-np/--max-np/--host-discovery-script) are not "
+            "supported with it")
     if not args.elastic:
+        if args.np is None and lsf.using_lsf():
+            # Under LSF the allocation defines np/hosts (reference
+            # launch.py:221: -np not required when using_lsf()).
+            args.np = lsf.get_num_processes()
+            if not args.hosts and not args.hostfile:
+                args.hosts = lsf.get_hosts_arg()
         if args.np is None:
             raise ValueError("-np is required for static jobs")
         if args.hosts and args.hostfile:
@@ -138,9 +160,7 @@ def _validate(args) -> None:
 
 def _build_env(args) -> dict:
     env = dict(os.environ)
-    config_parser.set_env_from_args(env, args)
-    if args.disable_cache:
-        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    env.update(_build_env_overrides(args))
     return env
 
 
@@ -178,6 +198,38 @@ def _run_elastic(args) -> None:
     launch_elastic(args, env=_build_env(args))
 
 
+def _run_jsrun(args) -> None:
+    from . import js_run
+
+    hosts = None
+    if args.hosts or args.hostfile:
+        hosts = {}
+        for h in _get_hosts(args, args.np):
+            hosts[h.hostname] = hosts.get(h.hostname, 0) + h.slots
+    rc = js_run.js_run(args.command, env=_build_env_overrides(args),
+                       num_proc=args.np, hosts=hosts, verbose=args.verbose)
+    if rc != 0:
+        raise RuntimeError(f"jsrun exited with code {rc}")
+
+
+def _build_env_overrides(args) -> dict:
+    """HOROVOD_* knobs derived from CLI flags only (for launch paths that
+    must not ship the launcher's whole environment, e.g. jsrun's per-rank
+    env prefix)."""
+    env: dict = {}
+    config_parser.set_env_from_args(env, args)
+    if args.disable_cache:
+        env["HOROVOD_CACHE_CAPACITY"] = "0"
+    if args.network_interface:
+        env["HOROVOD_IFACE"] = args.network_interface
+        # The allowlist's consumer is the elastic DRIVER's interface
+        # intersection (ElasticDriver._nic_controller_addr →
+        # nic.iface_filter_from_env), which runs in THIS process — worker
+        # env alone would leave the flag a no-op.
+        os.environ["HOROVOD_IFACE"] = args.network_interface
+    return env
+
+
 def _run(args) -> None:
     if args.version:
         from .. import __version__
@@ -187,7 +239,9 @@ def _run(args) -> None:
     if args.config_file:
         config_parser.parse_config_file(args.config_file, args)
     _validate(args)
-    if args.elastic:
+    if getattr(args, "use_jsrun", False):
+        _run_jsrun(args)
+    elif args.elastic:
         _run_elastic(args)
     else:
         _run_static(args)

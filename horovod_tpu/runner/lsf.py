@@ -1,0 +1,63 @@
+"""LSF allocation introspection.
+
+Reference surface: ``horovod/runner/util/lsf.py`` (``LSFUtils``: using_lsf,
+get_compute_hosts, get_num_processes — np/hosts auto-derived so ``-np`` is
+optional under LSF, launch.py:221) and ``runner/js_run.py`` (jsrun launch).
+
+TPU-native redesign: the reference queries IBM CSM
+(``csm_allocation_query``) for Summit-style GPU counts; a TPU cluster has
+no CSM and no GPUs, so the allocation is read from LSF's own batch env —
+``LSB_MCPU_HOSTS`` ("host1 n1 host2 n2 ..." as exported by LSF on every
+batch host) with ``LSB_HOSTS`` ("host1 host1 host2 ..." one entry per
+slot) as the fallback. Slot counts mean worker processes (one per TPU
+host), exactly how the rest of the launcher treats hosts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+
+def using_lsf() -> bool:
+    """True when running inside an LSF job (reference lsf.py:35-37)."""
+    return "LSB_JOBID" in os.environ
+
+
+def get_compute_hosts_and_slots() -> Dict[str, int]:
+    """Ordered {host: slots} from the LSF batch env. The submission host
+    entry (``LSB_SUB_HOST``) is excluded when LSF lists it with 0 slots."""
+    mcpu = os.environ.get("LSB_MCPU_HOSTS", "").split()
+    hosts: Dict[str, int] = {}
+    if mcpu:
+        if len(mcpu) % 2 != 0:
+            raise ValueError(
+                f"malformed LSB_MCPU_HOSTS: {os.environ['LSB_MCPU_HOSTS']!r}")
+        for i in range(0, len(mcpu), 2):
+            slots = int(mcpu[i + 1])
+            if slots > 0:
+                hosts[mcpu[i]] = hosts.get(mcpu[i], 0) + slots
+        return hosts
+    for h in os.environ.get("LSB_HOSTS", "").split():
+        hosts[h] = hosts.get(h, 0) + 1
+    if not hosts:
+        raise RuntimeError(
+            "LSF allocation env not found (neither LSB_MCPU_HOSTS nor "
+            "LSB_HOSTS is set) — is this an LSF batch job?")
+    return hosts
+
+
+def get_compute_hosts() -> List[str]:
+    """Sorted LSF compute hosts (reference lsf.py:73-76)."""
+    return sorted(get_compute_hosts_and_slots())
+
+
+def get_num_processes() -> int:
+    """Total worker slots in the allocation (reference lsf.py:87-91)."""
+    return sum(get_compute_hosts_and_slots().values())
+
+
+def get_hosts_arg() -> str:
+    """The allocation as a ``-H host:slots,...`` launcher argument."""
+    hs = get_compute_hosts_and_slots()
+    return ",".join(f"{h}:{n}" for h, n in sorted(hs.items()))

@@ -1,0 +1,104 @@
+"""NIC discovery and interface intersection.
+
+Reference surface: ``horovod/runner/driver/driver_service.py:260``
+(driver/task services register each host's addresses; the driver computes
+the interfaces common to all hosts) + the ``HOROVOD_GLOO_IFACE`` /
+``--network-interface`` selection knob (``gloo_context.cc:49-84``,
+``launch.py:546``).
+
+TPU-native redesign: the native controller already listens on all
+interfaces (``TcpServer::Listen`` binds INADDR_ANY), so NIC selection is
+purely about which *address* peers dial. Workers report their
+``(interface, address)`` list at rendezvous; the driver intersects
+interface names across the hosts of the current world (optionally
+restricted by the knob) and hands peers the rank-0 host's address on the
+first common interface — no probing, no "rank-0 hostname resolves
+everywhere" assumption.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def iface_filter_from_env() -> Optional[List[str]]:
+    """Comma-separated interface allowlist from ``HOROVOD_IFACE`` (alias:
+    the reference's ``HOROVOD_GLOO_IFACE``), or None for no restriction."""
+    raw = os.environ.get("HOROVOD_IFACE") or \
+        os.environ.get("HOROVOD_GLOO_IFACE")
+    if not raw:
+        return None
+    return [s.strip() for s in raw.split(",") if s.strip()]
+
+
+def list_interfaces() -> List[Tuple[str, str]]:
+    """``[(ifname, ipv4_addr)]`` for every up interface with an IPv4
+    address (Linux SIOCGIFADDR ioctl; no third-party deps). The loopback
+    stays in the list — single-host worlds legitimately rendezvous on it —
+    but sorts last so real NICs win the intersection."""
+    try:
+        import fcntl
+    except ImportError:  # pragma: no cover - non-POSIX
+        return [("host", socket.gethostbyname(socket.gethostname()))]
+
+    out: List[Tuple[str, str]] = []
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for _, name in socket.if_nameindex():
+            try:
+                packed = fcntl.ioctl(
+                    s.fileno(), 0x8915,  # SIOCGIFADDR
+                    struct.pack("256s", name.encode()[:15]))
+                addr = socket.inet_ntoa(packed[20:24])
+            except OSError:
+                continue  # interface has no IPv4 address
+            out.append((name, addr))
+    finally:
+        s.close()
+    out.sort(key=lambda t: (t[1].startswith("127."), t[0]))
+    return out
+
+
+def common_interfaces(per_host: Dict[str, Sequence[Tuple[str, str]]],
+                      allow: Optional[Iterable[str]] = None) -> List[str]:
+    """Interface names present on EVERY host (reference
+    driver_service.py get_common_interfaces), optionally restricted to the
+    ``allow`` list; ordered by the first host's preference order."""
+    if not per_host:
+        return []
+    hosts = list(per_host)
+    common = None
+    for h in hosts:
+        names = {name for name, _ in per_host[h]}
+        common = names if common is None else (common & names)
+    first_order = [name for name, _ in per_host[hosts[0]]]
+    out = [n for n in first_order if n in (common or set())]
+    if allow is not None:
+        allowed = set(allow)
+        out = [n for n in out if n in allowed]
+    return out
+
+
+def select_controller_addr(rank0_ifaces: Sequence[Tuple[str, str]],
+                           per_host: Dict[str,
+                                          Sequence[Tuple[str, str]]],
+                           allow: Optional[Iterable[str]] = None
+                           ) -> Optional[str]:
+    """The rank-0 host's address on the first interface common to every
+    host of the world (None when there is no usable intersection — callers
+    fall back to the hostname heuristic)."""
+    commons = common_interfaces(per_host, allow=allow)
+    by_name = dict(rank0_ifaces)
+    for name in commons:
+        addr = by_name.get(name)
+        if addr and not addr.startswith("127."):
+            return addr
+    # All-loopback intersection is still valid for single-host worlds.
+    for name in commons:
+        addr = by_name.get(name)
+        if addr:
+            return addr
+    return None

@@ -1,0 +1,211 @@
+"""LSF/jsrun launcher synthesis + NIC discovery tests (reference:
+test/single/test_jsrun.py rankfile/command checks and the driver-service
+interface-intersection behavior, driver_service.py:260)."""
+
+import os
+
+import pytest
+
+from horovod_tpu.runner import js_run, lsf, nic
+
+
+class TestLSF:
+    def test_using_lsf(self, monkeypatch):
+        monkeypatch.delenv("LSB_JOBID", raising=False)
+        assert not lsf.using_lsf()
+        monkeypatch.setenv("LSB_JOBID", "1234")
+        assert lsf.using_lsf()
+
+    def test_hosts_from_mcpu(self, monkeypatch):
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "launchA 0 nodeB 4 nodeC 2")
+        assert lsf.get_compute_hosts_and_slots() == {"nodeB": 4, "nodeC": 2}
+        assert lsf.get_num_processes() == 6
+        assert lsf.get_compute_hosts() == ["nodeB", "nodeC"]
+        assert lsf.get_hosts_arg() == "nodeB:4,nodeC:2"
+
+    def test_hosts_from_lsb_hosts_fallback(self, monkeypatch):
+        monkeypatch.delenv("LSB_MCPU_HOSTS", raising=False)
+        monkeypatch.setenv("LSB_HOSTS", "n1 n1 n2")
+        assert lsf.get_compute_hosts_and_slots() == {"n1": 2, "n2": 1}
+
+    def test_malformed_mcpu(self, monkeypatch):
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeB 4 nodeC")
+        with pytest.raises(ValueError, match="malformed"):
+            lsf.get_compute_hosts_and_slots()
+
+    def test_no_allocation(self, monkeypatch):
+        monkeypatch.delenv("LSB_MCPU_HOSTS", raising=False)
+        monkeypatch.delenv("LSB_HOSTS", raising=False)
+        with pytest.raises(RuntimeError, match="LSF allocation"):
+            lsf.get_compute_hosts_and_slots()
+
+
+class TestJsrun:
+    HOSTS = {"nodeB": 2, "nodeC": 2}
+
+    def test_validate_truncates(self):
+        v = js_run.validate_host_slots(self.HOSTS, 3)
+        assert v == [("nodeB", 2), ("nodeC", 1)]
+
+    def test_validate_rejects_overflow(self):
+        with pytest.raises(ValueError, match="not enough slots"):
+            js_run.validate_host_slots(self.HOSTS, 5)
+
+    def test_validate_rejects_oversubscription(self):
+        with pytest.raises(ValueError, match="per-host limit"):
+            js_run.validate_host_slots({"n": 8}, 8, max_slots_per_host=4)
+
+    def test_rankfile_content(self, tmp_path):
+        path = str(tmp_path / "erf")
+        js_run.generate_jsrun_rankfile(self.HOSTS, 4, cpus_per_slot=2,
+                                       path=path)
+        text = open(path).read()
+        assert "overlapping_rs: allow" in text
+        assert "cpu_index_using: logical" in text
+        # 4 ranks, disjoint cpu ranges restarting per host
+        assert "rank: 0: { hostname: nodeB; cpu: {0-1} ; mem: * }" in text
+        assert "rank: 1: { hostname: nodeB; cpu: {2-3} ; mem: * }" in text
+        assert "rank: 2: { hostname: nodeC; cpu: {0-1} ; mem: * }" in text
+        assert "rank: 3: { hostname: nodeC; cpu: {2-3} ; mem: * }" in text
+
+    def test_command_synthesis(self, tmp_path):
+        rf = str(tmp_path / "erf")
+        cmd = js_run.build_jsrun_command(
+            ["python", "train.py", "--lr", "0.1"],
+            env={"HOROVOD_AUTOTUNE": "1"}, num_proc=4, hosts=self.HOSTS,
+            output_filename="/tmp/out.log", rankfile_path=rf)
+        assert cmd.startswith(f"jsrun --erf_input {rf} ")
+        assert "--stdio_stdout /tmp/out.log" in cmd
+        assert "--stdio_stderr /tmp/out.log" in cmd
+        # env contract: knobs + rendezvous on the first compute host
+        assert "HOROVOD_AUTOTUNE=1" in cmd
+        assert "HOROVOD_CONTROLLER_ADDR=nodeB" in cmd
+        assert f"HOROVOD_CONTROLLER_PORT="\
+               f"{js_run.DEFAULT_CONTROLLER_PORT}" in cmd
+        assert "HOROVOD_SIZE=4" in cmd
+        assert cmd.endswith("python train.py --lr 0.1")
+
+    def test_port_override_honored(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("HOROVOD_CONTROLLER_PORT", "50000")
+        cmd = js_run.build_jsrun_command(
+            ["python", "t.py"], num_proc=2, hosts={"n1": 2},
+            rankfile_path=str(tmp_path / "erf"))
+        assert "HOROVOD_CONTROLLER_PORT=50000" in cmd
+
+    def test_jsrun_rejects_elastic_flags(self):
+        from horovod_tpu.runner.launch import parse_args, _validate
+
+        args = parse_args(["--jsrun", "--min-np", "2", "-H", "a:2",
+                           "python", "t.py"])
+        with pytest.raises(ValueError, match="elastic flags"):
+            _validate(args)
+
+    def test_cli_np_hosts_from_lsf(self, monkeypatch):
+        """-np becomes optional under LSF (reference launch.py:221)."""
+        from horovod_tpu.runner.launch import parse_args, _validate
+
+        monkeypatch.setenv("LSB_JOBID", "7")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "nodeB 2 nodeC 2")
+        args = parse_args(["python", "train.py"])
+        _validate(args)
+        assert args.np == 4
+        assert args.hosts == "nodeB:2,nodeC:2"
+
+
+IFACES_A = [("eth0", "10.0.0.1"), ("ib0", "192.168.1.1"),
+            ("lo", "127.0.0.1")]
+IFACES_B = [("eth0", "10.0.0.2"), ("ib0", "192.168.1.2"),
+            ("lo", "127.0.0.1")]
+IFACES_C = [("ens3", "10.1.0.3"), ("ib0", "192.168.1.3"),
+            ("lo", "127.0.0.1")]
+
+
+class TestNic:
+    def test_common_interfaces(self):
+        common = nic.common_interfaces(
+            {"a": IFACES_A, "b": IFACES_B, "c": IFACES_C})
+        assert common == ["ib0", "lo"]
+
+    def test_common_interfaces_with_allowlist(self):
+        common = nic.common_interfaces({"a": IFACES_A, "b": IFACES_B},
+                                       allow=["ib0"])
+        assert common == ["ib0"]
+
+    def test_select_controller_addr(self):
+        addr = nic.select_controller_addr(
+            IFACES_A, {"a": IFACES_A, "b": IFACES_B, "c": IFACES_C})
+        assert addr == "192.168.1.1"  # rank0's address on the common NIC
+
+    def test_select_prefers_non_loopback(self):
+        addr = nic.select_controller_addr(
+            IFACES_A, {"a": IFACES_A, "b": IFACES_B})
+        assert addr == "10.0.0.1"  # eth0 ranks before ib0 in a's order
+
+    def test_select_loopback_when_only_common(self):
+        only_lo = [("lo", "127.0.0.1")]
+        addr = nic.select_controller_addr(
+            only_lo, {"a": only_lo, "b": [("lo", "127.0.0.1"),
+                                          ("eth9", "10.9.9.9")]})
+        assert addr == "127.0.0.1"
+
+    def test_select_none_without_intersection(self):
+        assert nic.select_controller_addr(
+            [("eth0", "10.0.0.1")],
+            {"a": [("eth0", "10.0.0.1")], "b": [("ens3", "10.1.0.3")]}) \
+            is None
+
+    def test_iface_filter_env(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_IFACE", raising=False)
+        monkeypatch.delenv("HOROVOD_GLOO_IFACE", raising=False)
+        assert nic.iface_filter_from_env() is None
+        monkeypatch.setenv("HOROVOD_GLOO_IFACE", "ib0, ib1")
+        assert nic.iface_filter_from_env() == ["ib0", "ib1"]
+        monkeypatch.setenv("HOROVOD_IFACE", "eth0")
+        assert nic.iface_filter_from_env() == ["eth0"]
+
+    def test_list_interfaces_real(self):
+        ifaces = nic.list_interfaces()
+        assert ifaces, "expected at least one interface"
+        assert all(len(t) == 2 for t in ifaces)
+        # loopback sorts last so real NICs win intersections
+        if len(ifaces) > 1:
+            assert not ifaces[0][1].startswith("127.")
+
+
+class TestDriverNicSelection:
+    def test_driver_uses_common_iface_addr(self):
+        """Workers register NICs at rendezvous; peers are handed rank-0's
+        address on the intersected interface instead of the 'rank-0
+        hostname resolves everywhere' guess."""
+        import threading
+
+        from horovod_tpu.elastic.discovery import FixedHosts
+        from horovod_tpu.elastic.driver import ElasticDriver
+
+        driver = ElasticDriver(FixedHosts({"hostA": 1, "hostB": 1}),
+                               min_np=2)
+        hold = threading.Event()  # workers stay 'running' for the test
+        try:
+            driver.start(lambda slot, world_id: (hold.wait(30), 0)[1])
+            rank0_host = next(s.hostname
+                              for s in driver.current_assignments()
+                              if s.rank == 0)
+            other = "hostB" if rank0_host == "hostA" else "hostA"
+            r0_ifaces = IFACES_A if rank0_host == "hostA" else IFACES_B
+            o_ifaces = IFACES_B if rank0_host == "hostA" else IFACES_A
+            # rank-0 rendezvouses (registers NICs), reports its port
+            resp0 = driver.get_slot_info(rank0_host, 0, ifaces=r0_ifaces)
+            assert resp0.status == "ok"
+            driver.set_controller_port(driver.world_id, 33333)
+            # peer rendezvouses with its own NICs: gets the common-NIC addr
+            resp = driver.get_slot_info(other, 0, ifaces=o_ifaces)
+            assert resp.status == "ok"
+            assert resp.controller_addr == r0_ifaces[0][1]
+            # a host that never registered NICs falls back to hostname
+            driver._host_ifaces.clear()
+            resp = driver.get_slot_info(other, 0)
+            assert resp.controller_addr == rank0_host
+        finally:
+            hold.set()
+            driver.stop()
+            driver.shutdown_service()
