@@ -1,0 +1,95 @@
+"""Per-collective overhead: TF eager vs tf.function(py_function) vs JAX.
+
+Measures the cost of the `tf.py_function` boundary the TF binding uses
+inside `tf.function` graphs (reference comparison point: the reference's
+TF collectives are native AsyncOpKernels, tensorflow/mpi_ops.cc:371-419,
+with no Python hop). Run directly: spawns a 2-process world over the
+native TCP data plane on localhost and prints median per-allreduce
+latency for each path and payload size; rank 0 prints a JSON summary.
+
+    python examples/bench_tf_graph_overhead.py
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def worker():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import tensorflow as tf
+
+    import horovod_tpu as hvd_jax
+    import horovod_tpu.tensorflow as hvd_tf
+
+    hvd_tf.init()
+    rank = hvd_tf.rank()
+    results = {}
+    for label, n in [("4KB", 1024), ("4MB", 1024 * 1024)]:
+        x_tf = tf.constant(np.random.randn(n).astype(np.float32))
+        x_jax = jnp.asarray(np.random.randn(n).astype(np.float32))
+
+        @tf.function
+        def graph_allreduce(t):
+            return hvd_tf.allreduce(t, name=f"g.{label}")
+
+        def timeit(fn, iters=30):
+            fn()  # warm (trace + first negotiation)
+            ts = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                fn()
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts) * 1e6)  # us
+
+        results[label] = {
+            "tf_eager_us": timeit(
+                lambda: hvd_tf.allreduce(x_tf, name=f"e.{label}")),
+            "tf_function_us": timeit(lambda: graph_allreduce(x_tf)),
+            "jax_eager_us": timeit(
+                lambda: hvd_jax.allreduce(x_jax, name=f"j.{label}")),
+        }
+    if rank == 0:
+        for label, r in results.items():
+            r["py_function_overhead_us"] = round(
+                r["tf_function_us"] - r["tf_eager_us"], 1)
+        print(json.dumps(results, indent=2), flush=True)
+    hvd_tf.shutdown()
+
+
+def main():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+            "HOROVOD_RANK": str(r),
+            "HOROVOD_SIZE": "2",
+            "HOROVOD_CONTROLLER_ADDR": "127.0.0.1",
+            "HOROVOD_CONTROLLER_PORT": str(port),
+            "HVD_TF_BENCH_WORKER": "1",
+        })
+        procs.append(subprocess.Popen([sys.executable, __file__], env=env))
+    rc = max(p.wait() for p in procs)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    if os.environ.get("HVD_TF_BENCH_WORKER"):
+        worker()
+    else:
+        main()

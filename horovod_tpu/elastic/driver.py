@@ -290,8 +290,9 @@ class ElasticDriver:
         if not rank0_ifaces or not req_ifaces:
             return None
         per_host = {rank0_host: rank0_ifaces, requester_host: req_ifaces}
-        return nic.select_controller_addr(rank0_ifaces, per_host,
-                                          allow=nic.iface_filter_from_env())
+        return nic.select_controller_addr(
+            rank0_ifaces, per_host, allow=nic.iface_filter_from_env(),
+            allow_loopback=requester_host == rank0_host)
 
     def set_controller_port(self, world_id: int, port: int) -> None:
         """Record the controller port rank 0 bound for ``world_id``;

@@ -265,9 +265,72 @@ def allreduce(
 def grouped_allreduce(tensors: Sequence, **kwargs):
     """Allreduce a list of tensors as one logical group (reference:
     grouped allreduce added for torch in mpi_ops.py; the fusion analogue).
+
     Under jit, XLA fuses the per-tensor psums; for stronger guarantees use
-    :mod:`horovod_tpu.ops.fusion` which packs one flat buffer per dtype."""
-    return [allreduce(t, **kwargs) for t in tensors]
+    :mod:`horovod_tpu.ops.fusion` which packs one flat buffer per dtype.
+    On the eager path the group is packed host-side into one flat buffer
+    per wire dtype and enqueued as ONE native collective per buffer — one
+    controller negotiation per group instead of N (reference grouped-op
+    semantics; like the reference's fusion buffer, Adasum then treats the
+    packed buffer as a single logical vector)."""
+    tensors = [jnp.asarray(t) for t in tensors]
+    axes_t = _resolve_axes(kwargs.get("axes"))
+    if axes_t or not tensors:
+        return [allreduce(t, **kwargs) for t in tensors]
+    return _eager_grouped_allreduce(tensors, **kwargs)
+
+
+def _eager_grouped_allreduce(tensors, *, name: Optional[str] = None,
+                             op: ReduceOp = ReduceOp.AVERAGE,
+                             prescale_factor: float = 1.0,
+                             postscale_factor: float = 1.0,
+                             compression=None, axes=None,
+                             hierarchical: Optional[bool] = None):
+    if hierarchical is not None:
+        raise ValueError(
+            "allreduce(hierarchical=...) is only supported in-jit; set "
+            "HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
+    compression = compression or Compression.none
+    ctrl, world = _eager_ctx()
+
+    wires, ctxs = [], []
+    for t in tensors:
+        w, c = compression.compress(_scale(t, prescale_factor))
+        wires.append(w)
+        ctxs.append(c)
+    if world == 1:
+        return [_scale(compression.decompress(w, c), postscale_factor)
+                for w, c in zip(wires, ctxs)]
+
+    opmap = {ReduceOp.SUM: ctrl.SUM, ReduceOp.AVERAGE: ctrl.SUM,
+             ReduceOp.MIN: ctrl.MIN, ReduceOp.MAX: ctrl.MAX,
+             ReduceOp.PRODUCT: ctrl.PRODUCT, ReduceOp.ADASUM: ctrl.ADASUM}
+    post = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
+    gname = _eager_name(name, "grouped_allreduce")
+
+    # One flat buffer (and one negotiation) per wire dtype, in first-seen
+    # order; results unpack back to the original shapes/positions.
+    by_dtype: dict = {}
+    for i, w in enumerate(wires):
+        by_dtype.setdefault(jnp.dtype(w.dtype), []).append(i)
+    out: list = [None] * len(tensors)
+    handles = []
+    for dt, idxs in by_dtype.items():
+        flat = np.concatenate(
+            [np.asarray(_to_numpy(wires[i])).ravel() for i in idxs])
+        handles.append((dt, idxs, ctrl.allreduce_async(
+            flat, f"{gname}.{dt.name}", op=opmap[op], postscale=post)))
+    for dt, idxs, h in handles:
+        buf = h.wait()
+        offset = 0
+        for i in idxs:
+            n = wires[i].size
+            piece = jnp.asarray(
+                buf[offset:offset + n]).reshape(wires[i].shape)
+            offset += n
+            out[i] = _scale(compression.decompress(piece, ctxs[i]),
+                            postscale_factor)
+    return out
 
 
 def allgather(tensor, *, name: Optional[str] = None, axes=None,

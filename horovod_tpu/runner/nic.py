@@ -85,20 +85,24 @@ def common_interfaces(per_host: Dict[str, Sequence[Tuple[str, str]]],
 def select_controller_addr(rank0_ifaces: Sequence[Tuple[str, str]],
                            per_host: Dict[str,
                                           Sequence[Tuple[str, str]]],
-                           allow: Optional[Iterable[str]] = None
+                           allow: Optional[Iterable[str]] = None,
+                           allow_loopback: bool = False
                            ) -> Optional[str]:
-    """The rank-0 host's address on the first interface common to every
-    host of the world (None when there is no usable intersection — callers
-    fall back to the hostname heuristic)."""
+    """The rank-0 host's address on the first interface common to the
+    given hosts (None when there is no usable intersection — callers fall
+    back to the hostname heuristic). Loopback only counts when the caller
+    says the dialing host IS the rank-0 host (``allow_loopback``):
+    every multi-host pair shares 'lo', and handing a remote worker
+    127.0.0.1 would send it to its own machine."""
     commons = common_interfaces(per_host, allow=allow)
     by_name = dict(rank0_ifaces)
     for name in commons:
         addr = by_name.get(name)
         if addr and not addr.startswith("127."):
             return addr
-    # All-loopback intersection is still valid for single-host worlds.
-    for name in commons:
-        addr = by_name.get(name)
-        if addr:
-            return addr
+    if allow_loopback:
+        for name in commons:
+            addr = by_name.get(name)
+            if addr:
+                return addr
     return None

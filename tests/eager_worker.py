@@ -55,6 +55,38 @@ def main():
     assert out.shape[0] == size * 2
     assert list(np.asarray(recv)) == [2] * size
 
+    # grouped allreduce: one native enqueue (= one controller negotiation)
+    # per wire dtype, numerics identical to per-tensor allreduce
+    from horovod_tpu.ops import collective_ops as C
+
+    ctrl = C._controller()
+    calls = []
+    orig_enqueue = ctrl.allreduce_async
+
+    def counting_enqueue(arr, name, **kw):
+        calls.append(name)
+        return orig_enqueue(arr, name, **kw)
+
+    ctrl.allreduce_async = counting_enqueue
+    try:
+        group = [jnp.full((3,), float(rank)), jnp.ones((2, 2)) * rank,
+                 jnp.arange(5, dtype=jnp.float32) + rank]
+        outs = hvd.grouped_allreduce(group, op=hvd.Sum, name="grp")
+        assert len(calls) == 1, f"expected 1 fused enqueue, got {calls}"
+        for t, o in zip(group, outs):
+            expect = sum(np.asarray(t) - rank + r for r in range(size))
+            assert np.allclose(np.asarray(o), expect), (o, expect)
+        # mixed dtypes: one negotiation per wire dtype (int32 — float64
+        # would silently fold to float32 under jax's default x64 config)
+        calls.clear()
+        outs = hvd.grouped_allreduce(
+            [jnp.ones(3, jnp.float32), jnp.ones(3, jnp.int32),
+             jnp.ones(4, jnp.float32)], op=hvd.Sum, name="grp2")
+        assert len(calls) == 2, f"expected 2 fused enqueues, got {calls}"
+        assert all(np.allclose(np.asarray(o), size) for o in outs)
+    finally:
+        ctrl.allreduce_async = orig_enqueue
+
     # async handle API
     h = hvd.allreduce_async(jnp.ones(8), name=f"async_t")
     assert hvd.synchronize(h) is not None

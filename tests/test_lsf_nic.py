@@ -141,12 +141,21 @@ class TestNic:
             IFACES_A, {"a": IFACES_A, "b": IFACES_B})
         assert addr == "10.0.0.1"  # eth0 ranks before ib0 in a's order
 
-    def test_select_loopback_when_only_common(self):
+    def test_select_loopback_only_for_same_host(self):
         only_lo = [("lo", "127.0.0.1")]
-        addr = nic.select_controller_addr(
-            only_lo, {"a": only_lo, "b": [("lo", "127.0.0.1"),
-                                          ("eth9", "10.9.9.9")]})
-        assert addr == "127.0.0.1"
+        per_host = {"a": only_lo, "b": [("lo", "127.0.0.1"),
+                                        ("eth9", "10.9.9.9")]}
+        # a remote dialer must NEVER be handed loopback (it would dial its
+        # own machine) — fall back to the hostname heuristic instead
+        assert nic.select_controller_addr(only_lo, per_host) is None
+        assert nic.select_controller_addr(
+            only_lo, per_host, allow_loopback=True) == "127.0.0.1"
+
+    def test_select_no_loopback_across_disjoint_real_nics(self):
+        # eth0-vs-ens3 hosts share only 'lo': remote dialer gets None
+        a = [("eth0", "10.0.0.1"), ("lo", "127.0.0.1")]
+        c = [("ens3", "10.1.0.3"), ("lo", "127.0.0.1")]
+        assert nic.select_controller_addr(a, {"a": a, "c": c}) is None
 
     def test_select_none_without_intersection(self):
         assert nic.select_controller_addr(
