@@ -23,7 +23,12 @@ import os
 import socket
 from typing import Any, Callable, List, Optional
 
-from .store import HDFSStore, LocalStore, Store  # noqa: F401
+from .store import (  # noqa: F401
+    DBFSLocalStore,
+    HDFSStore,
+    LocalStore,
+    Store,
+)
 
 
 def _require_pyspark():

@@ -26,6 +26,16 @@ class Store:
     def get_logs_path(self, run_id: str) -> str:
         raise NotImplementedError
 
+    def get_runs_path(self) -> str:
+        """Parent directory of all run artifacts (reference:
+        store.py get_runs_path)."""
+        raise NotImplementedError
+
+    def get_run_path(self, run_id: str) -> str:
+        """One run's artifact directory (reference: store.py
+        get_run_path)."""
+        raise NotImplementedError
+
     def exists(self, path: str) -> bool:
         raise NotImplementedError
 
@@ -35,11 +45,23 @@ class Store:
     def write(self, path: str, data: bytes) -> None:
         raise NotImplementedError
 
+    def sync_fn(self, run_id: str):
+        """An ``fn(local_dir)`` that mirrors a worker-local run directory
+        into this store's run path (reference: store.py sync_fn — the
+        estimators' checkpoint/logs upload hook). Shipped to executors via
+        cloudpickle like every worker fn, so it must close over plain data
+        (paths, connection tuples), never live handles."""
+        raise NotImplementedError
+
     @staticmethod
     def create(prefix_path: str, *args, **kwargs) -> "Store":
-        """Pick a store from the path scheme (reference: store.py:99-110)."""
+        """Pick a store from the path scheme (reference: store.py:99-110 —
+        hdfs:// → HDFSStore, dbfs:/ or /dbfs → DBFSLocalStore, else
+        LocalStore)."""
         if prefix_path.startswith("hdfs://"):
             return HDFSStore(prefix_path, *args, **kwargs)
+        if DBFSLocalStore.matches_dbfs(prefix_path):
+            return DBFSLocalStore(prefix_path, *args, **kwargs)
         return LocalStore(prefix_path, *args, **kwargs)
 
 
@@ -75,6 +97,12 @@ class LocalStore(Store):
     def get_logs_path(self, run_id: str) -> str:
         return self._sub("runs", run_id, "logs")
 
+    def get_runs_path(self) -> str:
+        return self._sub("runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id)
+
     def exists(self, path: str) -> bool:
         return os.path.exists(path)
 
@@ -86,6 +114,43 @@ class LocalStore(Store):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
             f.write(data)
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id)
+
+        def fn(local_run_path: str) -> None:
+            import shutil
+
+            shutil.copytree(local_run_path, run_path, dirs_exist_ok=True)
+
+        return fn
+
+
+class DBFSLocalStore(LocalStore):
+    """Databricks DBFS store (reference: store.py DBFSLocalStore) —
+    ``dbfs:/...`` and ``file:///dbfs/...`` URIs map onto the FUSE mount at
+    ``/dbfs``, after which everything is plain filesystem I/O."""
+
+    def __init__(self, prefix_path: str):
+        super().__init__(self.normalize_path(prefix_path))
+
+    @staticmethod
+    def matches_dbfs(path: str) -> bool:
+        return (path.startswith("dbfs:/")
+                or path.startswith("/dbfs/")
+                or path.startswith("file:///dbfs/"))
+
+    @staticmethod
+    def normalize_path(path: str) -> str:
+        """Rewrite any DBFS URI form to the FUSE path (reference:
+        store.py DBFSLocalStore.normalize_datasets_path)."""
+        if path.startswith("dbfs:///"):
+            return "/dbfs/" + path[len("dbfs:///"):]
+        if path.startswith("dbfs:/"):
+            return "/dbfs/" + path[len("dbfs:/"):]
+        if path.startswith("file:///dbfs/"):
+            return path[len("file://"):]
+        return path
 
 
 class HDFSStore(Store):
@@ -140,6 +205,35 @@ class HDFSStore(Store):
 
     def get_logs_path(self, run_id: str) -> str:
         return self._sub("runs", run_id, "logs")
+
+    def get_runs_path(self) -> str:
+        return self._sub("runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return self._sub("runs", run_id)
+
+    def sync_fn(self, run_id: str):
+        run_path = self.get_run_path(run_id).replace("hdfs://", "")
+        conn = self._conn  # close over plain data: fn ships pickled
+
+        def fn(local_run_path: str) -> None:
+            import os as _os
+
+            from pyarrow import fs as pafs
+
+            hdfs = pafs.HadoopFileSystem(host=conn[0], port=conn[1],
+                                         user=conn[2])
+            for root, _, files in _os.walk(local_run_path):
+                rel = _os.path.relpath(root, local_run_path)
+                for name in files:
+                    parts = [run_path] + \
+                        ([] if rel == "." else rel.split(_os.sep)) + [name]
+                    dst = "/".join(parts)
+                    with open(_os.path.join(root, name), "rb") as src:
+                        with hdfs.open_output_stream(dst) as out:
+                            out.write(src.read())
+
+        return fn
 
     def exists(self, path: str) -> bool:
         from pyarrow import fs as pafs

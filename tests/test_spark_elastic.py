@@ -25,8 +25,12 @@ import pytest
 import elastic_fn
 from horovod_tpu.elastic import constants
 from horovod_tpu.spark.elastic import run_elastic_core, task_loop  # noqa: F401
-from horovod_tpu.spark.estimator import _load_shard, _materialize_shards
-from horovod_tpu.spark.store import LocalStore
+from horovod_tpu.spark.estimator import (
+    ShardReader,
+    _load_shard,
+    _materialize_shards,
+)
+from horovod_tpu.spark.store import DBFSLocalStore, LocalStore, Store
 
 cloudpickle.register_pickle_by_value(elastic_fn)
 
@@ -199,3 +203,151 @@ class TestMaterializeShards:
         for rank, c in enumerate(counts):
             x, y = _load_shard(store, data_dir, rank)
             assert x.shape == (c, 1)
+
+    def test_streaming_reader_bounds_memory(self, tmp_path):
+        """A shard far bigger than the chunk cap trains while at most one
+        chunk is ever resident (round-2 missing #5: whole-shard loads
+        capped dataset size at worker RAM)."""
+        import numpy as np
+
+        rows = [{"x": float(i), "y": float(i % 2)} for i in range(103)]
+        store = LocalStore(str(tmp_path / "store"))
+        data_dir, counts = _materialize_shards(
+            _FakeDF(rows), ["x"], ["y"], 1, store, "run_c", chunk_rows=8)
+        assert counts == [103]
+        reader = ShardReader(store, data_dir, 0)
+        assert reader.rows == 103
+        assert len(reader.chunk_sizes) == 13  # ceil(103/8)
+        # one "epoch" of batches: order preserved, all rows seen once
+        seen = np.concatenate([xb[:, 0] for xb, _ in
+                               reader.iter_batches(batch_size=5)])
+        np.testing.assert_allclose(seen, np.arange(103, dtype="float32"))
+        assert reader.max_resident_rows <= 8, reader.max_resident_rows
+        assert reader.steps_per_epoch(5) == sum(
+            (s + 4) // 5 for s in reader.chunk_sizes)
+
+    def test_torch_estimator_streams_under_memory_cap(self, tmp_path,
+                                                      monkeypatch):
+        """End-to-end: TorchEstimator.fit trains from a shard larger than
+        the configured chunk cap; the reader high-water mark stays at the
+        cap (the 'train from a shard larger than a configured memory cap'
+        done-criterion)."""
+        import numpy as np
+        import torch
+
+        from horovod_tpu.spark import estimator as est_mod
+
+        monkeypatch.setenv("HOROVOD_SPARK_CHUNK_ROWS", "16")
+        residents = []
+        orig_iter = est_mod.ShardReader.iter_batches
+
+        def tracking_iter(self, batch_size):
+            yield from orig_iter(self, batch_size)
+            residents.append(self.max_resident_rows)
+
+        monkeypatch.setattr(est_mod.ShardReader, "iter_batches",
+                            tracking_iter)
+        # pyspark is not installable here: stand in for the barrier-stage
+        # job with an in-process world-1 run (the reader path under test
+        # is identical; the barrier machinery has its own tests above)
+        import horovod_tpu.spark as hvd_spark
+
+        monkeypatch.setattr(hvd_spark, "run",
+                            lambda fn, num_proc=None, **kw: [fn()])
+        rng = np.random.RandomState(0)
+        rows = [{"x1": float(v), "y": float(2 * v + 1)}
+                for v in rng.randn(120)]
+        store = LocalStore(str(tmp_path / "store"))
+        est = est_mod.TorchEstimator(
+            model=torch.nn.Linear(1, 1), store=store,
+            feature_cols=["x1"], label_cols=["y"],
+            batch_size=8, epochs=2, num_proc=1)
+        est.fit(_FakeDF(rows))
+        assert residents and max(residents) <= 16, residents
+
+
+class TestDistributedTransform:
+    class _MapInPandasDF:
+        """Spark-DataFrame double pinning the mapInPandas surface the
+        transformer uses; toPandas is the path that must NOT be taken."""
+
+        def __init__(self, rows, n_parts=3):
+            import pandas as pd
+
+            self._parts = []
+            per = (len(rows) + n_parts - 1) // n_parts
+            for i in range(0, len(rows), per):
+                self._parts.append(pd.DataFrame(rows[i:i + per]))
+            self.schema = ("x1", "y")
+            self.topandas_called = False
+
+        def mapInPandas(self, fn, schema):
+            import pandas as pd
+
+            assert schema is self.schema  # pyspark-free fallback path
+            return pd.concat(list(fn(iter(self._parts))),
+                             ignore_index=True)
+
+        def toPandas(self):
+            self.topandas_called = True
+            raise AssertionError("transform must not collect to the driver")
+
+    def test_transform_uses_map_in_pandas(self):
+        import numpy as np
+        import torch
+
+        from horovod_tpu.spark.estimator import _ModelTransformer
+
+        model = torch.nn.Linear(1, 1)
+        with torch.no_grad():
+            model.weight.fill_(2.0)
+            model.bias.fill_(1.0)
+        t = _ModelTransformer(
+            model, ["x1"], ["y"],
+            lambda m, f: m(torch.from_numpy(f)).detach().numpy())
+        rows = [{"x1": float(i), "y": 0.0} for i in range(10)]
+        df = self._MapInPandasDF(rows)
+        out = t.transform(df)
+        assert not df.topandas_called
+        assert len(out) == 10
+        preds = np.concatenate(out["prediction"].tolist())
+        np.testing.assert_allclose(preds, 2.0 * np.arange(10) + 1.0,
+                                   rtol=1e-6)
+
+    def test_transform_plain_rows_fallback(self):
+        from horovod_tpu.spark.estimator import _ModelTransformer
+
+        t = _ModelTransformer(None, ["x1"], ["y"],
+                              lambda m, f: f * 3.0)
+        out = t.transform([{"x1": 2.0, "y": 0.0}])
+        assert float(out["prediction"][0][0]) == 6.0
+
+
+class TestStores:
+    def test_dbfs_normalization_and_dispatch(self, tmp_path,
+                                             monkeypatch):
+        assert DBFSLocalStore.normalize_path("dbfs:/a/b") == "/dbfs/a/b"
+        assert DBFSLocalStore.normalize_path("dbfs:///a") == "/dbfs/a"
+        assert DBFSLocalStore.normalize_path(
+            "file:///dbfs/a") == "/dbfs/a"
+        # create() dispatch (redirect /dbfs to tmp so no real mount needed)
+        monkeypatch.setattr(DBFSLocalStore, "normalize_path",
+                            staticmethod(lambda p: str(tmp_path / "dbfs")))
+        store = Store.create("dbfs:/ml/horovod")
+        assert isinstance(store, DBFSLocalStore)
+        assert store.get_run_path("r1").endswith("runs/r1")
+
+    def test_local_store_sync_fn(self, tmp_path):
+        store = LocalStore(str(tmp_path / "store"))
+        local = tmp_path / "local_run"
+        (local / "logs").mkdir(parents=True)
+        (local / "logs" / "events.txt").write_text("hello")
+        (local / "model.bin").write_bytes(b"\x00\x01")
+        # estimators ship worker fns with cloudpickle; sync_fn rides along
+        fn = cloudpickle.loads(cloudpickle.dumps(store.sync_fn("run_9")))
+        fn(str(local))
+        run = store.get_run_path("run_9")
+        assert open(os.path.join(run, "logs", "events.txt")).read() == \
+            "hello"
+        assert open(os.path.join(run, "model.bin"), "rb").read() == \
+            b"\x00\x01"
