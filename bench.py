@@ -190,10 +190,22 @@ def summarize_profile(log_dir: str, top: int = 15) -> None:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--batch-size", type=int, default=128,
-                    help="per-chip batch size (reference benchmark "
-                         "convention: 64, docs/benchmarks.rst:27-43; "
-                         "128 keeps the MXU fed on v5e)")
+    ap.add_argument("--model", choices=["resnet50", "gpt"],
+                    default="resnet50",
+                    help="resnet50 = the reference's headline benchmark "
+                         "(HBM-bound on TPU); gpt = GPT-124M, matmul-"
+                         "dominated, shows the framework's MFU ceiling "
+                         "without ResNet's bandwidth wall")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="per-chip batch size (default: 128 images for "
+                         "resnet50 — reference convention is 64, "
+                         "docs/benchmarks.rst:27-43, 128 keeps the MXU "
+                         "fed on v5e; 8 sequences for gpt)")
+    ap.add_argument("--seq-len", type=int, default=1024,
+                    help="sequence length for --model gpt")
+    ap.add_argument("--gpt-scale", choices=["124m", "350m"],
+                    default="124m",
+                    help="GPT size: 124m (12L/768d) or 350m (24L/1024d)")
     ap.add_argument("--num-warmup", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=10,
                     help="timing rounds (reference: 10)")
@@ -207,6 +219,8 @@ def main():
                     help="run K train steps per device call via lax.scan "
                          "(host-loop offload; hides per-dispatch latency)")
     args = ap.parse_args()
+    if args.batch_size is None:
+        args.batch_size = 128 if args.model == "resnet50" else 8
     if args.steps_per_call < 1:
         ap.error("--steps-per-call must be >= 1")
     if args.profile and args.num_iters < 2:
@@ -231,11 +245,44 @@ def main():
     log(f"devices: {devices}  platform={platform}  world={n_chips}  "
         f"global_batch={global_batch}")
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16),
-                           train=False)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    if args.model == "gpt":
+        from horovod_tpu.models import GPT, GPTConfig
+
+        shape = (dict(num_layers=12, num_heads=12, d_model=768, d_ff=3072)
+                 if args.gpt_scale == "124m" else
+                 dict(num_layers=24, num_heads=16, d_model=1024, d_ff=4096))
+        cfg = GPTConfig(vocab_size=32000, max_seq_len=args.seq_len,
+                        attention="dense", **shape)
+        model = GPT(cfg)
+        variables = model.init(rng, jnp.zeros((1, args.seq_len), jnp.int32))
+        params, batch_stats = variables["params"], {}
+        images = jnp.asarray(np.random.randint(
+            0, cfg.vocab_size, (global_batch, args.seq_len)))
+        labels = jnp.asarray(np.random.randint(
+            0, cfg.vocab_size, (global_batch, args.seq_len)))
+
+        def loss_fn(p, bs, xb, yb):
+            logits = model.apply({"params": p}, xb)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+            return loss, bs
+    else:
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        variables = model.init(
+            rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16), train=False)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        images = jnp.asarray(np.random.randn(global_batch, 224, 224, 3),
+                             jnp.bfloat16)
+        labels = jnp.asarray(np.random.randint(0, 1000, global_batch))
+
+        def loss_fn(p, bs, xb, yb):
+            logits, new_vars = model.apply(
+                {"params": p, "batch_stats": bs}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb).mean()
+            return loss, new_vars["batch_stats"]
 
     compression = (hvd.Compression.bf16 if args.fp16_allreduce
                    else hvd.Compression.none)
@@ -251,20 +298,8 @@ def main():
     params = jax.device_put(params, rep)
     batch_stats = jax.device_put(batch_stats, rep)
     opt_state = jax.device_put(opt_state, rep)
-
-    images = jax.device_put(
-        jnp.asarray(np.random.randn(global_batch, 224, 224, 3),
-                    jnp.bfloat16), data_sh)
-    labels = jax.device_put(
-        jnp.asarray(np.random.randint(0, 1000, global_batch)), data_sh)
-
-    def loss_fn(p, bs, xb, yb):
-        logits, new_vars = model.apply(
-            {"params": p, "batch_stats": bs}, xb, train=True,
-            mutable=["batch_stats"])
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, yb).mean()
-        return loss, new_vars["batch_stats"]
+    images = jax.device_put(images, data_sh)
+    labels = jax.device_put(labels, data_sh)
 
     def spmd(p, bs, s, xb, yb):
         (loss, nbs), grads = hvd.value_and_grad(
@@ -336,7 +371,8 @@ def main():
         jax.block_until_ready((params, batch_stats, opt_state, loss))
         dt = time.perf_counter() - t0
         steps = args.num_batches_per_iter * args.steps_per_call
-        rate = global_batch * steps / dt
+        items = global_batch * (args.seq_len if args.model == "gpt" else 1)
+        rate = items * steps / dt
         if args.profile and i == profile_iter:
             jax.profiler.stop_trace()
             # Tracing inflates the iter; keep it out of the reported stats.
@@ -356,11 +392,14 @@ def main():
     # hiccup and immune to a single anomalously fast iteration (round-2
     # methodology flaw: MFU from min(step_times)).
     median_step = float(np.median(step_times))
-    per_chip = global_batch / median_step / n_chips
+    items_per_step = global_batch * (args.seq_len if args.model == "gpt"
+                                     else 1)
+    per_chip = items_per_step / median_step / n_chips
+    unit = "tokens/sec/chip" if args.model == "gpt" else "images/sec/chip"
     peak = peak_flops_per_chip(devices[0])
     mfu = (flops / median_step / peak) if peak > 0 else None
-    log(f"Median img/sec on {n_chips} chip(s): "
-        f"{global_batch / median_step:.1f} "
+    log(f"Median {unit.split('/')[0]}/sec on {n_chips} chip(s): "
+        f"{items_per_step / median_step:.1f} "
         f"(mean {float(np.mean(img_secs)):.1f} "
         f"± {float(np.std(img_secs)):.1f});  per chip: {per_chip:.1f}")
     if mfu is not None:
@@ -368,11 +407,16 @@ def main():
             f"{median_step * 1e3:.2f} ms, min {min(step_times) * 1e3:.2f} ms, "
             f"peak {peak / 1e12:.0f} TFLOP/s/chip)")
 
+    metric = (f"gpt{args.gpt_scale}_tokens_per_sec_per_chip"
+              if args.model == "gpt"
+              else "resnet50_images_per_sec_per_chip")
     print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
+        "metric": metric,
         "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3),
+        "unit": unit,
+        "vs_baseline": (
+            round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
+            if args.model == "resnet50" else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "step_ms_median": round(median_step * 1e3, 3),
         "step_ms_min": round(min(step_times) * 1e3, 3),
@@ -385,7 +429,8 @@ def main():
             "~peak effective bandwidth (conv+BN fusions 780-940 GB/s "
             "vs 819 GB/s HBM peak on v5e incl. VMEM prefetch hits); "
             "see README.md 'Benchmark methodology'")}
-           if "v5 lite" in getattr(devices[0], "device_kind", "").lower()
+           if args.model == "resnet50"
+           and "v5 lite" in getattr(devices[0], "device_kind", "").lower()
            else {}),
     }), flush=True)
 
