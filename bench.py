@@ -1,14 +1,19 @@
 #!/usr/bin/env python
-"""Synthetic ResNet-50 training benchmark — the reference's headline harness.
+"""Synthetic training benchmark — the reference's headline harness.
 
-Mirrors ``examples/tensorflow2/tensorflow2_synthetic_benchmark.py`` from the
-reference (docs/benchmarks.rst:66-80): ResNet-50, synthetic ImageNet-shaped
-data, SGD-momentum, DistributedOptimizer gradient averaging, reporting
-images/sec. Runs on every visible chip via the Horovod mesh.
+Default mode mirrors ``examples/tensorflow2/tensorflow2_synthetic_benchmark
+.py`` from the reference (docs/benchmarks.rst:66-80): ResNet-50, synthetic
+ImageNet-shaped data, SGD-momentum, DistributedOptimizer gradient
+averaging, reporting images/sec. ``--model gpt`` swaps in a GPT-124M (or
+``--gpt-scale 350m``) language model over the identical training step,
+reporting tokens/sec — the matmul-dominated counterpoint to ResNet's
+HBM-bound profile. Runs on every visible chip via the Horovod mesh.
 
 Prints ONE JSON line:
-  {"metric": "resnet50_images_per_sec_per_chip", "value": <img/s/chip>,
-   "unit": "images/sec/chip", "vs_baseline": <ratio>, "mfu": <frac>,
+  {"metric": "resnet50_images_per_sec_per_chip" |
+             "gpt{124m,350m}_tokens_per_sec_per_chip",
+   "value": <items/s/chip>, "unit": "images/sec/chip"|"tokens/sec/chip",
+   "vs_baseline": <ratio, resnet50 only — null for gpt>, "mfu": <frac>,
    "platform": "tpu", ...}
 
 Methodology (round 3): per-chip batch 128, median-step throughput/MFU,
@@ -69,11 +74,12 @@ def peak_flops_per_chip(device) -> float:
     return 0.0
 
 
-def step_flops_per_chip(compiled, global_batch, n_chips) -> float:
+def step_flops_per_chip(compiled, global_items, n_chips,
+                        analytic_flops_per_item) -> float:
     """Per-chip FLOPs of one compiled train step. XLA's cost_analysis on an
     SPMD executable reports the per-device partitioned module, so it is
-    already per-chip; the analytic fallback (4.09 GFLOPs forward/image x 3
-    for fwd+bwd) is global and gets divided down."""
+    already per-chip; the analytic per-item fallback (model-specific:
+    fwd+bwd FLOPs per image/token) is global and gets divided down."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):  # older jax returns [dict]
@@ -83,7 +89,7 @@ def step_flops_per_chip(compiled, global_batch, n_chips) -> float:
             return flops
     except Exception as e:
         log(f"cost_analysis unavailable ({e}); using analytic FLOPs")
-    return 3.0 * 4.089e9 * global_batch / n_chips
+    return analytic_flops_per_item * global_items / n_chips
 
 
 def init_backend():
@@ -341,9 +347,20 @@ def main():
     lowered = train_step.lower(params, batch_stats, opt_state, images, labels)
     compiled = lowered.compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
+    # Analytic fallback, per item: ResNet-50 ~4.09 GFLOP fwd/image x 3 for
+    # fwd+bwd; GPT ~6*N FLOP/token (N = param count) for fwd+bwd.
+    if args.model == "gpt":
+        n_params = sum(int(np.prod(x.shape))
+                       for x in jax.tree.leaves(params))
+        analytic_per_item = 6.0 * n_params
+        items_per_step = global_batch * args.seq_len
+    else:
+        analytic_per_item = 3.0 * 4.089e9
+        items_per_step = global_batch
+    item_unit = "tok" if args.model == "gpt" else "img"
     flops = step_flops_per_chip(
-        compiled, global_batch * args.steps_per_call,
-        n_chips) / args.steps_per_call
+        compiled, items_per_step * args.steps_per_call,
+        n_chips, analytic_per_item) / args.steps_per_call
     # Drive the AOT executable directly so the jit dispatch path doesn't
     # trigger a second identical XLA compile.
     train_step = compiled
@@ -371,16 +388,16 @@ def main():
         jax.block_until_ready((params, batch_stats, opt_state, loss))
         dt = time.perf_counter() - t0
         steps = args.num_batches_per_iter * args.steps_per_call
-        items = global_batch * (args.seq_len if args.model == "gpt" else 1)
-        rate = items * steps / dt
+        rate = items_per_step * steps / dt
         if args.profile and i == profile_iter:
             jax.profiler.stop_trace()
             # Tracing inflates the iter; keep it out of the reported stats.
-            log(f"iter {i}: {rate:.1f} img/s total (profiled; excluded)")
+            log(f"iter {i}: {rate:.1f} {item_unit}/s total "
+                f"(profiled; excluded)")
             continue
         step_times.append(dt / steps)
         img_secs.append(rate)
-        log(f"iter {i}: {rate:.1f} img/s total")
+        log(f"iter {i}: {rate:.1f} {item_unit}/s total")
 
     if args.profile:
         try:
@@ -392,8 +409,6 @@ def main():
     # hiccup and immune to a single anomalously fast iteration (round-2
     # methodology flaw: MFU from min(step_times)).
     median_step = float(np.median(step_times))
-    items_per_step = global_batch * (args.seq_len if args.model == "gpt"
-                                     else 1)
     per_chip = items_per_step / median_step / n_chips
     unit = "tokens/sec/chip" if args.model == "gpt" else "images/sec/chip"
     peak = peak_flops_per_chip(devices[0])

@@ -58,10 +58,15 @@ def worker():
             "jax_eager_us": timeit(
                 lambda: hvd_jax.allreduce(x_jax, name=f"j.{label}")),
         }
+    from horovod_tpu.tensorflow import _native_ops
+
     if rank == 0:
         for label, r in results.items():
-            r["py_function_overhead_us"] = round(
+            # positive = the graph boundary costs vs eager (py_function
+            # path); negative = the native custom op beats eager dispatch
+            r["graph_vs_eager_us"] = round(
                 r["tf_function_us"] - r["tf_eager_us"], 1)
+        results["native_graph_ops"] = _native_ops() is not None
         print(json.dumps(results, indent=2), flush=True)
     hvd_tf.shutdown()
 

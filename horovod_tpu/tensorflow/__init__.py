@@ -81,7 +81,11 @@ def _eager_world():
 def allreduce(tensor, average=None, name=None, compression=None,
               op=None, prescale_factor=1.0, postscale_factor=1.0):
     """Synchronous, differentiable allreduce (reference:
-    tensorflow/__init__.py:53-153; gradient = allreduce of the gradient)."""
+    tensorflow/__init__.py:53-153; gradient = allreduce of the gradient).
+
+    In graph mode the op is a native graph node (cc/hvd_tf_ops.cc, the
+    reference's mpi_ops.cc:371-419 analogue) when the custom-op library is
+    available; ``tf.py_function`` is the fallback."""
     from .compression import Compression
 
     rop = _normalize_op(average, op)
@@ -89,25 +93,77 @@ def allreduce(tensor, average=None, name=None, compression=None,
 
     @tf.custom_gradient
     def _op(x):
-        y = _maybe_py_function(
-            lambda t: _allreduce_eager(t, rop, name, prescale_factor,
-                                       postscale_factor, compression),
-            x, x.dtype, x.shape)
+        y = _graph_or_eager_allreduce(x, rop, name, prescale_factor,
+                                      postscale_factor, compression)
 
         def grad(dy):
-            return _maybe_py_function(
-                lambda t: _allreduce_eager(t, rop, None, prescale_factor,
-                                           postscale_factor, compression),
-                dy, dy.dtype, dy.shape)
+            return _graph_or_eager_allreduce(dy, rop, None, prescale_factor,
+                                             postscale_factor, compression)
         return y, grad
 
     return _op(tf.convert_to_tensor(tensor))
 
 
+def _op_code(ctrl, rop):
+    """Native ReduceOp code for a binding-level op constant (single source
+    shared by the eager and graph paths)."""
+    return {Sum: ctrl.SUM, Average: ctrl.SUM, Min: ctrl.MIN,
+            Max: ctrl.MAX, Product: ctrl.PRODUCT, Adasum: ctrl.ADASUM}[rop]
+
+
+def _graph_name(x, name, default):
+    """Stable per-node tensor name derived from the traced graph (the
+    reference keys on TF node names the same way): deterministic from the
+    graph STRUCTURE, so ranks tracing the same function get identical
+    name sequences even when one rank retraces more often — a global
+    trace-time counter would desync and hang cross-rank negotiation."""
+    return x.graph.unique_name(name or default)
+
+
+def _graph_or_eager_allreduce(x, rop, name, prescale_factor,
+                              postscale_factor, compression):
+    lib = None if tf.executing_eagerly() else _native_ops()
+    if lib is None:
+        return _maybe_py_function(
+            lambda t: _allreduce_eager(t, rop, name, prescale_factor,
+                                       postscale_factor, compression),
+            x, x.dtype, x.shape)
+    ctrl, _ = _eager_world()
+    wire, cctx = compression.compress(x)
+    out = lib.hvdtpu_allreduce(
+        wire, tensor_name=_graph_name(x, name, "hvd.allreduce"),
+        reduce_op=_op_code(ctrl, rop), prescale=float(prescale_factor),
+        postscale=float(postscale_factor))
+    if rop == Average:
+        # Divide by the RUNTIME world size (HvdtpuSize node): a trace-time
+        # constant would keep averaging by the old size when an elastic
+        # world change reuses a cached concrete function.
+        size_now = lib.hvdtpu_size()
+        if out.dtype.is_floating:
+            out = out / tf.cast(size_now, out.dtype)
+        else:
+            out = tf.cast(
+                tf.cast(out, tf.float64) / tf.cast(size_now, tf.float64),
+                out.dtype)
+    return compression.decompress(out, cctx)
+
+
+def _native_ops():
+    """The custom-op library, only when the native core is live (a kernel
+    enqueue without a controller would fail; world-1 jobs have none and
+    keep the py_function identity path)."""
+    if C._controller() is None:
+        return None
+    from . import cc_ops
+
+    return cc_ops.load()
+
+
 def _maybe_py_function(fn, x, out_dtype, out_shape):
     """Run ``fn`` eagerly, or via tf.py_function when tracing inside a
     tf.function (reference analogue: the AsyncOpKernel boundary in
-    tensorflow/mpi_ops.cc — host-side work escapes the graph)."""
+    tensorflow/mpi_ops.cc — host-side work escapes the graph; the native
+    custom op replaces this wherever cc_ops builds)."""
     if tf.executing_eagerly():
         return fn(x)
     y = tf.py_function(fn, [x], out_dtype)
@@ -124,13 +180,11 @@ def _allreduce_eager(x, rop, name, prescale_factor, postscale_factor,
         scale = prescale_factor * postscale_factor
         out = compressed if scale == 1.0 else compressed * scale
     else:
-        opmap = {Sum: ctrl.SUM, Average: ctrl.SUM, Min: ctrl.MIN,
-                 Max: ctrl.MAX, Product: ctrl.PRODUCT, Adasum: ctrl.ADASUM}
         post = postscale_factor / world if rop == Average \
             else postscale_factor
         arr = ctrl.allreduce_async(
             _to_numpy(compressed), C._eager_name(name, "tf.allreduce"),
-            op=opmap[rop], prescale=float(prescale_factor),
+            op=_op_code(ctrl, rop), prescale=float(prescale_factor),
             postscale=float(post)).wait()
         out = tf.convert_to_tensor(arr)
     return compression.decompress(out, cctx)
@@ -149,8 +203,13 @@ def _normalize_op(average, op):
 
 def allgather(tensor, name=None):
     """First-dim concatenation across ranks (reference:
-    tensorflow/mpi_ops.py allgather); ragged dim 0 allowed."""
+    tensorflow/mpi_ops.py allgather); ragged dim 0 allowed. Graph mode
+    uses the native custom op when available."""
     x = tf.convert_to_tensor(tensor)
+    lib = None if tf.executing_eagerly() else _native_ops()
+    if lib is not None:
+        return lib.hvdtpu_allgather(
+            x, tensor_name=_graph_name(x, name, "hvd.allgather"))
 
     def fn(t):
         ctrl, world = _eager_world()
@@ -166,8 +225,14 @@ def allgather(tensor, name=None):
 
 
 def broadcast(tensor, root_rank=0, name=None):
-    """Reference: tensorflow/mpi_ops.py broadcast."""
+    """Reference: tensorflow/mpi_ops.py broadcast. Graph mode uses the
+    native custom op when available."""
     x = tf.convert_to_tensor(tensor)
+    lib = None if tf.executing_eagerly() else _native_ops()
+    if lib is not None:
+        return lib.hvdtpu_broadcast(
+            x, tensor_name=_graph_name(x, name, "hvd.broadcast"),
+            root_rank=root_rank)
 
     def fn(t):
         ctrl, world = _eager_world()

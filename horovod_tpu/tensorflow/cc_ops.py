@@ -1,0 +1,93 @@
+"""Build + load the native TF custom-op library.
+
+Reference: ``horovod/tensorflow/mpi_ops.py`` loads ``mpi_lib`` (the
+compiled AsyncOpKernels of mpi_ops.cc:371-419). Here the kernels
+(``cc/hvd_tf_ops.cc``) call the shared native core's C ABI directly, so
+graph-mode collectives are real TF graph nodes — no ``tf.py_function``
+boundary (~1.1-1.4 ms/collective, see examples/bench_tf_graph_overhead.py).
+
+The library is compiled on first use with TensorFlow's advertised flags
+(``tf.sysconfig``), linked against ``libhvdtpu.so`` (built on demand, the
+same .so the ctypes path loads — one handle table, one controller), and
+cached. Every failure mode degrades to the py_function path, loudly via a
+one-time warning: a missing compiler must never break training.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "cc", "hvd_tf_ops.cc")
+_OUT = os.path.join(_HERE, "cc", "build", "hvd_tf_ops.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_attempted = False
+
+
+def _up_to_date() -> bool:
+    """Fresh relative to BOTH our source and libhvdtpu.so — a rebuilt
+    native core may have changed the C ABI, and a stale kernel calling a
+    changed symbol reads garbage arguments."""
+    if not os.path.exists(_OUT):
+        return False
+    newest_dep = os.path.getmtime(_SRC)
+    from ..cc import _LIB_PATH
+
+    if os.path.exists(_LIB_PATH):
+        newest_dep = max(newest_dep, os.path.getmtime(_LIB_PATH))
+    return os.path.getmtime(_OUT) >= newest_dep
+
+
+def _build() -> str:
+    import fcntl
+
+    import tensorflow as tf
+
+    from ..cc import build as build_core
+
+    core_so = build_core()  # libhvdtpu.so (shared with the ctypes path)
+    os.makedirs(os.path.dirname(_OUT), exist_ok=True)
+    lock_path = _OUT + ".lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if _up_to_date():
+                return _OUT
+            cmd = (["g++", "-shared", "-fPIC", "-O2", "-o", _OUT, _SRC]
+                   + tf.sysconfig.get_compile_flags()
+                   + tf.sysconfig.get_link_flags()
+                   + [core_so, f"-Wl,-rpath,{os.path.dirname(core_so)}"])
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"TF custom-op build failed:\n{proc.stderr[-2000:]}")
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+    return _OUT
+
+
+def load() -> Optional[object]:
+    """The loaded op library module (with .hvdtpu_allreduce etc.), or None
+    when building/loading is impossible here (logged once)."""
+    global _lib, _load_attempted
+    with _lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        try:
+            import tensorflow as tf
+
+            path = _OUT if _up_to_date() else _build()
+            _lib = tf.load_op_library(path)
+        except Exception as e:
+            logging.warning(
+                "horovod_tpu: native TF ops unavailable (%s); graph-mode "
+                "collectives fall back to tf.py_function", e)
+            _lib = None
+        return _lib
