@@ -122,10 +122,18 @@ def _graph_name(x, name, default):
 
 def _graph_or_eager_allreduce(x, rop, name, prescale_factor,
                               postscale_factor, compression):
-    lib = None if tf.executing_eagerly() else _native_ops()
+    if tf.executing_eagerly():
+        return _allreduce_eager(x, rop, name, prescale_factor,
+                                postscale_factor, compression)
+    lib = _native_ops()
     if lib is None:
+        # py_function fallback — but the tensor NAME must still be the
+        # graph-structural one: a rank that failed to build the custom op
+        # must negotiate under the same names as its native-op peers, or
+        # the mixed-path world deadlocks at the first collective.
+        tname = _graph_name(x, name, "hvd.allreduce")
         return _maybe_py_function(
-            lambda t: _allreduce_eager(t, rop, name, prescale_factor,
+            lambda t: _allreduce_eager(t, rop, tname, prescale_factor,
                                        postscale_factor, compression),
             x, x.dtype, x.shape)
     ctrl, _ = _eager_world()
@@ -206,17 +214,23 @@ def allgather(tensor, name=None):
     tensorflow/mpi_ops.py allgather); ragged dim 0 allowed. Graph mode
     uses the native custom op when available."""
     x = tf.convert_to_tensor(tensor)
-    lib = None if tf.executing_eagerly() else _native_ops()
+    eager = tf.executing_eagerly()
+    lib = None if eager else _native_ops()
     if lib is not None:
         return lib.hvdtpu_allgather(
             x, tensor_name=_graph_name(x, name, "hvd.allgather"))
+    # Graph fallback uses the graph-structural name so mixed native/
+    # py_function worlds stay name-aligned (see _graph_or_eager_allreduce)
+    tname = name if eager else _graph_name(x, name, "hvd.allgather")
 
     def fn(t):
         ctrl, world = _eager_world()
         if world == 1:
             return tf.identity(t)
         arr = ctrl.allgather_async(
-            _to_numpy(t), C._eager_name(name, "tf.allgather")).wait()
+            _to_numpy(t),
+            C._eager_name(tname, "tf.allgather") if eager
+            else tname).wait()
         return tf.convert_to_tensor(arr)
 
     out_shape = tf.TensorShape([None]).concatenate(x.shape[1:]) \
@@ -228,19 +242,22 @@ def broadcast(tensor, root_rank=0, name=None):
     """Reference: tensorflow/mpi_ops.py broadcast. Graph mode uses the
     native custom op when available."""
     x = tf.convert_to_tensor(tensor)
-    lib = None if tf.executing_eagerly() else _native_ops()
+    eager = tf.executing_eagerly()
+    lib = None if eager else _native_ops()
     if lib is not None:
         return lib.hvdtpu_broadcast(
             x, tensor_name=_graph_name(x, name, "hvd.broadcast"),
             root_rank=root_rank)
+    tname = name if eager else _graph_name(x, name, "hvd.broadcast")
 
     def fn(t):
         ctrl, world = _eager_world()
         if world == 1:
             return tf.identity(t)
         arr = ctrl.broadcast_async(
-            _to_numpy(t), C._eager_name(name, "tf.broadcast"),
-            root=root_rank).wait()
+            _to_numpy(t),
+            C._eager_name(tname, "tf.broadcast") if eager
+            else tname, root=root_rank).wait()
         return tf.convert_to_tensor(arr)
 
     return _maybe_py_function(fn, x, x.dtype, x.shape)

@@ -291,14 +291,42 @@ class HvdtpuAllgatherOp : public AsyncOpKernel {
     int64_t row_elems = 1;
     for (size_t i = 1; i < shape.size(); ++i) row_elems *= shape[i];
     if (row_elems == 0) {
-      // Zero-size rows: nothing travels; world*rows of nothing. Sizing
-      // from result_bytes would divide by zero, so answer locally.
-      TensorShape out_shape = input.shape();
-      out_shape.set_dim(0, input.dim_size(0) * hvdtpu_size());
-      Tensor* output = nullptr;
-      OP_REQUIRES_OK_ASYNC(
-          ctx, ctx->allocate_output(0, out_shape, &output), done);
-      done();
+      // Zero-size rows: no payload travels, but the output's first dim is
+      // still the SUM of every rank's (possibly ragged) dim 0 — gather
+      // the per-rank row counts through a tiny companion collective
+      // (sizing locally as dim0*world would be wrong and rank-divergent
+      // for ragged inputs).
+      int64_t rows = input.dim_size(0);
+      int64_t one = 1;
+      std::string rows_name = tensor_name_ + ".rows";
+      int handle = hvdtpu_allgather(rows_name.c_str(), &rows, &one, 1,
+                                    /*dtype=*/3 /* int64 */);
+      if (!CheckEnqueued(ctx, handle, done)) return;
+      TensorShape base_shape = input.shape();
+      Waiter::Get().Add(handle, [ctx, handle, done,
+                                 base_shape](int rc) mutable {
+        if (rc != 0) {
+          ctx->CtxFailure(
+              Internal("horovod_tpu collective failed: ",
+                       std::string(hvdtpu_handle_error(handle))));
+          hvdtpu_release(handle);
+          done();
+          return;
+        }
+        int64_t n = hvdtpu_result_bytes(handle) /
+                    static_cast<int64_t>(sizeof(int64_t));
+        std::vector<int64_t> counts(static_cast<size_t>(n));
+        hvdtpu_fetch(handle, counts.data());
+        hvdtpu_release(handle);
+        int64_t total = 0;
+        for (int64_t c : counts) total += c;
+        base_shape.set_dim(0, total);
+        Tensor* output = nullptr;
+        ::tensorflow::Status s =
+            ctx->allocate_output(0, base_shape, &output);
+        if (!s.ok()) ctx->CtxFailure(s);
+        done();
+      });
       return;
     }
     int handle = hvdtpu_allgather(

@@ -59,7 +59,11 @@ def _build() -> str:
         try:
             if _up_to_date():
                 return _OUT
-            cmd = (["g++", "-shared", "-fPIC", "-O2", "-o", _OUT, _SRC]
+            # Compile to a temp path and os.replace (atomic): load() reads
+            # _OUT without the lock, and a reader must see either the old
+            # complete library or the new one — never a half-written ELF.
+            tmp_out = _OUT + f".tmp.{os.getpid()}"
+            cmd = (["g++", "-shared", "-fPIC", "-O2", "-o", tmp_out, _SRC]
                    + tf.sysconfig.get_compile_flags()
                    + tf.sysconfig.get_link_flags()
                    + [core_so, f"-Wl,-rpath,{os.path.dirname(core_so)}"])
@@ -67,6 +71,7 @@ def _build() -> str:
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"TF custom-op build failed:\n{proc.stderr[-2000:]}")
+            os.replace(tmp_out, _OUT)
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
     return _OUT
