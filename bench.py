@@ -212,6 +212,11 @@ def main():
     ap.add_argument("--gpt-scale", choices=["124m", "350m"],
                     default="124m",
                     help="GPT size: 124m (12L/768d) or 350m (24L/1024d)")
+    ap.add_argument("--attention", choices=["flash", "dense"],
+                    default="flash",
+                    help="GPT attention path: flash = Pallas kernel "
+                         "(no [T,T] HBM round-trip), dense = reference "
+                         "einsum attention")
     ap.add_argument("--num-warmup", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=10,
                     help="timing rounds (reference: 10)")
@@ -259,7 +264,7 @@ def main():
                  if args.gpt_scale == "124m" else
                  dict(num_layers=24, num_heads=16, d_model=1024, d_ff=4096))
         cfg = GPTConfig(vocab_size=32000, max_seq_len=args.seq_len,
-                        attention="dense", **shape)
+                        attention=args.attention, **shape)
         model = GPT(cfg)
         variables = model.init(rng, jnp.zeros((1, args.seq_len), jnp.int32))
         params, batch_stats = variables["params"], {}
@@ -347,20 +352,30 @@ def main():
     lowered = train_step.lower(params, batch_stats, opt_state, images, labels)
     compiled = lowered.compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
-    # Analytic fallback, per item: ResNet-50 ~4.09 GFLOP fwd/image x 3 for
-    # fwd+bwd; GPT ~6*N FLOP/token (N = param count) for fwd+bwd.
+    # Model FLOPs for MFU. ResNet-50: XLA cost analysis on the compiled
+    # step (analytic fallback ~4.09 GFLOP fwd/image x 3 for fwd+bwd). GPT:
+    # ALWAYS the standard analytic count — 6*N matmul FLOPs/token plus the
+    # causal attention term 6*L*T*d (the causal-halved convention, as in
+    # FlashAttention/Chinchilla accounting; PaLM Appendix B's unhalved
+    # form would be 12*L*T*d) — because XLA's cost analysis cannot see
+    # inside the Pallas flash-attention custom call and would under-credit
+    # the flash path for the very FLOPs it executes (MFU is defined on
+    # model FLOPs, not implementation ops).
     if args.model == "gpt":
         n_params = sum(int(np.prod(x.shape))
                        for x in jax.tree.leaves(params))
-        analytic_per_item = 6.0 * n_params
+        analytic_per_item = (6.0 * n_params
+                             + 6.0 * cfg.num_layers * args.seq_len
+                             * cfg.d_model)
         items_per_step = global_batch * args.seq_len
+        flops = analytic_per_item * items_per_step / n_chips
     else:
         analytic_per_item = 3.0 * 4.089e9
         items_per_step = global_batch
+        flops = step_flops_per_chip(
+            compiled, items_per_step * args.steps_per_call,
+            n_chips, analytic_per_item) / args.steps_per_call
     item_unit = "tok" if args.model == "gpt" else "img"
-    flops = step_flops_per_chip(
-        compiled, items_per_step * args.steps_per_call,
-        n_chips, analytic_per_item) / args.steps_per_call
     # Drive the AOT executable directly so the jit dispatch path doesn't
     # trigger a second identical XLA compile.
     train_step = compiled
@@ -439,6 +454,8 @@ def main():
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": n_chips,
         "per_chip_batch": args.batch_size,
+        **({"attention": args.attention, "seq_len": args.seq_len}
+           if args.model == "gpt" else {}),
         **({"note": (
             "HBM-roofline bound: profiled device busy time runs at "
             "~peak effective bandwidth (conv+BN fusions 780-940 GB/s "

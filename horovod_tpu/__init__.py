@@ -44,7 +44,8 @@ API surface parity map (reference file → here):
   horovod.keras / tensorflow.keras    → keras/, _keras/, tensorflow/keras/
   horovod.mxnet                       → mxnet/ (gated: MXNet is EOL)
   (no reference analogue)             → parallel/sequence.py (ring/Ulysses
-                                        attention), models/gpt.py
+                                        attention), ops/flash_attention.py
+                                        (Pallas flash kernel), models/gpt.py
 """
 
 from .common.basics import (  # noqa: F401
@@ -103,6 +104,7 @@ from .parallel.functions import (  # noqa: F401
     broadcast_parameters,
     broadcast_variables,
 )
+from .ops.flash_attention import flash_attention  # noqa: F401
 from .parallel.optimizer import DistributedOptimizer  # noqa: F401
 from .parallel.sequence import (  # noqa: F401
     dense_attention,

@@ -6,9 +6,11 @@ build. TPU-first choices:
 
 * bfloat16 activations, fp32 params/softmax statistics (MXU-native),
 * pre-norm blocks, GELU MLP, learned positional embeddings,
-* attention is pluggable: ``dense`` (single chip), ``ring``
-  (ppermute ring over the mesh axis — O(T/n) sequence memory/chip), or
-  ``ulysses`` (all-to-all head exchange) from
+* attention is pluggable: ``dense`` (single chip), ``flash`` (Pallas
+  flash kernel, :mod:`horovod_tpu.ops.flash_attention` — same numerics,
+  no [T, T] HBM round-trip), ``ring`` (ppermute ring over the mesh axis —
+  O(T/n) sequence memory/chip), or ``ulysses`` (all-to-all head exchange,
+  local attention runs the flash kernel) from
   :mod:`horovod_tpu.parallel.sequence`,
 * optional ``remat`` per block (jax.checkpoint) to trade FLOPs for HBM,
 * everything is static-shaped, scan-free python loops over layers so XLA
@@ -39,7 +41,7 @@ class GPTConfig:
     d_ff: int = 3072
     max_seq_len: int = 2048
     dtype: jnp.dtype = jnp.bfloat16
-    attention: str = "dense"          # dense | ring | ulysses
+    attention: str = "dense"          # dense | flash | ring | ulysses
     seq_axis: str = LOCAL_AXIS        # mesh axis carrying the sequence
     remat: bool = False
     embed_init_std: float = 0.02
@@ -64,10 +66,22 @@ class _Attention(nn.Module):
             out = seqpar.ring_attention(q, k, v, axis=cfg.seq_axis,
                                         causal=True)
         elif cfg.attention == "ulysses":
-            out = seqpar.ulysses_attention(q, k, v, axis=cfg.seq_axis,
-                                           causal=True)
-        else:
+            from ..ops.flash_attention import flash_attention
+
+            out = seqpar.ulysses_attention(
+                q, k, v, axis=cfg.seq_axis, causal=True,
+                attn_fn=lambda qf, kf, vf: flash_attention(
+                    qf, kf, vf, causal=True))
+        elif cfg.attention == "flash":
+            from ..ops.flash_attention import flash_attention
+
+            out = flash_attention(q, k, v, causal=True)
+        elif cfg.attention == "dense":
             out = seqpar.dense_attention(q, k, v, causal=True)
+        else:
+            raise ValueError(
+                f"unknown attention {cfg.attention!r}; expected "
+                f"dense | flash | ring | ulysses")
         out = out.reshape(B, T, C)
         return nn.Dense(C, dtype=cfg.dtype, name="proj",
                         kernel_init=nn.initializers.normal(
