@@ -366,6 +366,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if causal and Tq != Tk:
         raise ValueError(
             f"causal flash attention needs Tq == Tk, got {Tq} != {Tk}")
+    if block_q < 128 or block_k < 128:
+        raise ValueError(
+            f"block_q/block_k must be >= 128 (MXU/lane tile), got "
+            f"{block_q}/{block_k}")
     bq, bk = _pick_block(Tq, block_q), _pick_block(Tk, block_k)
     if bq is None or bk is None:
         from ..parallel.sequence import dense_attention

@@ -157,6 +157,10 @@ def ulysses_attention(q, k, v, *, axis=LOCAL_AXIS, causal: bool = True,
     B, T_local, H, D = q.shape
     n = _axis_size(axis)
     if n == 1:
+        # Unsharded world: still honor the caller's local-attention kernel
+        # (e.g. flash) — the shard IS the full sequence.
+        if attn_fn is not None:
+            return attn_fn(q, k, v)
         return dense_attention(q, k, v, causal=causal, scale=scale)
     if H % n != 0:
         raise ValueError(f"heads {H} not divisible by axis size {n}")
