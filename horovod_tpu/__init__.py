@@ -104,7 +104,10 @@ from .parallel.functions import (  # noqa: F401
     broadcast_parameters,
     broadcast_variables,
 )
-from .ops.flash_attention import flash_attention  # noqa: F401
+from .ops.flash_attention import (  # noqa: F401
+    flash_attention,
+    flash_ring_attention,
+)
 from .parallel.optimizer import DistributedOptimizer  # noqa: F401
 from .parallel.sequence import (  # noqa: F401
     dense_attention,
