@@ -217,6 +217,14 @@ def main():
                     help="GPT attention path: flash = Pallas kernel "
                          "(no [T,T] HBM round-trip), dense = reference "
                          "einsum attention")
+    ap.add_argument("--lm-loss", choices=["fused", "dense"],
+                    default="dense",
+                    help="GPT LM-head loss: dense = einsum head + optax "
+                         "xent (fastest at vocab 32k — XLA's fused "
+                         "matmul+xent is already near-roofline); fused = "
+                         "Pallas linear cross-entropy, the [N, vocab] "
+                         "logits never touch HBM (the memory-scalable "
+                         "path for larger vocab/batch; ~2.5% slower here)")
     ap.add_argument("--num-warmup", type=int, default=5)
     ap.add_argument("--num-iters", type=int, default=10,
                     help="timing rounds (reference: 10)")
@@ -273,11 +281,24 @@ def main():
         labels = jnp.asarray(np.random.randint(
             0, cfg.vocab_size, (global_batch, args.seq_len)))
 
-        def loss_fn(p, bs, xb, yb):
-            logits = model.apply({"params": p}, xb)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits, yb).mean()
-            return loss, bs
+        if args.lm_loss == "fused":
+            import dataclasses
+
+            from horovod_tpu.ops.softmax_xent import linear_cross_entropy
+
+            hidden_model = GPT(dataclasses.replace(cfg, return_hidden=True))
+
+            def loss_fn(p, bs, xb, yb):
+                h = hidden_model.apply({"params": p}, xb)
+                loss = linear_cross_entropy(
+                    h, p["wte"].astype(cfg.dtype), yb).mean()
+                return loss, bs
+        else:
+            def loss_fn(p, bs, xb, yb):
+                logits = model.apply({"params": p}, xb)
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, yb).mean()
+                return loss, bs
     else:
         model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
         variables = model.init(
@@ -454,7 +475,8 @@ def main():
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": n_chips,
         "per_chip_batch": args.batch_size,
-        **({"attention": args.attention, "seq_len": args.seq_len}
+        **({"attention": args.attention, "seq_len": args.seq_len,
+            "lm_loss": args.lm_loss}
            if args.model == "gpt" else {}),
         **({"note": (
             "HBM-roofline bound: profiled device busy time runs at "

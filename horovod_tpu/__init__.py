@@ -108,6 +108,7 @@ from .ops.flash_attention import (  # noqa: F401
     flash_attention,
     flash_ring_attention,
 )
+from .ops.softmax_xent import linear_cross_entropy  # noqa: F401
 from .parallel.optimizer import DistributedOptimizer  # noqa: F401
 from .parallel.sequence import (  # noqa: F401
     dense_attention,

@@ -45,6 +45,11 @@ class GPTConfig:
     seq_axis: str = LOCAL_AXIS        # mesh axis carrying the sequence
     remat: bool = False
     embed_init_std: float = 0.02
+    # Return the final-LayerNorm hidden states [B, T, d_model] instead of
+    # logits — for a fused LM-head loss (ops/softmax_xent.py) that never
+    # materializes the [N, vocab] logits. Parameters are identical either
+    # way (wte is created for the embedding lookup regardless).
+    return_hidden: bool = False
 
 
 class _Attention(nn.Module):
@@ -154,6 +159,8 @@ class GPT(nn.Module):
         for i in range(cfg.num_layers):
             x = block(cfg, name=f"h{i}")(x)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if cfg.return_hidden:
+            return x
         # Tied embedding head. Inputs in the compute dtype (bf16 feeds the
         # MXU at full rate — the fp32 head matmul is ~18% of model FLOPs at
         # half throughput), accumulation and logits in fp32 for a stable

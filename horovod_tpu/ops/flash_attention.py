@@ -73,6 +73,28 @@ def _out_struct(shape, dtype, *operands):
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
+def _harmonize_vma(*arrays):
+    """pcast every array to the union of the group's varying-manual-axes.
+
+    Inside ``shard_map``, kernel operands must agree on vma (standard XLA
+    primitives get automatic ``pvary`` insertion; pallas kernel jaxprs do
+    not). The pcast is a type-level broadcast — free forward, and its
+    transpose is the psum a replicated operand's cotangent needs anyway
+    (identical to what autodiff inserts for the dense formulation).
+    No-op outside shard_map."""
+    from .collective_ops import _vma
+
+    union = frozenset().union(*[_vma(a) for a in arrays])
+    if not union:
+        return arrays
+    out = []
+    for a in arrays:
+        missing = tuple(sorted(union - _vma(a)))
+        out.append(jax.lax.pcast(a, missing, to="varying") if missing
+                   else a)
+    return tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
@@ -669,5 +691,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
         return dense_attention(q, k, v, causal=causal, scale=scale)
     scale = float(scale) if scale is not None else D ** -0.5
 
-    o = _flash(_pack(q), _pack(k), _pack(v), scale, causal, bq, bk)
+    qp, kp, vp = _harmonize_vma(_pack(q), _pack(k), _pack(v))
+    o = _flash(qp, kp, vp, scale, causal, bq, bk)
     return jnp.transpose(o.reshape(B, H, Tq, D), (0, 2, 1, 3))
