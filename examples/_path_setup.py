@@ -1,0 +1,8 @@
+"""Make `import horovod_tpu` work from a source checkout: the launcher
+spawns `python examples/<name>.py`, whose sys.path[0] is examples/, not
+the repo root. Imported for its side effect."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

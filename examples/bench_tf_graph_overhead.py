@@ -10,6 +10,8 @@ latency for each path and payload size; rank 0 prints a JSON summary.
     python examples/bench_tf_graph_overhead.py
 """
 
+import _path_setup  # noqa: F401  (repo-root import shim)
+
 import json
 import os
 import socket

@@ -8,6 +8,8 @@ host churn; state rolls back to the last commit):
     hvdrun -np 2 --min-np 2 -H localhost:2 python examples/elastic_jax.py
 """
 
+import _path_setup  # noqa: F401  (repo-root import shim)
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

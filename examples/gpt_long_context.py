@@ -8,15 +8,23 @@ SURVEY §5.7); this demonstrates the TPU build's long-context flagship:
   the dense path cannot even allocate its score tensor — at seq 8192,
   batch 2, 12 heads, dense attention needs B*H*T^2 fp32 = 6.4 GB *per
   layer* for the scores alone; flash streams them through VMEM.
-* ``--attention ring``: sequence parallelism — shards the sequence over
-  the mesh (`ppermute` ring over ICI) so per-chip memory is O(T/n). Run
-  on the 8-device CPU mesh to see an 8-way sequence shard:
+* ``--attention ring`` / ``--attention flash_ring``: sequence
+  parallelism — shards the sequence over the mesh (`ppermute` ring over
+  ICI) so per-chip memory is O(T/n); ``flash_ring`` runs the Pallas
+  flash kernel at every ring step (scores stay in VMEM too). Run on the
+  8-device CPU mesh to see an 8-way sequence shard:
 
       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
           python examples/gpt_long_context.py --attention ring --platform cpu
 
+  (On the CPU mesh the Pallas kernels run in interpreter mode — an
+  emulator. For ``flash_ring`` there, shrink the model:
+  ``--layers 2 --seq-len 256 --steps 2``. Real speed needs real chips.)
+
 Single real chip: `python examples/gpt_long_context.py` (flash, seq 8192).
 """
+
+import _path_setup  # noqa: F401  (repo-root import shim)
 
 import argparse
 
@@ -29,9 +37,11 @@ from jax.sharding import PartitionSpec as P
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--attention", choices=["flash", "ring", "dense"],
+    ap.add_argument("--attention",
+                    choices=["flash", "ring", "flash_ring", "dense"],
                     default="flash")
     ap.add_argument("--seq-len", type=int, default=8192)
+    ap.add_argument("--layers", type=int, default=12)
     ap.add_argument("--batch-size", type=int, default=2,
                     help="global batch (sequences)")
     ap.add_argument("--steps", type=int, default=10)
@@ -50,7 +60,7 @@ def main():
     print(f"world {hvd.size()} mesh={mesh.devices.shape} "
           f"attention={args.attention} seq={args.seq_len}")
 
-    cfg = GPTConfig(vocab_size=8192, num_layers=12, num_heads=12,
+    cfg = GPTConfig(vocab_size=8192, num_layers=args.layers, num_heads=12,
                     d_model=768, d_ff=3072, max_seq_len=args.seq_len,
                     attention=args.attention, seq_axis=hvd.HVD_AXES,
                     remat=True)
@@ -62,9 +72,10 @@ def main():
     x = jnp.asarray(toks[:, :-1])
     y = jnp.asarray(toks[:, 1:])
 
-    # Ring attention shards the SEQUENCE over the mesh; flash/dense shard
-    # the batch (plain DP).
-    data_spec = (P(None, hvd.HVD_AXES) if args.attention == "ring"
+    # Ring modes shard the SEQUENCE over the mesh; flash/dense shard the
+    # batch (plain DP).
+    data_spec = (P(None, hvd.HVD_AXES)
+                 if args.attention in ("ring", "flash_ring")
                  else hvd.data_pspec())
 
     variables = model.init(jax.random.PRNGKey(0), x[:1, :128])

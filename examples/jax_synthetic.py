@@ -8,6 +8,8 @@ Multi-process (one process per TPU host):
     hvdrun -np 2 -H localhost:2 python examples/jax_synthetic.py
 """
 
+import _path_setup  # noqa: F401  (repo-root import shim)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -7,6 +7,8 @@ Run with the launcher (one process per rank):
     hvdrun -np 2 -H localhost:2 python examples/pytorch_synthetic.py
 """
 
+import _path_setup  # noqa: F401  (repo-root import shim)
+
 import os
 
 # Torch here is a host-side framework; force the CPU JAX platform so

@@ -273,11 +273,12 @@ def linear_cross_entropy(x, w, labels, *,
     lab = labels.reshape(N)
     bn, bv = _pick_block(N, block_n), _pick_block(V, block_v)
     if bn is None or bv is None:
+        import optax
+
         logits = jnp.einsum("nc,vc->nv", xf.astype(jnp.float32),
                             w.astype(jnp.float32))
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, lab[:, None], axis=-1)[:, 0]
-        return (lse - tgt).reshape(lead)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, lab).reshape(lead)
     xf, w, lab8 = _harmonize_vma(xf, w, _broadcast8(lab, jnp.int32))
     loss = _linear_xent(xf, w, lab8, bn, bv)
     return loss.reshape(lead)
