@@ -116,6 +116,12 @@ from .parallel.sequence import (  # noqa: F401
     ulysses_attention,
 )
 from .parallel.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from .parallel.tensor import (  # noqa: F401
+    tp_merge_params,
+    tp_shard_params,
+    tp_split_params,
+    tp_unshard_params,
+)
 from .parallel.tape import (  # noqa: F401
     DistributedGradientTape,
     allreduce_gradients,

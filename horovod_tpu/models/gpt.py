@@ -24,12 +24,19 @@ offset by the chip's shard index automatically.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
+from jax import lax
 
 from ..common.basics import LOCAL_AXIS
 from ..parallel import sequence as seqpar
+
+
+def _tp_size(cfg) -> int:
+    """Bound size of the tensor-parallel axis (1 outside shard_map)."""
+    return seqpar._axis_size(cfg.tp_axis) if cfg.tp_axis else 1
 
 
 @dataclass(frozen=True)
@@ -45,6 +52,15 @@ class GPTConfig:
     seq_axis: str = LOCAL_AXIS        # mesh axis carrying the sequence
     remat: bool = False
     embed_init_std: float = 0.02
+    # Megatron-style tensor parallelism: when set and bound inside
+    # shard_map, attention heads and d_ff shard over this mesh axis —
+    # qkv/fc1 are column-parallel (local output slices), proj/fc2 are
+    # row-parallel (partial sums combined by one psum per block half).
+    # Parameters must be the LOCAL shards; see
+    # horovod_tpu.parallel.tensor.tp_shard_params for slicing a dense
+    # checkpoint. Composes with DP on the other axis (and with the
+    # non-ring attention modes).
+    tp_axis: Optional[str] = None
     # Return the final-LayerNorm hidden states [B, T, d_model] instead of
     # logits — for a fused LM-head loss (ops/softmax_xent.py) that never
     # materializes the [N, vocab] logits. Parameters are identical either
@@ -59,9 +75,29 @@ class _Attention(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         B, T, C = x.shape
-        H = cfg.num_heads
-        D = C // H
-        qkv = nn.Dense(3 * C, dtype=cfg.dtype, name="qkv",
+        tp = _tp_size(cfg)
+        if cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by "
+                f"tp axis size {tp}")
+        if tp > 1 and cfg.attention in ("ring", "flash_ring", "ulysses"):
+            tp_axes = ({cfg.tp_axis} if isinstance(cfg.tp_axis, str)
+                       else set(cfg.tp_axis))
+            seq_axes = ({cfg.seq_axis} if isinstance(cfg.seq_axis, str)
+                        else set(cfg.seq_axis))
+            if tp_axes & seq_axes:
+                # Same mesh axis cannot carry both head shards and
+                # sequence shards — the ring would rotate k/v between
+                # ranks holding DIFFERENT heads and silently produce
+                # garbage. Distinct axes (e.g. tp=local, seq=cross)
+                # compose fine.
+                raise ValueError(
+                    f"tp_axis {cfg.tp_axis!r} overlaps seq_axis "
+                    f"{cfg.seq_axis!r} under attention="
+                    f"{cfg.attention!r}; use disjoint mesh axes")
+        H = cfg.num_heads // tp   # local heads (column-parallel qkv)
+        D = C // cfg.num_heads
+        qkv = nn.Dense(3 * H * D, dtype=cfg.dtype, name="qkv",
                        kernel_init=nn.initializers.normal(0.02))(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, D)
@@ -92,10 +128,14 @@ class _Attention(nn.Module):
             raise ValueError(
                 f"unknown attention {cfg.attention!r}; expected "
                 f"dense | flash | ring | flash_ring | ulysses")
-        out = out.reshape(B, T, C)
-        return nn.Dense(C, dtype=cfg.dtype, name="proj",
-                        kernel_init=nn.initializers.normal(
-                            0.02 / (2 * cfg.num_layers) ** 0.5))(out)
+        out = out.reshape(B, T, H * D)
+        out = nn.Dense(C, dtype=cfg.dtype, name="proj",
+                       kernel_init=nn.initializers.normal(
+                           0.02 / (2 * cfg.num_layers) ** 0.5))(out)
+        # Row-parallel: each rank holds the rows for its heads; partial
+        # results sum across the tp axis (biases are sliced 1/tp so the
+        # psum restores the dense model's single bias).
+        return lax.psum(out, cfg.tp_axis) if tp > 1 else out
 
 
 class _MLP(nn.Module):
@@ -104,12 +144,17 @@ class _MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        x = nn.Dense(cfg.d_ff, dtype=cfg.dtype,
+        tp = _tp_size(cfg)
+        if cfg.d_ff % tp:
+            raise ValueError(
+                f"d_ff {cfg.d_ff} not divisible by tp axis size {tp}")
+        x = nn.Dense(cfg.d_ff // tp, dtype=cfg.dtype,
                      kernel_init=nn.initializers.normal(0.02))(x)
         x = nn.gelu(x)
-        return nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                        kernel_init=nn.initializers.normal(
-                            0.02 / (2 * cfg.num_layers) ** 0.5))(x)
+        x = nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                     kernel_init=nn.initializers.normal(
+                         0.02 / (2 * cfg.num_layers) ** 0.5))(x)
+        return lax.psum(x, cfg.tp_axis) if tp > 1 else x
 
 
 class _Block(nn.Module):

@@ -246,6 +246,14 @@ def allreduce(
         if _is_replicated(compressed, axes_t):
             red = _reduce_replicated(compressed, op, axes_t, _presummed)
         else:
+            # Partially replicated (varying on a strict subset of the
+            # requested axes, e.g. a TP-invariant loss allreduced over the
+            # full DPxTP mesh): pvary the invariant axes so the collective
+            # type-checks — each replicated copy then contributes, exactly
+            # the wire semantics of equal inputs on those ranks.
+            missing = tuple(sorted(set(axes_t) - _vma(compressed)))
+            if missing and _vma(compressed):
+                compressed = lax.pcast(compressed, missing, to="varying")
             if hierarchical is None:
                 hierarchical = (
                     basics.is_initialized()

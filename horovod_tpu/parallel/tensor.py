@@ -1,0 +1,183 @@
+"""Tensor parallelism: Megatron-style sharding helpers for the GPT family.
+
+The reference framework is data-parallel only (SURVEY §2.7); tensor
+parallelism is a TPU-scale extension. The model side lives in
+:mod:`horovod_tpu.models.gpt` (``GPTConfig.tp_axis``): qkv and the first
+MLP projection are column-parallel (each rank computes its own heads /
+d_ff slice), the attention output projection and second MLP projection
+are row-parallel with one ``lax.psum`` over the tp axis per half-block —
+two collectives per layer, the canonical Megatron schedule, riding ICI
+when the tp axis is the intra-host mesh axis.
+
+This module turns a DENSE checkpoint into the matching local shards.
+The shard_map-ready form is the two-tree split — sharded leaves stacked
+with a leading tp dim, replicated leaves kept separate so they stay
+provably replicated (vma-unvarying) inside the mesh program:
+
+    full = GPT(dense_cfg).init(key, tokens)["params"]
+    sharded, replicated = tp_split_params(full, n)
+
+    def spmd(shard_stack, repl, tokens):
+        local = tp_merge_params(
+            jax.tree.map(lambda a: a[0], shard_stack), repl)
+        return GPT(tp_cfg).apply({"params": local}, tokens)
+
+    jax.shard_map(spmd, mesh=mesh,
+                  in_specs=(P(tp_axis), P(), ...), ...)
+
+``tp_shard_params`` (stack everything, one tree) and
+``tp_unshard_params`` (inverse → dense checkpoint) are the offline
+checkpoint utilities. All are exact: the tp model's outputs equal the
+dense model's to float tolerance (tests/test_tensor_parallel.py).
+
+Slicing convention (matching the model's psum placement): column-parallel
+kernels/biases are sliced; row-parallel kernels are sliced on input rows
+and their biases divided by n (the psum then restores the single dense
+bias). Everything else (embeddings, LayerNorms, the tied head) is
+replicated.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _split_cols(a, n, i):
+    return jnp.split(a, n, axis=-1)[i]
+
+
+def _split_rows(a, n, i):
+    return jnp.split(a, n, axis=0)[i]
+
+
+def _qkv_slice(kernel_or_bias, n, i):
+    """qkv columns are [q(all heads) | k | v]: slice heads inside each."""
+    q, k, v = jnp.split(kernel_or_bias, 3, axis=-1)
+    return jnp.concatenate(
+        [_split_cols(q, n, i), _split_cols(k, n, i), _split_cols(v, n, i)],
+        axis=-1)
+
+
+def _qkv_merge(shards):
+    qs, ks, vs = zip(*(jnp.split(s, 3, axis=-1) for s in shards))
+    return jnp.concatenate(
+        [jnp.concatenate(qs, axis=-1),
+         jnp.concatenate(ks, axis=-1),
+         jnp.concatenate(vs, axis=-1)], axis=-1)
+
+
+def _merge_cols(shards):
+    return jnp.concatenate(shards, axis=-1)
+
+
+def _merge_rows(shards):
+    return jnp.concatenate(shards, axis=0)
+
+
+def _psum_bias_slice(leaf, n, i):
+    return leaf / n                    # the model's psum restores it
+
+
+def _psum_bias_merge(shards):
+    return shards[0] * len(shards)
+
+
+# Single source of truth for which GPT parameters shard how; every
+# consumer (split, stack, unshard) derives from this table. First match
+# wins; unmatched leaves are replicated.
+_TP_RULES = (
+    ("attn/qkv", lambda leaf, n, i: _qkv_slice(leaf, n, i), _qkv_merge),
+    ("attn/proj/kernel", _split_rows, _merge_rows),     # row-parallel
+    ("attn/proj/bias", _psum_bias_slice, _psum_bias_merge),
+    ("mlp/Dense_0", lambda leaf, n, i: _split_cols(leaf, n, i),
+     _merge_cols),                                      # column-parallel
+    ("mlp/Dense_1/kernel", _split_rows, _merge_rows),   # row-parallel
+    ("mlp/Dense_1/bias", _psum_bias_slice, _psum_bias_merge),
+)
+
+
+def _rule(name: str):
+    for pattern, shard, unshard in _TP_RULES:
+        if pattern in name:
+            return shard, unshard
+    return None
+
+
+def _shard_one(path, leaf, n, i):
+    name = "/".join(str(getattr(p, "key", p)) for p in path)
+    rule = _rule(name)
+    return rule[0](leaf, n, i) if rule else leaf
+
+
+def tp_shard_params(params, n: int):
+    """Dense GPT params → stacked tp shards (leading dim ``n`` per leaf)."""
+    def stack(path, leaf):
+        return jnp.stack([_shard_one(path, leaf, n, i) for i in range(n)])
+
+    return jax.tree_util.tree_map_with_path(stack, params)
+
+
+def tp_split_params(params, n: int):
+    """Dense GPT params → (sharded, replicated) trees for shard_map.
+
+    ``sharded`` holds only the tp-sharded leaves, stacked with a leading
+    ``n`` dim (pass with ``in_specs=P(tp_axis)``); ``replicated`` holds
+    the rest untouched (pass with ``in_specs=P()`` so they stay
+    vma-unvarying — there is no varying→invariant cast, so fake-stacking
+    replicated leaves would poison every downstream value's vma). Keys
+    absent from one tree live in the other; recombine inside the mesh
+    program with :func:`tp_merge_params`."""
+    def walk(tree, path):
+        sh, rp = {}, {}
+        for key, sub in tree.items():
+            p = f"{path}/{key}" if path else str(key)
+            if isinstance(sub, dict):
+                s, r = walk(sub, p)
+                if s:
+                    sh[key] = s
+                if r:
+                    rp[key] = r
+            else:
+                rule = _rule(p)
+                if rule:
+                    sh[key] = jnp.stack(
+                        [rule[0](sub, n, i) for i in range(n)])
+                else:
+                    rp[key] = sub
+        return sh, rp
+
+    return walk(params, "")
+
+
+def tp_merge_params(sharded_local, replicated):
+    """Recombine the two trees from :func:`tp_split_params` (after taking
+    this rank's shard, e.g. ``jax.tree.map(lambda a: a[0], sharded)``)."""
+    def merge(a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        out = dict(a)
+        for k, v in b.items():
+            out[k] = merge(a.get(k), v) if isinstance(v, dict) else v
+        return out
+
+    return merge(sharded_local, replicated)
+
+
+def tp_unshard_params(stacked):
+    """Invert :func:`tp_shard_params`: stacked shards → dense params."""
+    def merge(path, leaf):
+        name = "/".join(str(getattr(p, "key", p)) for p in path)
+        shards = [leaf[i] for i in range(leaf.shape[0])]
+        rule = _rule(name)
+        if rule:
+            return rule[1](shards)
+        np.testing.assert_allclose(np.asarray(shards[0]),
+                                   np.asarray(shards[-1]))
+        return shards[0]
+
+    return jax.tree_util.tree_map_with_path(merge, stacked)
