@@ -116,6 +116,11 @@ from .parallel.sequence import (  # noqa: F401
     ulysses_attention,
 )
 from .parallel.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from .parallel.expert import (  # noqa: F401
+    SwitchMoE,
+    ep_split_params,
+    switch_moe,
+)
 from .parallel.tensor import (  # noqa: F401
     tp_merge_params,
     tp_shard_params,

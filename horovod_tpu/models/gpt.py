@@ -61,6 +61,15 @@ class GPTConfig:
     # checkpoint. Composes with DP on the other axis (and with the
     # non-ring attention modes).
     tp_axis: Optional[str] = None
+    # Mixture-of-Experts: > 0 replaces every block's dense MLP with a
+    # Switch-MoE FFN of this many (GLOBAL) experts; with ep_axis bound
+    # inside shard_map, experts shard over that mesh axis and tokens are
+    # exchanged by all-to-all (parallel/expert.py). The router's
+    # load-balancing aux losses are sown under
+    # intermediates/.../moe_aux_loss.
+    moe_experts: int = 0
+    ep_axis: Optional[str] = None
+    moe_capacity_factor: float = 1.25
     # Return the final-LayerNorm hidden states [B, T, d_model] instead of
     # logits — for a fused LM-head loss (ops/softmax_xent.py) that never
     # materializes the [N, vocab] logits. Parameters are identical either
@@ -165,8 +174,16 @@ class _Block(nn.Module):
         cfg = self.cfg
         x = x + _Attention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
-        x = x + _MLP(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+        if cfg.moe_experts:
+            from ..parallel.expert import SwitchMoE
+
+            ffn = SwitchMoE(num_experts=cfg.moe_experts, d_ff=cfg.d_ff,
+                            capacity_factor=cfg.moe_capacity_factor,
+                            ep_axis=cfg.ep_axis, dtype=cfg.dtype,
+                            name="moe")
+        else:
+            ffn = _MLP(cfg, name="mlp")
+        x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
         return x
 
 

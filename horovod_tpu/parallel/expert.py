@@ -1,0 +1,162 @@
+"""Expert parallelism: Switch-style Mixture-of-Experts with all-to-all.
+
+The reference framework has no MoE (CNN-era, SURVEY §2.7), but its
+``alltoall`` collective is exactly the EP dispatch primitive — this module
+is the TPU-native layer built on it. Top-1 (Switch) routing with a fixed
+per-expert capacity, compiled entirely into the XLA program:
+
+1. route: ``softmax(x @ router)`` → argmax expert + gate probability;
+2. dispatch: scatter tokens into a static ``[E, capacity, C]`` buffer
+   (position = running count within the chosen expert; overflow tokens
+   are dropped — they ride the residual connection, standard Switch
+   behavior);
+3. exchange: one tiled ``lax.all_to_all`` re-shards the buffer from
+   expert-major [E, cap, C] to ``[E/n, n·cap, C]`` — each rank receives
+   every rank's tokens for ITS experts (the reference's MPI_Alltoallv
+   analogue, riding ICI);
+4. expert FFN: batched einsum over the local experts' weights;
+5. exchange back + combine: tokens return to their source rank and are
+   scaled by the gate (straight-through for the router's gradient).
+
+The load-balancing auxiliary loss (Switch eq. 4: E · Σ_e f_e · P_e) is
+returned alongside; callers add ``aux_weight * aux`` to the task loss.
+
+Everything is static-shaped; outside ``shard_map`` (or with a 1-sized
+axis) the same code runs with all experts local and no collective.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sequence import _axis_size
+
+
+def switch_moe(x, router_kernel, w1, b1, w2, b2, *,
+               axis: Optional[str] = None,
+               capacity_factor: float = 1.25):
+    """Top-1 MoE on flattened tokens ``x`` [N, C].
+
+    ``router_kernel``: [C, E_global]; expert weights carry the LOCAL
+    expert dim: ``w1`` [E_local, C, F], ``b1`` [E_local, F], ``w2``
+    [E_local, F, C], ``b2`` [E_local, C]. ``E_global = E_local · n``
+    where n is the bound size of ``axis``. Returns ``(y [N, C], aux)``.
+    """
+    N, C = x.shape
+    n = _axis_size(axis) if axis else 1
+    E_local = w1.shape[0]
+    E = E_local * n
+    if router_kernel.shape[-1] != E:
+        raise ValueError(
+            f"router has {router_kernel.shape[-1]} experts but "
+            f"E_local {E_local} x axis size {n} = {E}")
+    # Per-expert capacity: every rank contributes N tokens to E experts.
+    capacity = max(1, int(N * capacity_factor / E + 0.9999))
+
+    logits = jnp.einsum("nc,ce->ne", x.astype(jnp.float32),
+                        router_kernel.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                # [N, E]
+    expert = jnp.argmax(probs, axis=-1)                    # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)    # [N, E]
+    # Position of each token within its expert's queue.
+    pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+    keep = pos < capacity                                  # overflow drop
+    pos_c = jnp.minimum(pos, capacity - 1)
+
+    # Switch aux loss: fraction of tokens per expert x mean router prob.
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+
+    dispatch = jnp.zeros((E, capacity, C), x.dtype).at[expert, pos_c].add(
+        jnp.where(keep[:, None], x, 0))
+
+    if n > 1:
+        # [E, cap, C] → [E_local, n·cap, C]: rank r keeps/receives every
+        # rank's buffer rows for ITS local experts.
+        recv = lax.all_to_all(dispatch, axis, split_axis=0, concat_axis=1,
+                              tiled=True)
+    else:
+        recv = dispatch                                    # all local
+
+    h = jnp.einsum("ekc,ecf->ekf", recv, w1) + b1[:, None]
+    h = nn.gelu(h)
+    out = jnp.einsum("ekf,efc->ekc", h, w2) + b2[:, None]
+
+    if n > 1:
+        out = lax.all_to_all(out, axis, split_axis=1, concat_axis=0,
+                             tiled=True)                   # back home
+
+    y = out[expert, pos_c]                                 # [N, C]
+    y = jnp.where(keep[:, None], y, 0) * gate[:, None].astype(y.dtype)
+    return y.astype(x.dtype), aux
+
+
+class SwitchMoE(nn.Module):
+    """Flax module: Switch-MoE FFN (drop-in for a dense MLP block).
+
+    ``num_experts`` is GLOBAL; with ``ep_axis`` bound inside shard_map
+    each rank creates only its ``num_experts / n`` experts' weights (the
+    router is replicated). See ``ep_split_params`` for slicing a dense
+    (world-1) checkpoint into per-rank shards.
+    """
+
+    num_experts: int
+    d_ff: int
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    dtype: jnp.dtype = jnp.float32
+    kernel_init_std: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        n = _axis_size(self.ep_axis) if self.ep_axis else 1
+        if self.num_experts % n:
+            raise ValueError(
+                f"num_experts {self.num_experts} not divisible by "
+                f"ep axis size {n}")
+        e_local = self.num_experts // n
+        init = nn.initializers.normal(self.kernel_init_std)
+        router = self.param("router", init, (C, self.num_experts),
+                            jnp.float32)
+        w1 = self.param("w1", init, (e_local, C, self.d_ff), jnp.float32)
+        b1 = self.param("b1", nn.initializers.zeros, (e_local, self.d_ff),
+                        jnp.float32)
+        w2 = self.param("w2", init, (e_local, self.d_ff, C), jnp.float32)
+        b2 = self.param("b2", nn.initializers.zeros, (e_local, C),
+                        jnp.float32)
+        y, aux = switch_moe(
+            x.reshape(B * T, C),
+            router, w1.astype(self.dtype), b1.astype(self.dtype),
+            w2.astype(self.dtype), b2.astype(self.dtype),
+            axis=self.ep_axis, capacity_factor=self.capacity_factor)
+        self.sow("intermediates", "moe_aux_loss", aux)
+        return y.reshape(B, T, C)
+
+
+def _ep_rule(path: str):
+    """Expert weights live under a SwitchMoE module ('moe' in GPT blocks)
+    — anchor on the module name so unrelated params that happen to be
+    called w1/b1/w2/b2 elsewhere are never mis-sharded."""
+    mod, _, leaf = path.rpartition("/")
+    if leaf in ("w1", "b1", "w2", "b2") and mod.split("/")[-1] == "moe":
+        return lambda a, n, i: jnp.split(a, n, axis=0)[i]
+    return None
+
+
+def ep_split_params(params, n: int):
+    """Dense (world-1) SwitchMoE params → (sharded, replicated) trees,
+    same contract as :func:`horovod_tpu.parallel.tensor.tp_split_params`:
+    expert weights (leading expert dim) are stacked per-rank shards, the
+    router (and everything else) stays in the replicated tree."""
+    from .tensor import split_params_by_rule
+
+    return split_params_by_rule(params, n, _ep_rule)

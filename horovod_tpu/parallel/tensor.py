@@ -120,16 +120,12 @@ def tp_shard_params(params, n: int):
     return jax.tree_util.tree_map_with_path(stack, params)
 
 
-def tp_split_params(params, n: int):
-    """Dense GPT params → (sharded, replicated) trees for shard_map.
-
-    ``sharded`` holds only the tp-sharded leaves, stacked with a leading
-    ``n`` dim (pass with ``in_specs=P(tp_axis)``); ``replicated`` holds
-    the rest untouched (pass with ``in_specs=P()`` so they stay
-    vma-unvarying — there is no varying→invariant cast, so fake-stacking
-    replicated leaves would poison every downstream value's vma). Keys
-    absent from one tree live in the other; recombine inside the mesh
-    program with :func:`tp_merge_params`."""
+def split_params_by_rule(params, n: int, rule):
+    """Generic two-tree splitter: ``rule(path) -> shard_fn | None`` where
+    ``shard_fn(leaf, n, i)`` produces rank ``i``'s shard. Matched leaves
+    are stacked with a leading ``n`` dim into the first tree; everything
+    else goes untouched into the second. The shared walker behind
+    :func:`tp_split_params` and expert parallelism's ``ep_split_params``."""
     def walk(tree, path):
         sh, rp = {}, {}
         for key, sub in tree.items():
@@ -141,15 +137,28 @@ def tp_split_params(params, n: int):
                 if r:
                     rp[key] = r
             else:
-                rule = _rule(p)
-                if rule:
-                    sh[key] = jnp.stack(
-                        [rule[0](sub, n, i) for i in range(n)])
+                fn = rule(p)
+                if fn is not None:
+                    sh[key] = jnp.stack([fn(sub, n, i) for i in range(n)])
                 else:
                     rp[key] = sub
         return sh, rp
 
     return walk(params, "")
+
+
+def tp_split_params(params, n: int):
+    """Dense GPT params → (sharded, replicated) trees for shard_map.
+
+    ``sharded`` holds only the tp-sharded leaves, stacked with a leading
+    ``n`` dim (pass with ``in_specs=P(tp_axis)``); ``replicated`` holds
+    the rest untouched (pass with ``in_specs=P()`` so they stay
+    vma-unvarying — there is no varying→invariant cast, so fake-stacking
+    replicated leaves would poison every downstream value's vma). Keys
+    absent from one tree live in the other; recombine inside the mesh
+    program with :func:`tp_merge_params`."""
+    return split_params_by_rule(
+        params, n, lambda p: (lambda r: r[0] if r else None)(_rule(p)))
 
 
 def tp_merge_params(sharded_local, replicated):
@@ -176,8 +185,11 @@ def tp_unshard_params(stacked):
         rule = _rule(name)
         if rule:
             return rule[1](shards)
-        np.testing.assert_allclose(np.asarray(shards[0]),
-                                   np.asarray(shards[-1]))
+        for i, s in enumerate(shards[1:], 1):
+            if not np.allclose(np.asarray(s), np.asarray(shards[0])):
+                raise ValueError(
+                    f"replicated leaf {name!r} diverges between shard 0 "
+                    f"and shard {i}; checkpoint is inconsistent")
         return shards[0]
 
     return jax.tree_util.tree_map_with_path(merge, stacked)
