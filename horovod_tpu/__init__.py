@@ -121,6 +121,11 @@ from .parallel.expert import (  # noqa: F401
     ep_split_params,
     switch_moe,
 )
+from .parallel.pipeline import (  # noqa: F401
+    gpipe,
+    pipelined_gpt_apply,
+    pp_split_blocks,
+)
 from .parallel.tensor import (  # noqa: F401
     tp_merge_params,
     tp_shard_params,

@@ -1,0 +1,159 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over the mesh.
+
+The reference framework is data-parallel only (SURVEY §2.7); this is the
+TPU-native pipeline layer, built SPMD-style the way XLA wants it: every
+rank runs the SAME program each step — its own stage on whatever
+activation it holds — and activations hop to the next stage over a
+non-cyclic ``lax.ppermute`` (neighbor ICI hop). With M microbatches and
+n stages the schedule is the classic M + n - 1 steps; ranks in the
+fill/drain bubble compute garbage that never reaches an output (masked
+writes), the standard price of an SPMD pipeline.
+
+* :func:`gpipe` — generic: ``stage_fn(stage_params, x)`` applied to a
+  [M, ...] microbatch array, returns the [M, ...] outputs REPLICATED on
+  every rank (the last stage's results are broadcast by a masked psum).
+  Fully differentiable: the backward pass replays the schedule with
+  transposed ppermutes — exactly the GPipe backward.
+* :func:`pp_split_blocks` — slices a dense GPT checkpoint into stacked
+  per-stage block parameters (+ the replicated embedding/head tree).
+* :func:`pipelined_gpt_apply` — the GPT assembly: embedding and LM head
+  are computed replicated on every rank (cheap), the transformer stack
+  runs through the pipeline.
+
+Exact vs the dense model (tests/test_pipeline_parallel.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sequence import _axis_size
+
+
+def gpipe(stage_fn, stage_params, x_mbs, *, axis):
+    """Run microbatches [M, ...] through n pipeline stages over ``axis``.
+
+    ``stage_fn(stage_params, x)`` maps one microbatch through THIS rank's
+    stage (same shapes in and out). Returns [M, ...] outputs of the full
+    pipeline, identical on every rank.
+    """
+    n = _axis_size(axis)
+    if n == 1:
+        return jax.vmap(lambda x: stage_fn(stage_params, x))(x_mbs)
+    r = lax.axis_index(axis)
+    M = x_mbs.shape[0]
+    steps = M + n - 1
+    shift = [(i, i + 1) for i in range(n - 1)]   # non-cyclic: 0→1→...→n-1
+
+    def body(carry, t):
+        state, outputs = carry
+        # Stage 0 injects microbatch t; later stages consume the incoming
+        # activation from their left neighbor.
+        mb_in = x_mbs[jnp.clip(t, 0, M - 1)]
+        x = jnp.where(r == 0, mb_in, state)
+        y = stage_fn(stage_params, x)
+        # The last stage finishes microbatch t - (n - 1); write it (only
+        # there, only when valid — other ranks contribute zeros so a
+        # final psum broadcasts the real values).
+        out_idx = t - (n - 1)
+        valid = jnp.logical_and(r == n - 1, out_idx >= 0)
+        write = jnp.where(valid, y, 0).astype(outputs.dtype)
+        idx = jnp.clip(out_idx, 0, M - 1)
+        outputs = outputs.at[idx].set(
+            jnp.where(valid, write, outputs[idx]))
+        # Hop to the next stage (rank n-1's output leaves the ring; rank
+        # 0 receives zeros it never reads).
+        state = lax.ppermute(y, axis, shift)
+        return (state, outputs), None
+
+    # Scan carries become varying over the pipeline axis (per-rank stages
+    # and the masked writes); the fresh zero inits must match.
+    from ..ops.collective_ops import _vma
+
+    ring = {axis} if isinstance(axis, str) else set(axis)
+    axes_t = tuple(sorted(
+        ring | _vma(x_mbs)
+        | frozenset().union(*[_vma(l) for l in
+                              jax.tree.leaves(stage_params)])))
+    state0 = lax.pcast(jnp.zeros_like(x_mbs[0]), axes_t, to="varying")
+    outputs0 = lax.pcast(jnp.zeros(x_mbs.shape, x_mbs.dtype), axes_t,
+                         to="varying")
+    (_, outputs), _ = lax.scan(body, (state0, outputs0),
+                               jnp.arange(steps))
+    # Only the last stage holds real outputs; the masked psum replicates
+    # them everywhere (all other ranks contributed zeros).
+    return lax.psum(outputs, axis)
+
+
+def pp_split_blocks(params, n: int):
+    """Dense GPT params → (stages, rest).
+
+    ``stages``: for each transformer-block leaf ``h{i}/...`` a stacked
+    array [n, L/n, ...] — stage r holds blocks [r·L/n, (r+1)·L/n); pass
+    through shard_map with ``in_specs=P(pp_axis)`` and squeeze the
+    leading dim. ``rest``: embedding/final-LN (replicated, ``P()``).
+    """
+    blocks = sorted((k for k in params if k.startswith("h")),
+                    key=lambda k: int(k[1:]))
+    L = len(blocks)
+    if L % n:
+        raise ValueError(f"{L} blocks not divisible by {n} stages")
+    per = L // n
+
+    def stack_stage_leaves(*leaves):
+        # leaves: the same param across all L blocks, in order.
+        return jnp.stack(
+            [jnp.stack(leaves[s * per:(s + 1) * per]) for s in range(n)])
+
+    stages = jax.tree.map(stack_stage_leaves,
+                          *[params[b] for b in blocks])
+    rest = {k: v for k, v in params.items() if not k.startswith("h")}
+    return stages, rest
+
+
+def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
+                        num_microbatches: int):
+    """Forward a GPT through the pipeline. Inside shard_map: ``tokens``
+    [B, T] replicated over ``axis``, ``stage_params`` this rank's stacked
+    [L/n, ...] block tree, ``rest`` the replicated embedding/head tree.
+    Returns logits [B, T, vocab] (replicated over ``axis``)."""
+    import flax.linen as nn
+
+    from ..models.gpt import _Block
+
+    B, T = tokens.shape
+    if B % num_microbatches:
+        raise ValueError(
+            f"batch {B} not divisible by {num_microbatches} microbatches")
+    if T > cfg.max_seq_len:
+        # Same guard as GPT.__call__: jit gathers clamp out-of-bounds
+        # indices, which would silently reuse the last positional
+        # embedding.
+        raise ValueError(f"sequence length {T} exceeds "
+                         f"max_seq_len={cfg.max_seq_len}")
+    if cfg.moe_experts:
+        raise ValueError(
+            "pipelined_gpt_apply does not support MoE blocks: the "
+            "router's sown aux loss cannot be returned through the "
+            "pipeline stages (apply the MoE model under DP/EP instead)")
+    wte, wpe = rest["wte"], rest["wpe"]
+    x = (wte[tokens] + wpe[jnp.arange(T)][None]).astype(cfg.dtype)
+    x_mbs = x.reshape(num_microbatches, B // num_microbatches, T, -1)
+
+    block = _Block(cfg)
+
+    def stage_fn(stacked, h):
+        def one(h, bp):
+            return block.apply({"params": bp}, h), None
+
+        h, _ = lax.scan(one, h, stacked)
+        return h
+
+    h = gpipe(stage_fn, stage_params, x_mbs, axis=axis)
+    h = h.reshape(B, T, -1)
+    ln = nn.LayerNorm(dtype=cfg.dtype)
+    h = ln.apply({"params": rest["ln_f"]}, h)
+    return jnp.einsum("btc,vc->btv", h, wte.astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
