@@ -231,6 +231,10 @@ def main():
     ap.add_argument("--num-batches-per-iter", type=int, default=10)
     ap.add_argument("--fp16-allreduce", action="store_true",
                     help="bf16 wire compression (reference flag name kept)")
+    ap.add_argument("--space-to-depth", action="store_true",
+                    help="resnet50: MLPerf-style folded stem (4x4/1 conv "
+                         "on 2x2-blocked input instead of 7x7/2 on 3 "
+                         "channels — full MXU channel utilization)")
     ap.add_argument("--profile", metavar="DIR", default=None,
                     help="capture a jax.profiler trace of one timing iter "
                          "into DIR and print the top device ops")
@@ -300,7 +304,8 @@ def main():
                     logits, yb).mean()
                 return loss, bs
     else:
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                         space_to_depth=args.space_to_depth)
         variables = model.init(
             rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16), train=False)
         params, batch_stats = variables["params"], variables["batch_stats"]

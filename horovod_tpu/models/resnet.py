@@ -82,6 +82,14 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     act: Callable = nn.relu
     sync_batch_norm: bool = False
+    # MLPerf-style stem: fold 2x2 spatial blocks into channels and run a
+    # 4x4/1 conv instead of the 7x7/2 conv. A 3-channel 7x7 stem pads its
+    # contraction dim to the MXU's 8 lanes (~3/8 utilization); the folded
+    # stem contracts over 4*4*12 = 192 channels at full tile utilization.
+    # Same receptive field and output shape (modulo the SAME-padding
+    # alignment, one pixel at the border) — a standard benchmark-legal
+    # model variant, off by default.
+    space_to_depth: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -101,7 +109,19 @@ class ResNet(nn.Module):
                                      dtype=self.dtype)
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.space_to_depth:
+            n, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth needs even spatial dims, got {h}x{w}")
+            x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                n, h // 2, w // 2, 4 * c)
+            x = conv(self.num_filters, (4, 4), (1, 1),
+                     name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

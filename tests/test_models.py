@@ -31,6 +31,30 @@ def test_resnet18_forward_small():
     assert out.shape == (2, 10)
 
 
+def test_resnet_space_to_depth_stem():
+    """Folded stem: same output shape, 4x4x12 stem kernel, odd spatial
+    dims rejected."""
+    import pytest
+
+    from horovod_tpu.models.resnet import ResNet18
+
+    model = ResNet18(num_classes=10, dtype=jnp.float32,
+                     space_to_depth=True)
+    x = jnp.zeros((2, 32, 32, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert variables["params"]["conv_init"]["kernel"].shape == (4, 4, 12, 64)
+    out, _ = model.apply(variables, x, train=False,
+                         mutable=["batch_stats"])
+    ref = ResNet18(num_classes=10, dtype=jnp.float32)
+    rv = ref.init(jax.random.PRNGKey(0), x, train=False)
+    ref_out, _ = ref.apply(rv, x, train=False, mutable=["batch_stats"])
+    assert out.shape == ref_out.shape
+
+    with pytest.raises(ValueError, match="even spatial"):
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, 33, 33, 3)),
+                   train=False)
+
+
 def test_resnet50_param_count():
     # ~25.6M params is the well-known ResNet-50 size; catches structural bugs.
     model = ResNet50(num_classes=1000, dtype=jnp.float32)
