@@ -98,6 +98,15 @@ def _vma(x) -> frozenset:
         return frozenset()
 
 
+def pvary_missing(x, axes) -> "jax.Array":
+    """Cast ``x`` to be varying over whichever of ``axes`` it is not
+    already varying over (a free type-level broadcast; no-op when none
+    are missing). The single home for this idiom — used by the gradient
+    tape, the Pallas kernel wrappers, and the pipeline scan inits."""
+    missing = tuple(a for a in axes if a not in _vma(x))
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
 def _is_replicated(x, axes: Tuple[str, ...]) -> bool:
     return not (set(axes) & _vma(x))
 

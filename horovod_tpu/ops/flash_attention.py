@@ -98,17 +98,13 @@ def _harmonize_vma(*arrays):
     transpose is the psum a replicated operand's cotangent needs anyway
     (identical to what autodiff inserts for the dense formulation).
     No-op outside shard_map."""
-    from .collective_ops import _vma
+    from .collective_ops import _vma, pvary_missing
 
     union = frozenset().union(*[_vma(a) for a in arrays])
     if not union:
         return arrays
-    out = []
-    for a in arrays:
-        missing = tuple(sorted(union - _vma(a)))
-        out.append(jax.lax.pcast(a, missing, to="varying") if missing
-                   else a)
-    return tuple(out)
+    axes = tuple(sorted(union))
+    return tuple(pvary_missing(a, axes) for a in arrays)
 
 
 # ---------------------------------------------------------------------------

@@ -69,17 +69,18 @@ def gpipe(stage_fn, stage_params, x_mbs, *, axis):
         return (state, outputs), None
 
     # Scan carries become varying over the pipeline axis (per-rank stages
-    # and the masked writes); the fresh zero inits must match.
-    from ..ops.collective_ops import _vma
+    # and the masked writes); the fresh zero inits must match. pcast only
+    # the axes a value does not already vary over (zeros_like inherits
+    # e.g. a data-parallel batch axis from x_mbs).
+    from ..ops.collective_ops import _vma, pvary_missing
 
     ring = {axis} if isinstance(axis, str) else set(axis)
     axes_t = tuple(sorted(
         ring | _vma(x_mbs)
         | frozenset().union(*[_vma(l) for l in
                               jax.tree.leaves(stage_params)])))
-    state0 = lax.pcast(jnp.zeros_like(x_mbs[0]), axes_t, to="varying")
-    outputs0 = lax.pcast(jnp.zeros(x_mbs.shape, x_mbs.dtype), axes_t,
-                         to="varying")
+    state0 = pvary_missing(jnp.zeros_like(x_mbs[0]), axes_t)
+    outputs0 = pvary_missing(jnp.zeros(x_mbs.shape, x_mbs.dtype), axes_t)
     (_, outputs), _ = lax.scan(body, (state0, outputs0),
                                jnp.arange(steps))
     # Only the last stage holds real outputs; the masked psum replicates

@@ -34,12 +34,7 @@ from ..ops.compression import Compression
 def _pvary_tree(tree, axes_t):
     """Cast every leaf to be varying over ``axes_t`` so autodiff produces
     local (un-psummed) gradients for it."""
-
-    def one(x):
-        missing = tuple(a for a in axes_t if a not in C._vma(x))
-        return lax.pcast(x, missing, to="varying") if missing else x
-
-    return jax.tree.map(one, tree)
+    return jax.tree.map(lambda x: C.pvary_missing(x, axes_t), tree)
 
 
 def allreduce_gradients(
