@@ -16,14 +16,27 @@ Prints ONE JSON line:
    "vs_baseline": <ratio, resnet50 only — null for gpt>, "mfu": <frac>,
    "platform": "tpu", ...}
 
+Scaling mode (the north-star metric, docs/benchmarks.rst:13-43): pass
+``--scaling 1,2,4,8`` to run the SAME weak-scaling step (fixed per-chip
+batch, global batch = B*N) over growing device-subset meshes and report
+per-chip throughput plus efficiency vs the smallest world. The JSON line
+then carries ``{model}_scaling_efficiency_{maxN}chip`` with the full
+per-world table, and ``vs_baseline`` compares against the reference's
+published 90% 512-GPU scaling figure (docs/benchmarks.rst:13-14). The
+sweep runs unchanged on a v5e pod the day one is attached; today it is
+smoke-tested on the 8-device virtual CPU mesh
+(``--platform cpu --cpu-devices 8 --model resnet18 ...`` — MFU is omitted
+on CPU automatically). ``--chips N`` restricts any single run to the
+first N visible chips.
+
 Methodology (round 3): per-chip batch 128, median-step throughput/MFU,
 timing blocks on every step output, donated state buffers, optional
 ``--profile`` device-trace capture with a category/bytes roofline summary,
 optional ``--steps-per-call`` host-loop offload. See README.md
 "Benchmark methodology" for the profile-backed roofline analysis.
 
-``vs_baseline`` compares against 103.55 images/sec/device — the only
-absolute per-device throughput published in the reference:
+``vs_baseline`` (single-run mode) compares against 103.55 images/sec/device
+— the only absolute per-device throughput published in the reference:
 tf_cnn_benchmarks ResNet-101, batch 64, 1656.82 images/sec on 16 Pascal
 GPUs (docs/benchmarks.rst:27-43) → 103.55/GPU. BASELINE.json publishes no
 chip-level numbers (`published: {}`), so that figure is the anchor. Because a
@@ -143,7 +156,33 @@ def init_backend():
     return devices, "cpu"
 
 
+def force_cpu_backend(n_devices: int):
+    """Deterministic CPU bring-up for smoke tests: n virtual CPU devices,
+    never touching (or waiting on) an accelerator backend. Same recipe as
+    ``__graft_entry__.dryrun_multichip`` — works even when the site has
+    preinitialized a TPU client."""
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        xla_bridge._clear_backends()
+        xla_bridge.get_backend.cache_clear()
+    except Exception as e:
+        log(f"backend force-reset unavailable ({e}); relying on config")
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n_devices)
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        raise SystemExit(
+            f"--platform cpu asked for {n_devices} devices, got "
+            f"{len(devices)}; set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n_devices}")
+    return devices, "cpu"
+
+
 BASELINE_IMG_PER_SEC_PER_DEVICE = 1656.82 / 16  # docs/benchmarks.rst:27-43
+BASELINE_SCALING_EFFICIENCY = 0.90  # docs/benchmarks.rst:13-14 (512 GPUs)
 
 
 def summarize_profile(log_dir: str, top: int = 15) -> None:
@@ -194,65 +233,12 @@ def summarize_profile(log_dir: str, top: int = 15) -> None:
         log(f"  {us / 1e3:9.2f} ms  {100 * us / max(total, 1):5.1f}%  {name}")
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--model", choices=["resnet50", "gpt"],
-                    default="resnet50",
-                    help="resnet50 = the reference's headline benchmark "
-                         "(HBM-bound on TPU); gpt = GPT-124M, matmul-"
-                         "dominated, shows the framework's MFU ceiling "
-                         "without ResNet's bandwidth wall")
-    ap.add_argument("--batch-size", type=int, default=None,
-                    help="per-chip batch size (default: 128 images for "
-                         "resnet50 — reference convention is 64, "
-                         "docs/benchmarks.rst:27-43, 128 keeps the MXU "
-                         "fed on v5e; 8 sequences for gpt)")
-    ap.add_argument("--seq-len", type=int, default=1024,
-                    help="sequence length for --model gpt")
-    ap.add_argument("--gpt-scale", choices=["124m", "350m"],
-                    default="124m",
-                    help="GPT size: 124m (12L/768d) or 350m (24L/1024d)")
-    ap.add_argument("--attention", choices=["flash", "dense"],
-                    default="flash",
-                    help="GPT attention path: flash = Pallas kernel "
-                         "(no [T,T] HBM round-trip), dense = reference "
-                         "einsum attention")
-    ap.add_argument("--lm-loss", choices=["fused", "dense"],
-                    default="dense",
-                    help="GPT LM-head loss: dense = einsum head + optax "
-                         "xent (fastest at vocab 32k — XLA's fused "
-                         "matmul+xent is already near-roofline); fused = "
-                         "Pallas linear cross-entropy, the [N, vocab] "
-                         "logits never touch HBM (the memory-scalable "
-                         "path for larger vocab/batch; ~2.5% slower here)")
-    ap.add_argument("--num-warmup", type=int, default=5)
-    ap.add_argument("--num-iters", type=int, default=10,
-                    help="timing rounds (reference: 10)")
-    ap.add_argument("--num-batches-per-iter", type=int, default=10)
-    ap.add_argument("--fp16-allreduce", action="store_true",
-                    help="bf16 wire compression (reference flag name kept)")
-    ap.add_argument("--space-to-depth", action="store_true",
-                    help="resnet50: MLPerf-style folded stem (4x4/1 conv "
-                         "on 2x2-blocked input instead of 7x7/2 on 3 "
-                         "channels — full MXU channel utilization)")
-    ap.add_argument("--profile", metavar="DIR", default=None,
-                    help="capture a jax.profiler trace of one timing iter "
-                         "into DIR and print the top device ops")
-    ap.add_argument("--steps-per-call", type=int, default=1,
-                    help="run K train steps per device call via lax.scan "
-                         "(host-loop offload; hides per-dispatch latency)")
-    args = ap.parse_args()
-    if args.batch_size is None:
-        args.batch_size = 128 if args.model == "resnet50" else 8
-    if args.steps_per_call < 1:
-        ap.error("--steps-per-call must be >= 1")
-    if args.profile and args.num_iters < 2:
-        ap.error("--profile needs --num-iters >= 2 (the profiled iter is "
-                 "excluded from the reported stats)")
-    profile_iter = min(1, args.num_iters - 1)
-
-    devices, platform = init_backend()
-
+def run_once(args, devices, platform):
+    """One full measurement on ``devices``: init the world, build the
+    model + DistributedOptimizer step, compile, warm up, time, and return
+    the result row (no JSON printing — the caller owns the one-line
+    contract). Calls ``hvd.shutdown()`` first so scaling sweeps can re-init
+    over growing device subsets."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -260,13 +246,12 @@ def main():
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     import horovod_tpu as hvd
-    from horovod_tpu.models import ResNet50
 
+    hvd.shutdown()  # no-op unless a previous sweep world is up
     hvd.init(devices=devices)
     n_chips = hvd.size()
     global_batch = args.batch_size * n_chips
-    log(f"devices: {devices}  platform={platform}  world={n_chips}  "
-        f"global_batch={global_batch}")
+    log(f"world={n_chips} global_batch={global_batch} platform={platform}")
 
     rng = jax.random.PRNGKey(0)
     if args.model == "gpt":
@@ -275,7 +260,7 @@ def main():
         shape = (dict(num_layers=12, num_heads=12, d_model=768, d_ff=3072)
                  if args.gpt_scale == "124m" else
                  dict(num_layers=24, num_heads=16, d_model=1024, d_ff=4096))
-        cfg = GPTConfig(vocab_size=32000, max_seq_len=args.seq_len,
+        cfg = GPTConfig(vocab_size=args.vocab_size, max_seq_len=args.seq_len,
                         attention=args.attention, **shape)
         model = GPT(cfg)
         variables = model.init(rng, jnp.zeros((1, args.seq_len), jnp.int32))
@@ -304,12 +289,17 @@ def main():
                     logits, yb).mean()
                 return loss, bs
     else:
-        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
-                         space_to_depth=args.space_to_depth)
+        from horovod_tpu.models import ResNet18, ResNet50
+
+        resnet_cls = ResNet50 if args.model == "resnet50" else ResNet18
+        kw = ({"space_to_depth": args.space_to_depth}
+              if args.model == "resnet50" else {})
+        side = args.image_size
+        model = resnet_cls(num_classes=1000, dtype=jnp.bfloat16, **kw)
         variables = model.init(
-            rng, jnp.zeros((1, 224, 224, 3), jnp.bfloat16), train=False)
+            rng, jnp.zeros((1, side, side, 3), jnp.bfloat16), train=False)
         params, batch_stats = variables["params"], variables["batch_stats"]
-        images = jnp.asarray(np.random.randn(global_batch, 224, 224, 3),
+        images = jnp.asarray(np.random.randn(global_batch, side, side, 3),
                              jnp.bfloat16)
         labels = jnp.asarray(np.random.randint(0, 1000, global_batch))
 
@@ -378,7 +368,7 @@ def main():
     lowered = train_step.lower(params, batch_stats, opt_state, images, labels)
     compiled = lowered.compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
-    # Model FLOPs for MFU. ResNet-50: XLA cost analysis on the compiled
+    # Model FLOPs for MFU. ResNets: XLA cost analysis on the compiled
     # step (analytic fallback ~4.09 GFLOP fwd/image x 3 for fwd+bwd). GPT:
     # ALWAYS the standard analytic count — 6*N matmul FLOPs/token plus the
     # causal attention term 6*L*T*d (the causal-halved convention, as in
@@ -396,7 +386,10 @@ def main():
         items_per_step = global_batch * args.seq_len
         flops = analytic_per_item * items_per_step / n_chips
     else:
-        analytic_per_item = 3.0 * 4.089e9
+        # fwd-pass GFLOP/image at 224x224, x3 for fwd+bwd, scaled by the
+        # conv-dominated quadratic dependence on image side.
+        base = 4.089e9 if args.model == "resnet50" else 1.82e9
+        analytic_per_item = 3.0 * base * (args.image_size / 224.0) ** 2
         items_per_step = global_batch
         flops = step_flops_per_chip(
             compiled, items_per_step * args.steps_per_call,
@@ -417,10 +410,11 @@ def main():
     log(f"warmup ({args.num_warmup} steps): "
         f"{time.perf_counter() - t0:.1f}s  loss={float(loss):.3f}")
 
+    profile_iter = min(1, args.num_iters - 1) if args.profile else None
     img_secs = []
     step_times = []
     for i in range(args.num_iters):
-        if args.profile and i == profile_iter:
+        if i == profile_iter:
             jax.profiler.start_trace(args.profile)
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
@@ -430,7 +424,7 @@ def main():
         dt = time.perf_counter() - t0
         steps = args.num_batches_per_iter * args.steps_per_call
         rate = items_per_step * steps / dt
-        if args.profile and i == profile_iter:
+        if i == profile_iter:
             jax.profiler.stop_trace()
             # Tracing inflates the iter; keep it out of the reported stats.
             log(f"iter {i}: {rate:.1f} {item_unit}/s total "
@@ -463,26 +457,187 @@ def main():
             f"{median_step * 1e3:.2f} ms, min {min(step_times) * 1e3:.2f} ms, "
             f"peak {peak / 1e12:.0f} TFLOP/s/chip)")
 
-    metric = (f"gpt{args.gpt_scale}_tokens_per_sec_per_chip"
-              if args.model == "gpt"
-              else "resnet50_images_per_sec_per_chip")
+    return {
+        "per_chip": per_chip,
+        "unit": unit,
+        "mfu": mfu,
+        "step_ms_median": median_step * 1e3,
+        "step_ms_min": min(step_times) * 1e3,
+        "chips": n_chips,
+        "global_batch": global_batch,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", choices=["resnet50", "resnet18", "gpt"],
+                    default="resnet50",
+                    help="resnet50 = the reference's headline benchmark "
+                         "(HBM-bound on TPU); resnet18 = small CNN for "
+                         "CPU-mesh smoke runs; gpt = GPT-124M, matmul-"
+                         "dominated, shows the framework's MFU ceiling "
+                         "without ResNet's bandwidth wall")
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="per-chip batch size (default: 128 images for "
+                         "resnet50/18 — reference convention is 64, "
+                         "docs/benchmarks.rst:27-43, 128 keeps the MXU "
+                         "fed on v5e; 8 sequences for gpt)")
+    ap.add_argument("--image-size", type=int, default=224,
+                    help="square image side for resnet models (small "
+                         "values speed up CPU smoke runs)")
+    ap.add_argument("--seq-len", type=int, default=1024,
+                    help="sequence length for --model gpt")
+    ap.add_argument("--vocab-size", type=int, default=32000,
+                    help="GPT vocabulary size (the fused-vs-dense LM loss "
+                         "crossover depends on it)")
+    ap.add_argument("--gpt-scale", choices=["124m", "350m"],
+                    default="124m",
+                    help="GPT size: 124m (12L/768d) or 350m (24L/1024d)")
+    ap.add_argument("--attention", choices=["flash", "dense"],
+                    default="flash",
+                    help="GPT attention path: flash = Pallas kernel "
+                         "(no [T,T] HBM round-trip), dense = reference "
+                         "einsum attention")
+    ap.add_argument("--lm-loss", choices=["fused", "dense"],
+                    default="dense",
+                    help="GPT LM-head loss: dense = einsum head + optax "
+                         "xent (fastest at vocab 32k — XLA's fused "
+                         "matmul+xent is already near-roofline); fused = "
+                         "Pallas linear cross-entropy, the [N, vocab] "
+                         "logits never touch HBM (the memory-scalable "
+                         "path for larger vocab/batch)")
+    ap.add_argument("--chips", type=int, default=None,
+                    help="run on the first N visible chips only "
+                         "(default: all visible chips)")
+    ap.add_argument("--scaling", default=None, metavar="N1,N2,...",
+                    help="weak-scaling sweep: run the same per-chip batch "
+                         "over each world size (e.g. 1,2,4,8) and report "
+                         "per-chip efficiency vs the smallest; the JSON "
+                         "line becomes the scaling-efficiency metric")
+    ap.add_argument("--platform", choices=["auto", "cpu"], default="auto",
+                    help="auto = robust TPU bring-up with CPU fallback; "
+                         "cpu = force an N-virtual-device CPU mesh "
+                         "(--cpu-devices) for smoke-testing the scaling "
+                         "sweep without pod hardware")
+    ap.add_argument("--cpu-devices", type=int, default=8,
+                    help="virtual device count for --platform cpu")
+    ap.add_argument("--num-warmup", type=int, default=5)
+    ap.add_argument("--num-iters", type=int, default=10,
+                    help="timing rounds (reference: 10)")
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--fp16-allreduce", action="store_true",
+                    help="bf16 wire compression (reference flag name kept)")
+    ap.add_argument("--space-to-depth", action="store_true",
+                    help="resnet50: MLPerf-style folded stem (4x4/1 conv "
+                         "on 2x2-blocked input instead of 7x7/2 on 3 "
+                         "channels — full MXU channel utilization)")
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a jax.profiler trace of one timing iter "
+                         "into DIR and print the top device ops")
+    ap.add_argument("--steps-per-call", type=int, default=1,
+                    help="run K train steps per device call via lax.scan "
+                         "(host-loop offload; hides per-dispatch latency)")
+    args = ap.parse_args()
+    if args.batch_size is None:
+        args.batch_size = 8 if args.model == "gpt" else 128
+    if args.steps_per_call < 1:
+        ap.error("--steps-per-call must be >= 1")
+    if args.profile and args.num_iters < 2:
+        ap.error("--profile needs --num-iters >= 2 (the profiled iter is "
+                 "excluded from the reported stats)")
+
+    sweep = None
+    if args.scaling:
+        try:
+            sweep = sorted({int(x) for x in args.scaling.split(",")})
+        except ValueError:
+            ap.error(f"--scaling expects comma-separated ints, "
+                     f"got {args.scaling!r}")
+        if not sweep or sweep[0] < 1:
+            ap.error("--scaling sizes must be >= 1")
+
+    if args.platform == "cpu":
+        want = max(sweep) if sweep else (args.chips or args.cpu_devices)
+        devices, platform = force_cpu_backend(max(want, args.cpu_devices))
+    else:
+        devices, platform = init_backend()
+    if args.chips is not None:
+        if args.chips < 1:
+            ap.error("--chips must be >= 1")
+        if args.chips > len(devices):
+            raise SystemExit(f"--chips {args.chips} > {len(devices)} "
+                             f"visible devices")
+        devices = devices[:args.chips]
+
+    metric_stem = (f"gpt{args.gpt_scale}" if args.model == "gpt"
+                   else args.model)
+    gpt_fields = ({"attention": args.attention, "seq_len": args.seq_len,
+                   "lm_loss": args.lm_loss, "vocab_size": args.vocab_size}
+                  if args.model == "gpt" else {})
+
+    if sweep:
+        if sweep[-1] > len(devices):
+            raise SystemExit(f"--scaling max {sweep[-1]} > {len(devices)} "
+                             f"visible devices")
+        rows = []
+        for n in sweep:
+            log(f"=== scaling sweep: world {n} ===")
+            rows.append(run_once(args, devices[:n], platform))
+        base = rows[0]
+        for row in rows:
+            row["efficiency"] = row["per_chip"] / base["per_chip"]
+        log(f"-- weak scaling ({metric_stem}, per-chip batch "
+            f"{args.batch_size}, base world {base['chips']}) --")
+        log(f"  {'chips':>6} {'per-chip':>12} {'total':>12} "
+            f"{'efficiency':>10}")
+        for row in rows:
+            log(f"  {row['chips']:>6} {row['per_chip']:>12.1f} "
+                f"{row['per_chip'] * row['chips']:>12.1f} "
+                f"{row['efficiency']:>10.3f}")
+        final = rows[-1]
+        print(json.dumps({
+            "metric": f"{metric_stem}_scaling_efficiency_"
+                      f"{final['chips']}chip",
+            "value": round(final["efficiency"], 4),
+            "unit": "fraction",
+            # Reference's published scaling anchor: 90% at 512 GPUs
+            # (docs/benchmarks.rst:13-14).
+            "vs_baseline": round(
+                final["efficiency"] / BASELINE_SCALING_EFFICIENCY, 3),
+            "per_chip_base": round(base["per_chip"], 2),
+            "per_chip_final": round(final["per_chip"], 2),
+            "throughput_unit": base["unit"],
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "per_chip_batch": args.batch_size,
+            "table": [{"chips": r["chips"],
+                       "per_chip": round(r["per_chip"], 2),
+                       "efficiency": round(r["efficiency"], 4),
+                       "mfu": (round(r["mfu"], 4)
+                               if r["mfu"] is not None else None)}
+                      for r in rows],
+            **gpt_fields,
+        }), flush=True)
+        return
+
+    res = run_once(args, devices, platform)
+    metric = (f"{metric_stem}_tokens_per_sec_per_chip" if args.model == "gpt"
+              else f"{metric_stem}_images_per_sec_per_chip")
     print(json.dumps({
         "metric": metric,
-        "value": round(per_chip, 2),
-        "unit": unit,
+        "value": round(res["per_chip"], 2),
+        "unit": res["unit"],
         "vs_baseline": (
-            round(per_chip / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
+            round(res["per_chip"] / BASELINE_IMG_PER_SEC_PER_DEVICE, 3)
             if args.model == "resnet50" else None),
-        "mfu": round(mfu, 4) if mfu is not None else None,
-        "step_ms_median": round(median_step * 1e3, 3),
-        "step_ms_min": round(min(step_times) * 1e3, 3),
+        "mfu": round(res["mfu"], 4) if res["mfu"] is not None else None,
+        "step_ms_median": round(res["step_ms_median"], 3),
+        "step_ms_min": round(res["step_ms_min"], 3),
         "platform": platform,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
-        "chips": n_chips,
+        "chips": res["chips"],
         "per_chip_batch": args.batch_size,
-        **({"attention": args.attention, "seq_len": args.seq_len,
-            "lm_loss": args.lm_loss}
-           if args.model == "gpt" else {}),
+        **gpt_fields,
         **({"note": (
             "HBM-roofline bound: profiled device busy time runs at "
             "~peak effective bandwidth (conv+BN fusions 780-940 GB/s "

@@ -105,6 +105,8 @@ class DistributedOptimizer(mx.optimizer.Optimizer):
     # Everything not overridden below — lr/wd schedules, param dicts,
     # serialization — is the wrapped optimizer's business.
     def __getattr__(self, item):
+        if item == "_base":  # pre-__init__ probes (deepcopy/unpickle)
+            raise AttributeError(item)
         return getattr(self._base, item)
 
     def _sync_gradients(self, index, grad) -> None:

@@ -1,0 +1,66 @@
+"""bench.py weak-scaling sweep (--scaling): the north-star harness.
+
+The reference's headline metric is scaling efficiency 1->N workers
+(docs/benchmarks.rst:13-43, produced by running the synthetic benchmark
+under ``horovodrun -np N``); here one process sweeps growing device-subset
+meshes. On shared-host virtual CPU devices the efficiency *number* is
+meaningless (the "chips" contend for the same cores) — these tests verify
+the harness: the sweep runs, the world re-inits per size, the efficiency
+table is emitted, and the JSON contract holds. The identical command with
+``--platform auto`` is the pod run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run_bench(*extra, timeout=560):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # bench sets its own virtual-device count
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--platform", "cpu", "--model", "resnet18",
+         "--image-size", "32", "--batch-size", "2", "--num-warmup", "1",
+         "--num-iters", "1", "--num-batches-per-iter", "1", *extra],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return json.loads(proc.stdout.strip().splitlines()[-1]), proc.stderr
+
+
+class TestScalingSweep:
+    def test_sweep_emits_efficiency_table(self):
+        res, err = _run_bench("--cpu-devices", "2", "--scaling", "1,2")
+        assert res["metric"] == "resnet18_scaling_efficiency_2chip"
+        assert res["unit"] == "fraction"
+        assert [r["chips"] for r in res["table"]] == [1, 2]
+        assert res["table"][0]["efficiency"] == 1.0
+        assert res["value"] == res["table"][-1]["efficiency"] > 0
+        # vs_baseline anchors on the reference's published 90% figure
+        assert abs(res["vs_baseline"] - res["value"] / 0.90) < 2e-3
+        # MFU must be omitted on CPU, not fabricated
+        assert all(r["mfu"] is None for r in res["table"])
+        assert "weak scaling" in err
+
+    def test_chips_subset_single_run(self):
+        res, _ = _run_bench("--cpu-devices", "2", "--chips", "1")
+        assert res["metric"] == "resnet18_images_per_sec_per_chip"
+        assert res["chips"] == 1
+        assert res["platform"] == "cpu"
+        assert res["mfu"] is None
+
+    def test_scaling_rejects_bad_spec(self):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        for bad in ("1,two", "0,2"):
+            proc = subprocess.run(
+                [sys.executable, BENCH, "--platform", "cpu",
+                 "--scaling", bad],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert proc.returncode != 0, bad
+            assert "--scaling" in proc.stderr, proc.stderr[-500:]
