@@ -187,8 +187,19 @@ def init(
                 and cfg.controller != "none"):
             from .. import cc
 
+            port_cb = _controller_port_callback[0]
+            from ..runner import bootstrap
+
+            if bootstrap.bootstrap_requested():
+                # Static-launch KV protocol (runner/bootstrap.py): rank 0
+                # binds port 0 and publishes; other ranks resolve the
+                # controller address from the KV before native init.
+                rank = int(os.environ.get("HOROVOD_RANK", "0"))
+                cb = bootstrap.apply(rank)
+                if cb is not None:
+                    port_cb = cb
             _state.controller = cc.CoreContext(
-                bound_port_callback=_controller_port_callback[0])
+                bound_port_callback=port_cb)
             if _state.process_count == 1:
                 # Process-world mode (no jax.distributed): each worker
                 # process is one Horovod rank, exactly the reference's

@@ -9,14 +9,12 @@ func shipped via KV store, executed by run_task.py).
 from __future__ import annotations
 
 import os
-import socket
 import sys
 from typing import Any, Callable, List, Optional
 
 from .hosts import get_host_assignments, parse_host_files, parse_hosts
 from .http_server import KVStoreServer
 from .launch import run_commandline  # noqa: F401
-from .network import find_free_port
 from .static_run import launch_static
 
 
@@ -68,12 +66,9 @@ def run(func: Callable[..., Any],
 
     # The KV store lives in THIS (driver) process — workers must dial back
     # here, not the first worker host.
-    from .static_run import is_local_host
+    from .static_run import rendezvous_advertise_addr
 
-    if all(is_local_host(s.hostname) for s in slots):
-        addr = "127.0.0.1"
-    else:
-        addr = socket.getfqdn()
+    addr = rendezvous_advertise_addr(slots)
     command = [sys.executable, "-m", "horovod_tpu.runner.run_task",
                addr, str(kv_port)]
     base_env = dict(env if env is not None else os.environ)
@@ -81,7 +76,9 @@ def run(func: Callable[..., Any],
     base_env["HOROVOD_KV_TOKEN"] = token
 
     try:
-        launch_static(command, slots, controller_port=find_free_port(),
+        # controller_port=None → KV bootstrap through this server
+        # (rank 0 binds and reports; no launcher-side port guess).
+        launch_static(command, slots, controller_port=None,
                       rendezvous_port=kv_port, env=base_env, verbose=verbose)
         results: List[Any] = []
         import pickle

@@ -1,0 +1,151 @@
+"""Static-launch controller bootstrap over the rendezvous KV.
+
+Reference surface: ``horovod/runner/driver/driver_service.py`` +
+``launch.py:546`` — the reference's *static* launcher also runs interface
+discovery and a driver/task address-exchange protocol before workers form
+the ring; only its elastic path differs in packaging.
+
+TPU redesign (round 4, unifying static onto the proven elastic protocol,
+elastic/driver.py:255-303): the launcher no longer guesses a controller
+port with ``find_free_port()`` on *its* host — a guess that can collide on
+the rank-0 host and hands out ``slots[0].hostname`` even when workers
+cannot resolve it. Instead:
+
+1. the launcher injects ``HOROVOD_CONTROLLER_BOOTSTRAP=kv`` plus the
+   rendezvous KV coordinates (``HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT`` —
+   the launcher's own KV server) and NO controller address;
+2. rank 0 binds an OS-assigned port on ITS host
+   (``HOROVOD_CONTROLLER_PORT=0`` → native ``Listen(0)``) and, the moment
+   the listener is up (bound-port watcher, cc/__init__.py), publishes
+   ``{hostname, port, ifaces}`` into the KV;
+3. every other rank polls the KV, then picks rank-0's address on an
+   interface common to both hosts (``nic.select_controller_addr``,
+   pairwise — the same intersection the elastic driver computes), falling
+   back to the published hostname only when there is no usable
+   intersection.
+
+Port allocation happens on the host that uses it (race-free by
+construction), and address selection uses routable-interface evidence
+rather than the hostname-resolves-everywhere assumption.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from typing import Optional
+
+_SCOPE = "controller"
+_KEY = "static"
+
+# Per-process bootstrap generation. shutdown()+init() re-forms the world:
+# every rank runs apply() again, in lockstep, so per-process counters
+# agree — and keying the KV entry by generation keeps a re-init's workers
+# from dialing the PREVIOUS incarnation's dead listener (the static
+# analogue of the elastic driver's world_id-versioned port report,
+# elastic/driver.py set_controller_port).
+_generation = [0]
+
+
+def _gen_key() -> str:
+    return f"{_KEY}.{_generation[0]}"
+
+
+def _kv_coords():
+    return (os.environ["HOROVOD_GLOO_RENDEZVOUS_ADDR"],
+            int(os.environ["HOROVOD_GLOO_RENDEZVOUS_PORT"]))
+
+
+def bootstrap_requested() -> bool:
+    return os.environ.get("HOROVOD_CONTROLLER_BOOTSTRAP") == "kv"
+
+
+def publish_controller(port: int, key: Optional[str] = None) -> None:
+    """Rank 0: publish the bound controller port plus this host's identity
+    and interface table for the workers' pairwise NIC intersection."""
+    from . import nic
+    from .http_server import put_data_into_kvstore
+
+    addr, kv_port = _kv_coords()
+    try:
+        ifaces = nic.list_interfaces()
+    except OSError:
+        ifaces = []
+    payload = json.dumps({
+        "hostname": socket.gethostname(),
+        "port": int(port),
+        "ifaces": [[name, ip] for name, ip in ifaces],
+    })
+    put_data_into_kvstore(addr, kv_port, _SCOPE, key or _gen_key(),
+                          payload.encode())
+
+
+def resolve_controller(timeout: Optional[float] = None) -> None:
+    """Non-zero ranks: poll the KV for rank 0's report, select a routable
+    address, and write the resolved ``HOROVOD_CONTROLLER_ADDR/PORT`` into
+    the environment for the native core to consume."""
+    from . import nic
+    from .http_server import read_data_from_kvstore
+    from .static_run import is_local_host
+
+    import urllib.error
+
+    if timeout is None:
+        timeout = float(os.environ.get("HOROVOD_BOOTSTRAP_TIMEOUT", "300"))
+    addr, kv_port = _kv_coords()
+    deadline = time.monotonic() + timeout
+    key = _gen_key()
+    while True:
+        try:
+            raw = read_data_from_kvstore(addr, kv_port, _SCOPE, key)
+        except urllib.error.HTTPError as e:
+            if e.code != 404:  # 404 = not reported yet; keep polling
+                raise
+            raw = None
+        except urllib.error.URLError:
+            raw = None  # KV server not reachable yet
+        if raw:
+            break
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"rank 0 did not report its controller port within "
+                f"{timeout:.0f}s (HOROVOD_BOOTSTRAP_TIMEOUT); the rank-0 "
+                f"worker may have failed to start")
+        time.sleep(0.1)
+    info = json.loads(raw)
+    rank0_host = info["hostname"]
+    local = is_local_host(rank0_host) or rank0_host == socket.gethostname()
+    rank0_ifaces = [(n, a) for n, a in info.get("ifaces", [])]
+    controller_addr = None
+    if rank0_ifaces:
+        try:
+            mine = nic.list_interfaces()
+        except OSError:
+            mine = []
+        if mine:
+            controller_addr = nic.select_controller_addr(
+                rank0_ifaces,
+                {rank0_host: rank0_ifaces, "__self__": mine},
+                allow=nic.iface_filter_from_env(),
+                allow_loopback=local)
+    if controller_addr is None:
+        controller_addr = "127.0.0.1" if local else rank0_host
+    os.environ["HOROVOD_CONTROLLER_ADDR"] = controller_addr
+    os.environ["HOROVOD_CONTROLLER_PORT"] = str(info["port"])
+
+
+def apply(rank: int):
+    """Run the side of the protocol this rank plays. Returns the
+    bound-port callback rank 0 must register before native init (None for
+    other ranks, whose env is fully resolved on return). Each call is a
+    new generation (see ``_generation``)."""
+    _generation[0] += 1
+    if rank == 0:
+        os.environ["HOROVOD_CONTROLLER_PORT"] = "0"
+        os.environ.setdefault("HOROVOD_CONTROLLER_ADDR", "127.0.0.1")
+        key = _gen_key()
+        return lambda port: publish_controller(port, key=key)
+    resolve_controller()
+    return None

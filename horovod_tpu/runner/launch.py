@@ -30,7 +30,6 @@ from typing import List, Optional
 from . import config_parser
 from .hosts import get_host_assignments, parse_host_files, parse_hosts
 from .http_server import RendezvousServer
-from .network import find_free_port
 from .static_run import launch_static
 
 
@@ -184,8 +183,11 @@ def _run_static(args) -> None:
     rendezvous_port = rendezvous.start_server()
     rendezvous.init(slots)
     try:
+        # controller_port=None → KV bootstrap: rank 0 binds its own port
+        # and reports it through this rendezvous server (no launcher-side
+        # free-port guess; runner/bootstrap.py).
         launch_static(args.command, slots,
-                      controller_port=find_free_port(),
+                      controller_port=None,
                       rendezvous_port=rendezvous_port,
                       env=env, verbose=args.verbose)
     finally:

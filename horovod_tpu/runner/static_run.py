@@ -5,12 +5,17 @@ assignments, build per-slot commands (local exec or ssh), inject the
 ``HOROVOD_*`` env contract, launch all slots on threads, and fail fast: if
 any worker exits non-zero, terminate the rest (gloo_run.py:221-266).
 
-TPU redesign: instead of a Gloo HTTP rendezvous, workers bootstrap against
-the rank-0 native coordinator at ``HOROVOD_CONTROLLER_ADDR/PORT`` (see
-cc/src/operations.cc) — the launcher picks the port and points every worker
-at the first host. The rendezvous KV server is still started and advertised
-(``HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT``) for launcher-level transport
-(run-func results, elastic identity), mirroring the reference contract.
+TPU redesign: workers bootstrap against the rank-0 native coordinator.
+By default (``controller_port=None``) the launcher does NOT pick the port:
+it advertises its rendezvous KV (``HOROVOD_GLOO_RENDEZVOUS_ADDR/PORT``)
+and sets ``HOROVOD_CONTROLLER_BOOTSTRAP=kv`` so rank 0 binds an
+OS-assigned port on its own host and publishes ``(hostname, ifaces,
+port)`` for the other ranks to resolve (runner/bootstrap.py — the same
+rank-0-binds-and-reports protocol the elastic driver uses,
+elastic/driver.py:255-303; reference analogue: the static launcher's
+driver/task address exchange, driver_service.py). Passing an explicit
+``controller_port`` keeps the legacy fixed-port contract for callers that
+manage their own port space (spark/ray/js_run).
 """
 
 from __future__ import annotations
@@ -33,10 +38,20 @@ def is_local_host(hostname: str) -> bool:
                         socket.getfqdn())
 
 
-def slot_env(slot: SlotInfo, controller_addr: str, controller_port: int,
+def slot_env(slot: SlotInfo, controller_addr: Optional[str],
+             controller_port: Optional[int],
              rendezvous_port: Optional[int] = None,
+             rendezvous_addr: Optional[str] = None,
              base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
-    """The launcher-injected env contract (reference gloo_run.py:65-76)."""
+    """The launcher-injected env contract (reference gloo_run.py:65-76).
+
+    ``controller_port=None`` selects the KV bootstrap protocol: rank 0
+    binds and publishes its own port (runner/bootstrap.py) instead of the
+    launcher dictating one. ``rendezvous_addr`` is the address of the
+    launcher's KV server as reachable from this slot's host — NOT the
+    rank-0 worker host (they differ in general; conflating them was the
+    round-3 flaw).
+    """
     env = dict(base_env if base_env is not None else os.environ)
     env.update({
         "HOROVOD_RANK": str(slot.rank),
@@ -46,11 +61,24 @@ def slot_env(slot: SlotInfo, controller_addr: str, controller_port: int,
         "HOROVOD_CROSS_RANK": str(slot.cross_rank),
         "HOROVOD_CROSS_SIZE": str(slot.cross_size),
         "HOROVOD_HOSTNAME": slot.hostname,
-        "HOROVOD_CONTROLLER_ADDR": controller_addr,
-        "HOROVOD_CONTROLLER_PORT": str(controller_port),
     })
+    if controller_port is None:
+        if rendezvous_port is None:
+            raise ValueError("KV bootstrap (controller_port=None) needs a "
+                             "running rendezvous server")
+        env["HOROVOD_CONTROLLER_BOOTSTRAP"] = "kv"
+        env.pop("HOROVOD_CONTROLLER_ADDR", None)
+        env.pop("HOROVOD_CONTROLLER_PORT", None)
+    else:
+        # Symmetric strip: a nested launch from inside a kv-bootstrapped
+        # worker must not let the inherited flag override the explicit
+        # port contract.
+        env.pop("HOROVOD_CONTROLLER_BOOTSTRAP", None)
+        env["HOROVOD_CONTROLLER_ADDR"] = controller_addr
+        env["HOROVOD_CONTROLLER_PORT"] = str(controller_port)
     if rendezvous_port is not None:
-        env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = controller_addr
+        env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = \
+            rendezvous_addr if rendezvous_addr is not None else controller_addr
         env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(rendezvous_port)
     return env
 
@@ -74,8 +102,17 @@ def get_run_command(command: Sequence[str], hostname: str,
     return f"{SSH_COMMAND_PREFIX} {hostname} {shlex.quote(remote)}"
 
 
+def rendezvous_advertise_addr(slots: List[SlotInfo]) -> str:
+    """The launcher's own address as workers should dial it: loopback when
+    every slot is local, this host's FQDN otherwise (the KV server binds
+    INADDR_ANY)."""
+    if all(is_local_host(s.hostname) for s in slots):
+        return "127.0.0.1"
+    return socket.getfqdn()
+
+
 def launch_static(command: Sequence[str], slots: List[SlotInfo],
-                  controller_port: int,
+                  controller_port: Optional[int] = None,
                   rendezvous_port: Optional[int] = None,
                   env: Optional[Dict[str, str]] = None,
                   verbose: int = 0,
@@ -84,12 +121,20 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
     (reference launch_gloo, gloo_run.py:221-266).
 
     The coordinator (native rank-0 controller) runs inside the rank-0
-    worker; all workers get its address. Raises RuntimeError listing failed
-    ranks if any worker exits non-zero.
+    worker. With ``controller_port=None`` (default) its address/port reach
+    the other workers through the KV bootstrap protocol (module
+    docstring); an explicit port reverts to launcher-dictated addressing.
+    Raises RuntimeError listing failed ranks if any worker exits non-zero.
     """
+    if controller_port is None and rendezvous_port is None:
+        # Validate HERE, not in the per-slot threads (where a raise is
+        # swallowed and the launch would silently no-op).
+        raise ValueError("KV bootstrap (controller_port=None) needs a "
+                         "running rendezvous server (rendezvous_port)")
     controller_addr = slots[0].hostname
     if is_local_host(controller_addr):
         controller_addr = "127.0.0.1"
+    rdv_addr = rendezvous_advertise_addr(slots)
 
     abort = threading.Event()
     exit_codes: Dict[int, int] = {}
@@ -97,7 +142,8 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
 
     def _run_slot(slot: SlotInfo) -> None:
         senv = slot_env(slot, controller_addr, controller_port,
-                        rendezvous_port, base_env=env)
+                        rendezvous_port, rendezvous_addr=rdv_addr,
+                        base_env=env)
         cmd = get_run_command(command, slot.hostname, senv)
         if verbose >= 2:
             print(f"[launcher] rank {slot.rank} on {slot.hostname}: {cmd}",

@@ -255,6 +255,138 @@ print(f"OK rank={{hvd.rank()}} size={{hvd.size()}}")
 """
 
 
+class TestKVBootstrap:
+    """The static controller bootstrap (runner/bootstrap.py): rank 0 binds
+    its own port and publishes (hostname, ifaces, port); workers resolve a
+    routable address by NIC intersection. Reference analogue:
+    driver_service.py's interface exchange for static runs."""
+
+    @pytest.fixture()
+    def kv(self, monkeypatch):
+        server = KVStoreServer(auth_token=None)
+        port = server.start_server()
+        monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_GLOO_RENDEZVOUS_PORT", str(port))
+        monkeypatch.delenv("HOROVOD_KV_TOKEN", raising=False)
+        monkeypatch.delenv("HOROVOD_CONTROLLER_ADDR", raising=False)
+        monkeypatch.delenv("HOROVOD_CONTROLLER_PORT", raising=False)
+        yield server
+        server.shutdown_server()
+
+    def test_worker_uses_reported_port_and_nic_intersection(
+            self, kv, monkeypatch):
+        """The worker's controller coordinates are exactly what rank 0
+        reported — any port the launcher might have believed free is
+        irrelevant (the round-2/3 flaw: find_free_port() on the launcher
+        host can disagree with the rank-0 host's port space)."""
+        import json as _json
+
+        from horovod_tpu.runner import bootstrap, nic
+
+        # Emulate a REMOTE rank 0: hostname that doesn't resolve here, a
+        # port nobody on this host could have predicted, two NICs.
+        put_data_into_kvstore(
+            "127.0.0.1", kv.port, "controller", bootstrap._gen_key(),
+            _json.dumps({"hostname": "node-a.cluster.invalid",
+                         "port": 45671,
+                         "ifaces": [["eth1", "10.0.0.7"],
+                                    ["lo", "127.0.0.1"]]}).encode())
+        # This worker shares only eth1 with rank 0.
+        monkeypatch.setattr(
+            nic, "list_interfaces",
+            lambda: [("eth1", "10.0.0.9"), ("docker0", "172.17.0.1"),
+                     ("lo", "127.0.0.1")])
+        bootstrap.resolve_controller(timeout=10)
+        assert os.environ["HOROVOD_CONTROLLER_ADDR"] == "10.0.0.7"
+        assert os.environ["HOROVOD_CONTROLLER_PORT"] == "45671"
+
+    def test_worker_falls_back_to_hostname_without_intersection(
+            self, kv, monkeypatch):
+        import json as _json
+
+        from horovod_tpu.runner import bootstrap, nic
+
+        put_data_into_kvstore(
+            "127.0.0.1", kv.port, "controller", bootstrap._gen_key(),
+            _json.dumps({"hostname": "node-a.cluster.invalid",
+                         "port": 45672,
+                         "ifaces": [["ib0", "192.168.5.1"]]}).encode())
+        monkeypatch.setattr(nic, "list_interfaces",
+                            lambda: [("eth0", "10.0.0.9")])
+        bootstrap.resolve_controller(timeout=10)
+        assert os.environ["HOROVOD_CONTROLLER_ADDR"] == \
+            "node-a.cluster.invalid"
+        assert os.environ["HOROVOD_CONTROLLER_PORT"] == "45672"
+
+    def test_worker_times_out_without_rank0_report(self, kv, monkeypatch):
+        from horovod_tpu.runner import bootstrap
+
+        monkeypatch.setenv("HOROVOD_BOOTSTRAP_TIMEOUT", "0.5")
+        with pytest.raises(TimeoutError, match="rank 0"):
+            bootstrap.resolve_controller()
+
+    def test_rank0_publishes_bound_port(self, kv):
+        from horovod_tpu.runner import bootstrap
+
+        cb = bootstrap.apply(rank=0)
+        assert os.environ["HOROVOD_CONTROLLER_PORT"] == "0"  # Listen(0)
+        cb(43219)  # the native watcher reports the real bound port
+        import json as _json
+        import pickle
+
+        raw = kv.store.get("controller", bootstrap._gen_key())
+        info = _json.loads(pickle.loads(raw))
+        assert info["port"] == 43219
+        assert info["hostname"] == socket.gethostname()
+
+    def test_reinit_ignores_previous_incarnations_report(
+            self, kv, monkeypatch):
+        """shutdown()+init() re-forms the world; workers must not dial the
+        dead listener the previous incarnation published (the static
+        analogue of elastic's world_id-versioned port report)."""
+        import json as _json
+
+        from horovod_tpu.runner import bootstrap
+
+        put_data_into_kvstore(
+            "127.0.0.1", kv.port, "controller", bootstrap._gen_key(),
+            _json.dumps({"hostname": "stale.invalid", "port": 1,
+                         "ifaces": []}).encode())
+        bootstrap.apply(rank=0)  # new generation (rank 1 bumps in lockstep)
+        monkeypatch.setenv("HOROVOD_BOOTSTRAP_TIMEOUT", "0.5")
+        with pytest.raises(TimeoutError):
+            bootstrap.resolve_controller()
+
+    def test_static_launch_never_guesses_controller_ports(
+            self, monkeypatch, tmp_path):
+        """Launcher-side regression guard: the static path must not call
+        find_free_port() for the controller (the guess raced with the
+        rank-0 host's port space). launch.py and runner.run() now pass
+        controller_port=None; any reintroduced guess trips this."""
+        calls = []
+        monkeypatch.setattr(network, "find_free_port",
+                            lambda: calls.append(1) or 1)
+        script = tmp_path / "w.py"
+        script.write_text("import os\n"
+                          "assert os.environ['HOROVOD_CONTROLLER_BOOTSTRAP'"
+                          "] == 'kv'\n"
+                          "assert 'HOROVOD_CONTROLLER_PORT' not in "
+                          "os.environ\n")
+        from horovod_tpu.runner.hosts import (get_host_assignments,
+                                              parse_hosts)
+        from horovod_tpu.runner.static_run import launch_static
+
+        kv = KVStoreServer(auth_token=None)
+        port = kv.start_server()
+        try:
+            slots = get_host_assignments(parse_hosts("localhost:2"), 2)
+            launch_static([sys.executable, str(script)], slots,
+                          rendezvous_port=port)
+        finally:
+            kv.shutdown_server()
+        assert calls == []
+
+
 class TestEndToEnd:
     def test_cli_static_run(self, tmp_path):
         """hvdrun -np 2 python worker.py — full CLI path (reference:
