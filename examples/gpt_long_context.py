@@ -67,8 +67,8 @@ def main():
     model = GPT(cfg)
 
     rs = np.random.RandomState(0)
-    toks = rs.randint(0, cfg.vocab_size - 1, (args.batch_size,
-                                              args.seq_len + 1))
+    toks = rs.randint(0, cfg.vocab_size, (args.batch_size,
+                                          args.seq_len + 1))
     x = jnp.asarray(toks[:, :-1])
     y = jnp.asarray(toks[:, 1:])
 

@@ -188,12 +188,15 @@ def init(
             from .. import cc
 
             port_cb = _controller_port_callback[0]
-            from ..runner import bootstrap
-
-            if bootstrap.bootstrap_requested():
+            # Env check BEFORE importing runner/: non-bootstrap inits
+            # (elastic, jax.distributed) must not pay the launcher-package
+            # import on this path.
+            if os.environ.get("HOROVOD_CONTROLLER_BOOTSTRAP") == "kv":
                 # Static-launch KV protocol (runner/bootstrap.py): rank 0
                 # binds port 0 and publishes; other ranks resolve the
                 # controller address from the KV before native init.
+                from ..runner import bootstrap
+
                 rank = int(os.environ.get("HOROVOD_RANK", "0"))
                 cb = bootstrap.apply(rank)
                 if cb is not None:

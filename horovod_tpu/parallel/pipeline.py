@@ -139,6 +139,19 @@ def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
             "pipelined_gpt_apply does not support MoE blocks: the "
             "router's sown aux loss cannot be returned through the "
             "pipeline stages (apply the MoE model under DP/EP instead)")
+    if cfg.attention in ("ring", "flash_ring", "ulysses"):
+        seq_axes = ({cfg.seq_axis} if isinstance(cfg.seq_axis, str)
+                    else set(cfg.seq_axis))
+        pp_axes = {axis} if isinstance(axis, str) else set(axis)
+        if seq_axes & pp_axes:
+            # Mirrors the tp/seq overlap guard in models/gpt.py _Attention:
+            # a K/V rotation over the pipeline axis would exchange tensors
+            # between ranks holding DIFFERENT pipeline stages and silently
+            # produce garbage.
+            raise ValueError(
+                f"attention={cfg.attention!r} is sequence-parallel over "
+                f"seq_axis={cfg.seq_axis!r}, which overlaps the pipeline "
+                f"axis {axis!r}; use disjoint mesh axes")
     wte, wpe = rest["wte"], rest["wpe"]
     x = (wte[tokens] + wpe[jnp.arange(T)][None]).astype(cfg.dtype)
     x_mbs = x.reshape(num_microbatches, B // num_microbatches, T, -1)

@@ -152,7 +152,12 @@ def ulysses_attention(q, k, v, *, axis=LOCAL_AXIS, causal: bool = True,
     alltoall bisection bandwidth is high (ICI), which is the TPU case.
 
     ``attn_fn(q, k, v)`` may override the local attention (e.g. a pallas
-    flash kernel); default is :func:`dense_attention`.
+    flash kernel); default is :func:`dense_attention`. CONTRACT: attn_fn
+    must close over the same causal/scale semantics passed to THIS call —
+    it receives only (q, k, v), including on the n == 1 early-return path
+    where it is invoked directly on the unsharded inputs. A mismatch (e.g.
+    ``causal=False`` here but an attn_fn hardcoding ``causal=True``)
+    silently computes the attn_fn's semantics.
     """
     B, T_local, H, D = q.shape
     n = _axis_size(axis)

@@ -74,7 +74,12 @@ def publish_controller(port: int, key: Optional[str] = None) -> None:
     except OSError:
         ifaces = []
     payload = json.dumps({
-        "hostname": socket.gethostname(),
+        # Prefer the launcher-assigned name (slot_env's HOROVOD_HOSTNAME):
+        # ssh already proved it reachable from the launcher, and the
+        # hostfile names are what remote workers can resolve — a bare
+        # gethostname() may be short/misconfigured on clusters.
+        "hostname": os.environ.get("HOROVOD_HOSTNAME",
+                                   socket.gethostname()),
         "port": int(port),
         "ifaces": [[name, ip] for name, ip in ifaces],
     })
@@ -116,7 +121,7 @@ def resolve_controller(timeout: Optional[float] = None) -> None:
         time.sleep(0.1)
     info = json.loads(raw)
     rank0_host = info["hostname"]
-    local = is_local_host(rank0_host) or rank0_host == socket.gethostname()
+    local = is_local_host(rank0_host)
     rank0_ifaces = [(n, a) for n, a in info.get("ifaces", [])]
     controller_addr = None
     if rank0_ifaces:

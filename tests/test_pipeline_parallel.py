@@ -9,6 +9,7 @@ gradients through the scan/ppermute/psum backward.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -73,6 +74,29 @@ class TestPipelinedGPT:
             out_specs=P()))(stages, rest, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    rtol=2e-4, atol=2e-4)
+
+    def test_seq_parallel_attention_overlapping_pp_axis_rejected(self):
+        """A ring/flash_ring/ulysses seq_axis that intersects the pipeline
+        axis would rotate K/V between ranks holding DIFFERENT stages —
+        must raise, mirroring the MoE guard (advisor r3)."""
+        import dataclasses
+
+        cfg, params, tokens = self._setup()
+        stages, rest = pp_split_blocks(params, hvd.size())
+        bad = dataclasses.replace(cfg, attention="ring",
+                                  seq_axis=hvd.HVD_AXES)
+
+        def spmd(stg, rst, tok):
+            local = jax.tree.map(lambda a: a[0], stg)
+            return pipelined_gpt_apply(bad, local, rst, tok,
+                                       axis=hvd.HVD_AXES,
+                                       num_microbatches=2)
+
+        with pytest.raises(ValueError, match="overlaps the pipeline"):
+            jax.jit(jax.shard_map(
+                spmd, mesh=hvd.mesh(),
+                in_specs=(P(hvd.HVD_AXES), P(), P()),
+                out_specs=P()))(stages, rest, tokens)
 
     def test_dp_pp_2d(self):
         """DP over hvd_cross x PP over hvd_local: batch-sharded pipelined

@@ -325,9 +325,10 @@ class TestKVBootstrap:
         with pytest.raises(TimeoutError, match="rank 0"):
             bootstrap.resolve_controller()
 
-    def test_rank0_publishes_bound_port(self, kv):
+    def test_rank0_publishes_bound_port(self, kv, monkeypatch):
         from horovod_tpu.runner import bootstrap
 
+        monkeypatch.delenv("HOROVOD_HOSTNAME", raising=False)
         cb = bootstrap.apply(rank=0)
         assert os.environ["HOROVOD_CONTROLLER_PORT"] == "0"  # Listen(0)
         cb(43219)  # the native watcher reports the real bound port
