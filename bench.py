@@ -270,17 +270,18 @@ def run_once(args, devices, platform):
         labels = jnp.asarray(np.random.randint(
             0, cfg.vocab_size, (global_batch, args.seq_len)))
 
-        if args.lm_loss == "fused":
+        if args.lm_loss in ("fused", "auto"):
             import dataclasses
 
-            from horovod_tpu.ops.softmax_xent import linear_cross_entropy
+            from horovod_tpu.ops.softmax_xent import lm_head_loss
 
             hidden_model = GPT(dataclasses.replace(cfg, return_hidden=True))
+            head_mode = args.lm_loss
 
             def loss_fn(p, bs, xb, yb):
                 h = hidden_model.apply({"params": p}, xb)
-                loss = linear_cross_entropy(
-                    h, p["wte"].astype(cfg.dtype), yb).mean()
+                loss = lm_head_loss(h, p["wte"].astype(cfg.dtype), yb,
+                                    mode=head_mode).mean()
                 return loss, bs
         else:
             def loss_fn(p, bs, xb, yb):
@@ -498,14 +499,14 @@ def main():
                     help="GPT attention path: flash = Pallas kernel "
                          "(no [T,T] HBM round-trip), dense = reference "
                          "einsum attention")
-    ap.add_argument("--lm-loss", choices=["fused", "dense"],
-                    default="dense",
-                    help="GPT LM-head loss: dense = einsum head + optax "
-                         "xent (fastest at vocab 32k — XLA's fused "
-                         "matmul+xent is already near-roofline); fused = "
-                         "Pallas linear cross-entropy, the [N, vocab] "
-                         "logits never touch HBM (the memory-scalable "
-                         "path for larger vocab/batch)")
+    ap.add_argument("--lm-loss", choices=["auto", "fused", "dense"],
+                    default="auto",
+                    help="GPT LM-head loss. auto (default) = dense while "
+                         "the step's fp32 logits fit the measured HBM "
+                         "budget, fused beyond (lm_head_loss dispatch — "
+                         "dense measured faster at EVERY vocab that "
+                         "compiles on v5e, fused extends the envelope); "
+                         "dense / fused force a path")
     ap.add_argument("--chips", type=int, default=None,
                     help="run on the first N visible chips only "
                          "(default: all visible chips)")

@@ -108,7 +108,10 @@ from .ops.flash_attention import (  # noqa: F401
     flash_attention,
     flash_ring_attention,
 )
-from .ops.softmax_xent import linear_cross_entropy  # noqa: F401
+from .ops.softmax_xent import (  # noqa: F401
+    linear_cross_entropy,
+    lm_head_loss,
+)
 from .parallel.optimizer import DistributedOptimizer  # noqa: F401
 from .parallel.sequence import (  # noqa: F401
     dense_attention,
@@ -124,6 +127,7 @@ from .parallel.expert import (  # noqa: F401
 from .parallel.pipeline import (  # noqa: F401
     gpipe,
     pipelined_gpt_apply,
+    pipelined_gpt_loss,
     pp_split_blocks,
 )
 from .parallel.tensor import (  # noqa: F401

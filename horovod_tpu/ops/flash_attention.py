@@ -65,7 +65,7 @@ def _block_knob(name: str, default: int) -> int:
     v = _env_int(name, default)
     if v < 128:
         raise ValueError(
-            f"{name}={v}: flash-attention blocks must be >= 128 "
+            f"{name}={v}: Pallas kernel blocks must be >= 128 "
             f"(MXU/lane tile)")
     return v
 

@@ -17,8 +17,12 @@ writes), the standard price of an SPMD pipeline.
 * :func:`pp_split_blocks` — slices a dense GPT checkpoint into stacked
   per-stage block parameters (+ the replicated embedding/head tree).
 * :func:`pipelined_gpt_apply` — the GPT assembly: embedding and LM head
-  are computed replicated on every rank (cheap), the transformer stack
-  runs through the pipeline.
+  are computed replicated on every rank, the transformer stack runs
+  through the pipeline (inference / logits consumers).
+* :func:`pipelined_gpt_loss` — the TRAINING assembly: the LM head (the
+  dominant [B, T, vocab] einsum at real scale) is VOCAB-SHARDED over the
+  pipeline ranks with a Megatron-style sharded cross-entropy, so the
+  per-rank head cost is O(1/n) in compute and logits memory.
 
 Exact vs the dense model (tests/test_pipeline_parallel.py).
 """
@@ -114,17 +118,7 @@ def pp_split_blocks(params, n: int):
     return stages, rest
 
 
-def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
-                        num_microbatches: int):
-    """Forward a GPT through the pipeline. Inside shard_map: ``tokens``
-    [B, T] replicated over ``axis``, ``stage_params`` this rank's stacked
-    [L/n, ...] block tree, ``rest`` the replicated embedding/head tree.
-    Returns logits [B, T, vocab] (replicated over ``axis``)."""
-    import flax.linen as nn
-
-    from ..models.gpt import _Block
-
-    B, T = tokens.shape
+def _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis):
     if B % num_microbatches:
         raise ValueError(
             f"batch {B} not divisible by {num_microbatches} microbatches")
@@ -136,7 +130,7 @@ def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
                          f"max_seq_len={cfg.max_seq_len}")
     if cfg.moe_experts:
         raise ValueError(
-            "pipelined_gpt_apply does not support MoE blocks: the "
+            "the pipelined GPT assembly does not support MoE blocks: the "
             "router's sown aux loss cannot be returned through the "
             "pipeline stages (apply the MoE model under DP/EP instead)")
     if cfg.attention in ("ring", "flash_ring", "ulysses"):
@@ -152,6 +146,16 @@ def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
                 f"attention={cfg.attention!r} is sequence-parallel over "
                 f"seq_axis={cfg.seq_axis!r}, which overlaps the pipeline "
                 f"axis {axis!r}; use disjoint mesh axes")
+
+
+def _pipeline_hidden(cfg, stage_params, rest, tokens, *, axis,
+                     num_microbatches):
+    """Embedding + pipelined transformer stack → final hidden [B, T, C]
+    (pre-ln_f), replicated over ``axis``."""
+    from ..models.gpt import _Block
+
+    B, T = tokens.shape
+    _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis)
     wte, wpe = rest["wte"], rest["wpe"]
     x = (wte[tokens] + wpe[jnp.arange(T)][None]).astype(cfg.dtype)
     x_mbs = x.reshape(num_microbatches, B // num_microbatches, T, -1)
@@ -166,8 +170,95 @@ def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
         return h
 
     h = gpipe(stage_fn, stage_params, x_mbs, axis=axis)
-    h = h.reshape(B, T, -1)
+    return h.reshape(B, T, -1)
+
+
+def _head_logits(cfg, rest, h):
+    import flax.linen as nn
+
     ln = nn.LayerNorm(dtype=cfg.dtype)
-    h = ln.apply({"params": rest["ln_f"]}, h)
-    return jnp.einsum("btc,vc->btv", h, wte.astype(cfg.dtype),
+    hn = ln.apply({"params": rest["ln_f"]}, h)
+    return jnp.einsum("btc,vc->btv", hn, rest["wte"].astype(cfg.dtype),
                       preferred_element_type=jnp.float32)
+
+
+def pipelined_gpt_apply(cfg, stage_params, rest, tokens, *, axis,
+                        num_microbatches: int):
+    """Forward a GPT through the pipeline. Inside shard_map: ``tokens``
+    [B, T] replicated over ``axis``, ``stage_params`` this rank's stacked
+    [L/n, ...] block tree, ``rest`` the replicated embedding/head tree.
+    Returns logits [B, T, vocab] (replicated over ``axis``).
+
+    Every rank computes the full [B, T, vocab] head einsum on the
+    replicated hidden states; for training prefer
+    :func:`pipelined_gpt_loss`, which vocab-shards the head across the
+    pipeline ranks (per-rank head compute and logits memory O(1/n); the
+    [B, T, C] hidden broadcast remains)."""
+    h = _pipeline_hidden(cfg, stage_params, rest, tokens, axis=axis,
+                         num_microbatches=num_microbatches)
+    return _head_logits(cfg, rest, h)
+
+
+def pipelined_gpt_loss(cfg, stage_params, rest, tokens, targets, *, axis,
+                       num_microbatches: int):
+    """Mean LM cross-entropy of the pipelined GPT with a VOCAB-PARALLEL
+    head: the [B, T, V] einsum — the dominant term of a GPT step at real
+    scale — is sharded over the pipeline ranks instead of replicated.
+
+    :func:`pipelined_gpt_apply` makes every rank compute the full head on
+    the replicated hidden states, so pipelining saved nothing on the
+    dominant cost. Here each rank computes logits for its own V/n vocab
+    columns of the (replicated) hidden states and the softmax
+    cross-entropy is assembled with the Megatron-style sharded-vocab
+    reduction — a ``pmax`` for the global row max, one ``psum`` for the
+    global sum-of-exps, one ``psum`` for the label logit (exactly one
+    rank holds each label's column). Per-rank head compute AND logits
+    memory are O(1/n) of the replicated form, every rank does useful
+    work (no idle bubble ranks), and there is no per-device control flow
+    for XLA to choke on. Fully differentiable (slice/psum/gpipe all
+    transpose; the row max rides ``stop_gradient``, the standard exact
+    logsumexp trick). Exact vs the dense model's loss
+    (tests/test_pipeline_parallel.py)."""
+    import optax
+
+    n = _axis_size(axis)
+    h = _pipeline_hidden(cfg, stage_params, rest, tokens, axis=axis,
+                         num_microbatches=num_microbatches)
+    if n == 1:
+        logits = _head_logits(cfg, rest, h)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    import flax.linen as nn
+
+    ln = nn.LayerNorm(dtype=cfg.dtype)
+    hn = ln.apply({"params": rest["ln_f"]}, h)
+    wte = rest["wte"].astype(cfg.dtype)
+    V, C = wte.shape
+    Vp = -(-V // n)  # ceil: per-rank vocab shard
+    # Pad to n*Vp rows so the per-rank dynamic_slice is never clamped
+    # (clamping would silently desync vpos from the actual rows).
+    wpad = jnp.pad(wte, ((0, n * Vp - V), (0, 0)))
+    r = lax.axis_index(axis if isinstance(axis, str) else tuple(axis))
+    w_shard = lax.dynamic_slice(wpad, (r * Vp, jnp.int32(0)), (Vp, C))
+    logits_loc = jnp.einsum("btc,vc->btv", hn, w_shard,
+                            preferred_element_type=jnp.float32)
+    vpos = r * Vp + jax.lax.broadcasted_iota(jnp.int32, (Vp,), 0)
+    valid = vpos < V
+    logits_loc = jnp.where(valid[None, None, :], logits_loc, -jnp.inf)
+
+    # Label logit: exactly one rank's shard holds each target column.
+    hit = vpos[None, None, :] == targets[..., None]
+    tgt_logit = lax.psum(
+        jnp.sum(jnp.where(hit, logits_loc, 0.0), axis=-1), axis)
+    # Global logsumexp over the sharded vocab. stop_gradient goes INSIDE
+    # pmax (pmax has no JVP rule, but a symbolically-zero tangent never
+    # reaches it), and pmax — not all_gather+max — re-establishes the
+    # replicated (invariant) typing the P() out-spec needs. Any m gives
+    # the same lse mathematically; it only sets fp scaling.
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    m = lax.pmax(lax.stop_gradient(jnp.max(logits_loc, axis=-1)), ax)
+    sumexp = lax.psum(
+        jnp.sum(jnp.exp(logits_loc - m[..., None]), axis=-1), axis)
+    lse = m + jnp.log(sumexp)
+    return jnp.mean(lse - tgt_logit)

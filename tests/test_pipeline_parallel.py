@@ -122,6 +122,71 @@ class TestPipelinedGPT:
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_pipelined_loss_matches_dense(self):
+        """pipelined_gpt_loss (vocab-sharded head over the pipeline
+        ranks) equals the dense model's mean cross-entropy, value AND
+        gradients."""
+        import optax
+
+        from horovod_tpu.parallel.pipeline import pipelined_gpt_loss
+
+        cfg, params, tokens = self._setup(seed=4)
+        rs = np.random.RandomState(9)
+        targets = jnp.asarray(
+            rs.randint(0, cfg.vocab_size, tokens.shape))
+        n = hvd.size()
+        stages, rest = pp_split_blocks(params, n)
+        mesh = hvd.mesh()
+
+        def pp_loss(stages, rest):
+            def spmd(stg, rst, tok, tgt):
+                local = jax.tree.map(lambda a: a[0], stg)
+                return pipelined_gpt_loss(cfg, local, rst, tok, tgt,
+                                          axis=hvd.HVD_AXES,
+                                          num_microbatches=2)
+
+            return jax.shard_map(
+                spmd, mesh=mesh,
+                in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
+                out_specs=P())(stages, rest, tokens, targets)
+
+        def dense_loss(params):
+            logits = GPT(cfg).apply({"params": params}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        (loss, (g_stages, g_rest)) = jax.jit(
+            jax.value_and_grad(pp_loss, argnums=(0, 1)))(stages, rest)
+        want_loss, g_dense = jax.value_and_grad(dense_loss)(params)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(g_rest["wte"]), np.asarray(g_dense["wte"]),
+            rtol=1e-3, atol=1e-6)
+        got = jax.tree.map(lambda a: np.asarray(a[2, 0]), g_stages)
+        want = jax.tree.map(np.asarray, g_dense["h2"])
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3,
+                                                    atol=1e-6),
+            got, want)
+
+    def test_pipelined_loss_world1(self):
+        import optax
+
+        from horovod_tpu.parallel.pipeline import pipelined_gpt_loss
+
+        cfg, params, tokens = self._setup(L=2, B=2, T=8, seed=5)
+        rs = np.random.RandomState(10)
+        targets = jnp.asarray(rs.randint(0, cfg.vocab_size, tokens.shape))
+        stages, rest = pp_split_blocks(params, 1)
+        local = jax.tree.map(lambda a: a[0], stages)
+        loss = pipelined_gpt_loss(cfg, local, rest, tokens, targets,
+                                  axis=hvd.LOCAL_AXIS, num_microbatches=2)
+        logits = GPT(cfg).apply({"params": params}, tokens)
+        want = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+        np.testing.assert_allclose(float(loss), float(want), rtol=2e-5)
+
     def test_pp_grads_match_dense(self):
         """Gradients through the pipeline equal the dense gradients (for
         the replicated embedding AND a stage's block weights)."""

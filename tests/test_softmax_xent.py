@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
@@ -94,6 +95,27 @@ class TestLinearCrossEntropy:
             in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES)),
             out_specs=P()))(x, w, lab)
         np.testing.assert_allclose(float(out), expect, rtol=1e-5)
+
+    def test_lm_head_loss_dispatch(self, monkeypatch):
+        """auto = dense under the logits budget, fused above; both match
+        the reference formulation numerically."""
+        from horovod_tpu.ops.softmax_xent import lm_head_loss
+
+        x, w, lab = _data()
+        want = np.asarray(_ref(x, w, lab))
+        for mode in ("dense", "fused", "auto"):
+            got = lm_head_loss(x, w, lab, mode=mode)
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=mode)
+        # Force the budget below this shape's logits: auto must take the
+        # fused path (and still match).
+        monkeypatch.setenv("HOROVOD_XENT_AUTO_LOGITS_GB", "0")
+        got = lm_head_loss(x, w, lab, mode="auto")
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError, match="auto|dense|fused"):
+            lm_head_loss(x, w, lab, mode="bogus")
 
     def test_gpt_fused_loss_matches_logits_loss(self):
         cfg = gpt_tiny(dtype=jnp.float32)
