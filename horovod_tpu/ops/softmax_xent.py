@@ -254,6 +254,19 @@ def _linear_xent_vjp_bwd(bn, bv, res, g):
 _linear_xent.defvjp(_linear_xent_vjp_fwd, _linear_xent_vjp_bwd)
 
 
+def _dense_xent(x, w, labels, dtype=None):
+    """The plain XLA formulation: einsum head + optax cross-entropy.
+    Single source for both linear_cross_entropy's no-legal-blocking
+    fallback and lm_head_loss's dense branch."""
+    import optax
+
+    logits = jnp.einsum("...c,vc->...v",
+                        x if dtype is None else x.astype(dtype),
+                        w if dtype is None else w.astype(dtype),
+                        preferred_element_type=jnp.float32)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+
+
 def linear_cross_entropy(x, w, labels, *,
                          block_n: int = _DEF_BLOCK_N,
                          block_v: int = _DEF_BLOCK_V):
@@ -275,12 +288,7 @@ def linear_cross_entropy(x, w, labels, *,
     lab = labels.reshape(N)
     bn, bv = _pick_block(N, block_n), _pick_block(V, block_v)
     if bn is None or bv is None:
-        import optax
-
-        logits = jnp.einsum("nc,vc->nv", xf.astype(jnp.float32),
-                            w.astype(jnp.float32))
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, lab).reshape(lead)
+        return _dense_xent(xf, w, lab, dtype=jnp.float32).reshape(lead)
     xf, w, lab8 = _harmonize_vma(xf, w, _broadcast8(lab, jnp.int32))
     loss = _linear_xent(xf, w, lab8, bn, bv)
     return loss.reshape(lead)
@@ -311,7 +319,12 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
     if mode not in ("auto", "dense", "fused"):
         raise ValueError(f"mode must be auto|dense|fused, got {mode!r}")
     use_fused = mode == "fused"
-    block_n = _DEF_BLOCK_N
+    # Read the block knob at CALL time (unlike the import-time module
+    # default) so a runtime os.environ override works the way the
+    # adjacent HOROVOD_XENT_AUTO_LOGITS_GB knob does.
+    env_bn = os.environ.get("HOROVOD_XENT_BLOCK_N")
+    block_n = _block_knob("HOROVOD_XENT_BLOCK_N", _DEF_BLOCK_N) \
+        if env_bn is not None else _DEF_BLOCK_N
     if mode == "auto":
         N = 1
         for d in x.shape[:-1]:
@@ -319,7 +332,7 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
         budget = float(os.environ.get(
             "HOROVOD_XENT_AUTO_LOGITS_GB", "8")) * 2 ** 30
         use_fused = N * w.shape[0] * 4.0 > budget
-        if use_fused and "HOROVOD_XENT_BLOCK_N" not in os.environ:
+        if use_fused and env_bn is None:
             # Auto only fires at large N·V, where the 1024-row block's
             # backward overflows the VMEM scoped stack inside a full
             # train-step fusion context (measured: 17.18M vs the 16M
@@ -328,8 +341,4 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
             block_n = min(512, block_n)
     if use_fused:
         return linear_cross_entropy(x, w, labels, block_n=block_n)
-    import optax
-
-    logits = jnp.einsum("...c,vc->...v", x, w,
-                        preferred_element_type=jnp.float32)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return _dense_xent(x, w, labels)

@@ -239,7 +239,8 @@ def pipelined_gpt_loss(cfg, stage_params, rest, tokens, targets, *, axis,
     # Pad to n*Vp rows so the per-rank dynamic_slice is never clamped
     # (clamping would silently desync vpos from the actual rows).
     wpad = jnp.pad(wte, ((0, n * Vp - V), (0, 0)))
-    r = lax.axis_index(axis if isinstance(axis, str) else tuple(axis))
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    r = lax.axis_index(ax)
     w_shard = lax.dynamic_slice(wpad, (r * Vp, jnp.int32(0)), (Vp, C))
     logits_loc = jnp.einsum("btc,vc->btv", hn, w_shard,
                             preferred_element_type=jnp.float32)
@@ -250,15 +251,14 @@ def pipelined_gpt_loss(cfg, stage_params, rest, tokens, targets, *, axis,
     # Label logit: exactly one rank's shard holds each target column.
     hit = vpos[None, None, :] == targets[..., None]
     tgt_logit = lax.psum(
-        jnp.sum(jnp.where(hit, logits_loc, 0.0), axis=-1), axis)
+        jnp.sum(jnp.where(hit, logits_loc, 0.0), axis=-1), ax)
     # Global logsumexp over the sharded vocab. stop_gradient goes INSIDE
     # pmax (pmax has no JVP rule, but a symbolically-zero tangent never
     # reaches it), and pmax — not all_gather+max — re-establishes the
     # replicated (invariant) typing the P() out-spec needs. Any m gives
     # the same lse mathematically; it only sets fp scaling.
-    ax = axis if isinstance(axis, str) else tuple(axis)
     m = lax.pmax(lax.stop_gradient(jnp.max(logits_loc, axis=-1)), ax)
     sumexp = lax.psum(
-        jnp.sum(jnp.exp(logits_loc - m[..., None]), axis=-1), axis)
+        jnp.sum(jnp.exp(logits_loc - m[..., None]), axis=-1), ax)
     lse = m + jnp.log(sumexp)
     return jnp.mean(lse - tgt_logit)

@@ -265,23 +265,63 @@ def broadcast(tensor, root_rank=0, name=None):
 
 def alltoall(tensor, splits=None, name=None):
     """Returns (output, received_splits) (reference:
-    tensorflow/mpi_ops.py alltoall)."""
-    ctrl, world = _eager_world()
+    tensorflow/mpi_ops.py alltoall). Graph mode uses the native custom op
+    (reference analogue: HorovodAlltoallOp, mpi_ops.cc:754-792) when
+    available, ``tf.py_function`` otherwise."""
     x = tf.convert_to_tensor(tensor)
-    if world == 1:
-        n = int(x.shape[0]) if x.shape.rank else 1
-        return tf.identity(x), tf.constant([n], dtype=tf.int32)
-    sp = None if splits is None else [int(s) for s in np.asarray(splits)]
-    h = ctrl.alltoall_async(_to_numpy(x),
-                            C._eager_name(name, "tf.alltoall"), splits=sp)
-    out = h.wait()
-    return (tf.convert_to_tensor(out),
-            tf.constant(np.asarray(h.recv_splits(), dtype=np.int32)))
+    if tf.executing_eagerly():
+        ctrl, world = _eager_world()
+        if world == 1:
+            n = int(x.shape[0]) if x.shape.rank else 1
+            return tf.identity(x), tf.constant([n], dtype=tf.int32)
+        sp = None if splits is None else [int(s) for s in np.asarray(splits)]
+        h = ctrl.alltoall_async(_to_numpy(x),
+                                C._eager_name(name, "tf.alltoall"),
+                                splits=sp)
+        out = h.wait()
+        return (tf.convert_to_tensor(out),
+                tf.constant(np.asarray(h.recv_splits(), dtype=np.int32)))
+
+    sp64 = (tf.zeros([0], tf.int64) if splits is None
+            else tf.cast(tf.convert_to_tensor(splits), tf.int64))
+    tname = _graph_name(x, name, "hvd.alltoall")
+    lib = _native_ops()
+    if lib is not None:
+        out, rs = lib.hvdtpu_alltoall(x, sp64, tensor_name=tname)
+        return out, tf.cast(rs, tf.int32)
+
+    def fn(t, s):
+        ctrl, world = _eager_world()
+        if world == 1:
+            return (tf.identity(t),
+                    tf.constant([int(t.shape[0])], dtype=tf.int32))
+        spl = ([int(v) for v in s.numpy()] if int(s.shape[0]) else None)
+        h = ctrl.alltoall_async(_to_numpy(t), tname, splits=spl)
+        out = h.wait()
+        return (tf.convert_to_tensor(out),
+                tf.constant(np.asarray(h.recv_splits(), dtype=np.int32)))
+
+    out, rs = tf.py_function(fn, [x, sp64], [x.dtype, tf.int32])
+    if x.shape.rank:
+        out.set_shape(tf.TensorShape([None]).concatenate(x.shape[1:]))
+    rs.set_shape([None])
+    return out, rs
 
 
-def join() -> int:
-    """Reference: tensorflow/mpi_ops.py join."""
-    return C.join()
+def join():
+    """Reference: tensorflow/mpi_ops.py join. Eagerly returns the
+    last-joined rank as a python int; inside a tf.function it is a graph
+    node (reference analogue: HorovodJoinOp, mpi_ops.cc:604-634)
+    producing an int32 scalar tensor."""
+    if tf.executing_eagerly():
+        return C.join()
+    lib = _native_ops()
+    if lib is not None:
+        return lib.hvdtpu_join()
+    y = tf.py_function(lambda: tf.constant(C.join(), tf.int32), [],
+                       tf.int32)
+    y.set_shape([])
+    return y
 
 
 # --------------------------------------------------------------------------

@@ -44,6 +44,12 @@ int hvdtpu_allgather(const char* name, const void* data,
                      const int64_t* shape, int ndim, int dtype);
 int hvdtpu_broadcast(const char* name, void* data, const int64_t* shape,
                      int ndim, int dtype, int root);
+int hvdtpu_alltoall(const char* name, const void* data,
+                    const int64_t* shape, int ndim, int dtype,
+                    const int64_t* splits, int nsplits);
+int hvdtpu_join(void);
+int hvdtpu_join_result(int handle);
+int hvdtpu_recv_splits(int handle, int64_t* out, int max);
 int hvdtpu_poll(int handle);
 int hvdtpu_wait(int handle);
 const char* hvdtpu_handle_error(int handle);
@@ -369,6 +375,143 @@ class HvdtpuAllgatherOp : public AsyncOpKernel {
   std::string tensor_name_;
 };
 
+// Alltoall with optional uneven splits (reference: HorovodAlltoallOp,
+// mpi_ops.cc:754-792). Outputs the concatenated received rows AND the
+// per-rank received row counts; both first dims are data-dependent, so
+// the kernel sizes them from the completed handle's recv_splits.
+class HvdtpuAlltoallOp : public AsyncOpKernel {
+ public:
+  explicit HvdtpuAlltoallOp(OpKernelConstruction* ctx)
+      : AsyncOpKernel(ctx) {
+    OP_REQUIRES_OK(ctx, ctx->GetAttr("tensor_name", &tensor_name_));
+  }
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    OP_REQUIRES_ASYNC(ctx, hvdtpu_is_initialized(),
+                      FailedPrecondition("horovod_tpu native core not "
+                                         "initialized; call hvd.init()"),
+                      done);
+    const Tensor& input = ctx->input(0);
+    const Tensor& splits = ctx->input(1);
+    OP_REQUIRES_ASYNC(ctx, input.dims() >= 1,
+                      InvalidArgument("alltoall needs rank >= 1 tensors"),
+                      done);
+    OP_REQUIRES_ASYNC(ctx, splits.dims() == 1,
+                      InvalidArgument("splits must be a vector"), done);
+    int dtype = NativeDtype(input.dtype());
+    OP_REQUIRES_ASYNC(ctx, dtype >= 0,
+                      InvalidArgument("unsupported dtype for alltoall"),
+                      done);
+    int nsplits = static_cast<int>(splits.dim_size(0));
+    const int64_t* splits_ptr =
+        nsplits > 0 ? splits.flat<int64_t>().data() : nullptr;
+    if (nsplits > 0) {
+      int64_t total = 0;
+      for (int i = 0; i < nsplits; ++i) {
+        int64_t s = splits_ptr[i];
+        OP_REQUIRES_ASYNC(ctx, s >= 0,
+                          InvalidArgument("splits entries must be >= 0"),
+                          done);
+        total += s;
+      }
+      OP_REQUIRES_ASYNC(
+          ctx, total == input.dim_size(0),
+          InvalidArgument("splits must sum to the tensor's first dim"),
+          done);
+    }
+    auto shape = ShapeVec(input);
+    int64_t row_elems = 1;
+    for (size_t i = 1; i < shape.size(); ++i) row_elems *= shape[i];
+    int64_t elem_bytes =
+        static_cast<int64_t>(::tensorflow::DataTypeSize(input.dtype()));
+    int handle = hvdtpu_alltoall(
+        tensor_name_.c_str(), input.tensor_data().data(), shape.data(),
+        static_cast<int>(shape.size()), dtype, splits_ptr, nsplits);
+    if (!CheckEnqueued(ctx, handle, done)) return;
+    TensorShape base_shape = input.shape();
+    Waiter::Get().Add(
+        handle, [ctx, handle, done, base_shape, row_elems,
+                 elem_bytes](int rc) mutable {
+          if (rc != 0) {
+            ctx->CtxFailure(
+                Internal("horovod_tpu collective failed: ",
+                         std::string(hvdtpu_handle_error(handle))));
+            hvdtpu_release(handle);
+            done();
+            return;
+          }
+          int world = hvdtpu_size();
+          std::vector<int64_t> rs(static_cast<size_t>(world), 0);
+          int got = hvdtpu_recv_splits(handle, rs.data(), world);
+          int64_t total_rows = 0;
+          for (int i = 0; i < got; ++i) total_rows += rs[static_cast<
+              size_t>(i)];
+          base_shape.set_dim(0, total_rows);
+          Tensor* output = nullptr;
+          ::tensorflow::Status s =
+              ctx->allocate_output(0, base_shape, &output);
+          if (s.ok() && total_rows * row_elems * elem_bytes > 0) {
+            hvdtpu_fetch(handle,
+                         const_cast<char*>(output->tensor_data().data()));
+          }
+          Tensor* out_splits = nullptr;
+          if (s.ok()) {
+            s = ctx->allocate_output(
+                1, TensorShape({static_cast<int64_t>(got)}), &out_splits);
+          }
+          if (!s.ok()) {
+            ctx->CtxFailure(s);
+          } else {
+            for (int i = 0; i < got; ++i) {
+              out_splits->flat<int64_t>()(i) = rs[static_cast<size_t>(i)];
+            }
+          }
+          hvdtpu_release(handle);
+          done();
+        });
+  }
+
+ private:
+  std::string tensor_name_;
+};
+
+// Join barrier (reference: HorovodJoinOp, mpi_ops.cc:604-634): signals
+// this rank has no more collectives this round; resolves when every rank
+// joined, outputting the last-joined rank.
+class HvdtpuJoinOp : public AsyncOpKernel {
+ public:
+  explicit HvdtpuJoinOp(OpKernelConstruction* ctx) : AsyncOpKernel(ctx) {}
+
+  void ComputeAsync(OpKernelContext* ctx, DoneCallback done) override {
+    OP_REQUIRES_ASYNC(ctx, hvdtpu_is_initialized(),
+                      FailedPrecondition("horovod_tpu native core not "
+                                         "initialized; call hvd.init()"),
+                      done);
+    int handle = hvdtpu_join();
+    if (!CheckEnqueued(ctx, handle, done)) return;
+    Waiter::Get().Add(handle, [ctx, handle, done](int rc) {
+      if (rc != 0) {
+        ctx->CtxFailure(Internal("horovod_tpu join failed: ",
+                                 std::string(hvdtpu_handle_error(handle))));
+        hvdtpu_release(handle);
+        done();
+        return;
+      }
+      int last = hvdtpu_join_result(handle);
+      Tensor* out = nullptr;
+      ::tensorflow::Status s =
+          ctx->allocate_output(0, TensorShape({}), &out);
+      if (!s.ok()) {
+        ctx->CtxFailure(s);
+      } else {
+        out->scalar<int32_t>()() = last;
+      }
+      hvdtpu_release(handle);
+      done();
+    });
+  }
+};
+
 // Runtime world size: lets Average divide by the CURRENT size instead of
 // a trace-time constant (elastic world changes reuse cached concrete
 // functions; a baked divisor would silently mis-average).
@@ -423,6 +566,30 @@ REGISTER_OP("HvdtpuAllgather")
       return ::tensorflow::OkStatus();
     });
 
+REGISTER_OP("HvdtpuAlltoall")
+    .Attr("T: type")
+    .Attr("tensor_name: string")
+    .Input("tensor: T")
+    .Input("splits: int64")
+    .Output("output: T")
+    .Output("received_splits: int64")
+    .SetShapeFn([](::tensorflow::shape_inference::InferenceContext* c) {
+      ::tensorflow::shape_inference::ShapeHandle out;
+      TF_RETURN_IF_ERROR(c->ReplaceDim(
+          c->input(0), 0, c->UnknownDim(), &out));
+      c->set_output(0, out);
+      c->set_output(1, c->Vector(c->UnknownDim()));
+      return ::tensorflow::OkStatus();
+    });
+
+REGISTER_OP("HvdtpuJoin")
+    .Output("last_joined_rank: int32")
+    .SetIsStateful()
+    .SetShapeFn([](::tensorflow::shape_inference::InferenceContext* c) {
+      c->set_output(0, c->Scalar());
+      return ::tensorflow::OkStatus();
+    });
+
 REGISTER_OP("HvdtpuSize")
     .Output("size: int32")
     .SetShapeFn([](::tensorflow::shape_inference::InferenceContext* c) {
@@ -439,6 +606,12 @@ REGISTER_KERNEL_BUILDER(Name("HvdtpuBroadcast").Device(
 REGISTER_KERNEL_BUILDER(Name("HvdtpuAllgather").Device(
                             ::tensorflow::DEVICE_CPU),
                         HvdtpuAllgatherOp);
+REGISTER_KERNEL_BUILDER(Name("HvdtpuAlltoall").Device(
+                            ::tensorflow::DEVICE_CPU),
+                        HvdtpuAlltoallOp);
+REGISTER_KERNEL_BUILDER(Name("HvdtpuJoin").Device(
+                            ::tensorflow::DEVICE_CPU),
+                        HvdtpuJoinOp);
 REGISTER_KERNEL_BUILDER(Name("HvdtpuSize").Device(
                             ::tensorflow::DEVICE_CPU),
                         HvdtpuSizeOp);

@@ -172,6 +172,44 @@ def main():
     expect = sum(sum(r + i for r in range(size)) for i in range(64))
     assert np.allclose(f.numpy(), expect), (f, expect)
 
+    # -- native graph-mode alltoall + join (reference: HorovodAlltoallOp
+    # mpi_ops.cc:754-792, HorovodJoinOp :604-634) --
+    @tf.function
+    def graph_a2a(t, sp):
+        return hvd.alltoall(t, splits=sp, name="g.a2a")
+
+    cf = graph_a2a.get_concrete_function(
+        tf.TensorSpec([None], tf.float32), tf.TensorSpec([None], tf.int64))
+    a2a_types = {op.type for op in cf.graph.get_operations()}
+    if _native_ops() is not None:
+        assert "HvdtpuAlltoall" in a2a_types, a2a_types
+        assert not any("PyFunc" in t for t in a2a_types), a2a_types
+    # even splits
+    out, rsp = graph_a2a(tf.range(size * 2, dtype=tf.float32),
+                         tf.zeros([0], tf.int64))
+    expect = np.concatenate([np.arange(2) + 2 * rank for _ in range(size)])
+    assert np.allclose(out.numpy(), expect), out.numpy()
+    assert list(rsp.numpy()) == [2] * size, rsp.numpy()
+    # uneven splits: rank r sends r+1 rows to every peer
+    rows = size * (rank + 1)
+    out, rsp = graph_a2a(
+        tf.fill([rows], float(rank)),
+        tf.constant([rank + 1] * size, dtype=tf.int64))
+    assert list(rsp.numpy()) == [r + 1 for r in range(size)], rsp.numpy()
+    expect = np.concatenate([np.full(r + 1, float(r)) for r in range(size)])
+    assert np.allclose(out.numpy(), expect), out.numpy()
+
+    @tf.function
+    def graph_join():
+        return hvd.join()
+
+    cfj = graph_join.get_concrete_function()
+    join_types = {op.type for op in cfj.graph.get_operations()}
+    if _native_ops() is not None:
+        assert "HvdtpuJoin" in join_types, join_types
+    last = graph_join()
+    assert 0 <= int(last.numpy()) < size, last
+
     # gradient THROUGH the native graph op (custom_gradient wraps it)
     @tf.function
     def graph_grad(t):
