@@ -321,10 +321,10 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
     use_fused = mode == "fused"
     # Read the block knob at CALL time (unlike the import-time module
     # default) so a runtime os.environ override works the way the
-    # adjacent HOROVOD_XENT_AUTO_LOGITS_GB knob does.
-    env_bn = os.environ.get("HOROVOD_XENT_BLOCK_N")
-    block_n = _block_knob("HOROVOD_XENT_BLOCK_N", _DEF_BLOCK_N) \
-        if env_bn is not None else _DEF_BLOCK_N
+    # adjacent HOROVOD_XENT_AUTO_LOGITS_GB knob does. An empty string
+    # means unset (shell idiom), matching _env_int's treatment.
+    env_bn = os.environ.get("HOROVOD_XENT_BLOCK_N") or None
+    block_n = _block_knob("HOROVOD_XENT_BLOCK_N", _DEF_BLOCK_N)
     if mode == "auto":
         N = 1
         for d in x.shape[:-1]:
