@@ -74,6 +74,30 @@ _DEF_BLOCK_Q = _block_knob("HOROVOD_FLASH_BLOCK_Q", 1024)
 _DEF_BLOCK_K = _block_knob("HOROVOD_FLASH_BLOCK_K", 1024)
 
 
+def _resolve_blocks(B, Tq, Tk, H, D, dtype, causal):
+    """Block sizes for a flash call that pinned neither block: env knobs
+    win; otherwise the kernel autotuner's cached/swept choice (TPU,
+    single-process); otherwise the hand-tuned defaults. Multi-process
+    SPMD only READS the autotune cache (a sweep could pick different
+    blocks on different hosts → divergent programs); ship the cache file
+    to every host to use tuned blocks there."""
+    import os
+
+    # `or` (not `in`): an empty string means unset, the shell idiom
+    # _env_int also honors — consistent with the xent knobs.
+    if (os.environ.get("HOROVOD_FLASH_BLOCK_Q")
+            or os.environ.get("HOROVOD_FLASH_BLOCK_K")):
+        return (_block_knob("HOROVOD_FLASH_BLOCK_Q", 1024),
+                _block_knob("HOROVOD_FLASH_BLOCK_K", 1024))
+    from . import kernel_autotune
+
+    if not kernel_autotune.enabled():
+        return _DEF_BLOCK_Q, _DEF_BLOCK_K
+    return kernel_autotune.flash_blocks(
+        B, Tq, Tk, H, D, dtype, causal,
+        (_DEF_BLOCK_Q, _DEF_BLOCK_K), _pick_block)
+
+
 def _interpret() -> bool:
     """Run in interpreter mode off-TPU (CPU test suite)."""
     return jax.default_backend() != "tpu"
@@ -677,8 +701,8 @@ def flash_ring_attention(q, k, v, *, axis, causal: bool = True,
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = _DEF_BLOCK_Q,
-                    block_k: int = _DEF_BLOCK_K):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Exact attention with the flash schedule. Layout [B, T, H, D].
 
     Differentiable (custom VJP with Pallas backward kernels). Block sizes
@@ -686,12 +710,23 @@ def flash_attention(q, k, v, *, causal: bool = True,
     block is always legal — Mosaic accepts block dims equal to the array
     dim); only a long sequence with no 128-aligned divisor falls back to
     the dense path — numerics are identical either way.
+
+    ``block_q``/``block_k`` default to the kernel autotuner's choice for
+    this (shape, chip) — swept once, cached on disk
+    (ops/kernel_autotune.py) — unless the ``HOROVOD_FLASH_BLOCK_Q/K``
+    knobs pin them or the caller passes explicit values.
     """
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
     if causal and Tq != Tk:
         raise ValueError(
             f"causal flash attention needs Tq == Tk, got {Tq} != {Tk}")
+    if block_q is None and block_k is None:
+        block_q, block_k = _resolve_blocks(B, Tq, Tk, H, D, q.dtype,
+                                           causal)
+    else:
+        block_q = _DEF_BLOCK_Q if block_q is None else block_q
+        block_k = _DEF_BLOCK_K if block_k is None else block_k
     if block_q < 128 or block_k < 128:
         raise ValueError(
             f"block_q/block_k must be >= 128 (MXU/lane tile), got "

@@ -268,8 +268,8 @@ def _dense_xent(x, w, labels, dtype=None):
 
 
 def linear_cross_entropy(x, w, labels, *,
-                         block_n: int = _DEF_BLOCK_N,
-                         block_v: int = _DEF_BLOCK_V):
+                         block_n=None,
+                         block_v=None):
     """Per-token cross entropy of ``softmax(x @ wᵀ)`` against ``labels``.
 
     ``x``: [..., C] activations (any leading shape); ``w``: [V, C] vocab
@@ -277,7 +277,13 @@ def linear_cross_entropy(x, w, labels, *,
     losses. Differentiable w.r.t. ``x`` and ``w`` (custom VJP, Pallas
     kernels; the [N, V] logits never touch HBM). Falls back to the plain
     XLA formulation when no legal blocking exists.
+
+    Blocks default to the kernel autotuner's cached/swept choice for this
+    (shape, chip) (ops/kernel_autotune.py) unless the
+    ``HOROVOD_XENT_BLOCK_N/V`` knobs or explicit arguments pin them.
     """
+    import os
+
     lead = x.shape[:-1]
     C = x.shape[-1]
     V = w.shape[0]
@@ -286,6 +292,23 @@ def linear_cross_entropy(x, w, labels, *,
         N *= d
     xf = x.reshape(N, C)
     lab = labels.reshape(N)
+    if block_n is None and block_v is None:
+        if (os.environ.get("HOROVOD_XENT_BLOCK_N")
+                or os.environ.get("HOROVOD_XENT_BLOCK_V")):
+            block_n = _block_knob("HOROVOD_XENT_BLOCK_N", 1024)
+            block_v = _block_knob("HOROVOD_XENT_BLOCK_V", 1024)
+        else:
+            from . import kernel_autotune
+
+            if kernel_autotune.enabled():
+                block_n, block_v = kernel_autotune.xent_blocks(
+                    N, V, C, x.dtype, (_DEF_BLOCK_N, _DEF_BLOCK_V),
+                    _pick_block)
+            else:
+                block_n, block_v = _DEF_BLOCK_N, _DEF_BLOCK_V
+    else:
+        block_n = _DEF_BLOCK_N if block_n is None else block_n
+        block_v = _DEF_BLOCK_V if block_v is None else block_v
     bn, bv = _pick_block(N, block_n), _pick_block(V, block_v)
     if bn is None or bv is None:
         return _dense_xent(xf, w, lab, dtype=jnp.float32).reshape(lead)
@@ -339,6 +362,16 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
             # limit at [32k tokens, 128k vocab]); 512 rows compiles and
             # measures identically standalone (196.6 vs 196.9 ms).
             block_n = min(512, block_n)
+            from . import kernel_autotune
+
+            if kernel_autotune.enabled():
+                # Tune within the in-context-safe grid (bn <= 512); the
+                # sweep-failure default stays the safe 512-row block.
+                block_n, bv = kernel_autotune.xent_blocks(
+                    N, w.shape[0], x.shape[-1], x.dtype,
+                    (block_n, _DEF_BLOCK_V), _pick_block)
+                return linear_cross_entropy(x, w, labels,
+                                            block_n=block_n, block_v=bv)
     if use_fused:
         return linear_cross_entropy(x, w, labels, block_n=block_n)
     return _dense_xent(x, w, labels)

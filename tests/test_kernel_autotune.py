@@ -1,0 +1,110 @@
+"""Kernel block-size autotuner (ops/kernel_autotune.py) — cache and
+dispatch logic. The sweep's timing machinery only means anything on a
+real TPU (see the module docstring), so these tests drive get_or_tune
+with canned bench functions; the real-hardware proof is the flagship
+bench converging to >= the hand-tuned number with a fresh cache."""
+
+import json
+
+import pytest
+
+import horovod_tpu.ops.kernel_autotune as at
+
+
+@pytest.fixture()
+def fresh_cache(tmp_path, monkeypatch):
+    path = tmp_path / "autotune.json"
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE", str(path))
+    monkeypatch.setattr(at, "_mem", {})
+    monkeypatch.setattr(at, "_loaded", False)
+    yield path
+
+
+class TestGetOrTune:
+    def test_disabled_off_tpu_returns_default(self, fresh_cache):
+        # CPU test env: enabled() is False -> default, no bench calls.
+        calls = []
+        out = at.get_or_tune("k", "s", [(1,), (2,)],
+                             lambda c: calls.append(c) or 0.1, (9,))
+        assert out == (9,) and calls == []
+
+    def test_sweep_picks_fastest_and_caches(self, fresh_cache, monkeypatch):
+        monkeypatch.setattr(at, "enabled", lambda: True)
+        times = {(256,): 0.003, (512,): 0.001, (1024,): 0.002}
+        calls = []
+
+        def bench(c):
+            calls.append(c)
+            return times[c]
+
+        out = at.get_or_tune("k", "sig1", list(times), bench, (9,))
+        assert out == (512,)
+        assert sorted(calls) == sorted(times)
+        # cache hit: no bench calls the second time
+        calls.clear()
+        assert at.get_or_tune("k", "sig1", list(times), bench,
+                              (9,)) == (512,)
+        assert calls == []
+        # and the on-disk cache is a fresh process's warm start
+        disk = json.loads(fresh_cache.read_text())
+        key = [k for k in disk if k.endswith("sig1")][0]
+        assert disk[key]["blocks"] == [512]
+        monkeypatch.setattr(at, "_mem", {})
+        monkeypatch.setattr(at, "_loaded", False)
+        assert at.get_or_tune("k", "sig1", list(times), bench,
+                              (9,)) == (512,)
+        assert calls == []
+
+    def test_failing_candidates_skipped(self, fresh_cache, monkeypatch):
+        monkeypatch.setattr(at, "enabled", lambda: True)
+
+        def bench(c):
+            if c == (512,):
+                raise RuntimeError("VMEM")
+            return 0.002 if c == (256,) else 0.004
+
+        out = at.get_or_tune("k", "sig2", [(256,), (512,), (1024,)],
+                             bench, (9,))
+        assert out == (256,)
+
+    def test_all_failing_returns_default(self, fresh_cache, monkeypatch):
+        monkeypatch.setattr(at, "enabled", lambda: True)
+
+        def bench(c):
+            raise RuntimeError("timing not linear")
+
+        assert at.get_or_tune("k", "sig3", [(1,)], bench, (9,)) == (9,)
+        # nothing cached: a later process may succeed where this one failed
+        assert not fresh_cache.exists() or "sig3" not in \
+            fresh_cache.read_text()
+
+    def test_multiprocess_only_reads_cache(self, fresh_cache, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(at, "enabled", lambda: True)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        calls = []
+        out = at.get_or_tune("k", "sig4", [(1,), (2,)],
+                             lambda c: calls.append(c) or 0.1, (9,))
+        assert out == (9,) and calls == []  # no sweep in multi-host
+        # but a pre-shipped cache entry is honored
+        at._mem[f"k|{getattr(jax.devices()[0], 'device_kind', 'tpu')}"
+                f"|sig4"] = {"blocks": [2]}
+        assert at.get_or_tune("k", "sig4", [(1,), (2,)],
+                              lambda c: 0.1, (9,)) == (2,)
+
+
+class TestShapeGates:
+    def test_small_shapes_keep_defaults(self, fresh_cache, monkeypatch):
+        """The B=1 model.init trace must not trigger a sweep."""
+        monkeypatch.setattr(at, "enabled", lambda: True)
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops.flash_attention import _pick_block
+
+        out = at.flash_blocks(1, 1024, 1024, 12, 64, jnp.bfloat16, True,
+                              (1024, 1024), _pick_block)
+        assert out == (1024, 1024)
+        out = at.xent_blocks(64, 1024, 128, jnp.float32, (1024, 1024),
+                             _pick_block)
+        assert out == (1024, 1024)
