@@ -126,8 +126,10 @@ from .parallel.expert import (  # noqa: F401
 )
 from .parallel.pipeline import (  # noqa: F401
     gpipe,
+    gpipe_1f1b,
     pipelined_gpt_apply,
     pipelined_gpt_loss,
+    pipelined_gpt_train_1f1b,
     pp_split_blocks,
 )
 from .parallel.tensor import (  # noqa: F401

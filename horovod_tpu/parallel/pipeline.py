@@ -36,6 +36,19 @@ from jax import lax
 from .sequence import _axis_size
 
 
+def _carry_axes(axis, x_mbs, stage_params):
+    """Varying-axes type for pipeline scan carries: the pipeline axis
+    itself plus whatever the inputs/stage params already vary over (e.g.
+    a data-parallel batch axis). Single home for both schedules' inits."""
+    from ..ops.collective_ops import _vma
+
+    ring = {axis} if isinstance(axis, str) else set(axis)
+    return tuple(sorted(
+        ring | _vma(x_mbs)
+        | frozenset().union(*[_vma(l) for l in
+                              jax.tree.leaves(stage_params)])))
+
+
 def gpipe(stage_fn, stage_params, x_mbs, *, axis):
     """Run microbatches [M, ...] through n pipeline stages over ``axis``.
 
@@ -76,13 +89,9 @@ def gpipe(stage_fn, stage_params, x_mbs, *, axis):
     # and the masked writes); the fresh zero inits must match. pcast only
     # the axes a value does not already vary over (zeros_like inherits
     # e.g. a data-parallel batch axis from x_mbs).
-    from ..ops.collective_ops import _vma, pvary_missing
+    from ..ops.collective_ops import pvary_missing
 
-    ring = {axis} if isinstance(axis, str) else set(axis)
-    axes_t = tuple(sorted(
-        ring | _vma(x_mbs)
-        | frozenset().union(*[_vma(l) for l in
-                              jax.tree.leaves(stage_params)])))
+    axes_t = _carry_axes(axis, x_mbs, stage_params)
     state0 = pvary_missing(jnp.zeros_like(x_mbs[0]), axes_t)
     outputs0 = pvary_missing(jnp.zeros(x_mbs.shape, x_mbs.dtype), axes_t)
     (_, outputs), _ = lax.scan(body, (state0, outputs0),
@@ -148,17 +157,18 @@ def _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis):
                 f"axis {axis!r}; use disjoint mesh axes")
 
 
-def _pipeline_hidden(cfg, stage_params, rest, tokens, *, axis,
-                     num_microbatches):
-    """Embedding + pipelined transformer stack → final hidden [B, T, C]
-    (pre-ln_f), replicated over ``axis``."""
-    from ..models.gpt import _Block
+def _embed(cfg, ep, tokens):
+    """Token + positional embedding from an {wte, wpe} tree (single home
+    for the pipeline paths; differentiable w.r.t. ``ep``)."""
+    T = tokens.shape[1]
+    return (ep["wte"][tokens]
+            + ep["wpe"][jnp.arange(T)][None]).astype(cfg.dtype)
 
-    B, T = tokens.shape
-    _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis)
-    wte, wpe = rest["wte"], rest["wpe"]
-    x = (wte[tokens] + wpe[jnp.arange(T)][None]).astype(cfg.dtype)
-    x_mbs = x.reshape(num_microbatches, B // num_microbatches, T, -1)
+
+def _make_stage_fn(cfg):
+    """This rank's stage: its stacked [L/n, ...] blocks folded over the
+    activation (single home for both schedules)."""
+    from ..models.gpt import _Block
 
     block = _Block(cfg)
 
@@ -169,7 +179,18 @@ def _pipeline_hidden(cfg, stage_params, rest, tokens, *, axis,
         h, _ = lax.scan(one, h, stacked)
         return h
 
-    h = gpipe(stage_fn, stage_params, x_mbs, axis=axis)
+    return stage_fn
+
+
+def _pipeline_hidden(cfg, stage_params, rest, tokens, *, axis,
+                     num_microbatches):
+    """Embedding + pipelined transformer stack → final hidden [B, T, C]
+    (pre-ln_f), replicated over ``axis``."""
+    B, T = tokens.shape
+    _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis)
+    x = _embed(cfg, rest, tokens)
+    x_mbs = x.reshape(num_microbatches, B // num_microbatches, T, -1)
+    h = gpipe(_make_stage_fn(cfg), stage_params, x_mbs, axis=axis)
     return h.reshape(B, T, -1)
 
 
@@ -262,3 +283,185 @@ def pipelined_gpt_loss(cfg, stage_params, rest, tokens, targets, *, axis,
         jnp.sum(jnp.exp(logits_loc - m[..., None]), axis=-1), ax)
     lse = m + jnp.log(sumexp)
     return jnp.mean(lse - tgt_logit)
+
+
+def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
+               tgt_mbs, *, axis):
+    """1F1B pipeline schedule: loss + gradients in one fused pass with
+    O(pipeline_depth) activation memory.
+
+    :func:`gpipe` differentiates its forward scan with autodiff, so the
+    backward retains residuals for ALL M microbatches per rank — O(M)
+    activation memory, GPipe's classic cost. This schedule hand-interleaves
+    one-forward-one-backward: stage r runs F(m) at tick m+r and B(m) at
+    tick m+2n-1-r, so at most 2n-1-2r microbatches are in flight per rank
+    and the stash is a static ``[2n-1, ...]`` ring buffer — O(n), however
+    large M grows. Backward uses input-stash rematerialization (the stage
+    forward is recomputed at B time for its VJP — one extra forward per
+    microbatch, the standard remat trade).
+
+    ``stage_fn(stage_params, x)`` is this rank's stage.
+    ``loss_fn(head_params, y, tgt)`` maps the LAST stage's output to a
+    scalar per-microbatch loss (every rank evaluates it SPMD-style; only
+    the last rank's result/cotangents are un-masked). Returns
+    ``(loss, d_stage_params, d_head_params, d_x_mbs)`` where ``loss`` is
+    the mean over microbatches (replicated), ``d_stage_params`` is this
+    rank's stage-parameter gradient (device-varying, like the stage
+    parameters themselves), ``d_head_params`` is replicated, and
+    ``d_x_mbs`` is the gradient w.r.t. the pipeline input (for the
+    caller's embedding backward).
+    """
+    n = _axis_size(axis)
+    M = x_mbs.shape[0]
+    if n == 1:
+        def total(sp, hp, x):
+            ys = jax.vmap(lambda xm: stage_fn(sp, xm))(x)
+            losses = jax.vmap(lambda ym, tm: loss_fn(hp, ym, tm))(
+                ys, tgt_mbs)
+            return losses.mean()
+
+        loss, (gs, gh, gx) = jax.value_and_grad(total, argnums=(0, 1, 2))(
+            stage_params, head_params, x_mbs)
+        return loss, gs, gh, gx
+
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    r = lax.axis_index(ax)
+    S = 2 * n - 1                       # max microbatches in flight
+    T_ticks = M + 2 * n - 1
+    up = [(i, i + 1) for i in range(n - 1)]
+    down = [(i + 1, i) for i in range(n - 1)]
+    is_last = r == n - 1
+    fzero = jnp.float32(0)
+
+    from ..ops.collective_ops import _vma, pvary_missing
+
+    axes_t = _carry_axes(axis, x_mbs, stage_params)
+
+    def vary(tree):
+        return jax.tree.map(lambda a: pvary_missing(a, axes_t), tree)
+
+    mb_shape = x_mbs.shape[1:]
+    zeros_mb = pvary_missing(jnp.zeros(mb_shape, x_mbs.dtype), axes_t)
+    carry0 = (
+        zeros_mb,                                        # act in transit
+        zeros_mb.astype(jnp.float32),                    # grad in transit
+        vary(jnp.zeros((S,) + mb_shape, x_mbs.dtype)),   # input stash
+        zeros_mb.astype(jnp.float32),                    # dy (last stage)
+        vary(jax.tree.map(jnp.zeros_like, stage_params)),  # d_stage
+        vary(jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), head_params)),
+        vary(jnp.zeros(x_mbs.shape, jnp.float32)),       # d_x_mbs
+        pvary_missing(fzero, axes_t),                    # loss accum
+    )
+
+    def tick(carry, t):
+        act, gract, stash, dy_state, d_sp, d_hp, d_x, loss_acc = carry
+
+        # ---- backward phase FIRST: B(m_b), m_b = t - (2n - 1 - r) ----
+        # B consumes only previous-tick state (stash written at F time
+        # ticks ago, gract/dy_state from the prior tick). Running F first
+        # would overwrite dy_state with the NEXT microbatch's cotangent
+        # before B(m_b) reads it — off-by-one on every last-stage grad.
+        m_b = t - (2 * n - 1 - r)
+        b_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        x_saved = stash[jnp.clip(m_b, 0, M - 1) % S]
+        _, stage_vjp = jax.vjp(
+            lambda p, x: stage_fn(p, x), stage_params, x_saved)
+        gy = jnp.where(is_last, dy_state, gract)
+        g_sp_m, gx = stage_vjp(gy.astype(x_saved.dtype))
+        d_sp = jax.tree.map(
+            lambda acc, g: acc + jnp.where(b_valid, g, 0.0).astype(
+                acc.dtype), d_sp, g_sp_m)
+        bidx = jnp.clip(m_b, 0, M - 1)
+        write_dx = jnp.logical_and(b_valid, r == 0)
+        d_x = d_x.at[bidx].set(
+            jnp.where(write_dx, gx.astype(jnp.float32), d_x[bidx]))
+        new_gract = lax.ppermute(gx.astype(jnp.float32), ax, down)
+
+        # ---- forward phase: F(m_f) with m_f = t - r ----
+        m_f = t - r
+        f_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        x_in = jnp.where(r == 0, x_mbs[jnp.clip(m_f, 0, M - 1)], act)
+        y = stage_fn(stage_params, x_in)
+        slot_f = jnp.clip(m_f, 0, M - 1) % S
+        stash = stash.at[slot_f].set(
+            jnp.where(f_valid, x_in, stash[slot_f]))
+
+        # last stage: per-microbatch loss + output cotangent + head grads.
+        # The head params enter the vjp as a VARYING copy: differentiating
+        # through the replicated (invariant) tree would transpose the
+        # implicit pvary into a psum, summing every rank's garbage-y
+        # contribution into g_hp_m before our mask can drop it.
+        hp_vary = vary(head_params)
+        tgt = tgt_mbs[jnp.clip(m_f, 0, M - 1)]
+        loss_m, head_vjp = jax.vjp(
+            lambda hp, y: loss_fn(hp, y, tgt), hp_vary, y)
+        # The seed cotangent must carry the same varying axes as loss_m.
+        g_hp_m, dy = head_vjp(pvary_missing(jnp.float32(1),
+                                            tuple(sorted(_vma(loss_m)))))
+        take = jnp.logical_and(is_last, f_valid)
+        loss_acc = loss_acc + jnp.where(take, loss_m, fzero)
+        d_hp = jax.tree.map(
+            lambda acc, g: acc + jnp.where(take, g, 0.0).astype(acc.dtype),
+            d_hp, g_hp_m)
+        dy_state = jnp.where(take, dy.astype(jnp.float32), dy_state)
+        act = lax.ppermute(y, ax, up)
+
+        return (act, new_gract, stash, dy_state, d_sp, d_hp, d_x,
+                loss_acc), None
+
+    (_, _, _, _, d_sp, d_hp, d_x, loss_acc), _ = lax.scan(
+        tick, carry0, jnp.arange(T_ticks))
+
+    # loss/head grads live on the last stage, input grads on stage 0;
+    # masked psums replicate (every other rank contributed zeros).
+    loss = lax.psum(loss_acc, ax) / M
+    d_hp = jax.tree.map(
+        lambda a: lax.psum(a, ax) / M, d_hp)
+    d_x = lax.psum(d_x, ax) / M
+    return loss, jax.tree.map(lambda a: a / M, d_sp), d_hp, d_x
+
+
+def pipelined_gpt_train_1f1b(cfg, stage_params, rest, tokens, targets, *,
+                             axis, num_microbatches: int):
+    """One fused GPT training computation under the 1F1B schedule:
+    returns ``(loss, d_stage_params, d_rest)`` directly (the schedule
+    hand-interleaves forward and backward, so this is not a function you
+    differentiate — it IS the gradient computation).
+
+    Same contract as :func:`pipelined_gpt_loss` + ``jax.grad``, with
+    activation memory O(pipeline_depth) instead of O(num_microbatches):
+    use it when M must be large (deep pipelines want M >> n to shrink
+    the bubble, which is exactly when GPipe's O(M) stash hurts). The LM
+    head runs replicated per microbatch on every rank (masked off the
+    last stage) — the memory-lean counterpart of
+    :func:`pipelined_gpt_loss`'s vocab-sharded head; exactness vs the
+    dense model is tested for both."""
+    import optax
+
+    B, T = tokens.shape
+    _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis)
+    M = num_microbatches
+
+    ep = {"wte": rest["wte"], "wpe": rest["wpe"]}
+    x, embed_vjp = jax.vjp(lambda ep: _embed(cfg, ep, tokens), ep)
+    x_mbs = x.reshape(M, B // M, T, -1)
+    tgt_mbs = targets.reshape(M, B // M, T)
+
+    def loss_fn(hp, y, tgt):
+        # hp carries exactly the {ln_f, wte} keys _head_logits reads.
+        return optax.softmax_cross_entropy_with_integer_labels(
+            _head_logits(cfg, hp, y), tgt).mean()
+
+    hp = {"ln_f": rest["ln_f"], "wte": rest["wte"]}
+    loss, g_stages, g_hp, d_x = gpipe_1f1b(
+        _make_stage_fn(cfg), loss_fn, stage_params, hp, x_mbs, tgt_mbs,
+        axis=axis)
+    (g_ep,) = embed_vjp(d_x.reshape(B, T, -1).astype(x.dtype))
+    g_rest = {
+        # wte is tied: embedding-lookup grad + LM-head grad
+        "wte": g_ep["wte"].astype(jnp.float32) + g_hp["wte"],
+        "wpe": g_ep["wpe"].astype(jnp.float32),
+        "ln_f": g_hp["ln_f"],
+    }
+    return loss, g_stages, g_rest

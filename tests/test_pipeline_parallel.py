@@ -187,6 +187,82 @@ class TestPipelinedGPT:
             logits, targets).mean()
         np.testing.assert_allclose(float(loss), float(want), rtol=2e-5)
 
+    def test_1f1b_matches_dense(self):
+        """The 1F1B schedule's fused loss+grads equal the dense model's
+        (loss, wte/wpe/ln_f grads, per-stage block grads) — the same
+        contract as pipelined_gpt_loss + jax.grad, at O(n) activation
+        memory."""
+        import optax
+
+        from horovod_tpu.parallel.pipeline import pipelined_gpt_train_1f1b
+
+        cfg, params, tokens = self._setup(seed=6)
+        rs = np.random.RandomState(11)
+        targets = jnp.asarray(rs.randint(0, cfg.vocab_size, tokens.shape))
+        n = hvd.size()
+        stages, rest = pp_split_blocks(params, n)
+        mesh = hvd.mesh()
+
+        def spmd(stg, rst, tok, tgt):
+            local = jax.tree.map(lambda a: a[0], stg)
+            loss, g_st, g_rest = pipelined_gpt_train_1f1b(
+                cfg, local, rst, tok, tgt, axis=hvd.HVD_AXES,
+                num_microbatches=4)
+            return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
+
+        loss, g_stages, g_rest = jax.jit(jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
+            out_specs=(P(), P(hvd.HVD_AXES), P())))(
+            stages, rest, tokens, targets)
+
+        def dense_loss(params):
+            logits = GPT(cfg).apply({"params": params}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        want_loss, g_dense = jax.value_and_grad(dense_loss)(params)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(g_rest["wte"]), np.asarray(g_dense["wte"]),
+            rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(g_rest["wpe"]), np.asarray(g_dense["wpe"]),
+            rtol=1e-3, atol=1e-6)
+        for s in (0, hvd.size() - 1):
+            got = jax.tree.map(lambda a: np.asarray(a[s, 0]), g_stages)
+            want = jax.tree.map(np.asarray, g_dense[f"h{s}"])
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    a, b, rtol=1e-3, atol=1e-6), got, want)
+
+    def test_1f1b_world1(self):
+        import optax
+
+        from horovod_tpu.parallel.pipeline import pipelined_gpt_train_1f1b
+
+        cfg, params, tokens = self._setup(L=2, B=4, T=8, seed=7)
+        rs = np.random.RandomState(12)
+        targets = jnp.asarray(rs.randint(0, cfg.vocab_size, tokens.shape))
+        stages, rest = pp_split_blocks(params, 1)
+        local = jax.tree.map(lambda a: a[0], stages)
+        loss, g_st, g_rest = pipelined_gpt_train_1f1b(
+            cfg, local, rest, tokens, targets, axis=hvd.LOCAL_AXIS,
+            num_microbatches=2)
+
+        def dense_loss(params):
+            logits = GPT(cfg).apply({"params": params}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        want_loss, g_dense = jax.value_and_grad(dense_loss)(params)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(g_rest["wte"]), np.asarray(g_dense["wte"]),
+            rtol=1e-3, atol=1e-6)
+
     def test_pp_grads_match_dense(self):
         """Gradients through the pipeline equal the dense gradients (for
         the replicated embedding AND a stage's block weights)."""
