@@ -237,6 +237,52 @@ class TestPipelinedGPT:
                 lambda a, b: np.testing.assert_allclose(
                     a, b, rtol=1e-3, atol=1e-6), got, want)
 
+    def test_1f1b_stash_wraps_at_large_m(self):
+        """M > 2n-1 makes the input-stash ring buffer actually wrap —
+        the schedule's advertised large-M regime; slot reuse and the
+        B-before-F collision ordering must stay exact."""
+        import optax
+
+        from horovod_tpu.parallel.pipeline import pipelined_gpt_train_1f1b
+
+        n = hvd.size()
+        M = 2 * n  # > S = 2n-1: every slot gets reused
+        cfg, params, tokens = self._setup(L=n, B=M, T=8, seed=8)
+        rs = np.random.RandomState(13)
+        targets = jnp.asarray(rs.randint(0, cfg.vocab_size, tokens.shape))
+        stages, rest = pp_split_blocks(params, n)
+
+        def spmd(stg, rst, tok, tgt):
+            local = jax.tree.map(lambda a: a[0], stg)
+            loss, g_st, g_rest = pipelined_gpt_train_1f1b(
+                cfg, local, rst, tok, tgt, axis=hvd.HVD_AXES,
+                num_microbatches=M)
+            return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
+
+        loss, g_stages, g_rest = jax.jit(jax.shard_map(
+            spmd, mesh=hvd.mesh(),
+            in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
+            out_specs=(P(), P(hvd.HVD_AXES), P())))(
+            stages, rest, tokens, targets)
+
+        def dense_loss(params):
+            logits = GPT(cfg).apply({"params": params}, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        want_loss, g_dense = jax.value_and_grad(dense_loss)(params)
+        np.testing.assert_allclose(float(loss), float(want_loss),
+                                   rtol=2e-5)
+        np.testing.assert_allclose(
+            np.asarray(g_rest["wte"]), np.asarray(g_dense["wte"]),
+            rtol=1e-3, atol=1e-6)
+        got = jax.tree.map(lambda a: np.asarray(a[0, 0]), g_stages)
+        want = jax.tree.map(np.asarray, g_dense["h0"])
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3,
+                                                    atol=1e-6),
+            got, want)
+
     def test_1f1b_world1(self):
         import optax
 
