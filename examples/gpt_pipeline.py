@@ -23,7 +23,6 @@ import argparse
 import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd

@@ -305,23 +305,64 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
     scalar per-microbatch loss (every rank evaluates it SPMD-style; only
     the last rank's result/cotangents are un-masked). Returns
     ``(loss, d_stage_params, d_head_params, d_x_mbs)`` where ``loss`` is
-    the mean over microbatches (replicated), ``d_stage_params`` is this
-    rank's stage-parameter gradient (device-varying, like the stage
-    parameters themselves), ``d_head_params`` is replicated, and
+    the mean over microbatches (replicated over the PIPELINE axis),
+    ``d_stage_params`` is this rank's stage-parameter gradient
+    (device-varying, like the stage parameters themselves),
+    ``d_head_params`` is replicated over the pipeline axis, and
     ``d_x_mbs`` is the gradient w.r.t. the pipeline input (for the
     caller's embedding backward).
+
+    Composing with data parallelism: when the inputs are sharded over a
+    DP axis, every returned gradient is PER-DATA-SHARD — average over
+    the DP axes yourself (``hvd.allreduce_pytree(op=Average,
+    axes=...)``), exactly as with ``jax.grad`` under shard_map. All
+    parameter trees enter their vjps as varying copies internally so the
+    implicit pvary transpose cannot pre-sum shards
+    (tests/test_pipeline_parallel.py::test_dp_1f1b_2d).
     """
     n = _axis_size(axis)
     M = x_mbs.shape[0]
     if n == 1:
+        # Same per-data-shard gradient contract as the scheduled path:
+        # when the inputs vary over a DP axis, params enter the grad as
+        # varying copies or the implicit pvary transpose psums shard
+        # gradients together. Everything is harmonized to the UNION of
+        # varying axes (a size-1 pipeline in_spec still marks params
+        # varying over it), and the trailing ring psums — numerically
+        # identity over a size-1 axis — restore the n>1 output typing
+        # (gh/gx ring-invariant, gs ring-varying). All of this is a
+        # no-op outside shard_map, where _vma is empty.
+        from ..ops.collective_ops import _vma, pvary_missing
+
+        ring = ({axis} if isinstance(axis, str) else set(axis))
+        union = set()
+        for leaf in (jax.tree.leaves(stage_params)
+                     + jax.tree.leaves(head_params)
+                     + [x_mbs, tgt_mbs]):
+            union |= _vma(leaf)
+        union_t = tuple(sorted(union))
+
+        def v(t):
+            return jax.tree.map(lambda a: pvary_missing(a, union_t), t) \
+                if union_t else t
+
+        sp_in, hp_in, x_in, tgt_in = (v(stage_params), v(head_params),
+                                      v(x_mbs), v(tgt_mbs))
+
         def total(sp, hp, x):
             ys = jax.vmap(lambda xm: stage_fn(sp, xm))(x)
             losses = jax.vmap(lambda ym, tm: loss_fn(hp, ym, tm))(
-                ys, tgt_mbs)
+                ys, tgt_in)
             return losses.mean()
 
         loss, (gs, gh, gx) = jax.value_and_grad(total, argnums=(0, 1, 2))(
-            stage_params, head_params, x_mbs)
+            sp_in, hp_in, x_in)
+        ring_in_union = tuple(a for a in sorted(ring) if a in union)
+        if ring_in_union:
+            # identity over the size-1 ring axis; drops it from the vma
+            gh = jax.tree.map(lambda a: lax.psum(a, ring_in_union), gh)
+            gx = lax.psum(gx, ring_in_union)
+            loss = lax.psum(loss, ring_in_union)
         return loss, gs, gh, gx
 
     ax = axis if isinstance(axis, str) else tuple(axis)
@@ -365,8 +406,11 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
         m_b = t - (2 * n - 1 - r)
         b_valid = jnp.logical_and(m_b >= 0, m_b < M)
         x_saved = stash[jnp.clip(m_b, 0, M - 1) % S]
+        # Varying copy for the same reason as hp_vary below: under a DP
+        # axis the stage params are invariant over it, and the implicit
+        # pvary's transpose would psum shard gradients together.
         _, stage_vjp = jax.vjp(
-            lambda p, x: stage_fn(p, x), stage_params, x_saved)
+            lambda p, x: stage_fn(p, x), vary(stage_params), x_saved)
         gy = jnp.where(is_last, dy_state, gract)
         g_sp_m, gx = stage_vjp(gy.astype(x_saved.dtype))
         d_sp = jax.tree.map(
@@ -444,6 +488,16 @@ def pipelined_gpt_train_1f1b(cfg, stage_params, rest, tokens, targets, *,
     M = num_microbatches
 
     ep = {"wte": rest["wte"], "wpe": rest["wpe"]}
+    # Like the head params in gpipe_1f1b: when tokens are data-sharded
+    # (varying over a DP axis), the replicated embedding tree must enter
+    # its vjp as a varying copy, or the implicit pvary transposes into a
+    # psum over the data axis and g_ep comes back SUMMED across shards —
+    # the caller's DP gradient averaging then over-counts.
+    from ..ops.collective_ops import _vma, pvary_missing
+
+    tok_axes = tuple(sorted(_vma(tokens)))
+    if tok_axes:
+        ep = jax.tree.map(lambda a: pvary_missing(a, tok_axes), ep)
     x, embed_vjp = jax.vjp(lambda ep: _embed(cfg, ep, tokens), ep)
     x_mbs = x.reshape(M, B // M, T, -1)
     tgt_mbs = targets.reshape(M, B // M, T)
