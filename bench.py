@@ -483,7 +483,7 @@ def main():
                          "resnet50/18 — reference convention is 64, "
                          "docs/benchmarks.rst:27-43, 128 keeps the MXU "
                          "fed on v5e; 8 sequences for gpt)")
-    ap.add_argument("--image-size", type=int, default=224,
+    ap.add_argument("--image-size", type=int, default=None,
                     help="square image side for resnet models (small "
                          "values speed up CPU smoke runs)")
     ap.add_argument("--seq-len", type=int, default=1024,
@@ -522,10 +522,10 @@ def main():
                          "sweep without pod hardware")
     ap.add_argument("--cpu-devices", type=int, default=8,
                     help="virtual device count for --platform cpu")
-    ap.add_argument("--num-warmup", type=int, default=5)
-    ap.add_argument("--num-iters", type=int, default=10,
+    ap.add_argument("--num-warmup", type=int, default=None)
+    ap.add_argument("--num-iters", type=int, default=None,
                     help="timing rounds (reference: 10)")
-    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=None)
     ap.add_argument("--fp16-allreduce", action="store_true",
                     help="bf16 wire compression (reference flag name kept)")
     ap.add_argument("--space-to-depth", action="store_true",
@@ -539,8 +539,17 @@ def main():
                     help="run K train steps per device call via lax.scan "
                          "(host-loop offload; hides per-dispatch latency)")
     args = ap.parse_args()
+    # None sentinels distinguish unset from explicitly-passed-default, so
+    # the CPU-fallback shrink can honor EXACTLY the flags the user typed.
+    _shrinkable = ("batch_size", "image_size", "num_warmup", "num_iters",
+                   "num_batches_per_iter")
+    explicit = {k: getattr(args, k) is not None for k in _shrinkable}
     if args.batch_size is None:
         args.batch_size = 8 if args.model == "gpt" else 128
+    for k, dflt in (("image_size", 224), ("num_warmup", 5),
+                    ("num_iters", 10), ("num_batches_per_iter", 10)):
+        if getattr(args, k) is None:
+            setattr(args, k, dflt)
     if args.steps_per_call < 1:
         ap.error("--steps-per-call must be >= 1")
     if args.profile and args.num_iters < 2:
@@ -562,6 +571,24 @@ def main():
         devices, platform = force_cpu_backend(max(want, args.cpu_devices))
     else:
         devices, platform = init_backend()
+        if platform == "cpu":
+            # Accelerator-unavailable fallback: shrink the workload so the
+            # run still finishes inside a driver timeout (a TPU-sized
+            # ResNet-50 batch on CPU takes hours — the round-1 rc!=0
+            # failure mode). Only knobs the user left at defaults shrink.
+            shrunk = {}
+            if not explicit["batch_size"]:
+                args.batch_size = 8 if args.model != "gpt" else 2
+                shrunk["batch_size"] = args.batch_size
+            for name, small in (("image_size", 96), ("num_warmup", 1),
+                                ("num_iters", 3),
+                                ("num_batches_per_iter", 2)):
+                if not explicit[name]:
+                    setattr(args, name, small)
+                    shrunk[name] = small
+            if shrunk:
+                log(f"CPU fallback: shrunk workload {shrunk} so the run "
+                    f"completes (explicit flags are honored)")
     if args.chips is not None:
         if args.chips < 1:
             ap.error("--chips must be >= 1")

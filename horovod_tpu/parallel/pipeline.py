@@ -36,6 +36,16 @@ from jax import lax
 from .sequence import _axis_size
 
 
+def _pvary_tree(tree, axes):
+    """pvary_missing over every leaf (single home for the tree-mapped
+    form of collective_ops' idiom)."""
+    from ..ops.collective_ops import pvary_missing
+
+    if not axes:
+        return tree
+    return jax.tree.map(lambda a: pvary_missing(a, tuple(axes)), tree)
+
+
 def _carry_axes(axis, x_mbs, stage_params):
     """Varying-axes type for pipeline scan carries: the pipeline axis
     itself plus whatever the inputs/stage params already vary over (e.g.
@@ -342,12 +352,10 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
             union |= _vma(leaf)
         union_t = tuple(sorted(union))
 
-        def v(t):
-            return jax.tree.map(lambda a: pvary_missing(a, union_t), t) \
-                if union_t else t
-
-        sp_in, hp_in, x_in, tgt_in = (v(stage_params), v(head_params),
-                                      v(x_mbs), v(tgt_mbs))
+        sp_in, hp_in, x_in, tgt_in = (
+            _pvary_tree(stage_params, union_t),
+            _pvary_tree(head_params, union_t),
+            _pvary_tree(x_mbs, union_t), _pvary_tree(tgt_mbs, union_t))
 
         def total(sp, hp, x):
             ys = jax.vmap(lambda xm: stage_fn(sp, xm))(x)
@@ -379,7 +387,7 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
     axes_t = _carry_axes(axis, x_mbs, stage_params)
 
     def vary(tree):
-        return jax.tree.map(lambda a: pvary_missing(a, axes_t), tree)
+        return _pvary_tree(tree, axes_t)
 
     mb_shape = x_mbs.shape[1:]
     zeros_mb = pvary_missing(jnp.zeros(mb_shape, x_mbs.dtype), axes_t)
@@ -493,11 +501,9 @@ def pipelined_gpt_train_1f1b(cfg, stage_params, rest, tokens, targets, *,
     # its vjp as a varying copy, or the implicit pvary transposes into a
     # psum over the data axis and g_ep comes back SUMMED across shards —
     # the caller's DP gradient averaging then over-counts.
-    from ..ops.collective_ops import _vma, pvary_missing
+    from ..ops.collective_ops import _vma
 
-    tok_axes = tuple(sorted(_vma(tokens)))
-    if tok_axes:
-        ep = jax.tree.map(lambda a: pvary_missing(a, tok_axes), ep)
+    ep = _pvary_tree(ep, tuple(sorted(_vma(tokens))))
     x, embed_vjp = jax.vjp(lambda ep: _embed(cfg, ep, tokens), ep)
     x_mbs = x.reshape(M, B // M, T, -1)
     tgt_mbs = targets.reshape(M, B // M, T)

@@ -291,8 +291,8 @@ class TestPipelinedGPT:
         # axis is non-trivial (restored in the finally that wraps the
         # WHOLE body: a failure must not leak the mesh to later tests).
         hvd.shutdown()
-        hvd.init(devices=jax.devices(), mesh_shape=(2, 4))
         try:
+            hvd.init(devices=jax.devices(), mesh_shape=(2, 4))
             self._run_dp_1f1b()
         finally:
             hvd.shutdown()
@@ -302,8 +302,8 @@ class TestPipelinedGPT:
         """Degenerate pipeline (n=1) under a real DP axis — the n==1
         fast path must keep the same per-shard gradient contract."""
         hvd.shutdown()
-        hvd.init(devices=jax.devices()[:2], mesh_shape=(2, 1))
         try:
+            hvd.init(devices=jax.devices()[:2], mesh_shape=(2, 1))
             self._run_dp_1f1b(expect_pp=1)
         finally:
             hvd.shutdown()
