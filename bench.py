@@ -486,8 +486,9 @@ def main():
     ap.add_argument("--image-size", type=int, default=None,
                     help="square image side for resnet models (small "
                          "values speed up CPU smoke runs)")
-    ap.add_argument("--seq-len", type=int, default=1024,
-                    help="sequence length for --model gpt")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="sequence length for --model gpt "
+                         "(default 1024)")
     ap.add_argument("--vocab-size", type=int, default=32000,
                     help="GPT vocabulary size (the fused-vs-dense LM loss "
                          "crossover depends on it)")
@@ -542,12 +543,13 @@ def main():
     # None sentinels distinguish unset from explicitly-passed-default, so
     # the CPU-fallback shrink can honor EXACTLY the flags the user typed.
     _shrinkable = ("batch_size", "image_size", "num_warmup", "num_iters",
-                   "num_batches_per_iter")
+                   "num_batches_per_iter", "seq_len")
     explicit = {k: getattr(args, k) is not None for k in _shrinkable}
     if args.batch_size is None:
         args.batch_size = 8 if args.model == "gpt" else 128
     for k, dflt in (("image_size", 224), ("num_warmup", 5),
-                    ("num_iters", 10), ("num_batches_per_iter", 10)):
+                    ("num_iters", 10), ("num_batches_per_iter", 10),
+                    ("seq_len", 1024)):
         if getattr(args, k) is None:
             setattr(args, k, dflt)
     if args.steps_per_call < 1:
@@ -582,7 +584,8 @@ def main():
                 shrunk["batch_size"] = args.batch_size
             for name, small in (("image_size", 96), ("num_warmup", 1),
                                 ("num_iters", 3),
-                                ("num_batches_per_iter", 2)):
+                                ("num_batches_per_iter", 2),
+                                ("seq_len", 128)):
                 if not explicit[name]:
                     setattr(args, name, small)
                     shrunk[name] = small

@@ -152,6 +152,16 @@ def _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis):
             "the pipelined GPT assembly does not support MoE blocks: the "
             "router's sown aux loss cannot be returned through the "
             "pipeline stages (apply the MoE model under DP/EP instead)")
+    if getattr(cfg, "tp_axis", None) and _axis_size(cfg.tp_axis) > 1:
+        # With an ACTIVE tp axis (size > 1 — models/gpt.py's _tp_size
+        # no-ops a size-1 axis), _Attention/_Mlp psum partial products
+        # over it — but pp_split_blocks hands every pipeline rank FULL
+        # (un-tp-sliced) stage weights, so those psums would sum complete
+        # outputs tp-fold and silently produce garbage.
+        raise ValueError(
+            "the pipelined GPT assembly does not support tp_axis: stage "
+            "parameters are not tensor-parallel-sliced (compose TP with "
+            "DP/SP instead, or drop tp_axis for the pipeline path)")
     if cfg.attention in ("ring", "flash_ring", "ulysses"):
         seq_axes = ({cfg.seq_axis} if isinstance(cfg.seq_axis, str)
                     else set(cfg.seq_axis))
@@ -342,7 +352,7 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
         # identity over a size-1 axis — restore the n>1 output typing
         # (gh/gx ring-invariant, gs ring-varying). All of this is a
         # no-op outside shard_map, where _vma is empty.
-        from ..ops.collective_ops import _vma, pvary_missing
+        from ..ops.collective_ops import _vma
 
         ring = ({axis} if isinstance(axis, str) else set(axis))
         union = set()

@@ -98,6 +98,27 @@ class TestPipelinedGPT:
                 in_specs=(P(hvd.HVD_AXES), P(), P()),
                 out_specs=P()))(stages, rest, tokens)
 
+    def test_tp_axis_rejected(self):
+        """tp_axis with un-tp-sliced stage params would psum complete
+        outputs tp-fold — must raise, like the seq-axis/MoE guards."""
+        import dataclasses
+
+        cfg, params, tokens = self._setup()
+        stages, rest = pp_split_blocks(params, hvd.size())
+        bad = dataclasses.replace(cfg, tp_axis=hvd.LOCAL_AXIS)
+
+        def spmd(stg, rst, tok):
+            local = jax.tree.map(lambda a: a[0], stg)
+            return pipelined_gpt_apply(bad, local, rst, tok,
+                                       axis=hvd.HVD_AXES,
+                                       num_microbatches=2)
+
+        with pytest.raises(ValueError, match="tp_axis"):
+            jax.jit(jax.shard_map(
+                spmd, mesh=hvd.mesh(),
+                in_specs=(P(hvd.HVD_AXES), P(), P()),
+                out_specs=P()))(stages, rest, tokens)
+
     def test_dp_pp_2d(self):
         """DP over hvd_cross x PP over hvd_local: batch-sharded pipelined
         forward equals the dense model."""
