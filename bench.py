@@ -675,8 +675,14 @@ def main():
             "vs 819 GB/s HBM peak on v5e incl. VMEM prefetch hits); "
             "see README.md 'Benchmark methodology'. Matmul-bound "
             "flagship via --model gpt (same step/collectives, Pallas "
-            "flash attention): GPT-124M 117.2k tok/s/chip MFU 0.43, "
-            "GPT-350M 42.9k tok/s/chip MFU 0.472 on this chip")}
+            "flash attention): GPT-124M 117.2-117.3k tok/s/chip MFU "
+            "0.43 (re-verified r4 under the lm-loss auto default), "
+            "GPT-350M 42.9k tok/s/chip MFU 0.472. Fused-CE envelope: "
+            "batch 32 x 128k vocab runs 75.9k tok/s MFU 0.45 where "
+            "the dense head cannot compile (17 GB logits vs 16 GB "
+            "HBM); dense wins 4-11% at every vocab that fits "
+            "(README vocab sweep). Weak-scaling harness: --scaling "
+            "1,..,64 (dryrun leg 9)")}
            if args.model == "resnet50"
            and "v5 lite" in getattr(devices[0], "device_kind", "").lower()
            else {}),
