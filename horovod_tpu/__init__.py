@@ -87,6 +87,7 @@ from .ops.collective_ops import (  # noqa: F401
     allreduce_async,
     alltoall,
     alltoall_async,
+    alltoall_ragged,
     barrier,
     broadcast,
     broadcast_async,

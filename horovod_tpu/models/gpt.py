@@ -70,6 +70,13 @@ class GPTConfig:
     moe_experts: int = 0
     ep_axis: Optional[str] = None
     moe_capacity_factor: float = 1.25
+    # Ragged (uneven-alltoall) expert dispatch: pools expert capacity
+    # across senders instead of a per-(sender, expert) quota (reference
+    # uneven-splits path: operations.cc:1031-1092).
+    # moe_pair_capacity_factor bounds each (sender -> rank) block at
+    # factor * N / n rows.
+    moe_ragged: bool = False
+    moe_pair_capacity_factor: float = 2.0
     # Return the final-LayerNorm hidden states [B, T, d_model] instead of
     # logits — for a fused LM-head loss (ops/softmax_xent.py) that never
     # materializes the [N, vocab] logits. Parameters are identical either
@@ -180,6 +187,8 @@ class _Block(nn.Module):
             ffn = SwitchMoE(num_experts=cfg.moe_experts, d_ff=cfg.d_ff,
                             capacity_factor=cfg.moe_capacity_factor,
                             ep_axis=cfg.ep_axis, dtype=cfg.dtype,
+                            ragged=cfg.moe_ragged,
+                            pair_capacity_factor=cfg.moe_pair_capacity_factor,
                             name="moe")
         else:
             ffn = _MLP(cfg, name="mlp")

@@ -483,6 +483,147 @@ def alltoall(tensor, splits=None, *, name: Optional[str] = None, axes=None):
     return out, recv
 
 
+def alltoall_ragged(tensor, splits, *, capacity: int,
+                    name: Optional[str] = None, axes=None,
+                    recv_splits=None):
+    """Uneven alltoall that compiles under ``jit`` via a static-capacity
+    padded exchange.
+
+    The reference negotiates per-pair receive counts at runtime and
+    allocates an exactly-sized output (operations.cc:1031-1092;
+    ``AlltoallGetRecvSplits``, controller.h:145).  XLA requires static
+    shapes, so the TPU-native protocol trades exactness for a static
+    per-pair bound:
+
+    1. each pair block (the rows destined for rank ``i``) is padded to
+       ``capacity`` rows into an ``[n, capacity, ...]`` send buffer
+       (padding rows are zeroed so no garbage rides the wire);
+    2. the per-pair counts ride a tiny int32 ``lax.all_to_all`` — the
+       compiled analogue of the controller's recv-splits negotiation;
+    3. one tiled ``lax.all_to_all`` moves the padded payload over ICI;
+    4. received blocks are compacted to the front of the output with a
+       drop-mode scatter on the padding rows.
+
+    Args:
+      tensor: ``[T, ...]`` laid out destination-major — rows
+        ``[sum(splits[:i]), sum(splits[:i+1]))`` go to rank ``i``.
+      splits: int32 ``[n]``; may be a *traced* array (dynamic values,
+        static shape).  Entries are clamped to ``capacity``: rows beyond
+        it are dropped at the sender and the clamped count is what the
+        receiver sees in ``recv_splits`` (the Switch-MoE overflow
+        contract; pick ``capacity >= max(splits)`` for losslessness).
+      capacity: static per-pair row bound (python int).
+      recv_splits: optional precomputed int32 ``[n]`` of incoming
+        per-pair counts (e.g. from a prior ``alltoall_ragged`` with the
+        same splits this step) — skips the counts negotiation
+        collective.  Values are clamped to ``capacity``; they must match
+        what peers actually send or rows will be mis-compacted.
+
+    Returns ``(out, recv_splits)`` where ``out`` is
+    ``[n * capacity, ...]`` with the received blocks compacted to the
+    front (rows past ``sum(recv_splits)`` are zeros) and ``recv_splits``
+    is int32 ``[n]`` — ``recv_splits[i]`` rows arrived from rank ``i``.
+
+    Outside shard_map the same contract runs over the process world
+    through the native controller's uneven path (clamp + compact on the
+    host, then pad the exact-sized result up to the capacity layout).
+    """
+    tensor = jnp.asarray(tensor)
+    if tensor.ndim == 0:
+        raise ValueError("alltoall_ragged requires a tensor with ndim >= 1")
+    capacity = int(capacity)
+    if capacity < 1:
+        raise ValueError(f"capacity must be >= 1, got {capacity}")
+    axes_t = _resolve_axes(axes)
+
+    if not axes_t:
+        return _eager_alltoall_ragged(tensor, splits, capacity, name)
+
+    n = _world_size(axes_t)
+    if not isinstance(splits, jax.core.Tracer):
+        if np.any(np.asarray(splits) < 0):
+            raise ValueError(f"splits must be non-negative, got {splits}")
+    splits = jnp.maximum(jnp.asarray(splits, dtype=jnp.int32), 0)
+    if splits.shape != (n,):
+        raise ValueError(
+            f"splits must have shape ({n},) for a world of {n}, got "
+            f"{splits.shape}")
+    sp = jnp.minimum(splits, capacity)
+
+    T = tensor.shape[0]
+    rest = tensor.shape[1:]
+    j = jnp.arange(capacity, dtype=jnp.int32)
+    valid_send = j[None, :] < sp[:, None]                  # [n, capacity]
+    if T == 0:
+        send = jnp.zeros((n, capacity) + rest, tensor.dtype)
+    else:
+        # Block offsets follow the CALLER's layout (the original splits,
+        # overflow rows included); only the first sp[i] rows of each
+        # block are picked up.
+        offs = jnp.cumsum(splits) - splits
+        idx = jnp.clip(offs[:, None] + j[None, :], 0, T - 1)
+        send = jnp.take(tensor, idx.reshape(-1), axis=0).reshape(
+            (n, capacity) + rest)
+        mask = valid_send.reshape((n, capacity) + (1,) * len(rest))
+        send = jnp.where(mask, send, jnp.zeros((), tensor.dtype))
+
+    if n > 1:
+        # pvary replicated operands: all_to_all needs device-varying
+        # inputs under jax 0.9's VMA model.
+        if recv_splits is None:
+            recv_splits = lax.all_to_all(
+                pvary_missing(sp, axes_t), axes_t, split_axis=0,
+                concat_axis=0, tiled=True)
+        else:
+            recv_splits = jnp.clip(
+                jnp.asarray(recv_splits, jnp.int32), 0, capacity)
+        recv = lax.all_to_all(
+            pvary_missing(send, axes_t), axes_t, split_axis=0,
+            concat_axis=0, tiled=True)
+    else:
+        recv_splits = sp if recv_splits is None else jnp.clip(
+            jnp.asarray(recv_splits, jnp.int32), 0, capacity)
+        recv = send
+
+    # Compact: scatter valid rows to the front, padding rows off the end
+    # (mode="drop" discards out-of-bounds destinations).
+    roffs = jnp.cumsum(recv_splits) - recv_splits
+    valid_recv = j[None, :] < recv_splits[:, None]
+    dest = jnp.where(valid_recv, roffs[:, None] + j[None, :], n * capacity)
+    flat = recv.reshape((n * capacity,) + rest)
+    out = jnp.zeros_like(flat).at[dest.reshape(-1)].set(flat, mode="drop")
+    return out, recv_splits
+
+
+def _eager_alltoall_ragged(tensor, splits, capacity: int,
+                           name: Optional[str] = None):
+    """Host-path ``alltoall_ragged``: same padded-output contract, data
+    moves through the native controller's uneven alltoall."""
+    world = _eager_world()
+    splits_np = np.asarray(splits, dtype=np.int64)
+    if splits_np.shape != (world,):
+        raise ValueError(
+            f"splits must have shape ({world},) for a process world of "
+            f"{world}, got {splits_np.shape}")
+    if np.any(splits_np < 0):
+        raise ValueError(f"splits must be non-negative, got {splits_np}")
+    sp = np.minimum(splits_np, capacity)
+    offs = np.cumsum(splits_np) - splits_np
+    keep = np.concatenate(
+        [offs[i] + np.arange(sp[i]) for i in range(world)]
+    ).astype(np.int64) if world else np.zeros((0,), np.int64)
+    compacted = jnp.take(tensor, keep, axis=0)
+    out, recv = _eager_alltoall(compacted, sp.astype(np.int32), name)
+    if recv is None:  # world of one: everything loops back locally
+        recv = jnp.asarray(sp, dtype=jnp.int32)
+    total = world * capacity
+    pad = total - out.shape[0]
+    if pad:
+        out = jnp.concatenate(
+            [out, jnp.zeros((pad,) + out.shape[1:], out.dtype)], axis=0)
+    return out, jnp.asarray(recv, dtype=jnp.int32)
+
+
 def join() -> int:
     """Signal that this process has exhausted its data (reference: JoinOp,
     collective_operations.cc:256-264; torch/mpi_ops.py:646).

@@ -37,6 +37,31 @@ from jax import lax
 from .sequence import _axis_size
 
 
+def _route(x, router_kernel, E):
+    """Shared Switch top-1 routing: returns ``(expert, gate, aux,
+    onehot)`` — argmax expert id [N], gate probability [N], the
+    load-balancing aux loss (Switch eq. 4: E · Σ_e f_e · P_e), and the
+    int32 [N, E] expert one-hot (built once; callers reuse it)."""
+    probs = jax.nn.softmax(
+        jnp.einsum("nc,ce->ne", x.astype(jnp.float32),
+                   router_kernel.astype(jnp.float32)), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)                    # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)    # [N, E]
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    aux = E * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return expert, gate, aux, onehot
+
+
+def _check_experts(router_kernel, E_local, n):
+    E = E_local * n
+    if router_kernel.shape[-1] != E:
+        raise ValueError(
+            f"router has {router_kernel.shape[-1]} experts but "
+            f"E_local {E_local} x axis size {n} = {E}")
+    return E
+
+
 def switch_moe(x, router_kernel, w1, b1, w2, b2, *,
                axis: Optional[str] = None,
                capacity_factor: float = 1.25):
@@ -49,31 +74,16 @@ def switch_moe(x, router_kernel, w1, b1, w2, b2, *,
     """
     N, C = x.shape
     n = _axis_size(axis) if axis else 1
-    E_local = w1.shape[0]
-    E = E_local * n
-    if router_kernel.shape[-1] != E:
-        raise ValueError(
-            f"router has {router_kernel.shape[-1]} experts but "
-            f"E_local {E_local} x axis size {n} = {E}")
+    E = _check_experts(router_kernel, w1.shape[0], n)
     # Per-expert capacity: every rank contributes N tokens to E experts.
     capacity = max(1, int(N * capacity_factor / E + 0.9999))
 
-    logits = jnp.einsum("nc,ce->ne", x.astype(jnp.float32),
-                        router_kernel.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)                # [N, E]
-    expert = jnp.argmax(probs, axis=-1)                    # [N]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    expert, gate, aux, onehot = _route(x, router_kernel, E)
 
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)    # [N, E]
     # Position of each token within its expert's queue.
     pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
     keep = pos < capacity                                  # overflow drop
     pos_c = jnp.minimum(pos, capacity - 1)
-
-    # Switch aux loss: fraction of tokens per expert x mean router prob.
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
-    mean_p = jnp.mean(probs, axis=0)
-    aux = E * jnp.sum(frac * mean_p)
 
     dispatch = jnp.zeros((E, capacity, C), x.dtype).at[expert, pos_c].add(
         jnp.where(keep[:, None], x, 0))
@@ -99,6 +109,103 @@ def switch_moe(x, router_kernel, w1, b1, w2, b2, *,
     return y.astype(x.dtype), aux
 
 
+def switch_moe_ragged(x, router_kernel, w1, b1, w2, b2, *,
+                      axis: Optional[str] = None,
+                      capacity_factor: float = 1.25,
+                      pair_capacity_factor: float = 2.0):
+    """Top-1 MoE with *ragged* all-to-all dispatch (uneven per-rank
+    splits, reference: MPI_Alltoallv path, operations.cc:1031-1092).
+
+    Same signature/returns as :func:`switch_moe`, different dispatch
+    protocol.  Instead of a fixed ``[E, capacity, C]`` buffer where each
+    (sender, expert) pair has a hard quota, tokens are sorted by
+    destination *rank* and exchanged with
+    :func:`~horovod_tpu.ops.collective_ops.alltoall_ragged`; the
+    receiver then pools each local expert's capacity across ALL senders.
+    Drops now happen only when
+
+    * a single (sender → rank) pair exceeds
+      ``pair_capacity_factor * N / n`` rows (gross rank-level skew), or
+    * one expert *globally* exceeds ``capacity_factor * N * n / E``
+      rows (the same total as :func:`switch_moe`, but pooled instead of
+      per-sender),
+
+    which is strictly laxer than the fixed path's per-(sender, expert)
+    quota — the capacity-overflow cliff VERDICT r4 flagged.  Dropped
+    tokens still emit zeros and ride the residual.
+    """
+    N, C = x.shape
+    n = _axis_size(axis) if axis else 1
+    E_local = w1.shape[0]
+    E = _check_experts(router_kernel, E_local, n)
+    # Pooled per-local-expert capacity: global token count over global
+    # expert count, same total buffer bytes as the fixed path.
+    local_cap = max(1, int(N * n * capacity_factor / E + 0.9999))
+
+    expert, gate, aux, _ = _route(x, router_kernel, E)
+
+    dest = (expert // E_local).astype(jnp.int32)           # owning rank
+    e_loc = (expert % E_local).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)                 # dest-major
+    xs, es, blk = x[order], e_loc[order], dest[order]
+    splits = jnp.sum(jax.nn.one_hot(dest, n, dtype=jnp.int32), axis=0)
+
+    if n > 1:
+        pair_cap = max(1, min(N, int(N * pair_capacity_factor / n
+                                     + 0.9999)))
+        from ..ops.collective_ops import alltoall_ragged
+        recv_x, recv_splits = alltoall_ragged(
+            xs, splits, capacity=pair_cap, axes=axis)
+        # Same splits as the x exchange: reuse its negotiated counts.
+        recv_e, _ = alltoall_ragged(es, splits, capacity=pair_cap,
+                                    axes=axis, recv_splits=recv_splits)
+    else:
+        pair_cap = N
+        recv_x, recv_splits, recv_e = xs, splits, es
+
+    R = recv_x.shape[0]                                    # n * pair_cap
+    rvalid = jnp.arange(R) < jnp.sum(recv_splits)          # compacted
+    re = jnp.where(rvalid, recv_e, 0)
+
+    # Running position within each local expert's pooled queue.
+    oh = jax.nn.one_hot(re, E_local, dtype=jnp.int32) * \
+        rvalid[:, None].astype(jnp.int32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1
+    keep = rvalid & (pos >= 0) & (pos < local_cap)
+    pos_c = jnp.clip(pos, 0, local_cap - 1)
+
+    buf = jnp.zeros((E_local, local_cap, C), x.dtype).at[re, pos_c].add(
+        jnp.where(keep[:, None], recv_x, 0))
+
+    h = jnp.einsum("ekc,ecf->ekf", buf, w1) + b1[:, None]
+    h = nn.gelu(h)
+    out = jnp.einsum("ekf,efc->ekc", h, w2) + b2[:, None]
+
+    # Back to the received-row order (dropped rows -> zeros), then home.
+    rows_out = out[re, pos_c] * keep[:, None].astype(out.dtype)
+    sp_c = jnp.minimum(splits, pair_cap)
+    if n > 1:
+        # Return-trip recv counts are our own clamped sends — no
+        # negotiation needed.
+        back, _ = alltoall_ragged(rows_out, recv_splits, capacity=pair_cap,
+                                  axes=axis, recv_splits=sp_c)
+    else:
+        back = rows_out
+
+    # Sorted-token -> compact return position: block r of the return
+    # buffer holds min(splits[r], pair_cap) rows in send order.
+    boffs = jnp.cumsum(sp_c) - sp_c
+    offs = jnp.cumsum(splits) - splits
+    p = jnp.arange(N)
+    p_in = p - offs[blk]
+    sent = p_in < pair_cap
+    cpos = jnp.where(sent, boffs[blk] + p_in, 0)
+    y_sorted = jnp.where(sent[:, None], back[cpos], 0)
+    inv = jnp.argsort(order)
+    y = y_sorted[inv] * gate[:, None].astype(y_sorted.dtype)
+    return y.astype(x.dtype), aux
+
+
 class SwitchMoE(nn.Module):
     """Flax module: Switch-MoE FFN (drop-in for a dense MLP block).
 
@@ -114,6 +221,12 @@ class SwitchMoE(nn.Module):
     ep_axis: Optional[str] = None
     dtype: jnp.dtype = jnp.float32
     kernel_init_std: float = 0.02
+    # Ragged (uneven alltoall) dispatch: pools expert capacity across
+    # senders, removing the per-(sender, expert) overflow cliff.
+    # pair_capacity_factor bounds the (sender -> rank) block at
+    # pair_capacity_factor * N / n rows (ragged path only).
+    ragged: bool = False
+    pair_capacity_factor: float = 2.0
 
     @nn.compact
     def __call__(self, x):
@@ -133,11 +246,17 @@ class SwitchMoE(nn.Module):
         w2 = self.param("w2", init, (e_local, self.d_ff, C), jnp.float32)
         b2 = self.param("b2", nn.initializers.zeros, (e_local, C),
                         jnp.float32)
-        y, aux = switch_moe(
+        kw = {}
+        if self.ragged:
+            moe_fn = switch_moe_ragged
+            kw["pair_capacity_factor"] = self.pair_capacity_factor
+        else:
+            moe_fn = switch_moe
+        y, aux = moe_fn(
             x.reshape(B * T, C),
             router, w1.astype(self.dtype), b1.astype(self.dtype),
             w2.astype(self.dtype), b2.astype(self.dtype),
-            axis=self.ep_axis, capacity_factor=self.capacity_factor)
+            axis=self.ep_axis, capacity_factor=self.capacity_factor, **kw)
         self.sow("intermediates", "moe_aux_loss", aux)
         return y.reshape(B, T, C)
 

@@ -20,6 +20,7 @@ from horovod_tpu.parallel.expert import (
     SwitchMoE,
     ep_split_params,
     switch_moe,
+    switch_moe_ragged,
 )
 from horovod_tpu.parallel.tensor import tp_merge_params
 
@@ -100,6 +101,136 @@ class TestSwitchMoE:
                 mesh=hvd.mesh(),
                 in_specs=(P(), P(), P(), P(), P(), P()),
                 out_specs=P()))(x, router, w1, b1, w2, b2)
+
+
+def _per_token_expect(x, router, w1, b1, w2, b2):
+    import flax.linen as nn
+
+    probs = jax.nn.softmax(x @ router)
+    e = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, e[:, None], axis=-1)[:, 0]
+    h = nn.gelu(jnp.einsum("nc,ncf->nf", x, w1[e]) + b1[e])
+    return (jnp.einsum("nf,nfc->nc", h, w2[e]) + b2[e]) * gate[:, None]
+
+
+class TestSwitchMoERagged:
+    def test_matches_per_token_ffn_world1(self):
+        x, router, w1, b1, w2, b2 = _layer_data()
+        y, aux = switch_moe_ragged(x, router, w1, b1, w2, b2,
+                                   capacity_factor=8.0)
+        expect = _per_token_expect(x, router, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                                   rtol=1e-5, atol=1e-5)
+        assert float(aux) > 0
+
+    def test_ep_sharded_per_rank_tokens(self):
+        """8-way EP, DIFFERENT tokens per rank, no drops: exact against
+        the per-token FFN on every rank's own tokens."""
+        n = hvd.size()
+        Np, C, F, E = 8, 16, 32, 8
+        rs = np.random.RandomState(3)
+        x_all = jnp.asarray(rs.randn(n * Np, C), jnp.float32) * 0.5
+        _, router, w1, b1, w2, b2 = _layer_data(C=C, F=F, E=E, seed=3)
+
+        def spmd(x, router, w1s, b1s, w2s, b2s):
+            y, aux = switch_moe_ragged(
+                x, router, w1s[0], b1s[0], w2s[0], b2s[0],
+                axis=hvd.HVD_AXES, capacity_factor=8.0,
+                pair_capacity_factor=8.0)
+            return y, hvd.allreduce(aux, op=hvd.Average)
+
+        stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
+        y, _ = jax.jit(jax.shard_map(
+            spmd, mesh=hvd.mesh(),
+            in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES),
+                      P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
+            out_specs=(P(hvd.HVD_AXES), P())))(
+            x_all, router, stack(w1), stack(b1), stack(w2), stack(b2))
+        expect = _per_token_expect(x_all, router, w1, b1, w2, b2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_ragged_pools_capacity_fixed_drops(self):
+        """Sender-skewed routing: the fixed path's per-(sender, expert)
+        quota drops tokens the ragged pooled capacity keeps."""
+        n = hvd.size()
+        Np, C = 8, 8
+        E, F = 8, 16
+        # Router ~ 10*I with C == E: token one_hot(e) routes to expert e.
+        router = jnp.eye(C, E) * 10.0
+        rs = np.random.RandomState(4)
+        w1 = jnp.asarray(rs.randn(E, C, F), jnp.float32) * 0.1
+        b1 = jnp.asarray(rs.randn(E, F), jnp.float32) * 0.01
+        w2 = jnp.asarray(rs.randn(E, F, C), jnp.float32) * 0.1
+        b2 = jnp.asarray(rs.randn(E, C), jnp.float32) * 0.01
+        # Rank 0's tokens ALL route to expert 0; rank r>0's tokens to
+        # expert r. Global expert-0 load (8) == pooled cap at cf=1.0
+        # (N*n/E = 8), but blows the per-sender quota (N*cf/E = 1).
+        dest_e = np.zeros((n, Np), np.int64)
+        for r in range(1, n):
+            dest_e[r, :] = r
+        x_all = jnp.asarray(np.eye(C)[dest_e.reshape(-1)], jnp.float32)
+
+        def run(moe_fn, **kw):
+            def spmd(x, router, w1s, b1s, w2s, b2s):
+                y, _ = moe_fn(x, router, w1s[0], b1s[0], w2s[0], b2s[0],
+                              axis=hvd.HVD_AXES, capacity_factor=1.0, **kw)
+                return y
+
+            stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
+            return np.asarray(jax.jit(jax.shard_map(
+                spmd, mesh=hvd.mesh(),
+                in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES),
+                          P(hvd.HVD_AXES), P(hvd.HVD_AXES),
+                          P(hvd.HVD_AXES)),
+                out_specs=P(hvd.HVD_AXES)))(
+                x_all, router, stack(w1), stack(b1), stack(w2), stack(b2)))
+
+        y_fixed = run(switch_moe)
+        y_ragged = run(switch_moe_ragged, pair_capacity_factor=8.0)
+        # Fixed: rank 0 keeps only 1 of its 8 expert-0 tokens.
+        rank0_fixed = np.abs(y_fixed[:Np]).sum(-1)
+        assert np.count_nonzero(rank0_fixed > 1e-9) == 1
+        # Ragged: pooled capacity keeps all of them — exact everywhere.
+        expect = np.asarray(_per_token_expect(x_all, router, w1, b1, w2, b2))
+        np.testing.assert_allclose(y_ragged, expect, rtol=1e-4, atol=1e-5)
+
+    def test_ragged_gradients_match_dense_no_drop(self):
+        """d(loss)/d(params) through the ragged dispatch == world-1."""
+        n = hvd.size()
+        Np, C, F, E = 4, 8, 16, 8
+        rs = np.random.RandomState(5)
+        x_all = jnp.asarray(rs.randn(n * Np, C), jnp.float32) * 0.5
+        _, router, w1, b1, w2, b2 = _layer_data(C=C, F=F, E=E, seed=5)
+
+        def loss_world1(w1, w2):
+            y, _ = switch_moe_ragged(x_all, router, w1, b1, w2, b2,
+                                     capacity_factor=8.0)
+            return jnp.sum(y * y)
+
+        g1 = jax.grad(loss_world1, argnums=(0, 1))(w1, w2)
+
+        def loss_spmd(x, w1s, b1s, w2s, b2s):
+            def inner(w1r, w2r):
+                y, _ = switch_moe_ragged(
+                    x, router, w1r, b1s[0], w2r, b2s[0],
+                    axis=hvd.HVD_AXES, capacity_factor=8.0,
+                    pair_capacity_factor=8.0)
+                return jax.lax.psum(jnp.sum(y * y), hvd.HVD_AXES)
+
+            return jax.grad(inner, argnums=(0, 1))(w1s[0], w2s[0])
+
+        stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
+        g8 = jax.jit(jax.shard_map(
+            loss_spmd, mesh=hvd.mesh(),
+            in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(hvd.HVD_AXES),
+                      P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
+            out_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES))))(
+            x_all, stack(w1), stack(b1), stack(w2), stack(b2))
+        np.testing.assert_allclose(np.asarray(g8[0]).reshape(w1.shape),
+                                   np.asarray(g1[0]), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g8[1]).reshape(w2.shape),
+                                   np.asarray(g1[1]), rtol=1e-4, atol=1e-5)
 
 
 class TestMoEGPT:
