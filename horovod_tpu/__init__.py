@@ -124,6 +124,7 @@ from .parallel.expert import (  # noqa: F401
     SwitchMoE,
     ep_split_params,
     switch_moe,
+    switch_moe_ragged,
 )
 from .parallel.pipeline import (  # noqa: F401
     gpipe,

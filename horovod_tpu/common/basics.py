@@ -216,6 +216,13 @@ def init(
             _state.timeline = Timeline(_state.config.timeline,
                                        mark_cycles=_state.config.timeline_mark_cycles)
         _state.initialized = True
+    # Outside the lock (uses eager collectives): multi-host runs verify
+    # that every host loaded an identical kernel-autotune cache before
+    # any cached block choice may shape a compiled program.
+    if _state.process_count > 1:
+        from ..ops import kernel_autotune
+
+        kernel_autotune.verify_multihost_cache()
 
 
 def shutdown() -> None:

@@ -36,6 +36,23 @@ _lock = threading.Lock()
 _mem: Dict[str, dict] = {}
 _loaded = False
 
+# Bump when a kernel's implementation changes in a way that invalidates
+# previously-tuned block choices (ADVICE r4: stale cache entries were
+# returned before any legality/sweep logic runs). The candidate grid is
+# additionally hashed into the key, so grid edits self-invalidate.
+_KERNEL_VERSIONS: Dict[str, int] = {
+    "flash_attention": 1,
+    "linear_xent": 1,
+}
+
+
+def _grid_token(candidates: Sequence[Tuple[int, ...]]) -> str:
+    import hashlib
+
+    return hashlib.md5(
+        repr(sorted(tuple(c) for c in candidates)).encode()
+    ).hexdigest()[:8]
+
 
 def _cache_path() -> str:
     return os.environ.get(
@@ -105,18 +122,28 @@ def get_or_tune(kind: str, sig: str,
     import jax
 
     chip = getattr(jax.devices()[0], "device_kind", "tpu")
-    key = f"{kind}|{chip}|{sig}"
+    ver = _KERNEL_VERSIONS.get(kind, 1)
+    key = f"{kind}|{chip}|{sig}|v{ver}.g{_grid_token(candidates)}"
     with _lock:
         _load_locked()
         hit = _mem.get(key)
-    if isinstance(hit, dict) and isinstance(hit.get("blocks"), list):
-        return tuple(hit["blocks"])
+    cached = tuple(hit["blocks"]) if (
+        isinstance(hit, dict) and isinstance(hit.get("blocks"), list)
+    ) else None
     if jax.process_count() > 1:
-        # Multi-host SPMD must compile IDENTICAL programs on every host;
-        # an independent sweep could pick different blocks per host. Only
-        # cached entries are used here — pre-tune on one host and ship
-        # the cache file.
+        # Multi-host SPMD must compile IDENTICAL programs on every host.
+        # Per-host cache files can legitimately differ (one host tuned,
+        # another not), so a local cache hit is only trusted after the
+        # init-time fingerprint agreement proved every host loaded the
+        # same cache (verify_multihost_cache); otherwise every host
+        # falls back to the (identical-by-construction) default. No
+        # collective runs here — a hot-path collective gated on
+        # host-local state could deadlock divergent hosts.
+        if _multihost_cache_ok[0] and cached is not None:
+            return cached
         return default
+    if cached is not None:
+        return cached
 
     results: List[Tuple[float, Tuple[int, ...]]] = []
     t_sweep = time.perf_counter()
@@ -142,6 +169,63 @@ def get_or_tune(kind: str, sig: str,
         "candidates in %.0fs; cached in %s)", kind, sig, best,
         best_dt * 1e3, len(results), entry["sweep_seconds"], _cache_path())
     return best
+
+
+# Multi-host cache trust: set once by verify_multihost_cache() at init
+# time. Until it runs (and proves every host loaded an identical cache
+# file), multi-host get_or_tune uses only the defaults.
+_multihost_cache_ok = [False]
+
+
+def cache_fingerprint() -> str:
+    """Canonical digest of the loaded autotune cache."""
+    import hashlib
+
+    with _lock:
+        _load_locked()
+        blob = json.dumps(_mem, sort_keys=True)
+    return hashlib.md5(blob.encode()).hexdigest()
+
+
+def verify_multihost_cache() -> bool:
+    """One-shot init-time agreement: allgather the cache fingerprint
+    across the process world; local cache hits are trusted in multi-host
+    mode only if every host loaded the same cache file (ADVICE r4:
+    divergent per-host caches compile divergent XLA programs — a
+    hang/garbage risk in SPMD).
+
+    Called from ``hvd.init()`` — the one point where every process is
+    guaranteed in lockstep, so the collective cannot deadlock divergent
+    hosts the way a lazy hot-path agreement could. Returns the verdict
+    (also stored module-globally for get_or_tune)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        _multihost_cache_ok[0] = True  # single host: nothing to diverge
+        return True
+    try:
+        from ..ops import collective_ops as C
+        from ..parallel.functions import allgather_object
+
+        # The allgather must actually span every jax process, or the
+        # "agreement" is vacuous.
+        if C._eager_world() < jax.process_count():
+            _multihost_cache_ok[0] = False
+            return False
+        prints = allgather_object(cache_fingerprint())
+        ok = len(set(prints)) == 1
+    except Exception as e:  # no agreement channel: defaults are safe
+        logging.info("autotune multi-host cache verification unavailable "
+                     "(%s); using default blocks", e)
+        ok = False
+    if not ok:
+        logging.warning(
+            "horovod_tpu autotune: per-host kernel caches differ (or "
+            "could not be verified); multi-host runs will use default "
+            "block sizes. Ship one HOROVOD_AUTOTUNE_CACHE file to every "
+            "host to enable tuned blocks.")
+    _multihost_cache_ok[0] = ok
+    return ok
 
 
 def _timed_chain(step_fn, args, target_seconds: float = 0.5,

@@ -330,12 +330,14 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
     throughput crossover: the fused kernel's value is the operating
     envelope — at [32k tokens x 128k vocab] the dense step fails to
     compile (the fp32 logits alone are 17 GB against 16 GB HBM) while
-    the fused path runs. ``mode="auto"`` therefore picks dense while the
-    step's peak logits footprint (fwd + recomputed bwd, fp32) stays
-    under ``HOROVOD_XENT_AUTO_LOGITS_GB`` (default 8 GiB — comfortably
-    inside the measured-working 256k point, safely below the failing
-    17 GB point), and fused above it. ``mode="dense"``/``"fused"``
-    force a path.
+    the fused path runs. ``mode="auto"`` therefore picks dense while a
+    single fp32 logits buffer (``N * V * 4`` bytes — the unit XLA must
+    materialize at least once in the dense head) stays under
+    ``HOROVOD_XENT_AUTO_LOGITS_GB`` (default 10 GiB: strictly above the
+    measured-working 256k point, which is exactly 8 GiB, so that point
+    stays dense with margin rather than by strict-inequality luck; and
+    safely below the failing 17 GB point), and fused above it.
+    ``mode="dense"``/``"fused"`` force a path.
     """
     import os
 
@@ -353,7 +355,7 @@ def lm_head_loss(x, w, labels, *, mode: str = "auto"):
         for d in x.shape[:-1]:
             N *= d
         budget = float(os.environ.get(
-            "HOROVOD_XENT_AUTO_LOGITS_GB", "8")) * 2 ** 30
+            "HOROVOD_XENT_AUTO_LOGITS_GB", "10")) * 2 ** 30
         use_fused = N * w.shape[0] * 4.0 > budget
         if use_fused and env_bn is None:
             # Auto only fires at large N·V, where the 1024-row block's

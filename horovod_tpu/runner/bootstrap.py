@@ -40,17 +40,27 @@ from typing import Optional
 _SCOPE = "controller"
 _KEY = "static"
 
-# Per-process bootstrap generation. shutdown()+init() re-forms the world:
-# every rank runs apply() again, in lockstep, so per-process counters
-# agree — and keying the KV entry by generation keeps a re-init's workers
-# from dialing the PREVIOUS incarnation's dead listener (the static
-# analogue of the elastic driver's world_id-versioned port report,
-# elastic/driver.py set_controller_port).
+# KV key = launcher world id + per-process bootstrap generation.
+#
+# The world id (HOROVOD_BOOTSTRAP_WORLD_ID, one fresh value per
+# launch_static invocation — the static analogue of the elastic driver's
+# world_id) anchors the key to the launcher run, so ranks of different
+# launches sharing a KV server can never cross-read port reports.
+#
+# The generation handles in-process shutdown()+init() cycles: every rank
+# runs apply() again, in lockstep, so per-process counters agree — and
+# keying by generation keeps a re-init's workers from dialing the
+# PREVIOUS incarnation's dead listener. NOTE (ADVICE r4): this requires
+# whole-world re-init. A single worker relaunched by an external
+# supervisor restarts at generation 1 while peers are at N and will time
+# out after HOROVOD_BOOTSTRAP_TIMEOUT — per-worker churn is the elastic
+# driver's job (elastic/driver.py), not the static bootstrap's.
 _generation = [0]
 
 
 def _gen_key() -> str:
-    return f"{_KEY}.{_generation[0]}"
+    world = os.environ.get("HOROVOD_BOOTSTRAP_WORLD_ID", "local")
+    return f"{_KEY}.{world}.{_generation[0]}"
 
 
 def _kv_coords():

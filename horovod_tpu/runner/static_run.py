@@ -136,6 +136,16 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
         controller_addr = "127.0.0.1"
     rdv_addr = rendezvous_advertise_addr(slots)
 
+    if controller_port is None:
+        # One world id per launch: the KV bootstrap key is anchored to
+        # the launcher invocation (the static analogue of the elastic
+        # driver's world_id), so ranks of different launches sharing a
+        # KV server can never cross-read each other's port reports.
+        import uuid
+
+        env = dict(env if env is not None else os.environ)
+        env.setdefault("HOROVOD_BOOTSTRAP_WORLD_ID", uuid.uuid4().hex[:12])
+
     abort = threading.Event()
     exit_codes: Dict[int, int] = {}
     lock = threading.Lock()

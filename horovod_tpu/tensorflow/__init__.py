@@ -293,8 +293,11 @@ def alltoall(tensor, splits=None, name=None):
     def fn(t, s):
         ctrl, world = _eager_world()
         if world == 1:
-            return (tf.identity(t),
-                    tf.constant([int(t.shape[0])], dtype=tf.int32))
+            # Mirror the eager path's rank-0 guard: a scalar input has
+            # no dim 0 to split (degenerate, but the two paths must
+            # agree on what they accept).
+            n = int(t.shape[0]) if t.shape.rank else 1
+            return (tf.identity(t), tf.constant([n], dtype=tf.int32))
         spl = ([int(v) for v in s.numpy()] if int(s.shape[0]) else None)
         h = ctrl.alltoall_async(_to_numpy(t), tname, splits=spl)
         out = h.wait()

@@ -47,7 +47,10 @@ class TestGetOrTune:
         assert calls == []
         # and the on-disk cache is a fresh process's warm start
         disk = json.loads(fresh_cache.read_text())
-        key = [k for k in disk if k.endswith("sig1")][0]
+        key = [k for k in disk if "|sig1|" in k][0]
+        # Key carries a kernel version + candidate-grid token so kernel
+        # or grid changes self-invalidate stale entries (ADVICE r4).
+        assert "|v1.g" in key
         assert disk[key]["blocks"] == [512]
         monkeypatch.setattr(at, "_mem", {})
         monkeypatch.setattr(at, "_loaded", False)
@@ -78,20 +81,58 @@ class TestGetOrTune:
         assert not fresh_cache.exists() or "sig3" not in \
             fresh_cache.read_text()
 
-    def test_multiprocess_only_reads_cache(self, fresh_cache, monkeypatch):
+    def test_multiprocess_never_sweeps(self, fresh_cache, monkeypatch):
         import jax
 
         monkeypatch.setattr(at, "enabled", lambda: True)
         monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(at, "_multihost_cache_ok", [False])
         calls = []
-        out = at.get_or_tune("k", "sig4", [(1,), (2,)],
+        cands = [(1,), (2,)]
+        out = at.get_or_tune("k", "sig4", cands,
                              lambda c: calls.append(c) or 0.1, (9,))
         assert out == (9,) and calls == []  # no sweep in multi-host
-        # but a pre-shipped cache entry is honored
-        at._mem[f"k|{getattr(jax.devices()[0], 'device_kind', 'tpu')}"
-                f"|sig4"] = {"blocks": [2]}
-        assert at.get_or_tune("k", "sig4", [(1,), (2,)],
-                              lambda c: 0.1, (9,)) == (2,)
+        # A local cache hit is NOT trusted until the init-time
+        # fingerprint agreement proved every host loaded the same cache
+        # (ADVICE r4: per-host caches can legitimately differ ->
+        # divergent XLA programs); until then, the default.
+        chip = getattr(jax.devices()[0], "device_kind", "tpu")
+        key = f"k|{chip}|sig4|v1.g{at._grid_token(cands)}"
+        at._mem[key] = {"blocks": [2]}
+        assert at.get_or_tune("k", "sig4", cands, lambda c: 0.1, (9,)) == (9,)
+        # After verification, the (identical-everywhere) cache is used.
+        monkeypatch.setattr(at, "_multihost_cache_ok", [True])
+        assert at.get_or_tune("k", "sig4", cands, lambda c: 0.1, (9,)) == (2,)
+
+    def test_verify_multihost_cache(self, fresh_cache, monkeypatch):
+        import jax
+
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.parallel import functions
+
+        # Single process: trivially consistent.
+        monkeypatch.setattr(at, "_multihost_cache_ok", [False])
+        assert at.verify_multihost_cache() is True
+        assert at._multihost_cache_ok[0]
+
+        # Multi-host, agreement channel spans the world, fingerprints
+        # agree -> trusted.
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(C, "_eager_world", lambda: 2)
+        fp = at.cache_fingerprint()
+        monkeypatch.setattr(functions, "allgather_object",
+                            lambda obj: [fp, obj])
+        assert at.verify_multihost_cache() is True
+
+        # Fingerprints differ -> defaults (loud warning, no deadlock).
+        monkeypatch.setattr(functions, "allgather_object",
+                            lambda obj: ["other", obj])
+        assert at.verify_multihost_cache() is False
+        assert not at._multihost_cache_ok[0]
+
+        # Agreement channel does not span the world -> not trusted.
+        monkeypatch.setattr(C, "_eager_world", lambda: 1)
+        assert at.verify_multihost_cache() is False
 
 
 class TestShapeGates:
