@@ -117,8 +117,12 @@ def init_backend():
        ``"platform": "cpu"`` recorded in the JSON line.
     """
     from horovod_tpu.common.backend import (
-        BackendInitError, acquire_devices, probe_backend, _reset_backends)
+        BackendInitError, acquire_devices, clear_stale_tpu_locks,
+        probe_backend, _reset_backends)
 
+    # Pre-probe hygiene (round-4 postmortem: a process killed mid-run can
+    # leave a libtpu lockfile that wedges every later PJRT creation).
+    clear_stale_tpu_locks()
     probes = int(os.environ.get("HOROVOD_BENCH_PROBES", "3"))
     probe_timeout = float(os.environ.get("HOROVOD_BENCH_PROBE_TIMEOUT", "150"))
     ok = False

@@ -210,10 +210,14 @@ def verify_multihost_cache() -> bool:
         # The allgather must actually span every jax process, or the
         # "agreement" is vacuous.
         if C._eager_world() < jax.process_count():
-            _multihost_cache_ok[0] = False
-            return False
-        prints = allgather_object(cache_fingerprint())
-        ok = len(set(prints)) == 1
+            logging.info(
+                "autotune: eager agreement channel spans %d < %d jax "
+                "processes; cannot verify cache consistency",
+                C._eager_world(), jax.process_count())
+            ok = False
+        else:
+            prints = allgather_object(cache_fingerprint())
+            ok = len(set(prints)) == 1
     except Exception as e:  # no agreement channel: defaults are safe
         logging.info("autotune multi-host cache verification unavailable "
                      "(%s); using default blocks", e)

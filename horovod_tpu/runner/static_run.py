@@ -144,7 +144,10 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
         import uuid
 
         env = dict(env if env is not None else os.environ)
-        env.setdefault("HOROVOD_BOOTSTRAP_WORLD_ID", uuid.uuid4().hex[:12])
+        # Unconditional: an inherited id (e.g. a nested launch from
+        # inside a worker whose env carries the outer launch's value)
+        # must not alias two launches onto the same KV key.
+        env["HOROVOD_BOOTSTRAP_WORLD_ID"] = uuid.uuid4().hex[:12]
 
     abort = threading.Event()
     exit_codes: Dict[int, int] = {}
