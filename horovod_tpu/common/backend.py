@@ -32,7 +32,7 @@ from __future__ import annotations
 import os
 import sys
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import jax
 
@@ -96,31 +96,39 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
-def _device_holders() -> List[str]:
+def _device_holders() -> Tuple[List[str], int]:
     """Processes holding ``/dev/accel*`` / ``/dev/vfio*`` open, via a
-    /proc fd scan (``fuser`` is not always installed on TPU VMs)."""
+    /proc fd scan (``fuser`` is not always installed on TPU VMs).
+    Returns ``(holders, uninspectable)`` — the second count is pids
+    whose fd table we could not read (EACCES as non-root), so "no
+    holders found" can be distinguished from "could not look"."""
     import glob
 
     targets = set(glob.glob("/dev/accel*")) | set(glob.glob("/dev/vfio/*"))
     if not targets:
-        return []
-    holders = []
+        return [], 0
+    holders: List[str] = []
+    uninspectable = 0
     for pdir in glob.glob("/proc/[0-9]*"):
         try:
-            for fd in os.listdir(os.path.join(pdir, "fd")):
-                try:
-                    if os.readlink(os.path.join(pdir, "fd", fd)) in targets:
-                        pid = pdir.rsplit("/", 1)[1]
-                        with open(os.path.join(pdir, "cmdline"), "rb") as f:
-                            cmd = f.read().replace(b"\0", b" ")[:160]
-                        holders.append(
-                            f"pid {pid}: {cmd.decode(errors='replace')}")
-                        break
-                except OSError:
-                    continue
-        except OSError:
+            fds = os.listdir(os.path.join(pdir, "fd"))
+        except PermissionError:
+            uninspectable += 1
             continue
-    return holders
+        except OSError:
+            continue  # process exited mid-scan
+        for fd in fds:
+            try:
+                if os.readlink(os.path.join(pdir, "fd", fd)) in targets:
+                    pid = pdir.rsplit("/", 1)[1]
+                    with open(os.path.join(pdir, "cmdline"), "rb") as f:
+                        cmd = f.read().replace(b"\0", b" ")[:160]
+                    holders.append(
+                        f"pid {pid}: {cmd.decode(errors='replace')}")
+                    break
+            except OSError:
+                continue
+    return holders, uninspectable
 
 
 def clear_stale_tpu_locks() -> None:
@@ -153,6 +161,16 @@ def clear_stale_tpu_locks() -> None:
                      "process; not removing (another process owns the "
                      "chip)")
                 continue
+            # Unlink race guard: if the path no longer names the inode
+            # we flocked (someone re-created the file since our open),
+            # removing it would delete THEIR lockfile — skip.
+            try:
+                if os.fstat(fd).st_ino != os.stat(path).st_ino:
+                    _log(f"libtpu lockfile {path} was re-created "
+                         "concurrently; leaving it alone")
+                    continue
+            except OSError:
+                continue  # already gone: nothing to do
             # Secondary pid heuristic for lockfiles that DO carry one
             # (some runtimes write it): a live pid means keep.
             try:
@@ -178,47 +196,66 @@ def diagnose_backend() -> None:
     device-file holders, lockfiles, and the backend-relevant env — so a
     hung probe leaves an actionable trail instead of a bare timeout
     (VERDICT r4: three silent 150 s timeouts cost the round its TPU
-    measurement)."""
+    measurement). Diagnostics must never turn a recoverable probe
+    failure into a crash, so every section is exception-guarded."""
     import glob
     import socket
 
     # 1. Remote-relay runtimes (axon tunnel): is anything listening?
     relay_ips = os.environ.get("PALLAS_AXON_POOL_IPS")
-    if relay_ips:
-        port = int(os.environ.get("HOROVOD_AXON_RELAY_PORT", "8083"))
-        for ip in relay_ips.split(","):
-            try:
-                with socket.create_connection((ip.strip(), port),
-                                              timeout=3):
-                    _log(f"relay {ip}:{port}: TCP reachable (tunnel up; "
-                         "hang is past the transport — likely chip-side)")
-            except OSError as e:
-                _log(f"relay {ip}:{port}: NOT reachable ({e}) — the "
-                     "tunnel/relay process is down; nothing in this "
-                     "process can bring the chip back")
+    try:
+        if relay_ips:
+            port = int(os.environ.get("HOROVOD_AXON_RELAY_PORT",
+                                      "8083").strip() or "8083")
+            for ip in relay_ips.split(","):
+                try:
+                    with socket.create_connection((ip.strip(), port),
+                                                  timeout=3):
+                        _log(f"relay {ip}:{port}: TCP reachable (tunnel "
+                             "up; hang is past the transport — likely "
+                             "chip-side)")
+                except OSError as e:
+                    _log(f"relay {ip}:{port}: NOT reachable ({e}) — the "
+                         "tunnel/relay process is down; nothing in this "
+                         "process can bring the chip back")
+    except Exception as e:
+        _log(f"relay diagnostics failed: {e}")
     # 2. Local chips: device files + who holds them.
-    accels = sorted(glob.glob("/dev/accel*"))
-    if accels:
-        _log(f"local TPU device files: {accels}")
-        holders = _device_holders()
-        if holders:
-            _log("device holders (a leftover process wedges PJRT "
-                 "creation):\n  " + "\n  ".join(holders))
-        else:
-            _log("no process holds the device files")
-    elif not relay_ips:
-        _log("no /dev/accel* files and no relay configured: this host "
-             "has no TPU attached")
+    try:
+        accels = sorted(glob.glob("/dev/accel*"))
+        if accels:
+            _log(f"local TPU device files: {accels}")
+            holders, blind = _device_holders()
+            if holders:
+                _log("device holders (a leftover process wedges PJRT "
+                     "creation):\n  " + "\n  ".join(holders))
+            elif blind:
+                _log(f"no holder found among inspectable processes, but "
+                     f"{blind} pids were uninspectable (EACCES — run as "
+                     f"root for a definitive answer)")
+            else:
+                _log("no process holds the device files")
+        elif not relay_ips:
+            _log("no /dev/accel* files and no relay configured: this "
+                 "host has no TPU attached")
+    except Exception as e:
+        _log(f"device-holder diagnostics failed: {e}")
     # 3. Lockfiles (report only; clear_stale_tpu_locks removes dead ones).
-    locks = glob.glob("/tmp/libtpu_lockfile*")
-    if locks:
-        _log(f"libtpu lockfiles present: {locks}")
+    try:
+        locks = glob.glob("/tmp/libtpu_lockfile*")
+        if locks:
+            _log(f"libtpu lockfiles present: {locks}")
+    except Exception:
+        pass
     # 4. Backend-relevant env at failure time.
-    keys = sorted(k for k in os.environ
-                  if k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_",
-                                   "PALLAS_", "AXON_", "PJRT_")))
-    env = ", ".join(f"{k}={os.environ[k][:60]}" for k in keys)
-    _log(f"backend env: {env or '<none>'}")
+    try:
+        keys = sorted(k for k in os.environ
+                      if k.startswith(("JAX_", "TPU_", "LIBTPU", "XLA_",
+                                       "PALLAS_", "AXON_", "PJRT_")))
+        env = ", ".join(f"{k}={os.environ[k][:60]}" for k in keys)
+        _log(f"backend env: {env or '<none>'}")
+    except Exception:
+        pass
 
 
 def probe_backend(timeout: float = 120.0) -> bool:

@@ -1,0 +1,151 @@
+"""Worker for the systematic op x dtype matrix over the native 2-process
+plane (tests/test_op_matrix.py), plus the cross-rank mismatch ERROR
+cases.
+
+Models the reference's exhaustive parallel tier
+(test/parallel/test_tensorflow.py: every dtype x dim x error case over a
+real multi-process world): every collective runs in every wire dtype the
+native core supports, with exact numeric assertions, then deliberately
+inconsistent submissions assert that the controller's consistency
+checker (cc/src/controller.cc ConstructResponse) delivers the Mismatched
+error text to EVERY rank — not just rank 0."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from horovod_tpu import cc  # noqa: E402
+
+try:
+    import ml_dtypes
+
+    BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - baked image has ml_dtypes
+    BF16 = None
+
+DTYPES = [np.dtype(d) for d in (np.uint8, np.int8, np.int32, np.int64,
+                                np.float16, np.float32, np.float64)]
+if BF16 is not None:
+    DTYPES.append(BF16)
+
+
+def as_f64(a):
+    return np.asarray(a, dtype=np.float64)
+
+
+def check_dtype(ctx, dt, rank, size):
+    name = dt.name
+    # Values stay tiny so every dtype (incl. uint8/int8/fp16/bf16) holds
+    # the exact sum: max element = 2 + (size-1), summed over <= 6 ranks.
+    base = (np.arange(8) % 3).astype(np.int64)
+
+    # --- allreduce (SUM) ---
+    x = (base + rank).astype(dt)
+    out = ctx.allreduce_async(x.copy(), f"ar.{name}").wait()
+    exp = base * size + sum(range(size))
+    assert np.array_equal(as_f64(out), as_f64(exp)), (name, "allreduce")
+
+    # --- grouped allreduce: concurrent handles ride the fusion buffer,
+    # the eager analogue of hvd.grouped_allreduce ---
+    hs = [ctx.allreduce_async((base[:4] + rank + i).astype(dt),
+                              f"grp{i}.{name}") for i in range(3)]
+    for i, h in enumerate(hs):
+        exp = base[:4] * size + sum(range(size)) + i * size
+        assert np.array_equal(as_f64(h.wait()), as_f64(exp)), (
+            name, "grouped", i)
+
+    # --- allgather (ragged: rank r contributes r+1 rows) ---
+    g = ctx.allgather_async(np.full((rank + 1, 2), rank, dt),
+                            f"ag.{name}").wait()
+    assert g.dtype == dt, (name, g.dtype)
+    row = 0
+    for r in range(size):
+        assert (as_f64(g[row:row + r + 1]) == r).all(), (name, "allgather")
+        row += r + 1
+
+    # --- broadcast (non-zero root) ---
+    root = 1 % size
+    out = ctx.broadcast_async(np.full(4, rank, dt), f"bc.{name}",
+                              root=root).wait()
+    assert (as_f64(out) == root).all(), (name, "broadcast")
+
+    # --- alltoall (uneven splits: d+1 rows to dest d) ---
+    splits = [d + 1 for d in range(size)]
+    h = ctx.alltoall_async(np.full((sum(splits), 3), rank, dt),
+                           f"a2a.{name}", splits=splits)
+    out = h.wait()
+    assert h.recv_splits() == [rank + 1] * size, (name, "recv_splits")
+    assert out.dtype == dt and (as_f64(out) >= 0).all()
+    row = 0
+    for r in range(size):
+        assert (as_f64(out[row:row + rank + 1]) == r).all(), (
+            name, "alltoall")
+        row += rank + 1
+
+
+def expect_error(fn, substr, what):
+    try:
+        fn().wait()
+    except cc.NativeError as e:
+        msg = str(e)
+        assert substr.lower() in msg.lower(), (what, substr, msg)
+        return
+    raise AssertionError(f"{what}: rank did not receive the controller "
+                         f"ERROR response (expected '{substr}')")
+
+
+def check_mismatches(ctx, rank, size):
+    """Deliberately inconsistent submissions: the controller's cross-rank
+    validation must deliver the ERROR text to every rank (reference:
+    ConstructResponse error paths, horovod/common/controller.cc)."""
+    # Shape mismatch (allreduce): rank 0 sends 4 elements, others 5.
+    expect_error(
+        lambda: ctx.allreduce_async(
+            np.ones(4 + (rank != 0), np.float32), "err.shape"),
+        "Mismatched allreduce tensor shapes", "shape mismatch")
+    # Dtype mismatch: rank 0 fp32, others int32.
+    expect_error(
+        lambda: ctx.allreduce_async(
+            np.ones(4, np.float32 if rank == 0 else np.int32), "err.dtype"),
+        "Mismatched data types", "dtype mismatch")
+    # Collective-op mismatch: rank 0 allreduce, others allgather.
+    expect_error(
+        lambda: (ctx.allreduce_async(np.ones(4, np.float32), "err.op")
+                 if rank == 0 else
+                 ctx.allgather_async(np.ones(4, np.float32), "err.op")),
+        "Mismatched collective operations", "op mismatch")
+    # Reduce-op mismatch: SUM vs MIN under one name.
+    expect_error(
+        lambda: ctx.allreduce_async(
+            np.ones(4, np.float32), "err.rop",
+            op=ctx.SUM if rank == 0 else ctx.MIN),
+        "Mismatched reduce ops", "reduce-op mismatch")
+    # Broadcast root mismatch.
+    expect_error(
+        lambda: ctx.broadcast_async(np.ones(4, np.float32), "err.root",
+                                    root=rank % size),
+        "Mismatched broadcast root ranks", "root mismatch")
+    # The world must still be healthy after every ERROR response.
+    out = ctx.allreduce_async(np.ones(4, np.float32), "post.err").wait()
+    assert np.allclose(out, size)
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    ctx = cc.CoreContext()
+    assert ctx.rank() == rank and ctx.size() == size
+    for dt in DTYPES:
+        check_dtype(ctx, dt, rank, size)
+    if size > 1:
+        check_mismatches(ctx, rank, size)
+    ctx.barrier()
+    ctx.close()
+    print(f"matrix worker rank {rank}/{size}: OK "
+          f"({len(DTYPES)} dtypes x 5 ops + error matrix)")
+
+
+if __name__ == "__main__":
+    main()
