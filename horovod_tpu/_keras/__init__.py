@@ -63,7 +63,8 @@ def broadcast_model_state(model, root_rank: int = 0) -> None:
 
 def create_distributed_optimizer(optimizer, compression=None,
                                  op=ReduceOp.AVERAGE,
-                                 prescale_factor=1.0, postscale_factor=1.0):
+                                 prescale_factor=1.0, postscale_factor=1.0,
+                                 sparse_as_dense=False):
     """Dynamically subclass the wrapped Keras optimizer so isinstance
     checks and serialization keep working (the reference's exact approach,
     _keras/__init__.py:25-85), overriding gradient application to
@@ -112,18 +113,32 @@ def create_distributed_optimizer(optimizer, compression=None,
 
             # Under the TF backend Keras compiles train_step into a
             # tf.function; host collectives must escape the graph.
+            is_tf = keras.backend.backend() == "tensorflow"
             in_tf_graph = False
-            if keras.backend.backend() == "tensorflow":
+            if is_tf:
                 import tensorflow as tf
 
                 in_tf_graph = not tf.executing_eagerly()
             out = []
             for i, g in enumerate(grads):
+                if is_tf and isinstance(g, tf.IndexedSlices):
+                    if sparse_as_dense:
+                        # Densify escape hatch (reference keras path);
+                        # falls through to the dense reduction below.
+                        g = tf.convert_to_tensor(g)
+                    else:
+                        # Reference default for sparse grads: the
+                        # values+indices allgather path shared with
+                        # DistributedGradientTape.
+                        from ..tensorflow import _allreduce_grads
+
+                        out.append(_allreduce_grads(
+                            [g], compression, op, prescale_factor,
+                            postscale_factor)[0])
+                        continue
                 if g is None:
                     out.append(None)
                 elif in_tf_graph:
-                    import tensorflow as tf
-
                     y = tf.py_function(
                         lambda t, idx=i: tf.convert_to_tensor(
                             reduce_np(t.numpy(), idx)), [g], g.dtype)

@@ -47,12 +47,15 @@ def size() -> int:
 
 
 def DistributedOptimizer(optimizer, compression=None, op=Average,
-                         prescale_factor=1.0, postscale_factor=1.0):
+                         prescale_factor=1.0, postscale_factor=1.0,
+                         sparse_as_dense=False):
     """Wrap a Keras optimizer so gradient application averages across
     ranks (reference: keras/__init__.py DistributedOptimizer →
-    _keras/__init__.py:25-85)."""
+    _keras/__init__.py:25-85). ``sparse_as_dense`` densifies
+    IndexedSlices gradients before reduction."""
     return create_distributed_optimizer(optimizer, compression, op,
-                                        prescale_factor, postscale_factor)
+                                        prescale_factor, postscale_factor,
+                                        sparse_as_dense=sparse_as_dense)
 
 
 def broadcast_global_variables(root_rank: int = 0, model=None) -> None:

@@ -391,12 +391,13 @@ def allgather_object(obj: Any, name: Optional[str] = None) -> List[Any]:
 
 class _DistributedGradientTape:
     def __init__(self, tape, compression, op, prescale_factor,
-                 postscale_factor):
+                 postscale_factor, sparse_as_dense=False):
         self._tape = tape
         self._compression = compression
         self._op = op
         self._prescale = prescale_factor
         self._postscale = postscale_factor
+        self._sparse_as_dense = sparse_as_dense
 
     def __getattr__(self, item):
         return getattr(self._tape, item)
@@ -411,12 +412,37 @@ class _DistributedGradientTape:
     def gradient(self, target, sources, output_gradients=None):
         grads = self._tape.gradient(target, sources, output_gradients)
         return _allreduce_grads(grads, self._compression, self._op,
-                                self._prescale, self._postscale)
+                                self._prescale, self._postscale,
+                                self._sparse_as_dense)
 
 
-def _allreduce_grads(grads, compression, op, prescale, postscale):
+def _runtime_world():
+    """The eager-collective (process) world as a value resolved at
+    EXECUTION time in graph mode — native HvdtpuSize node when the op
+    library is live, py_function otherwise — so elastic world changes
+    reaching a cached concrete function see the new size. Eagerly it is
+    just the current int. (Deliberately NOT named _eager_world: the
+    module-local ``_eager_world()`` returns a (ctrl, world) tuple.)"""
+    if tf.executing_eagerly():
+        return C._eager_world()
+    lib = _native_ops()
+    if lib is not None:
+        return lib.hvdtpu_size()
+    world = tf.py_function(lambda: np.int32(C._eager_world()), [], tf.int32)
+    world.set_shape([])
+    return world
+
+
+def _allreduce_grads(grads, compression, op, prescale, postscale,
+                     sparse_as_dense=False):
     out = []
     for i, g in enumerate(grads):
+        if isinstance(g, tf.IndexedSlices) and sparse_as_dense:
+            # Densify escape hatch (reference: tensorflow/__init__.py:
+            # 260,299,437 — what users reach for when the allgather of a
+            # large embedding gradient blows memory): one dense
+            # allreduce instead of a size-x values+indices gather.
+            g = tf.convert_to_tensor(g)
         if g is None:
             out.append(None)
         elif isinstance(g, tf.IndexedSlices):
@@ -431,7 +457,15 @@ def _allreduce_grads(grads, compression, op, prescale, postscale):
                     "(IndexedSlices) gradients.")
             values = allgather(g.values, name=f"grad.{i}.values")
             if op == Average:
-                values = values / size()
+                # Divide by the world the allgather actually spanned:
+                # the host-path collectives run over the PROCESS world,
+                # which under single-controller SPMD differs from
+                # size()'s device world (reference :107 divides by
+                # hvd.size() because its gather always spans it). The
+                # divisor must be RUNTIME-evaluated: a trace-time
+                # constant keeps averaging by the old size when an
+                # elastic world change reuses a cached tf.function.
+                values = values / tf.cast(_runtime_world(), values.dtype)
             out.append(tf.IndexedSlices(
                 values,
                 allgather(g.indices, name=f"grad.{i}.indices"),
@@ -444,28 +478,33 @@ def _allreduce_grads(grads, compression, op, prescale, postscale):
 
 
 def DistributedGradientTape(gradtape, compression=None, op=Average,
-                            prescale_factor=1.0, postscale_factor=1.0):
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            sparse_as_dense=False):
     """Wrap tf.GradientTape so gradient() allreduces (reference:
-    tensorflow/__init__.py:530-576)."""
+    tensorflow/__init__.py:530-576). ``sparse_as_dense`` densifies
+    IndexedSlices gradients before reduction (reference :260)."""
     from .compression import Compression
 
     return _DistributedGradientTape(
         gradtape, compression or Compression.none, op, prescale_factor,
-        postscale_factor)
+        postscale_factor, sparse_as_dense)
 
 
 def DistributedOptimizer(optimizer, name=None, compression=None, op=Average,
                          prescale_factor=1.0, postscale_factor=1.0,
-                         backward_passes_per_step=1):
+                         backward_passes_per_step=1,
+                         sparse_as_dense=False):
     """Wrap a Keras optimizer so apply_gradients() averages gradients
     across ranks first (reference: tensorflow/__init__.py:435-508 +
-    _keras/__init__.py:25-85 create_distributed_optimizer)."""
+    _keras/__init__.py:25-85 create_distributed_optimizer).
+    ``sparse_as_dense`` densifies IndexedSlices gradients before
+    reduction (reference :437)."""
     from .compression import Compression
     from .._keras import create_distributed_optimizer
 
     return create_distributed_optimizer(
         optimizer, compression or Compression.none, op, prescale_factor,
-        postscale_factor)
+        postscale_factor, sparse_as_dense=sparse_as_dense)
 
 
 # Late imports: these modules import names from this package

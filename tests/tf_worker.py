@@ -71,6 +71,38 @@ def main():
     mean_x = np.mean([r + 1 for r in range(size)])
     assert np.allclose(gw.numpy(), mean_x), gw.numpy()
 
+    # -- sparse_as_dense: embedding (IndexedSlices) gradients — the
+    # allgather path and the densify path must agree numerically
+    # (reference: tensorflow/__init__.py:260,299,437) --
+    emb = tf.Variable(np.full((size + 1, 4), 0.5, np.float32))
+    idx = tf.constant([rank, rank + 1, rank])  # rank-dependent + dup
+
+    def emb_grad(sparse_as_dense, tag):
+        with hvd.DistributedGradientTape(
+                tf.GradientTape(),
+                sparse_as_dense=sparse_as_dense) as tape:
+            vals = tf.nn.embedding_lookup(emb, idx)
+            loss = tf.reduce_sum(vals * vals)
+        (g,) = tape.gradient(loss, [emb])
+        if sparse_as_dense:
+            assert not isinstance(g, tf.IndexedSlices), tag
+        else:
+            assert isinstance(g, tf.IndexedSlices), tag
+            g = tf.convert_to_tensor(g)  # duplicate indices sum
+        return g.numpy()
+
+    g_gather = emb_grad(False, "gather")
+    g_dense = emb_grad(True, "dense")
+    # Expected: average over ranks of each rank's dense grad
+    # (row r: 2 hits -> 2.0; row r+1: 1 hit -> 1.0; grad d/dv v^2 = 2v).
+    exp = np.zeros((size + 1, 4), np.float64)
+    for r in range(size):
+        exp[r] += 2 * 2 * 0.5
+        exp[r + 1] += 2 * 0.5
+    exp /= size
+    assert np.allclose(g_gather, exp), (g_gather, exp)
+    assert np.allclose(g_dense, exp), (g_dense, exp)
+
     # -- Keras: DistributedOptimizer + callbacks through model.fit --
     import keras
 
@@ -107,6 +139,35 @@ def main():
     # ranks log the same value
     lv = hvd.allgather(tf.constant([[losses[-1]]]))
     assert np.allclose(lv.numpy()[0], lv.numpy()[-1]), lv.numpy()
+
+    # -- Keras + embedding (IndexedSlices) gradients: the optimizer's
+    # sparse grads ride the shared allgather path by default and the
+    # densify path with sparse_as_dense=True; both must train and end
+    # with identical weights across ranks --
+    for sad in (False, True):
+        keras.utils.set_random_seed(99 + rank)
+        emodel = keras.Sequential([
+            keras.layers.Input(shape=(3,), dtype="int32"),
+            keras.layers.Embedding(16, 4),
+            keras.layers.Flatten(),
+            keras.layers.Dense(1),
+        ])
+        eopt = hvdk.DistributedOptimizer(
+            keras.optimizers.SGD(learning_rate=0.05),
+            sparse_as_dense=sad)
+        emodel.compile(optimizer=eopt, loss="mse")
+        ers = np.random.RandomState(200 + rank)
+        exs = ers.randint(0, 16, (32, 3)).astype(np.int32)
+        eys = exs.sum(axis=1, keepdims=True).astype(np.float32) * 0.1
+        ehist = emodel.fit(
+            exs, eys, batch_size=8, epochs=2, verbose=0,
+            callbacks=[hvdk.callbacks.BroadcastGlobalVariablesCallback(0)])
+        assert ehist.history["loss"][-1] < ehist.history["loss"][0], (
+            "embedding keras", sad, ehist.history["loss"])
+        eflat = np.concatenate([w.flatten() for w in emodel.get_weights()])
+        eg = hvd.allgather(tf.constant(eflat[None, :]))
+        assert np.allclose(eg.numpy()[0], eg.numpy()[-1], atol=1e-5), (
+            "embedding keras ranks diverged", sad)
 
     # -- KerasState sync --
     state = hvdk.elastic.KerasState(model, epoch=rank)
