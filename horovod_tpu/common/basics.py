@@ -144,6 +144,37 @@ def _bridge_jsm_env() -> None:
             os.environ[hvd_key] = os.environ[jsm_key]
 
 
+def _bridge_mpi_env() -> None:
+    """Map mpirun's rank-identity vars onto the HOROVOD_* env contract
+    when the latter is absent (mpirun launch path, runner/mpi_run.py:
+    mpirun is the process placer; OpenMPI/Spectrum export
+    ``OMPI_COMM_WORLD_*``, MPICH/Hydra export ``PMI_*``)."""
+    bridges = (
+        {  # OpenMPI / IBM Spectrum MPI
+            "HOROVOD_RANK": "OMPI_COMM_WORLD_RANK",
+            "HOROVOD_SIZE": "OMPI_COMM_WORLD_SIZE",
+            "HOROVOD_LOCAL_RANK": "OMPI_COMM_WORLD_LOCAL_RANK",
+            "HOROVOD_LOCAL_SIZE": "OMPI_COMM_WORLD_LOCAL_SIZE",
+        },
+        {  # MPICH (Hydra PMI; local identity rides MPI_LOCALRANKID)
+            "HOROVOD_RANK": "PMI_RANK",
+            "HOROVOD_SIZE": "PMI_SIZE",
+            "HOROVOD_LOCAL_RANK": "?MPI_LOCALRANKID",
+            "HOROVOD_LOCAL_SIZE": "?MPI_LOCALNRANKS",
+        },
+    )
+    for bridge in bridges:
+        # "?"-prefixed sources are optional; the rest gate the bridge.
+        required = {k: v for k, v in bridge.items()
+                    if not v.startswith("?")}
+        if all(v in os.environ for v in required.values()):
+            for hvd_key, mpi_key in bridge.items():
+                mpi_key = mpi_key.lstrip("?")
+                if mpi_key in os.environ:
+                    os.environ.setdefault(hvd_key, os.environ[mpi_key])
+            return
+
+
 def init(
     comm=None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -172,6 +203,7 @@ def init(
         if comm is not None and devices is None:
             devices = comm  # parity: allow init(devices)
         _bridge_jsm_env()
+        _bridge_mpi_env()
         _state.config = _config.from_env()
         _state.mesh = _build_mesh(devices, mesh_shape)
         _state.process_index = jax.process_index()

@@ -31,6 +31,25 @@ from . import lsf
 DEFAULT_CONTROLLER_PORT = 42223
 
 
+def apply_rendezvous_defaults(worker_env: Dict[str, str], first_host: str,
+                              num_proc: int) -> Dict[str, str]:
+    """Controller-rendezvous defaults shared by the jsrun and mpirun
+    process placers: every rank of a fresh allocation computes the same
+    (first host, fixed port) with no launcher RPC. Launcher-exported
+    HOROVOD_CONTROLLER_* beat the defaults (the env prefix overrides the
+    inherited environment, so the operator's escape hatch must be
+    honored here)."""
+    worker_env.setdefault(
+        "HOROVOD_CONTROLLER_ADDR",
+        os.environ.get("HOROVOD_CONTROLLER_ADDR", first_host))
+    worker_env.setdefault(
+        "HOROVOD_CONTROLLER_PORT",
+        os.environ.get("HOROVOD_CONTROLLER_PORT",
+                       str(DEFAULT_CONTROLLER_PORT)))
+    worker_env.setdefault("HOROVOD_SIZE", str(num_proc))
+    return worker_env
+
+
 def is_jsrun_installed() -> bool:
     """True if the jsrun binary is on PATH (reference js_run.py:44-46)."""
     return shutil.which("jsrun") is not None
@@ -113,19 +132,9 @@ def build_jsrun_command(command: Sequence[str],
                                      path=rankfile_path)
         binding_args = f"--erf_input {rf}"
 
-    worker_env = dict(env or {})
-    first_host = next(iter(validate_host_slots(hosts, num_proc)))[0]
-    # Launcher-exported HOROVOD_CONTROLLER_* beat the defaults (the env
-    # prefix below overrides jsrun's inherited environment, so the
-    # operator's escape hatch must be honored here).
-    worker_env.setdefault(
-        "HOROVOD_CONTROLLER_ADDR",
-        os.environ.get("HOROVOD_CONTROLLER_ADDR", first_host))
-    worker_env.setdefault(
-        "HOROVOD_CONTROLLER_PORT",
-        os.environ.get("HOROVOD_CONTROLLER_PORT",
-                       str(DEFAULT_CONTROLLER_PORT)))
-    worker_env.setdefault("HOROVOD_SIZE", str(num_proc))
+    worker_env = apply_rendezvous_defaults(
+        dict(env or {}),
+        next(iter(validate_host_slots(hosts, num_proc)))[0], num_proc)
     env_prefix = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(worker_env.items()))
 

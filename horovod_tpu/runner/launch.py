@@ -112,6 +112,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     lsf_grp.add_argument("--jsrun", action="store_true", dest="use_jsrun",
                          help="place workers with jsrun (LSF clusters; "
                               "np/hosts auto-derived from the allocation)")
+    mpi_grp = parser.add_argument_group("mpi")
+    mpi_grp.add_argument("--mpi", action="store_true", dest="use_mpi",
+                         help="place workers with the cluster's mpirun "
+                              "(OpenMPI/Spectrum/MPICH detected via "
+                              "'mpirun --version'; rank identity bridges "
+                              "from OMPI_COMM_WORLD_*/PMI_*)")
+    mpi_grp.add_argument("--mpi-args", dest="extra_mpi_args",
+                         help="extra arguments passed through to mpirun")
+    parser.add_argument("--ssh-port", type=int, dest="ssh_port",
+                        help="ssh port for remote workers (reference: "
+                             "horovodrun --ssh-port)")
     parser.add_argument("--network-interface", dest="network_interface",
                         help="comma-separated NIC names the controller "
                              "address may use (reference: horovodrun "
@@ -137,6 +148,14 @@ def _validate(args) -> None:
             "--jsrun places a fixed-size job; elastic flags "
             "(--min-np/--max-np/--host-discovery-script) are not "
             "supported with it")
+    if getattr(args, "use_mpi", False):
+        if args.elastic:
+            raise ValueError(
+                "--mpi places a fixed-size job; elastic flags "
+                "(--min-np/--max-np/--host-discovery-script) are not "
+                "supported with it")
+        if getattr(args, "use_jsrun", False):
+            raise ValueError("--mpi and --jsrun are mutually exclusive")
     if not args.elastic:
         if args.np is None and lsf.using_lsf():
             # Under LSF the allocation defines np/hosts (reference
@@ -189,7 +208,8 @@ def _run_static(args) -> None:
         launch_static(args.command, slots,
                       controller_port=None,
                       rendezvous_port=rendezvous_port,
-                      env=env, verbose=args.verbose)
+                      env=env, verbose=args.verbose,
+                      ssh_port=args.ssh_port)
     finally:
         rendezvous.stop()
 
@@ -200,18 +220,38 @@ def _run_elastic(args) -> None:
     launch_elastic(args, env=_build_env(args))
 
 
+def _hosts_dict(args):
+    """Ordered {hostname: slots} from -H/--hostfile, or None when the
+    placer should derive hosts from its own allocation."""
+    if not (args.hosts or args.hostfile):
+        return None
+    hosts = {}
+    for h in _get_hosts(args, args.np):
+        hosts[h.hostname] = hosts.get(h.hostname, 0) + h.slots
+    return hosts
+
+
 def _run_jsrun(args) -> None:
     from . import js_run
 
-    hosts = None
-    if args.hosts or args.hostfile:
-        hosts = {}
-        for h in _get_hosts(args, args.np):
-            hosts[h.hostname] = hosts.get(h.hostname, 0) + h.slots
+    hosts = _hosts_dict(args)
     rc = js_run.js_run(args.command, env=_build_env_overrides(args),
                        num_proc=args.np, hosts=hosts, verbose=args.verbose)
     if rc != 0:
         raise RuntimeError(f"jsrun exited with code {rc}")
+
+
+def _run_mpi(args) -> None:
+    from . import mpi_run
+
+    hosts = _hosts_dict(args)
+    rc = mpi_run.mpi_run(args.command, env=_build_env_overrides(args),
+                         num_proc=args.np, hosts=hosts,
+                         verbose=args.verbose, ssh_port=args.ssh_port,
+                         extra_mpi_args=getattr(args, "extra_mpi_args",
+                                                None))
+    if rc != 0:
+        raise RuntimeError(f"mpirun exited with code {rc}")
 
 
 def _build_env_overrides(args) -> dict:
@@ -243,6 +283,8 @@ def _run(args) -> None:
     _validate(args)
     if getattr(args, "use_jsrun", False):
         _run_jsrun(args)
+    elif getattr(args, "use_mpi", False):
+        _run_mpi(args)
     elif args.elastic:
         _run_elastic(args)
     else:

@@ -84,7 +84,8 @@ def slot_env(slot: SlotInfo, controller_addr: Optional[str],
 
 
 def get_run_command(command: Sequence[str], hostname: str,
-                    env: Dict[str, str]) -> str:
+                    env: Dict[str, str],
+                    ssh_port: Optional[int] = None) -> str:
     """Build the shell command for one slot; remote slots are wrapped in ssh
     with the env contract inlined (reference gloo_run.py:133-178). Shared by
     the static and elastic launchers."""
@@ -99,7 +100,8 @@ def get_run_command(command: Sequence[str], hostname: str,
                   if k.startswith("HOROVOD_") or k in ("PATH", "PYTHONPATH"))
     exported = " ".join(f"{k}={shlex.quote(env[k])}" for k in keys)
     remote = f"cd {shlex.quote(os.getcwd())} ; env {exported} {cmd}"
-    return f"{SSH_COMMAND_PREFIX} {hostname} {shlex.quote(remote)}"
+    port = f" -p {int(ssh_port)}" if ssh_port else ""
+    return f"{SSH_COMMAND_PREFIX}{port} {hostname} {shlex.quote(remote)}"
 
 
 def rendezvous_advertise_addr(slots: List[SlotInfo]) -> str:
@@ -116,7 +118,8 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
                   rendezvous_port: Optional[int] = None,
                   env: Optional[Dict[str, str]] = None,
                   verbose: int = 0,
-                  prefix_output_with_rank: bool = True) -> None:
+                  prefix_output_with_rank: bool = True,
+                  ssh_port: Optional[int] = None) -> None:
     """Launch every slot, stream output, fail fast on first failure
     (reference launch_gloo, gloo_run.py:221-266).
 
@@ -157,7 +160,8 @@ def launch_static(command: Sequence[str], slots: List[SlotInfo],
         senv = slot_env(slot, controller_addr, controller_port,
                         rendezvous_port, rendezvous_addr=rdv_addr,
                         base_env=env)
-        cmd = get_run_command(command, slot.hostname, senv)
+        cmd = get_run_command(command, slot.hostname, senv,
+                              ssh_port=ssh_port)
         if verbose >= 2:
             print(f"[launcher] rank {slot.rank} on {slot.hostname}: {cmd}",
                   file=sys.stderr)

@@ -272,6 +272,11 @@ class TestKVBootstrap:
         monkeypatch.delenv("HOROVOD_CONTROLLER_PORT", raising=False)
         yield server
         server.shutdown_server()
+        # resolve_controller WRITES these into os.environ; delenv on an
+        # absent var registers no cleanup, so scrub explicitly or they
+        # leak into later tests (observed: jsrun command synthesis).
+        os.environ.pop("HOROVOD_CONTROLLER_ADDR", None)
+        os.environ.pop("HOROVOD_CONTROLLER_PORT", None)
 
     def test_worker_uses_reported_port_and_nic_intersection(
             self, kv, monkeypatch):
