@@ -43,13 +43,15 @@ def _driver_addr() -> str:
 
 
 def make_exec_worker_fn(command: Sequence[str], env: Dict[str, str],
-                        driver: ElasticDriver, verbose: int = 0):
+                        driver: ElasticDriver, verbose: int = 0,
+                        ssh_port: Optional[int] = None):
     """create_worker_fn for ElasticDriver: exec the training command for a
     slot, return its exit code (reference gloo_run.py:282-320)."""
 
     def _exec(slot: SlotInfo, world_id: int) -> int:
         senv = _worker_env(slot, driver, env)
-        cmd = get_run_command(command, slot.hostname, senv)
+        cmd = get_run_command(command, slot.hostname, senv,
+                              ssh_port=ssh_port)
         if verbose >= 2:
             print(f"[elastic] spawn {slot.hostname}:{slot.local_rank} "
                   f"world {world_id}: {cmd}", file=sys.stderr)
@@ -77,8 +79,9 @@ def launch_elastic(args, env: Optional[Dict[str, str]] = None) -> None:
                            reset_limit=args.reset_limit,
                            verbose=args.verbose)
     try:
-        driver.start(make_exec_worker_fn(args.command, env, driver,
-                                         verbose=args.verbose))
+        driver.start(make_exec_worker_fn(
+            args.command, env, driver, verbose=args.verbose,
+            ssh_port=getattr(args, "ssh_port", None)))
         ok = driver.join()
         if not ok:
             raise RuntimeError("elastic job failed (no successful worker)")

@@ -110,6 +110,17 @@ def build_mpirun_command(command: Sequence[str],
             raise ValueError("num_proc or hosts is required")
         num_proc = sum(hosts.values())
 
+    if not hosts and "HOROVOD_CONTROLLER_ADDR" not in os.environ and \
+            not (env or {}).get("HOROVOD_CONTROLLER_ADDR"):
+        # Hosts may still be remote (mpirun's own --hostfile via
+        # --mpi-args): a 127.0.0.1 rendezvous would never form there.
+        import logging
+
+        logging.warning(
+            "mpi_run: no -H/--hostfile given; defaulting the controller "
+            "rendezvous to 127.0.0.1. If mpirun places ranks on REMOTE "
+            "hosts (e.g. via --mpi-args '--hostfile ...'), pass -H or "
+            "export HOROVOD_CONTROLLER_ADDR=<rank-0 host> instead.")
     worker_env = apply_rendezvous_defaults(
         dict(env or {}), next(iter(hosts)) if hosts else "127.0.0.1",
         num_proc)

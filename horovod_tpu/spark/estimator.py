@@ -26,11 +26,17 @@ class _EstimatorParams:
                  batch_size: int = 32, epochs: int = 1,
                  num_proc: Optional[int] = None,
                  verbose: int = 1, run_id: Optional[str] = None,
-                 loss=None, optimizer=None):
+                 loss=None, optimizer=None, validation=None):
         if model is None:
             raise ValueError("model is required")
         if not feature_cols or not label_cols:
             raise ValueError("feature_cols and label_cols are required")
+        if validation is not None and not isinstance(validation, str):
+            validation = float(validation)
+            if not 0.0 < validation < 1.0:
+                raise ValueError(
+                    f"validation fraction must be in (0, 1), got "
+                    f"{validation}")
         self.model = model
         self.store = store
         self.feature_cols = list(feature_cols)
@@ -42,6 +48,13 @@ class _EstimatorParams:
         self.run_id = run_id or "run_1"
         self.loss = loss
         self.optimizer = optimizer
+        # Reference: spark/keras/estimator.py:128-142 — a float is a
+        # row fraction held out for validation; a string names a column
+        # whose truthy rows are the validation set.
+        self.validation = validation
+        # Per-epoch metrics from the last fit(), rank-averaged
+        # ({"loss": [...], "val_loss": [...]}).
+        self.history_ = None
 
 
 class _ModelTransformer:
@@ -103,24 +116,52 @@ class _ModelTransformer:
             return schema
 
 
-def _collect_partition_numpy(df, feature_cols, label_cols, num_proc):
-    """df → list of (features, labels) numpy shards, one per rank, collected
-    on the driver. Only used when no Store is configured (small-data
-    convenience path); with a Store the scalable
-    :func:`_materialize_shards` path is used instead."""
+def _val_selector(validation):
+    """(partition_row_index, row) -> True for validation rows (reference:
+    spark/keras/estimator.py:128-142). A column name selects truthy
+    rows; a fraction selects a deterministic interleaved subset whose
+    density matches the fraction EXACTLY for any value in (0, 1) —
+    row ``i`` is validation iff the running count ``floor((i+1)*f)``
+    advances at ``i`` — so every rank's shard gets a proportional
+    validation slice without a shuffle."""
+    if validation is None:
+        return lambda i, r: False
+    if isinstance(validation, str):
+        return lambda i, r: bool(r[validation])
+    f = float(validation)
+    return lambda i, r: int((i + 1) * f) - int(i * f) >= 1
+
+
+def _collect_partition_numpy(df, feature_cols, label_cols, num_proc,
+                             validation=None):
+    """df → list of (features, labels, val_features, val_labels) numpy
+    shards, one per rank, collected on the driver. Only used when no
+    Store is configured (small-data convenience path); with a Store the
+    scalable :func:`_materialize_shards` path is used instead."""
     import numpy as np
 
-    rows = df.select(*feature_cols, *label_cols).collect()
-    feats = np.asarray([[r[c] for c in feature_cols] for r in rows],
-                       dtype="float32")
-    labels = np.asarray([[r[c] for c in label_cols] for r in rows],
-                        dtype="float32")
+    cols = list(feature_cols) + list(label_cols)
+    if isinstance(validation, str):
+        cols.append(validation)
+    rows = df.select(*cols).collect()
+    is_val = _val_selector(validation)
+    tr = [r for i, r in enumerate(rows) if not is_val(i, r)]
+    va = [r for i, r in enumerate(rows) if is_val(i, r)]
+
+    def to_np(rs, cs):
+        return np.asarray([[r[c] for c in cs] for r in rs],
+                          dtype="float32").reshape(len(rs), len(cs))
+
     shards = []
-    per = max(1, len(rows) // num_proc)
+    per = max(1, len(tr) // num_proc)
+    vper = max(1, len(va) // num_proc) if va else 0
     for i in range(num_proc):
-        lo = i * per
-        hi = len(rows) if i == num_proc - 1 else (i + 1) * per
-        shards.append((feats[lo:hi], labels[lo:hi]))
+        hi = len(tr) if i == num_proc - 1 else (i + 1) * per
+        vhi = len(va) if i == num_proc - 1 else (i + 1) * vper
+        t = tr[i * per:hi]
+        v = va[i * vper:vhi] if va else []
+        shards.append((to_np(t, feature_cols), to_np(t, label_cols),
+                       to_np(v, feature_cols), to_np(v, label_cols)))
     return shards
 
 
@@ -139,20 +180,24 @@ def _chunk_rows() -> int:
 
 
 def _materialize_shards(df, feature_cols, label_cols, num_proc, store,
-                        run_id, chunk_rows=None):
+                        run_id, chunk_rows=None, validation=None):
     """Materialize ``df`` to ``num_proc`` per-rank shard directories *on
     the executors* (reference: spark/common/util.py prepare_data —
     DataFrame → Parquet → Petastorm readers). The driver never collects
     the dataset (round-1 verdict #5), and each shard is CHUNKED
     (``shard_i/chunk_XXXXX.npz`` + ``meta.json``) so workers stream it per
     epoch instead of loading the whole shard (round-2 missing #5: the
-    whole-``.npz`` load capped dataset size at worker RAM).
+    whole-``.npz`` load capped dataset size at worker RAM). With
+    ``validation`` set, each partition's validation rows stream to
+    sibling ``val_chunk_XXXXX.npz`` files (reference
+    keras/estimator.py:128-142 validation split).
 
     Returns ``(data_dir, rows_per_shard)``.
     """
     fcols, lcols = list(feature_cols), list(label_cols)
     data_dir = f"{store.get_train_data_path()}/{run_id}"
     chunk_rows = chunk_rows or _chunk_rows()
+    is_val = _val_selector(validation)
 
     def _write(idx, rows):
         import io as _io
@@ -160,7 +205,7 @@ def _materialize_shards(df, feature_cols, label_cols, num_proc, store,
 
         import numpy as _np
 
-        def _flush(feats, labels, k):
+        def _flush(feats, labels, k, prefix):
             buf = _io.BytesIO()
             _np.savez(
                 buf,
@@ -168,27 +213,39 @@ def _materialize_shards(df, feature_cols, label_cols, num_proc, store,
                     len(feats), len(fcols)),
                 labels=_np.asarray(labels, "float32").reshape(
                     len(labels), len(lcols)))
-            store.write(f"{data_dir}/shard_{idx}/chunk_{k:05d}.npz",
-                        buf.getvalue())
+            store.write(
+                f"{data_dir}/shard_{idx}/{prefix}chunk_{k:05d}.npz",
+                buf.getvalue())
             return len(feats)
 
-        feats, labels = [], []
-        chunk_sizes = []
-        for r in rows:
+        bufs = {"": ([], [], []), "val_": ([], [], [])}
+        for i, r in enumerate(rows):
+            prefix = "val_" if is_val(i, r) else ""
+            feats, labels, sizes = bufs[prefix]
             feats.append([float(r[c]) for c in fcols])
             labels.append([float(r[c]) for c in lcols])
             if len(feats) >= chunk_rows:
-                chunk_sizes.append(_flush(feats, labels, len(chunk_sizes)))
-                feats, labels = [], []
-        if feats or not chunk_sizes:  # empty shard still gets chunk 0
-            chunk_sizes.append(_flush(feats, labels, len(chunk_sizes)))
+                sizes.append(_flush(feats, labels, len(sizes), prefix))
+                feats.clear()
+                labels.clear()
+        for prefix, (feats, labels, sizes) in bufs.items():
+            if prefix == "val_" and validation is None:
+                continue  # no val files at all without a split
+            if feats or not sizes:  # empty split still gets chunk 0
+                sizes.append(_flush(feats, labels, len(sizes), prefix))
+        train_sizes = bufs[""][2]
+        val_sizes = bufs["val_"][2]
         store.write(f"{data_dir}/shard_{idx}/meta.json", _json.dumps({
-            "rows": sum(chunk_sizes), "chunk_sizes": chunk_sizes,
+            "rows": sum(train_sizes), "chunk_sizes": train_sizes,
+            "val_rows": sum(val_sizes), "val_chunk_sizes": val_sizes,
             "n_features": len(fcols), "n_labels": len(lcols),
         }).encode())
-        yield (idx, sum(chunk_sizes))
+        yield (idx, sum(train_sizes))
 
-    rdd = df.select(*fcols, *lcols).repartition(num_proc).rdd
+    cols = fcols + lcols
+    if isinstance(validation, str):
+        cols = cols + [validation]
+    rdd = df.select(*cols).repartition(num_proc).rdd
     counts = dict(rdd.mapPartitionsWithIndex(_write).collect())
     return data_dir, [counts.get(i, 0) for i in range(num_proc)]
 
@@ -202,14 +259,21 @@ class ShardReader:
     ``max_resident_rows`` records the high-water mark of rows held, so
     tests can assert the memory bound."""
 
-    def __init__(self, store, data_dir: str, rank: int):
+    def __init__(self, store, data_dir: str, rank: int,
+                 split: str = "train"):
         import json as _json
 
+        if split not in ("train", "val"):
+            raise ValueError(f"split must be train|val, got {split!r}")
         self._store = store
         self._dir = f"{data_dir}/shard_{rank}"
+        self._prefix = "" if split == "train" else "val_"
         meta = _json.loads(store.read(f"{self._dir}/meta.json"))
-        self.rows = int(meta["rows"])
-        self.chunk_sizes = list(meta["chunk_sizes"])
+        rows_key = "rows" if split == "train" else "val_rows"
+        sizes_key = ("chunk_sizes" if split == "train"
+                     else "val_chunk_sizes")
+        self.rows = int(meta.get(rows_key, 0))
+        self.chunk_sizes = list(meta.get(sizes_key, []))
         self.max_resident_rows = 0
 
     def _load_chunk(self, k: int):
@@ -218,7 +282,7 @@ class ShardReader:
         import numpy as _np
 
         with _np.load(_io.BytesIO(self._store.read(
-                f"{self._dir}/chunk_{k:05d}.npz"))) as z:
+                f"{self._dir}/{self._prefix}chunk_{k:05d}.npz"))) as z:
             x, y = z["features"], z["labels"]
         self.max_resident_rows = max(self.max_resident_rows, len(x))
         return x, y
@@ -259,10 +323,11 @@ def _prepare_data(df, params):
     if params.store is not None:
         data_dir, _ = _materialize_shards(
             df, params.feature_cols, params.label_cols, num_proc,
-            params.store, params.run_id)
+            params.store, params.run_id, validation=params.validation)
         return None, params.store, data_dir
-    return _collect_partition_numpy(df, params.feature_cols,
-                                    params.label_cols, num_proc), None, None
+    return _collect_partition_numpy(
+        df, params.feature_cols, params.label_cols, num_proc,
+        validation=params.validation), None, None
 
 
 class KerasEstimator(_EstimatorParams):
@@ -282,6 +347,7 @@ class KerasEstimator(_EstimatorParams):
         loss = self.loss or "mse"
         lr_opt = self.optimizer
         batch_size, epochs = self.batch_size, self.epochs
+        has_val = self.validation is not None
 
         def _train():
             import numpy as np
@@ -297,8 +363,13 @@ class KerasEstimator(_EstimatorParams):
                           loss=loss)
             callbacks = [
                 hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+                # Averages every epoch metric across ranks — incl.
+                # val_loss, so rank 0's history is the GLOBAL
+                # validation metric (reference: remote.py metric
+                # aggregation).
                 hvd.callbacks.MetricAverageCallback(),
             ]
+            fit_kw = dict(epochs=epochs, verbose=0, callbacks=callbacks)
             if data_dir is not None:
                 # Stream the chunked shard: one chunk resident at a time
                 # (reference: the per-epoch Petastorm reader loop in
@@ -314,23 +385,51 @@ class KerasEstimator(_EstimatorParams):
                         f"rank {hvd.rank()} received an empty data "
                         f"shard; provide at least num_proc rows (or "
                         f"lower num_proc)")
+                if has_val:
+                    vreader = ShardReader(store, data_dir, hvd.rank(),
+                                          split="val")
+                    if vreader.rows == 0:
+                        # Every rank must emit val metrics or the
+                        # metric-average collective's key sets diverge.
+                        raise ValueError(
+                            f"rank {hvd.rank()} received an empty "
+                            f"VALIDATION shard; provide more rows or a "
+                            f"larger validation fraction")
+
+                    def _vgen():
+                        while True:
+                            yield from vreader.iter_batches(batch_size)
+
+                    fit_kw.update(
+                        validation_data=_vgen(),
+                        validation_steps=vreader.steps_per_epoch(
+                            batch_size))
 
                 def _gen():
                     while True:
                         yield from reader.iter_batches(batch_size)
 
-                model.fit(_gen(),
-                          steps_per_epoch=reader.steps_per_epoch(
-                              batch_size),
-                          epochs=epochs, verbose=0, callbacks=callbacks)
+                hist = model.fit(
+                    _gen(),
+                    steps_per_epoch=reader.steps_per_epoch(batch_size),
+                    **fit_kw)
             else:
-                x, y = shards[hvd.rank()]
-                model.fit(x, y, batch_size=batch_size, epochs=epochs,
-                          verbose=0, callbacks=callbacks)
-            return [np.asarray(w) for w in model.get_weights()]
+                x, y, xv, yv = shards[hvd.rank()]
+                if has_val:
+                    if len(xv) == 0:
+                        raise ValueError(
+                            f"rank {hvd.rank()} received an empty "
+                            f"VALIDATION shard; provide more rows or a "
+                            f"larger validation fraction")
+                    fit_kw["validation_data"] = (xv, yv)
+                hist = model.fit(x, y, batch_size=batch_size, **fit_kw)
+            return ([np.asarray(w) for w in model.get_weights()],
+                    {k: [float(v) for v in vs]
+                     for k, vs in hist.history.items()})
 
         results = spark_run(_train, num_proc=num_proc)
-        self.model.set_weights(results[0])
+        weights, self.history_ = results[0]
+        self.model.set_weights(weights)
         if self.store is not None:
             ckpt = self.store.get_checkpoint_path(self.run_id)
             self.store.write(ckpt + "/model.keras",
@@ -359,6 +458,7 @@ class TorchEstimator(_EstimatorParams):
         batch_size, epochs = self.batch_size, self.epochs
         opt_factory = self.optimizer or (
             lambda params: torch.optim.Adam(params))
+        has_val = self.validation is not None
 
         def _train():
             import io as _io
@@ -380,26 +480,77 @@ class TorchEstimator(_EstimatorParams):
                 loss = loss_fn(out, T.from_numpy(yb))
                 loss.backward()
                 opt.step()
+                return float(loss)
 
+            def _rank_avg(local):
+                """Rank-average a scalar metric — the same global
+                metric MetricAverageCallback produces on the Keras
+                path (applied to BOTH loss series so history_ is
+                uniformly rank-averaged)."""
+                return float(hvd.allreduce(T.tensor([float(local)]),
+                                           average=True)[0])
+
+            def _val_loss(batches):
+                model.eval()  # freeze dropout/BN: no val-data leakage
+                try:
+                    total, n = 0.0, 0
+                    with T.no_grad():
+                        for xb, yb in batches:
+                            total += float(loss_fn(
+                                model(T.from_numpy(xb)),
+                                T.from_numpy(yb)))
+                            n += 1
+                finally:
+                    model.train()
+                return _rank_avg(total / max(n, 1))
+
+            history = {"loss": []}
+            if has_val:
+                history["val_loss"] = []
             if data_dir is not None:
                 # Stream the chunked shard per epoch (reference:
                 # spark/torch/remote.py reader loop).
                 reader = ShardReader(store, data_dir, hvd.rank())
+                vreader = ShardReader(store, data_dir, hvd.rank(),
+                                      split="val") if has_val else None
+                if has_val and vreader.rows == 0:
+                    raise ValueError(
+                        f"rank {hvd.rank()} received an empty "
+                        f"VALIDATION shard; provide more rows or a "
+                        f"larger validation fraction")
                 for _ in range(epochs):
-                    for xb, yb in reader.iter_batches(batch_size):
-                        _step(xb, yb)
+                    ep = [_step(xb, yb)
+                          for xb, yb in reader.iter_batches(batch_size)]
+                    history["loss"].append(
+                        _rank_avg(sum(ep) / max(len(ep), 1)))
+                    if has_val:
+                        history["val_loss"].append(
+                            _val_loss(vreader.iter_batches(batch_size)))
             else:
-                x, y = shards[hvd.rank()]
+                x, y, xv, yv = shards[hvd.rank()]
+                if has_val and len(xv) == 0:
+                    raise ValueError(
+                        f"rank {hvd.rank()} received an empty "
+                        f"VALIDATION shard; provide more rows or a "
+                        f"larger validation fraction")
                 for _ in range(epochs):
-                    for i in range(0, len(x), batch_size):
-                        _step(x[i:i + batch_size], y[i:i + batch_size])
-            return {k: v.numpy() for k, v in model.state_dict().items()}
+                    ep = [_step(x[i:i + batch_size], y[i:i + batch_size])
+                          for i in range(0, len(x), batch_size)]
+                    history["loss"].append(
+                        _rank_avg(sum(ep) / max(len(ep), 1)))
+                    if has_val:
+                        history["val_loss"].append(_val_loss(
+                            (xv[i:i + batch_size], yv[i:i + batch_size])
+                            for i in range(0, len(xv), batch_size)))
+            return ({k: v.numpy() for k, v in model.state_dict().items()},
+                    history)
 
         results = spark_run(_train, num_proc=num_proc)
         import torch as T
 
+        state, self.history_ = results[0]
         self.model.load_state_dict(
-            {k: T.from_numpy(v) for k, v in results[0].items()})
+            {k: T.from_numpy(v) for k, v in state.items()})
         return _ModelTransformer(
             self.model, self.feature_cols, self.label_cols,
             lambda m, f: m(__import__("torch").from_numpy(f))
@@ -415,8 +566,9 @@ def _serialize_keras(model) -> bytes:
     try:
         keras.saving.save_model(model, buf, save_format="keras")
         return buf.getvalue()
-    except TypeError:
-        # Older keras: save to a temp file path
+    except (TypeError, ValueError):
+        # Keras 3 rejects save_format / non-path targets: use a temp
+        # .keras file path instead.
         import os
         import tempfile
 
@@ -439,7 +591,7 @@ def _deserialize_keras(data: bytes):
 
     try:
         return keras.saving.load_model(io.BytesIO(data))
-    except TypeError:
+    except (TypeError, ValueError):
         fd, path = tempfile.mkstemp(suffix=".keras")
         os.close(fd)
         try:

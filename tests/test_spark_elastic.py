@@ -266,6 +266,134 @@ class TestMaterializeShards:
         assert residents and max(residents) <= 16, residents
 
 
+class TestValidationSplit:
+    """validation=<fraction|column> (reference keras/estimator.py:128-142):
+    executor-side split into sibling val chunks, streamed per epoch, with
+    per-epoch validation metrics averaged across ranks."""
+
+    def test_materialize_fraction_split(self, tmp_path):
+        import numpy as np
+
+        rows = [{"x": float(i), "y": 0.0} for i in range(40)]
+        store = LocalStore(str(tmp_path / "store"))
+        data_dir, counts = _materialize_shards(
+            _FakeDF(rows), ["x"], ["y"], 2, store, "run_v",
+            chunk_rows=8, validation=0.25)
+        train_x, val_x = [], []
+        for rank in range(2):
+            tr = ShardReader(store, data_dir, rank)
+            va = ShardReader(store, data_dir, rank, split="val")
+            for x, _ in tr.iter_chunks():
+                train_x.extend(x[:, 0].tolist())
+            for x, _ in va.iter_chunks():
+                val_x.extend(x[:, 0].tolist())
+            # every 4th row of each partition is validation
+            assert va.rows == 5 and tr.rows == 15
+        assert not set(train_x) & set(val_x)  # disjoint
+        assert sorted(train_x + val_x) == [float(i) for i in range(40)]
+        assert counts == [15, 15]  # counts report TRAIN rows
+
+    def test_materialize_column_split(self, tmp_path):
+        rows = [{"x": float(i), "y": 0.0, "is_val": float(i >= 30)}
+                for i in range(40)]
+        store = LocalStore(str(tmp_path / "store"))
+        data_dir, _ = _materialize_shards(
+            _FakeDF(rows), ["x"], ["y"], 2, store, "run_vc",
+            validation="is_val")
+        total_val = sum(
+            ShardReader(store, data_dir, r, split="val").rows
+            for r in range(2))
+        total_train = sum(
+            ShardReader(store, data_dir, r).rows for r in range(2))
+        assert total_val == 10 and total_train == 30
+
+    def test_no_validation_writes_no_val_files(self, tmp_path):
+        rows = [{"x": 1.0, "y": 0.0}] * 4
+        store = LocalStore(str(tmp_path / "store"))
+        data_dir, _ = _materialize_shards(
+            _FakeDF(rows), ["x"], ["y"], 1, store, "run_nv")
+        va = ShardReader(store, data_dir, 0, split="val")
+        assert va.rows == 0 and va.chunk_sizes == []
+
+    def test_fraction_bounds_validated(self):
+        import torch
+
+        from horovod_tpu.spark import estimator as est_mod
+
+        with pytest.raises(ValueError, match="validation fraction"):
+            est_mod.TorchEstimator(
+                model=torch.nn.Linear(1, 1), feature_cols=["x"],
+                label_cols=["y"], validation=1.5)
+
+    def test_torch_estimator_validation_history(self, tmp_path,
+                                                monkeypatch):
+        import numpy as np
+        import torch
+
+        import horovod_tpu.spark as hvd_spark
+        from horovod_tpu.spark import estimator as est_mod
+
+        monkeypatch.setattr(hvd_spark, "run",
+                            lambda fn, num_proc=None, **kw: [fn()])
+        rng = np.random.RandomState(1)
+        rows = [{"x1": float(v), "y": float(3 * v)} for v in rng.randn(64)]
+        store = LocalStore(str(tmp_path / "store"))
+        est = est_mod.TorchEstimator(
+            model=torch.nn.Linear(1, 1), store=store,
+            feature_cols=["x1"], label_cols=["y"],
+            batch_size=8, epochs=3, num_proc=1, validation=0.25)
+        est.fit(_FakeDF(rows))
+        assert sorted(est.history_) == ["loss", "val_loss"]
+        assert len(est.history_["val_loss"]) == 3
+        assert all(np.isfinite(v) for v in est.history_["val_loss"])
+        # training reduces the train loss on this linear fit
+        assert est.history_["loss"][-1] < est.history_["loss"][0]
+
+    def test_keras_estimator_validation_history(self, tmp_path,
+                                                monkeypatch):
+        keras = pytest.importorskip("keras")
+        import numpy as np
+
+        import horovod_tpu.spark as hvd_spark
+        from horovod_tpu.spark import estimator as est_mod
+
+        monkeypatch.setattr(hvd_spark, "run",
+                            lambda fn, num_proc=None, **kw: [fn()])
+        rng = np.random.RandomState(2)
+        rows = [{"x1": float(v), "y": float(2 * v)} for v in rng.randn(48)]
+        store = LocalStore(str(tmp_path / "store"))
+        model = keras.Sequential([keras.layers.Input(shape=(1,)),
+                                  keras.layers.Dense(1)])
+        est = est_mod.KerasEstimator(
+            model=model, store=store, feature_cols=["x1"],
+            label_cols=["y"], batch_size=8, epochs=2, num_proc=1,
+            validation=0.25)
+        est.fit(_FakeDF(rows))
+        assert "val_loss" in est.history_
+        assert len(est.history_["val_loss"]) == 2
+
+    def test_empty_validation_shard_fails_loudly(self, tmp_path,
+                                                 monkeypatch):
+        import numpy as np
+        import torch
+
+        import horovod_tpu.spark as hvd_spark
+        from horovod_tpu.spark import estimator as est_mod
+
+        monkeypatch.setattr(hvd_spark, "run",
+                            lambda fn, num_proc=None, **kw: [fn()])
+        # Column split where NO row is marked validation -> empty val
+        # shard must raise, not hang the metric collective.
+        rows = [{"x1": 1.0, "y": 1.0, "v": 0.0}] * 8
+        store = LocalStore(str(tmp_path / "store"))
+        est = est_mod.TorchEstimator(
+            model=torch.nn.Linear(1, 1), store=store,
+            feature_cols=["x1"], label_cols=["y"],
+            batch_size=4, epochs=1, num_proc=1, validation="v")
+        with pytest.raises(ValueError, match="VALIDATION"):
+            est.fit(_FakeDF(rows))
+
+
 class TestDistributedTransform:
     class _MapInPandasDF:
         """Spark-DataFrame double pinning the mapInPandas surface the
