@@ -265,7 +265,8 @@ def run_once(args, devices, platform):
                  if args.gpt_scale == "124m" else
                  dict(num_layers=24, num_heads=16, d_model=1024, d_ff=4096))
         cfg = GPTConfig(vocab_size=args.vocab_size, max_seq_len=args.seq_len,
-                        attention=args.attention, **shape)
+                        attention=args.attention, fused_ln=args.fused_ln,
+                        remat=args.remat, **shape)
         model = GPT(cfg)
         variables = model.init(rng, jnp.zeros((1, args.seq_len), jnp.int32))
         params, batch_stats = variables["params"], {}
@@ -504,6 +505,12 @@ def main():
                     help="GPT attention path: flash = Pallas kernel "
                          "(no [T,T] HBM round-trip), dense = reference "
                          "einsum attention")
+    ap.add_argument("--fused-ln", action="store_true",
+                    help="fused residual+LayerNorm Pallas kernel for each "
+                         "block's second LN (GPT; MFU A/B lever)")
+    ap.add_argument("--remat", action="store_true",
+                    help="checkpoint each GPT block (trade FLOPs for HBM; "
+                         "lets bigger --batch-size fit)")
     ap.add_argument("--lm-loss", choices=["auto", "fused", "dense"],
                     default="auto",
                     help="GPT LM-head loss. auto (default) = dense while "

@@ -77,6 +77,12 @@ class GPTConfig:
     # factor * N / n rows.
     moe_ragged: bool = False
     moe_pair_capacity_factor: float = 2.0
+    # Fused residual-add + LayerNorm Pallas kernel for each block's
+    # second LN (ops/layer_norm.py): saves one HBM round trip of the
+    # [B, T, C] stream per block when XLA does not fuse the add into the
+    # LN reductions. Param tree is identical either way (ln2/scale,
+    # ln2/bias), so checkpoints are interchangeable.
+    fused_ln: bool = False
     # Return the final-LayerNorm hidden states [B, T, d_model] instead of
     # logits — for a fused LM-head loss (ops/softmax_xent.py) that never
     # materializes the [N, vocab] logits. Parameters are identical either
@@ -173,14 +179,40 @@ class _MLP(nn.Module):
         return lax.psum(x, cfg.tp_axis) if tp > 1 else x
 
 
+class _FusedLNAdd(nn.Module):
+    """Residual add + LayerNorm in one Pallas pass (ops/layer_norm.py).
+
+    Param names/shapes match ``nn.LayerNorm`` exactly (scale, bias under
+    this module's name) so dense checkpoints load into fused models and
+    back."""
+
+    cfg: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, sub):
+        from ..ops.layer_norm import ln_residual
+
+        C = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (C,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (C,),
+                          jnp.float32)
+        # eps matches flax nn.LayerNorm's default (1e-6) so fused and
+        # unfused models are numerically interchangeable.
+        y, h = ln_residual(x, sub, scale, bias, 1e-6)
+        return y.astype(self.cfg.dtype), h
+
+
 class _Block(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        x = x + _Attention(cfg, name="attn")(
+        attn_out = _Attention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
+        if not cfg.fused_ln:
+            x = x + attn_out
         if cfg.moe_experts:
             from ..parallel.expert import SwitchMoE
 
@@ -192,6 +224,11 @@ class _Block(nn.Module):
                             name="moe")
         else:
             ffn = _MLP(cfg, name="mlp")
+        if cfg.fused_ln:
+            # One pass: h = x + attn_out (the stream continues through
+            # h), m = ln2(h) — the Pallas kernel's HBM saving.
+            m, h = _FusedLNAdd(cfg, name="ln2")(x, attn_out)
+            return h + ffn(m)
         x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
         return x
 

@@ -480,7 +480,7 @@ class TorchEstimator(_EstimatorParams):
                 loss = loss_fn(out, T.from_numpy(yb))
                 loss.backward()
                 opt.step()
-                return float(loss)
+                return float(loss.detach()), len(xb)
 
             def _rank_avg(local):
                 """Rank-average a scalar metric — the same global
@@ -490,19 +490,27 @@ class TorchEstimator(_EstimatorParams):
                 return float(hvd.allreduce(T.tensor([float(local)]),
                                            average=True)[0])
 
+            def _row_mean(pairs):
+                """Sample-weighted mean of (batch_mean, batch_rows) —
+                partial tail batches must not skew the metric (Keras
+                weights by sample count the same way)."""
+                total, n = 0.0, 0
+                for mean, rows in pairs:
+                    total += mean * rows
+                    n += rows
+                return total / max(n, 1)
+
             def _val_loss(batches):
                 model.eval()  # freeze dropout/BN: no val-data leakage
                 try:
-                    total, n = 0.0, 0
                     with T.no_grad():
-                        for xb, yb in batches:
-                            total += float(loss_fn(
-                                model(T.from_numpy(xb)),
-                                T.from_numpy(yb)))
-                            n += 1
+                        pairs = [
+                            (float(loss_fn(model(T.from_numpy(xb)),
+                                           T.from_numpy(yb))), len(xb))
+                            for xb, yb in batches]
                 finally:
                     model.train()
-                return _rank_avg(total / max(n, 1))
+                return _rank_avg(_row_mean(pairs))
 
             history = {"loss": []}
             if has_val:
@@ -521,8 +529,7 @@ class TorchEstimator(_EstimatorParams):
                 for _ in range(epochs):
                     ep = [_step(xb, yb)
                           for xb, yb in reader.iter_batches(batch_size)]
-                    history["loss"].append(
-                        _rank_avg(sum(ep) / max(len(ep), 1)))
+                    history["loss"].append(_rank_avg(_row_mean(ep)))
                     if has_val:
                         history["val_loss"].append(
                             _val_loss(vreader.iter_batches(batch_size)))
@@ -536,8 +543,7 @@ class TorchEstimator(_EstimatorParams):
                 for _ in range(epochs):
                     ep = [_step(x[i:i + batch_size], y[i:i + batch_size])
                           for i in range(0, len(x), batch_size)]
-                    history["loss"].append(
-                        _rank_avg(sum(ep) / max(len(ep), 1)))
+                    history["loss"].append(_rank_avg(_row_mean(ep)))
                     if has_val:
                         history["val_loss"].append(_val_loss(
                             (xv[i:i + batch_size], yv[i:i + batch_size])

@@ -1,0 +1,155 @@
+"""Fused residual+LayerNorm Pallas kernel (ops/layer_norm.py) vs the
+plain-XLA formulation — forward, both outputs, full gradient set, odd
+shapes, and the shard_map (DP) path. Interpreter mode on CPU; the same
+code path compiles on TPU (bench --fused-ln A/B)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.layer_norm import ln_residual
+
+
+def ref_ln_residual(x, res, gamma, beta, eps=1e-5):
+    h = (x + res).astype(jnp.float32)
+    mean = jnp.mean(h, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(h - mean), axis=-1, keepdims=True)
+    y = (h - mean) * jax.lax.rsqrt(var + eps) * gamma + beta
+    return y.astype(x.dtype), h.astype(x.dtype)
+
+
+def _data(shape=(4, 32, 128), dtype=jnp.float32, seed=0):
+    rs = np.random.RandomState(seed)
+    C = shape[-1]
+    x = jnp.asarray(rs.randn(*shape), dtype)
+    r = jnp.asarray(rs.randn(*shape), dtype) * 0.5
+    g = jnp.asarray(1.0 + 0.1 * rs.randn(C), jnp.float32)
+    b = jnp.asarray(0.1 * rs.randn(C), jnp.float32)
+    return x, r, g, b
+
+
+@pytest.mark.parametrize("shape", [(4, 32, 128), (8, 256), (2, 7, 384)])
+def test_forward_matches_reference(shape):
+    x, r, g, b = _data(shape)
+    y, h = ln_residual(x, r, g, b)
+    ye, he = ref_ln_residual(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ye),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(he),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_forward_bf16():
+    x, r, g, b = _data((4, 64, 256), jnp.bfloat16)
+    y, h = ln_residual(x, r, g, b)
+    ye, he = ref_ln_residual(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(y, np.float32),
+                               np.asarray(ye, np.float32),
+                               rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(np.asarray(h, np.float32),
+                               np.asarray(he, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_gradients_match_reference():
+    x, r, g, b = _data((2, 16, 128))
+    w = jnp.asarray(np.random.RandomState(5).randn(128), jnp.float32)
+
+    def loss_fused(x, r, g, b):
+        y, h = ln_residual(x, r, g, b)
+        # use BOTH outputs so dh and dy cotangents are exercised
+        return jnp.sum(y * w) + jnp.sum(jnp.square(h)) * 0.1
+
+    def loss_ref(x, r, g, b):
+        y, h = ref_ln_residual(x, r, g, b)
+        return jnp.sum(y * w) + jnp.sum(jnp.square(h)) * 0.1
+
+    gf = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, r, g, b)
+    ge = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, r, g, b)
+    for a, e in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("shape", [(3, 5, 128), (1021, 128)])
+def test_rows_pad_to_block_multiple(shape):
+    # Non-multiple (and PRIME) row counts pad up to a block multiple —
+    # never degrade to 1-row blocks — and grads see no padding rows.
+    x, r, g, b = _data(shape, seed=2)
+    y, _ = ln_residual(x, r, g, b, 1e-5, 256)
+    ye, _ = ref_ln_residual(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ye),
+                               rtol=1e-5, atol=1e-5)
+    gf = jax.grad(lambda g: jnp.sum(ln_residual(x, r, g, b)[0]))(g)
+    ge = jax.grad(lambda g: jnp.sum(ref_ln_residual(x, r, g, b)[0]))(g)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(ge),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_under_shard_map_dp():
+    # DP over the batch: the kernel's vma harmonization must accept
+    # varying streams with replicated gamma/beta.
+    x, r, g, b = _data((8, 16, 128), seed=3)
+
+    def f(xs, rs, g, b):
+        y, h = ln_residual(xs, rs, g, b)
+        return y, h
+
+    y, h = jax.jit(jax.shard_map(
+        f, mesh=hvd.mesh(),
+        in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(), P()),
+        out_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES))))(x, r, g, b)
+    ye, he = ref_ln_residual(x, r, g, b)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ye),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(he),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gpt_fused_ln_matches_unfused():
+    """GPTConfig.fused_ln swaps the add+ln2 pair for the kernel with an
+    IDENTICAL param tree: same init loads into both, same outputs and
+    gradients (the bench --fused-ln A/B is purely a perf lever)."""
+    import dataclasses
+
+    import optax
+
+    from horovod_tpu.models import GPT, gpt_tiny
+
+    cfg = gpt_tiny(dtype=jnp.float32)
+    cfg_f = dataclasses.replace(cfg, fused_ln=True)
+    rs = np.random.RandomState(0)
+    toks = rs.randint(0, cfg.vocab_size, (2, 33))
+    x, yt = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    v = GPT(cfg).init(jax.random.PRNGKey(0), x)
+    # identical param trees
+    assert jax.tree.structure(v) == jax.tree.structure(
+        GPT(cfg_f).init(jax.random.PRNGKey(0), x))
+    out_d = GPT(cfg).apply(v, x)
+    out_f = GPT(cfg_f).apply(v, x)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_d),
+                               rtol=2e-4, atol=2e-4)
+
+    def loss(params, c):
+        out = GPT(c).apply({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            out, yt).mean()
+
+    gd = jax.grad(loss)(v["params"], cfg)
+    gf = jax.grad(loss)(v["params"], cfg_f)
+    flat_d = jax.tree.leaves(gd)
+    flat_f = jax.tree.leaves(gf)
+    for a, e in zip(flat_f, flat_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(e),
+                                   rtol=5e-3, atol=1e-4)
+
+
+def test_shape_validation():
+    x, r, g, b = _data()
+    with pytest.raises(ValueError, match="mismatch"):
+        ln_residual(x, r[:2], g, b)
+    with pytest.raises(ValueError, match="gamma"):
+        ln_residual(x, r, g[:5], b)
