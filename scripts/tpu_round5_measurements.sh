@@ -1,0 +1,39 @@
+#!/bin/bash
+# One-shot hardware measurement sweep for the round-5 features.
+# Run the moment the TPU tunnel is reachable:
+#   bash scripts/tpu_round5_measurements.sh [OUTDIR]
+# Captures, in order of VERDICT r4 priority:
+#   1. ResNet-50 + GPT-124M/350M regressions vs round 3 (2271 img/s,
+#      117.2k / 42.9k tok/s)
+#   2. the MFU A/B levers: --fused-ln, --remat (+batch sweep), and a
+#      fresh-cache kernel-autotune run (first-run sweep -> second-run
+#      cache hit in the log tail)
+#   3. GPT-350M profile for the MFU gap attribution table
+#   4. the elastic-on-TPU smoke (PJRT teardown/re-acquisition)
+set -u
+OUT=${1:-/root/repo/BENCH_r05_sweep}
+mkdir -p "$OUT"
+cd /root/repo
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  timeout 560 "$@" >"$OUT/$name.log" 2>&1
+  rc=$?
+  tail -3 "$OUT/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+run resnet50          python bench.py
+run gpt124m           python bench.py --model gpt --batch-size 16
+run gpt350m           python bench.py --model gpt --gpt-scale 350m --batch-size 8
+run gpt350m_fusedln   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+run gpt350m_remat16   python bench.py --model gpt --gpt-scale 350m --batch-size 16 --remat
+run gpt124m_fusedln   python bench.py --model gpt --batch-size 16 --fused-ln
+# Fresh-cache autotune: sweep on run 1, cache hit on run 2.
+AT_CACHE=$OUT/autotune_cache.json
+run gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
+run gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
+run gpt350m_profile   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
+run elastic_smoke     python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
+echo "all artifacts in $OUT"
+grep -h '"metric"' "$OUT"/*.log 2>/dev/null | tail -20
