@@ -709,7 +709,7 @@ def main():
             "behind bench flags; scripts/tpu_round5_measurements.sh "
             "captures the full sweep in one command when the chip is "
             "reachable.")}
-           if platform == "cpu" else {}),
+           if platform == "cpu" and args.platform != "cpu" else {}),
     }), flush=True)
 
 

@@ -462,9 +462,11 @@ def alltoall(tensor, splits=None, *, name: Optional[str] = None, axes=None):
         s = np.asarray(splits)
         if not (s.ndim == 1 and len(s) == n and np.all(s == s[0])):
             raise NotImplementedError(
-                "uneven alltoall splits require static shapes under XLA; "
-                "use equal splits in compiled code (reference uneven path: "
-                "operations.cc:1031-1092)")
+                "uneven alltoall splits require static shapes under XLA: "
+                "use hvd.alltoall_ragged(tensor, splits, capacity=...) — "
+                "the compiled static-capacity protocol for the reference's "
+                "uneven path (operations.cc:1031-1092) — or equal splits "
+                "here")
     if tensor.shape[0] % n != 0:
         raise ValueError(
             f"alltoall dim 0 ({tensor.shape[0]}) must be divisible by the "

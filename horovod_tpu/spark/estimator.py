@@ -26,7 +26,8 @@ class _EstimatorParams:
                  batch_size: int = 32, epochs: int = 1,
                  num_proc: Optional[int] = None,
                  verbose: int = 1, run_id: Optional[str] = None,
-                 loss=None, optimizer=None, validation=None):
+                 loss=None, optimizer=None, validation=None,
+                 validation_steps_per_epoch=None):
         if model is None:
             raise ValueError("model is required")
         if not feature_cols or not label_cols:
@@ -37,6 +38,11 @@ class _EstimatorParams:
                 raise ValueError(
                     f"validation fraction must be in (0, 1), got "
                     f"{validation}")
+        if validation_steps_per_epoch is not None and \
+                int(validation_steps_per_epoch) < 1:
+            raise ValueError(
+                f"validation_steps_per_epoch must be >= 1, got "
+                f"{validation_steps_per_epoch}")
         self.model = model
         self.store = store
         self.feature_cols = list(feature_cols)
@@ -52,6 +58,9 @@ class _EstimatorParams:
         # row fraction held out for validation; a string names a column
         # whose truthy rows are the validation set.
         self.validation = validation
+        # Cap on validation batches evaluated per epoch (reference
+        # keras/estimator.py:142); None = the full validation shard.
+        self.validation_steps_per_epoch = validation_steps_per_epoch
         # Per-epoch metrics from the last fit(), rank-averaged
         # ({"loss": [...], "val_loss": [...]}).
         self.history_ = None
@@ -348,6 +357,7 @@ class KerasEstimator(_EstimatorParams):
         lr_opt = self.optimizer
         batch_size, epochs = self.batch_size, self.epochs
         has_val = self.validation is not None
+        val_steps_cap = self.validation_steps_per_epoch
 
         def _train():
             import numpy as np
@@ -396,14 +406,25 @@ class KerasEstimator(_EstimatorParams):
                             f"VALIDATION shard; provide more rows or a "
                             f"larger validation fraction")
 
-                    def _vgen():
-                        while True:
-                            yield from vreader.iter_batches(batch_size)
+                    vsteps = vreader.steps_per_epoch(batch_size)
+                    if val_steps_cap is not None:
+                        vsteps = min(vsteps, int(val_steps_cap))
 
-                    fit_kw.update(
-                        validation_data=_vgen(),
-                        validation_steps=vreader.steps_per_epoch(
-                            batch_size))
+                    def _vgen():
+                        # Restart the shard every vsteps batches so each
+                        # epoch evaluates the SAME leading subset (the
+                        # Torch path's semantics — capped epochs must
+                        # not drift through the shard).
+                        while True:
+                            count = 0
+                            for b in vreader.iter_batches(batch_size):
+                                if count >= vsteps:
+                                    break
+                                count += 1
+                                yield b
+
+                    fit_kw.update(validation_data=_vgen(),
+                                  validation_steps=vsteps)
 
                 def _gen():
                     while True:
@@ -459,6 +480,7 @@ class TorchEstimator(_EstimatorParams):
         opt_factory = self.optimizer or (
             lambda params: torch.optim.Adam(params))
         has_val = self.validation is not None
+        val_steps_cap = self.validation_steps_per_epoch
 
         def _train():
             import io as _io
@@ -501,6 +523,11 @@ class TorchEstimator(_EstimatorParams):
                 return total / max(n, 1)
 
             def _val_loss(batches):
+                if val_steps_cap is not None:
+                    import itertools
+
+                    batches = itertools.islice(batches,
+                                               int(val_steps_cap))
                 model.eval()  # freeze dropout/BN: no val-data leakage
                 try:
                     with T.no_grad():

@@ -11,9 +11,9 @@
 #   3. GPT-350M profile for the MFU gap attribution table
 #   4. the elastic-on-TPU smoke (PJRT teardown/re-acquisition)
 set -u
-OUT=${1:-/root/repo/BENCH_r05_sweep}
+cd "$(dirname "$0")/.." || exit 1
+OUT=${1:-$PWD/BENCH_r05_sweep}
 mkdir -p "$OUT"
-cd /root/repo
 run() {
   name=$1; shift
   echo "=== $name: $* ==="

@@ -372,6 +372,40 @@ class TestValidationSplit:
         assert "val_loss" in est.history_
         assert len(est.history_["val_loss"]) == 2
 
+    def test_validation_steps_per_epoch_caps_batches(self, tmp_path,
+                                                     monkeypatch):
+        """validation_steps_per_epoch (reference keras/estimator.py:142)
+        bounds the per-epoch validation work."""
+        import numpy as np
+        import torch
+
+        import horovod_tpu.spark as hvd_spark
+        from horovod_tpu.spark import estimator as est_mod
+
+        monkeypatch.setattr(hvd_spark, "run",
+                            lambda fn, num_proc=None, **kw: [fn()])
+        seen = []
+        orig = est_mod.ShardReader.iter_batches
+
+        def counting(self, batch_size):
+            for b in orig(self, batch_size):
+                if self._prefix == "val_":
+                    seen.append(len(b[0]))
+                yield b
+
+        monkeypatch.setattr(est_mod.ShardReader, "iter_batches", counting)
+        rng = np.random.RandomState(3)
+        rows = [{"x1": float(v), "y": float(v)} for v in rng.randn(64)]
+        store = LocalStore(str(tmp_path / "store"))
+        est = est_mod.TorchEstimator(
+            model=torch.nn.Linear(1, 1), store=store,
+            feature_cols=["x1"], label_cols=["y"], batch_size=4,
+            epochs=2, num_proc=1, validation=0.5,
+            validation_steps_per_epoch=3)
+        est.fit(_FakeDF(rows))
+        # islice stops the generator after 3 val batches per epoch.
+        assert len(seen) == 2 * 3, seen
+
     def test_empty_validation_shard_fails_loudly(self, tmp_path,
                                                  monkeypatch):
         import numpy as np
