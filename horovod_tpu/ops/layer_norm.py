@@ -130,6 +130,9 @@ def _fwd_impl(x, res, gamma, beta, eps, block_rows):
     orig_shape = x.shape
     x2, r2 = _flatten(x), _flatten(res)
     N = x2.shape[0]
+    if N == 0:  # empty stream (e.g. a zero-row microbatch slice)
+        h = x + res
+        return jnp.zeros_like(h), h
     br, Np = _pad_rows(N, block_rows)
     x2, r2 = _padded(x2, Np - N), _padded(r2, Np - N)
     g2, b2 = gamma.reshape(1, C), beta.reshape(1, C)
@@ -160,6 +163,9 @@ def _vjp_bwd(eps, block_rows, residuals, cts):
     orig_shape = h.shape
     h2, dy2, dh2 = _flatten(h), _flatten(dy), _flatten(dh)
     N = h2.shape[0]
+    if N == 0:
+        z = jnp.zeros_like(gamma)
+        return jnp.zeros_like(h), jnp.zeros_like(h), z, z
     br, Np = _pad_rows(N, block_rows)
     h2 = _padded(h2, Np - N)
     dy2 = _padded(dy2, Np - N)  # zero rows: no dgamma/dbeta pollution
