@@ -1,8 +1,24 @@
 """Make `import horovod_tpu` work from a source checkout: the launcher
 spawns `python examples/<name>.py`, whose sys.path[0] is examples/, not
-the repo root. Imported for its side effect."""
+the repo root. Imported for its side effect; also hosts the shared
+``--cpu`` virtual-mesh helpers the examples use."""
 
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def add_cpu_flag(ap):
+    ap.add_argument("--cpu", type=int, default=0, metavar="N",
+                    help="force an N-virtual-device CPU mesh (no TPU "
+                         "needed; works even when a TPU backend exists)")
+    return ap
+
+
+def apply_cpu_flag(args):
+    if getattr(args, "cpu", 0):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu)

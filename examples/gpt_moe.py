@@ -4,7 +4,8 @@ but its alltoall collective is exactly the EP dispatch primitive,
 operations.cc:1031-1092).
 
 Trains a small MoE GPT whose experts shard over the mesh's local axis
-(DP rides the cross axis), with a choice of dispatch protocol:
+while the batch shards over BOTH axes (every rank sees distinct
+tokens), with a choice of dispatch protocol:
 
 * ``--dispatch fixed``: classic Switch routing into a static
   ``[E, capacity, C]`` buffer — tokens drop when one (sender, expert)
@@ -14,13 +15,20 @@ Trains a small MoE GPT whose experts shard over the mesh's local axis
   ALL senders, so only rank-level skew or global expert overflow drops
   tokens (the reference's MPI_Alltoallv analogue, compiled).
 
-The router's load-balancing aux loss is mixed into the objective.
+Gradient correctness without per-class rescaling: the objective is the
+GLOBAL token mean, formed inside shard_map via ``psum``, so autodiff
+delivers exactly d(global)/dθ for every parameter class — expert shards
+collect contributions through the all_to_all transpose (+ the implicit
+cross-axis psum), the replicated backbone through the standard pvary
+transpose. The router's load-balancing aux loss is mixed in.
+
 Runs anywhere a mesh exists; to try 4-way EP x 2-way DP without TPUs:
 
-    python examples/gpt_moe.py --steps 10 --cpu 8
+    python examples/gpt_moe.py --steps 10 --cpu 8 --dp 2
 """
 
 import _path_setup  # noqa: F401  (repo-root import shim)
+from _path_setup import add_cpu_flag, apply_cpu_flag
 
 import argparse
 import dataclasses
@@ -46,19 +54,28 @@ def main():
     ap.add_argument("--aux-weight", type=float, default=0.01)
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch-size", type=int, default=4,
-                    help="per-DP-rank batch")
+                    help="per-RANK batch")
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--lr", type=float, default=1e-2)
-    ap.add_argument("--cpu", type=int, default=0, metavar="N",
-                    help="force an N-virtual-device CPU mesh")
+    ap.add_argument("--dp", type=int, default=None,
+                    help="DP (cross-axis) size; default 1 in a single "
+                         "process — set e.g. --dp 2 with --cpu 8 for "
+                         "2-way DP x 4-way EP")
+    add_cpu_flag(ap)
     args = ap.parse_args()
 
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu)
-    hvd.init()
+    apply_cpu_flag(args)
+    mesh_shape = None
+    if args.dp:
+        nd = jax.device_count()
+        if nd % args.dp:
+            raise SystemExit(f"--dp {args.dp} must divide the device "
+                             f"count {nd}")
+        mesh_shape = (args.dp, nd // args.dp)
+    hvd.init(mesh_shape=mesh_shape)
     mesh = hvd.mesh()
     n_dp, ep_n = int(mesh.devices.shape[0]), int(mesh.devices.shape[1])
+    n_world = n_dp * ep_n
     if args.experts % ep_n:
         raise SystemExit(f"--experts {args.experts} must divide by the "
                          f"EP axis size {ep_n}")
@@ -72,8 +89,9 @@ def main():
 
     rs = np.random.RandomState(0)
     toks = rs.randint(0, cfg.vocab_size,
-                      (args.batch_size * n_dp, args.seq_len + 1))
+                      (args.batch_size * n_world, args.seq_len + 1))
     x, y = jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    total_tokens = x.size
     # Init a dense (all-experts-local) model, then shard the expert
     # weights over the EP axis; the router and backbone replicate.
     variables = GPT(cfg_dense).init(jax.random.PRNGKey(0), x[:1])
@@ -86,29 +104,26 @@ def main():
                 jax.tree.map(lambda a: a[0], stk1), rp1)
             out, mods = GPT(cfg).apply({"params": local}, xb,
                                        mutable=["intermediates"])
-            ll = optax.softmax_cross_entropy_with_integer_labels(
-                out, yb).mean()
+            tok_ce = optax.softmax_cross_entropy_with_integer_labels(
+                out, yb)
+            # GLOBAL token mean: grads need no per-class rescaling.
+            ce = jax.lax.psum(jnp.sum(tok_ce), hvd.HVD_AXES) / total_tokens
             aux = sum(jnp.sum(a) for a in
                       jax.tree.leaves(mods["intermediates"]))
-            return (jax.lax.pmean(ll, hvd.CROSS_AXIS)
-                    + aux_w * aux / cfg.num_layers)
+            aux = jax.lax.pmean(aux, hvd.HVD_AXES) / cfg.num_layers
+            return ce + aux_w * aux
 
         loss, (g_stk, g_rp) = jax.value_and_grad(
             loss_fn, argnums=(0, 1))(stk, rp)
-        # Expert shards: DP-average over cross; replicated backbone:
-        # average over the whole world.
-        g_stk = jax.tree.map(
-            lambda t: jax.lax.pmean(t, hvd.CROSS_AXIS), g_stk)
-        g_rp = jax.tree.map(
-            lambda t: jax.lax.pmean(t, hvd.HVD_AXES), g_rp)
         stk = jax.tree.map(lambda a, g: a - args.lr * g, stk, g_stk)
         rp = jax.tree.map(lambda a, g: a - args.lr * g, rp, g_rp)
-        return stk, rp, jax.lax.pmean(loss, hvd.HVD_AXES)
+        return stk, rp, loss
 
     stepc = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS),
-                  P(hvd.CROSS_AXIS)),
+        in_specs=(P(hvd.LOCAL_AXIS), P(),
+                  P((hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),
+                  P((hvd.CROSS_AXIS, hvd.LOCAL_AXIS))),
         out_specs=(P(hvd.LOCAL_AXIS), P(), P())))
 
     print(f"MoE GPT: {args.experts} experts over {ep_n}-way EP x "

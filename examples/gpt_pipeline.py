@@ -17,6 +17,7 @@ Runs anywhere a mesh exists; to try the 8-stage pipeline without TPUs:
 """
 
 import _path_setup  # noqa: F401  (repo-root import shim)
+from _path_setup import add_cpu_flag, apply_cpu_flag
 
 import argparse
 
@@ -38,14 +39,10 @@ def main():
     ap.add_argument("--seq-len", type=int, default=32)
     ap.add_argument("--microbatches", type=int, default=4)
     ap.add_argument("--lr", type=float, default=0.3)
-    ap.add_argument("--cpu", type=int, default=0, metavar="N",
-                    help="force an N-virtual-device CPU mesh (no TPU "
-                         "needed; works even when a TPU backend exists)")
+    add_cpu_flag(ap)
     args = ap.parse_args()
 
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", args.cpu)
+    apply_cpu_flag(args)
     hvd.init()
     n = hvd.size()
     mesh = hvd.mesh()

@@ -9,6 +9,9 @@ Multi-process (one process per TPU host):
 """
 
 import _path_setup  # noqa: F401  (repo-root import shim)
+from _path_setup import add_cpu_flag, apply_cpu_flag
+
+import argparse
 
 import jax
 import jax.numpy as jnp
@@ -21,6 +24,9 @@ from horovod_tpu.models import MnistNet
 
 
 def main():
+    ap = add_cpu_flag(argparse.ArgumentParser())
+    args = ap.parse_args()
+    apply_cpu_flag(args)
     hvd.init()
     mesh = hvd.mesh()
     print(f"rank {hvd.rank()}/{hvd.size()} devices={mesh.devices.shape}")

@@ -54,6 +54,16 @@ from .worker import notification_manager  # noqa: F401
 def _driver_client():
     from ..runner import network
 
+    needed = ("HOROVOD_ELASTIC_DRIVER_ADDR", "HOROVOD_ELASTIC_DRIVER_PORT",
+              "HOROVOD_ELASTIC_DRIVER_KEY")
+    if not all(k in os.environ for k in needed):
+        missing = [k for k in needed if k not in os.environ]
+        raise RuntimeError(
+            f"not running under the elastic driver ({missing} unset): "
+            "launch this script with `hvdrun -np N --min-np N "
+            "[--max-np M] --host-discovery-script ... <cmd>` (the "
+            "driver injects the HOROVOD_ELASTIC_DRIVER_* coordinates "
+            "into workers)")
     addr = os.environ["HOROVOD_ELASTIC_DRIVER_ADDR"]
     port = int(os.environ["HOROVOD_ELASTIC_DRIVER_PORT"])
     key = bytes.fromhex(os.environ["HOROVOD_ELASTIC_DRIVER_KEY"])
