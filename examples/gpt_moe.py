@@ -68,9 +68,9 @@ def main():
     mesh_shape = None
     if args.dp:
         nd = jax.device_count()
-        if nd % args.dp:
-            raise SystemExit(f"--dp {args.dp} must divide the device "
-                             f"count {nd}")
+        if args.dp <= 0 or nd % args.dp:
+            raise SystemExit(f"--dp {args.dp} must be positive and "
+                             f"divide the device count {nd}")
         mesh_shape = (args.dp, nd // args.dp)
     hvd.init(mesh_shape=mesh_shape)
     mesh = hvd.mesh()

@@ -56,8 +56,8 @@ def _driver_client():
 
     needed = ("HOROVOD_ELASTIC_DRIVER_ADDR", "HOROVOD_ELASTIC_DRIVER_PORT",
               "HOROVOD_ELASTIC_DRIVER_KEY")
-    if not all(k in os.environ for k in needed):
-        missing = [k for k in needed if k not in os.environ]
+    missing = [k for k in needed if k not in os.environ]
+    if missing:
         raise RuntimeError(
             f"not running under the elastic driver ({missing} unset): "
             "launch this script with `hvdrun -np N --min-np N "

@@ -1,0 +1,34 @@
+"""Worker for the stall-inspector integration test (reference:
+test/integration/test_stall.py — run a job where one rank lags past the
+warning threshold and assert the coordinator's stall warning names the
+ready and missing ranks)."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from horovod_tpu import cc  # noqa: E402
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    ctx = cc.CoreContext()
+    if rank != 0:
+        # Lag past HOROVOD_STALL_CHECK_TIME_SECONDS before submitting:
+        # the coordinator's inspector must warn about the stalled tensor.
+        time.sleep(float(os.environ.get("STALL_WORKER_LAG", "3")))
+    out = ctx.allreduce_async(np.ones(4, np.float32), "stalled.t").wait()
+    assert np.allclose(out, ctx.size())
+    # A second, prompt collective proves the world recovered.
+    out = ctx.allreduce_async(np.ones(2, np.float32), "after.t").wait()
+    assert np.allclose(out, ctx.size())
+    ctx.barrier()
+    ctx.close()
+    print(f"stall worker rank {rank}: OK")
+
+
+if __name__ == "__main__":
+    main()

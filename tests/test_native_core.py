@@ -153,6 +153,7 @@ def _run_world(n, extra_env=None, timeout=120, worker=WORKER,
         outs.append(out)
         ok = ok and p.returncode == 0
     assert ok, "worker failures:\n" + "\n----\n".join(outs)
+    return outs
 
 
 class TestMultiProcess:

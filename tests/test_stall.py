@@ -1,0 +1,38 @@
+"""Stall-inspector integration test (reference:
+test/integration/test_stall.py): a 2-process world where rank 1 lags
+past the warning threshold — the coordinator must emit the stall
+warning naming the stalled tensor and the ready/missing ranks, and the
+job must still complete once the laggard arrives."""
+
+import os
+
+from test_native_core import REPO, _run_world
+
+WORKER = os.path.join(REPO, "tests", "stall_worker.py")
+
+
+def test_stall_warning_names_ready_and_missing_ranks():
+    outs = _run_world(2, {
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "1",
+        "HOROVOD_LOG_LEVEL": "warning",
+        "STALL_WORKER_LAG": "3",
+    }, worker=WORKER)
+    combined = "\n".join(outs)
+    # The coordinator (rank 0) warned about the stalled tensor with the
+    # rank bookkeeping, and both ranks finished the job afterwards.
+    assert "waiting for remainder of ranks" in combined, combined
+    assert "stalled.t" in combined
+    assert "ready ranks: 0" in combined
+    assert "missing ranks: 1" in combined
+    for r in range(2):
+        assert f"stall worker rank {r}: OK" in combined
+
+
+def test_no_warning_under_threshold():
+    outs = _run_world(2, {
+        "HOROVOD_STALL_CHECK_TIME_SECONDS": "30",
+        "HOROVOD_LOG_LEVEL": "warning",
+        "STALL_WORKER_LAG": "1",
+    }, worker=WORKER)
+    combined = "\n".join(outs)
+    assert "waiting for remainder of ranks" not in combined, combined
