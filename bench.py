@@ -686,14 +686,15 @@ def main():
             "vs 819 GB/s HBM peak on v5e incl. VMEM prefetch hits); "
             "see README.md 'Benchmark methodology'. Matmul-bound "
             "flagship via --model gpt (same step/collectives, Pallas "
-            "flash attention): GPT-124M 117.2-117.3k tok/s/chip MFU "
-            "0.43 (re-verified r4 under the lm-loss auto default), "
-            "GPT-350M 42.9k tok/s/chip MFU 0.472. Fused-CE envelope: "
-            "batch 32 x 128k vocab runs 75.9k tok/s MFU 0.45 where "
-            "the dense head cannot compile (17 GB logits vs 16 GB "
-            "HBM); dense wins 4-11% at every vocab that fits "
-            "(README vocab sweep). Weak-scaling harness: --scaling "
-            "1,..,64 (dryrun leg 9)")}
+            "flash attention), re-measured r5 on hardware "
+            "(BENCH_r05_sweep/): GPT-124M 115.8k tok/s/chip MFU 0.42, "
+            "GPT-350M 42.3k tok/s/chip MFU 0.466 (both within ~1.5% "
+            "of r3: 117.2k / 42.9k). Fused-CE envelope: batch 32 x "
+            "128k vocab runs 75.9k tok/s MFU 0.45 where the dense "
+            "head cannot compile (17 GB logits vs 16 GB HBM); dense "
+            "wins 4-11% at every vocab that fits (README vocab "
+            "sweep). Weak-scaling harness: --scaling 1,..,64 (dryrun "
+            "leg 9)")}
            if args.model == "resnet50"
            and "v5 lite" in getattr(devices[0], "device_kind", "").lower()
            else {}),
@@ -701,14 +702,14 @@ def main():
             "CPU FALLBACK — the accelerator backend was unavailable "
             "(the probe diagnostics logged above give the specific "
             "cause), so this number reflects nothing about TPU "
-            "performance. Last real TPU measurements (r3; GPT figures "
-            "re-verified r4 under the lm-loss auto default): ResNet-50 "
-            "2271 img/s MFU 0.276, GPT-124M 117.2k tok/s MFU 0.43, "
-            "GPT-350M 42.9k tok/s MFU 0.472. The r5 perf levers "
-            "(--fused-ln, --remat, autotune cache) are built and gated "
-            "behind bench flags; scripts/tpu_round5_measurements.sh "
-            "captures the full sweep in one command when the chip is "
-            "reachable.")}
+            "performance. Real TPU measurements captured r5 "
+            "(BENCH_r05_sweep/ in-repo, driver-checkable logs): "
+            "ResNet-50 2164 img/s MFU 0.263 (noisy relay day; r3 "
+            "2271/0.276), GPT-124M 115.8k tok/s MFU 0.42, GPT-350M "
+            "42.3k tok/s MFU 0.466, GPT-350M remat b16 33.7k (remat "
+            "recompute tax - not a single-chip win). "
+            "scripts/tpu_round5_measurements.sh re-captures the full "
+            "sweep in one command when the chip is reachable.")}
            if platform == "cpu" and args.platform != "cpu" else {}),
     }), flush=True)
 

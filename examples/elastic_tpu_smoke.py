@@ -33,6 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+import _path_setup  # noqa: F401  (repo root onto sys.path)
 import horovod_tpu as hvd
 from horovod_tpu.common.backend import (
     acquire_devices, clear_stale_tpu_locks, diagnose_backend,

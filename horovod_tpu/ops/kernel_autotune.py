@@ -146,15 +146,26 @@ def get_or_tune(kind: str, sig: str,
         return cached
 
     results: List[Tuple[float, Tuple[int, ...]]] = []
+    errors: List[str] = []
     t_sweep = time.perf_counter()
     for cand in candidates:
         try:
             dt = bench(cand)
             results.append((dt, cand))
         except Exception as e:  # compile/VMEM failure: candidate illegal
+            errors.append(f"{cand}: {type(e).__name__}: {str(e)[:200]}")
             logging.info("autotune %s %s: candidate %s failed (%s)",
                          kind, sig, cand, str(e)[:200])
     if not results:
+        # Every candidate failing is not a per-candidate legality quirk —
+        # it is the sweep silently not working (e.g. the relay timing
+        # linearity check rejecting everything). Say so once, loudly,
+        # with the evidence (r5: a whole hardware session produced no
+        # sweep lines because this path logged only at INFO).
+        logging.warning(
+            "horovod_tpu autotune: %s %s — ALL %d candidates failed; "
+            "using default blocks %s. Errors:\n  %s", kind, sig,
+            len(candidates), default, "\n  ".join(errors))
         return default
     results.sort()
     best_dt, best = results[0]

@@ -78,8 +78,19 @@ def _fwd_kernel(x_ref, r_ref, g_ref, b_ref, y_ref, h_ref, *, eps, inv_c):
 
 
 def _bwd_kernel(h_ref, g_ref, dy_ref, dh_ref, dx_ref, dg_ref, db_ref,
-                *, eps, inv_c):
+                dg_scr, db_scr, *, eps, inv_c, nb):
+    # dgamma/dbeta partials accumulate in VMEM scratch across the
+    # (sequential) row-block grid and are written once at the last step:
+    # a per-block (1, C) output block would violate Mosaic's (8, 128)
+    # block-shape minimum (the r5 TPU bring-up failure — interpreter mode
+    # never checks it), while the (8, C) full-array output below is
+    # always legal.
     i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        dg_scr[...] = jnp.zeros_like(dg_scr)
+        db_scr[...] = jnp.zeros_like(db_scr)
 
     @pl.when(i >= 0)  # traced truth: see _fwd_kernel
     def _():
@@ -96,8 +107,17 @@ def _bwd_kernel(h_ref, g_ref, dy_ref, dh_ref, dx_ref, dg_ref, db_ref,
         dln = rstd * (dyg - c1 - xhat * c2)
         dx_ref[...] = (dln + dh_ref[...].astype(jnp.float32)).astype(
             dx_ref.dtype)
-        dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-        db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+        # Full-tile broadcast accumulate (all 8 sublanes carry the same
+        # value) — avoids single-sublane scatter writes; row 0 is read out.
+        dg_scr[...] += jnp.broadcast_to(
+            jnp.sum(dy * xhat, axis=0, keepdims=True), dg_scr.shape)
+        db_scr[...] += jnp.broadcast_to(
+            jnp.sum(dy, axis=0, keepdims=True), db_scr.shape)
+
+    @pl.when(i == nb - 1)
+    def _finish():
+        dg_ref[...] = dg_scr[...]
+        db_ref[...] = db_scr[...]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
@@ -175,20 +195,26 @@ def _vjp_bwd(eps, block_rows, residuals, cts):
     h2, g2, dy2, dh2 = _harmonize_vma(h2, g2, dy2, dh2)
     row_spec = pl.BlockSpec((br, C), lambda i: (i, 0))
     par_spec = pl.BlockSpec((1, C), lambda i: (0, 0))
-    blk_spec = pl.BlockSpec((1, C), lambda i: (i, 0))
+    acc_spec = pl.BlockSpec((8, C), lambda i: (0, 0))
     dx, dgp, dbp = pl.pallas_call(
-        functools.partial(_bwd_kernel, eps=eps, inv_c=1.0 / C),
+        functools.partial(_bwd_kernel, eps=eps, inv_c=1.0 / C, nb=nb),
         grid=(nb,),
         in_specs=[row_spec, par_spec, row_spec, row_spec],
-        out_specs=[row_spec, blk_spec, blk_spec],
+        out_specs=[row_spec, acc_spec, acc_spec],
         out_shape=[_out_struct((Np, C), h.dtype, h2, dy2, dh2),
-                   _out_struct((nb, C), jnp.float32, h2, dy2, dh2),
-                   _out_struct((nb, C), jnp.float32, h2, dy2, dh2)],
+                   _out_struct((8, C), jnp.float32, h2, dy2, dh2),
+                   _out_struct((8, C), jnp.float32, h2, dy2, dh2)],
+        scratch_shapes=[pltpu.VMEM((8, C), jnp.float32),
+                        pltpu.VMEM((8, C), jnp.float32)],
+        # The scratch accumulators carry across row blocks: the grid dim
+        # must stay sequential.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
         interpret=_interpret(),
     )(h2, g2, dy2, dh2)
     dx = dx[:N].reshape(orig_shape)
-    dgamma = jnp.sum(dgp, axis=0).astype(gamma.dtype)
-    dbeta = jnp.sum(dbp, axis=0).astype(gamma.dtype)
+    dgamma = dgp[0].astype(gamma.dtype)
+    dbeta = dbp[0].astype(gamma.dtype)
     # h = x + res: both inputs receive the same cotangent.
     return dx, dx, dgamma, dbeta
 

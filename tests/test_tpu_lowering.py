@@ -1,0 +1,134 @@
+"""Cross-platform TPU lowering checks for every Pallas kernel.
+
+Interpreter-mode tests (the rest of the suite) verify kernel *numerics*
+but never run Mosaic's lowering-time legality checks — block shapes whose
+last two dims are neither (8, 128)-divisible nor equal to the array dims
+lower fine in interpreter mode and then fail on real hardware at compile
+time. That is exactly how the fused-LN backward's per-block ``(1, C)``
+dgamma/dbeta outputs survived a full CPU suite and died in the round-5
+hardware session (BENCH_r05_sweep/gpt350m_fusedln.log).
+
+These tests force the non-interpreter kernels and AOT-lower for the
+``tpu`` platform on the CPU host (no device needed): the Mosaic lowering
+rule — including ``_check_block_mappings`` — runs during StableHLO
+lowering, so an illegal BlockSpec fails HERE, one round before hardware.
+Execution is NOT attempted (that needs a chip); legality is the contract.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _lower_tpu(fn, *args):
+    return jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+@pytest.fixture()
+def real_kernels(monkeypatch):
+    """Force interpret=False so Mosaic lowering (and its block-mapping
+    legality checks) actually runs."""
+    import horovod_tpu.ops.flash_attention as F
+    import horovod_tpu.ops.layer_norm as L
+    import horovod_tpu.ops.softmax_xent as X
+
+    monkeypatch.setattr(F, "_interpret", lambda: False)
+    monkeypatch.setattr(L, "_interpret", lambda: False)
+    monkeypatch.setattr(X, "_interpret", lambda: False)
+    yield
+
+
+@pytest.mark.parametrize("B,T,C", [
+    (8, 1024, 1024),   # the round-5 hardware failure shape (350M blocks)
+    (16, 1024, 768),   # 124M bench shape
+    (1, 7, 256),       # N < 8 rows: single whole-array block
+    (2, 300, 512),     # N not a block multiple: padded rows
+])
+def test_ln_residual_lowers_for_tpu(real_kernels, B, T, C):
+    from horovod_tpu.ops.layer_norm import ln_residual
+
+    x = jnp.zeros((B, T, C), jnp.bfloat16)
+    g = jnp.ones((C,), jnp.float32)
+    b = jnp.zeros((C,), jnp.float32)
+
+    def f(x, r, g, b):
+        def loss(x):
+            y, h = ln_residual(x, r, g, b, 1e-6)
+            return y.astype(jnp.float32).sum() + h.astype(jnp.float32).sum()
+
+        return jax.grad(loss)(x)
+
+    _lower_tpu(f, x, x, g, b)
+
+
+@pytest.mark.parametrize("B,T,H,D,blocks", [
+    (16, 1024, 12, 64, None),      # 124M bench shape, default blocks
+    (8, 1024, 16, 64, None),       # 350M bench shape
+    (2, 1024, 4, 64, (512, 512)),  # explicit non-default blocking
+    (1, 384, 4, 128, None),        # whole-sequence single block
+])
+def test_flash_attention_lowers_for_tpu(real_kernels, B, T, H, D, blocks):
+    from horovod_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.zeros((B, T, H, D), jnp.bfloat16)
+    kw = {}
+    if blocks is not None:
+        kw = {"block_q": blocks[0], "block_k": blocks[1]}
+
+    def f(q, k, v):
+        def loss(q):
+            return flash_attention(q, k, v, causal=True,
+                                   **kw).astype(jnp.float32).sum()
+
+        return jax.grad(loss)(q)
+
+    _lower_tpu(f, q, q, q)
+
+
+@pytest.mark.parametrize("N,V,C", [
+    (1024, 32000, 768),    # bench LM head
+    (512, 1000, 256),      # small head
+])
+def test_linear_cross_entropy_lowers_for_tpu(real_kernels, N, V, C):
+    from horovod_tpu.ops.softmax_xent import linear_cross_entropy
+
+    x = jnp.zeros((N, C), jnp.bfloat16)
+    w = jnp.zeros((V, C), jnp.bfloat16)
+    y = jnp.zeros((N,), jnp.int32)
+
+    def f(x, w, y):
+        def loss(x):
+            return linear_cross_entropy(x, w, y).mean()
+
+        return jax.grad(loss)(x)
+
+    _lower_tpu(f, x, w, y)
+
+
+def test_fused_ln_gpt_block_lowers_for_tpu(real_kernels):
+    """The composition that actually failed on hardware: a fused-LN GPT
+    block's full fwd+bwd (flash attention + ln_residual together)."""
+    from horovod_tpu.models import GPT, gpt_tiny
+
+    cfg = gpt_tiny(attention="flash", fused_ln=True, max_seq_len=512)
+    model = GPT(cfg)
+    tokens = jnp.zeros((2, 512), jnp.int32)
+    # Abstract init: eager execution would run the forced non-interpret
+    # kernels on the CPU backend; shapes are all lowering needs.
+    params = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), tokens))["params"]
+
+    def f(p, tokens):
+        def loss(p):
+            return model.apply({"params": p},
+                               tokens).astype(jnp.float32).mean()
+
+        return jax.grad(loss)(p)
+
+    _lower_tpu(f, params, tokens)
