@@ -148,14 +148,31 @@ def get_or_tune(kind: str, sig: str,
     results: List[Tuple[float, Tuple[int, ...]]] = []
     errors: List[str] = []
     t_sweep = time.perf_counter()
-    for cand in candidates:
-        try:
-            dt = bench(cand)
-            results.append((dt, cand))
-        except Exception as e:  # compile/VMEM failure: candidate illegal
-            errors.append(f"{cand}: {type(e).__name__}: {str(e)[:200]}")
-            logging.info("autotune %s %s: candidate %s failed (%s)",
-                         kind, sig, cand, str(e)[:200])
+
+    def _sweep() -> None:
+        for cand in candidates:
+            try:
+                dt = bench(cand)
+                results.append((dt, cand))
+            except Exception as e:  # compile/VMEM failure: candidate illegal
+                errors.append(f"{cand}: {type(e).__name__}: {str(e)[:200]}")
+                logging.info("autotune %s %s: candidate %s failed (%s)",
+                             kind, sig, cand, str(e)[:200])
+
+    # The sweep fires at TRACE time (kernels resolve their blocks while
+    # the caller's train step is being traced), and under an ambient jit
+    # trace the bench's inner jit calls would be STAGED into that trace
+    # instead of executed — the host fetch then hits a tracer and every
+    # candidate dies with TracerArrayConversionError (the r5 hardware
+    # sessions' silent all-candidates failure). JAX's trace state is
+    # thread-local, so a worker thread has a clean trace context while
+    # sharing the initialized device client: real compile + execute +
+    # timing, regardless of the caller's trace depth.
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(
+            1, thread_name_prefix="hvd-autotune") as ex:
+        ex.submit(_sweep).result()
     if not results:
         # Every candidate failing is not a per-candidate legality quirk —
         # it is the sweep silently not working (e.g. the relay timing

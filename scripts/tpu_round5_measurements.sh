@@ -10,30 +10,68 @@
 #      cache hit in the log tail)
 #   3. GPT-350M profile for the MFU gap attribution table
 #   4. the elastic-on-TPU smoke (PJRT teardown/re-acquisition)
+#
+# Session learnings baked in (first r5 chip session, BENCH_r05_sweep/):
+#   - GPT train-step compiles take 150-200 s through the relay, so the
+#     old 560 s cap was too tight for the autotune legs (compile + sweep)
+#     and a `timeout`-kill mid-remote-compile can take the RELAY down
+#     with it (PALLAS_AXON_REMOTE_COMPILE posts compiles to the relay) -
+#     every later leg then burns its full budget on probe timeouts.
+#     Budgets are per-leg now, generous for compile-heavy legs.
+#   - Probe the relay before each leg and skip (not fall back) when it is
+#     down: a CPU-fallback "measurement" is worthless and costs minutes.
 set -u
 cd "$(dirname "$0")/.." || exit 1
 OUT=${1:-$PWD/BENCH_r05_sweep}
 mkdir -p "$OUT"
+
+relay_up() {
+  # No relay configured (real TPU VM): treat as up.
+  [ -z "${PALLAS_AXON_POOL_IPS:-}" ] && return 0
+  python - <<'EOF'
+import os, socket, sys
+port = int(os.environ.get("HOROVOD_AXON_RELAY_PORT", "8083"))
+for ip in os.environ["PALLAS_AXON_POOL_IPS"].split(","):
+    try:
+        with socket.create_connection((ip.strip(), port), timeout=3):
+            sys.exit(0)
+    except OSError:
+        pass
+sys.exit(1)
+EOF
+}
+
 run() {
-  name=$1; shift
+  budget=$1; name=$2; shift 2
+  if ! relay_up; then
+    echo "--- $name SKIPPED (relay down; a CPU fallback would measure nothing)"
+    return
+  fi
   echo "=== $name: $* ==="
-  timeout 560 "$@" >"$OUT/$name.log" 2>&1
+  timeout "$budget" "$@" >"$OUT/$name.log" 2>&1
   rc=$?
   tail -3 "$OUT/$name.log"
   echo "--- $name rc=$rc"
+  if [ "$rc" = 124 ]; then
+    # The kill may have wedged the client/relay; give it a recovery
+    # window before the next leg's probe burns its budget.
+    echo "--- $name timed out; 60 s relay recovery pause"
+    sleep 60
+  fi
 }
 
-run resnet50          python bench.py
-run gpt124m           python bench.py --model gpt --batch-size 16
-run gpt350m           python bench.py --model gpt --gpt-scale 350m --batch-size 8
-run gpt350m_fusedln   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
-run gpt350m_remat16   python bench.py --model gpt --gpt-scale 350m --batch-size 16 --remat
-run gpt124m_fusedln   python bench.py --model gpt --batch-size 16 --fused-ln
-# Fresh-cache autotune: sweep on run 1, cache hit on run 2.
+run 560  resnet50          python bench.py
+run 700  gpt124m           python bench.py --model gpt --batch-size 16
+run 700  gpt350m           python bench.py --model gpt --gpt-scale 350m --batch-size 8
+run 700  gpt350m_fusedln   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+run 700  gpt350m_remat16   python bench.py --model gpt --gpt-scale 350m --batch-size 16 --remat
+run 700  gpt124m_fusedln   python bench.py --model gpt --batch-size 16 --fused-ln
+# Fresh-cache autotune: sweep on run 1 (compile per candidate -> the big
+# budget), cache hit on run 2.
 AT_CACHE=$OUT/autotune_cache.json
-run gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
-run gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
-run gpt350m_profile   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
-run elastic_smoke     python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
+run 2400 gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
+run 700  gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
+run 900  gpt350m_profile   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
+run 700  elastic_smoke     python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
 echo "all artifacts in $OUT"
 grep -h '"metric"' "$OUT"/*.log 2>/dev/null | tail -20

@@ -135,6 +135,39 @@ class TestGetOrTune:
         assert at.verify_multihost_cache() is False
 
 
+class TestTraceTimeSweep:
+    def test_sweep_executes_under_an_active_jit_trace(self, fresh_cache,
+                                                      monkeypatch):
+        """The sweep fires while the caller's train step is being traced
+        (block resolution happens inside flash_attention's forward). An
+        ambient trace must not stage the bench's inner jits — r5 hardware
+        sessions lost every candidate to TracerArrayConversionError this
+        way. The worker-thread escape gives the bench a clean (thread-
+        local) trace context, so real execution + host fetch works."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        monkeypatch.setattr(at, "enabled", lambda: True)
+        swept = {}
+
+        def traced(x):
+            def bench(cand):
+                # Real execution + concrete fetch, as _timed_chain does.
+                y = jax.jit(lambda a: (a * cand[0]).sum())(
+                    jnp.ones((8, 8), jnp.float32))
+                return 1.0 / float(np.asarray(y))
+
+            swept["blocks"] = at.get_or_tune(
+                "k", "trace_sig", [(1,), (2,)], bench, (9,))
+            return x * 1.0
+
+        jax.jit(traced).lower(jnp.zeros((2, 2)))
+        # (2,) is faster by construction (bench returns 1/(64*c)).
+        assert swept["blocks"] == (2,)
+        assert "trace_sig" in fresh_cache.read_text()
+
+
 class TestShapeGates:
     def test_small_shapes_keep_defaults(self, fresh_cache, monkeypatch):
         """The B=1 model.init trace must not trigger a sweep."""
