@@ -168,11 +168,32 @@ def get_or_tune(kind: str, sig: str,
     # thread-local, so a worker thread has a clean trace context while
     # sharing the initialized device client: real compile + execute +
     # timing, regardless of the caller's trace depth.
-    import concurrent.futures
+    # jax context managers (default_device & co) are thread-local: carry
+    # the caller's effective default device into the worker so the bench
+    # times the device the caller pinned, not whatever device 0 is doing.
+    # Anything escaping _sweep's per-candidate try (it only catches
+    # Exception) re-raises in the caller — a bare Thread would hand it to
+    # threading.excepthook and the empty-results path would then lie
+    # ("ALL candidates failed" with no errors).
+    caller_device = jax.config.jax_default_device
+    escaped: List[BaseException] = []
 
-    with concurrent.futures.ThreadPoolExecutor(
-            1, thread_name_prefix="hvd-autotune") as ex:
-        ex.submit(_sweep).result()
+    def _sweep_with_context() -> None:
+        try:
+            if caller_device is None:
+                _sweep()
+            else:
+                with jax.default_device(caller_device):
+                    _sweep()
+        except BaseException as e:
+            escaped.append(e)
+
+    worker = threading.Thread(target=_sweep_with_context,
+                              name="hvd-autotune")
+    worker.start()
+    worker.join()
+    if escaped:
+        raise escaped[0]
     if not results:
         # Every candidate failing is not a per-candidate legality quirk —
         # it is the sweep silently not working (e.g. the relay timing

@@ -1,0 +1,84 @@
+# Shared helpers for the TPU measurement scripts (sourced, not run).
+# Single home for the relay probe + budgeted-leg runner so a fix (port,
+# probe timeout, recovery window) lands once — the copies diverged the
+# first time they existed separately.
+#
+# Contract: caller sets $OUT before using run().
+#   relay_up          — 0 iff the axon relay answers (or none configured)
+#   run BUDGET NAME CMD... — skip if relay down; else run under `timeout
+#                       BUDGET` with output in $OUT/NAME.log; on a 124
+#                       timeout, pause 60 s (a kill mid-remote-compile
+#                       can wedge the relay; give it a recovery window).
+#                       A clean exit stamps $OUT/NAME.done; with
+#                       MEASURE_RESUME=1, stamped legs are skipped so a
+#                       sweep re-run after a mid-sweep relay flap only
+#                       measures what it missed (the watcher sets this).
+
+relay_up() {
+  # No relay configured (real TPU VM): treat as up.
+  [ -z "${PALLAS_AXON_POOL_IPS:-}" ] && return 0
+  python - <<'EOF'
+import os, socket, sys
+port = int(os.environ.get("HOROVOD_AXON_RELAY_PORT", "8083"))
+for ip in os.environ["PALLAS_AXON_POOL_IPS"].split(","):
+    try:
+        with socket.create_connection((ip.strip(), port), timeout=3):
+            sys.exit(0)
+    except OSError:
+        pass
+sys.exit(1)
+EOF
+}
+
+# Legs that could not produce a measurement this invocation (relay down,
+# nonzero exit, timeout, CPU fallback). Callers may `exit $((
+# MEASURE_MISSED > 0 ))` so wrappers know a re-run is needed.
+MEASURE_MISSED=0
+
+run() {
+  budget=$1; name=$2; shift 2
+  if [ "${MEASURE_RESUME:-0}" = 1 ] && [ -e "$OUT/$name.done" ]; then
+    echo "--- $name already measured ($OUT/$name.done); resume skips it"
+    return
+  fi
+  if ! relay_up; then
+    echo "--- $name SKIPPED (relay down; a CPU fallback would measure nothing)"
+    MEASURE_MISSED=$((MEASURE_MISSED + 1))
+    return
+  fi
+  echo "=== $name: $* ==="
+  timeout "$budget" "$@" >"$OUT/$name.log" 2>&1
+  rc=$?
+  tail -3 "$OUT/$name.log"
+  echo "--- $name rc=$rc"
+  # A CPU fallback (relay died between our probe and the leg's own)
+  # exits 0 but measured nothing — don't stamp it done. bench.py prints
+  # the "falling back" banner; every leg's JSON line carries platform.
+  if [ "$rc" = 0 ] && \
+     ! grep -qE 'falling back to CPU|"platform": "cpu"' "$OUT/$name.log"; then
+    : >"$OUT/$name.done"
+  else
+    MEASURE_MISSED=$((MEASURE_MISSED + 1))
+  fi
+  if [ "$rc" = 124 ]; then
+    # The kill may have wedged the client/relay; give it a recovery
+    # window before the next leg's probe burns its budget.
+    echo "--- $name timed out; 60 s relay recovery pause"
+    sleep 60
+  fi
+}
+
+# run_if_done PRIOR BUDGET NAME CMD... — like run(), but only when leg
+# PRIOR is stamped done. For cache-hit legs: re-running a "hit" leg
+# against the empty cache its failed predecessor left would do the full
+# first-use sweep under a budget sized for a hit (timeout -> possible
+# relay wedge).
+run_if_done() {
+  prior=$1; shift
+  if [ ! -e "$OUT/$prior.done" ]; then
+    echo "--- $2 SKIPPED (prerequisite $prior not measured)"
+    MEASURE_MISSED=$((MEASURE_MISSED + 1))
+    return
+  fi
+  run "$@"
+}

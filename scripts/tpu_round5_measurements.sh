@@ -10,6 +10,9 @@
 #      cache hit in the log tail)
 #   3. GPT-350M profile for the MFU gap attribution table
 #   4. the elastic-on-TPU smoke (PJRT teardown/re-acquisition)
+# The targeted re-run after session 1's relay death is
+# scripts/tpu_round5b_measurements.sh (same legs minus the ones that
+# landed, plus the warmed-cache best-config attempt).
 #
 # Session learnings baked in (first r5 chip session, BENCH_r05_sweep/):
 #   - GPT train-step compiles take 150-200 s through the relay, so the
@@ -20,58 +23,31 @@
 #     Budgets are per-leg now, generous for compile-heavy legs.
 #   - Probe the relay before each leg and skip (not fall back) when it is
 #     down: a CPU-fallback "measurement" is worthless and costs minutes.
+#   - Since 27b814b the first-use autotune sweep really runs, so every
+#     leg that is NOT deliberately measuring the autotuner pins
+#     HOROVOD_KERNEL_AUTOTUNE=0: keeps baselines comparable to the
+#     hand-tuned defaults the README cites, and keeps a multi-candidate
+#     compile sweep from blowing a budget sized for one compile.
 set -u
 cd "$(dirname "$0")/.." || exit 1
+. scripts/measure_lib.sh
 OUT=${1:-$PWD/BENCH_r05_sweep}
 mkdir -p "$OUT"
 
-relay_up() {
-  # No relay configured (real TPU VM): treat as up.
-  [ -z "${PALLAS_AXON_POOL_IPS:-}" ] && return 0
-  python - <<'EOF'
-import os, socket, sys
-port = int(os.environ.get("HOROVOD_AXON_RELAY_PORT", "8083"))
-for ip in os.environ["PALLAS_AXON_POOL_IPS"].split(","):
-    try:
-        with socket.create_connection((ip.strip(), port), timeout=3):
-            sys.exit(0)
-    except OSError:
-        pass
-sys.exit(1)
-EOF
-}
-
-run() {
-  budget=$1; name=$2; shift 2
-  if ! relay_up; then
-    echo "--- $name SKIPPED (relay down; a CPU fallback would measure nothing)"
-    return
-  fi
-  echo "=== $name: $* ==="
-  timeout "$budget" "$@" >"$OUT/$name.log" 2>&1
-  rc=$?
-  tail -3 "$OUT/$name.log"
-  echo "--- $name rc=$rc"
-  if [ "$rc" = 124 ]; then
-    # The kill may have wedged the client/relay; give it a recovery
-    # window before the next leg's probe burns its budget.
-    echo "--- $name timed out; 60 s relay recovery pause"
-    sleep 60
-  fi
-}
-
-run 560  resnet50          python bench.py
-run 700  gpt124m           python bench.py --model gpt --batch-size 16
-run 700  gpt350m           python bench.py --model gpt --gpt-scale 350m --batch-size 8
-run 700  gpt350m_fusedln   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
-run 700  gpt350m_remat16   python bench.py --model gpt --gpt-scale 350m --batch-size 16 --remat
-run 700  gpt124m_fusedln   python bench.py --model gpt --batch-size 16 --fused-ln
+run 560  resnet50          env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py
+run 700  gpt124m           env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --batch-size 16
+run 700  gpt350m           env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8
+run 700  gpt350m_fusedln   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+run 700  gpt350m_remat16   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 16 --remat
+run 700  gpt124m_fusedln   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --batch-size 16 --fused-ln
 # Fresh-cache autotune: sweep on run 1 (compile per candidate -> the big
-# budget), cache hit on run 2.
+# budget), cache hit on run 2. rm guarantees "fresh" even on a re-run.
 AT_CACHE=$OUT/autotune_cache.json
-run 2400 gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
-run 700  gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" python bench.py --model gpt --batch-size 16
-run 900  gpt350m_profile   python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
-run 700  elastic_smoke     python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
-echo "all artifacts in $OUT"
+rm -f "$AT_CACHE"
+run 2400 gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --batch-size 16
+run_if_done gpt124m_autotune1 700  gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --batch-size 16
+run 900  gpt350m_profile   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
+run 700  elastic_smoke     env HOROVOD_KERNEL_AUTOTUNE=0 python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
+echo "all artifacts in $OUT ($MEASURE_MISSED legs missed)"
 grep -h '"metric"' "$OUT"/*.log 2>/dev/null | tail -20
+exit $((MEASURE_MISSED > 0))

@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-5 second chip session: re-capture ONLY the legs the first session
+# lost (relay died mid-sweep, BENCH_r05_sweep/*.log) plus the two fixes
+# landed since:
+#   - fused-LN backward Mosaic block legality (ee75828) -> --fused-ln A/Bs
+#   - trace-time autotune sweep runs in a worker thread (27b814b) ->
+#     fresh-cache autotune pair (first-run sweep, second-run cache hit)
+#   - elastic smoke import path (examples/_path_setup.py)
+# Already-good legs from session 1 (resnet50, gpt124m, gpt350m, remat16)
+# are NOT re-run unless you pass --all.
+#
+# Usage: tpu_round5b_measurements.sh [OUTDIR] [--all]
+set -u
+cd "$(dirname "$0")/.." || exit 1
+. scripts/measure_lib.sh
+OUT=$PWD/BENCH_r05_sweep
+ALL=0
+for arg in "$@"; do
+  case "$arg" in
+    --all) ALL=1 ;;
+    --*) echo "unknown flag: $arg" >&2; exit 2 ;;
+    *) OUT=$arg ;;
+  esac
+done
+mkdir -p "$OUT"
+
+# MFU levers first (the >=0.50 goal), then the autotune pair, then the
+# risky teardown legs last so a wedge can't cost the perf numbers.
+# The fused-LN A/B legs pin HOROVOD_KERNEL_AUTOTUNE=0: session 1's
+# baselines effectively ran default blocks (the trace-time sweep was
+# inert until 27b814b), so the A/B stays apples-to-apples — and an
+# implicit first-use sweep (compile per candidate through the relay)
+# would blow a 900 s budget anyway.
+run 900  gpt350m_fusedln   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+run 900  gpt124m_fusedln   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --batch-size 16 --fused-ln
+# Fresh-cache autotune: sweep on run 1 (compile per candidate -> the big
+# budget), cache hit on run 2. rm guarantees "fresh" even on a re-run —
+# except on a MEASURE_RESUME continuation where run 1 already landed:
+# wiping then would force the remaining legs to re-sweep inside budgets
+# sized for a cache hit.
+AT_CACHE=$OUT/autotune_cache.json
+if ! { [ "${MEASURE_RESUME:-0}" = 1 ] && [ -e "$OUT/gpt124m_autotune1.done" ]; }; then
+  rm -f "$AT_CACHE"
+fi
+run 2400 gpt124m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --batch-size 16
+run_if_done gpt124m_autotune1 900  gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --batch-size 16
+# Best-config attempt at the MFU >= 0.50 goal: fused LN + whatever the
+# warmed cache picked (the flash-block choice alone measured +9% at 124M).
+run 2400 gpt350m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+run_if_done gpt350m_autotune1 900  gpt350m_best      env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+# Profile matches the 42.3k baseline config (autotune off) so the MFU
+# attribution table describes the number we actually reported.
+run 1200 gpt350m_profile   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
+run 900  elastic_smoke     env HOROVOD_KERNEL_AUTOTUNE=0 python examples/elastic_tpu_smoke.py --cycles 3 --steps 20 --reset-backend
+if [ "$ALL" = 1 ]; then
+  run 560  resnet50          env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py
+  run 900  gpt124m           env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --batch-size 16
+  run 900  gpt350m           env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8
+fi
+echo "all artifacts in $OUT ($MEASURE_MISSED legs missed)"
+grep -h '"metric"' "$OUT"/*.log 2>/dev/null | tail -20
+exit $((MEASURE_MISSED > 0))

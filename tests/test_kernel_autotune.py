@@ -167,6 +167,26 @@ class TestTraceTimeSweep:
         assert swept["blocks"] == (2,)
         assert "trace_sig" in fresh_cache.read_text()
 
+    def test_worker_inherits_callers_default_device(self, fresh_cache,
+                                                    monkeypatch):
+        """jax.default_device is thread-local; the sweep worker must
+        carry the caller's pin so candidates are timed on the device the
+        user chose, not device 0."""
+        import jax
+
+        monkeypatch.setattr(at, "enabled", lambda: True)
+        pinned = jax.devices()[-1]
+        seen = []
+
+        def bench(cand):
+            seen.append(jax.config.jax_default_device)
+            return 0.001 * cand[0]
+
+        with jax.default_device(pinned):
+            out = at.get_or_tune("k", "devsig", [(1,), (2,)], bench, (9,))
+        assert out == (1,)
+        assert seen and all(d is pinned for d in seen)
+
 
 class TestShapeGates:
     def test_small_shapes_keep_defaults(self, fresh_cache, monkeypatch):
