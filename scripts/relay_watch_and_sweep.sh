@@ -10,9 +10,18 @@ cd "$(dirname "$0")/.." || exit 1
 LOG=${1:-/tmp/relay_watch.log}
 POLL=${RELAY_POLL_SECS:-30}
 MAX_ATTEMPTS=${RELAY_MAX_SWEEP_ATTEMPTS:-4}
+# Hard stop (epoch seconds). The driver runs the official bench.py at
+# round end — a watcher-launched sweep colliding with it would corrupt
+# the headline number, so the watcher must be long gone by then.
+# Default: 4 h from launch.
+DEADLINE=${RELAY_WATCH_DEADLINE:-$(($(date +%s) + 14400))}
 attempt=0
-echo "$(date -u +%T) watching for relay..." >>"$LOG"
+echo "$(date -u +%T) watching for relay (deadline $(date -u -d "@$DEADLINE" +%T))..." >>"$LOG"
 while :; do
+  if [ "$(date +%s)" -ge "$DEADLINE" ]; then
+    echo "$(date -u +%T) deadline reached; exiting so a late relay return can't collide with the driver's round-end bench" >>"$LOG"
+    exit 3
+  fi
   if relay_up; then
     attempt=$((attempt + 1))
     echo "$(date -u +%T) relay is UP; settling 30s then sweep attempt $attempt/$MAX_ATTEMPTS" >>"$LOG"

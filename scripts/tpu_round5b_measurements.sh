@@ -48,6 +48,10 @@ run_if_done gpt124m_autotune1 900  gpt124m_autotune2 env "HOROVOD_AUTOTUNE_CACHE
 # warmed cache picked (the flash-block choice alone measured +9% at 124M).
 run 2400 gpt350m_autotune1 env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
 run_if_done gpt350m_autotune1 900  gpt350m_best      env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --fused-ln
+# Batch-growth lever: b12 without remat is a maybe-fit on 16 GB HBM
+# (b16 OOMs, hence the r5s1 remat leg); a compile OOM just fails the
+# leg. Uses the warmed cache + fused LN = best-known config.
+run_if_done gpt350m_autotune1 900  gpt350m_b12       env "HOROVOD_AUTOTUNE_CACHE=$AT_CACHE" HOROVOD_KERNEL_AUTOTUNE=1 python bench.py --model gpt --gpt-scale 350m --batch-size 12 --fused-ln
 # Profile matches the 42.3k baseline config (autotune off) so the MFU
 # attribution table describes the number we actually reported.
 run 1200 gpt350m_profile   env HOROVOD_KERNEL_AUTOTUNE=0 python bench.py --model gpt --gpt-scale 350m --batch-size 8 --profile "$OUT/profile"
