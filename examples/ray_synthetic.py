@@ -1,0 +1,88 @@
+"""Ray executor example (reference analogue:
+examples/ray/ray_train.py shape): run the synthetic JAX DP training
+function on Ray actors colocated via a placement group.
+
+Run on a machine with ray installed::
+
+    python examples/ray_synthetic.py [--num-workers 2] [--steps 20]
+    python examples/ray_synthetic.py --elastic --min-np 1 --max-np 4
+
+Each worker forces the CPU backend (Ray actors share the host; a TPU
+variant would instead map one worker per TPU host and skip the
+override). The elastic variant uses ElasticRayExecutor — discovery
+comes from the Ray cluster's live node set, and ``run`` returns
+whether the job finished with a successful worker.
+"""
+
+import argparse
+import functools
+
+import _path_setup  # noqa: F401  (repo root onto sys.path)
+
+
+def train_fn(steps: int = 20):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    rng = np.random.default_rng(hvd.rank())
+    w_true = jnp.arange(4.0)
+    params = jnp.zeros(4)
+    opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+    state = opt.init(params)
+
+    def loss_fn(p, x, y):
+        return jnp.mean((x @ p - y) ** 2)
+
+    loss = None
+    for _ in range(steps):
+        x = jnp.asarray(rng.normal(size=(32, 4)), jnp.float32)
+        y = x @ w_true
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, state = opt.update(grads, state, params)
+        params = optax.apply_updates(params, updates)
+    final = hvd.allreduce(loss, op=hvd.Average)
+    out = (hvd.rank(), hvd.size(), float(final))
+    hvd.shutdown()
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-workers", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--elastic", action="store_true")
+    ap.add_argument("--min-np", type=int, default=1)
+    ap.add_argument("--max-np", type=int, default=4)
+    args = ap.parse_args()
+
+    import ray
+
+    ray.init(ignore_reinit_error=True)
+    if args.elastic:
+        from horovod_tpu.ray import ElasticRayExecutor
+
+        ex = ElasticRayExecutor(min_np=args.min_np, max_np=args.max_np)
+        ex.start()
+        ok = ex.run(functools.partial(train_fn, args.steps))
+        print(f"elastic job {'succeeded' if ok else 'failed'}")
+    else:
+        from horovod_tpu.ray import RayExecutor
+
+        ex = RayExecutor(num_workers=args.num_workers)
+        ex.start()
+        results = ex.run(train_fn, kwargs={"steps": args.steps})
+        ex.shutdown()
+        for rank, size, loss in results:
+            print(f"rank {rank}/{size}: final rank-averaged loss "
+                  f"{loss:.5f}")
+
+
+if __name__ == "__main__":
+    main()
