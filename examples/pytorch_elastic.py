@@ -1,0 +1,64 @@
+"""Elastic PyTorch training example (reference analogue:
+examples/elastic/pytorch/pytorch_synthetic_benchmark_elastic.py):
+@hvd.elastic.run with a TorchState carrying the model, optimizer, and
+progress counters over world changes.
+
+Run under the elastic launcher (the driver re-forms the world on host
+churn; training rolls back to the last commit)::
+
+    hvdrun -np 2 --min-np 1 -H localhost:2 python examples/pytorch_elastic.py
+"""
+
+import _path_setup  # noqa: F401  (repo-root import shim)
+
+import jax
+
+# Workers must not touch a (possibly wedged) TPU backend for a host-side
+# torch job; see docs/troubleshooting.md "Launcher can't form a world".
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+TOTAL_BATCHES = 40
+MODEL_DIM = 16
+
+
+def main():
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(
+        torch.nn.Linear(MODEL_DIM, 32), torch.nn.ReLU(),
+        torch.nn.Linear(32, 1))
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+
+    @hvd.elastic.run
+    def train(state):
+        loss = torch.tensor(float("inf"))  # resume-at-end: loop may not run
+        while state.batch < TOTAL_BATCHES:
+            rs = np.random.RandomState(state.batch)  # deterministic data
+            x = torch.tensor(rs.randn(8, MODEL_DIM), dtype=torch.float32)
+            y = torch.tensor(rs.randn(8, 1), dtype=torch.float32)
+            state.optimizer.zero_grad()
+            loss = F.mse_loss(state.model(x), y)
+            loss.backward()
+            state.optimizer.step()
+            state.batch += 1
+            if state.batch % 5 == 0:
+                state.commit()  # checkpoint + raise on host churn
+        return float(loss.detach())
+
+    state = hvd.elastic.TorchState(model=model, optimizer=opt, batch=0)
+    final_loss = train(state)
+    if hvd.rank() == 0:
+        print(f"done: world={hvd.size()} batches={state.batch} "
+              f"final loss {final_loss:.5f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,8 @@ from typing import Optional
 import torch
 
 from ..common import basics
+from ..common.config import _env_bool
+from ..common.exceptions import NotInitializedError
 from .compression import Compression
 from . import mpi_ops
 from .mpi_ops import Average, Adasum, Sum
@@ -64,7 +66,21 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._synchronized = False
         self._should_synchronize = True
         self._allreduce_delay = {}
-        if mpi_ops._world() > 1:
+        # Register hooks for any world that can ever exceed 1: a static
+        # world > 1, or an elastic job (reference optimizer.py:77 gates on
+        # `size() > 1 or HOROVOD_ELASTIC == '1'`). Elastic scripts build
+        # the optimizer BEFORE the first rendezvous initializes the world
+        # (examples/pytorch_elastic.py), so a construction-time world
+        # check must tolerate the uninitialized state — and an elastic
+        # world that starts at 1 can grow, so hooks must exist anyway.
+        elastic = _env_bool("HOROVOD_ELASTIC", False)
+        try:
+            world = mpi_ops._world()
+        except NotInitializedError:
+            if not elastic:
+                raise
+            world = 0
+        if world > 1 or elastic:
             self._register_hooks()
 
     # -- hook plumbing (reference: optimizer.py:103-149) --

@@ -136,6 +136,35 @@ class TestCompression:
 
 
 class TestDistributedOptimizer:
+    def test_elastic_construction_before_init(self, monkeypatch):
+        """Elastic scripts build the optimizer BEFORE the first rendezvous
+        initializes the world (examples/pytorch_elastic.py); the hook gate
+        must tolerate that and register hooks anyway, since an elastic
+        world of 1 can grow (reference optimizer.py:77: `size() > 1 or
+        HOROVOD_ELASTIC == '1'`)."""
+        from horovod_tpu.common.exceptions import NotInitializedError
+        from horovod_tpu.torch import optimizer as opt_mod
+
+        def _raise():
+            raise NotInitializedError()
+
+        monkeypatch.setattr(opt_mod.mpi_ops, "_world", _raise)
+
+        def build():
+            model = torch.nn.Linear(4, 2)
+            return hvd_torch.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=model.named_parameters())
+
+        # Static job, no init: constructing is a caller error, as before.
+        monkeypatch.delenv("HOROVOD_ELASTIC", raising=False)
+        with pytest.raises(NotInitializedError):
+            build()
+        # Elastic job: construction succeeds and hooks are registered.
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        opt = build()
+        assert len(opt._requires_update) == 2  # weight + bias hooked
+
     def test_wraps_class(self):
         model = torch.nn.Linear(4, 2)
         opt = hvd_torch.DistributedOptimizer(
