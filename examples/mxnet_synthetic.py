@@ -1,0 +1,66 @@
+"""MXNet (gluon) training example over the native data plane (reference
+analogue: examples/mxnet/mxnet_mnist.py — synthetic features instead of
+an MNIST download; this image has zero egress).
+
+Run with the launcher on a machine with mxnet installed::
+
+    hvdrun -np 2 -H localhost:2 python examples/mxnet_synthetic.py
+
+The DistributedTrainer syncs gradients in gluon's ``_allreduce_grads``
+hook via one grouped sum-allreduce; the world average rides the
+trainer's ``rescale_grad``. Parameters broadcast from rank 0 after the
+deferred gluon initialization (the binding's deferred-init hook covers
+shapes that only materialize at first forward).
+"""
+
+import _path_setup  # noqa: F401  (repo-root import shim)
+
+import jax
+
+# MXNet here is a host-side framework; force the CPU JAX platform so
+# workers never race each other for an accelerator.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu.mxnet as hvd  # noqa: E402
+
+
+def main():
+    import mxnet as mx
+    from mxnet import autograd, gluon
+
+    hvd.init()
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    trainer = hvd.DistributedTrainer(
+        net.collect_params(), "sgd",
+        {"learning_rate": 0.01 * hvd.size()})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    rng = np.random.default_rng(hvd.rank())
+    first = True
+    for step in range(50):
+        x = mx.nd.array(rng.normal(size=(32, 32)).astype("float32"))
+        y = mx.nd.array(rng.integers(0, 10, size=(32,)))
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        if first:
+            # After the first forward materialized every shape.
+            hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+            first = False
+        trainer.step(x.shape[0])
+        if step % 10 == 0 and hvd.rank() == 0:
+            print(f"step {step}: loss {float(loss.mean().asscalar()):.4f}")
+
+    if hvd.rank() == 0:
+        print(f"done: world={hvd.size()}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
