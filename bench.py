@@ -708,8 +708,10 @@ def main():
             "2271/0.276), GPT-124M 115.8k tok/s MFU 0.42, GPT-350M "
             "42.3k tok/s MFU 0.466, GPT-350M remat b16 33.7k (remat "
             "recompute tax - not a single-chip win). "
-            "scripts/tpu_round5_measurements.sh re-captures the full "
-            "sweep in one command when the chip is reachable.")}
+            "scripts/tpu_round5b_measurements.sh re-captures the "
+            "missing legs (resumable via .done stamps); "
+            "scripts/relay_watch_and_sweep.sh launches it the moment "
+            "the relay returns.")}
            if platform == "cpu" and args.platform != "cpu" else {}),
     }), flush=True)
 
