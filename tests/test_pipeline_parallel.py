@@ -450,3 +450,61 @@ class TestPipelinedGPT:
             lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-3,
                                                     atol=1e-6),
             got, want)
+
+
+class TestScheduleMemory:
+    def test_1f1b_temp_memory_beats_gpipe(self):
+        """The 1F1B schedule's claimed O(depth) activation stash vs
+        GPipe's O(num_microbatches), verified by the COMPILER: XLA's
+        memory analysis of the two compiled programs. At M=16
+        microbatches over 8 stages the measured temp-buffer ratio is
+        ~10x (254.8 vs 25.6 MiB on the CPU mesh); assert a conservative
+        3x so layout/fusion changes don't flake the test while a stash
+        regression (re-stashing all M activations) still fails it."""
+        from horovod_tpu.parallel.pipeline import (pipelined_gpt_loss,
+                                                   pipelined_gpt_train_1f1b)
+
+        M = 16
+        cfg = gpt_tiny(dtype=jnp.float32, num_layers=8, d_model=256,
+                       d_ff=1024, max_seq_len=128)
+        rs = np.random.RandomState(0)
+        B, T = 32, 128
+        tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)))
+        targets = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, T)))
+        params = GPT(cfg).init(jax.random.PRNGKey(0), tokens)["params"]
+        stages, rest = pp_split_blocks(params, hvd.size())
+        mesh = hvd.mesh()
+
+        def gpipe_loss(stages, rest):
+            def spmd(stg, rst, tok, tgt):
+                local = jax.tree.map(lambda a: a[0], stg)
+                return pipelined_gpt_loss(cfg, local, rst, tok, tgt,
+                                          axis=hvd.HVD_AXES,
+                                          num_microbatches=M)
+
+            return jax.shard_map(
+                spmd, mesh=mesh,
+                in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
+                out_specs=P())(stages, rest, tokens, targets)
+
+        def spmd_1f1b(stg, rst, tok, tgt):
+            local = jax.tree.map(lambda a: a[0], stg)
+            loss, g_st, g_rest = pipelined_gpt_train_1f1b(
+                cfg, local, rst, tok, tgt, axis=hvd.HVD_AXES,
+                num_microbatches=M)
+            return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
+
+        gpipe_c = jax.jit(
+            jax.value_and_grad(gpipe_loss, argnums=(0, 1))).lower(
+            stages, rest).compile()
+        f1b1_c = jax.jit(jax.shard_map(
+            spmd_1f1b, mesh=mesh,
+            in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
+            out_specs=(P(), P(hvd.HVD_AXES), P()))).lower(
+            stages, rest, tokens, targets).compile()
+
+        gpipe_tmp = gpipe_c.memory_analysis().temp_size_in_bytes
+        f1b1_tmp = f1b1_c.memory_analysis().temp_size_in_bytes
+        assert f1b1_tmp * 3 < gpipe_tmp, (
+            f"1F1B temp {f1b1_tmp / 2**20:.1f} MiB not <3x GPipe's "
+            f"{gpipe_tmp / 2**20:.1f} MiB")
