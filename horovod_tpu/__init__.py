@@ -146,7 +146,9 @@ from .parallel.tape import (  # noqa: F401
     grad,
     value_and_grad,
 )
+from .common.basics import fault_counters  # noqa: F401
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
+from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
 
 from jax.sharding import PartitionSpec as _P

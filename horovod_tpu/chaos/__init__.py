@@ -1,0 +1,50 @@
+"""Deterministic fault injection for robustness testing.
+
+The elastic fault-tolerance story (blacklist-and-resume on worker failure,
+RPC retry, stall detection) can only be *demonstrated* against failures —
+and natural failures don't show up on demand. This package injects them
+deterministically: worker crashes, RPC drops/delays/duplicates, discovery
+flaps, and artificial stalls, at named points in the runner, elastic, and
+collective layers.
+
+Two front doors:
+
+* **Env-driven** (crosses process boundaries — how plans reach workers)::
+
+      HOROVOD_CHAOS_SEED=42 \\
+      HOROVOD_CHAOS_PLAN='network.client.send:drop,prob=0.3,max=5' \\
+      python -m horovod_tpu.runner -np 2 python train.py
+
+* **Programmatic**::
+
+      from horovod_tpu import chaos
+
+      plan = chaos.FaultPlan(seed=42)
+      plan.add("collective.eager", "crash", where="hostB:0",
+               after=3, max_count=1)
+      chaos.configure(plan)          # this process
+      env.update(plan.to_env())      # ...or ship it to subprocesses
+
+With a fixed seed the fault schedule is reproducible: rule decisions are a
+pure function of (seed, rule index, per-rule invocation count). See
+``docs/robustness.md`` for the fault model and the injection-point
+registry, and ``scripts/chaos_soak.py`` for soak loops.
+"""
+
+from .injector import (  # noqa: F401
+    INJECTION_POINTS,
+    ChaosInjector,
+    FaultInjectedError,
+    active,
+    configure,
+    enabled,
+    inject,
+    reset,
+)
+from .plan import (  # noqa: F401
+    ACTIONS,
+    PLAN_ENV,
+    SEED_ENV,
+    FaultPlan,
+    FaultSpec,
+)

@@ -1,0 +1,194 @@
+"""The injection engine behind :func:`horovod_tpu.chaos.inject`.
+
+Call sites across the framework name their hazard points and call
+``chaos.inject(point, **ctx)``; with no plan active that is a single flag
+check. With a plan active, the injector deterministically decides whether
+any rule fires (see :mod:`horovod_tpu.chaos.plan` for the decision
+contract), performs ``crash``/``drop``/``delay``/``stall`` inline, and
+hands ``dup``/``flap`` back to the call site to interpret.
+
+Registered injection points (ctx keys each site provides):
+
+====================== ====================================================
+``network.client.send``   RPC client about to dial (service, addr, attempt)
+``network.server.handle`` RPC server about to dispatch (service)
+``bootstrap.rendezvous``  worker asking the driver/KV for its world
+``driver.slot_grant``     driver answering a GetSlotRequest (host, rank)
+``driver.worker_exit``    driver processing a worker exit (host, code)
+``discovery.update``      HostManager polling the discovery source
+``collective.eager``      eager-path collective about to run
+====================== ====================================================
+
+Every fired fault bumps a ``chaos.<action>`` counter
+(:mod:`horovod_tpu.common.counters`) — and therefore a Timeline instant
+event — and is appended to the injector's ``schedule`` log, the artifact
+the determinism tests compare across runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common import counters
+from .plan import (
+    ACTION_CRASH,
+    ACTION_DELAY,
+    ACTION_DROP,
+    ACTION_DUP,
+    ACTION_FLAP,
+    ACTION_STALL,
+    FaultPlan,
+)
+
+INJECTION_POINTS = (
+    "network.client.send",
+    "network.server.handle",
+    "bootstrap.rendezvous",
+    "driver.slot_grant",
+    "driver.worker_exit",
+    "discovery.update",
+    "collective.eager",
+)
+
+
+class FaultInjectedError(ConnectionError):
+    """An injected ``drop``. Subclasses ConnectionError so the hardened
+    retry paths treat it exactly like a real network failure."""
+
+
+def _identity() -> str:
+    """This process's worker identity tag (``host:local_rank``), matched
+    against rule ``where`` globs. Falls back to '*'-matchable defaults in
+    the driver/launcher process."""
+    host = os.environ.get("HOROVOD_HOSTNAME", "")
+    local_rank = os.environ.get("HOROVOD_LOCAL_RANK", "")
+    return f"{host}:{local_rank}" if host else "driver"
+
+
+class ChaosInjector:
+    """Evaluates one :class:`FaultPlan`. Thread-safe; per-rule counters
+    advance under a lock, the fault actions run outside it."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+        self._lock = threading.Lock()
+        # Per-rule RNG streams keyed off (seed, rule index): the decision
+        # sequence of one rule is independent of how invocations of OTHER
+        # rules interleave with it.
+        self._rngs = [random.Random(f"{plan.seed}:{i}")
+                      for i in range(len(plan.specs))]
+        self._seen = [0] * len(plan.specs)   # matching invocations
+        self._fired = [0] * len(plan.specs)  # rule hits
+        #: [(point, where, action, rule_index, hit_number)] — the schedule.
+        self.schedule: List[Tuple[str, str, str, int, int]] = []
+
+    def decide(self, point: str, where: str) -> Optional[Tuple[int, str]]:
+        """(rule index, action) of the first rule that fires, else None."""
+        with self._lock:
+            for i, spec in enumerate(self.plan.specs):
+                if not spec.matches(point, where):
+                    continue
+                self._seen[i] += 1
+                k = self._seen[i]
+                if k <= spec.after:
+                    continue
+                if (k - spec.after - 1) % spec.every != 0:
+                    continue
+                # Draw even when prob == 1 so adding `prob=` to a rule
+                # never shifts the stream of a later decision.
+                draw = self._rngs[i].random()
+                if draw >= spec.prob:
+                    continue
+                if spec.max_count is not None and \
+                        self._fired[i] >= spec.max_count:
+                    continue
+                self._fired[i] += 1
+                self.schedule.append((point, where, spec.action, i,
+                                      self._fired[i]))
+                return i, spec.action
+        return None
+
+    def inject(self, point: str, where: Optional[str] = None,
+               **ctx) -> Optional[str]:
+        """Evaluate ``point``; perform inline actions; return the action
+        name for caller-interpreted ones (``dup``/``flap``), else None."""
+        where = _identity() if where is None else where
+        hit = self.decide(point, where)
+        if hit is None:
+            return None
+        i, action = hit
+        spec = self.plan.specs[i]
+        counters.increment(f"chaos.{action}",
+                           attrs={"point": point, "where": where, **ctx})
+        logging.warning(
+            f"chaos: injecting {action} at {point} (where={where}, "
+            f"rule #{i}, ctx={ctx})")
+        if action == ACTION_CRASH:
+            # A hard death: no atexit, no stack unwind — what a kernel
+            # panic or OOM-kill looks like to the rest of the job.
+            os._exit(spec.exit_code)
+        if action == ACTION_DROP:
+            raise FaultInjectedError(
+                f"chaos: injected drop at {point} (where={where})")
+        if action in (ACTION_DELAY, ACTION_STALL):
+            time.sleep(spec.secs)
+            return None
+        return action  # dup / flap: the call site interprets
+
+
+# ---------------------------------------------------------------------------
+# Process-global injector: configured programmatically or lazily from env.
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_injector: Optional[ChaosInjector] = None
+_env_checked = False
+
+
+def configure(plan: Optional[FaultPlan]) -> Optional[ChaosInjector]:
+    """Install ``plan`` as this process's active fault plan (None clears
+    it). Returns the installed injector for schedule inspection."""
+    global _injector, _env_checked
+    with _lock:
+        _injector = ChaosInjector(plan) if plan and plan.specs else None
+        _env_checked = True  # programmatic config wins over env
+        return _injector
+
+
+def reset() -> None:
+    """Drop any active injector and re-arm env discovery (tests)."""
+    global _injector, _env_checked
+    with _lock:
+        _injector = None
+        _env_checked = False
+
+
+def active() -> Optional[ChaosInjector]:
+    """The live injector, initializing from HOROVOD_CHAOS_* on first use."""
+    global _injector, _env_checked
+    if _env_checked:
+        return _injector
+    with _lock:
+        if not _env_checked:
+            plan = FaultPlan.from_env()
+            _injector = ChaosInjector(plan) if plan else None
+            _env_checked = True
+        return _injector
+
+
+def enabled() -> bool:
+    return active() is not None
+
+
+def inject(point: str, where: Optional[str] = None, **ctx) -> Optional[str]:
+    """Module-level injection entry — what framework call sites use. A
+    no-op (single cached-flag check) when no plan is active."""
+    inj = active()
+    if inj is None:
+        return None
+    return inj.inject(point, where=where, **ctx)

@@ -1,0 +1,176 @@
+"""Fault plans: deterministic, seedable schedules of injected faults.
+
+A :class:`FaultPlan` is a list of :class:`FaultSpec` rules plus a seed.
+Rules are matched against named injection points (see
+:mod:`horovod_tpu.chaos.injector` for the point registry) and fire
+deterministically: the decision for the k-th matching invocation of a rule
+is a pure function of ``(seed, rule index, k)``, so two processes running
+the same plan against the same call sequence observe the identical fault
+schedule — the property the determinism tests in ``tests/test_chaos.py``
+pin down.
+
+Plans cross process boundaries through two env vars (``to_env`` /
+``from_env``), which is how the elastic launcher ships a plan into
+workers::
+
+    HOROVOD_CHAOS_SEED=42
+    HOROVOD_CHAOS_PLAN=network.client.send:drop,prob=0.5,max=3;\
+collective.eager:crash,where=hostB:0,after=3,max=1
+
+Wire grammar: rules separated by ``;``, each rule
+``<point-glob>:<action>[,key=value]*``. ``where`` values may contain ``:``
+(worker identities are ``host:local_rank``), which is why options are
+comma- rather than colon-separated.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import os
+from typing import Dict, List, Mapping, Optional, Sequence
+
+SEED_ENV = "HOROVOD_CHAOS_SEED"
+PLAN_ENV = "HOROVOD_CHAOS_PLAN"
+
+#: Actions performed inline by the injector.
+ACTION_CRASH = "crash"    # os._exit — a hard worker death, no cleanup
+ACTION_DROP = "drop"      # raise FaultInjectedError (a ConnectionError)
+ACTION_DELAY = "delay"    # sleep `secs`
+ACTION_STALL = "stall"    # sleep `secs`; semantically a hang, not jitter
+#: Actions returned to the call site for interpretation.
+ACTION_DUP = "dup"        # RPC client: deliver the request twice
+ACTION_FLAP = "flap"      # discovery: report an empty host set
+
+ACTIONS = (ACTION_CRASH, ACTION_DROP, ACTION_DELAY, ACTION_STALL,
+           ACTION_DUP, ACTION_FLAP)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One fault rule.
+
+    point:  glob over injection-point names (``network.client.*``).
+    action: one of :data:`ACTIONS`.
+    where:  glob over the call's identity/context tag (worker identity
+            ``host:local_rank`` at worker-side points; ``*`` = anywhere).
+    after:  skip the first ``after`` matching invocations.
+    every:  after that, consider every ``every``-th invocation.
+    prob:   fire considered invocations with this probability (seeded).
+    max_count: stop firing after this many hits (None = unbounded).
+    secs:   duration for delay/stall.
+    exit_code: process exit code for crash.
+    """
+
+    point: str
+    action: str
+    where: str = "*"
+    after: int = 0
+    every: int = 1
+    prob: float = 1.0
+    max_count: Optional[int] = None
+    secs: float = 0.0
+    exit_code: int = 1
+
+    def __post_init__(self) -> None:
+        if self.action not in ACTIONS:
+            raise ValueError(
+                f"unknown chaos action {self.action!r}; expected one of "
+                f"{ACTIONS}")
+        if self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        if not (0.0 <= self.prob <= 1.0):
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+
+    def matches(self, point: str, where: str) -> bool:
+        return fnmatch.fnmatchcase(point, self.point) and \
+            fnmatch.fnmatchcase(where, self.where)
+
+    # -- wire format -----------------------------------------------------
+
+    def serialize(self) -> str:
+        parts = [f"{self.point}:{self.action}"]
+        defaults = FaultSpec(point="", action=self.action)
+        for field in ("where", "after", "every", "prob", "max_count",
+                      "secs", "exit_code"):
+            value = getattr(self, field)
+            if value != getattr(defaults, field):
+                parts.append(f"{_WIRE_KEYS[field]}={value}")
+        return ",".join(parts)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        head, *opts = [t.strip() for t in text.split(",") if t.strip()]
+        if ":" not in head:
+            raise ValueError(
+                f"chaos rule {text!r} must start with '<point>:<action>'")
+        point, action = head.rsplit(":", 1)
+        kwargs: Dict[str, object] = {}
+        for opt in opts:
+            if "=" not in opt:
+                raise ValueError(
+                    f"chaos rule option {opt!r} must be key=value")
+            key, value = opt.split("=", 1)
+            field = _FIELD_KEYS.get(key.strip())
+            if field is None:
+                raise ValueError(
+                    f"unknown chaos rule option {key!r} in {text!r}; "
+                    f"expected one of {sorted(_FIELD_KEYS)}")
+            kwargs[field] = _COERCE[field](value.strip())
+        return cls(point=point.strip(), action=action.strip(), **kwargs)
+
+
+_WIRE_KEYS = {
+    "where": "where", "after": "after", "every": "every", "prob": "prob",
+    "max_count": "max", "secs": "secs", "exit_code": "exit_code",
+}
+_FIELD_KEYS = {v: k for k, v in _WIRE_KEYS.items()}
+_COERCE = {
+    "where": str, "after": int, "every": int, "prob": float,
+    "max_count": lambda v: None if v in ("None", "none", "") else int(v),
+    "secs": float, "exit_code": int,
+}
+
+
+class FaultPlan:
+    """A seed plus an ordered list of fault rules."""
+
+    def __init__(self, seed: int = 0,
+                 specs: Sequence[FaultSpec] = ()) -> None:
+        self.seed = int(seed)
+        self.specs: List[FaultSpec] = list(specs)
+
+    def add(self, point: str, action: str, **kwargs) -> "FaultPlan":
+        """Append a rule; chains: ``plan.add(...).add(...)``."""
+        self.specs.append(FaultSpec(point=point, action=action, **kwargs))
+        return self
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan(seed={self.seed}, specs={self.specs!r})"
+
+    # -- env round-trip --------------------------------------------------
+
+    def to_env(self) -> Dict[str, str]:
+        """Env-var form for shipping into worker subprocesses."""
+        return {
+            SEED_ENV: str(self.seed),
+            PLAN_ENV: ";".join(s.serialize() for s in self.specs),
+        }
+
+    @classmethod
+    def from_env(cls,
+                 environ: Optional[Mapping[str, str]] = None
+                 ) -> Optional["FaultPlan"]:
+        """Parse a plan from ``environ`` (default ``os.environ``); None
+        when no plan is configured."""
+        environ = os.environ if environ is None else environ
+        text = environ.get(PLAN_ENV, "").strip()
+        if not text:
+            return None
+        seed = int(environ.get(SEED_ENV, "0"))
+        specs = [FaultSpec.parse(rule)
+                 for rule in text.split(";") if rule.strip()]
+        return cls(seed=seed, specs=specs)

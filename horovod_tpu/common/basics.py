@@ -46,6 +46,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from . import config as _config
+from . import counters as _counters
 from .exceptions import NotInitializedError
 
 # Mesh axis names. The pair mirrors the reference's local/cross communicator
@@ -279,9 +280,21 @@ def shutdown() -> None:
         from ..ops import collective_ops
 
         collective_ops._reset_eager_state()
+        # New incarnation, fresh fault/retry counters (totals persist).
+        _counters.reset_incarnation()
 
 
 atexit.register(shutdown)
+
+
+def fault_counters(total: bool = False) -> dict:
+    """Snapshot of the fault/retry counters (RPC retries, injected chaos
+    faults, blacklist transitions, stall-watchdog firings). Scope is the
+    current world incarnation by default — counters clear on
+    ``shutdown()``, so an elastic job reads per-incarnation numbers;
+    ``total=True`` returns process-lifetime cumulative values. Does not
+    require ``init()``: the runner/driver processes record too."""
+    return _counters.counters(total=total)
 
 
 def is_initialized() -> bool:

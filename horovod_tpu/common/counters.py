@@ -1,0 +1,76 @@
+"""Fault / retry counters, exported through ``horovod_tpu.common.basics``.
+
+Robustness events (RPC retries, injected faults, blacklist transitions,
+stall-watchdog firings) are recorded here so tests and operators can assert
+on *how* a job survived, not just that it did. Two scopes:
+
+* **incarnation** — cleared by :func:`reset_incarnation`, which
+  ``basics.shutdown()`` calls; in an elastic job this makes the counters
+  per world incarnation (the shutdown→init cycle between worlds).
+* **total** — cumulative across the life of the process.
+
+Every increment is also emitted as an instant event on the active
+:class:`horovod_tpu.utils.timeline.Timeline` (when one is attached), so a
+``chrome://tracing`` view of a chaotic run shows exactly when each fault
+or retry happened relative to the collectives around it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+_lock = threading.Lock()
+_incarnation: Dict[str, int] = {}
+_total: Dict[str, int] = {}
+
+
+def increment(name: str, n: int = 1,
+              attrs: Optional[dict] = None) -> None:
+    """Bump counter ``name`` by ``n`` and mirror it onto the timeline.
+
+    ``name`` is dot-separated (``rpc.client.retry``, ``chaos.drop``,
+    ``elastic.stall.warning``); ``attrs`` ride into the timeline event's
+    ``args`` for context (service name, host, attempt number, ...).
+    """
+    with _lock:
+        _incarnation[name] = _incarnation.get(name, 0) + n
+        _total[name] = _total.get(name, 0) + n
+    _emit_timeline(name, attrs)
+
+
+def _emit_timeline(name: str, attrs: Optional[dict]) -> None:
+    # Lazy import: counters must stay importable from the launcher/runner
+    # processes without dragging framework state along.
+    try:
+        from . import basics
+
+        tl = basics._state.timeline
+    except Exception:  # pragma: no cover - partial interpreter teardown
+        return
+    if tl is not None:
+        tl.instant(f"FAULT:{name}", tid="faults", args=attrs)
+
+
+def get(name: str, total: bool = False) -> int:
+    with _lock:
+        return (_total if total else _incarnation).get(name, 0)
+
+
+def counters(total: bool = False) -> Dict[str, int]:
+    """Snapshot of all counters (incarnation scope by default)."""
+    with _lock:
+        return dict(_total if total else _incarnation)
+
+
+def reset_incarnation() -> None:
+    """Clear the per-incarnation scope (called by ``basics.shutdown()``)."""
+    with _lock:
+        _incarnation.clear()
+
+
+def reset_all() -> None:
+    """Clear both scopes (tests)."""
+    with _lock:
+        _incarnation.clear()
+        _total.clear()

@@ -88,6 +88,14 @@ def _rendezvous(client) -> None:
         ifaces = None
     deadline = time.monotonic() + constants.ELASTIC_TIMEOUT_SECS
     while True:
+        # Worker-side rendezvous hazard gate: 'crash' is a worker dying
+        # between worlds (driver sees the exit and resumes without it);
+        # 'stall' holds this slot back and trips the driver's formation
+        # watchdog rather than any collective-level detector.
+        from ..chaos import injector as _chaos
+
+        _chaos.inject("bootstrap.rendezvous", phase="elastic",
+                      world_id=_last_world_id[0] + 1)
         resp = client._send(GetSlotRequest(host, local_rank,
                                            _last_world_id[0] + 1,
                                            ifaces=ifaces))

@@ -9,9 +9,15 @@ classifies each update as added/removed/mixed (HostUpdateResult).
 from __future__ import annotations
 
 import logging
+import math
+import os
 import subprocess
 import threading
+import time
 from typing import Dict, List, Optional, Set
+
+from ..chaos import injector as chaos
+from ..common import counters
 
 
 class HostUpdateResult:
@@ -65,37 +71,94 @@ class FixedHosts(HostDiscovery):
 
 class HostManager:
     """Tracks current/blacklisted hosts and diffs discovery results
-    (reference discovery.py:92-164)."""
+    (reference discovery.py:92-164).
 
-    def __init__(self, discovery: HostDiscovery):
+    Blacklist cooldown: the reference blacklists forever — one crash and
+    the host's capacity is lost for the life of the job. With
+    ``cooldown_secs > 0`` (constructor arg, or the
+    ``HOROVOD_BLACKLIST_COOLDOWN_SECS`` env var) a blacklisted host is
+    re-admitted after the cooldown elapses: the next discovery diff
+    reports it as *added*, so the driver builds a new world that includes
+    it. A host that fails again is re-blacklisted with a fresh cooldown.
+    Default is 0 → infinite blacklist, the reference behavior.
+    """
+
+    def __init__(self, discovery: HostDiscovery,
+                 cooldown_secs: Optional[float] = None):
+        if cooldown_secs is None:
+            try:
+                cooldown_secs = float(os.environ.get(
+                    "HOROVOD_BLACKLIST_COOLDOWN_SECS", "0"))
+            except ValueError:
+                cooldown_secs = 0.0
+        self._cooldown = cooldown_secs
         self._discovery = discovery
         self._lock = threading.Lock()
         self._current_hosts: Dict[str, int] = {}
-        self._blacklist: Set[str] = set()
+        self._blacklist: Dict[str, float] = {}  # host → expiry (monotonic)
+        # Hosts whose cooldown expired since the last diff: the next
+        # update_available_hosts must report them as added even though the
+        # raw discovery result never changed.
+        self._readmitted_pending: Set[str] = set()
+
+    def _prune_expired_locked(self) -> None:
+        """Drop expired blacklist entries (caller holds the lock)."""
+        now = time.monotonic()
+        for host in [h for h, exp in self._blacklist.items() if exp <= now]:
+            del self._blacklist[host]
+            self._readmitted_pending.add(host)
+            counters.increment("elastic.blacklist.readmit",
+                               attrs={"host": host})
+            logging.warning(
+                f"blacklist cooldown expired for host {host} — "
+                f"re-admitting")
 
     @property
     def current_hosts(self) -> Dict[str, int]:
         with self._lock:
+            self._prune_expired_locked()
             return {h: s for h, s in self._current_hosts.items()
                     if h not in self._blacklist}
 
     def blacklist(self, host: str) -> None:
-        """Reference discovery.py:128-136 — a failed host never returns."""
+        """Reference discovery.py:128-136, plus cooldown: without one the
+        failed host never returns; with one it may rejoin after
+        ``cooldown_secs`` (a fresh failure re-arms the timer)."""
         with self._lock:
-            if host not in self._blacklist:
-                logging.warning(f"blacklisting host {host}")
-                self._blacklist.add(host)
+            expiry = time.monotonic() + self._cooldown \
+                if self._cooldown > 0 else math.inf
+            fresh = host not in self._blacklist
+            self._blacklist[host] = expiry
+            self._readmitted_pending.discard(host)
+            if fresh:
+                counters.increment("elastic.blacklist",
+                                   attrs={"host": host})
+                logging.warning(
+                    f"blacklisting host {host}"
+                    + (f" for {self._cooldown:.0f}s"
+                       if self._cooldown > 0 else ""))
 
     def is_blacklisted(self, host: str) -> bool:
         with self._lock:
+            self._prune_expired_locked()
             return host in self._blacklist
 
     def update_available_hosts(self) -> int:
         """Run discovery once; return a HostUpdateResult mask."""
-        new_hosts = self._discovery.find_available_hosts_and_slots()
+        if chaos.inject("discovery.update") == "flap":
+            # Injected flap: the discovery source transiently reports an
+            # empty world (DNS blip, control-plane hiccup).
+            new_hosts: Dict[str, int] = {}
+        else:
+            new_hosts = self._discovery.find_available_hosts_and_slots()
         with self._lock:
+            self._prune_expired_locked()
+            readmitted = self._readmitted_pending
+            self._readmitted_pending = set()
+            # A just-readmitted host is excluded from `old` so the diff
+            # reports it as added (the raw result may not have changed).
             old = {h: s for h, s in self._current_hosts.items()
-                   if h not in self._blacklist}
+                   if h not in self._blacklist and h not in readmitted}
             new = {h: s for h, s in new_hosts.items()
                    if h not in self._blacklist}
             self._current_hosts = new_hosts

@@ -18,11 +18,14 @@ sockets can never cross-talk between incarnations.
 from __future__ import annotations
 
 import logging
+import os
 import socket
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..chaos import injector as chaos
+from ..common import counters
 from ..runner import network
 from ..runner.hosts import HostInfo, SlotInfo, get_host_assignments
 from . import constants
@@ -97,7 +100,11 @@ class ElasticDriver:
     def __init__(self, discovery, min_np: int, max_np: Optional[int] = None,
                  reset_limit: Optional[int] = None, verbose: int = 0,
                  key: Optional[bytes] = None,
-                 controller_addr_override: Optional[str] = None):
+                 controller_addr_override: Optional[str] = None,
+                 stall_check_disable: Optional[bool] = None,
+                 stall_warn_secs: Optional[float] = None,
+                 stall_shutdown_secs: Optional[float] = None,
+                 blacklist_cooldown_secs: Optional[float] = None):
         # controller_addr_override: tests simulating multi-host churn with
         # fake hostnames on one machine point every worker at 127.0.0.1
         # (the reference mocks ssh the same way, SURVEY §4).
@@ -107,12 +114,36 @@ class ElasticDriver:
         self._min_np = min_np
         self._max_np = max_np
         self._verbose = verbose
-        self._host_manager = HostManager(discovery)
+        self._host_manager = HostManager(
+            discovery, cooldown_secs=blacklist_cooldown_secs)
         self._registry = WorkerStateRegistry(self, self._host_manager,
                                              reset_limit=reset_limit,
                                              verbose=verbose > 0)
         self.key = key or secret.make_secret_key()
         self._service = ElasticDriverService(self.key, self)
+
+        # Stall watchdog config: the --stall-check-* CLI flags land in
+        # these env vars (runner/config_parser.py) and the elastic
+        # launcher also passes them explicitly. Semantics: a world
+        # incarnation that stops making *formation progress* (no slot
+        # reaching rendezvous, no port report, no worker exit) for longer
+        # than the warning threshold is reported; past the shutdown
+        # threshold (0 = never) the incarnation is abandoned — hosts of
+        # the slots that never showed up are blacklisted and the driver
+        # resumes into a new world without them.
+        self._stall_check_disable = _env_bool(
+            "HOROVOD_STALL_CHECK_DISABLE", False) \
+            if stall_check_disable is None else stall_check_disable
+        self._stall_warn_secs = _env_float(
+            "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0) \
+            if stall_warn_secs is None else stall_warn_secs
+        self._stall_shutdown_secs = _env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0) \
+            if stall_shutdown_secs is None else stall_shutdown_secs
+        self._progress_ts = time.monotonic()
+        self._stall_warned_world = -1
+        self._stall_handled_world = -1
+        self._stall_thread: Optional[threading.Thread] = None
 
         self._lock = threading.RLock()
         self._world_id = -1
@@ -169,6 +200,10 @@ class ElasticDriver:
         self._discovery_thread = threading.Thread(target=self._discover_loop,
                                                   daemon=True)
         self._discovery_thread.start()
+        if not self._stall_check_disable and self._stall_warn_secs > 0:
+            self._stall_thread = threading.Thread(
+                target=self._stall_watchdog, daemon=True)
+            self._stall_thread.start()
 
     def wait_for_available_slots(self, min_np: int,
                                  timeout: Optional[float] = None):
@@ -214,6 +249,10 @@ class ElasticDriver:
     def get_slot_info(self, host: str, local_rank: int,
                       min_world_id: int = 0,
                       ifaces=None) -> GetSlotResponse:
+        # An injected 'drop' here surfaces to the worker as an unanswered
+        # RPC (its client retries with backoff); 'delay'/'stall' model a
+        # driver too busy to grant slots.
+        chaos.inject("driver.slot_grant", host=host, local_rank=local_rank)
         with self._lock:
             if ifaces:
                 self._host_ifaces[host] = [tuple(i) for i in ifaces]
@@ -260,6 +299,7 @@ class ElasticDriver:
                     self._controller_port == 0:
                 return GetSlotResponse("waiting")
             self._registry.record_ready(host, local_rank)
+            self._touch_progress()
             rank0_host = next(s.hostname for s in self._assignments.values()
                               if s.rank == 0)
             if self._controller_addr_override is not None:
@@ -301,6 +341,7 @@ class ElasticDriver:
         with self._lock:
             if world_id == self._world_id:
                 self._controller_port = port
+                self._touch_progress()
 
     def register_worker_address(self, host: str, local_rank: int,
                                 addr: str, port: int) -> None:
@@ -345,6 +386,67 @@ class ElasticDriver:
             # restore + re-rendezvous) via on_worker_failure.
             self._maybe_resume()
             self._notify_workers(res)
+
+    # ---------------------------------------------------- stall watchdog
+
+    def _touch_progress(self) -> None:
+        self._progress_ts = time.monotonic()
+
+    def _missing_slots(self) -> List[Tuple[str, int]]:
+        """Assigned slots that have not reached rendezvous (or exited)
+        this incarnation."""
+        recorded = self._registry.recorded_slots()
+        with self._lock:
+            return [k for k in self._assignments
+                    if f"{k[0]}:{k[1]}" not in recorded]
+
+    def _stall_watchdog(self) -> None:
+        """Enforce the --stall-check-* contract on world formation: warn
+        when an incarnation stops making progress for
+        ``stall_warn_secs``; past ``stall_shutdown_secs`` (if > 0),
+        abandon it — blacklist the hosts whose slots never showed up and
+        resume without them. The native core's stall inspector covers
+        collectives *inside* a formed world; this thread covers the
+        formation path the core never sees (a worker hung before init)."""
+        interval = max(0.05, min(1.0, self._stall_warn_secs / 4))
+        while not self._shutdown.wait(interval):
+            if self._finished.is_set():
+                return
+            missing = self._missing_slots()
+            with self._lock:
+                world_id = self._world_id
+                stalled_for = time.monotonic() - self._progress_ts
+            if not missing:
+                continue  # world fully formed (or empty): core takes over
+            if stalled_for > self._stall_warn_secs and \
+                    self._stall_warned_world < world_id:
+                self._stall_warned_world = world_id
+                counters.increment("elastic.stall.warning",
+                                   attrs={"world_id": world_id})
+                logging.warning(
+                    f"world {world_id} formation stalled for "
+                    f"{stalled_for:.1f}s — waiting on slots "
+                    f"{sorted(missing)} "
+                    f"(--stall-check-warning-time-seconds="
+                    f"{self._stall_warn_secs:g})")
+            if self._stall_shutdown_secs > 0 and \
+                    stalled_for > self._stall_shutdown_secs and \
+                    self._stall_handled_world < world_id:
+                self._stall_handled_world = world_id
+                counters.increment("elastic.stall.shutdown",
+                                   attrs={"world_id": world_id})
+                logging.error(
+                    f"world {world_id} formation stalled for "
+                    f"{stalled_for:.1f}s — abandoning the incarnation; "
+                    f"blacklisting {sorted({h for h, _ in missing})}")
+                for host in {h for h, _ in missing}:
+                    self._host_manager.blacklist(host)
+                if self._registry.reset_limit_reached():
+                    logging.error(
+                        "elastic reset limit reached — shutting down")
+                    self.stop()
+                    return
+                self._maybe_resume()
 
     def _notify_workers(self, res: int) -> None:
         with self._lock:
@@ -394,6 +496,7 @@ class ElasticDriver:
             slots = get_host_assignments(host_infos, self._min_np,
                                          self._max_np or total)
             self._world_id += 1
+            self._touch_progress()
             if not initial:
                 self._registry.increment_reset_count()
             self._registry.reset()
@@ -431,6 +534,11 @@ class ElasticDriver:
         t.start()
 
     def _handle_worker_exit(self, slot: SlotInfo, code: int) -> None:
+        # 'delay' here models a slow exit-status pipeline (ssh teardown);
+        # the lifecycle decisions below must tolerate arriving late.
+        chaos.inject("driver.worker_exit", host=slot.hostname,
+                     local_rank=slot.local_rank, code=code)
+        self._touch_progress()
         key = (slot.hostname, slot.local_rank)
         with self._lock:
             self._live_workers.pop(key, None)
@@ -477,3 +585,18 @@ class ElasticDriver:
 def _is_local(hostname: str) -> bool:
     return hostname in ("localhost", "127.0.0.1", socket.gethostname(),
                         socket.getfqdn())
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v in (None, ""):
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")

@@ -75,9 +75,16 @@ def launch_elastic(args, env: Optional[Dict[str, str]] = None) -> None:
 
     min_np = args.min_np or args.np
     max_np = args.max_np
-    driver = ElasticDriver(discovery, min_np=min_np, max_np=max_np,
-                           reset_limit=args.reset_limit,
-                           verbose=args.verbose)
+    # --stall-check-* flags drive the driver's formation watchdog directly
+    # (the env copies from config_parser only reach worker processes).
+    driver = ElasticDriver(
+        discovery, min_np=min_np, max_np=max_np,
+        reset_limit=args.reset_limit, verbose=args.verbose,
+        stall_check_disable=getattr(args, "no_stall_check", None),
+        stall_warn_secs=getattr(args, "stall_check_warning_time_seconds",
+                                None),
+        stall_shutdown_secs=getattr(
+            args, "stall_check_shutdown_time_seconds", None))
     try:
         driver.start(make_exec_worker_fn(
             args.command, env, driver, verbose=args.verbose,

@@ -63,6 +63,12 @@ class WorkerStateRegistry:
         with self._lock:
             return {k for k, s in self._states.items() if s == state}
 
+    def recorded_slots(self) -> Set[str]:
+        """All ``host:local_rank`` keys that reached any state this
+        incarnation (the stall watchdog's notion of 'showed up')."""
+        with self._lock:
+            return set(self._states)
+
     def reset(self) -> None:
         """Clear per-world state before a new assignment round
         (reference registration.py:63-72)."""

@@ -695,6 +695,12 @@ def _eager_ctx():
     whose controller is missing (HOROVOD_CONTROLLER=none, or HOROVOD_SIZE
     unset under jax.distributed) must fail loudly: silently skipping the
     collective would let ranks diverge unreduced."""
+    # Chaos gate for the eager path: 'crash' is a worker dying
+    # mid-collective (peers see HorovodInternalError and the elastic
+    # restore path engages); 'stall' is a straggler rank.
+    from ..chaos import injector as _chaos
+
+    _chaos.inject("collective.eager")
     s = basics._require_init()
     ctrl = s.controller
     world = ctrl.size() if ctrl is not None else s.process_count

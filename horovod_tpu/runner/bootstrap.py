@@ -37,6 +37,8 @@ import socket
 import time
 from typing import Optional
 
+from ..chaos import injector as chaos
+
 _SCOPE = "controller"
 _KEY = "static"
 
@@ -78,6 +80,10 @@ def publish_controller(port: int, key: Optional[str] = None) -> None:
     from . import nic
     from .http_server import put_data_into_kvstore
 
+    # Injection point for the static bootstrap: a crash/stall here is
+    # rank 0 dying (or hanging) between binding its controller port and
+    # publishing it — the failure mode HOROVOD_BOOTSTRAP_TIMEOUT bounds.
+    chaos.inject("bootstrap.rendezvous", phase="kv_publish")
     addr, kv_port = _kv_coords()
     try:
         ifaces = nic.list_interfaces()
@@ -107,6 +113,7 @@ def resolve_controller(timeout: Optional[float] = None) -> None:
 
     import urllib.error
 
+    chaos.inject("bootstrap.rendezvous", phase="kv_resolve")
     if timeout is None:
         timeout = float(os.environ.get("HOROVOD_BOOTSTRAP_TIMEOUT", "300"))
     addr, kv_port = _kv_coords()

@@ -13,16 +13,29 @@ Wire format per message: ``len(4B big-endian) | hmac(32B) | pickle-bytes``.
 from __future__ import annotations
 
 import hmac
+import os
 import pickle
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Optional, Tuple
 
+from ..chaos import injector as chaos
+from ..common import counters
 from .secret import DIGEST_LENGTH_BYTES
 
 _LEN = struct.Struct(">I")
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    try:
+        return float(v) if v not in (None, "") else default
+    except ValueError:
+        return default
 
 
 def find_free_port() -> int:
@@ -90,6 +103,11 @@ class BasicService:
             def handle(self):
                 sock = self.request
                 try:
+                    # Injected 'drop' raises here: the request goes
+                    # unanswered and the client sees its peer hang up —
+                    # the server-side half of a lost message.
+                    chaos.inject("network.server.handle",
+                                 service=service._service_name)
                     req = read_message(sock, service._key)
                     resp = service._handle(req, self.client_address)
                     write_message(sock, resp, service._key)
@@ -126,30 +144,84 @@ class BasicService:
 
 class BasicClient:
     """Connects to a BasicService and exchanges one request/response per
-    call (reference network.py:150-268)."""
+    call (reference network.py:150-268).
+
+    Retries failed sends with capped exponential backoff + full jitter,
+    bounded by both ``attempts`` and an optional total-deadline budget
+    (``total_deadline`` seconds across all attempts of one ``_send``,
+    overridable via ``HOROVOD_RPC_DEADLINE_SECS``; 0 disables the budget
+    and the attempt count alone bounds the call). Backoff shape comes from
+    ``HOROVOD_RPC_RETRY_BASE_SECS`` (default 0.05) doubling up to
+    ``HOROVOD_RPC_RETRY_MAX_SECS`` (default 2.0).
+    """
 
     def __init__(self, service_name: str, addr: str, port: int, key: bytes,
-                 attempts: int = 3, timeout: float = 10.0):
+                 attempts: int = 3, timeout: float = 10.0,
+                 total_deadline: Optional[float] = None):
         self._service_name = service_name
         self._addr = addr
         self._port = port
         self._key = key
-        self._attempts = attempts
+        self._attempts = max(1, attempts)
         self._timeout = timeout
+        self._retry_base = _env_float("HOROVOD_RPC_RETRY_BASE_SECS", 0.05)
+        self._retry_max = _env_float("HOROVOD_RPC_RETRY_MAX_SECS", 2.0)
+        self._deadline_budget = _env_float(
+            "HOROVOD_RPC_DEADLINE_SECS", 0.0) \
+            if total_deadline is None else total_deadline
+
+    def _send_once(self, req: Any) -> Any:
+        with socket.create_connection((self._addr, self._port),
+                                      timeout=self._timeout) as sock:
+            write_message(sock, req, self._key)
+            return read_message(sock, self._key)
 
     def _send(self, req: Any) -> Any:
+        start = time.monotonic()
+        deadline = start + self._deadline_budget \
+            if self._deadline_budget > 0 else None
         last_err: Optional[Exception] = None
-        for _ in range(self._attempts):
+        attempt = 0
+        while attempt < self._attempts:
+            attempt += 1
             try:
-                with socket.create_connection((self._addr, self._port),
-                                              timeout=self._timeout) as sock:
-                    write_message(sock, req, self._key)
-                    return read_message(sock, self._key)
+                act = chaos.inject("network.client.send",
+                                   service=self._service_name,
+                                   addr=f"{self._addr}:{self._port}",
+                                   attempt=attempt)
+                if act == "dup":
+                    # Deliver the request twice (a retransmitted message
+                    # both copies of which arrived): services must be
+                    # idempotent per request.
+                    try:
+                        self._send_once(req)
+                    except (OSError, ConnectionError):
+                        pass
+                return self._send_once(req)
             except (OSError, ConnectionError) as e:
                 last_err = e
+                if attempt >= self._attempts:
+                    break
+                # Capped exponential backoff with jitter in [0.5x, 1.5x):
+                # concurrent clients of a recovering service must not
+                # retry in lockstep.
+                delay = min(self._retry_max,
+                            self._retry_base * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random()
+                if deadline is not None and \
+                        time.monotonic() + delay > deadline:
+                    break
+                counters.increment("rpc.client.retry",
+                                   attrs={"service": self._service_name,
+                                          "attempt": attempt})
+                time.sleep(delay)
+        elapsed = time.monotonic() - start
+        counters.increment("rpc.client.failure",
+                           attrs={"service": self._service_name,
+                                  "attempts": attempt})
         raise ConnectionError(
-            f"{self._service_name} RPC to {self._addr}:{self._port} failed: "
-            f"{last_err}")
+            f"{self._service_name} RPC to {self._addr}:{self._port} failed "
+            f"after {attempt} attempt(s) over {elapsed:.2f}s: {last_err}")
 
     def ping(self) -> PingResponse:
         return self._send(PingRequest())

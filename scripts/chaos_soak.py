@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Chaos soak: loop the elastic recovery scenario under injected faults.
+
+Runs the same scenario the integration tests pin
+(tests/test_elastic_integration.py::TestChaosElastic) N times with a
+different chaos seed per iteration, and checks the recovery invariants
+each time: the faulted host is blacklisted, the world re-forms at a new
+world_id, every survivor finishes, and all finishers agree on the
+trained weights. Exit code is the number of failed iterations.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py --iterations 10
+    python scripts/chaos_soak.py --fault drop --iterations 50 --seed 100
+    python scripts/chaos_soak.py --fault stall -n 5 --keep-going
+
+Faults: ``crash`` (hostB worker dies at an eager collective), ``drop``
+(driver slot-grant RPCs go unanswered; retry absorbs), ``stall``
+(hostB worker hangs before rendezvous; the stall watchdog abandons the
+incarnation), ``mixed`` (cycle through all three).
+"""
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+FAULTS = ("crash", "drop", "stall")
+
+
+def _read_log(path):
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records
+
+
+def run_once(fault, seed, workdir, verbose=False):
+    """One soak iteration; returns (ok, detail)."""
+    from horovod_tpu import chaos
+    from horovod_tpu.common import counters
+    from horovod_tpu.elastic import constants
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner import safe_shell_exec
+
+    constants.DISCOVER_HOSTS_FREQUENCY_SECS = 0.25
+    chaos.reset()
+    counters.reset_all()
+
+    script = os.path.join(workdir, "discover.sh")
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\necho hostA:2\necho hostB:1\n")
+    os.chmod(script, 0o755)
+    log_file = os.path.join(workdir, "log.jsonl")
+
+    worker_env = {}
+    driver_kwargs = {}
+    worker_args = ["--batches", "8", "--batch-sleep", "0.1"]
+    if fault == "crash":
+        plan = chaos.FaultPlan(seed=seed).add(
+            "collective.eager", "crash", where="hostB:0", after=3,
+            max_count=1)
+        worker_env = plan.to_env()
+    elif fault == "drop":
+        chaos.configure(chaos.FaultPlan(seed=seed).add(
+            "driver.slot_grant", "drop", prob=0.3, max_count=4))
+    elif fault == "stall":
+        plan = chaos.FaultPlan(seed=seed).add(
+            "bootstrap.rendezvous", "stall", where="hostB:0", secs=8,
+            max_count=1)
+        worker_env = {**plan.to_env(), "HOROVOD_START_TIMEOUT": "3"}
+        worker_args = ["--batches", "4", "--batch-sleep", "0.05"]
+        driver_kwargs = dict(stall_warn_secs=1.0, stall_shutdown_secs=2.0)
+    else:
+        raise ValueError(f"unknown fault {fault!r}")
+
+    driver = ElasticDriver(HostDiscoveryScript(script, 1), min_np=2,
+                           max_np=3, controller_addr_override="127.0.0.1",
+                           **driver_kwargs)
+
+    def _exec(slot, world_id):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+            "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+            "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+            "HOROVOD_START_TIMEOUT": "30",
+        })
+        env.update(worker_env)
+        cmd = " ".join(shlex.quote(c) for c in [
+            sys.executable, WORKER, "--log-file", log_file, *worker_args])
+        return safe_shell_exec.execute(cmd, env=env)
+
+    try:
+        driver.start(_exec)
+        ok = driver.join(timeout=180)
+    finally:
+        driver.stop()
+        driver.shutdown_service()
+        chaos.reset()
+
+    records = _read_log(log_file)
+    done = [r for r in records if r.get("done")]
+    problems = []
+    if not ok:
+        problems.append("job did not finish successfully")
+    if fault in ("crash", "stall"):
+        if not driver.host_manager.is_blacklisted("hostB"):
+            problems.append("hostB was not blacklisted")
+        if driver.world_id < 1:
+            problems.append(f"no new incarnation (world_id="
+                            f"{driver.world_id})")
+        if len(done) != 2:
+            problems.append(f"{len(done)} finishers, expected 2")
+    else:  # drop: absorbed invisibly, full world finishes
+        if len(done) != 3:
+            problems.append(f"{len(done)} finishers, expected 3")
+    if len({r["weights"] for r in done}) > 1:
+        problems.append(f"finishers disagree on weights: {done}")
+    detail = (f"world_id={driver.world_id} done={len(done)} "
+              f"counters={counters.counters(total=True)}")
+    if verbose and problems:
+        detail += f" records={records}"
+    return not problems, detail + ("" if not problems
+                                   else f" PROBLEMS={problems}")
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="loop the chaos-driven elastic recovery scenario")
+    parser.add_argument("-n", "--iterations", type=int, default=10)
+    parser.add_argument("--fault", choices=FAULTS + ("mixed",),
+                        default="crash")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base chaos seed (iteration i uses seed+i)")
+    parser.add_argument("--keep-going", action="store_true",
+                        help="run all iterations even after a failure")
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    failures = 0
+    for i in range(args.iterations):
+        fault = FAULTS[i % len(FAULTS)] if args.fault == "mixed" \
+            else args.fault
+        t0 = time.monotonic()
+        with tempfile.TemporaryDirectory(prefix="chaos_soak_") as workdir:
+            try:
+                ok, detail = run_once(fault, args.seed + i, workdir,
+                                      verbose=args.verbose)
+            except Exception as e:  # a crash of the harness is a failure
+                ok, detail = False, f"harness exception: {e!r}"
+        status = "ok" if ok else "FAIL"
+        print(f"[{i + 1}/{args.iterations}] fault={fault} "
+              f"seed={args.seed + i} {status} "
+              f"({time.monotonic() - t0:.1f}s) {detail}", flush=True)
+        if not ok:
+            failures += 1
+            if not args.keep_going:
+                break
+    print(f"chaos soak: {failures} failure(s)")
+    sys.exit(min(failures, 125))
+
+
+if __name__ == "__main__":
+    main()

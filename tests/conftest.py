@@ -22,6 +22,15 @@ import pytest  # noqa: E402
 import horovod_tpu as hvd  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from the tier-1 "
+        "'not slow' set")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection (chaos) robustness test — "
+        "see docs/robustness.md and scripts/chaos_soak.py")
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _hvd_init():
     hvd.init()

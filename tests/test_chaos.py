@@ -1,0 +1,275 @@
+"""Chaos (fault-injection) subsystem tests: plan wire format, schedule
+determinism, action semantics, and the hardened RPC retry path reacting
+to injected faults over real localhost sockets."""
+
+import os
+import time
+
+import pytest
+
+from horovod_tpu import chaos
+from horovod_tpu.common import counters
+from horovod_tpu.runner import network, secret
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos(monkeypatch):
+    """Each test starts with no plan active and fresh counters, and never
+    leaks its plan into the next test (the injector is process-global)."""
+    monkeypatch.delenv(chaos.PLAN_ENV, raising=False)
+    monkeypatch.delenv(chaos.SEED_ENV, raising=False)
+    chaos.reset()
+    counters.reset_all()
+    yield
+    chaos.reset()
+    counters.reset_all()
+
+
+class TestFaultPlanWireFormat:
+    def test_round_trip_through_env(self):
+        plan = chaos.FaultPlan(seed=42)
+        plan.add("network.client.send", "drop", prob=0.5, max_count=3)
+        plan.add("collective.eager", "crash", where="hostB:0", after=3,
+                 max_count=1)
+        plan.add("driver.slot_grant", "delay", secs=0.25, every=2)
+        env = plan.to_env()
+        parsed = chaos.FaultPlan.from_env(env)
+        assert parsed.seed == 42
+        assert [s.serialize() for s in parsed.specs] == \
+            [s.serialize() for s in plan.specs]
+
+    def test_where_may_contain_colon(self):
+        # Worker identities are host:local_rank — the rule separator must
+        # not eat them.
+        spec = chaos.FaultSpec.parse("collective.eager:stall,where=h1:3,secs=2")
+        assert spec.where == "h1:3"
+        assert spec.secs == 2.0
+        assert chaos.FaultSpec.parse(spec.serialize()).where == "h1:3"
+
+    def test_unknown_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos action"):
+            chaos.FaultSpec.parse("p:explode")
+
+    def test_unknown_option_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos rule option"):
+            chaos.FaultSpec.parse("p:drop,frequency=2")
+
+    def test_no_plan_in_env_means_none(self):
+        assert chaos.FaultPlan.from_env({}) is None
+
+
+def _schedule(seed, calls):
+    """Run a scripted (point, where) call sequence against a fresh
+    injector; return the fired-fault schedule."""
+    plan = chaos.FaultPlan(seed=seed)
+    plan.add("a.*", "delay", prob=0.5, secs=0.0)
+    plan.add("b.*", "delay", prob=0.5, secs=0.0)
+    inj = chaos.ChaosInjector(plan)
+    for point, where in calls:
+        inj.inject(point, where=where)
+    return tuple(inj.schedule)
+
+
+class TestDeterminism:
+    CALLS = [("a.x", "w1"), ("b.y", "w2"), ("a.x", "w1")] * 20
+
+    def test_same_seed_same_schedule(self):
+        assert _schedule(7, self.CALLS) == _schedule(7, self.CALLS)
+
+    def test_different_seed_different_schedule(self):
+        # 60 p=0.5 decisions: collision probability ~2^-60.
+        assert _schedule(7, self.CALLS) != _schedule(8, self.CALLS)
+
+    def test_rule_streams_independent_of_interleaving(self):
+        """Rule decisions depend only on that rule's own invocation
+        count, not on how other rules' calls interleave."""
+        a_only = [c for c in self.CALLS if c[0] == "a.x"]
+        mixed = _schedule(7, self.CALLS)
+        alone = _schedule(7, a_only)
+        assert [e for e in mixed if e[0] == "a.x"] == list(alone)
+
+
+class TestActionSemantics:
+    def test_after_every_max(self):
+        plan = chaos.FaultPlan().add("p", "delay", secs=0.0, after=2,
+                                     every=3, max_count=2)
+        inj = chaos.ChaosInjector(plan)
+        fired = [bool(inj.decide("p", "w")) for _ in range(12)]
+        # skip 2, then every 3rd considered, capped at 2 hits
+        assert fired == [False, False, True, False, False, True,
+                         False, False, False, False, False, False]
+
+    def test_where_glob_gates_firing(self):
+        plan = chaos.FaultPlan().add("p", "delay", secs=0.0,
+                                     where="hostB:*")
+        inj = chaos.ChaosInjector(plan)
+        assert inj.inject("p", where="hostA:0") is None
+        assert not inj.schedule
+        inj.inject("p", where="hostB:1")
+        assert len(inj.schedule) == 1
+
+    def test_drop_is_a_connection_error(self):
+        inj = chaos.ChaosInjector(chaos.FaultPlan().add("p", "drop"))
+        with pytest.raises(ConnectionError):
+            inj.inject("p", where="w")
+        assert counters.get("chaos.drop") == 1
+
+    def test_delay_sleeps(self):
+        inj = chaos.ChaosInjector(
+            chaos.FaultPlan().add("p", "delay", secs=0.15))
+        t0 = time.monotonic()
+        assert inj.inject("p", where="w") is None
+        assert time.monotonic() - t0 >= 0.14
+
+    def test_dup_and_flap_are_returned_to_caller(self):
+        inj = chaos.ChaosInjector(chaos.FaultPlan()
+                                  .add("p.dup", "dup")
+                                  .add("p.flap", "flap"))
+        assert inj.inject("p.dup", where="w") == "dup"
+        assert inj.inject("p.flap", where="w") == "flap"
+
+    def test_env_activation(self, monkeypatch):
+        plan = chaos.FaultPlan(seed=5).add("p", "delay", secs=0.0)
+        for k, v in plan.to_env().items():
+            monkeypatch.setenv(k, v)
+        chaos.reset()  # re-arm env discovery
+        assert chaos.enabled()
+        chaos.inject("p")
+        assert counters.get("chaos.delay") == 1
+
+
+class _CountingService(network.BasicService):
+    def __init__(self, key):
+        super().__init__("counting service", key)
+        self.handled = 0
+
+    def _handle(self, req, client_address):
+        self.handled += 1
+        return super()._handle(req, client_address)
+
+
+@pytest.fixture()
+def rpc_pair():
+    key = secret.make_secret_key()
+    service = _CountingService(key)
+    try:
+        yield service, key
+    finally:
+        service.shutdown()
+
+
+class TestRpcUnderChaos:
+    """The hardened BasicClient retry path driven by injected faults —
+    the RPC-drop leg of the recovery demonstration."""
+
+    def _client(self, service, key, **kw):
+        kw.setdefault("attempts", 4)
+        kw.setdefault("timeout", 5.0)
+        return network.BasicClient("counting service", "127.0.0.1",
+                                   service.port, key, **kw)
+
+    def test_client_send_drops_are_retried(self, rpc_pair):
+        service, key = rpc_pair
+        chaos.configure(chaos.FaultPlan().add(
+            "network.client.send", "drop", max_count=2))
+        resp = self._client(service, key).ping()
+        assert isinstance(resp, network.PingResponse)
+        assert counters.get("chaos.drop") == 2
+        assert counters.get("rpc.client.retry") == 2
+        assert counters.get("rpc.client.failure") == 0
+
+    def test_server_side_drop_is_survived(self, rpc_pair):
+        service, key = rpc_pair
+        chaos.configure(chaos.FaultPlan().add(
+            "network.server.handle", "drop", max_count=1))
+        resp = self._client(service, key).ping()
+        assert isinstance(resp, network.PingResponse)
+        # the dropped request never reached _handle; the retry did
+        assert service.handled == 1
+        assert counters.get("rpc.client.retry") >= 1
+
+    def test_duplicate_delivery(self, rpc_pair):
+        service, key = rpc_pair
+        chaos.configure(chaos.FaultPlan().add(
+            "network.client.send", "dup", max_count=1))
+        resp = self._client(service, key).ping()
+        assert isinstance(resp, network.PingResponse)
+        assert service.handled == 2  # idempotent service: both answered
+
+    def test_exhausted_retries_name_service_and_attempts(self):
+        port = network.find_free_port()  # nothing listening
+        client = network.BasicClient("doomed service", "127.0.0.1", port,
+                                     b"k" * 32, attempts=2, timeout=0.5)
+        with pytest.raises(ConnectionError) as err:
+            client.ping()
+        msg = str(err.value)
+        assert "doomed service" in msg
+        assert f"127.0.0.1:{port}" in msg
+        assert "2 attempt(s)" in msg
+        assert counters.get("rpc.client.failure") == 1
+
+    def test_deadline_budget_caps_attempts(self):
+        port = network.find_free_port()
+        client = network.BasicClient("budgeted service", "127.0.0.1", port,
+                                     b"k" * 32, attempts=1000, timeout=0.5,
+                                     total_deadline=0.5)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            client.ping()
+        # Bounded by the budget, not by 1000 connection attempts.
+        assert time.monotonic() - t0 < 5.0
+
+    def test_backoff_spaces_out_retries(self, rpc_pair, monkeypatch):
+        service, key = rpc_pair
+        monkeypatch.setenv("HOROVOD_RPC_RETRY_BASE_SECS", "0.1")
+        chaos.configure(chaos.FaultPlan().add(
+            "network.client.send", "drop", max_count=2))
+        t0 = time.monotonic()
+        self._client(service, key).ping()
+        # two backoff sleeps, each >= 0.5 * base * 2^i: >= 0.05 + 0.1
+        assert time.monotonic() - t0 >= 0.1
+
+
+class TestDiscoveryFlap:
+    def test_flap_empties_then_recovers(self):
+        from horovod_tpu.elastic.discovery import (FixedHosts, HostManager,
+                                                   HostUpdateResult)
+
+        chaos.configure(chaos.FaultPlan().add(
+            "discovery.update", "flap", after=1, max_count=1))
+        mgr = HostManager(FixedHosts({"a": 2}))
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        # injected flap: world transiently empty
+        assert mgr.update_available_hosts() == HostUpdateResult.removed
+        assert mgr.current_hosts == {}
+        # next poll sees the real host set again
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        assert mgr.current_hosts == {"a": 2}
+        assert counters.get("chaos.flap") == 1
+
+
+class TestCrashSubprocess:
+    def test_crash_kills_process_with_exit_code(self, tmp_path):
+        """crash must be a hard os._exit — no unwind, no atexit."""
+        import subprocess
+        import sys
+
+        plan = chaos.FaultPlan().add("p", "crash", exit_code=3)
+        env = dict(os.environ)
+        env.update(plan.to_env())
+        env["PYTHONPATH"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        code = (
+            "import atexit, sys\n"
+            "atexit.register(lambda: print('ATEXIT RAN'))\n"
+            "from horovod_tpu import chaos\n"
+            "chaos.inject('p')\n"
+            "print('SURVIVED')\n"
+        )
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 3
+        assert "SURVIVED" not in proc.stdout
+        assert "ATEXIT RAN" not in proc.stdout

@@ -66,6 +66,51 @@ class TestHostManager:
         assert disc.find_available_hosts_and_slots() == {"h1": 2, "h2": 4}
 
 
+class TestBlacklistCooldown:
+    def test_default_blacklist_is_forever(self):
+        mgr = HostManager(MutableDiscovery({"a": 1, "b": 1}))
+        mgr.update_available_hosts()
+        mgr.blacklist("b")
+        time.sleep(0.1)
+        assert mgr.is_blacklisted("b")
+        assert mgr.update_available_hosts() == HostUpdateResult.no_update
+        assert mgr.current_hosts == {"a": 1}
+
+    def test_readmission_after_cooldown_expiry(self):
+        mgr = HostManager(MutableDiscovery({"a": 1, "b": 1}),
+                          cooldown_secs=0.2)
+        mgr.update_available_hosts()
+        mgr.blacklist("b")
+        assert mgr.is_blacklisted("b")
+        assert mgr.current_hosts == {"a": 1}
+        assert mgr.update_available_hosts() == HostUpdateResult.no_update
+        time.sleep(0.25)
+        assert not mgr.is_blacklisted("b")
+        # the diff must report the re-admitted host as ADDED even though
+        # the raw discovery result never changed — that's what makes the
+        # driver build a world that includes it again
+        assert mgr.update_available_hosts() == HostUpdateResult.added
+        assert mgr.current_hosts == {"a": 1, "b": 1}
+
+    def test_reblacklist_rearms_the_timer(self):
+        mgr = HostManager(MutableDiscovery({"a": 1}), cooldown_secs=0.3)
+        mgr.blacklist("a")
+        time.sleep(0.2)
+        mgr.blacklist("a")  # failed again: fresh cooldown
+        time.sleep(0.15)    # 0.35s after first, 0.15s after second
+        assert mgr.is_blacklisted("a")
+        time.sleep(0.2)
+        assert not mgr.is_blacklisted("a")
+
+    def test_cooldown_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_SECS", "0.2")
+        mgr = HostManager(MutableDiscovery({"a": 1}))
+        mgr.blacklist("a")
+        assert mgr.is_blacklisted("a")
+        time.sleep(0.25)
+        assert not mgr.is_blacklisted("a")
+
+
 def _wait(predicate, timeout=10.0, msg="condition"):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -419,6 +464,74 @@ class TestGetSlotProtocol:
             resp = driver.get_slot_info("a", 1, min_world_id=0)
             assert resp.status == "ok"
             assert resp.controller_port == 22222
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+
+class TestStallWatchdog:
+    def test_formation_stall_warns_then_abandons_incarnation(self):
+        """Enforces the --stall-check-* contract: a slot that never
+        reaches rendezvous first draws a warning, then (past the shutdown
+        threshold) its host is blacklisted and the driver resumes into a
+        new world without it."""
+        from horovod_tpu.common import counters
+
+        counters.reset_all()
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 1, "b": 1}), min_np=1,
+                               max_np=2, stall_warn_secs=0.3,
+                               stall_shutdown_secs=0.8)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 2, msg="spawn")
+            # only a's worker rendezvouses; b's is 'hung' before init
+            resp = driver.get_slot_info("a", 0, min_world_id=0)
+            assert resp.status == "ok"
+            _wait(lambda: counters.get("elastic.stall.warning") >= 1,
+                  timeout=5, msg="stall warning")
+            assert not driver.host_manager.is_blacklisted("b")  # warn only
+            _wait(lambda: counters.get("elastic.stall.shutdown") >= 1,
+                  timeout=5, msg="stall shutdown")
+            _wait(lambda: driver.host_manager.is_blacklisted("b"),
+                  msg="stalled host blacklisted")
+            _wait(lambda: driver.world_id == 1, msg="new incarnation")
+            assert {s.hostname for s in driver.current_assignments()} \
+                == {"a"}
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_no_watchdog_when_disabled(self):
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 1}), min_np=1,
+                               stall_check_disable=True,
+                               stall_warn_secs=0.1,
+                               stall_shutdown_secs=0.2)
+        try:
+            driver.start(workers)
+            assert driver._stall_thread is None
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+
+    def test_formed_world_does_not_trip_the_watchdog(self):
+        """Once every slot is ready the formation watchdog goes quiet —
+        in-step stalls are the native stall inspector's job."""
+        from horovod_tpu.common import counters
+
+        counters.reset_all()
+        workers = RecordingWorkers()
+        driver = ElasticDriver(FixedHosts({"a": 1}), min_np=1,
+                               stall_warn_secs=0.2,
+                               stall_shutdown_secs=0.4)
+        try:
+            driver.start(workers)
+            _wait(lambda: len(workers.spawned) == 1, msg="spawn")
+            assert driver.get_slot_info("a", 0).status == "ok"
+            time.sleep(0.6)  # well past both thresholds
+            assert counters.get("elastic.stall.warning") == 0
+            assert counters.get("elastic.stall.shutdown") == 0
         finally:
             driver.stop()
             driver.shutdown_service()
