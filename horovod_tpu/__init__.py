@@ -94,6 +94,8 @@ from .ops.collective_ops import (  # noqa: F401
     grouped_allreduce,
     join,
     poll,
+    quantized_allreduce,
+    record_wire_stats,
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
@@ -113,7 +115,10 @@ from .ops.softmax_xent import (  # noqa: F401
     linear_cross_entropy,
     lm_head_loss,
 )
-from .parallel.optimizer import DistributedOptimizer  # noqa: F401
+from .parallel.optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    QuantizedEFState,
+)
 from .parallel.sequence import (  # noqa: F401
     dense_attention,
     ring_attention,

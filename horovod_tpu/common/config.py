@@ -68,6 +68,11 @@ class Config:
     hierarchical_allreduce: bool = False
     hierarchical_allgather: bool = False
 
+    # --- quantized allreduce (no reference analogue; EQuARX-style int8
+    #     wire on the DCN hop of the hierarchical decomposition) ---
+    quantized_allreduce: bool = False
+    quant_block: int = 256  # elements per int8 scale block
+
     # --- autotune (common.h:68-73) ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -118,6 +123,8 @@ def from_env() -> Config:
         cache_capacity=_env_int("HOROVOD_CACHE_CAPACITY", 1024),
         hierarchical_allreduce=_env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE", False),
         hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER", False),
+        quantized_allreduce=_env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False),
+        quant_block=_env_int("HOROVOD_QUANT_BLOCK", 256),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),
         autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
         autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

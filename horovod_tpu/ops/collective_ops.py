@@ -31,6 +31,7 @@ nccl_operations.cc:190-380) decomposes into intra-host ``psum_scatter`` (ICI)
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import threading
 from typing import Optional, Sequence, Tuple
@@ -43,6 +44,7 @@ from jax import lax
 from ..common import basics
 from ..common.basics import CROSS_AXIS, HVD_AXES, LOCAL_AXIS
 from ..common.exceptions import DuplicateTensorNameError
+from . import compression as _compression
 from .compression import Compression
 
 
@@ -122,6 +124,76 @@ def _scale(tensor, factor):
     return tensor * jnp.asarray(factor, dtype=tensor.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Wire-byte accounting (the bench A/B instrumentation).
+#
+# Collectives are traced once per compile, so accounting at trace time gives
+# exact static per-step byte counts with zero runtime cost. The cost model is
+# per-device bytes SENT under ring/topology-aware schedules: reduce-scatter
+# or all-gather of n elements over k ranks moves n*(k-1)/k, a full allreduce
+# 2*n*(k-1)/k; a flat psum over both Horovod axes is modeled as XLA's
+# topology-aware decomposition (ICI leg on the full payload, DCN leg on the
+# 1/local_size shard). ``dcn_bytes_fp`` tracks what the SAME traffic pattern
+# would cost at the payload's uncompressed dtype, so
+# ``dcn_bytes_fp / dcn_bytes`` is the wire-representation reduction of the
+# quantized path (EQuARX's "~4x wire bytes" accounting).
+# ---------------------------------------------------------------------------
+
+
+class WireStats:
+    """Accumulated per-device wire bytes for one traced program."""
+
+    def __init__(self) -> None:
+        self.ici_bytes = 0.0
+        self.dcn_bytes = 0.0
+        self.dcn_bytes_fp = 0.0
+
+    @property
+    def dcn_reduction(self) -> Optional[float]:
+        """fp-equivalent / actual bytes on the DCN hop (None if no DCN)."""
+        return (self.dcn_bytes_fp / self.dcn_bytes) if self.dcn_bytes else None
+
+
+_wire_recorders: list = []
+
+
+@contextlib.contextmanager
+def record_wire_stats():
+    """Record wire bytes of every collective traced inside the context.
+    Trace-time only: wrap ``jit(...).lower(...)`` (or the first call), not
+    the steady-state execution loop."""
+    ws = WireStats()
+    _wire_recorders.append(ws)
+    try:
+        yield ws
+    finally:
+        _wire_recorders.remove(ws)
+
+
+def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
+    for ws in _wire_recorders:
+        if kind == "dcn":
+            ws.dcn_bytes += wire_bytes
+            ws.dcn_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        else:
+            ws.ici_bytes += wire_bytes
+
+
+def _acct_psum(x, axes) -> None:
+    """Account a flat psum over ``axes`` with the topology-aware model."""
+    if not _wire_recorders:
+        return
+    n = float(np.prod(x.shape)) if x.ndim else 1.0
+    isz = jnp.dtype(x.dtype).itemsize
+    if LOCAL_AXIS in axes:
+        nl = lax.axis_size(LOCAL_AXIS)
+        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
+        n /= nl
+    if CROSS_AXIS in axes:
+        nc = lax.axis_size(CROSS_AXIS)
+        _acct("dcn", 2.0 * n * (nc - 1) / nc * isz)
+
+
 def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
     """Hierarchical allreduce: intra-host reduce-scatter → cross-host
     allreduce → intra-host allgather (reference algorithm:
@@ -130,6 +202,13 @@ def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
     root reduce/bcast remainder leg at nccl_operations.cc:244-307)."""
     nl = lax.axis_size(local_axis)
     if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
+        if _wire_recorders:
+            n = float(np.prod(x.shape))
+            isz = jnp.dtype(x.dtype).itemsize
+            nc = lax.axis_size(cross_axis)
+            _acct("ici", n * (nl - 1) / nl * isz)        # psum_scatter
+            _acct("dcn", 2.0 * (n / nl) * (nc - 1) / nc * isz)  # cross psum
+            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)  # gather-leg psum
         shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
         shard = lax.psum(shard, cross_axis)
         # Final allgather leg, expressed as a psum of disjointly-placed
@@ -146,7 +225,129 @@ def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
         full = lax.dynamic_update_slice_in_dim(
             full, shard, li * shard.shape[0], 0)
         return lax.psum(full, local_axis)
+    _acct_psum(x, (cross_axis, local_axis))
     return lax.psum(x, (cross_axis, local_axis))
+
+
+def _quant_block_size(block: Optional[int]) -> int:
+    if block:
+        return int(block)
+    if basics.is_initialized():
+        return basics.config().quant_block
+    return _compression.QUANT_BLOCK
+
+
+def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
+                    local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
+    """Quantized hierarchical allreduce-SUM with optional error feedback.
+
+    The EQuARX decomposition placed per HiCCL's rule — compress the slow
+    (cross-host/DCN) hop only, never the fast (ICI) one:
+
+    1. intra-host reduce-scatter (ICI, payload dtype);
+    2. cross-host quantized reduce-scatter (DCN): each rank quantizes its
+       whole shard to int8 with one fp32 scale per ``block`` elements, a
+       tiled ``all_to_all`` moves int8 + scales, receivers
+       dequantize-accumulate in fp32;
+    3. cross-host quantized all-gather (DCN): the reduced segment is
+       requantized and re-broadcast as a masked int8 psum — each rank
+       contributes its segment into a zeroed shard buffer, so the sum is
+       exact (disjoint support) and the result is replicated BY
+       CONSTRUCTION in the VMA model (the repo's broadcast idiom; a plain
+       ``all_gather`` would leave a device-varying mark that poisons
+       ``out_specs=P()`` consumers);
+    4. intra-host all-gather (ICI, payload dtype, psum-of-disjoint as in
+       :func:`_psum_hierarchical`).
+
+    Returns ``(sum, new_residual)``. With ``residual`` (error feedback),
+    the residual is added to ``x`` before hop 1 and the returned residual
+    holds this rank's quantization error — hop 2's error on the whole
+    shard it contributed plus hop 3's requantization error on the segment
+    it owns — written at the exact buffer positions where the next step's
+    reduce-scatter re-collects each component exactly once.
+
+    Falls back to an exact flat psum (consuming the residual, returning it
+    as zeros) when there is no cross axis or the flattened size does not
+    shard evenly over ``local_size * cross_size``.
+    """
+    nl = lax.axis_size(local_axis)
+    nc = lax.axis_size(cross_axis)
+    blk = _quant_block_size(block)
+    corrected = x if residual is None else x + residual.astype(x.dtype)
+    n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 0
+    if nc == 1 or n == 0 or n % nl or (n // nl) % nc:
+        axes = (cross_axis, local_axis)
+        _acct_psum(corrected, axes)
+        out = lax.psum(corrected, axes)
+        return out, (None if residual is None else jnp.zeros_like(residual))
+
+    flat = jnp.ravel(corrected)
+    sn = n // nl        # shard elements per device after the ICI leg
+    seg = sn // nc      # segment elements per cross rank within a shard
+    isz = jnp.dtype(x.dtype).itemsize
+    if _wire_recorders:
+        pad_n = ((-seg) % blk + seg) * nc  # padded shard elements
+        q_unit = pad_n + (pad_n // blk) * 4.0  # int8 payload + fp32 scales
+        _acct("ici", n * (nl - 1) / nl * isz)              # psum_scatter
+        _acct("dcn", q_unit * (nc - 1) / nc,               # hop-2 all_to_all
+              float(sn) * (nc - 1) / nc * isz)
+        _acct("dcn", 2.0 * q_unit * (nc - 1) / nc,         # hop-3 masked psum
+              2.0 * float(sn) * (nc - 1) / nc * isz)
+        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)        # ICI gather leg
+
+    # Hop 1 — ICI reduce-scatter in the payload dtype.
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                             tiled=True)
+
+    # Hop 2 — quantized DCN reduce-scatter (all_to_all of int8 + scales).
+    segs = shard.reshape(nc, seg).astype(jnp.float32)
+    pad = (-seg) % blk
+    if pad:
+        segs = jnp.concatenate(
+            [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
+    nb = segs.shape[1] // blk
+    blocks = segs.reshape(nc, nb, blk)
+    scales = _compression._block_scales(blocks)            # [nc, nb]
+    q = jnp.clip(jnp.round(blocks / scales[..., None]),
+                 -127, 127).astype(jnp.int8)
+    err1 = blocks - q.astype(jnp.float32) * scales[..., None]
+    qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)  # [nb, blk]
+
+    # Hop 3 — requantize the reduced segment; masked int8 psum gathers the
+    # shard with replication by construction (disjoint segment support).
+    s2 = _compression._block_scales(acc)                   # [nb]
+    q2 = jnp.clip(jnp.round(acc / s2[:, None]), -127, 127).astype(jnp.int8)
+    err2 = acc - q2.astype(jnp.float32) * s2[:, None]
+    ci = lax.axis_index(cross_axis)
+    qfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
+    sfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb), jnp.float32), s2[None], ci, 0)
+    qg = lax.psum(qfull, cross_axis)
+    sg = lax.psum(sfull, cross_axis)
+    shard_red = (qg.astype(jnp.float32) * sg[..., None]).reshape(
+        nc, nb * blk)[:, :seg].reshape(sn).astype(x.dtype)
+
+    # Hop 4 — ICI gather leg (psum of disjointly-placed shards).
+    li = lax.axis_index(local_axis)
+    full = jnp.zeros((n,), x.dtype)
+    full = lax.dynamic_update_slice_in_dim(full, shard_red, li * sn, 0)
+    out = lax.psum(full, local_axis).reshape(x.shape)
+    if residual is None:
+        return out, None
+
+    # Error feedback: hop-2 error on every segment this rank contributed,
+    # plus hop-3's requantization error on the one segment it owns.
+    rows = jnp.arange(nc)[:, None, None]
+    err_all = err1 + jnp.where(rows == ci, err2[None], 0.0)
+    err_sh = err_all.reshape(nc, nb * blk)[:, :seg].reshape(sn)
+    res_full = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((n,), jnp.float32), err_sh, li * sn, 0)
+    return out, res_full.reshape(x.shape).astype(residual.dtype)
 
 
 def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],
@@ -192,6 +393,7 @@ def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...], hierarchical: bool):
         if hierarchical and set(axes) == set(HVD_AXES):
             red = _psum_hierarchical(x)
         else:
+            _acct_psum(x, axes)
             red = lax.psum(x, axes)
         if op == ReduceOp.AVERAGE:
             n = _world_size(axes)
@@ -213,6 +415,15 @@ def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...], hierarchical: bool):
     raise ValueError(f"unsupported reduce op {op}")
 
 
+def _resolve_quantized(quantized: Optional[bool], compression) -> bool:
+    """Per-call arg > quantized compressor > HOROVOD_QUANTIZED_ALLREDUCE."""
+    if quantized is not None:
+        return bool(quantized)
+    if getattr(compression, "is_quantized", False):
+        return True
+    return basics.is_initialized() and basics.config().quantized_allreduce
+
+
 def allreduce(
     tensor,
     *,
@@ -223,6 +434,7 @@ def allreduce(
     name: Optional[str] = None,
     axes=None,
     hierarchical: Optional[bool] = None,
+    quantized: Optional[bool] = None,
     _presummed: bool = False,
 ):
     """Allreduce ``tensor`` across all ranks.
@@ -233,26 +445,106 @@ def allreduce(
     ``compression`` casts to a 16-bit wire format around the reduction
     (prefer ``Compression.bf16`` on TPU).
 
+    ``quantized`` (default: ``HOROVOD_QUANTIZED_ALLREDUCE``, or implied by
+    ``compression=Compression.int8``) sends blockwise-scaled int8 on the
+    DCN hop of the hierarchical reduce-scatter/all-gather decomposition —
+    see :func:`_psum_quantized`; ICI legs keep the payload dtype. For
+    error-feedback accumulation use :func:`quantized_allreduce`. With the
+    knob off (the default) this path is bit-identical to the unquantized
+    implementation.
+
     If ``tensor`` is provably replicated across the requested mesh axes
     (VMA-invariant), no collective is emitted — see
     :func:`_reduce_replicated`. ``_presummed`` is set by the gradient paths
     (optimizer/tape) to mark that an invariant input is an autodiff-summed
     gradient rather than an equal per-rank contribution.
     """
+    out, _ = _allreduce_impl(
+        tensor, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        name=name, axes=axes, hierarchical=hierarchical,
+        quantized=quantized, residual=None, _presummed=_presummed)
+    return out
+
+
+def quantized_allreduce(
+    tensor,
+    residual=None,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    compression=Compression.none,
+    name: Optional[str] = None,
+    axes=None,
+    block: Optional[int] = None,
+):
+    """Quantized allreduce with explicit error-feedback state.
+
+    Returns ``(reduced, new_residual)``. ``residual`` is the error-feedback
+    accumulator from the previous step (same shape as ``tensor``; pass
+    zeros initially): it is added to the payload before the wire and the
+    returned residual carries this rank's quantization error into the next
+    step, which keeps SGD/Adam convergence at full-precision quality while
+    the wire moves ~4x fewer DCN bytes. With ``residual=None`` the error is
+    dropped (stateless quantization) and the second return value is None.
+
+    The residual lives in the *transmitted* space — post ``prescale``, post
+    ``compression`` cast, pre reduction — so keep those settings constant
+    across steps. On exact paths (no cross axis, non-shardable size, eager
+    world of one) the residual is still consumed and returns as zeros.
+    """
+    return _allreduce_impl(
+        tensor, op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        name=name, axes=axes, hierarchical=None, quantized=True,
+        residual=residual, block=block, _presummed=False)
+
+
+def _allreduce_impl(
+    tensor,
+    *,
+    op: ReduceOp,
+    prescale_factor: float,
+    postscale_factor: float,
+    compression,
+    name: Optional[str],
+    axes,
+    hierarchical: Optional[bool],
+    quantized: Optional[bool],
+    residual,
+    block: Optional[int] = None,
+    _presummed: bool = False,
+):
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
+    quantized = _resolve_quantized(quantized, compression)
+    # Quantization is defined for float sum/average reductions only; other
+    # ops (min/max/product/adasum) always ride the exact wire.
+    quantized = (quantized and jnp.issubdtype(tensor.dtype, jnp.floating)
+                 and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
     if op == ReduceOp.ADASUM and not (
             axes_t and _is_replicated(tensor, axes_t)):
         from . import adasum as _adasum
 
         return _adasum.adasum_allreduce(
             tensor, axes=axes, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor, compression=compression)
+            postscale_factor=postscale_factor,
+            compression=compression), residual
 
     tensor = _scale(tensor, prescale_factor)
-    compressed, ctx = compression.compress(tensor)
+    # A quantized compressor is not a wire cast: the int8 layout happens
+    # inside the collective (real path) or as a local fake-quant round trip
+    # (fallback paths) — never through compress() on the real path, where
+    # it would double-quantize.
+    real_quant_cast = getattr(compression, "is_quantized", False)
+    compressed, ctx = ((tensor, None) if real_quant_cast
+                       else compression.compress(tensor))
+    new_residual = residual
     if axes_t:
         if _is_replicated(compressed, axes_t):
+            # No wire, no quantization error; the residual passes through
+            # untouched (it is zero on this path by construction).
             red = _reduce_replicated(compressed, op, axes_t, _presummed)
         else:
             # Partially replicated (varying on a strict subset of the
@@ -263,20 +555,61 @@ def allreduce(
             missing = tuple(sorted(set(axes_t) - _vma(compressed)))
             if missing and _vma(compressed):
                 compressed = lax.pcast(compressed, missing, to="varying")
-            if hierarchical is None:
-                hierarchical = (
-                    basics.is_initialized()
-                    and basics.config().hierarchical_allreduce
-                )
-            red = _reduce_in_jit(compressed, op, axes_t, bool(hierarchical))
+            if (quantized and set(axes_t) == set(HVD_AXES)
+                    and op in (ReduceOp.SUM, ReduceOp.AVERAGE)):
+                red, new_residual = _psum_quantized(
+                    compressed, residual=residual, block=block)
+                if op == ReduceOp.AVERAGE:
+                    n = _world_size(axes_t)
+                    red = red / jnp.asarray(n, dtype=red.dtype)
+            else:
+                if quantized and real_quant_cast:
+                    # Quantization requested but the reduction doesn't
+                    # decompose over (cross, local): fake-quant the
+                    # contribution so numerics still match the quantized
+                    # semantics; the wire stays full-width.
+                    if residual is not None:
+                        compressed = compressed + residual.astype(
+                            compressed.dtype)
+                    wire = _compression.fake_quantize_int8(
+                        compressed, _quant_block_size(block))
+                    if residual is not None:
+                        new_residual = (compressed - wire).astype(
+                            residual.dtype)
+                    compressed = wire
+                elif residual is not None:
+                    # Exact wire: consume the residual, nothing left over.
+                    compressed = compressed + residual.astype(
+                        compressed.dtype)
+                    new_residual = jnp.zeros_like(residual)
+                if hierarchical is None:
+                    hierarchical = (
+                        basics.is_initialized()
+                        and basics.config().hierarchical_allreduce
+                    )
+                red = _reduce_in_jit(compressed, op, axes_t,
+                                     bool(hierarchical))
     else:
         if hierarchical is not None:
             raise ValueError(
                 "allreduce(hierarchical=...) is only supported in-jit; set "
                 "HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
+        if quantized:
+            # Eager path: the native core reduces full-width dtypes, so the
+            # quantization is applied as a local fake-quant of this rank's
+            # contribution — identical numerics to the compiled hop-2
+            # contribution, full-width bytes (the byte savings are a
+            # compiled-path feature).
+            if residual is not None:
+                compressed = compressed + residual.astype(compressed.dtype)
+            wire = _compression.fake_quantize_int8(
+                compressed, _quant_block_size(block))
+            if residual is not None:
+                new_residual = (compressed - wire).astype(residual.dtype)
+            compressed = wire
         red = _eager_allreduce(compressed, op, name)
     red = compression.decompress(red, ctx)
-    return _scale(red, postscale_factor)
+    return _scale(red, postscale_factor), new_residual
 
 
 def grouped_allreduce(tensors: Sequence, **kwargs):

@@ -7,11 +7,76 @@ TPU note: bfloat16 is the MXU-native 16-bit format — it keeps fp32's exponent
 range, so unlike fp16 it needs no loss scaling and reduces over ICI at half
 the bandwidth of fp32. ``Compression.fp16`` is kept for API parity and maps
 to IEEE float16; prefer ``Compression.bf16`` on TPU.
+
+Beyond the reference's dtype casts, ``Compression.int8`` provides
+blockwise-scaled int8 quantization (EQuARX-style: one fp32 scale per
+``QUANT_BLOCK``-element block, values in [-127, 127]). Inside the compiled
+hierarchical allreduce it rides the wire as real int8 + scales on the
+cross-host (DCN) hop (see ``collective_ops._psum_quantized``); everywhere
+else — eager path, partial-axis reductions — ``compress`` degrades to a
+local quantize→dequantize round trip ("fake quant"), which preserves the
+numerics of a quantized contribution without needing an int8-aware wire
+reduction. The quantization *primitives* here are pure jnp so the fusion,
+collective, and test layers all share one definition of the format.
 """
 
 from __future__ import annotations
 
+from typing import Optional, Tuple
+
 import jax.numpy as jnp
+
+# Elements per quantization scale block. 256 = 4 x FUSION_BUFFER_ATOMIC_UNIT
+# (fusion.ATOMIC_UNIT = 64), so fused-bucket padding keeps whole blocks
+# meaningful; non-multiple tails are zero-padded inside quantize_int8.
+QUANT_BLOCK = 256
+
+
+def _block_scales(blocks: jnp.ndarray) -> jnp.ndarray:
+    """Per-block positive scale: absmax/127, with absmax==0 mapped to 1 so
+    all-zero blocks quantize to exact zeros instead of 0/0."""
+    absmax = jnp.max(jnp.abs(blocks), axis=-1)
+    return jnp.where(absmax > 0, absmax / 127.0, jnp.ones_like(absmax))
+
+
+def quantize_int8(
+    tensor, block: int = QUANT_BLOCK
+) -> Tuple[jnp.ndarray, jnp.ndarray, Tuple]:
+    """Blockwise int8 quantization of a float tensor.
+
+    Flattens ``tensor``, zero-pads to a multiple of ``block``, and emits
+    ``(q, scales, meta)``: ``q`` int8 ``[n_blocks, block]``, ``scales``
+    float32 ``[n_blocks]`` (absmax/127 per block), and ``meta`` carrying
+    the original shape/dtype for :func:`dequantize_int8`. Round-trip error
+    is bounded per element by ``scales[b] / 2`` (round-to-nearest).
+    """
+    tensor = jnp.asarray(tensor)
+    shape, dtype = tensor.shape, tensor.dtype
+    flat = jnp.ravel(tensor).astype(jnp.float32)
+    n = flat.shape[0]
+    pad = (-n) % block
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    blocks = flat.reshape(-1, block)
+    scales = _block_scales(blocks)
+    q = jnp.clip(jnp.round(blocks / scales[:, None]), -127, 127)
+    return q.astype(jnp.int8), scales, (shape, dtype, n)
+
+
+def dequantize_int8(q, scales, meta) -> jnp.ndarray:
+    """Inverse of :func:`quantize_int8` (up to the bounded rounding error):
+    fp32 multiply-accumulate, then the original shape and dtype."""
+    shape, dtype, n = meta
+    flat = (q.astype(jnp.float32) * scales[:, None]).reshape(-1)[:n]
+    return flat.reshape(shape).astype(dtype)
+
+
+def fake_quantize_int8(tensor, block: int = QUANT_BLOCK) -> jnp.ndarray:
+    """Quantize→dequantize round trip in the original dtype: the value a
+    quantized wire contribution carries, without the int8 layout. This is
+    what hop-1 of the real quantized collective transmits, so eager-path
+    semantics match the compiled path contribution-for-contribution."""
+    return dequantize_int8(*quantize_int8(tensor, block))
 
 
 class Compressor:
@@ -69,9 +134,42 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class QuantizedCompressor(Compressor):
+    """Blockwise-scaled int8 wire format (``Compression.int8``).
+
+    Unlike the cast compressors, int8 blocks with per-block scales are NOT
+    closed under addition, so the generic compress/decompress slot cannot
+    hand an int8 payload to a sum-reduction. ``compress`` therefore returns
+    the fake-quantized value in the original dtype — exactly the
+    contribution hop-1 of the real quantized collective transmits — and
+    ``allreduce`` routes quantized compression to the real int8
+    reduce-scatter/all-gather wire (``collective_ops._psum_quantized``)
+    whenever it is tracing over the full (cross, local) mesh. Pair with
+    error feedback (``quantized_allreduce(residual=...)`` or
+    ``DistributedOptimizer(quantized=True)``) to carry the quantization
+    error into the next step's gradient.
+    """
+
+    is_quantized = True
+    block: Optional[int] = None  # None -> QUANT_BLOCK / HOROVOD_QUANT_BLOCK
+
+    @classmethod
+    def compress(cls, tensor):
+        tensor = jnp.asarray(tensor)
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return fake_quantize_int8(tensor, cls.block or QUANT_BLOCK), None
+        return tensor, None  # ints/bools pass through, like the cast path
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor
+
+
 class Compression:
-    """Namespace mirroring the reference's ``hvd.Compression``."""
+    """Namespace mirroring the reference's ``hvd.Compression`` (plus the
+    TPU-native additions ``bf16`` and ``int8``)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = QuantizedCompressor

@@ -134,6 +134,8 @@ def allreduce_pytree(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     presummed: bool = False,
+    quantized: Optional[bool] = None,
+    error_feedback=None,
 ):
     """Allreduce every leaf of a pytree with tensor fusion.
 
@@ -149,12 +151,32 @@ def allreduce_pytree(
     contributions); the gradient paths (DistributedOptimizer, tape) pass
     ``presummed=True`` because shard_map autodiff auto-psums gradients of
     replicated parameters. Only genuinely per-rank leaves are packed into
-    fused buffers and reduced on the wire."""
+    fused buffers and reduced on the wire.
+
+    ``quantized`` routes each fused bucket through the blockwise-int8 DCN
+    wire (:func:`collective_ops._psum_quantized`); bucket padding to
+    ``ATOMIC_UNIT`` keeps the per-block scales aligned with the shard
+    layout. ``error_feedback`` is a pytree of per-rank residual
+    accumulators matching ``tree`` (zeros initially); when given, the
+    return value becomes ``(reduced_tree, new_error_feedback)`` — residuals
+    are packed with the same bucket plan as the gradients, so each bucket
+    carries its quantization error into the next step (EF-SGD). Non-float
+    and replicated leaves pass their residual through unchanged (it stays
+    zero)."""
     leaves, treedef = jax.tree.flatten(tree)
+    if error_feedback is not None:
+        quantized = True if quantized is None else quantized
+        ef_leaves = jax.tree.flatten(error_feedback)[0]
+        if len(ef_leaves) != len(leaves):
+            raise ValueError(
+                "error_feedback tree structure does not match the gradient "
+                f"tree ({len(ef_leaves)} vs {len(leaves)} leaves)")
     if not leaves:
-        return tree
+        return tree if error_feedback is None else (tree, error_feedback)
     axes_t = C._resolve_axes(axes)
     out: List[Optional[jax.Array]] = [None] * len(leaves)
+    new_ef: List[Optional[jax.Array]] = (
+        None if error_feedback is None else list(ef_leaves))
 
     varying_idx: List[int] = []
     for i, leaf in enumerate(leaves):
@@ -162,19 +184,36 @@ def allreduce_pytree(
             out[i] = C.allreduce(
                 leaf, op=op, compression=compression, axes=axes,
                 hierarchical=hierarchical, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor, _presummed=presummed)
+                postscale_factor=postscale_factor, quantized=quantized,
+                _presummed=presummed)
         else:
             varying_idx.append(i)
 
     if varying_idx:
         vleaves = [leaves[i] for i in varying_idx]
+        v_ef = (None if new_ef is None
+                else [ef_leaves[i] for i in varying_idx])
         buckets = plan_buckets(vleaves, threshold_bytes)
         for bucket in buckets:
             buf = pack(bucket, vleaves)
-            red = C.allreduce(
-                buf, op=op, compression=compression, axes=axes,
-                hierarchical=hierarchical, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor)
+            if (new_ef is not None
+                    and jnp.issubdtype(bucket.dtype, jnp.floating)):
+                rbuf = pack(bucket, v_ef)
+                red, rnew = C.quantized_allreduce(
+                    buf, rbuf, op=op, compression=compression, axes=axes,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+                for j, r in zip(bucket.leaf_indices, unpack(bucket, rnew)):
+                    new_ef[varying_idx[j]] = r
+            else:
+                red = C.allreduce(
+                    buf, op=op, compression=compression, axes=axes,
+                    hierarchical=hierarchical,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor, quantized=quantized)
             for j, leaf in zip(bucket.leaf_indices, unpack(bucket, red)):
                 out[varying_idx[j]] = leaf
-    return jax.tree.unflatten(treedef, out)
+    result = jax.tree.unflatten(treedef, out)
+    if error_feedback is None:
+        return result
+    return result, jax.tree.unflatten(treedef, new_ef)

@@ -23,13 +23,36 @@ locally for k microbatches and allreduced once, via ``optax.MultiSteps``.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, NamedTuple, Optional
 
+import jax
+import jax.numpy as jnp
 import optax
 
+from ..common import basics
+from ..common.config import _env_bool
 from ..ops import collective_ops as C
 from ..ops import fusion
 from ..ops.compression import Compression
+
+
+class QuantizedEFState(NamedTuple):
+    """Optimizer state of a quantized ``DistributedOptimizer``.
+
+    ``inner`` is the wrapped transformation's state. ``residual`` is the
+    error-feedback accumulator: a pytree matching the parameters whose
+    leaves carry a leading **per-rank axis** — each rank's residual is
+    rank-local state (every EF-SGD formulation keeps it per worker), so
+    under ``jax.shard_map`` the leaves must ride ``P(hvd.HVD_AXES)``
+    in/out specs (shape ``[world, *param_shape]`` outside the trace, this
+    rank's ``[1, *param_shape]`` slice inside), not the replicated ``P()``
+    of the inner state. A spec prefix of
+    ``QuantizedEFState(P(), hvd.data_pspec())`` does exactly that — see
+    ``bench.py --quantized`` for the worked example.
+    """
+
+    inner: Any
+    residual: Any
 
 
 def DistributedOptimizer(
@@ -41,6 +64,7 @@ def DistributedOptimizer(
     gradient_predivide_factor: float = 1.0,
     fusion_threshold_bytes: Optional[int] = None,
     hierarchical: Optional[bool] = None,
+    quantized: Optional[bool] = None,
     axes=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with fused gradient allreduce.
@@ -51,6 +75,17 @@ def DistributedOptimizer(
     (local accumulation), ``gradient_predivide_factor`` (split the averaging
     divisor across pre/post scaling: prescale = 1/f applied before the sum,
     postscale = f/N after — tensorflow/__init__.py:462-476).
+
+    ``quantized`` (default: the ``HOROVOD_QUANTIZED_ALLREDUCE`` knob) moves
+    each fused gradient bucket over the blockwise-int8 DCN wire with
+    per-bucket error feedback: the state becomes a
+    :class:`QuantizedEFState` wrapping the inner state plus a per-rank
+    residual pytree, and each step's quantization error is carried into
+    the next step's gradient, keeping convergence at full-precision
+    quality. Only meaningful when the gradients reaching ``update`` are
+    per-rank locals (e.g. via ``hvd.value_and_grad(..., reduce=False)``);
+    auto-psummed replicated gradients never touch the wire, so there is
+    nothing to quantize.
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -58,6 +93,10 @@ def DistributedOptimizer(
             "(reference: tensorflow/__init__.py:452-455)")
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    if quantized is None:
+        quantized = (basics.config().quantized_allreduce
+                     if basics.is_initialized()
+                     else _env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False))
 
     if gradient_predivide_factor != 1.0:
         # Average == Sum with the divisor split across pre/post scaling.
@@ -71,7 +110,7 @@ def DistributedOptimizer(
         reduce_op = op
         postscale_mode = None
 
-    def _allreduce(grads):
+    def _allreduce(grads, error_feedback=None):
         postscale = 1.0
         if postscale_mode == "predivide":
             axes_t = C._resolve_axes(axes)
@@ -87,14 +126,43 @@ def DistributedOptimizer(
             prescale_factor=prescale,
             postscale_factor=postscale,
             presummed=True,  # invariant grads are autodiff-psummed sums
+            quantized=quantized,
+            error_feedback=error_feedback,
         )
 
+    def _res_read(residual):
+        """Strip the per-rank leading axis: in-trace each rank's shard is
+        its ``[1, ...]`` slice; eagerly row ``rank()`` of the full stack."""
+        r = 0 if C._hvd_axes_in_trace() else (
+            basics.rank() if basics.is_initialized() else 0)
+        return jax.tree.map(lambda a: a[r], residual)
+
+    def _res_write(residual, new_local):
+        if C._hvd_axes_in_trace():
+            return jax.tree.map(lambda a: a[None], new_local)
+        r = basics.rank() if basics.is_initialized() else 0
+        return jax.tree.map(lambda a, v: a.at[r].set(v), residual, new_local)
+
     def init_fn(params):
-        return optimizer.init(params)
+        inner = optimizer.init(params)
+        if not quantized:
+            return inner
+        world = basics.size() if basics.is_initialized() else 1
+        residual = jax.tree.map(
+            lambda p: jnp.zeros((world,) + jnp.shape(p), jnp.asarray(p).dtype),
+            params)
+        return QuantizedEFState(inner=inner, residual=residual)
 
     def update_fn(grads, state, params=None, **extra):
-        reduced = _allreduce(grads)
-        return optimizer.update(reduced, state, params, **extra)
+        if not quantized:
+            reduced = _allreduce(grads)
+            return optimizer.update(reduced, state, params, **extra)
+        reduced, new_res = _allreduce(grads, _res_read(state.residual))
+        updates, new_inner = optimizer.update(
+            reduced, state.inner, params, **extra)
+        return updates, QuantizedEFState(
+            inner=new_inner,
+            residual=_res_write(state.residual, new_res))
 
     tx = optax.GradientTransformationExtraArgs(init_fn, update_fn)
     if backward_passes_per_step > 1:

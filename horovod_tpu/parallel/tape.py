@@ -45,15 +45,25 @@ def allreduce_gradients(
     fusion_threshold_bytes: Optional[int] = None,
     axes=None,
     hierarchical: Optional[bool] = None,
+    quantized: Optional[bool] = None,
+    error_feedback=None,
 ):
     """Allreduce a gradient pytree (reference: _make_allreduce_grads_fn,
     tensorflow/__init__.py:246-278). Fused into per-dtype buckets;
     ``presummed=True`` because invariant gradient leaves under shard_map are
-    autodiff-psummed sums, not equal per-rank contributions."""
+    autodiff-psummed sums, not equal per-rank contributions.
+
+    ``quantized`` selects the blockwise-int8 DCN wire per bucket;
+    ``error_feedback`` (a pytree of per-rank residuals matching ``grads``,
+    zeros initially) switches the return value to
+    ``(reduced, new_error_feedback)`` so callers can thread EF state
+    functionally — :class:`horovod_tpu.DistributedOptimizer` does this
+    inside its optax state instead."""
     return fusion.allreduce_pytree(
         grads, op=op, compression=compression,
         threshold_bytes=fusion_threshold_bytes, axes=axes,
-        hierarchical=hierarchical, presummed=True)
+        hierarchical=hierarchical, presummed=True,
+        quantized=quantized, error_feedback=error_feedback)
 
 
 def value_and_grad(
@@ -66,11 +76,20 @@ def value_and_grad(
     fusion_threshold_bytes: Optional[int] = None,
     axes=None,
     hierarchical: Optional[bool] = None,
+    quantized: Optional[bool] = None,
+    reduce: bool = True,
     **jax_kwargs,
 ):
     """``jax.value_and_grad`` whose gradients are allreduced across ranks —
     the DistributedGradientTape of the JAX world
-    (reference: tensorflow/__init__.py:511-576)."""
+    (reference: tensorflow/__init__.py:511-576).
+
+    ``reduce=False`` still pvaries the differentiated arguments (so the
+    gradients come back as true per-rank locals instead of auto-psummed
+    fp32 sums) but skips the allreduce — the hand-off point for callers
+    that let :class:`~horovod_tpu.DistributedOptimizer` own the reduction,
+    e.g. to keep error-feedback state in the optimizer when
+    ``quantized=True``."""
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux,
                             **jax_kwargs)
     idxs = (argnums,) if isinstance(argnums, int) else tuple(argnums)
@@ -82,10 +101,12 @@ def value_and_grad(
             for i in idxs:
                 args[i] = _pvary_tree(args[i], axes_t)
         val, grads = vg(*args, **kwargs)
+        if not reduce:
+            return val, grads
         grads = allreduce_gradients(
             grads, op=op, compression=compression,
             fusion_threshold_bytes=fusion_threshold_bytes, axes=axes,
-            hierarchical=hierarchical)
+            hierarchical=hierarchical, quantized=quantized)
         return val, grads
 
     return wrapped

@@ -10,12 +10,10 @@ fire ``allreduce_async_`` as gradients become ready during ``backward()``;
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import os
 
 import torch
 
-from ..common import basics
-from ..common.config import _env_bool
 from ..common.exceptions import NotInitializedError
 from .compression import Compression
 from . import mpi_ops
@@ -73,7 +71,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         # (examples/pytorch_elastic.py), so a construction-time world
         # check must tolerate the uninitialized state — and an elastic
         # world that starts at 1 can grow, so hooks must exist anyway.
-        elastic = _env_bool("HOROVOD_ELASTIC", False)
+        # Strictly == "1", matching both the reference check and the
+        # launcher contract (elastic/launcher.py:30, spark, ray, and
+        # config_parser all export exactly "1"): a truthy-but-nonstandard
+        # value like "true" must not diverge this gate from the other
+        # HOROVOD_ELASTIC consumers (docs/troubleshooting.md).
+        elastic = os.environ.get("HOROVOD_ELASTIC") == "1"
         try:
             world = mpi_ops._world()
         except NotInitializedError:

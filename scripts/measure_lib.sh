@@ -36,6 +36,9 @@ EOF
 MEASURE_MISSED=0
 
 run() {
+  # Declared local so legs can't leak state into each other (or into the
+  # sourcing script) through these helper variables.
+  local budget name rc
   budget=$1; name=$2; shift 2
   if [ "${MEASURE_RESUME:-0}" = 1 ] && [ -e "$OUT/$name.done" ]; then
     echo "--- $name already measured ($OUT/$name.done); resume skips it"
@@ -74,6 +77,7 @@ run() {
 # first-use sweep under a budget sized for a hit (timeout -> possible
 # relay wedge).
 run_if_done() {
+  local prior
   prior=$1; shift
   if [ ! -e "$OUT/$prior.done" ]; then
     echo "--- $2 SKIPPED (prerequisite $prior not measured)"

@@ -1,0 +1,79 @@
+"""Worker for multi-process quantized-allreduce correctness.
+
+Run under the launcher env contract (HOROVOD_RANK/SIZE + controller
+address) with HOROVOD_QUANTIZED_ALLREDUCE=1. On the eager (host) path the
+native core reduces full-width dtypes, so quantization is applied as a
+local fake-quant of each rank's contribution — every rank can therefore
+compute the exact expected result from the deterministic per-rank payloads
+and assert bit-level agreement with the quantized-semantics model.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.ops.compression import fake_quantize_int8  # noqa: E402
+
+
+def rank_payload(r, n=700):
+    # Deterministic per-rank data every rank can reconstruct.
+    return np.random.RandomState(100 + r).randn(n).astype(np.float32)
+
+
+def main():
+    rank = int(os.environ["HOROVOD_RANK"])
+    size = int(os.environ["HOROVOD_SIZE"])
+    hvd.init()
+    assert hvd.is_initialized()
+    # The env knob must land in the typed config.
+    from horovod_tpu.common import basics
+
+    assert basics.config().quantized_allreduce, "env knob not picked up"
+
+    mine = jnp.asarray(rank_payload(rank))
+    expect = np.mean(
+        [np.asarray(fake_quantize_int8(jnp.asarray(rank_payload(r))))
+         for r in range(size)], axis=0)
+
+    # Knob-driven quantization (no per-call arg): hvd.allreduce resolves
+    # quantized=None from HOROVOD_QUANTIZED_ALLREDUCE.
+    out = hvd.allreduce(mine, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6, atol=1e-6)
+
+    # Explicit API with error feedback: residual == corrected - transmitted.
+    res = jnp.zeros_like(mine)
+    out2, res2 = hvd.quantized_allreduce(mine, res, op=hvd.Average)
+    np.testing.assert_allclose(np.asarray(out2), expect, rtol=1e-6,
+                               atol=1e-6)
+    want_res = np.asarray(mine) - np.asarray(fake_quantize_int8(mine))
+    np.testing.assert_allclose(np.asarray(res2), want_res, rtol=1e-6,
+                               atol=1e-6)
+    # Second step carries the residual: the transmitted value is
+    # fake_quant(grad + residual).
+    out3, res3 = hvd.quantized_allreduce(mine, res2, op=hvd.Average)
+    corrected = np.asarray(mine) + np.asarray(res2)
+    sent = np.asarray(fake_quantize_int8(jnp.asarray(corrected)))
+    np.testing.assert_allclose(np.asarray(res3), corrected - sent,
+                               rtol=1e-6, atol=1e-6)
+
+    # Default-off contract: quantized=False must bypass quantization even
+    # with the env knob set.
+    exact = hvd.allreduce(mine, op=hvd.Average, quantized=False)
+    want_exact = np.mean([rank_payload(r) for r in range(size)], axis=0)
+    np.testing.assert_allclose(np.asarray(exact), want_exact, rtol=1e-6,
+                               atol=1e-6)
+
+    print(f"quantized_worker rank {rank}/{size} OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -475,7 +475,11 @@ class TestScheduleMemory:
         stages, rest = pp_split_blocks(params, hvd.size())
         mesh = hvd.mesh()
 
-        def gpipe_loss(stages, rest):
+        # tokens/targets are explicit arguments (not closure constants) so
+        # both programs lower with the same parameter layout — a closed-over
+        # batch would be baked into the GPipe executable as constants and
+        # skew the temp-memory comparison.
+        def gpipe_loss(stages, rest, tok, tgt):
             def spmd(stg, rst, tok, tgt):
                 local = jax.tree.map(lambda a: a[0], stg)
                 return pipelined_gpt_loss(cfg, local, rst, tok, tgt,
@@ -485,7 +489,7 @@ class TestScheduleMemory:
             return jax.shard_map(
                 spmd, mesh=mesh,
                 in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
-                out_specs=P())(stages, rest, tokens, targets)
+                out_specs=P())(stages, rest, tok, tgt)
 
         def spmd_1f1b(stg, rst, tok, tgt):
             local = jax.tree.map(lambda a: a[0], stg)
@@ -496,7 +500,7 @@ class TestScheduleMemory:
 
         gpipe_c = jax.jit(
             jax.value_and_grad(gpipe_loss, argnums=(0, 1))).lower(
-            stages, rest).compile()
+            stages, rest, tokens, targets).compile()
         f1b1_c = jax.jit(jax.shard_map(
             spmd_1f1b, mesh=mesh,
             in_specs=(P(hvd.HVD_AXES), P(), P(), P()),

@@ -111,6 +111,31 @@ def test_linear_cross_entropy_lowers_for_tpu(real_kernels, N, V, C):
     _lower_tpu(f, x, w, y)
 
 
+def test_quantized_allreduce_lowers_for_tpu():
+    """The quantized collective path AOT-lowers for the tpu platform: the
+    int8 all_to_all (hop 2), the masked int8 psum (hop 3), and the
+    round/clip/convert quantize math must all have TPU lowerings — checked
+    here, one round before hardware (the round-5 fused-LN lesson)."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), hvd.HVD_AXES)
+
+    def f(x, r):
+        def spmd(v, res):
+            out, nr = hvd.quantized_allreduce(v[0], res[0], op=hvd.Sum)
+            return out, nr[None]
+
+        return jax.shard_map(spmd, mesh=mesh,
+                             in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
+                             out_specs=(P(), P(hvd.HVD_AXES)))(x, r)
+
+    x = jnp.zeros((8, 1024), jnp.float32)
+    _lower_tpu(f, x, x)
+
+
 def test_fused_ln_gpt_block_lowers_for_tpu(real_kernels):
     """The composition that actually failed on hardware: a fused-LN GPT
     block's full fwd+bwd (flash attention + ln_residual together)."""
