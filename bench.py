@@ -270,33 +270,20 @@ def summarize_profile(log_dir: str, top: int = 15) -> None:
         log(f"  {us / 1e3:9.2f} ms  {100 * us / max(total, 1):5.1f}%  {name}")
 
 
-def run_once(args, devices, platform, *, quantized=False, mesh_shape=None):
-    """One full measurement on ``devices``: init the world, build the
-    model + DistributedOptimizer step, compile, warm up, time, and return
-    the result row (no JSON printing — the caller owns the one-line
-    contract). Calls ``hvd.shutdown()`` first so scaling sweeps can re-init
-    over growing device subsets.
-
-    ``quantized`` selects the int8 DCN wire with error feedback in the
-    DistributedOptimizer; ``mesh_shape=(cross, local)`` emulates a
-    multi-host topology (a real DCN hop) on a single host. Under
-    ``--quantized`` both A/B legs run the reduce-in-optimizer step
-    structure so the comparison is like-for-like."""
+def build_workload(args, global_batch):
+    """Model, synthetic data, and loss for one measurement leg — shared
+    between :func:`run_once` and the ``--autotune`` tuning session (every
+    autotune trial recompiles the SAME workload, so tuned params transfer
+    to the measured legs by construction). Returns a dict with ``params``,
+    ``batch_stats``, ``images``, ``labels``, ``loss_fn`` and, for GPT,
+    the model ``gpt_cfg`` (analytic-FLOPs inputs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    import horovod_tpu as hvd
-
-    hvd.shutdown()  # no-op unless a previous sweep world is up
-    hvd.init(devices=devices, mesh_shape=mesh_shape)
-    n_chips = hvd.size()
-    global_batch = args.batch_size * n_chips
-    log(f"world={n_chips} global_batch={global_batch} platform={platform}")
 
     rng = jax.random.PRNGKey(0)
+    gpt_cfg = None
     if args.model == "gpt":
         from horovod_tpu.models import GPT, GPTConfig
 
@@ -356,11 +343,52 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None):
                 logits, yb).mean()
             return loss, new_vars["batch_stats"]
 
+    if args.model == "gpt":
+        gpt_cfg = cfg
+    return {"params": params, "batch_stats": batch_stats,
+            "images": images, "labels": labels, "loss_fn": loss_fn,
+            "gpt_cfg": gpt_cfg}
+
+
+def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
+             tuned_params=None):
+    """One full measurement on ``devices``: init the world, build the
+    model + DistributedOptimizer step, compile, warm up, time, and return
+    the result row (no JSON printing — the caller owns the one-line
+    contract). Calls ``hvd.shutdown()`` first so scaling sweeps can re-init
+    over growing device subsets.
+
+    ``quantized`` selects the int8 DCN wire with error feedback in the
+    DistributedOptimizer; ``mesh_shape=(cross, local)`` emulates a
+    multi-host topology (a real DCN hop) on a single host. Under
+    ``--quantized`` both A/B legs run the reduce-in-optimizer step
+    structure so the comparison is like-for-like. ``tuned_params`` (the
+    frozen winner of an autotune session) overrides the collective
+    tunables for this leg — the ``--autotune`` A/B measures its value."""
+    import jax
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()  # no-op unless a previous sweep world is up
+    hvd.init(devices=devices, mesh_shape=mesh_shape)
+    n_chips = hvd.size()
+    global_batch = args.batch_size * n_chips
+    log(f"world={n_chips} global_batch={global_batch} platform={platform}")
+
+    wl = build_workload(args, global_batch)
+    params, batch_stats = wl["params"], wl["batch_stats"]
+    images, labels = wl["images"], wl["labels"]
+    loss_fn, cfg = wl["loss_fn"], wl["gpt_cfg"]
+
     compression = (hvd.Compression.bf16 if args.fp16_allreduce
                    else hvd.Compression.none)
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
                                   compression=compression,
-                                  quantized=quantized)
+                                  quantized=quantized,
+                                  tuned_params=tuned_params)
     opt_state = tx.init(params)
 
     mesh = hvd.mesh()
@@ -384,11 +412,14 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None):
     images = jax.device_put(images, data_sh)
     labels = jax.device_put(labels, data_sh)
 
-    # Under --quantized (either A/B leg) the optimizer owns the gradient
-    # reduction: reduce=False keeps the raw gradients per-rank locals so
-    # the fused (and, on the quantized leg, int8+error-feedback) bucket
-    # wire inside tx.update is the one and only gradient collective.
-    reduce_in_optimizer = bool(args.quantized)
+    # Under --quantized or --autotune (any leg) the optimizer owns the
+    # gradient reduction: reduce=False keeps the raw gradients per-rank
+    # locals so the fused (and, on the quantized leg, int8+error-feedback)
+    # bucket wire inside tx.update is the one and only gradient collective
+    # — the wire the autotuner's fusion/hierarchical knobs actually steer
+    # (auto-psummed replicated grads never touch the fusion path).
+    reduce_in_optimizer = bool(args.quantized
+                               or getattr(args, "autotune", False))
 
     def spmd(p, bs, s, xb, yb):
         (loss, nbs), grads = hvd.value_and_grad(
@@ -544,6 +575,69 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None):
     }
 
 
+def run_autotune_session(args, devices, platform, mesh_shape):
+    """Run the online Bayesian tuning session on the real bench workload
+    (``hvd.autotune_session``; each trial recompiles the step with a
+    candidate TunedParams and times a scoring window). Returns the
+    AutotuneResult whose ``.params`` the tuned A/B leg measures."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=mesh_shape)
+    n_chips = hvd.size()
+    global_batch = args.batch_size * n_chips
+    log(f"autotune session: world={n_chips} global_batch={global_batch}")
+    wl = build_workload(args, global_batch)
+    loss_fn = wl["loss_fn"]
+    compression = (hvd.Compression.bf16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    mesh = hvd.mesh()
+    rep = NamedSharding(mesh, P())
+    data_sh = hvd.data_sharding()
+    images = jax.device_put(wl["images"], data_sh)
+    labels = jax.device_put(wl["labels"], data_sh)
+
+    def make_step(tuned):
+        tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
+                                      compression=compression,
+                                      tuned_params=tuned)
+        state = {
+            "params": jax.device_put(wl["params"], rep),
+            "bs": jax.device_put(wl["batch_stats"], rep),
+            "opt": jax.device_put(tx.init(wl["params"]), rep),
+        }
+
+        # Same reduce-in-optimizer structure as the measured legs: the
+        # fused bucket wire inside tx.update is the gradient collective
+        # the tunables steer.
+        def spmd(p, bs, s, xb, yb):
+            (loss, nbs), grads = hvd.value_and_grad(
+                loss_fn, has_aux=True, reduce=False)(p, bs, xb, yb)
+            nbs = hvd.allreduce_pytree(nbs, op=hvd.Average)
+            updates, ns = tx.update(grads, s, p)
+            return optax.apply_updates(p, updates), nbs, ns, \
+                hvd.allreduce(loss)
+
+        train = jax.jit(jax.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(), P(), hvd.data_pspec(), hvd.data_pspec()),
+            out_specs=(P(), P(), P(), P())))
+
+        def step():
+            state["params"], state["bs"], state["opt"], loss = train(
+                state["params"], state["bs"], state["opt"], images, labels)
+            return loss
+
+        return step
+
+    return hvd.autotune_session(
+        make_step, cache_key=wl["params"], enabled=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", choices=["resnet50", "resnet18", "gpt"],
@@ -616,6 +710,14 @@ def main():
                          "feedback in the optimizer): runs a baseline leg "
                          "and a quantized leg over the same step structure "
                          "and reports wire-bytes and throughput deltas")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run the online Bayesian tuning session "
+                         "(hvd.autotune_session: GP/EI over fusion "
+                         "threshold + hierarchical allreduce, recompile "
+                         "per trial, warm-start cache), then A/B the "
+                         "frozen winner against the default knobs; the "
+                         "JSON line carries tuned_params + the trial "
+                         "history")
     ap.add_argument("--mesh-shape", default=None, metavar="CROSSxLOCAL",
                     help="emulate a multi-host (cross, local) topology, "
                          "e.g. 2x4 — gives the collectives a real DCN "
@@ -659,10 +761,13 @@ def main():
                      f"got {args.scaling!r}")
         if not sweep or sweep[0] < 1:
             ap.error("--scaling sizes must be >= 1")
-        if args.quantized or args.mesh_shape:
+        if args.quantized or args.mesh_shape or args.autotune:
             ap.error("--scaling cannot combine with --quantized/"
-                     "--mesh-shape (the sweep re-shapes the world per "
-                     "size)")
+                     "--mesh-shape/--autotune (the sweep re-shapes the "
+                     "world per size)")
+    if args.autotune and (args.quantized or args.profile):
+        ap.error("--autotune cannot combine with --quantized/--profile "
+                 "(one A/B structure per run)")
 
     mesh_shape = None
     if args.mesh_shape:
@@ -712,13 +817,15 @@ def main():
             len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
                          f"does not cover {len(devices)} devices")
-    if args.quantized and mesh_shape is None and len(devices) % 2 == 0 \
-            and len(devices) >= 2:
-        # A DCN (cross) hop is what the quantization compresses; emulate a
-        # 2-host topology unless the user pinned one.
+    if (args.quantized or args.autotune) and mesh_shape is None \
+            and len(devices) % 2 == 0 and len(devices) >= 2:
+        # A DCN (cross) hop is what quantization compresses and what the
+        # hierarchical-allreduce knob decomposes; emulate a 2-host
+        # topology unless the user pinned one.
         mesh_shape = (2, len(devices) // 2)
-        log(f"--quantized: emulating mesh_shape {mesh_shape} so the "
-            f"collectives have a cross (DCN) hop")
+        log(f"--{'quantized' if args.quantized else 'autotune'}: "
+            f"emulating mesh_shape {mesh_shape} so the collectives have "
+            f"a cross (DCN) hop")
 
     metric_stem = (f"gpt{args.gpt_scale}" if args.model == "gpt"
                    else args.model)
@@ -773,6 +880,51 @@ def main():
 
     metric = (f"{metric_stem}_tokens_per_sec_per_chip" if args.model == "gpt"
               else f"{metric_stem}_images_per_sec_per_chip")
+
+    if args.autotune:
+        # Tuning session first, then A/B: default knobs vs the frozen
+        # winner over the identical step structure. Baseline first so a
+        # tuned-path failure still leaves a reference number in the log.
+        result = run_autotune_session(args, devices, platform, mesh_shape)
+        tuned = result.params
+        log(f"=== A/B leg 1/2: default knobs ===")
+        res_d = run_once(args, devices, platform, mesh_shape=mesh_shape)
+        log(f"=== A/B leg 2/2: tuned {tuned.as_dict()} ===")
+        res_t = run_once(args, devices, platform, mesh_shape=mesh_shape,
+                         tuned_params=tuned)
+        delta = res_t["per_chip"] / res_d["per_chip"] - 1.0
+        log(f"A/B: default {res_d['per_chip']:.1f} vs tuned "
+            f"{res_t['per_chip']:.1f} {res_d['unit']} "
+            f"({100 * delta:+.1f}%)"
+            + (" [warm-start cache hit: trials skipped]"
+               if result.cache_hit else
+               f" after {result.samples} scored trials"))
+        print(json.dumps({
+            "metric": metric,
+            "value": round(res_t["per_chip"], 2),
+            "unit": res_t["unit"],
+            "vs_baseline": None,
+            "mfu": (round(res_t["mfu"], 4)
+                    if res_t["mfu"] is not None else None),
+            "step_ms_median": round(res_t["step_ms_median"], 3),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "chips": res_t["chips"],
+            "per_chip_batch": args.batch_size,
+            "autotune": True,
+            "autotune_cache_hit": result.cache_hit,
+            "autotune_samples": result.samples,
+            "tuned_params": tuned.as_dict(),
+            "trial_history": [
+                {**p.as_dict(), "score_steps_per_sec": round(s, 4)}
+                for p, s in result.history],
+            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                           if mesh_shape else None),
+            "baseline_per_chip": round(res_d["per_chip"], 2),
+            "throughput_delta": round(delta, 4),
+            **gpt_fields,
+        }), flush=True)
+        return
 
     if args.quantized:
         # A/B: identical step structure (reduce-in-optimizer), identical

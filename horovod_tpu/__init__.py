@@ -43,6 +43,7 @@ API surface parity map (reference file → here):
   horovod.tensorflow                  → tensorflow/ (ops, tape, optimizer)
   horovod.keras / tensorflow.keras    → keras/, _keras/, tensorflow/keras/
   horovod.mxnet                       → mxnet/ (gated: MXNet is EOL)
+  parameter_manager + optim/ (GP/BO)  → autotune/ (hvd.autotune_session)
   (no reference analogue)             → parallel/sequence.py (ring/Ulysses
                                         attention), ops/flash_attention.py
                                         (Pallas flash kernel), models/gpt.py
@@ -152,6 +153,11 @@ from .parallel.tape import (  # noqa: F401
     value_and_grad,
 )
 from .common.basics import fault_counters  # noqa: F401
+from .autotune import (  # noqa: F401
+    AutotuneResult,
+    TunedParams,
+    autotune_session,
+)
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
 from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)

@@ -1,0 +1,44 @@
+"""Online Bayesian autotuner for the collective tunables.
+
+Reference: ``horovod/common/parameter_manager.{h,cc}`` + the Gaussian
+process / Bayesian optimization under ``horovod/common/optim/`` — the
+coordinator scores each sample window and proposes the next knob setting
+by expected improvement, then freezes on the best configuration (this
+repo's native eager counterpart is ``cc/src/parameter_manager.cc`` +
+``gp.cc``).
+
+TPU-native redesign
+-------------------
+On the compiled path the reference's runtime knob flips do not exist:
+bucket plans and collective decompositions are fixed at **trace time**
+(ops/fusion.py docstring), so changing a tunable means recompiling the
+step. The autotuner therefore runs as an explicit *tuning session*
+(:func:`horovod_tpu.autotune_session`): each trial builds the step with a
+:class:`TunedParams` override, times a scoring window of real training
+steps, feeds the wall-clock score to the same GP/EI proposal loop as the
+reference, and freezes on the winner. Compile cost is amortized by a
+warm-start cache keyed on (model-tree-hash, mesh shape, world size) —
+a rerun of the same job skips straight to the frozen winner.
+
+Tunables (the knobs that matter on TPU, ISSUE 3):
+
+* ``fusion_threshold_bytes`` — bucket size, 1–256 MiB, log-space;
+* ``quant_block`` — int8 scale-block elements, 64–1024, log-space,
+  searched only when the quantized wire is on;
+* ``hierarchical_allreduce`` — explicit ICI/DCN decomposition vs the
+  flat psum XLA decomposes itself.
+"""
+
+from .gp import GaussianProcess  # noqa: F401
+from .parameter_manager import (  # noqa: F401
+    ParameterManager,
+    TunedParams,
+    read_log,
+)
+from .driver import (  # noqa: F401
+    AutotuneResult,
+    autotune_session,
+    cache_key_for,
+    load_cached_params,
+    sessions_run,
+)

@@ -1,0 +1,278 @@
+"""The tuning-session driver loop: recompile-per-trial + warm-start cache.
+
+Reference: the coordinator's per-cycle ``parameter_manager.Update`` hook
+(operations.cc:614-621) — there, new knob values apply between cycles at
+zero cost. On the compiled path every knob is baked into the traced
+program (bucket plans are trace-time, ops/fusion.py), so a trial is a
+**recompile**: :func:`autotune_session` asks the caller to rebuild its
+step for each :class:`TunedParams` proposal, times a scoring window of
+real steps, and feeds wall-clock step rate to the
+:class:`~.parameter_manager.ParameterManager`.
+
+Recompiles dominate session cost, so the frozen winner is persisted to
+the shared autotune cache (``HOROVOD_AUTOTUNE_CACHE``, one JSON file with
+the Pallas block-size entries of ops/kernel_autotune.py) keyed on
+(model-tree-hash, mesh shape, world size): a rerun of the same job skips
+every trial and compiles once, straight at the winner.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..common import basics
+from .parameter_manager import ParameterManager, TunedParams
+
+log = logging.getLogger("horovod_tpu.autotune")
+
+# Cache-entry schema version; bump when TunedParams gains/changes knobs.
+_CACHE_VERSION = 1
+
+# Process-lifetime session counter — hvd.shutdown() warns when
+# HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
+# silent no-op on the compiled path; see docs/autotune.md).
+_sessions_run = [0]
+
+
+def sessions_run() -> int:
+    """How many tuning sessions (including cache hits) this process ran."""
+    return _sessions_run[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class AutotuneResult:
+    """What a tuning session produced.
+
+    ``params`` is the frozen winner (feed it back as the
+    ``tuned_params=`` override of :class:`horovod_tpu.DistributedOptimizer`
+    / :func:`horovod_tpu.allreduce_pytree`). ``history`` is the scored
+    trial list in order; empty on a warm-start ``cache_hit``.
+    """
+
+    params: TunedParams
+    history: Tuple[Tuple[TunedParams, float], ...] = ()
+    cache_hit: bool = False
+    best_score: Optional[float] = None
+
+    @property
+    def samples(self) -> int:
+        return len(self.history)
+
+
+def cache_key_for(tree, mesh=None) -> str:
+    """Warm-start cache key: (model-tree-hash, mesh shape, world size).
+
+    ``tree`` is any pytree whose *structure and leaf shapes/dtypes*
+    identify the workload (pass the parameter tree); values never enter
+    the hash, so a checkpoint restore keys the same as a fresh init. The
+    bucket plan is a pure function of leaf order/shape/dtype
+    (ops/fusion.py plan_buckets is deterministic), which is exactly what
+    makes this key sound.
+    """
+    import jax
+
+    if isinstance(tree, str):
+        sig = tree
+    else:
+        leaves, treedef = jax.tree.flatten(tree)
+        parts = [str(treedef)]
+        for leaf in leaves:
+            parts.append(f"{jax.numpy.shape(leaf)}:"
+                         f"{jax.numpy.asarray(leaf).dtype}")
+        sig = hashlib.md5("|".join(parts).encode()).hexdigest()
+    if mesh is None and basics.is_initialized():
+        mesh = basics.mesh()
+    shape = ("x".join(str(s) for s in mesh.devices.shape)
+             if mesh is not None else "nomesh")
+    world = basics.size() if basics.is_initialized() else 1
+    return f"collective_tune|{sig}|mesh{shape}|world{world}" \
+           f"|v{_CACHE_VERSION}"
+
+
+def load_cached_params(key: str) -> Optional[TunedParams]:
+    """The frozen winner cached under ``key``, or None."""
+    from ..ops import kernel_autotune
+
+    entry = kernel_autotune.cache_lookup(key)
+    if not isinstance(entry, dict) or "params" not in entry:
+        return None
+    try:
+        return TunedParams.from_dict(entry["params"])
+    except (KeyError, TypeError, ValueError):
+        return None  # stale/foreign entry: tune fresh rather than crash
+
+
+def _store_cached_params(key: str, params: TunedParams, *,
+                         score: float, samples: int) -> None:
+    from ..ops import kernel_autotune
+
+    kernel_autotune.cache_store(key, {
+        "params": params.as_dict(),
+        "score_steps_per_sec": score,
+        "samples": samples,
+    })
+
+
+def _timeline_instant(name: str, args: dict) -> None:
+    tl = basics._state.timeline if basics.is_initialized() else None
+    if tl is not None:
+        tl.instant(name, tid="autotune", args=args)
+
+
+def autotune_session(
+    make_step: Callable[[TunedParams], Callable[[], object]],
+    *,
+    cache_key=None,
+    initial: Optional[TunedParams] = None,
+    enabled: Optional[bool] = None,
+    tune_quant_block: Optional[bool] = None,
+    tune_hierarchical: bool = True,
+    warmup_samples: Optional[int] = None,
+    steps_per_sample: Optional[int] = None,
+    max_samples: Optional[int] = None,
+    gp_noise: Optional[float] = None,
+    log_path: Optional[str] = None,
+    use_cache: bool = True,
+    seed: int = 0x9E3779B97F4A7C15,
+) -> AutotuneResult:
+    """Run an online tuning session and return the frozen winner.
+
+    ``make_step(tuned)`` must build (and implicitly compile) the training
+    step with the :class:`TunedParams` override applied — thread ``tuned``
+    into ``DistributedOptimizer(tuned_params=...)`` or
+    ``allreduce_pytree(tuned_params=...)`` — and return a zero-argument
+    callable that advances ONE real training step (owning its state in a
+    closure) and returns that step's outputs, which the driver blocks on
+    for wall-clock timing. It is called once per trial; each call is a
+    retrace.
+
+    Knob defaults come from :func:`horovod_tpu.init`'s Config
+    (``HOROVOD_AUTOTUNE_WARMUP_SAMPLES`` / ``_STEPS_PER_SAMPLE`` /
+    ``_BAYES_OPT_MAX_SAMPLES`` / ``_GAUSSIAN_PROCESS_NOISE`` /
+    ``_LOG``); explicit arguments override. ``enabled`` defaults to the
+    ``HOROVOD_AUTOTUNE`` knob: with it off the session is a no-op that
+    returns the initial (hand-set) parameters untouched, keeping the
+    default path bit-identical.
+
+    ``cache_key`` (a pytree — pass the parameter tree — or a string)
+    activates the warm-start cache: a prior frozen winner for the same
+    (model, mesh, world) returns immediately with ``cache_hit=True`` and
+    zero trials; a fresh session persists its winner on convergence.
+    ``use_cache=False`` forces re-tuning (the winner still overwrites the
+    cache entry).
+    """
+    import jax
+
+    cfg = basics.config() if basics.is_initialized() else None
+    if enabled is None:
+        enabled = bool(cfg.autotune) if cfg is not None else False
+    if initial is None:
+        initial = (TunedParams.from_config(cfg) if cfg is not None
+                   else TunedParams())
+    if not enabled:
+        log.info("autotune_session: HOROVOD_AUTOTUNE is off — returning "
+                 "the configured parameters untuned")
+        return AutotuneResult(params=initial)
+    _sessions_run[0] += 1
+    if tune_quant_block is None:
+        tune_quant_block = bool(cfg.quantized_allreduce) if cfg else False
+    if warmup_samples is None:
+        warmup_samples = cfg.autotune_warmup_samples if cfg else 3
+    if steps_per_sample is None:
+        steps_per_sample = cfg.autotune_steps_per_sample if cfg else 10
+    if max_samples is None:
+        max_samples = cfg.autotune_bayes_opt_max_samples if cfg else 20
+    if gp_noise is None:
+        gp_noise = cfg.autotune_gaussian_process_noise if cfg else 0.8
+    if log_path is None:
+        log_path = cfg.autotune_log if cfg else None
+
+    key = cache_key_for(cache_key) if cache_key is not None else None
+    if key is not None and use_cache:
+        cached = load_cached_params(key)
+        if cached is not None:
+            log.warning(
+                "horovod_tpu autotune: warm-start cache hit (%s) — "
+                "skipping trials, compiling straight at fusion_threshold="
+                "%d quant_block=%d hierarchical=%s", key,
+                cached.fusion_threshold_bytes, cached.quant_block,
+                cached.hierarchical_allreduce)
+            _timeline_instant("AUTOTUNE:CACHE_HIT",
+                              {"key": key, **cached.as_dict()})
+            return AutotuneResult(params=cached, cache_hit=True)
+
+    pm = ParameterManager(
+        initial,
+        tune_quant_block=tune_quant_block,
+        tune_hierarchical=tune_hierarchical,
+        warmup_samples=warmup_samples,
+        steps_per_sample=steps_per_sample,
+        max_samples=max_samples,
+        gp_noise=gp_noise,
+        log_path=log_path,
+        seed=seed,
+    )
+    log.warning(
+        "horovod_tpu autotune: tuning session started (%d warmup + up to "
+        "%d scored windows of %d steps; each new configuration is a "
+        "recompile)", warmup_samples, max_samples, steps_per_sample)
+    _timeline_instant("AUTOTUNE:SESSION_START", {
+        "warmup_samples": warmup_samples, "max_samples": max_samples,
+        "steps_per_sample": steps_per_sample})
+
+    built: Optional[Tuple[TunedParams, Callable[[], object]]] = None
+    while not pm.done:
+        tuned = pm.current
+        warmup = pm.warming_up
+        try:
+            if built is None or built[0] != tuned:
+                t0 = time.perf_counter()
+                built = (tuned, make_step(tuned))
+                # One untimed step absorbs this trial's compile + first
+                # dispatch so the scored window measures steady state.
+                jax.block_until_ready(built[1]())
+                log.info("autotune trial build %s: %.1fs to first step",
+                         tuned.as_dict(), time.perf_counter() - t0)
+            step = built[1]
+            t0 = time.perf_counter()
+            for _ in range(pm.steps_per_sample):
+                out = step()
+            jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            score = pm.steps_per_sample / dt if dt > 0 else 0.0
+        except Exception as e:
+            # A candidate that cannot build or run (compile failure, OOM
+            # at a huge threshold) is a terrible score, not a session
+            # abort — the GP learns to avoid the region (the same skip
+            # discipline as the kernel autotuner's failing candidates).
+            built = None
+            score = 0.0
+            log.warning("autotune trial %s failed (%s: %s); scoring 0",
+                        tuned.as_dict(), type(e).__name__, str(e)[:200])
+        pm.record_sample(score)
+        _timeline_instant("AUTOTUNE:SAMPLE", {
+            "warmup": warmup, "score_steps_per_sec": round(score, 4),
+            **tuned.as_dict()})
+        if not warmup:
+            log.info("autotune sample %d/%d: %s -> %.3f steps/sec",
+                     pm.samples_done, max_samples, tuned.as_dict(), score)
+
+    best = pm.best
+    _timeline_instant("AUTOTUNE:CONVERGED", {
+        "samples": pm.samples_done,
+        "score_steps_per_sec": round(pm.best_score, 4),
+        **best.as_dict()})
+    log.warning(
+        "horovod_tpu autotune: converged after %d samples — "
+        "fusion_threshold=%d quant_block=%d hierarchical=%s "
+        "(%.3f steps/sec)", pm.samples_done, best.fusion_threshold_bytes,
+        best.quant_block, best.hierarchical_allreduce, pm.best_score)
+    if key is not None:
+        _store_cached_params(key, best, score=pm.best_score,
+                             samples=pm.samples_done)
+    return AutotuneResult(params=best, history=tuple(pm.history),
+                          best_score=pm.best_score)

@@ -1,0 +1,113 @@
+"""Small NumPy Gaussian process for the knob autotuner.
+
+Reference: ``cc/src/gp.cc`` (itself mirroring
+``horovod/common/optim/gaussian_process.cc``): RBF kernel, Cholesky
+fit, triangular-solve predict, and closed-form expected improvement.
+The autotuner's design spaces are tiny (≤ ~20 samples in ≤ 3 dims), so
+a dependency-free dense implementation is the right size — NumPy's
+Cholesky replaces the reference's Eigen.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class GaussianProcess:
+    """GP regression with an RBF kernel and observation noise.
+
+    ``noise`` is the ``HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE`` knob
+    (reference default 0.8 — deliberately large: step-time scores on a
+    busy host are noisy, and a stiff prior keeps one lucky window from
+    dominating the search).
+    """
+
+    def __init__(self, dims: int, length_scale: float = 0.3,
+                 noise: float = 0.8) -> None:
+        if dims < 1:
+            raise ValueError("dims must be >= 1")
+        self.dims = dims
+        self.length_scale = float(length_scale)
+        self.noise = float(noise)
+        self._x: Optional[np.ndarray] = None
+        self._l: Optional[np.ndarray] = None
+        self._alpha: Optional[np.ndarray] = None
+
+    def kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """RBF: k(a, b) = exp(-|a-b|^2 / (2 l^2)), rows x rows."""
+        a = np.atleast_2d(np.asarray(a, dtype=np.float64))
+        b = np.atleast_2d(np.asarray(b, dtype=np.float64))
+        d2 = np.sum((a[:, None, :] - b[None, :, :]) ** 2, axis=-1)
+        return np.exp(-d2 / (2.0 * self.length_scale ** 2))
+
+    @property
+    def fitted(self) -> bool:
+        return self._alpha is not None
+
+    def fit(self, x, y) -> bool:
+        """Fit on rows ``x`` and targets ``y``. Returns False (and stays
+        unfitted) when K + noise^2 I is not positive definite — the
+        reference's Fit() bool contract (gp.cc:17-57)."""
+        x = np.atleast_2d(np.asarray(x, dtype=np.float64))
+        y = np.asarray(y, dtype=np.float64).reshape(-1)
+        if x.shape[0] != y.shape[0] or x.shape[1] != self.dims:
+            raise ValueError(
+                f"fit expects x [n, {self.dims}] and matching y, got "
+                f"{x.shape} / {y.shape}")
+        k = self.kernel(x, x)
+        k[np.diag_indices_from(k)] += self.noise ** 2
+        try:
+            l = np.linalg.cholesky(k)
+        except np.linalg.LinAlgError:
+            return False
+        self._x = x
+        self._l = l
+        # alpha = K^-1 y via the two triangular solves (gp.cc:43-55).
+        z = _solve_lower(l, y)
+        self._alpha = _solve_upper(l.T, z)
+        return True
+
+    def predict(self, x) -> Tuple[float, float]:
+        """Posterior (mean, stddev) at a single point ``x``."""
+        if not self.fitted:
+            raise RuntimeError("predict() before a successful fit()")
+        x = np.asarray(x, dtype=np.float64).reshape(1, self.dims)
+        kstar = self.kernel(x, self._x)[0]
+        mean = float(kstar @ self._alpha)
+        # v = L^-1 k*; var = k(x,x) - v.v  (gp.cc:66-76)
+        v = _solve_lower(self._l, kstar)
+        var = 1.0 - float(v @ v)  # k(x, x) = 1 for RBF
+        return mean, math.sqrt(var) if var > 0.0 else 0.0
+
+    def expected_improvement(self, x, best_y: float,
+                             xi: float = 0.0) -> float:
+        """EI of ``x`` over the incumbent ``best_y`` (gp.cc:79-89)."""
+        mu, sigma = self.predict(x)
+        if sigma <= 1e-12:
+            return 0.0
+        imp = mu - best_y - xi
+        z = imp / sigma
+        cdf = 0.5 * math.erfc(-z / math.sqrt(2.0))
+        pdf = math.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+        return imp * cdf + sigma * pdf
+
+
+def _solve_lower(l: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Forward substitution L z = b (L lower triangular)."""
+    n = b.shape[0]
+    z = np.zeros(n)
+    for i in range(n):
+        z[i] = (b[i] - l[i, :i] @ z[:i]) / l[i, i]
+    return z
+
+
+def _solve_upper(u: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Back substitution U z = b (U upper triangular)."""
+    n = b.shape[0]
+    z = np.zeros(n)
+    for i in range(n - 1, -1, -1):
+        z[i] = (b[i] - u[i, i + 1:] @ z[i + 1:]) / u[i, i]
+    return z

@@ -1,0 +1,316 @@
+"""Trial state machine for the collective-knob autotuner.
+
+Reference: ``cc/src/parameter_manager.cc`` (mirroring
+``horovod/common/parameter_manager.cc``): warmup windows are discarded,
+every later window scores the current knob setting, the next setting
+comes from expected improvement over a GP fit on the normalized scores,
+and after ``max_samples`` scored windows the manager freezes on the best
+configuration seen.
+
+The compiled-path differences from the native eager manager:
+
+* knobs are :class:`TunedParams` — fusion threshold (1–256 MiB,
+  log-space), ``quant_block`` (64–1024, log-space, power-of-two snapped,
+  searched only when the quantized wire is on) and the hierarchical
+  allreduce flag. Cycle time and the response cache do not exist on the
+  compiled path (the XLA schedule replaces both — ops/fusion.py);
+* scores are wall-clock **steps/sec** of a real training window (the
+  driver times them), not coordinator bytes/sec — on the compiled path
+  the collective schedule is inside the step, so step rate is the
+  end-to-end objective the knobs exist to move;
+* proposals are deduplicated against already-tried configurations:
+  log-space snapping makes the space effectively discrete, and repeat
+  trials would each cost a recompile.
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import logging
+import math
+import os
+from typing import IO, List, Optional, Tuple
+
+from .gp import GaussianProcess
+
+log = logging.getLogger("horovod_tpu.autotune")
+
+# Search bounds, log2-space (ISSUE 3: fusion threshold 1-256 MiB,
+# quant_block 64-1024).
+_MIN_FUSION_LOG = 20.0  # 2^20 = 1 MiB
+_MAX_FUSION_LOG = 28.0  # 2^28 = 256 MiB
+_MIN_QBLOCK_LOG = 6.0   # 2^6  = 64
+_MAX_QBLOCK_LOG = 10.0  # 2^10 = 1024
+_DIMS = 3  # fusion, quant_block, hierarchical
+
+# CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
+# window score; same layout here with the compiled-path knob set).
+CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
+              "hierarchical_allreduce", "score_steps_per_sec")
+
+
+@dataclasses.dataclass(frozen=True)
+class TunedParams:
+    """One knob setting to build (or that built) a compiled step — the
+    analogue of the Params struct the reference coordinator broadcasts
+    (SynchronizeParameters, controller.cc:34-48). Hashable so trial
+    dedup and the warm-start cache can key on it."""
+
+    fusion_threshold_bytes: int = 64 * 1024 * 1024
+    quant_block: int = 256
+    hierarchical_allreduce: bool = False
+
+    def as_dict(self) -> dict:
+        return {
+            "fusion_threshold_bytes": int(self.fusion_threshold_bytes),
+            "quant_block": int(self.quant_block),
+            "hierarchical_allreduce": bool(self.hierarchical_allreduce),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TunedParams":
+        return cls(
+            fusion_threshold_bytes=int(d["fusion_threshold_bytes"]),
+            quant_block=int(d["quant_block"]),
+            hierarchical_allreduce=bool(d["hierarchical_allreduce"]),
+        )
+
+    @classmethod
+    def from_config(cls, config) -> "TunedParams":
+        """Seed from a :class:`horovod_tpu.common.config.Config` (the
+        hand-set env knobs are trial 0, as in the reference where tuning
+        starts from the configured values)."""
+        return cls(
+            fusion_threshold_bytes=config.fusion_threshold_bytes,
+            quant_block=config.quant_block,
+            hierarchical_allreduce=config.hierarchical_allreduce,
+        )
+
+
+class _XorShift:
+    """xorshift64* — the reference manager's deterministic proposal RNG
+    (parameter_manager.cc:106-113); seedable so sessions replay."""
+
+    def __init__(self, seed: int = 0x9E3779B97F4A7C15) -> None:
+        self.state = seed & 0xFFFFFFFFFFFFFFFF or 0x9E3779B97F4A7C15
+
+    def next(self) -> float:
+        s = self.state
+        s ^= (s >> 12) & 0xFFFFFFFFFFFFFFFF
+        s = (s ^ (s << 25)) & 0xFFFFFFFFFFFFFFFF
+        s ^= s >> 27
+        self.state = s
+        return ((s * 0x2545F4914F6CDD1D & 0xFFFFFFFFFFFFFFFF) >> 11) / float(
+            1 << 53)
+
+
+class ParameterManager:
+    """Warmup → sample → freeze over :class:`TunedParams` trials.
+
+    Drive it like the reference's ``Update`` loop, one scored window at a
+    time::
+
+        pm = ParameterManager(initial, tune_quant_block=..., ...)
+        while not pm.done:
+            score = measure(pm.current)   # steps/sec of a timed window
+            pm.record_sample(score)
+        winner = pm.best
+
+    ``warmup_samples`` windows run on the initial setting and are
+    discarded (parameter_manager.cc:162 — JIT/dispatch warmup must not
+    enter the GP); then every window is scored, and after ``max_samples``
+    scored windows the manager freezes (``done``) on the best setting.
+    """
+
+    def __init__(
+        self,
+        initial: TunedParams,
+        *,
+        tune_quant_block: bool = False,
+        tune_hierarchical: bool = True,
+        warmup_samples: int = 3,
+        steps_per_sample: int = 10,
+        max_samples: int = 20,
+        gp_noise: float = 0.8,
+        log_path: Optional[str] = None,
+        seed: int = 0x9E3779B97F4A7C15,
+    ) -> None:
+        if max_samples < 1:
+            raise ValueError("max_samples must be >= 1")
+        self.initial = initial
+        self.current = initial
+        self.best = initial
+        self.best_score = -math.inf
+        self.tune_quant_block = tune_quant_block
+        self.tune_hierarchical = tune_hierarchical
+        self.warmup_samples = max(0, warmup_samples)
+        self.steps_per_sample = max(1, steps_per_sample)
+        self.max_samples = max_samples
+        self.gp_noise = gp_noise
+        self.done = False
+        self.history: List[Tuple[TunedParams, float]] = []
+        self._warmups_done = 0
+        self._rng = _XorShift(seed)
+        self._tried = {self._unit_key(initial)}
+        self._log: Optional[IO[str]] = None
+        self._csv = None
+        if log_path:
+            os.makedirs(os.path.dirname(os.path.abspath(log_path)),
+                        exist_ok=True)
+            self._log = open(log_path, "w", newline="")
+            self._csv = csv.writer(self._log)
+            self._csv.writerow(CSV_FIELDS)
+            self._log.flush()
+
+    # -- unit-cube coordinates (parameter_manager.cc:63-86) -------------
+
+    def _to_unit(self, p: TunedParams) -> Tuple[float, ...]:
+        f = math.log2(max(1, p.fusion_threshold_bytes))
+        q = math.log2(max(1, p.quant_block))
+        return (
+            (f - _MIN_FUSION_LOG) / (_MAX_FUSION_LOG - _MIN_FUSION_LOG),
+            (q - _MIN_QBLOCK_LOG) / (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG),
+            # Booleans sit at 0.25/0.75, well inside the box.
+            0.75 if p.hierarchical_allreduce else 0.25,
+        )
+
+    def _from_unit(self, u) -> TunedParams:
+        f = _MIN_FUSION_LOG + u[0] * (_MAX_FUSION_LOG - _MIN_FUSION_LOG)
+        if self.tune_quant_block:
+            # Snap to a power of two: scale blocks align with the
+            # ATOMIC_UNIT-padded bucket layout (ops/fusion.py).
+            q = _MIN_QBLOCK_LOG + u[1] * (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG)
+            qblock = 1 << max(int(_MIN_QBLOCK_LOG),
+                              min(int(_MAX_QBLOCK_LOG), round(q)))
+        else:
+            qblock = self.initial.quant_block
+        hier = (u[2] >= 0.5 if self.tune_hierarchical
+                else self.initial.hierarchical_allreduce)
+        return TunedParams(
+            fusion_threshold_bytes=int(2.0 ** f),
+            quant_block=qblock,
+            hierarchical_allreduce=hier,
+        )
+
+    def _unit_key(self, p: TunedParams) -> tuple:
+        """Dedup key: the *snapped* knob values, so two unit points that
+        collapse to the same compiled configuration count as one trial."""
+        # Fusion threshold dedups at 1/4-octave resolution — finer than
+        # that cannot change a bucket plan by more than rounding.
+        return (round(math.log2(max(1, p.fusion_threshold_bytes)) * 4),
+                p.quant_block, p.hierarchical_allreduce)
+
+    # -- sampling loop ---------------------------------------------------
+
+    @property
+    def warming_up(self) -> bool:
+        return (not self.done
+                and self._warmups_done < self.warmup_samples)
+
+    @property
+    def samples_done(self) -> int:
+        return len(self.history)
+
+    def record_sample(self, score: float) -> None:
+        """Feed one scored window (steps/sec of ``current``); advances the
+        warmup → sample → freeze machine (parameter_manager.cc:139-194)."""
+        if self.done:
+            raise RuntimeError("record_sample() after convergence")
+        if self._warmups_done < self.warmup_samples:
+            self._warmups_done += 1
+            return  # discarded: current stays the initial setting
+        score = float(score)
+        self.history.append((self.current, score))
+        self._write_row(score)
+        if score > self.best_score:
+            self.best_score = score
+            self.best = self.current
+        if len(self.history) >= self.max_samples:
+            self._freeze()
+            return
+        self.current = self._propose_next()
+
+    def _write_row(self, score: float) -> None:
+        if self._csv is None:
+            return
+        p = self.current
+        self._csv.writerow([len(self.history), p.fusion_threshold_bytes,
+                            p.quant_block,
+                            int(p.hierarchical_allreduce),
+                            f"{score:.6g}"])
+        self._log.flush()
+
+    def _freeze(self) -> None:
+        self.done = True
+        self.current = self.best
+        self.close()
+        log.info(
+            "autotune converged after %d samples: fusion_threshold=%d "
+            "quant_block=%d hierarchical=%s (best %.3f steps/sec)",
+            len(self.history), self.best.fusion_threshold_bytes,
+            self.best.quant_block, self.best.hierarchical_allreduce,
+            self.best_score)
+
+    def _sample_unit(self) -> Tuple[float, ...]:
+        u = [self._rng.next() for _ in range(_DIMS)]
+        if not self.tune_hierarchical:
+            u[2] = 0.25
+        return tuple(u)
+
+    def _propose_next(self) -> TunedParams:
+        """EI-argmax over random candidates once the GP fits; random
+        exploration before that (parameter_manager.cc:88-137). Prefers
+        configurations not yet tried (each repeat costs a recompile)."""
+        xs = [self._to_unit(p) for p, _ in self.history]
+        ys = [s for _, s in self.history]
+        # Normalize scores to zero-mean/unit-variance for the GP.
+        mean = sum(ys) / len(ys)
+        sd = math.sqrt(sum((y - mean) ** 2 for y in ys) / len(ys)) or 1.0
+        yn = [(y - mean) / sd for y in ys]
+        best_n = max(yn)
+        gp = GaussianProcess(_DIMS, 0.3, self.gp_noise)
+        fitted = len(xs) >= 2 and gp.fit(xs, yn)
+
+        # EI-argmax among candidates snapping to an untried configuration;
+        # if every candidate collapses onto tried points (degenerate
+        # space), take the overall argmax.
+        new_x, new_ei = None, -1.0
+        any_x, any_ei = None, -1.0
+        for _ in range(1000 if fitted else 64):
+            cand = self._sample_unit()
+            ei = (gp.expected_improvement(cand, best_n) if fitted
+                  else self._rng.next())
+            if any_x is None or ei > any_ei:
+                any_x, any_ei = cand, ei
+            if ei > new_ei and \
+                    self._unit_key(self._from_unit(cand)) not in self._tried:
+                new_x, new_ei = cand, ei
+        proposal = self._from_unit(new_x if new_x is not None else any_x)
+        self._tried.add(self._unit_key(proposal))
+        return proposal
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+            self._csv = None
+
+
+def read_log(path: str) -> List[dict]:
+    """Parse a ``HOROVOD_AUTOTUNE_LOG`` CSV back into typed rows — the
+    round-trip counterpart of the manager's writer (tests assert the
+    schema; analysis notebooks get typed values for free)."""
+    rows: List[dict] = []
+    with open(path, newline="") as f:
+        for rec in csv.DictReader(f):
+            rows.append({
+                "sample": int(rec["sample"]),
+                "fusion_threshold_bytes": int(
+                    rec["fusion_threshold_bytes"]),
+                "quant_block": int(rec["quant_block"]),
+                "hierarchical_allreduce": bool(
+                    int(rec["hierarchical_allreduce"])),
+                "score_steps_per_sec": float(rec["score_steps_per_sec"]),
+            })
+    return rows

@@ -258,11 +258,38 @@ def init(
         kernel_autotune.verify_multihost_cache()
 
 
+# One warning per process: HOROVOD_AUTOTUNE=1 that never reached a
+# tuning session is a silent no-op on the compiled path (bucket plans are
+# trace-time; the knob activates hvd.autotune_session, docs/autotune.md).
+_autotune_unused_warned = [False]
+
+
+def _warn_autotune_unused(cfg: Optional[_config.Config]) -> None:
+    if cfg is None or not cfg.autotune or _autotune_unused_warned[0]:
+        return
+    from ..autotune import driver as _autotune_driver
+
+    if _autotune_driver.sessions_run() > 0:
+        return
+    _autotune_unused_warned[0] = True
+    import logging
+
+    logging.getLogger("horovod_tpu.autotune").warning(
+        "HOROVOD_AUTOTUNE=1 but no tuning session ran: on the compiled "
+        "(XLA) path the collective tunables are fixed at trace time, so "
+        "autotuning requires an explicit session — wrap your step in "
+        "hvd.autotune_session(make_step, cache_key=params) and build the "
+        "step with the returned TunedParams (tuned_params= on "
+        "DistributedOptimizer / allreduce_pytree). Without it the knob "
+        "changes nothing. See docs/autotune.md.")
+
+
 def shutdown() -> None:
     """Tear down framework state (reference: horovod_shutdown,
     operations.cc:676-683). Safe to call multiple times; init() can be called
     again afterwards (the elastic reset path relies on this,
     common/elastic.py:147-168)."""
+    _warn_autotune_unused(_state.config)
     with _state.lock:
         if _state.timeline is not None:
             _state.timeline.close()

@@ -435,6 +435,7 @@ def allreduce(
     axes=None,
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
+    block: Optional[int] = None,
     _presummed: bool = False,
 ):
     """Allreduce ``tensor`` across all ranks.
@@ -451,7 +452,9 @@ def allreduce(
     see :func:`_psum_quantized`; ICI legs keep the payload dtype. For
     error-feedback accumulation use :func:`quantized_allreduce`. With the
     knob off (the default) this path is bit-identical to the unquantized
-    implementation.
+    implementation. ``block`` overrides the ``HOROVOD_QUANT_BLOCK``
+    scale-block size for this call (the autotuner threads its tuned
+    value through here).
 
     If ``tensor`` is provably replicated across the requested mesh axes
     (VMA-invariant), no collective is emitted — see
@@ -463,7 +466,8 @@ def allreduce(
         tensor, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         name=name, axes=axes, hierarchical=hierarchical,
-        quantized=quantized, residual=None, _presummed=_presummed)
+        quantized=quantized, residual=None, block=block,
+        _presummed=_presummed)
     return out
 
 
@@ -590,10 +594,13 @@ def _allreduce_impl(
                 red = _reduce_in_jit(compressed, op, axes_t,
                                      bool(hierarchical))
     else:
-        if hierarchical is not None:
+        # hierarchical=False matches what the eager data plane does (flat
+        # rings), so only an explicit True is an unsatisfiable request —
+        # autotuner TunedParams overrides legitimately pass False here.
+        if hierarchical:
             raise ValueError(
-                "allreduce(hierarchical=...) is only supported in-jit; set "
-                "HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
+                "allreduce(hierarchical=True) is only supported in-jit; "
+                "set HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
         if quantized:
             # Eager path: the native core reduces full-width dtypes, so the
             # quantization is applied as a local fake-quant of this rank's
@@ -636,9 +643,9 @@ def _eager_grouped_allreduce(tensors, *, name: Optional[str] = None,
                              postscale_factor: float = 1.0,
                              compression=None, axes=None,
                              hierarchical: Optional[bool] = None):
-    if hierarchical is not None:
+    if hierarchical:
         raise ValueError(
-            "allreduce(hierarchical=...) is only supported in-jit; set "
+            "allreduce(hierarchical=True) is only supported in-jit; set "
             "HOROVOD_HIERARCHICAL_ALLREDUCE for the eager path")
     compression = compression or Compression.none
     ctrl, world = _eager_ctx()

@@ -63,7 +63,15 @@ def plan_buckets(
     walk tensors in order, open a new buffer when the current one would
     exceed the threshold or the dtype changes (the reference's look-ahead
     skips over mixed dtypes; leaf order here is pytree order, which is
-    deterministic, so we simply group by dtype)."""
+    deterministic, so we simply group by dtype).
+
+    Guarantees the autotuner's warm-start cache key relies on: the plan
+    is a pure, deterministic function of (leaf order, shapes, dtypes,
+    threshold) — identical pytrees always produce identical plans; a
+    single leaf larger than the threshold becomes its own bucket (never
+    an error, and never shared — a following small leaf must not ride a
+    bucket that already blew past the cap); 0-d and zero-size leaves
+    count as one element (the reference's min-1 slot)."""
     if threshold_bytes is None:
         threshold_bytes = (
             basics.config().fusion_threshold_bytes
@@ -87,6 +95,10 @@ def plan_buckets(
                 cur_idx, cur_elems = [], 0
             cur_idx.append(i)
             cur_elems += n
+            if n > max_elems:
+                # Oversized leaf: its own bucket, closed immediately.
+                buckets.append(_close_bucket(dtype, cur_idx, leaves))
+                cur_idx, cur_elems = [], 0
         if cur_idx:
             buckets.append(_close_bucket(dtype, cur_idx, leaves))
     return buckets
@@ -104,8 +116,14 @@ def _close_bucket(dtype, idxs: List[int], leaves) -> Bucket:
 def pack(bucket: Bucket, leaves: Sequence[jax.Array]) -> jax.Array:
     """Concatenate the bucket's leaves into one flat padded buffer (the
     MemcpyInFusionBuffer analogue, collective_operations.cc:34-59 — here a
-    traced concatenate that XLA fuses)."""
-    flat = [jnp.ravel(jnp.asarray(leaves[i])) for i in bucket.leaf_indices]
+    traced concatenate that XLA fuses). A zero-size leaf still owns its
+    min-1 slot in the plan (plan_buckets), so it packs as slot padding."""
+    flat = []
+    for i, size in zip(bucket.leaf_indices, bucket.sizes):
+        v = jnp.ravel(jnp.asarray(leaves[i]))
+        if v.shape[0] < size:  # zero-size leaf: fill its min-1 slot
+            v = jnp.zeros((size,), dtype=v.dtype)
+        flat.append(v)
     buf = jnp.concatenate(flat) if len(flat) > 1 else flat[0]
     pad = bucket.padded_size - buf.shape[0]
     if pad:
@@ -118,7 +136,8 @@ def unpack(bucket: Bucket, buf: jax.Array) -> List[jax.Array]:
     out = []
     off = 0
     for size, shape in zip(bucket.sizes, bucket.shapes):
-        out.append(jnp.reshape(buf[off:off + size], shape))
+        n = int(np.prod(shape, dtype=np.int64))  # real elems (slot >= 1)
+        out.append(jnp.reshape(buf[off:off + n], shape))
         off += size
     return out
 
@@ -136,6 +155,8 @@ def allreduce_pytree(
     presummed: bool = False,
     quantized: Optional[bool] = None,
     error_feedback=None,
+    block: Optional[int] = None,
+    tuned_params=None,
 ):
     """Allreduce every leaf of a pytree with tensor fusion.
 
@@ -162,7 +183,20 @@ def allreduce_pytree(
     are packed with the same bucket plan as the gradients, so each bucket
     carries its quantization error into the next step (EF-SGD). Non-float
     and replicated leaves pass their residual through unchanged (it stays
-    zero)."""
+    zero).
+
+    ``tuned_params`` (an ``autotune.TunedParams``) applies an autotuner
+    override: it fills ``threshold_bytes``, ``hierarchical``, and the
+    int8 scale-``block`` wherever the caller left them unset, so a tuning
+    session (or its frozen winner) steers the trace without touching the
+    process-wide env config. Explicit per-call arguments still win."""
+    if tuned_params is not None:
+        if threshold_bytes is None:
+            threshold_bytes = tuned_params.fusion_threshold_bytes
+        if hierarchical is None:
+            hierarchical = tuned_params.hierarchical_allreduce
+        if block is None:
+            block = tuned_params.quant_block
     leaves, treedef = jax.tree.flatten(tree)
     if error_feedback is not None:
         quantized = True if quantized is None else quantized
@@ -185,7 +219,7 @@ def allreduce_pytree(
                 leaf, op=op, compression=compression, axes=axes,
                 hierarchical=hierarchical, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, quantized=quantized,
-                _presummed=presummed)
+                block=block, _presummed=presummed)
         else:
             varying_idx.append(i)
 
@@ -202,7 +236,7 @@ def allreduce_pytree(
                 red, rnew = C.quantized_allreduce(
                     buf, rbuf, op=op, compression=compression, axes=axes,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor)
+                    postscale_factor=postscale_factor, block=block)
                 for j, r in zip(bucket.leaf_indices, unpack(bucket, rnew)):
                     new_ef[varying_idx[j]] = r
             else:
@@ -210,7 +244,8 @@ def allreduce_pytree(
                     buf, op=op, compression=compression, axes=axes,
                     hierarchical=hierarchical,
                     prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor, quantized=quantized)
+                    postscale_factor=postscale_factor, quantized=quantized,
+                    block=block)
             for j, leaf in zip(bucket.leaf_indices, unpack(bucket, red)):
                 out[varying_idx[j]] = leaf
     result = jax.tree.unflatten(treedef, out)

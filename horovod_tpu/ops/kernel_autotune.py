@@ -109,6 +109,25 @@ def _store_locked(key: str, entry: dict) -> None:
         logging.debug("autotune cache write failed: %s", e)
 
 
+def cache_lookup(key: str) -> Optional[dict]:
+    """Entry stored under ``key`` in the shared autotune cache file
+    (``HOROVOD_AUTOTUNE_CACHE``), or None. Used by the collective-knob
+    autotuner (autotune/driver.py) so kernel block choices and frozen
+    collective tunables live in ONE warm-start file with the same
+    locking, atomicity, and multi-host fingerprint discipline."""
+    with _lock:
+        _load_locked()
+        entry = _mem.get(key)
+    return entry if isinstance(entry, dict) else None
+
+
+def cache_store(key: str, entry: dict) -> None:
+    """Persist ``entry`` under ``key`` in the shared autotune cache file
+    (read-merge-write under the OS lock; see :func:`cache_lookup`)."""
+    with _lock:
+        _store_locked(key, entry)
+
+
 def get_or_tune(kind: str, sig: str,
                 candidates: Sequence[Tuple[int, ...]],
                 bench: Callable[[Tuple[int, ...]], float],

@@ -66,6 +66,7 @@ def DistributedOptimizer(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     axes=None,
+    tuned_params=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with fused gradient allreduce.
 
@@ -86,6 +87,13 @@ def DistributedOptimizer(
     per-rank locals (e.g. via ``hvd.value_and_grad(..., reduce=False)``);
     auto-psummed replicated gradients never touch the wire, so there is
     nothing to quantize.
+
+    ``tuned_params`` (an ``autotune.TunedParams``, e.g. the winner of
+    :func:`horovod_tpu.autotune_session`) overrides the fusion threshold,
+    hierarchical flag, and int8 scale-block for this optimizer's gradient
+    allreduce wherever the explicit kwargs above were left unset —
+    rebuilding the optimizer with a new override is exactly what one
+    autotune trial does (the step retraces with the new bucket plan).
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -93,6 +101,13 @@ def DistributedOptimizer(
             "(reference: tensorflow/__init__.py:452-455)")
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    quant_block = None
+    if tuned_params is not None:
+        if fusion_threshold_bytes is None:
+            fusion_threshold_bytes = tuned_params.fusion_threshold_bytes
+        if hierarchical is None:
+            hierarchical = tuned_params.hierarchical_allreduce
+        quant_block = tuned_params.quant_block
     if quantized is None:
         quantized = (basics.config().quantized_allreduce
                      if basics.is_initialized()
@@ -128,6 +143,7 @@ def DistributedOptimizer(
             presummed=True,  # invariant grads are autodiff-psummed sums
             quantized=quantized,
             error_feedback=error_feedback,
+            block=quant_block,
         )
 
     def _res_read(residual):

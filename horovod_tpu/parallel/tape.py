@@ -47,6 +47,7 @@ def allreduce_gradients(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     error_feedback=None,
+    tuned_params=None,
 ):
     """Allreduce a gradient pytree (reference: _make_allreduce_grads_fn,
     tensorflow/__init__.py:246-278). Fused into per-dtype buckets;
@@ -58,12 +59,14 @@ def allreduce_gradients(
     zeros initially) switches the return value to
     ``(reduced, new_error_feedback)`` so callers can thread EF state
     functionally — :class:`horovod_tpu.DistributedOptimizer` does this
-    inside its optax state instead."""
+    inside its optax state instead. ``tuned_params`` applies an autotuner
+    override (see :func:`~horovod_tpu.ops.fusion.allreduce_pytree`)."""
     return fusion.allreduce_pytree(
         grads, op=op, compression=compression,
         threshold_bytes=fusion_threshold_bytes, axes=axes,
         hierarchical=hierarchical, presummed=True,
-        quantized=quantized, error_feedback=error_feedback)
+        quantized=quantized, error_feedback=error_feedback,
+        tuned_params=tuned_params)
 
 
 def value_and_grad(
@@ -77,6 +80,7 @@ def value_and_grad(
     axes=None,
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
+    tuned_params=None,
     reduce: bool = True,
     **jax_kwargs,
 ):
@@ -106,7 +110,8 @@ def value_and_grad(
         grads = allreduce_gradients(
             grads, op=op, compression=compression,
             fusion_threshold_bytes=fusion_threshold_bytes, axes=axes,
-            hierarchical=hierarchical, quantized=quantized)
+            hierarchical=hierarchical, quantized=quantized,
+            tuned_params=tuned_params)
         return val, grads
 
     return wrapped

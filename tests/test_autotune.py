@@ -1,0 +1,493 @@
+"""Collective-knob autotuner tests (reference: parameter_manager.cc +
+gp.cc; the compiled-path Python port lives in horovod_tpu/autotune/).
+
+Tiers mirror the subsystem layers: the NumPy GP against a known
+quadratic, the warmup → sample → freeze state machine, the CSV log
+schema round-trip, the warm-start cache (a rerun skips every trial), the
+end-to-end toy tuning session on the CPU mesh (the ISSUE acceptance
+criterion), the TunedParams override equivalence with hand-set env knobs,
+and the three-layer CLI/YAML → env → Config contract."""
+
+import argparse
+import dataclasses
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.autotune import (
+    AutotuneResult,
+    GaussianProcess,
+    ParameterManager,
+    TunedParams,
+    autotune_session,
+    cache_key_for,
+    load_cached_params,
+    read_log,
+)
+from horovod_tpu.autotune import parameter_manager as pm_mod
+from horovod_tpu.common import basics, config as config_mod
+from horovod_tpu.ops import fusion
+from horovod_tpu.runner import config_parser
+
+MIB = 1024 * 1024
+
+
+class TestGaussianProcess:
+    def test_fit_predict_recovers_training_points(self):
+        # Noise-free-ish GP interpolates a smooth function at its samples.
+        xs = [[x] for x in np.linspace(0.0, 1.0, 9)]
+        ys = [-((x[0] - 0.3) ** 2) * 4 for x in xs]
+        gp = GaussianProcess(1, length_scale=0.3, noise=0.01)
+        assert gp.fit(xs, ys)
+        for x, y in zip(xs, ys):
+            mu, sd = gp.predict(x)
+            assert abs(mu - y) < 0.05
+            assert sd < 0.05
+
+    def test_predict_uncertainty_grows_off_data(self):
+        gp = GaussianProcess(1, length_scale=0.1, noise=0.01)
+        assert gp.fit([[0.1], [0.2]], [0.0, 0.1])
+        _, sd_near = gp.predict([0.15])
+        _, sd_far = gp.predict([0.9])
+        assert sd_far > sd_near
+
+    def test_ei_picks_the_basin(self):
+        # Maximizing -(x-0.3)^2: EI over a candidate grid must peak near
+        # x = 0.3 once the GP has seen points straddling it.
+        xs = [[0.0], [0.15], [0.45], [0.6], [0.9]]
+        ys = [-(x[0] - 0.3) ** 2 for x in xs]
+        mean, sd = np.mean(ys), np.std(ys) or 1.0
+        yn = [(y - mean) / sd for y in ys]
+        gp = GaussianProcess(1, length_scale=0.3, noise=0.1)
+        assert gp.fit(xs, yn)
+        grid = np.linspace(0.0, 1.0, 101)
+        eis = [gp.expected_improvement([x], max(yn)) for x in grid]
+        assert abs(grid[int(np.argmax(eis))] - 0.3) < 0.1
+
+    def test_fit_rejects_non_pd(self):
+        # Duplicate rows with zero noise make K singular.
+        gp = GaussianProcess(1, noise=0.0)
+        assert not gp.fit([[0.5], [0.5]], [1.0, 1.0])
+        assert not gp.fitted
+
+
+def _run_manager(pm, score_fn):
+    while not pm.done:
+        pm.record_sample(score_fn(pm.current))
+    return pm
+
+
+class TestParameterManager:
+    def test_warmup_then_sample_then_freeze(self):
+        initial = TunedParams(fusion_threshold_bytes=64 * MIB)
+        pm = ParameterManager(initial, warmup_samples=3, max_samples=8)
+        # Warmup windows keep the initial setting and are discarded.
+        for _ in range(3):
+            assert pm.warming_up
+            assert pm.current == initial
+            pm.record_sample(123.0)
+        assert pm.samples_done == 0 and not pm.done
+        _run_manager(pm, lambda p: 1.0)
+        assert pm.done
+        assert pm.samples_done == 8
+        with pytest.raises(RuntimeError):
+            pm.record_sample(1.0)
+
+    def test_explores_distinct_configs_and_freezes_on_best(self):
+        # Score peaks at 8 MiB; the frozen winner must be the best-scored
+        # trial, and the proposal dedup must yield >= 5 distinct configs.
+        def score(p):
+            return -abs(np.log2(p.fusion_threshold_bytes) - 23.0)
+
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=10)
+        _run_manager(pm, score)
+        configs = {p for p, _ in pm.history}
+        assert len(configs) >= 5
+        best_seen = max(pm.history, key=lambda t: t[1])
+        assert pm.best == best_seen[0]
+        assert pm.current == pm.best  # frozen
+
+    def test_bounds_respected(self):
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=12, tune_quant_block=True)
+        _run_manager(pm, lambda p: 0.0)
+        for p, _ in pm.history:
+            assert MIB <= p.fusion_threshold_bytes <= 256 * MIB
+            assert 64 <= p.quant_block <= 1024
+            assert p.quant_block & (p.quant_block - 1) == 0  # pow2 snap
+
+    def test_untuned_dims_stay_fixed(self):
+        init = TunedParams(quant_block=192, hierarchical_allreduce=True)
+        pm = ParameterManager(init, warmup_samples=0, max_samples=6,
+                              tune_quant_block=False,
+                              tune_hierarchical=False)
+        _run_manager(pm, lambda p: 0.0)
+        for p, _ in pm.history:
+            assert p.quant_block == 192
+            assert p.hierarchical_allreduce is True
+
+    def test_deterministic_replay(self):
+        def score(p):
+            return float(np.log2(p.fusion_threshold_bytes))
+
+        runs = []
+        for _ in range(2):
+            pm = ParameterManager(TunedParams(), warmup_samples=1,
+                                  max_samples=7, seed=42)
+            pm.record_sample(0.0)  # warmup
+            _run_manager(pm, score)
+            runs.append([p for p, _ in pm.history])
+        assert runs[0] == runs[1]
+
+    def test_csv_log_round_trip(self, tmp_path):
+        path = str(tmp_path / "autotune.csv")
+        pm = ParameterManager(TunedParams(), warmup_samples=2,
+                              max_samples=5, log_path=path,
+                              tune_quant_block=True)
+        _run_manager(pm, lambda p: float(p.quant_block))
+        rows = read_log(path)
+        assert len(rows) == 5
+        with open(path) as f:
+            assert f.readline().strip() == ",".join(pm_mod.CSV_FIELDS)
+        for row, (p, s) in zip(rows, pm.history):
+            assert row["fusion_threshold_bytes"] == p.fusion_threshold_bytes
+            assert row["quant_block"] == p.quant_block
+            assert row["hierarchical_allreduce"] == p.hierarchical_allreduce
+            assert row["score_steps_per_sec"] == pytest.approx(s, rel=1e-5)
+        assert [r["sample"] for r in rows] == list(range(1, 6))
+
+
+class TestTunedParams:
+    def test_dict_round_trip(self):
+        p = TunedParams(fusion_threshold_bytes=8 * MIB, quant_block=128,
+                        hierarchical_allreduce=True)
+        assert TunedParams.from_dict(p.as_dict()) == p
+
+    def test_from_config(self):
+        cfg = config_mod.Config(fusion_threshold_bytes=2 * MIB,
+                                quant_block=512,
+                                hierarchical_allreduce=True)
+        p = TunedParams.from_config(cfg)
+        assert p.fusion_threshold_bytes == 2 * MIB
+        assert p.quant_block == 512
+        assert p.hierarchical_allreduce is True
+
+
+def _toy_make_step(tuned, sleep_by_threshold=None):
+    """A compiled toy step honoring the TunedParams override: fused
+    allreduce of a small gradient tree through the real bucket planner
+    (eager data plane, world of one — tier-1, no TPU)."""
+    tree = {"w": jnp.ones((256,), jnp.float32),
+            "b": jnp.ones((8,), jnp.float32)}
+    state = {"t": tree}
+
+    def step():
+        state["t"] = fusion.allreduce_pytree(
+            state["t"], op=hvd.Sum, tuned_params=tuned)
+        if sleep_by_threshold is not None:
+            import time
+
+            time.sleep(sleep_by_threshold(tuned))
+        return state["t"]
+
+    return step
+
+
+class TestSession:
+    def test_disabled_knob_is_noop(self, tmp_path, monkeypatch):
+        calls = []
+
+        def make_step(tuned):
+            calls.append(tuned)
+            return lambda: jnp.zeros(())
+
+        res = autotune_session(make_step, enabled=False)
+        assert isinstance(res, AutotuneResult)
+        assert res.params == TunedParams.from_config(basics.config())
+        assert res.history == () and not res.cache_hit
+        assert calls == []  # no trial ever built
+
+    def test_session_converges_writes_log_and_cache(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        self._reset_kernel_cache()
+        log_path = str(tmp_path / "tune.csv")
+
+        # Favor small fusion thresholds: score = -log2(threshold) via a
+        # deterministic sleep per step.
+        def sleep_by_threshold(p):
+            return np.log2(p.fusion_threshold_bytes) * 2e-4
+
+        built = []
+
+        def make_step(tuned):
+            built.append(tuned)
+            return _toy_make_step(tuned, sleep_by_threshold)
+
+        res = autotune_session(
+            make_step, cache_key="toy-e2e", enabled=True,
+            warmup_samples=1, steps_per_sample=3, max_samples=6,
+            tune_hierarchical=False,  # the toy step runs eagerly
+            log_path=log_path)
+        assert not res.cache_hit
+        assert res.samples == 6
+        # Explores >= 5 candidate configs (ISSUE acceptance criterion).
+        assert len({p for p, _ in res.history}) >= 5
+        # Converged to the best-scored trial (small thresholds win).
+        best = max(res.history, key=lambda t: t[1])
+        assert res.params == best[0]
+        # CSV written with one row per scored sample.
+        assert len(read_log(log_path)) == 6
+        # Warm-start cache holds the winner...
+        key = cache_key_for("toy-e2e")
+        assert load_cached_params(key) == res.params
+        # ...and a rerun skips every trial.
+        built.clear()
+        res2 = autotune_session(make_step, cache_key="toy-e2e",
+                                enabled=True)
+        assert res2.cache_hit
+        assert res2.params == res.params
+        assert built == []  # zero rebuilds, zero trials
+
+    def test_failing_trial_scores_zero_not_abort(self):
+        # A candidate that cannot compile/run (e.g. OOM at a huge
+        # threshold) must not kill the session: it scores 0 and the
+        # search continues elsewhere.
+        def make_step(tuned):
+            if tuned.fusion_threshold_bytes > 32 * MIB:
+                raise MemoryError("synthetic compile OOM")
+            return _toy_make_step(tuned)
+
+        res = autotune_session(
+            make_step, enabled=True, warmup_samples=0,
+            steps_per_sample=2, max_samples=6, tune_hierarchical=False,
+            initial=TunedParams(fusion_threshold_bytes=4 * MIB))
+        assert res.samples == 6
+        failed = [s for p, s in res.history
+                  if p.fusion_threshold_bytes > 32 * MIB]
+        ok = [s for p, s in res.history
+              if p.fusion_threshold_bytes <= 32 * MIB]
+        assert all(s == 0.0 for s in failed)
+        assert ok and all(s > 0.0 for s in ok)
+        assert res.params.fusion_threshold_bytes <= 32 * MIB
+
+    def test_session_emits_timeline_events(self, monkeypatch):
+        events = []
+
+        class FakeTimeline:
+            def instant(self, name, tid=None, args=None):
+                events.append((name, args))
+
+        monkeypatch.setattr(basics._state, "timeline", FakeTimeline())
+        res = autotune_session(
+            lambda tuned: _toy_make_step(tuned), enabled=True,
+            warmup_samples=1, steps_per_sample=2, max_samples=3,
+            tune_hierarchical=False)
+        names = [n for n, _ in events]
+        assert names[0] == "AUTOTUNE:SESSION_START"
+        # One instant per window: 1 warmup + 3 scored.
+        assert names.count("AUTOTUNE:SAMPLE") == 4
+        samples = [a for n, a in events if n == "AUTOTUNE:SAMPLE"]
+        assert samples[0]["warmup"] is True
+        assert all("score_steps_per_sec" in a and
+                   "fusion_threshold_bytes" in a for a in samples)
+        assert names[-1] == "AUTOTUNE:CONVERGED"
+        assert events[-1][1]["fusion_threshold_bytes"] == \
+            res.params.fusion_threshold_bytes
+
+    def test_cache_key_separates_mesh_and_model(self):
+        k1 = cache_key_for({"w": jnp.zeros((4, 4))})
+        k2 = cache_key_for({"w": jnp.zeros((4, 8))})
+        k3 = cache_key_for({"v": jnp.zeros((4, 4))})
+        assert len({k1, k2, k3}) == 3
+        assert k1 == cache_key_for({"w": jnp.zeros((4, 4))})
+        assert "mesh" in k1 and "world" in k1
+
+    @staticmethod
+    def _reset_kernel_cache():
+        from horovod_tpu.ops import kernel_autotune
+
+        with kernel_autotune._lock:
+            kernel_autotune._mem.clear()
+            kernel_autotune._loaded = False
+
+    def test_sessions_counter_and_shutdown_warning(self, caplog):
+        # HOROVOD_AUTOTUNE=1 with no session must warn once at shutdown
+        # (the knob is otherwise a trace-time no-op); a session suppresses
+        # the warning. Tested at the helper level so the live test world
+        # stays up.
+        from horovod_tpu.autotune import driver as at_driver
+
+        cfg_on = config_mod.Config(autotune=True)
+        monkey_sessions = at_driver._sessions_run[0]
+        basics._autotune_unused_warned[0] = False
+        try:
+            at_driver._sessions_run[0] = 0
+            with caplog.at_level(logging.WARNING,
+                                 logger="horovod_tpu.autotune"):
+                basics._warn_autotune_unused(cfg_on)
+            assert any("no tuning session" in r.message
+                       for r in caplog.records)
+            # One warning per process.
+            n = len(caplog.records)
+            basics._warn_autotune_unused(cfg_on)
+            assert len(caplog.records) == n
+            # With a session run, no warning.
+            caplog.clear()
+            basics._autotune_unused_warned[0] = False
+            at_driver._sessions_run[0] = 3
+            basics._warn_autotune_unused(cfg_on)
+            assert not caplog.records
+            # Knob off: never warns.
+            at_driver._sessions_run[0] = 0
+            basics._warn_autotune_unused(config_mod.Config(autotune=False))
+            assert not caplog.records
+        finally:
+            at_driver._sessions_run[0] = monkey_sessions
+            basics._autotune_unused_warned[0] = True
+
+
+class TestTunedParamsOverride:
+    def test_override_matches_env_config_bucket_plan(self, monkeypatch):
+        """The tuned override and the hand-set env knobs must steer the
+        SAME trace-time decisions: identical bucket plans (the cache-key
+        soundness contract) and identical reductions."""
+        leaves = [jnp.ones((1000,), jnp.float32) for _ in range(6)]
+        tuned = TunedParams(fusion_threshold_bytes=8192)
+        plan_tuned = fusion.plan_buckets(
+            leaves, threshold_bytes=tuned.fusion_threshold_bytes)
+        cfg = dataclasses.replace(basics.config(),
+                                  fusion_threshold_bytes=8192)
+        monkeypatch.setattr(basics._state, "config", cfg)
+        plan_env = fusion.plan_buckets(leaves, threshold_bytes=None)
+        assert plan_tuned == plan_env
+        assert len(plan_tuned) == 3  # 2048-elem cap -> 2 leaves/bucket
+
+    def test_override_reduction_bit_identical_to_env(self, monkeypatch):
+        rs = np.random.RandomState(7)
+        tree = {"a": jnp.asarray(rs.randn(500), jnp.float32),
+                "b": jnp.asarray(rs.randn(33), jnp.float32)}
+        tuned = TunedParams(fusion_threshold_bytes=1024,
+                            hierarchical_allreduce=False)
+        out_tuned = fusion.allreduce_pytree(tree, op=hvd.Sum,
+                                            tuned_params=tuned)
+        cfg = dataclasses.replace(
+            basics.config(), fusion_threshold_bytes=1024,
+            hierarchical_allreduce=False)
+        monkeypatch.setattr(basics._state, "config", cfg)
+        out_env = fusion.allreduce_pytree(tree, op=hvd.Sum)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(out_tuned[k]),
+                                          np.asarray(out_env[k]))
+
+    @pytest.mark.skipif(not hasattr(jax, "shard_map"),
+                        reason="jax.shard_map unavailable on this jax")
+    def test_compiled_2x4_tuned_vs_env_bit_identical(self, monkeypatch):
+        """Compiled smoke on the emulated 2-host x 4-chip mesh: a step
+        built with tuned_params= must produce bit-identical reductions to
+        one built under the equivalent hand-set env config."""
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    hvd.HVD_AXES)
+        rs = np.random.RandomState(11)
+        tree = {"w": jnp.asarray(rs.randn(8, 40, 3), jnp.float32),
+                "b": jnp.asarray(rs.randn(8, 7), jnp.float32)}
+        tuned = TunedParams(fusion_threshold_bytes=2 * MIB,
+                            quant_block=128,
+                            hierarchical_allreduce=True)
+
+        def run(tp):
+            def f(t):
+                local = jax.tree.map(lambda v: v[0], t)
+                return fusion.allreduce_pytree(local, op=hvd.Sum,
+                                               tuned_params=tp)
+
+            return jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+                                 out_specs=P())(tree)
+
+        out_tuned = run(tuned)
+        cfg = dataclasses.replace(
+            basics.config(), fusion_threshold_bytes=2 * MIB,
+            quant_block=128, hierarchical_allreduce=True)
+        monkeypatch.setattr(basics._state, "config", cfg)
+        out_env = run(None)
+        for k in tree:
+            np.testing.assert_array_equal(np.asarray(out_tuned[k]),
+                                          np.asarray(out_env[k]))
+
+
+class TestConfigRoundTrip:
+    """Three-layer contract: every --autotune-* CLI flag and YAML key
+    must land in Config with the same value (the env plumbing the
+    reference converges on; runner/config_parser.py)."""
+
+    AUTOTUNE_ARGS = {
+        "autotune": True,
+        "autotune_log_file": "/tmp/at.csv",
+        "autotune_warmup_samples": 5,
+        "autotune_steps_per_sample": 7,
+        "autotune_bayes_opt_max_samples": 11,
+        "autotune_gaussian_process_noise": 0.25,
+    }
+    CONFIG_FIELDS = {
+        "autotune": "autotune",
+        "autotune_log_file": "autotune_log",
+        "autotune_warmup_samples": "autotune_warmup_samples",
+        "autotune_steps_per_sample": "autotune_steps_per_sample",
+        "autotune_bayes_opt_max_samples": "autotune_bayes_opt_max_samples",
+        "autotune_gaussian_process_noise":
+            "autotune_gaussian_process_noise",
+    }
+
+    def _assert_lands_in_config(self, args, monkeypatch):
+        env = {}
+        config_parser.set_env_from_args(env, args)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        cfg = config_mod.from_env()
+        for attr, field in self.CONFIG_FIELDS.items():
+            assert getattr(cfg, field) == self.AUTOTUNE_ARGS[attr], field
+
+    def test_cli_flags_round_trip(self, monkeypatch):
+        # Every --autotune-* flag the launcher defines maps onto an env
+        # var (guards against adding a flag without wiring it).
+        from horovod_tpu.runner import launch
+
+        cli = ["--autotune", "--autotune-log-file", "/tmp/at.csv",
+               "--autotune-warmup-samples", "5",
+               "--autotune-steps-per-sample", "7",
+               "--autotune-bayes-opt-max-samples", "11",
+               "--autotune-gaussian-process-noise", "0.25"]
+        args = launch.parse_args(cli + ["-np", "1", "true"])
+        for attr, want in self.AUTOTUNE_ARGS.items():
+            assert getattr(args, attr) == want, attr
+            assert attr in config_parser._ARG_ENV or attr == "autotune", \
+                f"{attr} missing from config_parser._ARG_ENV"
+        self._assert_lands_in_config(args, monkeypatch)
+
+    def test_yaml_keys_round_trip(self, tmp_path, monkeypatch):
+        yaml_text = (
+            "autotune:\n"
+            "  enabled: true\n"
+            "  log-file: /tmp/at.csv\n"
+            "  warmup-samples: 5\n"
+            "  steps-per-sample: 7\n"
+            "  bayes-opt-max-samples: 11\n"
+            "  gaussian-process-noise: 0.25\n")
+        path = tmp_path / "hvd.yaml"
+        path.write_text(yaml_text)
+        args = argparse.Namespace(
+            **{a: None for a in self.AUTOTUNE_ARGS})
+        args.autotune = None
+        config_parser.parse_config_file(str(path), args)
+        for attr, want in self.AUTOTUNE_ARGS.items():
+            assert getattr(args, attr) == want, attr
+        self._assert_lands_in_config(args, monkeypatch)

@@ -35,6 +35,54 @@ def test_padding_to_atomic_unit():
     assert b.padded_size == 128
 
 
+def test_plan_buckets_oversized_leaf_own_bucket():
+    # A single leaf bigger than the threshold must become its own bucket
+    # — never an error, never shared with a following small leaf.
+    leaves = [jnp.zeros(10, jnp.float32), jnp.zeros(5000, jnp.float32),
+              jnp.zeros(10, jnp.float32)]
+    buckets = fusion.plan_buckets(leaves, threshold_bytes=1000)
+    by_leaf = {i: b for b in buckets for i in b.leaf_indices}
+    assert by_leaf[1].leaf_indices == (1,)
+    covered = sorted(i for b in buckets for i in b.leaf_indices)
+    assert covered == [0, 1, 2]
+    # Leading position too: still alone.
+    buckets = fusion.plan_buckets(
+        [jnp.zeros(5000, jnp.float32), jnp.zeros(10, jnp.float32)],
+        threshold_bytes=1000)
+    assert buckets[0].leaf_indices == (0,)
+    assert buckets[1].leaf_indices == (1,)
+
+
+def test_plan_buckets_zero_dim_and_empty_leaves():
+    # 0-d and zero-size leaves occupy one slot (the `or 1` path): the
+    # plan covers them and pack/unpack round-trips.
+    leaves = [jnp.asarray(3.5, jnp.float32), jnp.zeros((0,), jnp.float32),
+              jnp.asarray(np.arange(4), jnp.float32)]
+    buckets = fusion.plan_buckets(leaves, threshold_bytes=1 << 20)
+    assert len(buckets) == 1
+    assert buckets[0].sizes == (1, 1, 4)
+    covered = sorted(i for b in buckets for i in b.leaf_indices)
+    assert covered == [0, 1, 2]
+    out = fusion.unpack(buckets[0], fusion.pack(buckets[0], leaves))
+    for a, b in zip(leaves, out):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_plan_buckets_deterministic():
+    # The autotune warm-start cache keys on (tree-hash, mesh, world): the
+    # plan must be identical across identical pytrees and process runs.
+    def make_leaves(seed):
+        rs = np.random.RandomState(seed)
+        return [jnp.asarray(rs.randn(n), jnp.float32)
+                for n in (100, 7, 300, 1, 50)] + [
+                jnp.zeros(9, jnp.bfloat16), jnp.zeros(2, jnp.float32)]
+
+    p1 = fusion.plan_buckets(make_leaves(0), threshold_bytes=800)
+    p2 = fusion.plan_buckets(make_leaves(1), threshold_bytes=800)
+    assert p1 == p2  # values never enter the plan, only shapes/dtypes
+
+
 def test_pack_unpack_roundtrip():
     rng = np.random.RandomState(0)
     leaves = [jnp.asarray(rng.randn(3, 4), jnp.float32),
