@@ -167,6 +167,13 @@ def force_cpu_backend(n_devices: int):
     preinitialized a TPU client."""
     import jax
 
+    # jax < 0.5 has no jax_num_cpu_devices config; the XLA flag (parsed
+    # at backend creation, which the reset below forces) is the portable
+    # spelling, so set it unconditionally before clearing backends.
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag).strip()
     try:
         from jax._src import xla_bridge
 
@@ -175,7 +182,10 @@ def force_cpu_backend(n_devices: int):
     except Exception as e:
         log(f"backend force-reset unavailable ({e}); relying on config")
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass  # jax < 0.5: XLA_FLAGS above carries the device count
     devices = jax.devices()
     if len(devices) < n_devices:
         raise SystemExit(
@@ -350,8 +360,8 @@ def build_workload(args, global_batch):
             "gpt_cfg": gpt_cfg}
 
 
-def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
-             tuned_params=None):
+def run_once(args, devices, platform, *, quantized=False, zero=False,
+             mesh_shape=None, tuned_params=None):
     """One full measurement on ``devices``: init the world, build the
     model + DistributedOptimizer step, compile, warm up, time, and return
     the result row (no JSON printing — the caller owns the one-line
@@ -359,11 +369,13 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
     over growing device subsets.
 
     ``quantized`` selects the int8 DCN wire with error feedback in the
-    DistributedOptimizer; ``mesh_shape=(cross, local)`` emulates a
+    DistributedOptimizer; ``zero`` the ZeRO-1 sharded optimizer update
+    (reduce-scatter grads → per-rank optax update on 1/world shards →
+    all-gather, docs/zero.md); ``mesh_shape=(cross, local)`` emulates a
     multi-host topology (a real DCN hop) on a single host. Under
-    ``--quantized`` both A/B legs run the reduce-in-optimizer step
-    structure so the comparison is like-for-like. ``tuned_params`` (the
-    frozen winner of an autotune session) overrides the collective
+    ``--quantized``/``--zero`` both A/B legs run the reduce-in-optimizer
+    step structure so the comparison is like-for-like. ``tuned_params``
+    (the frozen winner of an autotune session) overrides the collective
     tunables for this leg — the ``--autotune`` A/B measures its value."""
     import jax
     import numpy as np
@@ -388,6 +400,7 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
                                   compression=compression,
                                   quantized=quantized,
+                                  zero=zero,
                                   tuned_params=tuned_params)
     opt_state = tx.init(params)
 
@@ -398,7 +411,15 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
     # Pin shardings up front so step 2 doesn't recompile on resharded args.
     params = jax.device_put(params, rep)
     batch_stats = jax.device_put(batch_stats, rep)
-    if quantized:
+    if zero:
+        # ZeRO state: flat bucket moments (and EF residuals) shard
+        # rank-major over the mesh; scalars replicate
+        # (hvd.zero_state_pspecs docstring).
+        state_spec = hvd.zero_state_pspecs(opt_state)
+        opt_state = jax.device_put(
+            opt_state,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), state_spec))
+    elif quantized:
         # Error-feedback residuals are per-rank state: leaves carry a
         # leading world axis sharded over the mesh; the inner optimizer
         # state stays replicated (hvd.QuantizedEFState docstring).
@@ -409,16 +430,30 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
     else:
         opt_state = jax.device_put(opt_state, rep)
         state_spec = P()
+    # Optimizer-state bytes this rank actually holds: on the ZeRO leg
+    # every non-scalar leaf shards 1/world over the mesh (the
+    # zero_state_pspecs contract), so per-rank bytes shrink world× — the
+    # memory metric the A/B reports.
+    if zero:
+        opt_state_bytes_per_rank = float(sum(
+            (l.nbytes / n_chips if getattr(l, "ndim", 0) >= 1 else l.nbytes)
+            for l in jax.tree.leaves(opt_state)))
+    else:
+        opt_state_bytes_per_rank = float(sum(
+            getattr(l, "nbytes", 0) for l in jax.tree.leaves(opt_state)))
+    log(f"opt_state bytes/rank: {opt_state_bytes_per_rank / 1e6:.3f} MB"
+        + (" (ZeRO-sharded)" if zero else " (replicated)"))
     images = jax.device_put(images, data_sh)
     labels = jax.device_put(labels, data_sh)
 
-    # Under --quantized or --autotune (any leg) the optimizer owns the
-    # gradient reduction: reduce=False keeps the raw gradients per-rank
-    # locals so the fused (and, on the quantized leg, int8+error-feedback)
-    # bucket wire inside tx.update is the one and only gradient collective
-    # — the wire the autotuner's fusion/hierarchical knobs actually steer
+    # Under --quantized, --zero, or --autotune (any leg) the optimizer
+    # owns the gradient reduction: reduce=False keeps the raw gradients
+    # per-rank locals so the fused (and, on the quantized leg,
+    # int8+error-feedback; on the zero leg, reduce-scattered) bucket wire
+    # inside tx.update is the one and only gradient collective — the wire
+    # the autotuner's fusion/hierarchical knobs actually steer
     # (auto-psummed replicated grads never touch the fusion path).
-    reduce_in_optimizer = bool(args.quantized
+    reduce_in_optimizer = bool(args.quantized or getattr(args, "zero", False)
                                or getattr(args, "autotune", False))
 
     def spmd(p, bs, s, xb, yb):
@@ -453,7 +488,7 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
     # Donate params/batch_stats/opt_state: the step overwrites them, so XLA
     # can update in place instead of allocating fresh HBM buffers — on a
     # bandwidth-bound chip the avoided copy is measurable.
-    train_step = jax.jit(jax.shard_map(
+    train_step = jax.jit(hvd.shard_map(
         step_body, mesh=mesh,
         in_specs=(P(), P(), state_spec, hvd.data_pspec(), hvd.data_pspec()),
         out_specs=(P(), P(), state_spec, P())), donate_argnums=(0, 1, 2))
@@ -572,6 +607,7 @@ def run_once(args, devices, platform, *, quantized=False, mesh_shape=None,
         "wire_bytes_dcn": wire.dcn_bytes,
         "wire_bytes_dcn_fp": wire.dcn_bytes_fp,
         "wire_reduction_dcn": wire.dcn_reduction,
+        "opt_state_bytes_per_rank": opt_state_bytes_per_rank,
     }
 
 
@@ -622,7 +658,7 @@ def run_autotune_session(args, devices, platform, mesh_shape):
             return optax.apply_updates(p, updates), nbs, ns, \
                 hvd.allreduce(loss)
 
-        train = jax.jit(jax.shard_map(
+        train = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(), P(), P(), hvd.data_pspec(), hvd.data_pspec()),
             out_specs=(P(), P(), P(), P())))
@@ -710,6 +746,14 @@ def main():
                          "feedback in the optimizer): runs a baseline leg "
                          "and a quantized leg over the same step structure "
                          "and reports wire-bytes and throughput deltas")
+    ap.add_argument("--zero", action="store_true",
+                    help="A/B the ZeRO-1 sharded optimizer (reduce-scatter "
+                         "grads, per-rank optax update on 1/world flat "
+                         "shards, all-gather updates): runs a replicated "
+                         "leg and a sharded leg over the same fused "
+                         "reduce-in-optimizer step and reports "
+                         "throughput_delta, opt_state_bytes_per_rank and "
+                         "wire bytes (docs/zero.md)")
     ap.add_argument("--autotune", action="store_true",
                     help="run the online Bayesian tuning session "
                          "(hvd.autotune_session: GP/EI over fusion "
@@ -761,13 +805,18 @@ def main():
                      f"got {args.scaling!r}")
         if not sweep or sweep[0] < 1:
             ap.error("--scaling sizes must be >= 1")
-        if args.quantized or args.mesh_shape or args.autotune:
+        if args.quantized or args.mesh_shape or args.autotune or args.zero:
             ap.error("--scaling cannot combine with --quantized/"
-                     "--mesh-shape/--autotune (the sweep re-shapes the "
-                     "world per size)")
-    if args.autotune and (args.quantized or args.profile):
-        ap.error("--autotune cannot combine with --quantized/--profile "
-                 "(one A/B structure per run)")
+                     "--mesh-shape/--autotune/--zero (the sweep re-shapes "
+                     "the world per size)")
+    if args.autotune and (args.quantized or args.profile or args.zero):
+        ap.error("--autotune cannot combine with --quantized/--profile/"
+                 "--zero (one A/B structure per run)")
+    if args.zero and args.quantized:
+        ap.error("--zero cannot combine with --quantized (one A/B "
+                 "structure per run; the quantized ZeRO wire is covered "
+                 "by DistributedOptimizer(zero=True, quantized=True) and "
+                 "tests/test_zero.py)")
 
     mesh_shape = None
     if args.mesh_shape:
@@ -817,13 +866,14 @@ def main():
             len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
                          f"does not cover {len(devices)} devices")
-    if (args.quantized or args.autotune) and mesh_shape is None \
+    if (args.quantized or args.autotune or args.zero) and mesh_shape is None \
             and len(devices) % 2 == 0 and len(devices) >= 2:
-        # A DCN (cross) hop is what quantization compresses and what the
-        # hierarchical-allreduce knob decomposes; emulate a 2-host
+        # A DCN (cross) hop is what quantization compresses, what the
+        # hierarchical-allreduce knob decomposes, and what splits the
+        # ZeRO reduce-scatter into its ICI/DCN legs; emulate a 2-host
         # topology unless the user pinned one.
         mesh_shape = (2, len(devices) // 2)
-        log(f"--{'quantized' if args.quantized else 'autotune'}: "
+        log(f"--{'quantized' if args.quantized else 'zero' if args.zero else 'autotune'}: "
             f"emulating mesh_shape {mesh_shape} so the collectives have "
             f"a cross (DCN) hop")
 
@@ -922,6 +972,57 @@ def main():
                            if mesh_shape else None),
             "baseline_per_chip": round(res_d["per_chip"], 2),
             "throughput_delta": round(delta, 4),
+            **gpt_fields,
+        }), flush=True)
+        return
+
+    if args.zero:
+        # A/B: identical step structure (reduce-in-optimizer), identical
+        # mesh, same fused bucket schedule; only the update layout changes
+        # (replicated full update vs reduce-scatter → 1/world shard update
+        # → all-gather). Baseline first so a sharded-path failure still
+        # leaves a reference number in the log.
+        log("=== A/B leg 1/2: baseline (replicated optimizer update) ===")
+        res_b = run_once(args, devices, platform, zero=False,
+                         mesh_shape=mesh_shape)
+        log("=== A/B leg 2/2: ZeRO-1 sharded optimizer update ===")
+        res_z = run_once(args, devices, platform, zero=True,
+                         mesh_shape=mesh_shape)
+        delta = res_z["per_chip"] / res_b["per_chip"] - 1.0
+        log(f"A/B: replicated {res_b['per_chip']:.1f} vs ZeRO "
+            f"{res_z['per_chip']:.1f} {res_b['unit']} "
+            f"({100 * delta:+.1f}%); opt state "
+            f"{res_b['opt_state_bytes_per_rank'] / 1e6:.3f} -> "
+            f"{res_z['opt_state_bytes_per_rank'] / 1e6:.3f} MB/rank "
+            f"({res_b['opt_state_bytes_per_rank'] / max(1.0, res_z['opt_state_bytes_per_rank']):.2f}x)")
+        print(json.dumps({
+            "metric": metric,
+            "value": round(res_z["per_chip"], 2),
+            "unit": res_z["unit"],
+            "vs_baseline": None,
+            "mfu": (round(res_z["mfu"], 4)
+                    if res_z["mfu"] is not None else None),
+            "step_ms_median": round(res_z["step_ms_median"], 3),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "chips": res_z["chips"],
+            "per_chip_batch": args.batch_size,
+            "zero": True,
+            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                           if mesh_shape else None),
+            "baseline_per_chip": round(res_b["per_chip"], 2),
+            "throughput_delta": round(delta, 4),
+            "opt_state_bytes_per_rank": round(
+                res_z["opt_state_bytes_per_rank"], 1),
+            "opt_state_bytes_per_rank_baseline": round(
+                res_b["opt_state_bytes_per_rank"], 1),
+            "opt_state_reduction": round(
+                res_b["opt_state_bytes_per_rank"]
+                / max(1.0, res_z["opt_state_bytes_per_rank"]), 3),
+            "wire_bytes_ici": round(res_z["wire_bytes_ici"], 1),
+            "wire_bytes_dcn": round(res_z["wire_bytes_dcn"], 1),
+            "wire_bytes_ici_baseline": round(res_b["wire_bytes_ici"], 1),
+            "wire_bytes_dcn_baseline": round(res_b["wire_bytes_dcn"], 1),
             **gpt_fields,
         }), flush=True)
         return

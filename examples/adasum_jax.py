@@ -44,7 +44,7 @@ def train(op_name: str, steps: int, seed: int = 0):
             updates, state2 = opt.update(grads, state, params)
             return optax.apply_updates(params, updates), state2, \
                 hvd.allreduce(loss, op=hvd.Average)
-        return jax.shard_map(
+        return hvd.shard_map(
             spmd, mesh=hvd.mesh(),
             in_specs=(P(), P(), hvd.data_pspec(), hvd.data_pspec()),
             out_specs=(P(), P(), P()))(params, state, x, y)

@@ -95,7 +95,7 @@ def main():
             updates, ns = tx.update(grads, s, p)
             return optax.apply_updates(p, updates), ns, hvd.allreduce(loss)
 
-        return jax.shard_map(
+        return hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(), P(), data_spec, data_spec),
             out_specs=(P(), P(), P()))(p, s, xb, yb)

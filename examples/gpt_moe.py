@@ -119,7 +119,7 @@ def main():
         rp = jax.tree.map(lambda a, g: a - args.lr * g, rp, g_rp)
         return stk, rp, loss
 
-    stepc = jax.jit(jax.shard_map(
+    stepc = jax.jit(hvd.shard_map(
         step, mesh=mesh,
         in_specs=(P(hvd.LOCAL_AXIS), P(),
                   P((hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),

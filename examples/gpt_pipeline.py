@@ -88,7 +88,7 @@ def main():
                 lambda p, g: p - args.lr * g.astype(p.dtype), rst, g_rest)
             return jax.tree.map(lambda a: a[None], local), rst, loss
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(hvd.shard_map(
         spmd, mesh=mesh,
         in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
         out_specs=(P(hvd.HVD_AXES), P(), P())))

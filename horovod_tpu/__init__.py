@@ -67,6 +67,7 @@ from .common.basics import (  # noqa: F401
     mpi_threads_supported,
     rank,
     replicated_sharding,
+    shard_map,
     shutdown,
     size,
 )
@@ -82,6 +83,7 @@ from .ops.collective_ops import (  # noqa: F401
     Product,
     ReduceOp,
     Sum,
+    all_gather,
     allgather,
     allgather_async,
     allreduce,
@@ -97,6 +99,7 @@ from .ops.collective_ops import (  # noqa: F401
     poll,
     quantized_allreduce,
     record_wire_stats,
+    reduce_scatter,
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
@@ -119,6 +122,9 @@ from .ops.softmax_xent import (  # noqa: F401
 from .parallel.optimizer import (  # noqa: F401
     DistributedOptimizer,
     QuantizedEFState,
+    ZeroState,
+    zero_reshard_state,
+    zero_state_pspecs,
 )
 from .parallel.sequence import (  # noqa: F401
     dense_attention,

@@ -30,7 +30,8 @@ from .parameter_manager import ParameterManager, TunedParams
 log = logging.getLogger("horovod_tpu.autotune")
 
 # Cache-entry schema version; bump when TunedParams gains/changes knobs.
-_CACHE_VERSION = 1
+# v2: + zero_sharding (ZeRO-1 sharded optimizer).
+_CACHE_VERSION = 2
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -131,6 +132,7 @@ def autotune_session(
     enabled: Optional[bool] = None,
     tune_quant_block: Optional[bool] = None,
     tune_hierarchical: bool = True,
+    tune_zero: bool = False,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -157,6 +159,13 @@ def autotune_session(
     ``HOROVOD_AUTOTUNE`` knob: with it off the session is a no-op that
     returns the initial (hand-set) parameters untouched, keeping the
     default path bit-identical.
+
+    ``tune_zero`` adds the ZeRO-sharding flag to the search space; leave
+    it False (the default) unless ``make_step`` actually threads
+    ``tuned.zero_sharding`` through (``DistributedOptimizer(tuned_params=
+    tuned)`` + ``hvd.value_and_grad(..., tuned_params=tuned)`` do) — the
+    knob restructures the optimizer state, so a step built without it
+    would silently score a config it never ran.
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -209,6 +218,7 @@ def autotune_session(
         initial,
         tune_quant_block=tune_quant_block,
         tune_hierarchical=tune_hierarchical,
+        tune_zero=tune_zero,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,

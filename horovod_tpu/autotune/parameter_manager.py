@@ -11,8 +11,10 @@ The compiled-path differences from the native eager manager:
 
 * knobs are :class:`TunedParams` — fusion threshold (1–256 MiB,
   log-space), ``quant_block`` (64–1024, log-space, power-of-two snapped,
-  searched only when the quantized wire is on) and the hierarchical
-  allreduce flag. Cycle time and the response cache do not exist on the
+  searched only when the quantized wire is on), the hierarchical
+  allreduce flag, and the ``zero_sharding`` flag (relaxed categoricals
+  at 0.25/0.75; zero is searched only when the session's step accepts
+  it — it restructures the optimizer state, see docs/zero.md). Cycle time and the response cache do not exist on the
   compiled path (the XLA schedule replaces both — ops/fusion.py);
 * scores are wall-clock **steps/sec** of a real training window (the
   driver times them), not coordinator bytes/sec — on the compiled path
@@ -42,12 +44,13 @@ _MIN_FUSION_LOG = 20.0  # 2^20 = 1 MiB
 _MAX_FUSION_LOG = 28.0  # 2^28 = 256 MiB
 _MIN_QBLOCK_LOG = 6.0   # 2^6  = 64
 _MAX_QBLOCK_LOG = 10.0  # 2^10 = 1024
-_DIMS = 3  # fusion, quant_block, hierarchical
+_DIMS = 4  # fusion, quant_block, hierarchical, zero_sharding
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
-              "hierarchical_allreduce", "score_steps_per_sec")
+              "hierarchical_allreduce", "zero_sharding",
+              "score_steps_per_sec")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -60,20 +63,25 @@ class TunedParams:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     quant_block: int = 256
     hierarchical_allreduce: bool = False
+    zero_sharding: bool = False
 
     def as_dict(self) -> dict:
         return {
             "fusion_threshold_bytes": int(self.fusion_threshold_bytes),
             "quant_block": int(self.quant_block),
             "hierarchical_allreduce": bool(self.hierarchical_allreduce),
+            "zero_sharding": bool(self.zero_sharding),
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "TunedParams":
+        # .get: entries cached before the zero knob existed stay readable
+        # (the cache key's schema version gates real reuse).
         return cls(
             fusion_threshold_bytes=int(d["fusion_threshold_bytes"]),
             quant_block=int(d["quant_block"]),
             hierarchical_allreduce=bool(d["hierarchical_allreduce"]),
+            zero_sharding=bool(d.get("zero_sharding", False)),
         )
 
     @classmethod
@@ -85,6 +93,7 @@ class TunedParams:
             fusion_threshold_bytes=config.fusion_threshold_bytes,
             quant_block=config.quant_block,
             hierarchical_allreduce=config.hierarchical_allreduce,
+            zero_sharding=getattr(config, "zero_sharding", False),
         )
 
 
@@ -129,6 +138,7 @@ class ParameterManager:
         *,
         tune_quant_block: bool = False,
         tune_hierarchical: bool = True,
+        tune_zero: bool = False,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -144,6 +154,10 @@ class ParameterManager:
         self.best_score = -math.inf
         self.tune_quant_block = tune_quant_block
         self.tune_hierarchical = tune_hierarchical
+        # zero_sharding restructures the step (ZeroState layout), so it is
+        # searched only when the session's step builder declares it can
+        # accept the knob (autotune_session(tune_zero=True)).
+        self.tune_zero = tune_zero
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -171,8 +185,10 @@ class ParameterManager:
         return (
             (f - _MIN_FUSION_LOG) / (_MAX_FUSION_LOG - _MIN_FUSION_LOG),
             (q - _MIN_QBLOCK_LOG) / (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG),
-            # Booleans sit at 0.25/0.75, well inside the box.
+            # Booleans (relaxed categoricals) sit at 0.25/0.75, well
+            # inside the box.
             0.75 if p.hierarchical_allreduce else 0.25,
+            0.75 if p.zero_sharding else 0.25,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -187,10 +203,13 @@ class ParameterManager:
             qblock = self.initial.quant_block
         hier = (u[2] >= 0.5 if self.tune_hierarchical
                 else self.initial.hierarchical_allreduce)
+        zero = (u[3] >= 0.5 if self.tune_zero
+                else self.initial.zero_sharding)
         return TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
             hierarchical_allreduce=hier,
+            zero_sharding=zero,
         )
 
     def _unit_key(self, p: TunedParams) -> tuple:
@@ -199,7 +218,7 @@ class ParameterManager:
         # Fusion threshold dedups at 1/4-octave resolution — finer than
         # that cannot change a bucket plan by more than rounding.
         return (round(math.log2(max(1, p.fusion_threshold_bytes)) * 4),
-                p.quant_block, p.hierarchical_allreduce)
+                p.quant_block, p.hierarchical_allreduce, p.zero_sharding)
 
     # -- sampling loop ---------------------------------------------------
 
@@ -238,6 +257,7 @@ class ParameterManager:
         self._csv.writerow([len(self.history), p.fusion_threshold_bytes,
                             p.quant_block,
                             int(p.hierarchical_allreduce),
+                            int(p.zero_sharding),
                             f"{score:.6g}"])
         self._log.flush()
 
@@ -247,15 +267,17 @@ class ParameterManager:
         self.close()
         log.info(
             "autotune converged after %d samples: fusion_threshold=%d "
-            "quant_block=%d hierarchical=%s (best %.3f steps/sec)",
+            "quant_block=%d hierarchical=%s zero=%s (best %.3f steps/sec)",
             len(self.history), self.best.fusion_threshold_bytes,
             self.best.quant_block, self.best.hierarchical_allreduce,
-            self.best_score)
+            self.best.zero_sharding, self.best_score)
 
     def _sample_unit(self) -> Tuple[float, ...]:
         u = [self._rng.next() for _ in range(_DIMS)]
         if not self.tune_hierarchical:
             u[2] = 0.25
+        if not self.tune_zero:
+            u[3] = 0.25
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -311,6 +333,7 @@ def read_log(path: str) -> List[dict]:
                 "quant_block": int(rec["quant_block"]),
                 "hierarchical_allreduce": bool(
                     int(rec["hierarchical_allreduce"])),
+                "zero_sharding": bool(int(rec.get("zero_sharding", 0))),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             })
     return rows

@@ -56,6 +56,15 @@ CROSS_AXIS = "hvd_cross"
 LOCAL_AXIS = "hvd_local"
 HVD_AXES: Tuple[str, str] = (CROSS_AXIS, LOCAL_AXIS)
 
+# ``jax.shard_map`` graduated from jax.experimental in jax 0.6; on the
+# pinned 0.4.x line only the experimental spelling exists. This resolver is
+# the single home every horovod_tpu caller (and the test suite, via
+# ``hvd.shard_map``) goes through, so either jax works unmodified.
+if getattr(jax, "shard_map", None) is not None:
+    shard_map = jax.shard_map
+else:  # jax < 0.6
+    from jax.experimental.shard_map import shard_map
+
 
 class _State:
     """Process-global framework state (reference: HorovodGlobalState,

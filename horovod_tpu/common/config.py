@@ -73,6 +73,10 @@ class Config:
     quantized_allreduce: bool = False
     quant_block: int = 256  # elements per int8 scale block
 
+    # --- ZeRO-1 sharded optimizer (no reference analogue; reduce-scatter
+    #     data parallelism with per-rank optax updates, docs/zero.md) ---
+    zero_sharding: bool = False
+
     # --- autotune (common.h:68-73) ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -125,6 +129,7 @@ def from_env() -> Config:
         hierarchical_allgather=_env_bool("HOROVOD_HIERARCHICAL_ALLGATHER", False),
         quantized_allreduce=_env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False),
         quant_block=_env_int("HOROVOD_QUANT_BLOCK", 256),
+        zero_sharding=_env_bool("HOROVOD_ZERO_SHARDING", False),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),
         autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
         autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

@@ -83,21 +83,74 @@ def _resolve_axes(axes) -> Tuple[str, ...]:
     return tuple(axes)
 
 
+def _axis_size(name) -> int:
+    """Size of a bound mesh axis. ``lax.axis_size`` appeared alongside the
+    graduated ``jax.shard_map``; on jax 0.4.x the size comes from the axis
+    env directly (the same source ``basics._bound_axes`` reads)."""
+    try:
+        return lax.axis_size(name)
+    except AttributeError:  # jax < 0.6
+        from jax._src.core import get_axis_env
+
+        return get_axis_env().axis_sizes[name]
+
+
 def _world_size(axes: Tuple[str, ...]):
     n = 1
     for a in axes:
-        n *= lax.axis_size(a)
+        n *= _axis_size(a)
     return n
 
 
 def _vma(x) -> frozenset:
     """Varying-manual-axes of ``x``: which mesh axes the value differs
-    across. JAX tracks this in the aval; an empty set means the value is
-    provably identical on every device."""
+    across. JAX >= 0.6 tracks this in the aval (``jax.typeof(x).vma``);
+    jax 0.4.x's ``shard_map(check_rep=True)`` tracks the complement — the
+    set of axes a value is provably *replicated* over — on its rewrite
+    tracers, so there vma = bound axes - rep. An empty set means the value
+    is provably identical on every device."""
     try:
         return frozenset(jax.typeof(x).vma)
+    except Exception:
+        pass
+    try:  # jax < 0.6: check_rep replication tracking
+        from jax.experimental.shard_map import get_replication
+
+        while True:
+            try:
+                rep = get_replication(x)
+                break
+            except Exception:
+                # Wrapper tracers (JVP/linearize) carry the rep on their
+                # primal; get_replication itself unwraps batching.
+                primal = getattr(x, "primal", None)
+                if primal is None:
+                    raise
+                x = primal
+        return frozenset(basics._bound_axes()) - frozenset(rep)
     except Exception:  # pragma: no cover - non-traced / API drift
         return frozenset()
+
+
+def _pvary(x, axes) -> "jax.Array":
+    """Cast ``x`` to be varying over ``axes`` (a free type-level
+    broadcast). ``lax.pcast`` on jax >= 0.6; jax 0.4.x spells the same
+    rep-set adjustment ``shard_map.pbroadcast``."""
+    if not axes:
+        return x
+    try:
+        return lax.pcast(x, tuple(axes), to="varying")
+    except AttributeError:  # jax < 0.6
+        from jax.experimental.shard_map import pbroadcast
+
+        try:
+            return pbroadcast(x, tuple(axes))
+        except Exception:
+            # pbroadcast rejects operands that are ALREADY device-varying
+            # over the axes — which only happens when the rep set was not
+            # recoverable from a wrapper tracer. Varying is what the
+            # caller wanted; the value itself is untouched either way.
+            return x
 
 
 def pvary_missing(x, axes) -> "jax.Array":
@@ -106,7 +159,7 @@ def pvary_missing(x, axes) -> "jax.Array":
     are missing). The single home for this idiom — used by the gradient
     tape, the Pallas kernel wrappers, and the pipeline scan inits."""
     missing = tuple(a for a in axes if a not in _vma(x))
-    return lax.pcast(x, missing, to="varying") if missing else x
+    return _pvary(x, missing) if missing else x
 
 
 def _is_replicated(x, axes: Tuple[str, ...]) -> bool:
@@ -186,11 +239,11 @@ def _acct_psum(x, axes) -> None:
     n = float(np.prod(x.shape)) if x.ndim else 1.0
     isz = jnp.dtype(x.dtype).itemsize
     if LOCAL_AXIS in axes:
-        nl = lax.axis_size(LOCAL_AXIS)
+        nl = _axis_size(LOCAL_AXIS)
         _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
         n /= nl
     if CROSS_AXIS in axes:
-        nc = lax.axis_size(CROSS_AXIS)
+        nc = _axis_size(CROSS_AXIS)
         _acct("dcn", 2.0 * n * (nc - 1) / nc * isz)
 
 
@@ -200,12 +253,12 @@ def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
     nccl_operations.cc:190-380, including the non-divisible remainder handled
     separately — here via the flat-psum fallback, matching the reference's
     root reduce/bcast remainder leg at nccl_operations.cc:244-307)."""
-    nl = lax.axis_size(local_axis)
+    nl = _axis_size(local_axis)
     if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
         if _wire_recorders:
             n = float(np.prod(x.shape))
             isz = jnp.dtype(x.dtype).itemsize
-            nc = lax.axis_size(cross_axis)
+            nc = _axis_size(cross_axis)
             _acct("ici", n * (nl - 1) / nl * isz)        # psum_scatter
             _acct("dcn", 2.0 * (n / nl) * (nc - 1) / nc * isz)  # cross psum
             _acct("ici", 2.0 * n * (nl - 1) / nl * isz)  # gather-leg psum
@@ -270,8 +323,8 @@ def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
     as zeros) when there is no cross axis or the flattened size does not
     shard evenly over ``local_size * cross_size``.
     """
-    nl = lax.axis_size(local_axis)
-    nc = lax.axis_size(cross_axis)
+    nl = _axis_size(local_axis)
+    nc = _axis_size(cross_axis)
     blk = _quant_block_size(block)
     corrected = x if residual is None else x + residual.astype(x.dtype)
     n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 0
@@ -348,6 +401,377 @@ def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
     res_full = lax.dynamic_update_slice_in_dim(
         jnp.zeros((n,), jnp.float32), err_sh, li * sn, 0)
     return out, res_full.reshape(x.shape).astype(residual.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Bucket-level reduce-scatter / all-gather — the ZeRO-1 wire pair.
+#
+# A fused gradient bucket planned with ``plan_buckets(shard_multiple=world)``
+# (ops/fusion.py) reduce-scatters into ``world`` contiguous flat shards in
+# RANK-MAJOR order (rank r = cross*local_size + local owns
+# ``[r*seg, (r+1)*seg)``), the optimizer updates only its shard, and the
+# updated values all-gather back. Rank-major ordering matches how
+# ``P(HVD_AXES)`` splits a leading dim, so sharded optimizer state outside
+# the trace is the flat bucket itself — no permutation.
+#
+# The hierarchical decomposition follows HiCCL's placement rule (the same
+# one _psum_quantized implements): the ICI leg always rides the payload
+# dtype; only the cross-host DCN leg is eligible for the blockwise-int8
+# wire. reduce_scatter is hops 1-2 of _psum_quantized, all_gather is hops
+# 3-4 — ZeRO splits that collective in half and runs the optimizer update
+# in between.
+# ---------------------------------------------------------------------------
+
+
+def _quant_rs_leg(segs, blk: int, cross_axis):
+    """Quantized DCN reduce-scatter leg (hop 2 of :func:`_psum_quantized`):
+    ``segs`` is this rank's ICI-scattered shard viewed ``[nc, seg]`` in
+    fp32, row ``j`` destined to cross rank ``j``. Returns
+    ``(reduced_seg [seg] fp32, err [nc, seg] fp32)`` where ``err`` is this
+    rank's quantization error on everything it sent."""
+    nc, seg = segs.shape
+    pad = (-seg) % blk
+    if pad:
+        segs = jnp.concatenate(
+            [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
+    nb = segs.shape[1] // blk
+    blocks = segs.reshape(nc, nb, blk)
+    scales = _compression._block_scales(blocks)            # [nc, nb]
+    q = jnp.clip(jnp.round(blocks / scales[..., None]),
+                 -127, 127).astype(jnp.int8)
+    err = blocks - q.astype(jnp.float32) * scales[..., None]
+    qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)
+    return (acc.reshape(nb * blk)[:seg],
+            err.reshape(nc, nb * blk)[:, :seg])
+
+
+def _quant_ag_leg(seg_vals, blk: int, cross_axis):
+    """Quantized DCN all-gather leg (hop 3 of :func:`_psum_quantized`):
+    quantize this rank's owned segment ``[seg]`` (fp32) and rebroadcast it
+    as a masked int8 psum — disjoint support makes the sum exact and the
+    result replicated over ``cross_axis`` BY CONSTRUCTION. Returns
+    ``(vals [nc, seg] fp32, err [seg] fp32)``."""
+    nc = _axis_size(cross_axis)
+    seg = seg_vals.shape[0]
+    pad = (-seg) % blk
+    padded = (jnp.concatenate([seg_vals, jnp.zeros((pad,), jnp.float32)])
+              if pad else seg_vals)
+    nb = padded.shape[0] // blk
+    blocks = padded.reshape(nb, blk)
+    s2 = _compression._block_scales(blocks)                # [nb]
+    q2 = jnp.clip(jnp.round(blocks / s2[:, None]),
+                  -127, 127).astype(jnp.int8)
+    err = (blocks - q2.astype(jnp.float32) * s2[:, None]).reshape(
+        nb * blk)[:seg]
+    ci = lax.axis_index(cross_axis)
+    qfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
+    sfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb), jnp.float32), s2[None], ci, 0)
+    qg = lax.psum(qfull, cross_axis)
+    sg = lax.psum(sfull, cross_axis)
+    vals = (qg.astype(jnp.float32) * sg[..., None]).reshape(
+        nc, nb * blk)[:, :seg]
+    return vals, err
+
+
+def _rs_postscale(shard, op: ReduceOp, world: int, postscale_factor: float):
+    post = postscale_factor
+    if op == ReduceOp.AVERAGE:
+        post = post / world
+    return _scale(shard, post)
+
+
+def reduce_scatter(
+    tensor,
+    residual=None,
+    *,
+    op: ReduceOp = ReduceOp.AVERAGE,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    name: Optional[str] = None,
+    axes=None,
+    quantized: Optional[bool] = None,
+    block: Optional[int] = None,
+    _presummed: bool = False,
+):
+    """Reduce a flat buffer across all ranks and return this rank's
+    contiguous ``1/world`` shard (rank-major: rank ``r`` owns elements
+    ``[r*seg, (r+1)*seg)`` of the reduction).
+
+    The ZeRO-1 gradient wire: where :func:`allreduce` moves
+    ``2n(k-1)/k`` bytes per device, the reduce-scatter half moves
+    ``n(k-1)/k`` and leaves each rank holding exactly the shard its
+    optimizer partition updates. Only ``op=Average``/``Sum`` are defined
+    (a scatter of min/max has no reference analogue and no user).
+
+    ``quantized`` (default: the ``HOROVOD_QUANTIZED_ALLREDUCE`` knob)
+    sends blockwise-int8 on the cross-host (DCN) leg of the hierarchical
+    decomposition — hop 2 of :func:`_psum_quantized`; the ICI leg keeps
+    the payload dtype. ``residual`` is the error-feedback accumulator for
+    that leg, sized ``n / local_size`` (this rank's ICI-scattered shard —
+    quantization error lives on what this rank *sends*, which is its
+    post-ICI shard, not its final ``1/world`` segment); pass zeros
+    initially and the call returns ``(shard, new_residual)``. Without
+    ``residual`` the return is just ``shard``. On exact paths (quantized
+    off, no cross axis, eager) a provided residual is consumed into the
+    payload and returned as zeros.
+
+    In-trace the input must divide evenly by the world size — pack it
+    with ``plan_buckets(shard_multiple=world)`` (ops/fusion.py). Eagerly
+    the reduction runs over the process world through the native core
+    (allreduce + local slice; byte savings are a compiled-path feature).
+    """
+    tensor = jnp.asarray(tensor)
+    if tensor.ndim != 1:
+        raise ValueError(
+            f"reduce_scatter operates on flat bucket buffers, got shape "
+            f"{tensor.shape} — ravel and pad with plan_buckets/pack")
+    if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
+        raise ValueError(f"reduce_scatter supports Average/Sum, got {op}")
+    axes_t = _resolve_axes(axes)
+    quantized = _resolve_quantized(quantized, Compression.none)
+    quantized = quantized and jnp.issubdtype(tensor.dtype, jnp.floating)
+
+    if not axes_t:
+        return _eager_reduce_scatter(tensor, residual, op,
+                                     prescale_factor, postscale_factor,
+                                     name)
+
+    world = _world_size(axes_t)
+    n = int(tensor.shape[0])
+    if n % world:
+        raise ValueError(
+            f"reduce_scatter buffer of {n} elements does not divide into "
+            f"{world} shards — plan buckets with shard_multiple=world")
+    seg = n // world
+
+    if _is_replicated(tensor, axes_t):
+        # No wire. presummed (gradient path): the value is already the
+        # cross-rank sum — slice it (Average adds the /world). Otherwise
+        # equal per-rank contributions: Sum scales by world, Average is
+        # the identity — exactly what the wire would return.
+        x = _scale(tensor, prescale_factor)
+        rank = lax.axis_index(axes_t)
+        shard = lax.dynamic_slice_in_dim(x, rank * seg, seg, 0)
+        if _presummed:
+            shard = _rs_postscale(shard, op, world, postscale_factor)
+        else:
+            if op == ReduceOp.SUM:
+                shard = _scale(shard, float(world))
+            shard = _scale(shard, postscale_factor)
+        new_res = None if residual is None else jnp.zeros_like(residual)
+        return shard if residual is None else (shard, new_res)
+
+    flat = _scale(pvary_missing(tensor, axes_t), prescale_factor)
+    hierarchical = (set(axes_t) == set(HVD_AXES)
+                    and (quantized or residual is not None))
+    if hierarchical:
+        nl = _axis_size(LOCAL_AXIS)
+        nc = _axis_size(CROSS_AXIS)
+        sn = n // nl
+        isz = jnp.dtype(flat.dtype).itemsize
+        blk = _quant_block_size(block)
+        if _wire_recorders:
+            _acct("ici", n * (nl - 1) / nl * isz)          # ICI psum_scatter
+            if nc > 1:
+                if quantized:
+                    pad_n = ((-seg) % blk + seg) * nc
+                    q_unit = pad_n + (pad_n // blk) * 4.0
+                    _acct("dcn", q_unit * (nc - 1) / nc,
+                          float(sn) * (nc - 1) / nc * isz)
+                else:
+                    _acct("dcn", sn * (nc - 1) / nc * isz)
+        # ICI leg, rank-major: view [nc, nl, seg], scatter the nl dim.
+        h = lax.psum_scatter(flat.reshape(nc, nl, seg), LOCAL_AXIS,
+                             scatter_dimension=1, tiled=True)
+        h = h.reshape(nc, seg)
+        new_res = None
+        if residual is not None:
+            if residual.shape != (sn,):
+                raise ValueError(
+                    f"reduce_scatter residual must be the post-ICI shard "
+                    f"[{sn}] (= n/local_size), got {residual.shape}")
+            h = h + residual.reshape(nc, seg).astype(h.dtype)
+        if nc == 1:
+            shard = h.reshape(seg)
+            if residual is not None:
+                new_res = jnp.zeros_like(residual)
+        elif quantized:
+            red, err = _quant_rs_leg(h.astype(jnp.float32), blk, CROSS_AXIS)
+            shard = red.astype(flat.dtype)
+            if residual is not None:
+                new_res = err.reshape(sn).astype(residual.dtype)
+        else:
+            shard = lax.psum_scatter(h, CROSS_AXIS, scatter_dimension=0,
+                                     tiled=True).reshape(seg)
+            if residual is not None:
+                new_res = jnp.zeros_like(residual)
+    else:
+        # Exact flat scatter: XLA decomposes it topology-aware, and the
+        # piece order over an axis tuple is lex (= rank-major) order.
+        if _wire_recorders:
+            isz = jnp.dtype(flat.dtype).itemsize
+            rem = float(n)
+            if LOCAL_AXIS in axes_t:
+                nl = _axis_size(LOCAL_AXIS)
+                _acct("ici", rem * (nl - 1) / nl * isz)
+                rem /= nl
+            if CROSS_AXIS in axes_t:
+                nc = _axis_size(CROSS_AXIS)
+                _acct("dcn", rem * (nc - 1) / nc * isz)
+        shard = lax.psum_scatter(flat, axes_t, scatter_dimension=0,
+                                 tiled=True)
+        new_res = None if residual is None else jnp.zeros_like(residual)
+    shard = _rs_postscale(shard, op, world, postscale_factor)
+    return shard if residual is None else (shard, new_res)
+
+
+def all_gather(
+    shard,
+    residual=None,
+    *,
+    name: Optional[str] = None,
+    axes=None,
+    quantized: Optional[bool] = None,
+    block: Optional[int] = None,
+):
+    """Concatenate per-rank flat shards in rank-major order into the full
+    replicated buffer — the inverse of :func:`reduce_scatter` and the
+    second half of the ZeRO-1 step (broadcast of the updated parameter
+    shards).
+
+    The result is replicated BY CONSTRUCTION (the repo's masked-psum
+    idiom: each rank contributes its shard into a zeroed buffer at its
+    own offset, disjoint support makes the psum exact), so it feeds
+    ``out_specs=P()`` consumers directly — a plain ``lax.all_gather``
+    output carries a device-varying mark that would poison them.
+
+    ``quantized`` sends blockwise-int8 on the cross-host (DCN) leg — hop
+    3 of :func:`_psum_quantized` — with optional error feedback:
+    ``residual`` is the accumulator over this rank's OWNED segment
+    (shape ``[seg]``); when given the return becomes
+    ``(full, new_residual)``. Every rank (owner included) consumes the
+    same dequantized value, so the buffer stays exactly replicated.
+
+    Distinct from :func:`allgather` (the reference-parity op): this is
+    the flat bucket primitive — replication by construction, quantized
+    DCN leg, eager fallback over the process world.
+    """
+    shard = jnp.asarray(shard)
+    if shard.ndim != 1:
+        raise ValueError(
+            f"all_gather operates on flat shard buffers, got shape "
+            f"{shard.shape}")
+    axes_t = _resolve_axes(axes)
+    quantized = _resolve_quantized(quantized, Compression.none)
+    quantized = quantized and jnp.issubdtype(shard.dtype, jnp.floating)
+
+    if not axes_t:
+        return _eager_shard_all_gather(shard, residual, name)
+
+    world = _world_size(axes_t)
+    seg = int(shard.shape[0])
+    n = seg * world
+
+    if _is_replicated(shard, axes_t):
+        # Equal shard everywhere: the gather is a local tile.
+        full = jnp.tile(shard, world)
+        new_res = None if residual is None else jnp.zeros_like(residual)
+        return full if residual is None else (full, new_res)
+
+    use_quant = (quantized and set(axes_t) == set(HVD_AXES)
+                 and _axis_size(CROSS_AXIS) > 1)
+    if use_quant:
+        nl = _axis_size(LOCAL_AXIS)
+        nc = _axis_size(CROSS_AXIS)
+        blk = _quant_block_size(block)
+        isz = jnp.dtype(shard.dtype).itemsize
+        if _wire_recorders:
+            pad_seg = (-seg) % blk + seg
+            q_unit = pad_seg + (pad_seg // blk) * 4.0
+            _acct("dcn", 2.0 * q_unit * nc * (nc - 1) / nc,
+                  2.0 * float(seg) * nc * (nc - 1) / nc * isz)
+            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
+        x = shard.astype(jnp.float32)
+        if residual is not None:
+            if residual.shape != (seg,):
+                raise ValueError(
+                    f"all_gather residual must match the shard [{seg}], "
+                    f"got {residual.shape}")
+            x = x + residual.astype(jnp.float32)
+        vals, err = _quant_ag_leg(x, blk, CROSS_AXIS)      # [nc, seg]
+        new_res = (None if residual is None
+                   else err.astype(residual.dtype))
+        # ICI leg: place this rank's cross-gathered column at local index
+        # li of the rank-major [nc, nl, seg] layout, psum-of-disjoint.
+        li = lax.axis_index(LOCAL_AXIS)
+        fullb = jnp.zeros((nc, nl, seg), jnp.float32)
+        fullb = lax.dynamic_update_slice(fullb, vals[:, None, :], (0, li, 0))
+        full = lax.psum(fullb, LOCAL_AXIS).reshape(n).astype(shard.dtype)
+        return full if residual is None else (full, new_res)
+
+    # Exact path: one masked psum over all axes (disjoint contributions;
+    # XLA decomposes it over ICI/DCN topology-aware).
+    x = shard
+    new_res = None
+    if residual is not None:
+        x = x + residual.astype(x.dtype)  # exact wire: consume the residual
+        new_res = jnp.zeros_like(residual)
+    rank = lax.axis_index(axes_t)
+    buf = jnp.zeros((n,), x.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, x, rank * seg, 0)
+    _acct_psum(buf, axes_t)
+    full = lax.psum(buf, axes_t)
+    return full if residual is None else (full, new_res)
+
+
+def _eager_reduce_scatter(tensor, residual, op: ReduceOp,
+                          prescale_factor: float, postscale_factor: float,
+                          name: Optional[str]):
+    """Host-path reduce_scatter over the process world: native allreduce
+    then the local rank-major slice (exact wire; the byte savings and the
+    quantized leg are compiled-path features)."""
+    ctrl, world = _eager_ctx()
+    x = _scale(tensor, prescale_factor)
+    if residual is not None:
+        x = x + residual.astype(x.dtype)
+    if tensor.shape[0] % world:
+        raise ValueError(
+            f"reduce_scatter buffer of {tensor.shape[0]} elements does "
+            f"not divide into {world} shards")
+    seg = tensor.shape[0] // world
+    if world == 1:
+        shard = x
+    else:
+        red = _eager_allreduce(x, ReduceOp.SUM,
+                               _eager_name(name, "reduce_scatter"))
+        r = basics.rank()
+        shard = red[r * seg:(r + 1) * seg]
+    shard = _rs_postscale(shard, op, world, postscale_factor)
+    if residual is None:
+        return shard
+    return shard, jnp.zeros_like(residual)
+
+
+def _eager_shard_all_gather(shard, residual, name: Optional[str]):
+    """Host-path all_gather of flat shards (native allgather concatenates
+    in rank order, which IS the rank-major layout)."""
+    ctrl, world = _eager_ctx()
+    x = shard
+    new_res = None
+    if residual is not None:
+        x = x + residual.astype(x.dtype)
+        new_res = jnp.zeros_like(residual)
+    if world == 1:
+        full = x
+    else:
+        full = _eager_allgather(x, _eager_name(name, "shard_all_gather"))
+    return full if residual is None else (full, new_res)
 
 
 def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],
@@ -558,7 +982,7 @@ def _allreduce_impl(
             # the wire semantics of equal inputs on those ranks.
             missing = tuple(sorted(set(axes_t) - _vma(compressed)))
             if missing and _vma(compressed):
-                compressed = lax.pcast(compressed, missing, to="varying")
+                compressed = _pvary(compressed, missing)
             if (quantized and set(axes_t) == set(HVD_AXES)
                     and op in (ReduceOp.SUM, ReduceOp.AVERAGE)):
                 red, new_residual = _psum_quantized(

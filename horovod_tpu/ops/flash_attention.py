@@ -48,6 +48,28 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401  (TPU backend)
 
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 naming
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+if not hasattr(jax, "shard_map"):
+    # jax < 0.6: the experimental shard_map's check_rep machinery has no
+    # replication rule for pallas_call. The sound rule for a per-device
+    # kernel: every output is replicated exactly over the axes ALL
+    # operands are replicated over (tensor operands are vma-harmonized
+    # before each call; scalar offset operands may stay replicated).
+    try:
+        from jax.experimental import shard_map as _sm_compat
+        from jax._src.pallas.pallas_call import pallas_call_p as _pc_p
+
+        def _pallas_rep_rule(mesh, *in_rep, **params):
+            reps = [set(r) for r in in_rep if r is not None]
+            return set.intersection(*reps) if reps else None
+
+        _sm_compat.register_check(_pc_p)(_pallas_rep_rule)
+        _sm_compat.register_norewrite(_pc_p)
+    except Exception:  # pragma: no cover - internal-API drift
+        pass
+
 _NEG_INF = -1e30  # finite: keeps running-max arithmetic NaN-free
 
 # Large blocks amortize Mosaic's per-grid-cell overhead and give the MXU
@@ -106,11 +128,16 @@ def _interpret() -> bool:
 def _out_struct(shape, dtype, *operands):
     """ShapeDtypeStruct whose varying-manual-axes are the union of the
     operands' — required inside ``jax.shard_map`` (check_vma), harmless
-    outside (vma=frozenset())."""
+    outside (vma=frozenset()). jax < 0.6 has no aval-level vma (its
+    shard_map tracks replication on the tracer instead), so the plain
+    struct is the correct spelling there."""
     from .collective_ops import _vma
 
     vma = frozenset().union(*[_vma(x) for x in operands])
-    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # jax < 0.6
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _harmonize_vma(*arrays):
@@ -515,6 +542,7 @@ def _ring_fwd_impl(q, k, v, axis, scale, causal, bq, bk):
     from jax import lax
 
     from ..parallel.sequence import _axis_size
+    from .collective_ops import pvary_missing
 
     n = _axis_size(axis)
     my = lax.axis_index(axis)
@@ -523,7 +551,7 @@ def _ring_fwd_impl(q, k, v, axis, scale, causal, bq, bk):
     axes_t = _ring_axes(axis, q, k, v)
 
     def _vary(x):
-        return lax.pcast(x, axes_t, to="varying")
+        return pvary_missing(x, axes_t)
 
     def merge(o, lse, k_blk, v_blk, i):
         # Blocks travel +1 per rotation: after i steps we hold (my - i)'s.
@@ -574,6 +602,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis, scale, causal, bq, bk):
     from jax import lax
 
     from ..parallel.sequence import _axis_size
+    from .collective_ops import pvary_missing
 
     n = _axis_size(axis)
     my = lax.axis_index(axis)
@@ -582,7 +611,7 @@ def _ring_bwd_impl(q, k, v, o, lse, do, axis, scale, causal, bq, bk):
     axes_t = _ring_axes(axis, q, k, v, o, lse, do)
 
     def _vary(x):
-        return lax.pcast(x, axes_t, to="varying")
+        return pvary_missing(x, axes_t)
 
     lse8 = jnp.broadcast_to(lse[..., None], (*lse.shape, 8))
     delta = _prep_residuals(o, do)

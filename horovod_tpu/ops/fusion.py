@@ -56,6 +56,8 @@ class Bucket:
 def plan_buckets(
     leaves: Sequence[jax.Array],
     threshold_bytes: Optional[int] = None,
+    *,
+    shard_multiple: int = 1,
 ) -> List[Bucket]:
     """Greedy first-fit bucketing in leaf order, one buffer per dtype run.
 
@@ -71,13 +73,22 @@ def plan_buckets(
     single leaf larger than the threshold becomes its own bucket (never
     an error, and never shared — a following small leaf must not ride a
     bucket that already blew past the cap); 0-d and zero-size leaves
-    count as one element (the reference's min-1 slot)."""
+    count as one element (the reference's min-1 slot).
+
+    ``shard_multiple`` (the ZeRO-sharding hook) rounds every bucket's
+    padded size up to a multiple of ``lcm(ATOMIC_UNIT, shard_multiple)``
+    instead of plain ``ATOMIC_UNIT``, so the flat buffer reduce-scatters
+    evenly into ``shard_multiple`` per-rank shards (pass the world size).
+    It never changes WHICH leaves share a bucket — only the tail padding —
+    so plans for different world sizes unpack identically (the elastic
+    reshard path relies on this)."""
     if threshold_bytes is None:
         threshold_bytes = (
             basics.config().fusion_threshold_bytes
             if basics.is_initialized()
             else 64 * 1024 * 1024
         )
+    unit = int(np.lcm(ATOMIC_UNIT, max(1, int(shard_multiple))))
     by_dtype: dict = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
@@ -91,24 +102,25 @@ def plan_buckets(
         for i in idxs:
             n = int(np.prod(jnp.shape(leaves[i]), dtype=np.int64)) or 1
             if cur_idx and cur_elems + n > max_elems:
-                buckets.append(_close_bucket(dtype, cur_idx, leaves))
+                buckets.append(_close_bucket(dtype, cur_idx, leaves, unit))
                 cur_idx, cur_elems = [], 0
             cur_idx.append(i)
             cur_elems += n
             if n > max_elems:
                 # Oversized leaf: its own bucket, closed immediately.
-                buckets.append(_close_bucket(dtype, cur_idx, leaves))
+                buckets.append(_close_bucket(dtype, cur_idx, leaves, unit))
                 cur_idx, cur_elems = [], 0
         if cur_idx:
-            buckets.append(_close_bucket(dtype, cur_idx, leaves))
+            buckets.append(_close_bucket(dtype, cur_idx, leaves, unit))
     return buckets
 
 
-def _close_bucket(dtype, idxs: List[int], leaves) -> Bucket:
+def _close_bucket(dtype, idxs: List[int], leaves,
+                  unit: int = ATOMIC_UNIT) -> Bucket:
     shapes = tuple(tuple(jnp.shape(leaves[i])) for i in idxs)
     sizes = tuple(int(np.prod(s, dtype=np.int64)) or 1 for s in shapes)
     total = sum(sizes)
-    padded = ((total + ATOMIC_UNIT - 1) // ATOMIC_UNIT) * ATOMIC_UNIT
+    padded = ((total + unit - 1) // unit) * unit
     return Bucket(dtype=dtype, leaf_indices=tuple(idxs), sizes=sizes,
                   shapes=shapes, padded_size=padded)
 
@@ -140,6 +152,51 @@ def unpack(bucket: Bucket, buf: jax.Array) -> List[jax.Array]:
         out.append(jnp.reshape(buf[off:off + n], shape))
         off += size
     return out
+
+
+# ---------------------------------------------------------------------------
+# ZeRO shard layout: a bucket planned with ``shard_multiple=world`` divides
+# into ``world`` equal contiguous shards in RANK-MAJOR order — rank
+# ``r = cross_rank * local_size + local_rank`` owns elements
+# ``[r * seg, (r + 1) * seg)`` of the flat buffer (``seg = padded // world``).
+# The compiled reduce-scatter/all-gather (ops/collective_ops.py) produce and
+# consume exactly this layout, and because it matches how ``P(HVD_AXES)``
+# splits a leading dim, a ZeRO optimizer-state leaf outside the trace is
+# simply the flat bucket itself, sharded — no permutation to undo when
+# checkpointing or elastically resharding.
+# ---------------------------------------------------------------------------
+
+
+def shard_size(bucket: Bucket, world: int) -> int:
+    """Per-rank shard elements of a bucket planned with
+    ``shard_multiple=world``."""
+    if bucket.padded_size % world:
+        raise ValueError(
+            f"bucket padded_size {bucket.padded_size} does not divide into "
+            f"{world} shards — plan with plan_buckets(shard_multiple=world)")
+    return bucket.padded_size // world
+
+
+def shard_slice(buf: jax.Array, world: int, rank) -> jax.Array:
+    """This rank's contiguous flat shard of a packed bucket buffer.
+    ``rank`` may be a traced per-device index (``hvd.rank()`` inside
+    shard_map) or a python int (host-side slicing for elastic reshard)."""
+    if buf.shape[0] % world:
+        raise ValueError(
+            f"buffer of {buf.shape[0]} elements does not divide into "
+            f"{world} shards")
+    seg = buf.shape[0] // world
+    import jax.lax as lax
+
+    return lax.dynamic_slice_in_dim(buf, rank * seg, seg, 0)
+
+
+def shard_unslice(shards: Sequence[jax.Array]) -> jax.Array:
+    """Reassemble a flat bucket buffer from its per-rank shards in rank
+    order (the host-side inverse of :func:`shard_slice`; in-trace the
+    all-gather collective does this on the wire)."""
+    shards = [jnp.ravel(jnp.asarray(s)) for s in shards]
+    return jnp.concatenate(shards) if len(shards) > 1 else shards[0]
 
 
 def allreduce_pytree(

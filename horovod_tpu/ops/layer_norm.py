@@ -38,6 +38,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 naming
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from .flash_attention import _harmonize_vma, _interpret, _out_struct
 
 _DEF_BLOCK_ROWS = 256

@@ -33,6 +33,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+if not hasattr(pltpu, "CompilerParams"):  # jax < 0.6 naming
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
 from .flash_attention import (
     _harmonize_vma,
     _interpret,

@@ -36,12 +36,14 @@ def _axis_size(axis) -> int:
     """Static size of a bound mesh axis (python int at trace time).
     Unbound axes (tracing outside shard_map, e.g. model.init) count as 1 —
     the shard IS the full sequence there, so callers fall back to dense."""
+    from ..ops.collective_ops import _axis_size as _bound_axis_size
+
     bound = _bound_axes()
     names = (axis,) if isinstance(axis, str) else tuple(axis)
     n = 1
     for a in names:
         if a in bound:
-            n *= int(lax.axis_size(a))
+            n *= int(_bound_axis_size(a))
     return n
 
 
@@ -121,13 +123,13 @@ def ring_attention(q, k, v, *, axis=LOCAL_AXIS, causal: bool = True,
     # Accumulators must carry the union of the ring axis' varying type and
     # whatever axes q/k/v already vary over (e.g. a data-parallel batch
     # axis), or the scan carry types won't match.
-    from ..ops.collective_ops import _vma
+    from ..ops.collective_ops import _vma, pvary_missing
 
     ring_axes = {axis} if isinstance(axis, str) else set(axis)
     axes_t = tuple(sorted(ring_axes | _vma(q) | _vma(k) | _vma(v)))
 
     def _vary(x):
-        return lax.pcast(x, axes_t, to="varying")
+        return pvary_missing(x, axes_t)
 
     o0 = _vary(jnp.zeros((B, H, T_local, D), jnp.float32))
     m0 = _vary(jnp.full((B, H, T_local), _NEG_INF, jnp.float32))

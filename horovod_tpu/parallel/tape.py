@@ -80,6 +80,7 @@ def value_and_grad(
     axes=None,
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
+    zero: Optional[bool] = None,
     tuned_params=None,
     reduce: bool = True,
     **jax_kwargs,
@@ -93,19 +94,38 @@ def value_and_grad(
     fp32 sums) but skips the allreduce — the hand-off point for callers
     that let :class:`~horovod_tpu.DistributedOptimizer` own the reduction,
     e.g. to keep error-feedback state in the optimizer when
-    ``quantized=True``."""
+    ``quantized=True``.
+
+    ``zero`` (default: the ``HOROVOD_ZERO_SHARDING`` knob) marks the step
+    as ZeRO-sharded: under ZeRO the gradient reduction IS the optimizer's
+    reduce-scatter, so ``zero=True`` behaves as ``reduce=False`` — raw
+    per-rank local gradients are handed to the
+    ``DistributedOptimizer(zero=True)`` update, whose bucket
+    reduce-scatter is then the one and only gradient collective. This is
+    the knob's thread-through point: a step built with
+    ``hvd.value_and_grad(..., zero=zero)`` + ``DistributedOptimizer(...,
+    zero=zero)`` flips between the replicated and sharded schedules with
+    one flag (see docs/zero.md)."""
+    if zero is None and tuned_params is not None:
+        zero = tuned_params.zero_sharding
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux,
                             **jax_kwargs)
     idxs = (argnums,) if isinstance(argnums, int) else tuple(argnums)
 
     def wrapped(*args, **kwargs):
+        zero_eff = zero
+        if zero_eff is None:
+            from ..common import basics
+
+            zero_eff = (basics.config().zero_sharding
+                        if basics.is_initialized() else False)
         axes_t = C._resolve_axes(axes)
         if axes_t:
             args = list(args)
             for i in idxs:
                 args[i] = _pvary_tree(args[i], axes_t)
         val, grads = vg(*args, **kwargs)
-        if not reduce:
+        if not reduce or zero_eff:
             return val, grads
         grads = allreduce_gradients(
             grads, op=op, compression=compression,

@@ -69,7 +69,7 @@ def test_adasum_allreduce_matches_numpy_tree():
     rng = np.random.RandomState(7)
     x = rng.randn(N, 32).astype(np.float32)
 
-    out = jax.shard_map(
+    out = hvd.shard_map(
         lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
         mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
         out_specs=P())(jnp.asarray(x))
@@ -83,7 +83,7 @@ def test_vhdd_matches_numpy_tree():
     rng = np.random.RandomState(3)
     for n_elem in (32, 37):  # even and odd (pad + uneven halving) lengths
         x = rng.randn(N, n_elem).astype(np.float32)
-        out = jax.shard_map(
+        out = hvd.shard_map(
             lambda v: adasum._vhdd_allreduce(v[0], hvd.HVD_AXES),
             mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
             out_specs=P())(jnp.asarray(x))
@@ -97,7 +97,7 @@ def test_vhdd_threshold_dispatch(monkeypatch):
     monkeypatch.setattr(adasum, "GATHER_THRESHOLD_ELEMS", 1)
     rng = np.random.RandomState(5)
     x = rng.randn(N, 48).astype(np.float32)
-    out = jax.shard_map(
+    out = hvd.shard_map(
         lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
         mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
         out_specs=P())(jnp.asarray(x))
@@ -109,7 +109,7 @@ def test_vhdd_2d_shape_roundtrip(monkeypatch):
     monkeypatch.setattr(adasum, "GATHER_THRESHOLD_ELEMS", 1)
     rng = np.random.RandomState(9)
     x = rng.randn(N, 5, 7).astype(np.float32)
-    out = jax.shard_map(
+    out = hvd.shard_map(
         lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
         mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
         out_specs=P())(jnp.asarray(x))

@@ -19,7 +19,7 @@ N = 8
 
 
 def spmd(f, in_specs, out_specs):
-    return jax.shard_map(f, mesh=hvd.mesh(), in_specs=in_specs,
+    return hvd.shard_map(f, mesh=hvd.mesh(), in_specs=in_specs,
                          out_specs=out_specs)
 
 

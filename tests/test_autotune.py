@@ -159,8 +159,34 @@ class TestParameterManager:
             assert row["fusion_threshold_bytes"] == p.fusion_threshold_bytes
             assert row["quant_block"] == p.quant_block
             assert row["hierarchical_allreduce"] == p.hierarchical_allreduce
+            assert row["zero_sharding"] == p.zero_sharding
             assert row["score_steps_per_sec"] == pytest.approx(s, rel=1e-5)
         assert [r["sample"] for r in rows] == list(range(1, 6))
+
+    def test_csv_round_trip_with_tune_zero(self, tmp_path):
+        """zero_sharding rides the CSV schema: a tune_zero session
+        explores both values and read_log round-trips them typed."""
+        path = str(tmp_path / "autotune_zero.csv")
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=8, log_path=path,
+                              tune_zero=True, seed=7)
+        _run_manager(pm, lambda p: 2.0 if p.zero_sharding else 1.0)
+        with open(path) as f:
+            header = f.readline().strip()
+        assert header == ",".join(pm_mod.CSV_FIELDS)
+        assert "zero_sharding" in pm_mod.CSV_FIELDS
+        rows = read_log(path)
+        assert {r["zero_sharding"] for r in rows} == {False, True}
+        for row, (p, _) in zip(rows, pm.history):
+            assert row["zero_sharding"] == p.zero_sharding
+        # the winner is the zero=True arm (scored 2.0)
+        assert pm.best.zero_sharding is True
+
+    def test_tune_zero_off_never_proposes_zero(self):
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=6, seed=3)
+        _run_manager(pm, lambda p: 1.0)
+        assert all(not p.zero_sharding for p, _ in pm.history)
 
 
 class TestTunedParams:
@@ -387,8 +413,6 @@ class TestTunedParamsOverride:
             np.testing.assert_array_equal(np.asarray(out_tuned[k]),
                                           np.asarray(out_env[k]))
 
-    @pytest.mark.skipif(not hasattr(jax, "shard_map"),
-                        reason="jax.shard_map unavailable on this jax")
     def test_compiled_2x4_tuned_vs_env_bit_identical(self, monkeypatch):
         """Compiled smoke on the emulated 2-host x 4-chip mesh: a step
         built with tuned_params= must produce bit-identical reductions to
@@ -410,7 +434,7 @@ class TestTunedParamsOverride:
                 return fusion.allreduce_pytree(local, op=hvd.Sum,
                                                tuned_params=tp)
 
-            return jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+            return hvd.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
                                  out_specs=P())(tree)
 
         out_tuned = run(tuned)

@@ -35,7 +35,7 @@ def test_traced_ranks_are_per_chip():
     def f(x):
         return x + hvd.rank()
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
                         out_specs=P(hvd.HVD_AXES))(jnp.zeros(8))
     np.testing.assert_array_equal(np.asarray(out), np.arange(8))
 
@@ -47,7 +47,7 @@ def test_traced_local_cross_ranks():
     def f(x):
         return x + hvd.local_rank() + 100 * hvd.cross_rank()
 
-    out = jax.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=mesh, in_specs=P(hvd.HVD_AXES),
                         out_specs=P(hvd.HVD_AXES))(jnp.zeros(8))
     expect = [100 * (i // n_local) + (i % n_local) for i in range(8)]
     np.testing.assert_array_equal(np.asarray(out), expect)

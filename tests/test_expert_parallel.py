@@ -80,7 +80,7 @@ class TestSwitchMoE:
             return y[None], hvd.allreduce(aux, op=hvd.Average)
 
         stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
-        y, aux = jax.jit(jax.shard_map(
+        y, aux = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(), P(), P(hvd.HVD_AXES), P(hvd.HVD_AXES),
                       P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
@@ -95,7 +95,7 @@ class TestSwitchMoE:
         x, router, w1, b1, w2, b2 = _layer_data(E=8)
         with pytest.raises(ValueError, match="experts"):
             # Router says 8 experts but locals x axis = 8 * 8 = 64.
-            jax.jit(jax.shard_map(
+            jax.jit(hvd.shard_map(
                 lambda x, r, a, b, c, d: switch_moe(
                     x, r, a, b, c, d, axis=hvd.HVD_AXES)[0],
                 mesh=hvd.mesh(),
@@ -140,7 +140,7 @@ class TestSwitchMoERagged:
             return y, hvd.allreduce(aux, op=hvd.Average)
 
         stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
-        y, _ = jax.jit(jax.shard_map(
+        y, _ = jax.jit(hvd.shard_map(
             spmd, mesh=hvd.mesh(),
             in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES),
                       P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
@@ -178,7 +178,7 @@ class TestSwitchMoERagged:
                 return y
 
             stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
-            return np.asarray(jax.jit(jax.shard_map(
+            return np.asarray(jax.jit(hvd.shard_map(
                 spmd, mesh=hvd.mesh(),
                 in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES),
                           P(hvd.HVD_AXES), P(hvd.HVD_AXES),
@@ -221,7 +221,7 @@ class TestSwitchMoERagged:
             return jax.grad(inner, argnums=(0, 1))(w1s[0], w2s[0])
 
         stack = lambda a: jnp.stack(jnp.split(a, n, axis=0))
-        g8 = jax.jit(jax.shard_map(
+        g8 = jax.jit(hvd.shard_map(
             loss_spmd, mesh=hvd.mesh(),
             in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(hvd.HVD_AXES),
                       P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
@@ -308,7 +308,7 @@ class TestMoEGPT:
             return (stk, rp, hvd.allreduce(loss, op=hvd.Average),
                     hvd.allreduce(aux, op=hvd.Average))
 
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS),
                       P(hvd.CROSS_AXIS)),
@@ -345,7 +345,7 @@ class TestMoEGPT:
             # full combined output) but not provably so — stack copies.
             return logits[None]
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS)),
             out_specs=P(hvd.LOCAL_AXIS, hvd.CROSS_AXIS)))(

@@ -144,7 +144,7 @@ class TestFlashRingAttention:
         expect = seqpar.dense_attention(q, k, v, causal=causal)
         mesh = hvd.mesh()
         spec = P(None, hvd.HVD_AXES)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda a, b, c: flash_ring_attention(
                 a, b, c, axis=hvd.HVD_AXES, causal=causal),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -161,7 +161,7 @@ class TestFlashRingAttention:
         spec = P(None, hvd.HVD_AXES)
 
         def ring_loss(q, k, v):
-            o = jax.shard_map(
+            o = hvd.shard_map(
                 lambda a, b, c: flash_ring_attention(
                     a, b, c, axis=hvd.HVD_AXES, causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec),
@@ -189,7 +189,7 @@ class TestFlashRingAttention:
         variables = GPT(cfg_d).init(jax.random.PRNGKey(0), tokens)
         expect = GPT(cfg_d).apply(variables, tokens)
         mesh = hvd.mesh()
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda v, t: GPT(cfg_r).apply(v, t),
             mesh=mesh, in_specs=(P(), P(None, hvd.HVD_AXES)),
             out_specs=P(None, hvd.HVD_AXES),
@@ -219,7 +219,7 @@ class TestFlashIntegration:
         expect = seqpar.dense_attention(q, k, v, causal=True)
         mesh = hvd.mesh()
         spec = P(None, hvd.HVD_AXES)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda a, b, c: seqpar.ulysses_attention(
                 a, b, c, axis=hvd.HVD_AXES, causal=True,
                 attn_fn=lambda qf, kf, vf: flash_attention(

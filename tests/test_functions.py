@@ -22,7 +22,7 @@ def test_broadcast_variables_in_mesh():
         out = hvd.broadcast_variables(params, root_rank=3)
         return out
 
-    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                         out_specs=P())(jnp.zeros(N))
     np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4, 3), 3.0))
     np.testing.assert_array_equal(np.asarray(out["b"]), np.full((2,), 30.0))

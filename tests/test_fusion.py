@@ -108,7 +108,7 @@ def test_allreduce_pytree_matches_leafwise():
         local = jax.tree.map(lambda v: v[0], t)
         return fusion.allreduce_pytree(local, op=hvd.Sum)
 
-    out = jax.shard_map(
+    out = hvd.shard_map(
         f, mesh=hvd.mesh(),
         in_specs=P(hvd.HVD_AXES),
         out_specs=P())(tree)
@@ -131,7 +131,7 @@ def test_allreduce_pytree_small_threshold_many_buckets():
         return fusion.allreduce_pytree(local, op=hvd.Average,
                                        threshold_bytes=64)
 
-    out = jax.shard_map(
+    out = hvd.shard_map(
         f, mesh=hvd.mesh(),
         in_specs=P(hvd.HVD_AXES),
         out_specs=P())(tree)
@@ -154,7 +154,7 @@ def test_allreduce_pytree_mixed_dtype_compression():
         return fusion.allreduce_pytree(local, op=hvd.Sum,
                                        compression=hvd.Compression.bf16)
 
-    out = jax.shard_map(
+    out = hvd.shard_map(
         f, mesh=hvd.mesh(),
         in_specs=P(hvd.HVD_AXES),
         out_specs=P())(tree)

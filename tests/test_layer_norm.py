@@ -98,7 +98,7 @@ def test_under_shard_map_dp():
         y, h = ln_residual(xs, rs, g, b)
         return y, h
 
-    y, h = jax.jit(jax.shard_map(
+    y, h = jax.jit(hvd.shard_map(
         f, mesh=hvd.mesh(),
         in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES), P(), P()),
         out_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES))))(x, r, g, b)

@@ -80,7 +80,7 @@ def test_sync_batch_norm_global_moments():
         out, _ = model.apply(variables, xb, mutable=["batch_stats"])
         return out
 
-    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                         out_specs=P(hvd.HVD_AXES))(jnp.asarray(data))
     out = np.asarray(out)
     np.testing.assert_allclose(out.mean(axis=0), 0.0, atol=1e-3)
@@ -105,7 +105,7 @@ def test_sync_batch_norm_matches_big_batch():
         return out
 
     out_sync = np.asarray(
-        jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+        hvd.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                       out_specs=P(hvd.HVD_AXES))(jnp.asarray(data)))
     out_plain, _ = plain.apply(v_plain, jnp.asarray(data),
                                mutable=["batch_stats"])
@@ -138,7 +138,7 @@ def test_mnist_dp_training_step_decreases_loss():
             return (optax.apply_updates(params, updates), new_state,
                     hvd.allreduce(loss))
 
-        return jax.shard_map(
+        return hvd.shard_map(
             spmd, mesh=hvd.mesh(),
             in_specs=(P(), P(), P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
             out_specs=(P(), P(), P()))(params, opt_state, xb, yb)

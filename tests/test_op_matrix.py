@@ -41,12 +41,17 @@ def _is64(dtype):
 
 
 def _ctx(dtype):
-    return (jax.enable_x64(True) if _is64(dtype)
-            else contextlib.nullcontext())
+    if not _is64(dtype):
+        return contextlib.nullcontext()
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(True)
+    from jax.experimental import enable_x64  # jax < 0.6 spelling
+
+    return enable_x64()
 
 
 def spmd(f, in_specs, out_specs):
-    return jax.shard_map(f, mesh=hvd.mesh(), in_specs=in_specs,
+    return hvd.shard_map(f, mesh=hvd.mesh(), in_specs=in_specs,
                          out_specs=out_specs)
 
 

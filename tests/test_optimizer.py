@@ -49,7 +49,7 @@ def dp_train(tx, steps, x, y):
             return new_params, new_state, hvd.allreduce(loss)
 
         rep = jax.tree.map(lambda _: P(), (params, opt_state))
-        return jax.shard_map(
+        return hvd.shard_map(
             spmd_full, mesh=mesh,
             in_specs=(rep[0], rep[1], P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
             out_specs=(rep[0], rep[1], P()))(params, opt_state, xb, yb)
@@ -152,7 +152,7 @@ def test_value_and_grad_allreduces():
         val, g = hvd.value_and_grad(f)(p, x[0])
         return g
 
-    out = jax.shard_map(spmd, mesh=hvd.mesh(),
+    out = hvd.shard_map(spmd, mesh=hvd.mesh(),
                         in_specs=(P(), P(hvd.HVD_AXES)),
                         out_specs=P())(jnp.ones(3), jnp.asarray(xs))
     np.testing.assert_allclose(np.asarray(out), xs.mean(0), rtol=1e-5)
@@ -171,7 +171,7 @@ def test_distributed_gradient_tape_shim():
         loss, g = tape.gradient(p, x[0])
         return g
 
-    out = jax.shard_map(spmd, mesh=hvd.mesh(),
+    out = hvd.shard_map(spmd, mesh=hvd.mesh(),
                         in_specs=(P(), P(hvd.HVD_AXES)),
                         out_specs=P())(jnp.ones(3), jnp.asarray(xs))
     np.testing.assert_allclose(np.asarray(out), xs.mean(0), rtol=1e-5)
@@ -195,7 +195,7 @@ def test_allreduce_pytree_collective_semantics_on_replicated():
         tree = {"m": jnp.asarray([4.0, 5.0])}
         return hvd.allreduce_pytree(tree, op=hvd.Min)
 
-    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                         out_specs=P())(jnp.zeros(N))
     np.testing.assert_array_equal(np.asarray(out["m"]), [4.0, 5.0])
 
@@ -210,9 +210,9 @@ def test_adasum_with_compression():
         return hvd.allreduce(v[0], op=hvd.Adasum,
                              compression=hvd.Compression.bf16)
 
-    out = jax.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
+    out = hvd.shard_map(f, mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                         out_specs=P())(jnp.asarray(x))
-    ref = jax.shard_map(lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
+    ref = hvd.shard_map(lambda v: hvd.allreduce(v[0], op=hvd.Adasum),
                         mesh=hvd.mesh(), in_specs=P(hvd.HVD_AXES),
                         out_specs=P())(jnp.asarray(x))
     assert out.dtype == jnp.float32

@@ -35,7 +35,7 @@ class TestGPipe:
             return gpipe(lambda p, h: h * p[0], s[:, None], x,
                          axis=hvd.HVD_AXES)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh, in_specs=(P(), P(hvd.HVD_AXES)),
             out_specs=P()))(x, scalars)
         np.testing.assert_allclose(np.asarray(out),
@@ -69,7 +69,7 @@ class TestPipelinedGPT:
                                        axis=hvd.HVD_AXES,
                                        num_microbatches=2)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh, in_specs=(P(hvd.HVD_AXES), P(), P()),
             out_specs=P()))(stages, rest, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
@@ -93,7 +93,7 @@ class TestPipelinedGPT:
                                        num_microbatches=2)
 
         with pytest.raises(ValueError, match="overlaps the pipeline"):
-            jax.jit(jax.shard_map(
+            jax.jit(hvd.shard_map(
                 spmd, mesh=hvd.mesh(),
                 in_specs=(P(hvd.HVD_AXES), P(), P()),
                 out_specs=P()))(stages, rest, tokens)
@@ -114,7 +114,7 @@ class TestPipelinedGPT:
                                        num_microbatches=2)
 
         with pytest.raises(ValueError, match="tp_axis"):
-            jax.jit(jax.shard_map(
+            jax.jit(hvd.shard_map(
                 spmd, mesh=hvd.mesh(),
                 in_specs=(P(hvd.HVD_AXES), P(), P()),
                 out_specs=P()))(stages, rest, tokens)
@@ -136,7 +136,7 @@ class TestPipelinedGPT:
                                        axis=hvd.LOCAL_AXIS,
                                        num_microbatches=2)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS)),
             out_specs=P(hvd.CROSS_AXIS)))(stages, rest, tokens)
@@ -166,7 +166,7 @@ class TestPipelinedGPT:
                                           axis=hvd.HVD_AXES,
                                           num_microbatches=2)
 
-            return jax.shard_map(
+            return hvd.shard_map(
                 spmd, mesh=mesh,
                 in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
                 out_specs=P())(stages, rest, tokens, targets)
@@ -231,7 +231,7 @@ class TestPipelinedGPT:
                 num_microbatches=4)
             return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
 
-        loss, g_stages, g_rest = jax.jit(jax.shard_map(
+        loss, g_stages, g_rest = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
             out_specs=(P(), P(hvd.HVD_AXES), P())))(
@@ -280,7 +280,7 @@ class TestPipelinedGPT:
                 num_microbatches=M)
             return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
 
-        loss, g_stages, g_rest = jax.jit(jax.shard_map(
+        loss, g_stages, g_rest = jax.jit(hvd.shard_map(
             spmd, mesh=hvd.mesh(),
             in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
             out_specs=(P(), P(hvd.HVD_AXES), P())))(
@@ -361,7 +361,7 @@ class TestPipelinedGPT:
                                           axes=hvd.CROSS_AXIS)
             return loss, jax.tree.map(lambda a: a[None], g_st), g_rest
 
-        loss, g_stages, g_rest = jax.jit(jax.shard_map(
+        loss, g_stages, g_rest = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS),
                       P(hvd.CROSS_AXIS)),
@@ -429,7 +429,7 @@ class TestPipelinedGPT:
                                              num_microbatches=2)
                 return jnp.mean(logits * w)
 
-            return jax.shard_map(
+            return hvd.shard_map(
                 spmd, mesh=mesh, in_specs=(P(hvd.HVD_AXES), P(), P()),
                 out_specs=P())(stages, rest, tok)
 
@@ -486,7 +486,7 @@ class TestScheduleMemory:
                                           axis=hvd.HVD_AXES,
                                           num_microbatches=M)
 
-            return jax.shard_map(
+            return hvd.shard_map(
                 spmd, mesh=mesh,
                 in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
                 out_specs=P())(stages, rest, tok, tgt)
@@ -501,7 +501,7 @@ class TestScheduleMemory:
         gpipe_c = jax.jit(
             jax.value_and_grad(gpipe_loss, argnums=(0, 1))).lower(
             stages, rest, tokens, targets).compile()
-        f1b1_c = jax.jit(jax.shard_map(
+        f1b1_c = jax.jit(hvd.shard_map(
             spmd_1f1b, mesh=mesh,
             in_specs=(P(hvd.HVD_AXES), P(), P(), P()),
             out_specs=(P(), P(hvd.HVD_AXES), P()))).lower(

@@ -182,7 +182,7 @@ class TestQuantizedAllreduceCompiled:
             out, _ = hvd.quantized_allreduce(v[0], op=hvd.Sum)
             return out
 
-        out = jax.shard_map(spmd, mesh=mesh_2x4(),
+        out = hvd.shard_map(spmd, mesh=mesh_2x4(),
                             in_specs=P(hvd.HVD_AXES),
                             out_specs=P())(jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(out), x.sum(0),
@@ -197,7 +197,7 @@ class TestQuantizedAllreduceCompiled:
             out, _ = hvd.quantized_allreduce(v[0], op=hvd.Sum)
             return out[None]
 
-        out = np.asarray(jax.shard_map(
+        out = np.asarray(hvd.shard_map(
             spmd, mesh=mesh_2x4(), in_specs=P(hvd.HVD_AXES),
             out_specs=P(hvd.HVD_AXES))(jnp.asarray(x)))
         for r in range(1, N):
@@ -210,7 +210,7 @@ class TestQuantizedAllreduceCompiled:
             out, _ = hvd.quantized_allreduce(v[0], op=hvd.Average)
             return out
 
-        out = jax.shard_map(spmd, mesh=mesh_2x4(),
+        out = hvd.shard_map(spmd, mesh=mesh_2x4(),
                             in_specs=P(hvd.HVD_AXES),
                             out_specs=P())(jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(out), x.mean(0),
@@ -226,7 +226,7 @@ class TestQuantizedAllreduceCompiled:
                                  compression=hvd.Compression.bf16,
                                  quantized=True)
 
-        out = jax.shard_map(spmd, mesh=mesh_2x4(),
+        out = hvd.shard_map(spmd, mesh=mesh_2x4(),
                             in_specs=P(hvd.HVD_AXES),
                             out_specs=P())(jnp.asarray(x))
         assert out.dtype == jnp.float32
@@ -243,7 +243,7 @@ class TestQuantizedAllreduceCompiled:
             out, nr = hvd.quantized_allreduce(v[0], r[0], op=hvd.Sum)
             return out, nr[None]
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(hvd.shard_map(
             spmd, mesh=mesh_2x4(),
             in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
             out_specs=(P(), P(hvd.HVD_AXES))))
@@ -269,7 +269,7 @@ class TestQuantizedAllreduceCompiled:
         x = self._inputs(seed=7)
 
         def run(**kw):
-            return np.asarray(jax.shard_map(
+            return np.asarray(hvd.shard_map(
                 lambda v: hvd.allreduce(v[0], op=hvd.Sum, **kw),
                 mesh=mesh_2x4(), in_specs=P(hvd.HVD_AXES),
                 out_specs=P())(jnp.asarray(x)))
@@ -283,7 +283,7 @@ class TestQuantizedAllreduceCompiled:
             out, r = hvd.quantized_allreduce(v[0], v[0] * 0, op=hvd.Sum)
             return out, r[None]
 
-        out, res = jax.shard_map(
+        out, res = hvd.shard_map(
             spmd, mesh=mesh_2x4(), in_specs=P(hvd.HVD_AXES),
             out_specs=(P(), P(hvd.HVD_AXES)))(jnp.asarray(x))
         np.testing.assert_allclose(np.asarray(out), x.sum(0), rtol=1e-5)
@@ -306,7 +306,7 @@ class TestQuantizedPytree:
                 local, op=hvd.Sum, quantized=True, error_feedback=ef)
             return out, jax.tree.map(lambda a: a[None], new_ef)
 
-        out, ef = jax.shard_map(
+        out, ef = hvd.shard_map(
             spmd, mesh=mesh_2x4(), in_specs=P(hvd.HVD_AXES),
             out_specs=(P(), P(hvd.HVD_AXES)))(tree)
         x = np.asarray(tree["w"])
@@ -332,7 +332,7 @@ class TestQuantizedPytree:
             return fusion.allreduce_pytree(local, op=hvd.Sum,
                                            quantized=True)
 
-        f = jax.jit(jax.shard_map(spmd, mesh=mesh_2x4(),
+        f = jax.jit(hvd.shard_map(spmd, mesh=mesh_2x4(),
                                   in_specs=P(hvd.HVD_AXES), out_specs=P()))
         with C.record_wire_stats() as ws:
             f.lower(tree)  # accounting happens at trace time

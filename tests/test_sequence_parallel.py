@@ -26,7 +26,7 @@ def _shard_seq(fn, mesh, n_out=1):
     """Run fn inside shard_map with arrays sharded on seq dim over the full
     world (both mesh axes)."""
     spec = P(None, hvd.HVD_AXES)
-    return jax.jit(jax.shard_map(
+    return jax.jit(hvd.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=spec))
 
@@ -52,7 +52,7 @@ class TestRingAttention:
         expect = seqpar.dense_attention(q, k, v, causal=True)
         mesh = hvd.mesh()
         spec = P(None, hvd.LOCAL_AXIS)
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda a, b, c: seqpar.ring_attention(a, b, c,
                                                   axis=hvd.LOCAL_AXIS),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -107,7 +107,7 @@ class TestGPTSequenceParallel:
 
         model_r = GPT(cfg_r)
         mesh = hvd.mesh()
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda v, t: model_r.apply(v, t),
             mesh=mesh, in_specs=(P(), P(None, hvd.HVD_AXES)),
             out_specs=P(None, hvd.HVD_AXES),
@@ -128,7 +128,7 @@ class TestGPTSequenceParallel:
         expect = model_d.apply(variables, tokens)
 
         mesh = hvd.mesh()
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             lambda v, t: GPT(cfg_u).apply(v, t),
             mesh=mesh, in_specs=(P(), P(None, hvd.HVD_AXES)),
             out_specs=P(None, hvd.HVD_AXES),
@@ -170,7 +170,7 @@ class TestGPTSequenceParallel:
             loss = hvd.allreduce(loss)
             return params, new_state, loss
 
-        step = jax.jit(jax.shard_map(
+        step = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(), P(), P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS),
                       P(hvd.CROSS_AXIS, hvd.LOCAL_AXIS)),

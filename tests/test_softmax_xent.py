@@ -90,7 +90,7 @@ class TestLinearCrossEntropy:
                                          block_v=512).mean()
             return hvd.allreduce(local, op=hvd.Average)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.HVD_AXES), P(), P(hvd.HVD_AXES)),
             out_specs=P()))(x, w, lab)

@@ -75,7 +75,7 @@ class TestTPGPT:
             return GPT(bad).apply({"params": local}, tok)
 
         with pytest.raises(ValueError, match="overlaps"):
-            jax.jit(jax.shard_map(
+            jax.jit(hvd.shard_map(
                 spmd, mesh=mesh,
                 in_specs=(P(hvd.LOCAL_AXIS), P(), P()),
                 out_specs=P()))(sharded, repl, tokens)
@@ -96,7 +96,7 @@ class TestTPGPT:
                 jax.tree.map(lambda a: a[0], stk), rp)
             return GPT(tp_cfg).apply({"params": local}, tok)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh, in_specs=(P(hvd.HVD_AXES), P(), P()),
             out_specs=P()))(sharded, repl, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
@@ -120,7 +120,7 @@ class TestTPGPT:
                 jax.tree.map(lambda a: a[0], stk), rp)
             return GPT(tp_cfg).apply({"params": local}, tok)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS)),
             out_specs=P(hvd.CROSS_AXIS)))(sharded, repl, tokens)
@@ -162,7 +162,7 @@ class TestTPGPT:
             new_qkv = new_local["h0"]["attn"]["qkv"]["kernel"]
             return new_qkv[None], hvd.allreduce(loss)
 
-        new_qkv, loss = jax.jit(jax.shard_map(
+        new_qkv, loss = jax.jit(hvd.shard_map(
             spmd, mesh=mesh,
             in_specs=(P(hvd.LOCAL_AXIS), P(), P(hvd.CROSS_AXIS),
                       P(hvd.CROSS_AXIS)),

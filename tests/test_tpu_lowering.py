@@ -128,7 +128,7 @@ def test_quantized_allreduce_lowers_for_tpu():
             out, nr = hvd.quantized_allreduce(v[0], res[0], op=hvd.Sum)
             return out, nr[None]
 
-        return jax.shard_map(spmd, mesh=mesh,
+        return hvd.shard_map(spmd, mesh=mesh,
                              in_specs=(P(hvd.HVD_AXES), P(hvd.HVD_AXES)),
                              out_specs=(P(), P(hvd.HVD_AXES)))(x, r)
 
