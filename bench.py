@@ -361,7 +361,7 @@ def build_workload(args, global_batch):
 
 
 def run_once(args, devices, platform, *, quantized=False, zero=False,
-             mesh_shape=None, tuned_params=None):
+             overlap=False, mesh_shape=None, tuned_params=None):
     """One full measurement on ``devices``: init the world, build the
     model + DistributedOptimizer step, compile, warm up, time, and return
     the result row (no JSON printing — the caller owns the one-line
@@ -401,6 +401,7 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
                                   compression=compression,
                                   quantized=quantized,
                                   zero=zero,
+                                  overlap=overlap,
                                   tuned_params=tuned_params)
     opt_state = tx.init(params)
 
@@ -454,7 +455,8 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     # the autotuner's fusion/hierarchical knobs actually steer
     # (auto-psummed replicated grads never touch the fusion path).
     reduce_in_optimizer = bool(args.quantized or getattr(args, "zero", False)
-                               or getattr(args, "autotune", False))
+                               or getattr(args, "autotune", False)
+                               or getattr(args, "overlap", False))
 
     def spmd(p, bs, s, xb, yb):
         (loss, nbs), grads = hvd.value_and_grad(
@@ -607,6 +609,8 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         "wire_bytes_dcn": wire.dcn_bytes,
         "wire_bytes_dcn_fp": wire.dcn_bytes_fp,
         "wire_reduction_dcn": wire.dcn_reduction,
+        "wire_bytes_overlap": wire.overlap_bytes,
+        "comm_hidden_fraction": wire.hidden_fraction,
         "opt_state_bytes_per_rank": opt_state_bytes_per_rank,
     }
 
@@ -754,6 +758,15 @@ def main():
                          "reduce-in-optimizer step and reports "
                          "throughput_delta, opt_state_bytes_per_rank and "
                          "wire bytes (docs/zero.md)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="A/B the overlapped gradient reduction "
+                         "(HOROVOD_OVERLAP: reverse-layer bucket "
+                         "streaming + async-collective/LHS flags, "
+                         "docs/overlap.md): runs a synchronous leg and "
+                         "an overlap leg over the same reduce-in-"
+                         "optimizer step and reports throughput_delta, "
+                         "comm_hidden_fraction, and a "
+                         "step_time_breakdown")
     ap.add_argument("--autotune", action="store_true",
                     help="run the online Bayesian tuning session "
                          "(hvd.autotune_session: GP/EI over fusion "
@@ -805,18 +818,24 @@ def main():
                      f"got {args.scaling!r}")
         if not sweep or sweep[0] < 1:
             ap.error("--scaling sizes must be >= 1")
-        if args.quantized or args.mesh_shape or args.autotune or args.zero:
+        if args.quantized or args.mesh_shape or args.autotune or args.zero \
+                or args.overlap:
             ap.error("--scaling cannot combine with --quantized/"
-                     "--mesh-shape/--autotune/--zero (the sweep re-shapes "
-                     "the world per size)")
-    if args.autotune and (args.quantized or args.profile or args.zero):
+                     "--mesh-shape/--autotune/--zero/--overlap (the sweep "
+                     "re-shapes the world per size)")
+    if args.autotune and (args.quantized or args.profile or args.zero
+                          or args.overlap):
         ap.error("--autotune cannot combine with --quantized/--profile/"
-                 "--zero (one A/B structure per run)")
+                 "--zero/--overlap (one A/B structure per run)")
     if args.zero and args.quantized:
         ap.error("--zero cannot combine with --quantized (one A/B "
                  "structure per run; the quantized ZeRO wire is covered "
                  "by DistributedOptimizer(zero=True, quantized=True) and "
                  "tests/test_zero.py)")
+    if args.overlap and (args.quantized or args.zero):
+        ap.error("--overlap cannot combine with --quantized/--zero (one "
+                 "A/B structure per run; the compose matrix is covered "
+                 "by tests/test_overlap.py)")
 
     mesh_shape = None
     if args.mesh_shape:
@@ -866,16 +885,19 @@ def main():
             len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
                          f"does not cover {len(devices)} devices")
-    if (args.quantized or args.autotune or args.zero) and mesh_shape is None \
+    if (args.quantized or args.autotune or args.zero or args.overlap) \
+            and mesh_shape is None \
             and len(devices) % 2 == 0 and len(devices) >= 2:
         # A DCN (cross) hop is what quantization compresses, what the
-        # hierarchical-allreduce knob decomposes, and what splits the
-        # ZeRO reduce-scatter into its ICI/DCN legs; emulate a 2-host
-        # topology unless the user pinned one.
+        # hierarchical-allreduce knob decomposes, what splits the ZeRO
+        # reduce-scatter into its ICI/DCN legs, and what the overlap
+        # schedule hides under backward; emulate a 2-host topology
+        # unless the user pinned one.
         mesh_shape = (2, len(devices) // 2)
-        log(f"--{'quantized' if args.quantized else 'zero' if args.zero else 'autotune'}: "
-            f"emulating mesh_shape {mesh_shape} so the collectives have "
-            f"a cross (DCN) hop")
+        which = ("quantized" if args.quantized else "zero" if args.zero
+                 else "overlap" if args.overlap else "autotune")
+        log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
+            f"collectives have a cross (DCN) hop")
 
     metric_stem = (f"gpt{args.gpt_scale}" if args.model == "gpt"
                    else args.model)
@@ -972,6 +994,71 @@ def main():
                            if mesh_shape else None),
             "baseline_per_chip": round(res_d["per_chip"], 2),
             "throughput_delta": round(delta, 4),
+            **gpt_fields,
+        }), flush=True)
+        return
+
+    if args.overlap:
+        # A/B: identical step structure (reduce-in-optimizer), identical
+        # mesh, same fused bucket plan; only the schedule changes
+        # (synchronous post-backward reduction vs reverse-layer bucket
+        # streaming). Baseline first so an overlap-path failure still
+        # leaves a reference number in the log.
+        log("=== A/B leg 1/2: baseline (synchronous reduction) ===")
+        res_b = run_once(args, devices, platform, overlap=False,
+                         mesh_shape=mesh_shape)
+        log("=== A/B leg 2/2: overlapped bucket streaming ===")
+        res_o = run_once(args, devices, platform, overlap=True,
+                         mesh_shape=mesh_shape)
+        delta = res_o["per_chip"] / res_b["per_chip"] - 1.0
+        # comm_hidden_fraction: share of the step's per-device wire bytes
+        # issued through the overlap stream schedule (record_wire_stats
+        # trace-time accounting) — traffic positioned for the latency-
+        # hiding scheduler to run under backward/update compute.
+        hidden = res_o["comm_hidden_fraction"]
+        # step_time_breakdown: compute_ms backs the model wire time out
+        # of the synchronous leg (baseline = compute + fully exposed
+        # comm); exposed_comm_ms is what the overlap leg still pays on
+        # top of that compute. Bandwidths are modeled (env-overridable) —
+        # on an emulated CPU mesh they are nominal, on a pod they are the
+        # chip spec.
+        ici_gbps = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
+        dcn_gbps = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+        wire_ms = (res_b["wire_bytes_ici"] / (ici_gbps * 1e9)
+                   + res_b["wire_bytes_dcn"] / (dcn_gbps * 1e9)) * 1e3
+        compute_ms = max(0.0, res_b["step_ms_median"] - wire_ms)
+        exposed_ms = max(0.0, res_o["step_ms_median"] - compute_ms)
+        log(f"A/B: sync {res_b['per_chip']:.1f} vs overlap "
+            f"{res_o['per_chip']:.1f} {res_b['unit']} "
+            f"({100 * delta:+.1f}%); comm hidden fraction {hidden:.3f} "
+            f"({res_o['wire_bytes_overlap'] / 1e6:.3f} of "
+            f"{(res_o['wire_bytes_ici'] + res_o['wire_bytes_dcn']) / 1e6:.3f}"
+            f" MB/step/device streamed)")
+        print(json.dumps({
+            "metric": metric,
+            "value": round(res_o["per_chip"], 2),
+            "unit": res_o["unit"],
+            "vs_baseline": None,
+            "mfu": (round(res_o["mfu"], 4)
+                    if res_o["mfu"] is not None else None),
+            "step_ms_median": round(res_o["step_ms_median"], 3),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "chips": res_o["chips"],
+            "per_chip_batch": args.batch_size,
+            "overlap": True,
+            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                           if mesh_shape else None),
+            "baseline_per_chip": round(res_b["per_chip"], 2),
+            "throughput_delta": round(delta, 4),
+            "comm_hidden_fraction": round(hidden, 4),
+            "step_time_breakdown": {
+                "compute_ms": round(compute_ms, 3),
+                "exposed_comm_ms": round(exposed_ms, 3),
+            },
+            "wire_bytes_overlap": round(res_o["wire_bytes_overlap"], 1),
+            "wire_bytes_ici": round(res_o["wire_bytes_ici"], 1),
+            "wire_bytes_dcn": round(res_o["wire_bytes_dcn"], 1),
             **gpt_fields,
         }), flush=True)
         return

@@ -84,10 +84,12 @@ from .ops.collective_ops import (  # noqa: F401
     ReduceOp,
     Sum,
     all_gather,
+    all_gather_stream,
     allgather,
     allgather_async,
     allreduce,
     allreduce_async,
+    allreduce_stream,
     alltoall,
     alltoall_async,
     alltoall_ragged,
@@ -100,10 +102,11 @@ from .ops.collective_ops import (  # noqa: F401
     quantized_allreduce,
     record_wire_stats,
     reduce_scatter,
+    reduce_scatter_stream,
     synchronize,
 )
 from .ops.compression import Compression  # noqa: F401
-from .ops.fusion import allreduce_pytree  # noqa: F401
+from .ops.fusion import allreduce_pytree, stream_order  # noqa: F401
 from .parallel.functions import (  # noqa: F401
     allgather_object,
     broadcast_object,
@@ -121,8 +124,11 @@ from .ops.softmax_xent import (  # noqa: F401
 )
 from .parallel.optimizer import (  # noqa: F401
     DistributedOptimizer,
+    OverlapMultiStepsState,
     QuantizedEFState,
+    ZeroOverlapMultiStepsState,
     ZeroState,
+    overlap_state_pspecs,
     zero_reshard_state,
     zero_state_pspecs,
 )

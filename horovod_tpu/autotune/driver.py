@@ -31,7 +31,8 @@ log = logging.getLogger("horovod_tpu.autotune")
 
 # Cache-entry schema version; bump when TunedParams gains/changes knobs.
 # v2: + zero_sharding (ZeRO-1 sharded optimizer).
-_CACHE_VERSION = 2
+# v3: + overlap / num_comm_streams (overlapped gradient reduction).
+_CACHE_VERSION = 3
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -133,6 +134,7 @@ def autotune_session(
     tune_quant_block: Optional[bool] = None,
     tune_hierarchical: bool = True,
     tune_zero: bool = False,
+    tune_overlap: bool = False,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -165,7 +167,10 @@ def autotune_session(
     ``tuned.zero_sharding`` through (``DistributedOptimizer(tuned_params=
     tuned)`` + ``hvd.value_and_grad(..., tuned_params=tuned)`` do) — the
     knob restructures the optimizer state, so a step built without it
-    would silently score a config it never ran.
+    would silently score a config it never ran. ``tune_overlap`` gates
+    the ``overlap`` + ``num_comm_streams`` pair the same way (overlap ×
+    ``backward_passes_per_step`` restructures the accumulation state,
+    docs/overlap.md).
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -219,6 +224,7 @@ def autotune_session(
         tune_quant_block=tune_quant_block,
         tune_hierarchical=tune_hierarchical,
         tune_zero=tune_zero,
+        tune_overlap=tune_overlap,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,

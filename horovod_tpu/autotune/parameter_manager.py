@@ -39,18 +39,19 @@ from .gp import GaussianProcess
 log = logging.getLogger("horovod_tpu.autotune")
 
 # Search bounds, log2-space (ISSUE 3: fusion threshold 1-256 MiB,
-# quant_block 64-1024).
+# quant_block 64-1024; ISSUE 5: num_comm_streams pow2 1-4).
 _MIN_FUSION_LOG = 20.0  # 2^20 = 1 MiB
 _MAX_FUSION_LOG = 28.0  # 2^28 = 256 MiB
 _MIN_QBLOCK_LOG = 6.0   # 2^6  = 64
 _MAX_QBLOCK_LOG = 10.0  # 2^10 = 1024
-_DIMS = 4  # fusion, quant_block, hierarchical, zero_sharding
+_MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
+_DIMS = 6  # fusion, quant_block, hierarchical, zero, overlap, streams
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
-              "hierarchical_allreduce", "zero_sharding",
-              "score_steps_per_sec")
+              "hierarchical_allreduce", "zero_sharding", "overlap",
+              "num_comm_streams", "score_steps_per_sec")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,6 +65,8 @@ class TunedParams:
     quant_block: int = 256
     hierarchical_allreduce: bool = False
     zero_sharding: bool = False
+    overlap: bool = False
+    num_comm_streams: int = 1
 
     def as_dict(self) -> dict:
         return {
@@ -71,17 +74,21 @@ class TunedParams:
             "quant_block": int(self.quant_block),
             "hierarchical_allreduce": bool(self.hierarchical_allreduce),
             "zero_sharding": bool(self.zero_sharding),
+            "overlap": bool(self.overlap),
+            "num_comm_streams": int(self.num_comm_streams),
         }
 
     @classmethod
     def from_dict(cls, d: dict) -> "TunedParams":
-        # .get: entries cached before the zero knob existed stay readable
-        # (the cache key's schema version gates real reuse).
+        # .get: entries cached before the zero/overlap knobs existed stay
+        # readable (the cache key's schema version gates real reuse).
         return cls(
             fusion_threshold_bytes=int(d["fusion_threshold_bytes"]),
             quant_block=int(d["quant_block"]),
             hierarchical_allreduce=bool(d["hierarchical_allreduce"]),
             zero_sharding=bool(d.get("zero_sharding", False)),
+            overlap=bool(d.get("overlap", False)),
+            num_comm_streams=int(d.get("num_comm_streams", 1)),
         )
 
     @classmethod
@@ -94,6 +101,8 @@ class TunedParams:
             quant_block=config.quant_block,
             hierarchical_allreduce=config.hierarchical_allreduce,
             zero_sharding=getattr(config, "zero_sharding", False),
+            overlap=getattr(config, "overlap", False),
+            num_comm_streams=getattr(config, "num_comm_streams", 1),
         )
 
 
@@ -139,6 +148,7 @@ class ParameterManager:
         tune_quant_block: bool = False,
         tune_hierarchical: bool = True,
         tune_zero: bool = False,
+        tune_overlap: bool = False,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -158,6 +168,12 @@ class ParameterManager:
         # searched only when the session's step builder declares it can
         # accept the knob (autotune_session(tune_zero=True)).
         self.tune_zero = tune_zero
+        # overlap restructures the microbatch loop when composed with
+        # backward_passes_per_step (OverlapMultiStepsState), so it is
+        # gated the same way (autotune_session(tune_overlap=True));
+        # num_comm_streams rides the same gate — it only means anything
+        # with overlap on.
+        self.tune_overlap = tune_overlap
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -182,6 +198,7 @@ class ParameterManager:
     def _to_unit(self, p: TunedParams) -> Tuple[float, ...]:
         f = math.log2(max(1, p.fusion_threshold_bytes))
         q = math.log2(max(1, p.quant_block))
+        s = math.log2(max(1, p.num_comm_streams))
         return (
             (f - _MIN_FUSION_LOG) / (_MAX_FUSION_LOG - _MIN_FUSION_LOG),
             (q - _MIN_QBLOCK_LOG) / (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG),
@@ -189,6 +206,8 @@ class ParameterManager:
             # inside the box.
             0.75 if p.hierarchical_allreduce else 0.25,
             0.75 if p.zero_sharding else 0.25,
+            0.75 if p.overlap else 0.25,
+            s / _MAX_STREAMS_LOG,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -205,11 +224,24 @@ class ParameterManager:
                 else self.initial.hierarchical_allreduce)
         zero = (u[3] >= 0.5 if self.tune_zero
                 else self.initial.zero_sharding)
+        if self.tune_overlap:
+            ov = u[4] >= 0.5
+            # pow2 snap 1-4; only meaningful with overlap on — pin the
+            # dead dimension so it never splits otherwise-equal trials.
+            ns = 1 << max(0, min(int(_MAX_STREAMS_LOG),
+                                 round(u[5] * _MAX_STREAMS_LOG)))
+            if not ov:
+                ns = 1
+        else:
+            ov = self.initial.overlap
+            ns = self.initial.num_comm_streams
         return TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
             hierarchical_allreduce=hier,
             zero_sharding=zero,
+            overlap=ov,
+            num_comm_streams=ns,
         )
 
     def _unit_key(self, p: TunedParams) -> tuple:
@@ -218,7 +250,8 @@ class ParameterManager:
         # Fusion threshold dedups at 1/4-octave resolution — finer than
         # that cannot change a bucket plan by more than rounding.
         return (round(math.log2(max(1, p.fusion_threshold_bytes)) * 4),
-                p.quant_block, p.hierarchical_allreduce, p.zero_sharding)
+                p.quant_block, p.hierarchical_allreduce, p.zero_sharding,
+                p.overlap, p.num_comm_streams)
 
     # -- sampling loop ---------------------------------------------------
 
@@ -258,6 +291,8 @@ class ParameterManager:
                             p.quant_block,
                             int(p.hierarchical_allreduce),
                             int(p.zero_sharding),
+                            int(p.overlap),
+                            int(p.num_comm_streams),
                             f"{score:.6g}"])
         self._log.flush()
 
@@ -267,10 +302,12 @@ class ParameterManager:
         self.close()
         log.info(
             "autotune converged after %d samples: fusion_threshold=%d "
-            "quant_block=%d hierarchical=%s zero=%s (best %.3f steps/sec)",
+            "quant_block=%d hierarchical=%s zero=%s overlap=%s streams=%d "
+            "(best %.3f steps/sec)",
             len(self.history), self.best.fusion_threshold_bytes,
             self.best.quant_block, self.best.hierarchical_allreduce,
-            self.best.zero_sharding, self.best_score)
+            self.best.zero_sharding, self.best.overlap,
+            self.best.num_comm_streams, self.best_score)
 
     def _sample_unit(self) -> Tuple[float, ...]:
         u = [self._rng.next() for _ in range(_DIMS)]
@@ -278,6 +315,9 @@ class ParameterManager:
             u[2] = 0.25
         if not self.tune_zero:
             u[3] = 0.25
+        if not self.tune_overlap:
+            u[4] = 0.25
+            u[5] = 0.0
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -334,6 +374,9 @@ def read_log(path: str) -> List[dict]:
                 "hierarchical_allreduce": bool(
                     int(rec["hierarchical_allreduce"])),
                 "zero_sharding": bool(int(rec.get("zero_sharding", 0))),
+                "overlap": bool(int(rec.get("overlap", 0) or 0)),
+                "num_comm_streams": int(rec.get("num_comm_streams", 1)
+                                        or 1),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             })
     return rows

@@ -60,6 +60,101 @@ class BackendInitError(HorovodTpuError):
     """The accelerator backend could not be initialized after retries."""
 
 
+# ---------------------------------------------------------------------------
+# Overlapped-collective scheduling flags (docs/overlap.md).
+#
+# XLA hides collectives under compute only when (a) the collective lowers
+# to an async start/done pair and (b) the latency-hiding scheduler is
+# allowed to stretch the start→done window across independent compute.
+# Both are TPU compiler flags; on CPU/GPU backends the TPU spellings are
+# unknown flags that would crash XLA option parsing, so enabling is
+# platform-gated with a graceful no-op fallback.
+# ---------------------------------------------------------------------------
+
+# The canonical TPU async-collective + LHS flag set (the same knobs the
+# public MaxText/T5X configs ship with).
+_OVERLAP_XLA_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+)
+
+
+def _requested_platform() -> str:
+    """The platform the process is headed for, WITHOUT creating a backend
+    (jax.devices() here would freeze XLA_FLAGS before we can edit them):
+    jax.config's jax_platforms if set, else the JAX_PLATFORMS env, else
+    'auto'."""
+    try:
+        p = jax.config.jax_platforms  # set by jax.config.update
+    except AttributeError:
+        p = None
+    if not p:
+        p = os.environ.get("JAX_PLATFORMS") or ""
+    p = p.split(",")[0].strip().lower()
+    return p or "auto"
+
+
+def _backend_already_created() -> bool:
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:  # pragma: no cover - private-API drift
+        return False
+
+
+def enable_overlap_scheduling(platform: Optional[str] = None) -> bool:
+    """Arm XLA's async-collective + latency-hiding-scheduler flags for the
+    overlapped gradient reduction (``HOROVOD_OVERLAP=1``, docs/overlap.md).
+
+    Appends :data:`_OVERLAP_XLA_FLAGS` to ``XLA_FLAGS`` so the NEXT PJRT
+    client creation compiles collectives as async start/done pairs the
+    scheduler can stretch over independent backward compute. Returns True
+    when the flags were (or already are) armed for a TPU backend.
+
+    Graceful fallback everywhere else: on cpu/gpu platforms the TPU flag
+    spellings don't exist, so this is a logged no-op — the overlap
+    *schedule* (stream-ordered buckets, double-buffered microbatches,
+    ops/fusion.py) still traces identically; only the compiler-level
+    hiding is absent. Call before the first ``jax.devices()``; if a
+    backend already exists the flags cannot take effect in this process
+    and we say so instead of silently lying.
+    """
+    platform = (platform or _requested_platform()).lower()
+    if platform in ("auto", ""):
+        # Only commit to the TPU flag set when a TPU is actually in
+        # reach: XLA aborts on unknown flags, so guessing wrong on a
+        # CPU-only box would turn the graceful fallback into a crash.
+        import glob
+
+        has_tpu = bool(glob.glob("/dev/accel*")) or bool(
+            os.environ.get("PALLAS_AXON_POOL_IPS"))
+        platform = "tpu" if has_tpu else "cpu"
+    if platform != "tpu":
+        _log(f"overlap: platform {platform!r} has no async-collective "
+             "flag support; running the overlap schedule without "
+             "compiler-level latency hiding")
+        return False
+    flags = os.environ.get("XLA_FLAGS", "")
+    missing = [f for f in _OVERLAP_XLA_FLAGS if f not in flags]
+    if not missing:
+        return True
+    if _backend_already_created():
+        _log("overlap: the XLA backend is already initialized; async-"
+             "collective flags cannot apply to this process (set "
+             "HOROVOD_OVERLAP=1 before the first jax.devices() call, or "
+             "export XLA_FLAGS yourself)")
+        return False
+    os.environ["XLA_FLAGS"] = (flags + " " + " ".join(missing)).strip()
+    _log("overlap: armed async-collective/latency-hiding XLA flags "
+         f"({len(missing)} added)")
+    return True
+
+
 def _is_transient(exc: BaseException) -> bool:
     msg = f"{type(exc).__name__}: {exc}"
     low = msg.lower()

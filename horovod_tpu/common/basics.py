@@ -215,6 +215,13 @@ def init(
         _bridge_jsm_env()
         _bridge_mpi_env()
         _state.config = _config.from_env()
+        if _state.config.overlap:
+            # Before the mesh (= before PJRT client creation): the async-
+            # collective/LHS flags only apply to a fresh backend. Graceful
+            # no-op off-TPU (docs/overlap.md).
+            from .backend import enable_overlap_scheduling
+
+            enable_overlap_scheduling()
         _state.mesh = _build_mesh(devices, mesh_shape)
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()

@@ -77,6 +77,11 @@ class Config:
     #     data parallelism with per-rank optax updates, docs/zero.md) ---
     zero_sharding: bool = False
 
+    # --- overlapped gradient reduction (docs/overlap.md): stream fused
+    #     buckets into collectives while backward compute still runs ---
+    overlap: bool = False
+    num_comm_streams: int = 1  # bucket collectives in flight (pow2 1-4)
+
     # --- autotune (common.h:68-73) ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -130,6 +135,8 @@ def from_env() -> Config:
         quantized_allreduce=_env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False),
         quant_block=_env_int("HOROVOD_QUANT_BLOCK", 256),
         zero_sharding=_env_bool("HOROVOD_ZERO_SHARDING", False),
+        overlap=_env_bool("HOROVOD_OVERLAP", False),
+        num_comm_streams=_env_int("HOROVOD_NUM_COMM_STREAMS", 1),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),
         autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
         autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

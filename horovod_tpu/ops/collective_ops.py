@@ -200,11 +200,26 @@ class WireStats:
         self.ici_bytes = 0.0
         self.dcn_bytes = 0.0
         self.dcn_bytes_fp = 0.0
+        # Bytes issued through the overlap stream schedule (the
+        # allreduce_stream / reduce_scatter_stream / all_gather_stream
+        # entry points, docs/overlap.md) — wire traffic positioned so the
+        # latency-hiding scheduler can run it under independent compute.
+        self.overlap_bytes = 0.0
+        self.streamed_buckets = 0
 
     @property
     def dcn_reduction(self) -> Optional[float]:
         """fp-equivalent / actual bytes on the DCN hop (None if no DCN)."""
         return (self.dcn_bytes_fp / self.dcn_bytes) if self.dcn_bytes else None
+
+    @property
+    def hidden_fraction(self) -> float:
+        """Fraction of this program's wire bytes issued through the
+        overlap stream schedule (0.0 with overlap off; collectives
+        outside the gradient bucket wire — loss allreduce, batch-stats —
+        keep it below 1.0). The bench's ``comm_hidden_fraction``."""
+        total = self.ici_bytes + self.dcn_bytes
+        return (self.overlap_bytes / total) if total else 0.0
 
 
 _wire_recorders: list = []
@@ -772,6 +787,70 @@ def _eager_shard_all_gather(shard, residual, name: Optional[str]):
     else:
         full = _eager_allgather(x, _eager_name(name, "shard_all_gather"))
     return full if residual is None else (full, new_res)
+
+
+# ---------------------------------------------------------------------------
+# Overlap stream entry points (docs/overlap.md).
+#
+# One fused bucket per call, issued in the reverse-layer stream schedule
+# (ops/fusion.py stream_order) so buckets whose leaves finish early in
+# backprop launch first and XLA's latency-hiding scheduler can run them
+# under the still-executing backward. The wrappers change NO numerics —
+# they bracket the exact same collective with trace-time bookkeeping:
+# per-bucket OVERLAP:* timeline spans and WireStats.overlap_bytes (the
+# bench's comm_hidden_fraction numerator).
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _overlap_stream(kind: str, bucket_id):
+    """Bracket one streamed bucket collective: emit an ``OVERLAP:<kind>``
+    timeline span (host trace time) and account the bytes the wrapped
+    collective records as overlap-scheduled."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    tid = f"bucket{bucket_id}"
+    activity = f"OVERLAP:{kind}"
+    before = [(ws, ws.ici_bytes + ws.dcn_bytes) for ws in _wire_recorders]
+    if tl is not None:
+        tl.begin(tid, activity)
+    try:
+        yield
+    finally:
+        for ws, b in before:
+            delta = (ws.ici_bytes + ws.dcn_bytes) - b
+            ws.overlap_bytes += delta
+            ws.streamed_buckets += 1
+        if tl is not None:
+            tl.end(tid, activity)
+
+
+def allreduce_stream(tensor, residual=None, *, bucket_id=0, **kwargs):
+    """Per-bucket streaming allreduce: :func:`allreduce` (or, with
+    ``residual``, :func:`quantized_allreduce`) bracketed with
+    ``OVERLAP:ALLREDUCE`` bookkeeping. Bit-identical to the wrapped call —
+    the overlap comes from WHERE the scheduler (ops/fusion.py) issues it,
+    not from different math. Returns what the wrapped op returns
+    (``out``, or ``(out, new_residual)`` when ``residual`` is given)."""
+    with _overlap_stream("ALLREDUCE", bucket_id):
+        if residual is not None:
+            return quantized_allreduce(tensor, residual, **kwargs)
+        return allreduce(tensor, **kwargs)
+
+
+def reduce_scatter_stream(tensor, residual=None, *, bucket_id=0, **kwargs):
+    """Per-bucket streaming reduce-scatter (the ZeRO gradient wire under
+    the overlap schedule): :func:`reduce_scatter` bracketed with
+    ``OVERLAP:REDUCE_SCATTER`` bookkeeping; same contract."""
+    with _overlap_stream("REDUCE_SCATTER", bucket_id):
+        return reduce_scatter(tensor, residual, **kwargs)
+
+
+def all_gather_stream(shard, residual=None, *, bucket_id=0, **kwargs):
+    """Per-bucket streaming all-gather (the ZeRO update broadcast under
+    the overlap schedule): :func:`all_gather` bracketed with
+    ``OVERLAP:ALL_GATHER`` bookkeeping; same contract."""
+    with _overlap_stream("ALL_GATHER", bucket_id):
+        return all_gather(shard, residual, **kwargs)
 
 
 def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],

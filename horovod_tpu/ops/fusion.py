@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..common import basics
+from ..common.config import _env_bool
 from . import collective_ops as C
 from .compression import Compression
 
@@ -125,6 +126,44 @@ def _close_bucket(dtype, idxs: List[int], leaves,
                   shapes=shapes, padded_size=padded)
 
 
+def stream_order(buckets: Sequence[Bucket]) -> Tuple[int, ...]:
+    """Reverse-layer bucket issue schedule (docs/overlap.md).
+
+    Backprop produces gradients output-side first: for a forward-ordered
+    parameter pytree that means the HIGHEST leaf indices become ready
+    earliest. Issuing the bucket holding the highest leaf index first
+    aligns collective program order with data readiness, so a streamed
+    bucket can launch while the backward of earlier (input-side) layers
+    is still running — the compiled-path analogue of Horovod's background
+    coordinator starting reductions mid-backprop.
+
+    Only the ISSUE order changes; leaf→bucket assignment comes unchanged
+    from :func:`plan_buckets`, so every bucket carries identical contents
+    (and, on the quantized wire, identical scale-block boundaries) to the
+    in-order schedule — any collective sequence issued this way computes
+    bit-identical values. Ties (impossible within one dtype group, since
+    leaf indices are unique) break by bucket index for determinism."""
+    return tuple(sorted(range(len(buckets)),
+                        key=lambda j: (-max(buckets[j].leaf_indices), j)))
+
+
+def _resolve_overlap(overlap, num_comm_streams, tuned_params):
+    """(overlap_on, streams): explicit args > TunedParams override >
+    HOROVOD_OVERLAP / HOROVOD_NUM_COMM_STREAMS config."""
+    if tuned_params is not None:
+        if overlap is None:
+            overlap = tuned_params.overlap
+        if num_comm_streams is None:
+            num_comm_streams = tuned_params.num_comm_streams
+    if overlap is None:
+        overlap = (basics.config().overlap if basics.is_initialized()
+                   else _env_bool("HOROVOD_OVERLAP", False))
+    if num_comm_streams is None:
+        num_comm_streams = (basics.config().num_comm_streams
+                            if basics.is_initialized() else 1)
+    return bool(overlap), max(1, int(num_comm_streams))
+
+
 def pack(bucket: Bucket, leaves: Sequence[jax.Array]) -> jax.Array:
     """Concatenate the bucket's leaves into one flat padded buffer (the
     MemcpyInFusionBuffer analogue, collective_operations.cc:34-59 — here a
@@ -214,6 +253,8 @@ def allreduce_pytree(
     error_feedback=None,
     block: Optional[int] = None,
     tuned_params=None,
+    overlap: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
 ):
     """Allreduce every leaf of a pytree with tensor fusion.
 
@@ -243,10 +284,22 @@ def allreduce_pytree(
     zero).
 
     ``tuned_params`` (an ``autotune.TunedParams``) applies an autotuner
-    override: it fills ``threshold_bytes``, ``hierarchical``, and the
-    int8 scale-``block`` wherever the caller left them unset, so a tuning
-    session (or its frozen winner) steers the trace without touching the
-    process-wide env config. Explicit per-call arguments still win."""
+    override: it fills ``threshold_bytes``, ``hierarchical``, the int8
+    scale-``block``, and the ``overlap``/``num_comm_streams`` pair
+    wherever the caller left them unset, so a tuning session (or its
+    frozen winner) steers the trace without touching the process-wide env
+    config. Explicit per-call arguments still win.
+
+    ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) issues the bucket
+    collectives through the reverse-layer stream schedule
+    (:func:`stream_order` + per-bucket
+    :func:`~horovod_tpu.ops.collective_ops.allreduce_stream`), in flights
+    of ``num_comm_streams`` buckets whose unpacking is deferred until the
+    flight is issued — so up to that many collectives sit in the program
+    with no consumer between them and the latency-hiding scheduler can
+    run them under backward compute. Bucket contents and per-bucket math
+    are untouched, so overlap mode is bit-identical to off
+    (docs/overlap.md)."""
     if tuned_params is not None:
         if threshold_bytes is None:
             threshold_bytes = tuned_params.fusion_threshold_bytes
@@ -285,26 +338,53 @@ def allreduce_pytree(
         v_ef = (None if new_ef is None
                 else [ef_leaves[i] for i in varying_idx])
         buckets = plan_buckets(vleaves, threshold_bytes)
-        for bucket in buckets:
-            buf = pack(bucket, vleaves)
-            if (new_ef is not None
-                    and jnp.issubdtype(bucket.dtype, jnp.floating)):
-                rbuf = pack(bucket, v_ef)
-                red, rnew = C.quantized_allreduce(
-                    buf, rbuf, op=op, compression=compression, axes=axes,
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor, block=block)
-                for j, r in zip(bucket.leaf_indices, unpack(bucket, rnew)):
-                    new_ef[varying_idx[j]] = r
-            else:
-                red = C.allreduce(
-                    buf, op=op, compression=compression, axes=axes,
-                    hierarchical=hierarchical,
-                    prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor, quantized=quantized,
-                    block=block)
-            for j, leaf in zip(bucket.leaf_indices, unpack(bucket, red)):
-                out[varying_idx[j]] = leaf
+        overlap_on, n_streams = _resolve_overlap(overlap, num_comm_streams,
+                                                 tuned_params)
+        order = (stream_order(buckets) if overlap_on
+                 else tuple(range(len(buckets))))
+        flight = n_streams if overlap_on else 1
+        for s in range(0, len(order), flight):
+            issued = []
+            for j in order[s:s + flight]:
+                bucket = buckets[j]
+                buf = pack(bucket, vleaves)
+                use_ef = (new_ef is not None
+                          and jnp.issubdtype(bucket.dtype, jnp.floating))
+                if use_ef:
+                    rbuf = pack(bucket, v_ef)
+                    if overlap_on:
+                        red, rnew = C.allreduce_stream(
+                            buf, rbuf, bucket_id=j, op=op,
+                            compression=compression, axes=axes,
+                            prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor, block=block)
+                    else:
+                        red, rnew = C.quantized_allreduce(
+                            buf, rbuf, op=op, compression=compression,
+                            axes=axes, prescale_factor=prescale_factor,
+                            postscale_factor=postscale_factor, block=block)
+                else:
+                    rnew = None
+                    kw = dict(op=op, compression=compression, axes=axes,
+                              hierarchical=hierarchical,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              quantized=quantized, block=block)
+                    red = (C.allreduce_stream(buf, bucket_id=j, **kw)
+                           if overlap_on else C.allreduce(buf, **kw))
+                issued.append((j, red, rnew))
+            # Unpack AFTER the whole flight is issued: no consumer sits
+            # between in-flight collectives, so the scheduler may run
+            # them concurrently (flight == 1 reproduces the serial
+            # issue→unpack order of overlap-off exactly).
+            for j, red, rnew in issued:
+                bucket = buckets[j]
+                if rnew is not None:
+                    for i, r in zip(bucket.leaf_indices,
+                                    unpack(bucket, rnew)):
+                        new_ef[varying_idx[i]] = r
+                for i, leaf in zip(bucket.leaf_indices, unpack(bucket, red)):
+                    out[varying_idx[i]] = leaf
     result = jax.tree.unflatten(treedef, out)
     if error_feedback is None:
         return result

@@ -98,6 +98,76 @@ def zero_state_pspecs(state):
         state)
 
 
+def _lead_read(tree):
+    """Strip the per-rank leading axis of a leading-axis state tree:
+    in-trace each rank's slice is its ``[1, ...]`` row; eagerly row
+    ``rank()`` of the full ``[world, ...]`` stack (the
+    :class:`QuantizedEFState` residual convention)."""
+    r = 0 if C._hvd_axes_in_trace() else (
+        basics.rank() if basics.is_initialized() else 0)
+    return jax.tree.map(lambda a: a[r], tree)
+
+
+def _lead_write(tree, new_local):
+    """Write this rank's row back into a leading-axis state tree. In-trace
+    the row is pvaried first so the ``P(HVD_AXES)`` out-spec always sees a
+    device-varying value (a branchless ``where`` can hand back provably
+    replicated zeros)."""
+    axes = C._hvd_axes_in_trace()
+    if axes:
+        return jax.tree.map(
+            lambda a: C.pvary_missing(a, axes)[None], new_local)
+    r = basics.rank() if basics.is_initialized() else 0
+    return jax.tree.map(lambda a, v: a.at[r].set(v), tree, new_local)
+
+
+class OverlapMultiStepsState(NamedTuple):
+    """State of the double-buffered microbatch accumulator
+    (``overlap=True`` + ``backward_passes_per_step`` k > 1 on the
+    replicated path — docs/overlap.md mechanism 1).
+
+    ``inner`` is the wrapped transformation's state and ``acc`` the
+    running sum of *reduced* gradients — both replicated (``P()``).
+    ``pending`` holds the previous microbatch's raw per-rank local
+    gradients and ``residual`` the quantized wire's error-feedback
+    accumulator (``None`` unquantized); both are rank-local state with a
+    leading per-rank axis riding ``P(hvd.HVD_AXES)`` in/out specs, the
+    :class:`QuantizedEFState` residual convention
+    (:func:`overlap_state_pspecs` builds the matching spec tree).
+
+    Call *t* of a cycle reduces microbatch *t−1*'s buckets (``pending``)
+    — a reduction with NO data dependence on the caller's microbatch-*t*
+    backward traced in the same program region, which is exactly what
+    lets the latency-hiding scheduler run the two concurrently. The
+    final call folds the last two microbatches into one reduction (the
+    wire is linear, so the accumulated sum is unchanged) and overlaps it
+    with the optimizer update of already-reduced buckets. Each cycle
+    issues k bucket reductions (vs ``optax.MultiSteps``' single deferred
+    one): the classic DDP trade of wire volume for comm time hidden
+    under backward.
+    """
+
+    mini_step: Any  # int32 scalar, 0..k-1
+    inner: Any
+    acc: Any
+    pending: Any
+    residual: Any
+
+
+def overlap_state_pspecs(state: "OverlapMultiStepsState"):
+    """PartitionSpec tree for an :class:`OverlapMultiStepsState` under
+    ``hvd.shard_map``: ``pending``/``residual`` shard their leading
+    per-rank axis (``P(HVD_AXES)``), everything else replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    lead = lambda t: jax.tree.map(lambda _: P(basics.HVD_AXES), t)  # noqa: E731
+    rep = lambda t: jax.tree.map(lambda _: P(), t)  # noqa: E731
+    return OverlapMultiStepsState(
+        mini_step=P(), inner=rep(state.inner), acc=rep(state.acc),
+        pending=lead(state.pending),
+        residual=None if state.residual is None else lead(state.residual))
+
+
 class QuantizedEFState(NamedTuple):
     """Optimizer state of a quantized ``DistributedOptimizer``.
 
@@ -117,6 +187,104 @@ class QuantizedEFState(NamedTuple):
     residual: Any
 
 
+def _overlap_multi_steps(
+    inner: optax.GradientTransformation,
+    k: int,
+    allreduce_fn,
+    *,
+    quantized: bool,
+):
+    """Double-buffered microbatch accumulation for the replicated path
+    (``overlap=True`` + ``backward_passes_per_step`` k > 1) — see
+    :class:`OverlapMultiStepsState` for the schedule and its contract.
+
+    Branchless like :func:`_zero_multi_steps` (``where``-selected apply,
+    never ``lax.cond``), which also makes it the working
+    ``backward_passes_per_step`` spelling under ``shard_map``'s
+    replication checker on jax 0.4.x, where ``optax.MultiSteps``' cond
+    arms fail rep inference. Meaningful for per-rank local gradients
+    (``hvd.value_and_grad(..., reduce=False)``); already-psummed
+    replicated gradients are detected statically (VMA) and fall back to
+    accumulate-locally + one final reduction — MultiSteps semantics, no
+    extra wire."""
+
+    def init_fn(params):
+        world = basics.size() if basics.is_initialized() else 1
+        rows = jax.tree.map(
+            lambda p: jnp.zeros((world,) + jnp.shape(p),
+                                jnp.asarray(p).dtype), params)
+        return OverlapMultiStepsState(
+            mini_step=jnp.zeros((), jnp.int32),
+            inner=inner.init(params),
+            acc=jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params),
+            pending=rows,
+            residual=(jax.tree.map(jnp.zeros_like, rows)
+                      if quantized else None),
+        )
+
+    def update_fn(grads, state, params=None, **extra):
+        t = state.mini_step
+        is_last = t == (k - 1)
+        axes_t = C._hvd_axes_in_trace()
+        gleaves = jax.tree.leaves(grads)
+        presummed = bool(axes_t) and all(
+            C._is_replicated(l, axes_t) for l in gleaves)
+        res = None if state.residual is None else _lead_read(state.residual)
+        if presummed:
+            # Auto-psummed replicated gradients: already reduced, nothing
+            # to hide — accumulate locally, reduce the mean once (the
+            # reduction short-circuits per-leaf on invariant values).
+            acc = jax.tree.map(lambda a, g: a + g.astype(a.dtype),
+                               state.acc, grads)
+            mean = jax.tree.map(
+                lambda a, g: (a / k).astype(jnp.asarray(g).dtype),
+                acc, grads)
+            if res is not None:
+                red, new_res = allreduce_fn(mean, res)
+            else:
+                red, new_res = allreduce_fn(mean), None
+            pend_next = jax.tree.map(jnp.zeros_like, grads)
+        else:
+            # Double buffer: reduce microbatch t-1 (pending) now — no
+            # data dependence on this call's backward — folding the last
+            # microbatch into the final call's payload (linear wire).
+            pend = _lead_read(state.pending)
+            payload = jax.tree.map(
+                lambda p_, g_: jnp.where(is_last, p_ + g_, p_), pend, grads)
+            if res is not None:
+                rpay, new_res = allreduce_fn(payload, res)
+            else:
+                rpay, new_res = allreduce_fn(payload), None
+            acc = jax.tree.map(lambda a, r: a + r.astype(a.dtype),
+                               state.acc, rpay)
+            mean = jax.tree.map(
+                lambda a, g_: (a / k).astype(jnp.asarray(g_).dtype),
+                acc, grads)
+            red = mean
+            pend_next = jax.tree.map(
+                lambda g_: jnp.where(is_last, jnp.zeros_like(g_), g_),
+                grads)
+        upd, inner_new = inner.update(red, state.inner, params, **extra)
+        updates = jax.tree.map(
+            lambda u: jnp.where(is_last, u, jnp.zeros_like(u)), upd)
+        inner_next = jax.tree.map(
+            lambda old, new: jnp.where(is_last, new, old),
+            state.inner, inner_new)
+        acc_next = jax.tree.map(
+            lambda a: jnp.where(is_last, jnp.zeros_like(a), a), acc)
+        return updates, OverlapMultiStepsState(
+            mini_step=(t + 1) % k,
+            inner=inner_next,
+            acc=acc_next,
+            pending=_lead_write(state.pending, pend_next),
+            residual=(None if state.residual is None
+                      else _lead_write(state.residual, new_res)),
+        )
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
 def DistributedOptimizer(
     optimizer: optax.GradientTransformation,
     *,
@@ -128,6 +296,8 @@ def DistributedOptimizer(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     zero: Optional[bool] = None,
+    overlap: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
     axes=None,
     tuned_params=None,
 ) -> optax.GradientTransformation:
@@ -166,13 +336,27 @@ def DistributedOptimizer(
     already-psummed replicated gradients still shard the update math and
     the moments, just without the wire savings. See docs/zero.md.
 
+    ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) streams the fused
+    gradient buckets into collectives while backward compute still runs
+    (docs/overlap.md): buckets issue in reverse-layer order through the
+    per-bucket stream entry points in flights of ``num_comm_streams``
+    (pow2 1–4), and with ``backward_passes_per_step`` k > 1 the
+    accumulation loop double-buffers so microbatch t's backward and
+    microbatch t−1's bucket reduction are dependence-free in the same
+    program region (state becomes an :class:`OverlapMultiStepsState`; on
+    the ZeRO path the shard accumulator double-buffers the packed
+    buckets instead). With k == 1 overlap changes only collective issue
+    order, so it is bit-identical to off; ``hvd.init`` arms the XLA
+    async-collective/latency-hiding flags on TPU (graceful no-op
+    elsewhere).
+
     ``tuned_params`` (an ``autotune.TunedParams``, e.g. the winner of
     :func:`horovod_tpu.autotune_session`) overrides the fusion threshold,
-    hierarchical flag, int8 scale-block, and ZeRO flag for this
-    optimizer's gradient reduction wherever the explicit kwargs above
-    were left unset — rebuilding the optimizer with a new override is
-    exactly what one autotune trial does (the step retraces with the new
-    bucket plan).
+    hierarchical flag, int8 scale-block, ZeRO flag, and the
+    ``overlap``/``num_comm_streams`` pair for this optimizer's gradient
+    reduction wherever the explicit kwargs above were left unset —
+    rebuilding the optimizer with a new override is exactly what one
+    autotune trial does (the step retraces with the new bucket plan).
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -188,7 +372,10 @@ def DistributedOptimizer(
             hierarchical = tuned_params.hierarchical_allreduce
         if zero is None:
             zero = tuned_params.zero_sharding
-        quant_block = tuned_params.quant_block
+        if overlap is None:
+            overlap = tuned_params.overlap
+        if num_comm_streams is None:
+            num_comm_streams = tuned_params.num_comm_streams
     if quantized is None:
         quantized = (basics.config().quantized_allreduce
                      if basics.is_initialized()
@@ -197,6 +384,13 @@ def DistributedOptimizer(
         zero = (basics.config().zero_sharding
                 if basics.is_initialized()
                 else _env_bool("HOROVOD_ZERO_SHARDING", False))
+    if overlap is None:
+        overlap = (basics.config().overlap if basics.is_initialized()
+                   else _env_bool("HOROVOD_OVERLAP", False))
+    if num_comm_streams is None:
+        num_comm_streams = (basics.config().num_comm_streams
+                            if basics.is_initialized() else 1)
+    num_comm_streams = max(1, int(num_comm_streams))
     if zero:
         if op not in (C.ReduceOp.AVERAGE, C.ReduceOp.SUM):
             raise ValueError(
@@ -211,6 +405,8 @@ def DistributedOptimizer(
             fusion_threshold_bytes=fusion_threshold_bytes,
             quantized=quantized,
             quant_block=quant_block,
+            overlap=bool(overlap),
+            num_comm_streams=num_comm_streams,
             axes=axes,
         )
 
@@ -245,20 +441,19 @@ def DistributedOptimizer(
             quantized=quantized,
             error_feedback=error_feedback,
             block=quant_block,
+            overlap=overlap,
+            num_comm_streams=num_comm_streams,
         )
 
-    def _res_read(residual):
-        """Strip the per-rank leading axis: in-trace each rank's shard is
-        its ``[1, ...]`` slice; eagerly row ``rank()`` of the full stack."""
-        r = 0 if C._hvd_axes_in_trace() else (
-            basics.rank() if basics.is_initialized() else 0)
-        return jax.tree.map(lambda a: a[r], residual)
+    if overlap and backward_passes_per_step > 1:
+        # Mechanism 1 (docs/overlap.md): the double-buffered microbatch
+        # accumulator owns the reduction (and, when quantized, the EF
+        # residual) so microbatch t's backward and microbatch t-1's
+        # bucket reduction share a program region dependence-free.
+        return _overlap_multi_steps(optimizer, backward_passes_per_step,
+                                    _allreduce, quantized=quantized)
 
-    def _res_write(residual, new_local):
-        if C._hvd_axes_in_trace():
-            return jax.tree.map(lambda a: a[None], new_local)
-        r = basics.rank() if basics.is_initialized() else 0
-        return jax.tree.map(lambda a, v: a.at[r].set(v), residual, new_local)
+    _res_read, _res_write = _lead_read, _lead_write
 
     def init_fn(params):
         inner = optimizer.init(params)
@@ -350,6 +545,26 @@ class ZeroMultiStepsState(NamedTuple):
     acc_grads: Any
 
 
+class ZeroOverlapMultiStepsState(NamedTuple):
+    """Shard-level double-buffered accumulation state (``zero=True`` +
+    ``overlap=True`` + ``backward_passes_per_step`` k > 1).
+
+    Like :class:`ZeroMultiStepsState` the accumulator (``acc_shards``)
+    holds scattered ``1/world`` shards, but the reduce-scatter is
+    double-buffered: ``pending`` carries the previous microbatch's packed
+    raw bucket buffers (leading per-rank axis, the residual convention),
+    so call *t* reduce-scatters microbatch *t−1*'s buckets dependence-free
+    alongside microbatch *t*'s backward, and the final call folds the
+    last two microbatches into one reduction (linear wire — the
+    accumulated shard sum is unchanged). Same k collectives per cycle as
+    the non-overlapped ZeRO accumulator, shifted one call late."""
+
+    mini_step: Any  # int32 scalar, 0..k-1
+    inner: Any
+    acc_shards: Any  # per bucket, fp32, flat-bucket (shard) convention
+    pending: Any     # per bucket, [lead, padded], leading per-rank axis
+
+
 def _zero_multi_steps(inner: optax.GradientTransformation, k: int):
     """Branchless ``optax.MultiSteps`` equivalent for the shard level.
 
@@ -406,17 +621,29 @@ def _build_zero_transform(
     quantized: bool,
     quant_block: Optional[int],
     axes,
+    overlap: bool = False,
+    num_comm_streams: int = 1,
 ) -> optax.GradientTransformation:
     """The ZeRO-1 optax wrapper: reduce-scatter → shard update →
     all-gather, with the wrapped transformation living entirely on this
-    rank's flat bucket shards."""
+    rank's flat bucket shards.
+
+    ``overlap`` issues the per-bucket reduce-scatter/all-gather through
+    the reverse-layer stream schedule in flights of ``num_comm_streams``
+    (docs/overlap.md); with ``backward_passes_per_step`` k > 1 it also
+    double-buffers the accumulation loop (:class:`ZeroOverlapMultiSteps
+    State`) so each call's reduce-scatter covers the PREVIOUS microbatch
+    and runs dependence-free next to the current backward."""
     # backward_passes_per_step accumulates INSIDE the shard, so the
     # accumulator is a [padded // world] leaf, not a full gradient
     # replica. (The replicated path wraps MultiSteps OUTSIDE and
     # accumulates full pre-reduce gradients; here the reduce-scatter runs
     # every microbatch and the accumulation is post-reduce, shard-local.)
-    stx = (_zero_multi_steps(optimizer, backward_passes_per_step)
-           if backward_passes_per_step > 1 else optimizer)
+    k = backward_passes_per_step
+    db = overlap and k > 1  # double-buffered accumulation
+    stx = (_zero_multi_steps(optimizer, k)
+           if k > 1 and not db else optimizer)
+    num_comm_streams = max(1, int(num_comm_streams))
 
     if gradient_predivide_factor != 1.0:
         prescale = 1.0 / gradient_predivide_factor
@@ -469,6 +696,16 @@ def _build_zero_transform(
         plan = _plan(leaves, plan_world)
         shards = _shard_params(plan, leaves, own_world, in_trace)
         inner = stx.init(shards)
+        if db:
+            lead = 1 if in_trace else max(1, plan_world)
+            inner = ZeroOverlapMultiStepsState(
+                mini_step=jnp.zeros((), jnp.int32),
+                inner=inner,
+                acc_shards=tuple(
+                    jnp.zeros(jnp.shape(s), jnp.float32) for s in shards),
+                pending=tuple(
+                    jnp.zeros((lead, b.padded_size), b.dtype)
+                    for b in plan))
         if not quantized:
             return ZeroState(inner=inner, residual=None,
                              gather_residual=None)
@@ -519,73 +756,146 @@ def _build_zero_transform(
         eager_local = (not in_trace) and own_world == 1
 
         use_quant = quantized
-        gshards: List[Any] = []
-        new_rs: List[Any] = []
-        for i, b in enumerate(plan):
-            buf = fusion.pack(b, gleaves)
-            is_float = jnp.issubdtype(b.dtype, jnp.floating)
-            wire, ctx = compression.compress(buf)
-            if eager_local:
-                shard = C._scale(C._scale(wire, prescale), postscale)
-                new_rs.append(None if state.residual is None
-                              else state.residual[i])
-                gshards.append(compression.decompress(shard, ctx))
-                continue
-            res = (None if not (use_quant and is_float and state.residual)
-                   else _res_read(state.residual[i], in_trace))
-            if res is not None:
-                shard, nres = C.reduce_scatter(
-                    wire, res, op=reduce_op, prescale_factor=prescale,
-                    postscale_factor=postscale, quantized=True,
-                    block=quant_block, _presummed=True)
-                new_rs.append(_res_write(state.residual[i], nres, in_trace))
-            else:
-                shard = C.reduce_scatter(
-                    wire, op=reduce_op, prescale_factor=prescale,
-                    postscale_factor=postscale,
-                    quantized=use_quant and is_float,
-                    block=quant_block, _presummed=True)
-                new_rs.append(None if state.residual is None
-                              else state.residual[i])
-            gshards.append(compression.decompress(shard, ctx))
+        order = (fusion.stream_order(plan) if overlap
+                 else tuple(range(len(plan))))
+        flight = num_comm_streams if overlap else 1
+
+        ms = state.inner if db else None
+        if db:
+            t = ms.mini_step
+            is_last = t == (k - 1)
+        new_pending: List[Any] = [None] * len(plan)
+
+        gshards: List[Any] = [None] * len(plan)
+        new_rs: List[Any] = [None] * len(plan)
+        for s in range(0, len(order), flight):
+            issued = []
+            for i in order[s:s + flight]:
+                b = plan[i]
+                buf = fusion.pack(b, gleaves)
+                if db:
+                    # Double buffer: this call's wire carries the PREVIOUS
+                    # microbatch's packed buckets (no dependence on this
+                    # call's backward); the final call folds the last
+                    # microbatch in (the wire is linear).
+                    pend = _res_read(ms.pending[i], in_trace)
+                    new_pending[i] = _res_write(
+                        ms.pending[i],
+                        jnp.where(is_last, jnp.zeros_like(buf), buf),
+                        in_trace)
+                    buf = jnp.where(is_last, pend + buf, pend)
+                is_float = jnp.issubdtype(b.dtype, jnp.floating)
+                wire, ctx = compression.compress(buf)
+                if eager_local:
+                    shard = C._scale(C._scale(wire, prescale), postscale)
+                    new_rs[i] = (None if state.residual is None
+                                 else state.residual[i])
+                    gshards[i] = compression.decompress(shard, ctx)
+                    continue
+                res = (None
+                       if not (use_quant and is_float and state.residual)
+                       else _res_read(state.residual[i], in_trace))
+                rs_kw = dict(op=reduce_op, prescale_factor=prescale,
+                             postscale_factor=postscale,
+                             block=quant_block, _presummed=True)
+                if res is not None:
+                    if overlap:
+                        shard, nres = C.reduce_scatter_stream(
+                            wire, res, bucket_id=i, quantized=True, **rs_kw)
+                    else:
+                        shard, nres = C.reduce_scatter(
+                            wire, res, quantized=True, **rs_kw)
+                    new_rs[i] = _res_write(state.residual[i], nres,
+                                           in_trace)
+                else:
+                    if overlap:
+                        shard = C.reduce_scatter_stream(
+                            wire, bucket_id=i,
+                            quantized=use_quant and is_float, **rs_kw)
+                    else:
+                        shard = C.reduce_scatter(
+                            wire, quantized=use_quant and is_float, **rs_kw)
+                    new_rs[i] = (None if state.residual is None
+                                 else state.residual[i])
+                issued.append((i, shard, ctx))
+            # Decompress after the whole flight is issued: no consumer
+            # between in-flight scatters (flight == 1 == the serial
+            # schedule exactly).
+            for i, shard, ctx in issued:
+                gshards[i] = compression.decompress(shard, ctx)
 
         pshards = None
         if params is not None:
             pleaves, _ = jax.tree.flatten(params)
             pshards = _shard_params(plan, pleaves, own_world, in_trace)
 
-        ushards, new_inner = stx.update(tuple(gshards), state.inner,
-                                        pshards, **extra)
+        if db:
+            acc = tuple(a + g.astype(a.dtype)
+                        for a, g in zip(ms.acc_shards, gshards))
+            mean = tuple((a / k).astype(jnp.asarray(g).dtype)
+                         for a, g in zip(acc, gshards))
+            upd, inner_new = optimizer.update(mean, ms.inner, pshards,
+                                              **extra)
+            ushards = tuple(
+                jnp.where(is_last, u, jnp.zeros_like(u)) for u in upd)
+            inner_next = jax.tree.map(
+                lambda old, new: jnp.where(is_last, new, old),
+                ms.inner, inner_new)
+            acc_next = tuple(
+                jnp.where(is_last, jnp.zeros_like(a), a) for a in acc)
+            new_inner = ZeroOverlapMultiStepsState(
+                mini_step=(t + 1) % k, inner=inner_next,
+                acc_shards=acc_next, pending=tuple(new_pending))
+        else:
+            ushards, new_inner = stx.update(tuple(gshards), state.inner,
+                                            pshards, **extra)
 
         uleaves: List[Any] = [None] * len(gleaves)
-        new_ag: List[Any] = []
-        for i, b in enumerate(plan):
-            is_float = jnp.issubdtype(b.dtype, jnp.floating)
-            if eager_local:
-                full = ushards[i]
-                new_ag.append(None if state.gather_residual is None
-                              else state.gather_residual[i])
-                for j, leaf in zip(b.leaf_indices,
-                                   fusion.unpack(b, full)):
+        new_ag: List[Any] = [None] * len(plan)
+        for s in range(0, len(order), flight):
+            issued = []
+            for i in order[s:s + flight]:
+                b = plan[i]
+                is_float = jnp.issubdtype(b.dtype, jnp.floating)
+                if eager_local:
+                    new_ag[i] = (None if state.gather_residual is None
+                                 else state.gather_residual[i])
+                    issued.append((i, ushards[i], None))
+                    continue
+                wire, ctx = compression.compress(ushards[i])
+                res = (None
+                       if not (use_quant and is_float
+                               and state.gather_residual)
+                       else _res_read(state.gather_residual[i], in_trace))
+                if res is not None:
+                    if overlap:
+                        full, nres = C.all_gather_stream(
+                            wire, res, bucket_id=i, quantized=True,
+                            block=quant_block)
+                    else:
+                        full, nres = C.all_gather(
+                            wire, res, quantized=True, block=quant_block)
+                    new_ag[i] = _res_write(state.gather_residual[i], nres,
+                                           in_trace)
+                else:
+                    if overlap:
+                        full = C.all_gather_stream(
+                            wire, bucket_id=i,
+                            quantized=use_quant and is_float,
+                            block=quant_block)
+                    else:
+                        full = C.all_gather(
+                            wire, quantized=use_quant and is_float,
+                            block=quant_block)
+                    new_ag[i] = (None if state.gather_residual is None
+                                 else state.gather_residual[i])
+                issued.append((i, full, ctx))
+            for i, full, ctx in issued:
+                if ctx is not None or not eager_local:
+                    full = compression.decompress(full, ctx)
+                for j, leaf in zip(plan[i].leaf_indices,
+                                   fusion.unpack(plan[i], full)):
                     uleaves[j] = leaf
-                continue
-            wire, ctx = compression.compress(ushards[i])
-            res = (None
-                   if not (use_quant and is_float and state.gather_residual)
-                   else _res_read(state.gather_residual[i], in_trace))
-            if res is not None:
-                full, nres = C.all_gather(
-                    wire, res, quantized=True, block=quant_block)
-                new_ag.append(_res_write(state.gather_residual[i], nres,
-                                         in_trace))
-            else:
-                full = C.all_gather(wire, quantized=use_quant and is_float,
-                                    block=quant_block)
-                new_ag.append(None if state.gather_residual is None
-                              else state.gather_residual[i])
-            full = compression.decompress(full, ctx)
-            for j, leaf in zip(b.leaf_indices, fusion.unpack(b, full)):
-                uleaves[j] = leaf
 
         new_state = ZeroState(
             inner=new_inner,

@@ -48,6 +48,8 @@ def allreduce_gradients(
     quantized: Optional[bool] = None,
     error_feedback=None,
     tuned_params=None,
+    overlap: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
 ):
     """Allreduce a gradient pytree (reference: _make_allreduce_grads_fn,
     tensorflow/__init__.py:246-278). Fused into per-dtype buckets;
@@ -60,13 +62,18 @@ def allreduce_gradients(
     ``(reduced, new_error_feedback)`` so callers can thread EF state
     functionally — :class:`horovod_tpu.DistributedOptimizer` does this
     inside its optax state instead. ``tuned_params`` applies an autotuner
-    override (see :func:`~horovod_tpu.ops.fusion.allreduce_pytree`)."""
+    override (see :func:`~horovod_tpu.ops.fusion.allreduce_pytree`).
+    ``overlap`` (default ``HOROVOD_OVERLAP``) issues the buckets through
+    the reverse-layer stream schedule in flights of ``num_comm_streams``
+    — bit-identical values, overlap-friendly issue order
+    (docs/overlap.md)."""
     return fusion.allreduce_pytree(
         grads, op=op, compression=compression,
         threshold_bytes=fusion_threshold_bytes, axes=axes,
         hierarchical=hierarchical, presummed=True,
         quantized=quantized, error_feedback=error_feedback,
-        tuned_params=tuned_params)
+        tuned_params=tuned_params, overlap=overlap,
+        num_comm_streams=num_comm_streams)
 
 
 def value_and_grad(
@@ -81,6 +88,8 @@ def value_and_grad(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     zero: Optional[bool] = None,
+    overlap: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
     tuned_params=None,
     reduce: bool = True,
     **jax_kwargs,
@@ -131,7 +140,8 @@ def value_and_grad(
             grads, op=op, compression=compression,
             fusion_threshold_bytes=fusion_threshold_bytes, axes=axes,
             hierarchical=hierarchical, quantized=quantized,
-            tuned_params=tuned_params)
+            tuned_params=tuned_params, overlap=overlap,
+            num_comm_streams=num_comm_streams)
         return val, grads
 
     return wrapped

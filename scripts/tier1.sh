@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the EXACT command from ROADMAP.md ("Tier-1 verify:"),
+# wrapped so builders and the re-anchor reviewer run the identical check
+# (same pipefail discipline, same DOTS_PASSED echo, same exit code).
+#
+# Usage: scripts/tier1.sh            (from the repo root)
+# Log:   /tmp/_t1.log
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

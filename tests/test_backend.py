@@ -82,3 +82,44 @@ class TestDiagnose:
     def test_pid_alive(self):
         assert backend._pid_alive(os.getpid())
         assert not backend._pid_alive(4194399)
+
+
+class TestOverlapScheduling:
+    """enable_overlap_scheduling (docs/overlap.md): TPU-only flag arming
+    with a graceful no-op fallback everywhere else."""
+
+    def test_cpu_platform_is_noop(self, monkeypatch, capsys):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        assert backend.enable_overlap_scheduling("cpu") is False
+        assert os.environ["XLA_FLAGS"] == ""  # untouched
+        assert "latency hiding" in capsys.readouterr().err
+
+    def test_tpu_platform_arms_flags_before_backend(self, monkeypatch):
+        monkeypatch.setenv("XLA_FLAGS", "--existing=1")
+        # Pretend no backend exists yet so the flags can apply.
+        monkeypatch.setattr(backend, "_backend_already_created",
+                            lambda: False)
+        assert backend.enable_overlap_scheduling("tpu") is True
+        flags = os.environ["XLA_FLAGS"]
+        assert "--existing=1" in flags
+        for f in backend._OVERLAP_XLA_FLAGS:
+            assert f in flags
+        # Idempotent: a second call adds nothing.
+        before = os.environ["XLA_FLAGS"]
+        assert backend.enable_overlap_scheduling("tpu") is True
+        assert os.environ["XLA_FLAGS"] == before
+
+    def test_tpu_after_backend_created_refuses(self, monkeypatch, capsys):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        monkeypatch.setattr(backend, "_backend_already_created",
+                            lambda: True)
+        assert backend.enable_overlap_scheduling("tpu") is False
+        assert "already initialized" in capsys.readouterr().err
+
+    def test_auto_without_tpu_device_files_falls_back(self, monkeypatch,
+                                                      capsys):
+        monkeypatch.setenv("XLA_FLAGS", "")
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        monkeypatch.setattr(glob_mod, "glob", lambda p: [])
+        assert backend.enable_overlap_scheduling("auto") is False
+        assert os.environ["XLA_FLAGS"] == ""

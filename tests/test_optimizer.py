@@ -13,6 +13,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
+from jax0437_repros import _old_jax
 
 N = 8
 
@@ -124,6 +125,15 @@ def test_predivide_requires_average():
                                  gradient_predivide_factor=2.0)
 
 
+@pytest.mark.xfail(
+    _old_jax(), strict=False,
+    reason="upstream jax 0.4.37: optax.MultiSteps selects its accumulate/"
+           "apply arms with lax.cond, whose mixed-replication branches "
+           "fail old shard_map's rep checker — pure-jax repro: "
+           "tests/jax0437_repros.py::repro_cond_rep_mismatch (fixed by "
+           "the jax.shard_map graduation, jax >= 0.6; overlap=True uses "
+           "the branchless _overlap_multi_steps accumulator, which "
+           "traces fine — see test_overlap.py)")
 def test_backward_passes_per_step_accumulates():
     # k accumulation steps at lr then one apply ≈ one step on the averaged
     # grads (reference: torch/optimizer.py:133-149). With SGD the result
