@@ -615,6 +615,181 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     }
 
 
+def run_serve(args, devices, platform, mesh_shape):
+    """The ``--serve`` leg: a continuous-batching generation trace.
+
+    Opens the inference scenario family (docs/serving.md) on the same
+    stack the training legs measure: a :class:`ReplicaSet` partitions the
+    visible chips into tensor-parallel replica groups, a Poisson arrival
+    trace feeds the shared queue, and mid-trace the set resizes (scale
+    down, then back up) with in-flight requests drained into the queue —
+    the acceptance bar is zero dropped requests. Emits ONE JSON line with
+    tokens/sec (all prefill+decode work), goodput (generated tokens of
+    COMPLETED requests per second — replayed work does not count), and
+    p50/p99 request latency, plus a decode-vs-full-context logits parity
+    probe so the number is backed by a correctness check."""
+    import jax
+    import numpy as np
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import GPT, gpt_tiny
+    from horovod_tpu.serve import (PageConfig, PoissonTrace, ReplicaSet,
+                                   kv_cache as kvlib)
+
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=mesh_shape)
+    n_chips = hvd.size()
+
+    # Serve-scale model: gpt_tiny with 8 heads so every even partition of
+    # an 8-chip mesh gives a valid tp degree; fp32 on CPU meshes (bf16
+    # emulation is slow there), bf16 on real accelerators.
+    dtype = jnp.float32 if platform == "cpu" else jnp.bfloat16
+    cfg = gpt_tiny(num_heads=8, dtype=dtype)
+    params = GPT(cfg).init(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32))["params"]
+
+    page_size = args.serve_page_size
+    max_slots = args.serve_max_slots
+    p_lo, p_hi = args.serve_prompt_len
+    n_lo, n_hi = args.serve_max_new
+    pages_per_slot = -(-(p_hi + n_hi + 1) // page_size)
+    # Pool sized for ~75% occupancy at full slots: admission pressure is
+    # real (the scheduler's page-availability policy actually gates) but
+    # a lone big request can always run.
+    num_pages = 1 + max(pages_per_slot,
+                        int(0.75 * max_slots * pages_per_slot))
+    pc = PageConfig(num_pages=num_pages, page_size=page_size,
+                    max_slots=max_slots, pages_per_slot=pages_per_slot,
+                    num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                    head_dim=cfg.d_model // cfg.num_heads)
+
+    # Parity probe: one prompt decoded token-by-token through the cache
+    # must reproduce the full-context logits (docs/serving.md "page
+    # math"; tests/test_serve.py holds the tight tier-1 version).
+    rs_np = np.random.RandomState(7)
+    probe = rs_np.randint(2, cfg.vocab_size, size=12)
+    pcache = kvlib.init_cache(pc)
+    alloc = kvlib.PageAllocator(pc.num_pages)
+    pages = alloc.alloc("probe", pc.pages_for(len(probe)))
+    table = np.array(pcache.page_table)
+    table[0, :len(pages)] = pages
+    pcache = pcache._replace(page_table=jnp.asarray(table))
+    pstep = jax.jit(lambda tok, c: GPT(cfg).apply(
+        {"params": params}, tok, cache=c,
+        active=jnp.asarray([True] + [False] * (max_slots - 1))))
+    rows = []
+    for t in probe:
+        tok = jnp.asarray([int(t)] + [0] * (max_slots - 1))
+        logits, pcache = pstep(tok, pcache)
+        rows.append(np.asarray(logits[0], np.float32))
+    full = np.asarray(GPT(cfg).apply(
+        {"params": params}, jnp.asarray(probe)[None])[0], np.float32)
+    parity_err = float(np.max(np.abs(np.stack(rows) - full)))
+    tol = 2e-4 if dtype == jnp.float32 else 5e-2
+    log(f"decode-vs-full parity: max |Δlogit| {parity_err:.2e} "
+        f"(tol {tol:g})")
+    if parity_err > tol:
+        raise SystemExit(f"decode/full-context parity FAILED: "
+                         f"{parity_err} > {tol}")
+
+    n_replicas = args.serve_replicas
+    if n_chips % max(1, n_replicas):
+        raise SystemExit(f"--serve-replicas {n_replicas} does not "
+                         f"partition {n_chips} chips")
+    trace = PoissonTrace(rate=args.serve_rate,
+                         num_requests=args.serve_requests,
+                         seed=12345, prompt_len=(p_lo, p_hi),
+                         max_new_tokens=(n_lo, n_hi),
+                         vocab_size=cfg.vocab_size, eos_id=1)
+    rset = ReplicaSet(cfg, params, pc, devices=devices,
+                      n_replicas=n_replicas, eos_id=1)
+    for req in trace:
+        rset.submit(req)
+
+    # Manual trace loop so the elastic resize triggers on PROGRESS (a
+    # third / two-thirds of the trace complete), not a step count that
+    # depends on machine speed.
+    import time as _time
+
+    total = len(trace)
+    resize_down_at = max(1, total // 3)
+    resize_up_at = max(2, (2 * total) // 3)
+    did_down = did_up = False
+    t0 = _time.monotonic()
+    steps = 0
+    while rset.has_work:
+        now = _time.monotonic() - t0
+        done = (len(rset.stats.completed)
+                + sum(len(e.stats.completed) for e in rset.engines))
+        if args.serve_resize and not did_down and done >= resize_down_at \
+                and n_replicas > 1:
+            rset.resize(max(1, n_replicas // 2), now)
+            did_down = True
+            log(f"resize: {n_replicas} -> {max(1, n_replicas // 2)} "
+                f"replicas at {done}/{total} complete "
+                f"({rset.resize_events[-1]['in_flight']} in-flight "
+                f"migrated)")
+        if args.serve_resize and did_down and not did_up \
+                and done >= resize_up_at and n_replicas > 1:
+            rset.resize(n_replicas, now)
+            did_up = True
+            log(f"resize: back to {n_replicas} replicas at "
+                f"{done}/{total} complete")
+        if rset.step_all(now) == 0:
+            _time.sleep(1e-3)
+        steps += 1
+        if steps > 200_000:
+            raise SystemExit("serve trace did not drain")
+    wall = _time.monotonic() - t0
+    stats = rset.stats
+    for eng in rset.engines:
+        stats.merge(eng.stats)
+    stats.wall_time = wall
+
+    completed = len(stats.completed)
+    dropped = total - completed
+    lat = stats.latency_percentiles()
+    log(f"serve: {completed}/{total} requests in {wall:.2f}s | "
+        f"{stats.tokens_per_sec():.1f} tok/s processed, "
+        f"goodput {stats.goodput_tokens_per_sec():.1f} tok/s | "
+        f"p50 {lat['p50'] * 1e3:.0f} ms p99 {lat['p99'] * 1e3:.0f} ms | "
+        f"{stats.preemptions} preemptions, "
+        f"{len(rset.resize_events)} resizes")
+    if dropped:
+        raise SystemExit(f"serve trace DROPPED {dropped} requests")
+    print(json.dumps({
+        "metric": "gpt_serve_goodput_tokens_per_sec",
+        "value": round(stats.goodput_tokens_per_sec(), 2),
+        "unit": "tokens/sec",
+        "vs_baseline": None,
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "chips": n_chips,
+        "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                       if mesh_shape else None),
+        "tokens_per_sec": round(stats.tokens_per_sec(), 2),
+        "goodput_tokens_per_sec": round(stats.goodput_tokens_per_sec(), 2),
+        "latency_p50_ms": round(lat["p50"] * 1e3, 2),
+        "latency_p99_ms": round(lat["p99"] * 1e3, 2),
+        "requests": total,
+        "requests_completed": completed,
+        "requests_dropped": dropped,
+        "arrival_rate_per_sec": args.serve_rate,
+        "replicas": n_replicas,
+        "resize_events": rset.resize_events,
+        "engine_steps": stats.steps,
+        "prefill_tokens": stats.prefill_tokens,
+        "decode_tokens": stats.decode_tokens,
+        "preemptions": stats.preemptions,
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "max_slots": max_slots,
+        "decode_parity_max_err": parity_err,
+    }), flush=True)
+
+
 def run_autotune_session(args, devices, platform, mesh_shape):
     """Run the online Bayesian tuning session on the real bench workload
     (``hvd.autotune_session``; each trial recompiles the step with a
@@ -775,6 +950,32 @@ def main():
                          "frozen winner against the default knobs; the "
                          "JSON line carries tuned_params + the trial "
                          "history")
+    ap.add_argument("--serve", action="store_true",
+                    help="continuous-batching generation trace "
+                         "(docs/serving.md): Poisson arrivals into "
+                         "tensor-parallel replica groups with a paged "
+                         "KV cache, one elastic resize down and back up "
+                         "mid-trace; reports tokens/sec, goodput and "
+                         "p50/p99 latency plus a decode-vs-full-context "
+                         "logits parity probe")
+    ap.add_argument("--serve-rate", type=float, default=20.0,
+                    help="Poisson arrival rate, requests/sec")
+    ap.add_argument("--serve-requests", type=int, default=40,
+                    help="trace length in requests")
+    ap.add_argument("--serve-replicas", type=int, default=2,
+                    help="replica groups at trace start (must partition "
+                         "the chip count; tp degree = chips/replicas)")
+    ap.add_argument("--serve-prompt-len", default="4,16",
+                    metavar="LO,HI", help="prompt length range")
+    ap.add_argument("--serve-max-new", default="4,16",
+                    metavar="LO,HI", help="generation budget range")
+    ap.add_argument("--serve-page-size", type=int, default=4,
+                    help="KV-cache page size in tokens")
+    ap.add_argument("--serve-max-slots", type=int, default=8,
+                    help="concurrent sequences per replica")
+    ap.add_argument("--serve-resize", type=int, default=1,
+                    help="1 (default) = one elastic resize down and back "
+                         "up mid-trace; 0 = fixed replica count")
     ap.add_argument("--mesh-shape", default=None, metavar="CROSSxLOCAL",
                     help="emulate a multi-host (cross, local) topology, "
                          "e.g. 2x4 — gives the collectives a real DCN "
@@ -808,6 +1009,25 @@ def main():
     if args.profile and args.num_iters < 2:
         ap.error("--profile needs --num-iters >= 2 (the profiled iter is "
                  "excluded from the reported stats)")
+
+    if args.serve:
+        if args.scaling or args.quantized or args.zero or args.overlap \
+                or args.autotune or args.profile:
+            ap.error("--serve cannot combine with --scaling/--quantized/"
+                     "--zero/--overlap/--autotune/--profile (the serve "
+                     "leg has its own trace structure)")
+        for flag in ("serve_prompt_len", "serve_max_new"):
+            try:
+                lo, hi = (int(v) for v in getattr(args, flag).split(","))
+            except ValueError:
+                ap.error(f"--{flag.replace('_', '-')} expects LO,HI ints")
+            if lo < 1 or hi < lo:
+                ap.error(f"--{flag.replace('_', '-')}: need 1 <= LO <= HI")
+            setattr(args, flag, (lo, hi))
+        if args.serve_rate <= 0:
+            ap.error("--serve-rate must be > 0")
+        if args.serve_requests < 1 or args.serve_replicas < 1:
+            ap.error("--serve-requests/--serve-replicas must be >= 1")
 
     sweep = None
     if args.scaling:
@@ -885,19 +1105,26 @@ def main():
             len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
                          f"does not cover {len(devices)} devices")
-    if (args.quantized or args.autotune or args.zero or args.overlap) \
+    if (args.quantized or args.autotune or args.zero or args.overlap
+            or args.serve) \
             and mesh_shape is None \
             and len(devices) % 2 == 0 and len(devices) >= 2:
         # A DCN (cross) hop is what quantization compresses, what the
         # hierarchical-allreduce knob decomposes, what splits the ZeRO
         # reduce-scatter into its ICI/DCN legs, and what the overlap
-        # schedule hides under backward; emulate a 2-host topology
-        # unless the user pinned one.
+        # schedule hides under backward (and what a multi-host serve
+        # replica spans); emulate a 2-host topology unless the user
+        # pinned one.
         mesh_shape = (2, len(devices) // 2)
         which = ("quantized" if args.quantized else "zero" if args.zero
-                 else "overlap" if args.overlap else "autotune")
+                 else "overlap" if args.overlap
+                 else "serve" if args.serve else "autotune")
         log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
             f"collectives have a cross (DCN) hop")
+
+    if args.serve:
+        run_serve(args, devices, platform, mesh_shape)
+        return
 
     metric_stem = (f"gpt{args.gpt_scale}" if args.model == "gpt"
                    else args.model)

@@ -88,13 +88,22 @@ class GPTConfig:
     # materializes the [N, vocab] logits. Parameters are identical either
     # way (wte is created for the embedding lookup regardless).
     return_hidden: bool = False
+    # Decode-time KV paging (horovod_tpu/serve/kv_cache.py): when set, the
+    # cache's pages stripe round-robin over this mesh axis — contexts
+    # longer than one host's page pool — and decode attention merges
+    # per-rank flash partials with the ring-attention combine. Must be
+    # disjoint from tp_axis (same constraint as seq_axis: the stripe would
+    # otherwise rotate between ranks holding different heads). Only
+    # affects the cache path (__call__ with cache=); training modes are
+    # governed by ``attention``/``seq_axis`` as before.
+    kv_ring_axis: Optional[str] = None
 
 
 class _Attention(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode=None):
         cfg = self.cfg
         B, T, C = x.shape
         tp = _tp_size(cfg)
@@ -125,6 +134,26 @@ class _Attention(nn.Module):
         q = q.reshape(B, T, H, D)
         k = k.reshape(B, T, H, D)
         v = v.reshape(B, T, H, D)
+        if decode is not None:
+            # Paged single-token decode (serve/kv_cache.py): append this
+            # step's k/v to the layer's page pool, attend over the slot's
+            # cached pages. The TRAINING attention mode (dense/flash/ring)
+            # is irrelevant here — the cache IS the sequence; tp (local
+            # heads + row-parallel proj psum) composes unchanged.
+            from ..serve import kv_cache as kvlib
+
+            cache, meta, layer = decode
+            cache = kvlib.append_layer_kv(cache, layer, k[:, 0], v[:, 0],
+                                          meta)
+            out = kvlib.paged_attention(
+                q, cache.k[layer], cache.v[layer], cache.page_table,
+                meta.attend_len, ring_axis=cfg.kv_ring_axis)
+            out = out.reshape(B, T, H * D)
+            out = nn.Dense(C, dtype=cfg.dtype, name="proj",
+                           kernel_init=nn.initializers.normal(
+                               0.02 / (2 * cfg.num_layers) ** 0.5))(out)
+            out = lax.psum(out, cfg.tp_axis) if tp > 1 else out
+            return out, cache
         if cfg.attention == "ring":
             out = seqpar.ring_attention(q, k, v, axis=cfg.seq_axis,
                                         causal=True)
@@ -207,8 +236,32 @@ class _Block(nn.Module):
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode=None):
         cfg = self.cfg
+        if decode is not None:
+            # Decode path: same params, plain (unfused) pre-norm blocks —
+            # fused_ln targets the [B, T, C] training stream and is
+            # numerically interchangeable (identical eps/params), so a
+            # T=1 decode never pays the Pallas call.
+            cache, meta, layer = decode
+            attn_out, cache = _Attention(cfg, name="attn")(
+                nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x),
+                decode=(cache, meta, layer))
+            x = x + attn_out
+            if cfg.moe_experts:
+                from ..parallel.expert import SwitchMoE
+
+                ffn = SwitchMoE(
+                    num_experts=cfg.moe_experts, d_ff=cfg.d_ff,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    ep_axis=cfg.ep_axis, dtype=cfg.dtype,
+                    ragged=cfg.moe_ragged,
+                    pair_capacity_factor=cfg.moe_pair_capacity_factor,
+                    name="moe")
+            else:
+                ffn = _MLP(cfg, name="mlp")
+            x = x + ffn(nn.LayerNorm(dtype=cfg.dtype, name="ln2")(x))
+            return x, cache
         attn_out = _Attention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln1")(x))
         if not cfg.fused_ln:
@@ -234,13 +287,30 @@ class _Block(nn.Module):
 
 
 class GPT(nn.Module):
-    """Decoder-only LM. Returns logits [B, T_local, vocab]."""
+    """Decoder-only LM. Returns logits [B, T_local, vocab]; with
+    ``cache=`` (a :class:`horovod_tpu.serve.kv_cache.KVCache`), runs one
+    paged decode step instead — see :meth:`__call__`."""
 
     cfg: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, cache=None, active=None):
+        """Training/prefill forward, or — when ``cache`` is given — ONE
+        continuous-batching decode step (serve/engine.py):
+
+        ``tokens [S]`` (or ``[S, 1]``) holds the step's token per batch
+        slot, written at position ``cache.seq_lens[s]`` of every layer's
+        page pool; the returned logits ``[S, vocab]`` predict each slot's
+        NEXT token, with attention over all cached positions including
+        the one just written — so feeding a prompt token-by-token yields
+        logits identical (within dtype tolerance) to the full-context
+        forward at that position. ``active [S]`` bool masks dead slots
+        (their writes hit the null page and their cursor stays put).
+        Returns ``(logits, new_cache)``.
+        """
         cfg = self.cfg
+        if cache is not None:
+            return self._decode_step(tokens, cache, active)
         B, T_local = tokens.shape
         wte = self.param("wte", nn.initializers.normal(cfg.embed_init_std),
                          (cfg.vocab_size, cfg.d_model), jnp.float32)
@@ -275,6 +345,49 @@ class GPT(nn.Module):
         # softmax.
         return jnp.einsum("btc,vc->btv", x, wte.astype(cfg.dtype),
                           preferred_element_type=jnp.float32)
+
+    def _decode_step(self, tokens, cache, active):
+        from ..serve import kv_cache as kvlib
+
+        cfg = self.cfg
+        tp = _tp_size(cfg)
+        if cfg.kv_ring_axis and cfg.tp_axis and tp > 1:
+            ring = ({cfg.kv_ring_axis} if isinstance(cfg.kv_ring_axis, str)
+                    else set(cfg.kv_ring_axis))
+            tps = ({cfg.tp_axis} if isinstance(cfg.tp_axis, str)
+                   else set(cfg.tp_axis))
+            if ring & tps:
+                raise ValueError(
+                    f"kv_ring_axis {cfg.kv_ring_axis!r} overlaps tp_axis "
+                    f"{cfg.tp_axis!r}: the page stripe would rotate "
+                    f"between ranks holding different heads; use "
+                    f"disjoint mesh axes")
+        if tokens.ndim == 2:
+            tokens = tokens[:, 0]
+        S = tokens.shape[0]
+        if active is None:
+            active = jnp.ones((S,), bool)
+        wte = self.param("wte", nn.initializers.normal(cfg.embed_init_std),
+                         (cfg.vocab_size, cfg.d_model), jnp.float32)
+        wpe = self.param("wpe", nn.initializers.normal(cfg.embed_init_std),
+                         (cfg.max_seq_len, cfg.d_model), jnp.float32)
+        # One shared write cursor for every layer; the clip keeps the
+        # embedding gather in-bounds on inactive slots (the engine bounds
+        # live positions by max_seq_len/pages_per_slot at admission).
+        meta = kvlib.step_meta(cache, active,
+                               page_size=int(cache.k.shape[2]),
+                               ring_axis=cfg.kv_ring_axis)
+        pos = jnp.clip(cache.seq_lens, 0, cfg.max_seq_len - 1)
+        x = (wte[tokens] + wpe[pos]).astype(cfg.dtype)[:, None, :]
+        block = _Block
+        if cfg.remat:
+            block = nn.remat(_Block)
+        for i in range(cfg.num_layers):
+            x, cache = block(cfg, name=f"h{i}")(x, decode=(cache, meta, i))
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = jnp.einsum("sc,vc->sv", x[:, 0], wte.astype(cfg.dtype),
+                            preferred_element_type=jnp.float32)
+        return logits, kvlib.advance(cache, meta)
 
 
 def gpt_small(**overrides) -> GPTConfig:

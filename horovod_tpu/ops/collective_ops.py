@@ -43,7 +43,8 @@ from jax import lax
 
 from ..common import basics
 from ..common.basics import CROSS_AXIS, HVD_AXES, LOCAL_AXIS
-from ..common.exceptions import DuplicateTensorNameError
+from ..common.exceptions import (DuplicateTensorNameError,
+                                 NotInitializedError)
 from . import compression as _compression
 from .compression import Compression
 
@@ -92,7 +93,28 @@ def _axis_size(name) -> int:
     except AttributeError:  # jax < 0.6
         from jax._src.core import get_axis_env
 
-        return get_axis_env().axis_sizes[name]
+        try:
+            return get_axis_env().axis_sizes[name]
+        except KeyError:
+            raise _unbound_axis_error(name) from None
+    except NameError:
+        raise _unbound_axis_error(name) from None
+
+
+def _unbound_axis_error(name) -> Exception:
+    """A collective asked for a mesh axis that is not bound in the current
+    trace. Uninitialized backend → the reference-style "call hvd.init()
+    first" error instead of the raw KeyError/NameError; initialized →
+    explain the shard_map requirement."""
+    if not basics.is_initialized():
+        return NotInitializedError(
+            f"Horovod-TPU (required by a collective over mesh axis "
+            f"{name!r})")
+    return ValueError(
+        f"mesh axis {name!r} is not bound in the current trace: compiled "
+        f"collectives must run inside hvd.shard_map over the Horovod "
+        f"mesh (hvd.mesh()); omit axes= in eager host code to use the "
+        f"process-world path")
 
 
 def _world_size(axes: Tuple[str, ...]):

@@ -1,0 +1,33 @@
+"""Continuous-batching generation engine over the Horovod mesh.
+
+The inference scenario family of the north star ("heavy traffic from
+millions of users"), built on the training stack's primitives:
+
+* :mod:`.kv_cache` — paged, TP-head-sharded (and optionally ring/
+  sequence-striped) KV cache with a host-side page allocator;
+* :mod:`.scheduler` — request queue, Poisson arrival traces, and the
+  page-availability-driven admission/preemption policy;
+* :mod:`.engine` — the continuous-batching step loop: mixed prefill/
+  decode in ONE compiled step, eviction + admission every iteration;
+* :mod:`.replica` — elastic replica groups over device partitions,
+  drained (never dropped) across resizes, scaled through the elastic
+  discovery layer.
+
+See docs/serving.md for the architecture and the page math.
+"""
+
+from .engine import GenerationEngine  # noqa: F401
+from .kv_cache import (  # noqa: F401
+    KVCache,
+    PageAllocator,
+    PageConfig,
+    init_cache,
+    kv_cache_pspecs,
+    paged_attention,
+)
+from .replica import ReplicaAutoscaler, ReplicaSet  # noqa: F401
+from .scheduler import (  # noqa: F401
+    PoissonTrace,
+    Request,
+    Scheduler,
+)

@@ -1,0 +1,369 @@
+"""Continuous-batching generation engine (one replica).
+
+Iteration-level (Orca-style) batching: every engine step runs ONE
+compiled program over all ``max_slots`` batch slots, each slot consuming
+exactly one token — a *prompt* token for sequences still in prefill
+(teacher-forced, logits discarded until the boundary) or the previously
+*sampled* token for sequences in decode. Prefill and decode therefore mix
+freely in the same compiled step; there is no static-batch barrier:
+finished sequences are evicted and queued requests admitted between any
+two steps, and the compiled shape never changes (dead slots ride along
+masked, their writes landing on the cache's null page).
+
+The model runs under ``hvd.shard_map`` over the replica's mesh with
+attention heads tensor-parallel (``tp_axis``) and the KV page pools
+sharded the same way — the serving analogue of the training TP path, on
+the identical collective stack. Timeline spans: ``SERVE:PREFILL`` /
+``SERVE:DECODE`` bracket the compiled call (whichever phases the step
+contains), ``SERVE:ADMIT`` / ``SERVE:EVICT`` / ``SERVE:PREEMPT`` are
+instants with the slot/request in the name.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..common import basics
+from ..models.gpt import GPT, GPTConfig
+from ..parallel.tensor import tp_merge_params, tp_split_params
+from . import kv_cache as kvlib
+from .kv_cache import KVCache, PageConfig
+from .scheduler import Request, Scheduler
+
+SERVE_TP_AXIS = "serve_tp"
+
+
+class WallClock:
+    def __call__(self) -> float:
+        return time.monotonic() - self._t0
+
+    def __init__(self) -> None:
+        self._t0 = time.monotonic()
+
+
+class VirtualClock:
+    """Deterministic clock: advances ``dt`` per engine step (tests; wall
+    time would make admission order timing-dependent)."""
+
+    def __init__(self, dt: float = 1.0) -> None:
+        self.dt = dt
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def tick(self) -> None:
+        self.now += self.dt
+
+
+@dataclass
+class ServeStats:
+    """One trace's outcome (see docs/serving.md for the metric defs)."""
+
+    completed: List[Request] = field(default_factory=list)
+    steps: int = 0
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    wall_time: float = 0.0
+    preemptions: int = 0
+    resizes: int = 0
+
+    @property
+    def throughput_tokens(self) -> int:
+        """Every token the engine processed (prefill + decode, including
+        replayed work after preemption/resize)."""
+        return self.prefill_tokens + self.decode_tokens
+
+    @property
+    def goodput_tokens(self) -> int:
+        """Tokens that reached a user: generated tokens of COMPLETED
+        requests only — replayed prefill and abandoned partials don't
+        count."""
+        return sum(len(r.generated) for r in self.completed)
+
+    def tokens_per_sec(self) -> float:
+        return self.throughput_tokens / max(self.wall_time, 1e-9)
+
+    def goodput_tokens_per_sec(self) -> float:
+        return self.goodput_tokens / max(self.wall_time, 1e-9)
+
+    def latency_percentiles(self) -> Dict[str, float]:
+        lats = sorted(r.latency for r in self.completed
+                      if r.latency is not None)
+        if not lats:
+            return {"p50": float("nan"), "p99": float("nan")}
+        def pct(p):
+            return lats[min(len(lats) - 1, int(p * (len(lats) - 1) + 0.5))]
+        return {"p50": pct(0.50), "p99": pct(0.99)}
+
+    def merge(self, other: "ServeStats") -> None:
+        self.completed.extend(other.completed)
+        self.steps += other.steps
+        self.prefill_tokens += other.prefill_tokens
+        self.decode_tokens += other.decode_tokens
+        self.preemptions += other.preemptions
+        self.resizes += other.resizes
+
+
+@dataclass
+class _SlotState:
+    req: Request
+    consumed: int = 0   # tokens fed = this slot's device write cursor
+
+    @property
+    def n_prompt(self) -> int:
+        return len(self.req.prompt)
+
+    def next_token(self) -> int:
+        if self.consumed < self.n_prompt:
+            return self.req.prompt[self.consumed]
+        return self.req.generated[-1]
+
+    @property
+    def in_prefill(self) -> bool:
+        # The step consuming the LAST prompt token already produces the
+        # first sampled logits — count it as decode for TTFT purposes.
+        return self.consumed < self.n_prompt - 1
+
+
+class GenerationEngine:
+    """One replica: a compiled mixed prefill/decode step over a device
+    group, plus the host-side continuous-batching loop.
+
+    ``devices``: the replica's device subset — becomes a 1-D
+    ``(serve_tp,)`` mesh with attention heads (and KV pools) sharded
+    ``len(devices)``-way. Alternatively pass an existing ``mesh`` +
+    ``tp_axis`` (e.g. the Horovod mesh with ``tp_axis=hvd.HVD_AXES``).
+    ``params`` are the DENSE model params; the engine splits them.
+    """
+
+    def __init__(self, cfg: GPTConfig, params, page_config: PageConfig,
+                 *, devices: Optional[Sequence] = None,
+                 mesh: Optional[Mesh] = None, tp_axis=None,
+                 eos_id: int = 1, temperature: float = 0.0,
+                 seed: int = 0, name: str = "replica0") -> None:
+        import dataclasses
+
+        if mesh is None:
+            if devices is None:
+                devices = [jax.devices()[0]]
+            mesh = Mesh(np.array(list(devices)), (SERVE_TP_AXIS,))
+            tp_axis = SERVE_TP_AXIS
+        if tp_axis is None:
+            raise ValueError("pass tp_axis along with mesh")
+        tp = int(np.prod([mesh.shape[a] for a in (
+            (tp_axis,) if isinstance(tp_axis, str) else tp_axis)]))
+        if cfg.num_heads % tp:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by the replica's "
+                f"tp degree {tp} ({len(mesh.devices.ravel())} devices)")
+        if page_config.num_heads != cfg.num_heads or \
+                page_config.num_layers != cfg.num_layers or \
+                page_config.head_dim != cfg.d_model // cfg.num_heads:
+            raise ValueError("page_config geometry does not match the "
+                             "model config")
+        self.cfg = dataclasses.replace(
+            cfg, tp_axis=(tp_axis if tp > 1 else None))
+        self.page_config = page_config
+        self.mesh = mesh
+        self.tp = tp
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.name = name
+        self._rng = np.random.RandomState(seed)
+        self.sched = Scheduler(page_config)
+        self.slots: Dict[int, _SlotState] = {}
+        self.stats = ServeStats()
+
+        stacked, repl = tp_split_params(params, tp)
+        stk_spec = P(tp_axis) if tp > 1 else P()
+        rep_sh = jax.sharding.NamedSharding(mesh, P())
+        tp_sh = jax.sharding.NamedSharding(mesh, stk_spec)
+        self._stacked = jax.device_put(stacked, tp_sh)
+        self._repl = jax.device_put(repl, rep_sh)
+
+        # tp=1: fully replicated specs (a head-sharded in_spec on a size-1
+        # axis would mark every downstream value varying and fail the
+        # out_specs replication check even though no collective differs).
+        pool_spec = (P(None, None, None, tp_axis, None) if tp > 1
+                     else P())
+        cache_specs = KVCache(k=pool_spec, v=pool_spec,
+                              page_table=P(), seq_lens=P())
+        model_cfg = self.cfg
+
+        def spmd(stk, rp, cache, tokens, active):
+            local = tp_merge_params(
+                jax.tree.map(lambda a: a[0], stk), rp)
+            return GPT(model_cfg).apply({"params": local}, tokens,
+                                        cache=cache, active=active)
+
+        self._step_fn = jax.jit(basics.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(stk_spec, P(), cache_specs, P(), P()),
+            out_specs=(P(), cache_specs)))
+
+        cache = kvlib.init_cache(page_config, tp=1)  # global-shaped pools
+        cache_sh = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(mesh, s), cache_specs)
+        self.cache = jax.device_put(cache, cache_sh)
+
+    # -- queue ------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.sched.submit(req)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.sched.queue or self.slots)
+
+    def queue_depth(self) -> int:
+        return self.sched.queue_depth()
+
+    def in_flight(self) -> int:
+        return len(self.slots)
+
+    # -- the continuous-batching step -------------------------------------
+
+    def step(self, now: float) -> int:
+        """Admit, run ONE compiled mixed prefill/decode step, sample,
+        evict. Returns the number of tokens processed (0 = idle)."""
+        tl = basics._state.timeline if basics.is_initialized() else None
+        for slot in self.sched.admit(now):
+            self.slots[slot] = _SlotState(self.sched.running[slot])
+            if tl is not None:
+                tl.instant(f"SERVE:ADMIT slot{slot} "
+                           f"req{self.slots[slot].req.req_id}", tid=self.name)
+        if not self.slots:
+            return 0
+
+        # Page growth for this step's write position; preempt youngest on
+        # an empty pool (the preempted slot leaves the batch mid-flight).
+        for slot in sorted(self.slots):
+            if slot not in self.slots:   # evicted by a preemption below
+                continue
+            st = self.slots[slot]
+            while not self.sched.ensure_page(slot, st.consumed):
+                victim = self.sched.preempt_for_page(slot)
+                if victim is None:
+                    raise RuntimeError(
+                        f"page pool exhausted by a single sequence "
+                        f"(slot {slot}, pos {st.consumed}): size the pool "
+                        f"to at least pages_for(prompt+max_new_tokens)")
+                self.stats.preemptions += 1
+                if tl is not None:
+                    tl.instant(
+                        f"SERVE:PREEMPT slot{victim} "
+                        f"req{self.slots[victim].req.req_id}",
+                        tid=self.name)
+                del self.slots[victim]
+
+        S = self.page_config.max_slots
+        tokens = np.zeros((S,), np.int32)
+        active = np.zeros((S,), bool)
+        lens = np.zeros((S,), np.int32)
+        n_prefill = n_decode = 0
+        for slot, st in self.slots.items():
+            tokens[slot] = st.next_token()
+            active[slot] = True
+            lens[slot] = st.consumed
+            if st.in_prefill:
+                n_prefill += 1
+            else:
+                n_decode += 1
+
+        # Host mirrors are authoritative: admission/eviction/preemption
+        # edit the table and reset cursors, so push both every step.
+        cache = self.cache._replace(
+            page_table=jnp.asarray(self.sched.page_table),
+            seq_lens=jnp.asarray(lens))
+        phases = ([("PREFILL", n_prefill)] if n_prefill else []) + \
+                 ([("DECODE", n_decode)] if n_decode else [])
+        if tl is not None:
+            for ph, _ in phases:
+                tl.begin(self.name, f"SERVE:{ph}")
+        logits, self.cache = self._step_fn(
+            self._stacked, self._repl, cache,
+            jnp.asarray(tokens), jnp.asarray(active))
+        if tl is not None:
+            for ph, _ in reversed(phases):
+                tl.end(self.name, f"SERVE:{ph}")
+        logits = np.asarray(logits)
+
+        self.stats.prefill_tokens += n_prefill
+        self.stats.decode_tokens += n_decode
+        self.stats.steps += 1
+
+        for slot in list(self.slots):
+            st = self.slots[slot]
+            st.consumed += 1
+            if st.consumed < st.n_prompt:
+                continue  # still prefilling: logits discarded
+            tok = self._sample(logits[slot])
+            st.req.generated.append(tok)
+            if st.req.first_token_time is None:
+                st.req.first_token_time = now
+            if tok == self.eos_id or st.req.remaining_new_tokens <= 0:
+                reason = "eos" if tok == self.eos_id else "length"
+                req = self.sched.evict(slot, now, reason)
+                del self.slots[slot]
+                self.stats.completed.append(req)
+                if tl is not None:
+                    tl.instant(f"SERVE:EVICT slot{slot} req{req.req_id} "
+                               f"{reason}", tid=self.name)
+        return n_prefill + n_decode
+
+    def _sample(self, row: np.ndarray) -> int:
+        if self.temperature <= 0.0:
+            return int(np.argmax(row))
+        z = row.astype(np.float64) / self.temperature
+        z -= z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    # -- trace loop -------------------------------------------------------
+
+    def run(self, requests: Optional[Sequence[Request]] = None, *,
+            clock=None, max_steps: int = 100_000) -> ServeStats:
+        """Submit ``requests`` and step until queue and slots are empty.
+        ``clock`` defaults to a fresh :class:`WallClock`; pass a
+        :class:`VirtualClock` for deterministic tests."""
+        clock = clock or WallClock()
+        for req in (requests or ()):
+            self.submit(req)
+        t0 = clock()
+        for _ in range(max_steps):
+            if not self.has_work:
+                break
+            now = clock()
+            if self.step(now) == 0 and not isinstance(clock, VirtualClock):
+                time.sleep(1e-3)  # open-loop trace: next arrival is ahead
+            if isinstance(clock, VirtualClock):
+                clock.tick()
+        else:
+            raise RuntimeError(f"engine did not drain in {max_steps} steps")
+        self.stats.wall_time = clock() - t0
+        return self.stats
+
+    # -- drain (replica resize) -------------------------------------------
+
+    def drain(self) -> List[Request]:
+        """Stop this replica: every in-flight request leaves with its
+        progress folded into the prompt, ready to re-queue elsewhere.
+        The engine is empty (but reusable) afterwards."""
+        tl = basics._state.timeline if basics.is_initialized() else None
+        if tl is not None and self.slots:
+            tl.instant(f"SERVE:DRAIN {self.name} "
+                       f"{len(self.slots)} in-flight", tid=self.name)
+        self.slots.clear()
+        drained = self.sched.drain()
+        self.stats.resizes += len(drained)
+        queued, self.sched.queue = self.sched.queue, []
+        return queued

@@ -1,0 +1,410 @@
+"""Paged, mesh-sharded KV cache for the generation engine.
+
+vLLM-style paged attention re-thought for the Horovod mesh (PAPERS.md:
+continuous batching / PagedAttention line of work; no reference analogue —
+the reference is a training-only framework):
+
+* **Pages.** K/V live in fixed-size pages ``[page_size, H, D]`` inside one
+  flat pool per layer; a sequence owns a *page table* row of page ids, so
+  cache memory fragments at page granularity instead of max-seq-len
+  granularity. Page 0 is the reserved **null page**: the allocator never
+  hands it out, page-table rows point at it when unused, and masked/idle
+  batch slots direct their writes there — a scatter sink, never read.
+* **TP sharding.** The head dim of the pools shards over the
+  tensor-parallel mesh axis exactly like attention itself
+  (``kv_cache_pspecs`` → ``P(..., tp_axis, ...)``, e.g. ``P(HVD_AXES)``
+  heads over the full mesh); inside ``hvd.shard_map`` each rank allocates
+  only its local heads, so cache bytes scale 1/tp like the qkv weights.
+* **Ring (sequence) sharding.** For contexts longer than one host's pool,
+  pages stripe **round-robin over a mesh axis** (global page ``g`` lives
+  on rank ``g % n`` as local page ``g // n``). Decode then reuses the
+  ring-attention streaming-softmax algebra from
+  :func:`horovod_tpu.parallel.sequence.ring_attention`: every rank
+  computes a *partial* flash accumulator ``(o, m, l)`` over its local
+  pages and :func:`merge_attention_partials` combines them across the
+  axis with the identical rescale rule (``alpha = exp(m - m_new)``) —
+  collapsed to one collective round because a decode query is a single
+  token, so there is no per-step compute to pipeline the n-step ppermute
+  ring against.
+
+Everything device-side is a pure function of a :class:`KVCache` pytree —
+usable under ``jit`` / ``hvd.shard_map`` with no mutable state; the host
+side (:class:`PageAllocator`) owns which pages are live.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = float(jnp.finfo(jnp.float32).min)
+
+# The reserved scatter-sink page (see module docstring).
+NULL_PAGE = 0
+
+
+@dataclass(frozen=True)
+class PageConfig:
+    """Static geometry of the paged cache.
+
+    ``num_pages`` counts the pool size on THIS rank (ring mode stripes the
+    global pool, so per-rank pools are ``global_pages / ring_size``);
+    page 0 of every pool is the null page and is never allocatable.
+    ``pages_per_slot`` bounds one sequence's table row — the longest
+    context a slot can hold is ``pages_per_slot * page_size`` tokens.
+    """
+
+    num_pages: int
+    page_size: int
+    max_slots: int
+    pages_per_slot: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.float32
+
+    def __post_init__(self):
+        if self.num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is the "
+                             "reserved null page)")
+        for f in ("page_size", "max_slots", "pages_per_slot",
+                  "num_layers", "num_heads", "head_dim"):
+            if getattr(self, f) < 1:
+                raise ValueError(f"{f} must be >= 1")
+
+    @property
+    def tokens_per_slot(self) -> int:
+        return self.pages_per_slot * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens``."""
+        return -(-max(n_tokens, 0) // self.page_size)
+
+
+class KVCache(NamedTuple):
+    """Device-side cache state (a pytree; thread through the decode step).
+
+    k/v: ``[L, num_pages, page_size, H_local, D]`` page pools.
+    page_table: ``[max_slots, pages_per_slot]`` int32 page ids (NULL_PAGE
+    where unallocated; ring mode stores GLOBAL page ids).
+    seq_lens: ``[max_slots]`` int32 tokens currently stored per slot — the
+    write cursor: the next token of slot ``s`` lands at position
+    ``seq_lens[s]``.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    page_table: jnp.ndarray
+    seq_lens: jnp.ndarray
+
+
+def init_cache(cfg: PageConfig, tp: int = 1) -> KVCache:
+    """Zero-initialized cache; ``tp`` > 1 allocates only local heads
+    (call inside ``shard_map``, or device_put with ``kv_cache_pspecs``)."""
+    if cfg.num_heads % tp:
+        raise ValueError(
+            f"num_heads {cfg.num_heads} not divisible by tp={tp}")
+    shape = (cfg.num_layers, cfg.num_pages, cfg.page_size,
+             cfg.num_heads // tp, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, cfg.dtype),
+        v=jnp.zeros(shape, cfg.dtype),
+        page_table=jnp.full((cfg.max_slots, cfg.pages_per_slot), NULL_PAGE,
+                            jnp.int32),
+        seq_lens=jnp.zeros((cfg.max_slots,), jnp.int32),
+    )
+
+
+def kv_cache_pspecs(tp_axis=None) -> KVCache:
+    """PartitionSpecs for ``device_put``-ing a global cache onto the mesh:
+    page pools shard their head dim over ``tp_axis`` (pass ``HVD_AXES``
+    for heads over the whole mesh); table/lens replicate."""
+    pool = P(None, None, None, tp_axis, None) if tp_axis else P()
+    return KVCache(k=pool, v=pool, page_table=P(), seq_lens=P())
+
+
+class StepMeta(NamedTuple):
+    """Write coordinates for one engine step, computed ONCE from the
+    pre-step ``seq_lens`` and shared by every layer (all layers must write
+    the same position).
+
+    write_page/write_off: ``[S]`` scatter target per slot (the null page
+    for inactive slots). attend_len: ``[S]`` tokens visible to the step's
+    query AFTER its own k/v lands (``seq_lens + 1``; min 1 on inactive
+    slots so the masked softmax stays finite). active: ``[S]`` bool.
+    """
+
+    write_page: jnp.ndarray
+    write_off: jnp.ndarray
+    attend_len: jnp.ndarray
+    active: jnp.ndarray
+
+
+def step_meta(cache: KVCache, active, page_size: int,
+              ring_axis=None) -> StepMeta:
+    """Build the step's write coordinates. In ring mode (``ring_axis``)
+    the owner of the write page is ``global_page % n``; non-owners (and
+    inactive slots) write to their null page."""
+    pos = cache.seq_lens
+    active = jnp.asarray(active, bool)
+    slot = jnp.arange(cache.page_table.shape[0])
+    gpage = cache.page_table[slot, pos // page_size]
+    off = pos % page_size
+    if ring_axis is not None:
+        n = _ring_size(ring_axis)
+        me = lax.axis_index(ring_axis) if n > 1 else 0
+        owner, local = ring_owner_local(gpage, n)
+        mine = owner == me
+        page = jnp.where(active & mine, local, NULL_PAGE)
+        off = jnp.where(active & mine, off, 0)
+    else:
+        page = jnp.where(active, gpage, NULL_PAGE)
+        off = jnp.where(active, off, 0)
+    return StepMeta(
+        write_page=page.astype(jnp.int32),
+        write_off=off.astype(jnp.int32),
+        attend_len=jnp.where(active, pos + 1, 1).astype(jnp.int32),
+        active=active,
+    )
+
+
+def _ring_size(axis) -> int:
+    from ..parallel.sequence import _axis_size
+
+    return _axis_size(axis)
+
+
+def ring_owner_local(gpage, n: int):
+    """Map GLOBAL page ids to ``(owner_rank, local_page)`` under the ring
+    stripe. Allocatable ids (``g >= 1``) stripe round-robin starting at
+    rank 0; the null page maps to every rank's local null page with owner
+    ``-1`` (matches no rank, so null entries are never 'mine' — each
+    rank's local page 0 stays a pure scatter sink and a global pool of
+    ``n * (local_pages - 1) + 1`` ids covers ``n`` local pools exactly)."""
+    owner = jnp.where(gpage == NULL_PAGE, -1, (gpage - 1) % n)
+    local = jnp.where(gpage == NULL_PAGE, NULL_PAGE, 1 + (gpage - 1) // n)
+    return owner, local
+
+
+def ring_pool_ids(total_pages: int, n: int) -> int:
+    """Global allocatable-id count for ``n`` ranks of ``total_pages``-page
+    local pools (PageAllocator(total_pages=...) argument)."""
+    return n * (total_pages - 1) + 1
+
+
+def append_layer_kv(cache: KVCache, layer: int, k_new, v_new,
+                    meta: StepMeta) -> KVCache:
+    """Scatter one step's k/v (``[S, H, D]``) into layer ``layer`` at the
+    step's write coordinates. Inactive (and, in ring mode, non-owner)
+    slots land on the null page — duplicate indices there are harmless
+    because the null page is never read."""
+    k = cache.k.at[layer, meta.write_page, meta.write_off].set(
+        k_new.astype(cache.k.dtype))
+    v = cache.v.at[layer, meta.write_page, meta.write_off].set(
+        v_new.astype(cache.v.dtype))
+    return cache._replace(k=k, v=v)
+
+
+def advance(cache: KVCache, meta: StepMeta) -> KVCache:
+    """Commit the step: bump write cursors of active slots (call once per
+    step, after every layer appended)."""
+    return cache._replace(
+        seq_lens=cache.seq_lens + meta.active.astype(jnp.int32))
+
+
+def _gather_pages(pool, page_table):
+    """``[P, ps, H, D]`` pool + ``[S, Pps]`` table → ``[S, Pps*ps, H, D]``
+    contiguous per-slot K or V (positions ``j*ps + off``)."""
+    S, Pps = page_table.shape
+    ps = pool.shape[1]
+    g = pool[page_table]                       # [S, Pps, ps, H, D]
+    return g.reshape(S, Pps * ps, *pool.shape[2:])
+
+
+def _attend(q, keys, vals, mask, scale):
+    """Masked single-query attention partials.
+
+    q ``[S, 1, H, D]``, keys/vals ``[S, T, H, D]``, mask ``[S, T]`` →
+    flash accumulator ``(o [S,1,H,D] fp32 unnormalized, m [S,1,H],
+    l [S,1,H])`` so callers can either normalize locally or merge partials
+    across a mesh axis (ring mode)."""
+    s = jnp.einsum("sqhd,skhd->sqhk", q.astype(jnp.float32),
+                   keys.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                 # [S,1,H]
+    # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
+    m_safe = jnp.maximum(m, _NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(mask[:, None, None, :], p, 0.0)
+    l = jnp.sum(p, axis=-1)                                 # [S,1,H]
+    o = jnp.einsum("sqhk,skhd->sqhd", p, vals.astype(jnp.float32))
+    return o, m_safe, l
+
+
+def paged_attention_partial(q, k_pool, v_pool, page_table, attend_len,
+                            scale: Optional[float] = None,
+                            page_mask=None, page_positions=None):
+    """Flash-softmax partials of a single decode query over this rank's
+    pages. ``page_mask`` ``[S, Pps]`` (default: all table entries count)
+    masks entries another rank owns; ``page_positions`` ``[S, Pps]``
+    (default ``j``) gives each entry's GLOBAL page index within the
+    sequence so position masking survives ring striping."""
+    S, Pps = page_table.shape
+    ps = k_pool.shape[1]
+    D = q.shape[-1]
+    scale = D ** -0.5 if scale is None else scale
+    keys = _gather_pages(k_pool, page_table)
+    vals = _gather_pages(v_pool, page_table)
+    if page_positions is None:
+        page_positions = jnp.broadcast_to(jnp.arange(Pps)[None], (S, Pps))
+    # Position of table entry j, offset t: page_positions[s,j]*ps + t.
+    pos = (page_positions[:, :, None] * ps
+           + jnp.arange(ps)[None, None, :]).reshape(S, Pps * ps)
+    mask = pos < attend_len[:, None]
+    if page_mask is not None:
+        mask = mask & jnp.repeat(page_mask, ps, axis=1)
+    return _attend(q, keys, vals, mask, scale)
+
+
+def finalize_attention(o, m, l):
+    """Normalize a flash accumulator; fully-masked rows → 0."""
+    safe = jnp.where(l > 0, l, 1.0)
+    return jnp.where((l > 0)[..., None], o / safe[..., None], 0.0)
+
+
+def merge_attention_partials(o, m, l, axis):
+    """Combine per-rank flash partials across ``axis`` — the
+    ring-attention streaming-softmax combine
+    (:func:`horovod_tpu.parallel.sequence.ring_attention`'s
+    ``alpha = exp(m - m_new)`` rescale) in one collective round: a decode
+    query is a single token, so unlike training there is no per-step
+    einsum for an n-step ppermute ring to hide behind."""
+    m_g = lax.pmax(m, axis)
+    alpha = jnp.exp(m - m_g)
+    l_g = lax.psum(l * alpha, axis)
+    o_g = lax.psum(o * alpha[..., None], axis)
+    return o_g, m_g, l_g
+
+
+def paged_attention(q, k_pool, v_pool, page_table, attend_len,
+                    scale: Optional[float] = None, ring_axis=None):
+    """Single-token paged attention: ``q [S, 1, H, D]`` against the slot's
+    cached pages, masked to ``attend_len`` tokens. With ``ring_axis`` the
+    table holds GLOBAL page ids striped ``g % n`` across the axis: each
+    rank attends its local stripe and the partials merge ring-style."""
+    if ring_axis is not None:
+        n = _ring_size(ring_axis)
+        if n > 1:
+            me = lax.axis_index(ring_axis)
+            owner, local = ring_owner_local(page_table, n)
+            mine = owner == me
+            local = jnp.where(mine, local, NULL_PAGE)
+            Pps = page_table.shape[1]
+            positions = jnp.broadcast_to(
+                jnp.arange(Pps)[None], page_table.shape)
+            o, m, l = paged_attention_partial(
+                q, k_pool, v_pool, local, attend_len, scale,
+                page_mask=mine, page_positions=positions)
+            o, m, l = merge_attention_partials(o, m, l, ring_axis)
+            return finalize_attention(o, m, l).astype(q.dtype)
+    o, m, l = paged_attention_partial(q, k_pool, v_pool, page_table,
+                                      attend_len, scale)
+    return finalize_attention(o, m, l).astype(q.dtype)
+
+
+def gather_slot_kv(cache: KVCache, layer: int, slot: int,
+                   n_tokens: int, ring_axis=None):
+    """Debug/test readback: layer ``layer``'s contiguous ``[n, H, D]``
+    K/V of slot ``slot`` (eager or in-trace; ring mode all-gathers the
+    stripes via max-merge over the axis — exact because non-owned
+    positions read the zero null page... use outside hot paths only)."""
+    table = cache.page_table[slot]
+    ps = cache.k.shape[2]
+    if ring_axis is not None:
+        n = _ring_size(ring_axis)
+        if n > 1:
+            me = lax.axis_index(ring_axis)
+            owner, local = ring_owner_local(table, n)
+            mine = owner == me
+            local = jnp.where(mine, local, NULL_PAGE)
+            k = cache.k[layer, local] * mine[:, None, None, None]
+            v = cache.v[layer, local] * mine[:, None, None, None]
+            k = lax.psum(k, ring_axis)
+            v = lax.psum(v, ring_axis)
+            return (k.reshape(-1, *k.shape[2:])[:n_tokens],
+                    v.reshape(-1, *v.shape[2:])[:n_tokens])
+    k = cache.k[layer, table].reshape(-1, *cache.k.shape[3:])
+    v = cache.v[layer, table].reshape(-1, *cache.v.shape[3:])
+    return k[:n_tokens], v[:n_tokens]
+
+
+class PageAllocator:
+    """Host-side free-list over the page pool (ring mode: over GLOBAL page
+    ids ``1..total_pages-1``; page 0 is the null page).
+
+    All-or-nothing grants: ``alloc``/``extend`` either return the pages or
+    ``None`` with no state change — the scheduler's admission invariant
+    ("admission never exceeds free pages") falls out of that atomicity.
+    ``check_invariants`` is O(pages) and meant for tests/debug asserts.
+    """
+
+    def __init__(self, total_pages: int) -> None:
+        if total_pages < 2:
+            raise ValueError("total_pages must be >= 2 (null page + 1)")
+        self.total_pages = total_pages
+        # LIFO free list → recently-freed pages are reused first (the
+        # aliasing test's worst case, on purpose).
+        self._free: List[int] = list(range(total_pages - 1, 0, -1))
+        self._owner: Dict[int, List[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_of(self, seq_id) -> List[int]:
+        return list(self._owner.get(seq_id, ()))
+
+    def alloc(self, seq_id, n: int) -> Optional[List[int]]:
+        """Grant ``n`` pages to a NEW sequence, or None if short."""
+        if seq_id in self._owner:
+            raise ValueError(f"sequence {seq_id!r} already live")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._owner[seq_id] = pages
+        return pages
+
+    def extend(self, seq_id, n: int = 1) -> Optional[List[int]]:
+        """Grow a live sequence by ``n`` pages, or None if short."""
+        if seq_id not in self._owner:
+            raise ValueError(f"sequence {seq_id!r} not live")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._owner[seq_id].extend(pages)
+        return pages
+
+    def free(self, seq_id) -> List[int]:
+        """Release exactly the sequence's pages back to the pool."""
+        pages = self._owner.pop(seq_id)
+        self._free.extend(pages)
+        return pages
+
+    def live_sequences(self) -> List:
+        return list(self._owner)
+
+    def check_invariants(self) -> None:
+        """No page double-owned, none both free and owned, null page never
+        granted, and the pool accounts for every page."""
+        owned = [p for pages in self._owner.values() for p in pages]
+        assert len(owned) == len(set(owned)), "page owned twice"
+        assert NULL_PAGE not in owned, "null page allocated"
+        assert NULL_PAGE not in self._free, "null page in free list"
+        assert not (set(owned) & set(self._free)), "page both free and owned"
+        assert len(owned) + len(self._free) == self.total_pages - 1, \
+            "pages leaked"

@@ -1,0 +1,236 @@
+"""Elastic replica groups: N independent engines over device partitions.
+
+A replica group is a contiguous slice of the job's devices running one
+:class:`~horovod_tpu.serve.engine.GenerationEngine` (attention heads
+tensor-parallel inside the group). :class:`ReplicaSet` owns a global
+request queue, dispatches to the least-loaded replica, and — the elastic
+part — **resizes** the partition mid-trace: every engine drains (in-flight
+requests fold their progress into the prompt and return to the global
+queue; nothing is dropped), the engines are rebuilt over the new
+partition, and the trace continues. This is the serving analogue of the
+elastic driver's commit/restore cycle: drain = commit, re-admission =
+restore into the new world.
+
+:class:`ReplicaAutoscaler` drives resizes through the **existing
+elastic discovery layer** (elastic/discovery.py): a
+:class:`~horovod_tpu.elastic.discovery.HostManager` polls a
+``HostDiscovery`` exactly as ``ElasticDriver._discover_loop`` does
+(driver.py:365-391), and the replica target is
+``min(available groups, queue-pressure target)`` — discovery shrinking
+the fleet forces a scale-down, discovery re-adding capacity (plus queue
+depth beyond ``scale_up_depth``) grows it back.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from ..common import basics
+from ..elastic.discovery import HostDiscovery, HostManager
+from .engine import GenerationEngine, ServeStats, VirtualClock, WallClock
+from .kv_cache import PageConfig
+from .scheduler import Request
+
+
+class ReplicaSet:
+    """Partition ``devices`` into ``n_replicas`` engine groups sharing one
+    queue. Group count must divide the device count, and the model's head
+    count must divide by the per-group tp degree."""
+
+    def __init__(self, cfg, params, page_config: PageConfig, *,
+                 devices: Optional[Sequence] = None, n_replicas: int = 1,
+                 eos_id: int = 1, temperature: float = 0.0,
+                 seed: int = 0) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.page_config = page_config
+        self.devices = list(devices if devices is not None
+                            else jax.devices())
+        self.eos_id = eos_id
+        self.temperature = temperature
+        self.seed = seed
+        self.queue: List[Request] = []
+        self.stats = ServeStats()
+        self.resize_events: List[Dict] = []
+        self.engines: List[GenerationEngine] = []
+        self._build(n_replicas)
+
+    @property
+    def n_replicas(self) -> int:
+        return len(self.engines)
+
+    def _build(self, n_replicas: int) -> None:
+        n_dev = len(self.devices)
+        if n_replicas < 1 or n_dev % n_replicas:
+            raise ValueError(
+                f"{n_replicas} replicas do not evenly partition "
+                f"{n_dev} devices")
+        per = n_dev // n_replicas
+        self.engines = [
+            GenerationEngine(
+                self.cfg, self.params, self.page_config,
+                devices=self.devices[i * per:(i + 1) * per],
+                eos_id=self.eos_id, temperature=self.temperature,
+                seed=self.seed + i, name=f"replica{i}")
+            for i in range(n_replicas)]
+
+    # -- dispatch ---------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        self.queue.append(req)
+
+    def queue_depth(self) -> int:
+        return len(self.queue) + sum(e.queue_depth() for e in self.engines)
+
+    def in_flight(self) -> int:
+        return sum(e.in_flight() for e in self.engines)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(e.has_work for e in self.engines)
+
+    def _dispatch(self, now: float) -> None:
+        """Feed due arrivals to the least-loaded replica (queue depth +
+        in-flight); FIFO within the global queue."""
+        while self.queue and self.queue[0].arrival_time <= now:
+            req = self.queue.pop(0)
+            eng = min(self.engines,
+                      key=lambda e: e.queue_depth() + e.in_flight())
+            eng.submit(req)
+
+    def step_all(self, now: float) -> int:
+        self._dispatch(now)
+        return sum(e.step(now) for e in self.engines)
+
+    # -- elastic resize ---------------------------------------------------
+
+    def resize(self, n_replicas: int, now: float = 0.0) -> int:
+        """Drain every engine and rebuild over ``n_replicas`` groups.
+
+        In-flight requests fold generated progress into their prompts and
+        re-enter the global queue ahead of untouched arrivals — the
+        resize migrates work, it never drops it. Returns how many
+        requests were migrated."""
+        if n_replicas == self.n_replicas:
+            return 0
+        tl = basics._state.timeline if basics.is_initialized() else None
+        migrated: List[Request] = []
+        for eng in self.engines:
+            self.stats.merge(eng.stats)
+            eng.stats = ServeStats()
+            migrated.extend(eng.drain())
+        in_flight = sum(1 for r in migrated if r.resizes)
+        self.queue[:0] = migrated
+        old = self.n_replicas
+        self._build(n_replicas)
+        self.resize_events.append({
+            "time": now, "from": old, "to": n_replicas,
+            "migrated": len(migrated), "in_flight": in_flight})
+        if tl is not None:
+            tl.instant(f"SERVE:RESIZE {old}->{n_replicas} "
+                       f"migrated{len(migrated)}", tid="serve")
+        return len(migrated)
+
+    # -- trace loop -------------------------------------------------------
+
+    def run(self, requests: Optional[Sequence[Request]] = None, *,
+            clock=None, autoscaler: "ReplicaAutoscaler" = None,
+            resize_plan: Optional[Dict[int, int]] = None,
+            max_steps: int = 100_000) -> ServeStats:
+        """Run a trace to completion. ``resize_plan`` maps step index →
+        replica count (deterministic mid-trace resizes for tests/bench);
+        ``autoscaler`` polls discovery + queue depth instead."""
+        import time as _time
+
+        clock = clock or WallClock()
+        for req in (requests or ()):
+            self.submit(req)
+        t0 = clock()
+        for i in range(max_steps):
+            if not self.has_work:
+                break
+            now = clock()
+            if resize_plan and i in resize_plan:
+                self.resize(resize_plan[i], now)
+            if autoscaler is not None:
+                autoscaler.poll(now)
+            if self.step_all(now) == 0 and not isinstance(
+                    clock, VirtualClock):
+                _time.sleep(1e-3)
+            if isinstance(clock, VirtualClock):
+                clock.tick()
+        else:
+            raise RuntimeError(f"trace did not drain in {max_steps} steps")
+        for eng in self.engines:
+            self.stats.merge(eng.stats)
+            eng.stats = ServeStats()
+        self.stats.wall_time = clock() - t0
+        return self.stats
+
+
+class ReplicaAutoscaler:
+    """Discovery- and load-driven replica count.
+
+    ``discovery`` reports available "hosts" (device groups) exactly as the
+    elastic driver's discover loop consumes it — a shrinking report forces
+    a drain+scale-down (the serving analogue of a blacklisted host), a
+    recovered report allows scale-up again; within the available ceiling,
+    queue pressure picks the target: above ``scale_up_depth`` queued
+    requests per replica grow, below ``scale_down_depth`` shrink. Replica
+    counts are restricted to even partitions of the device count.
+    """
+
+    def __init__(self, replica_set: ReplicaSet,
+                 discovery: Optional[HostDiscovery] = None, *,
+                 min_replicas: int = 1, max_replicas: Optional[int] = None,
+                 scale_up_depth: int = 8, scale_down_depth: int = 1,
+                 cooldown_steps: int = 0) -> None:
+        self.rs = replica_set
+        self.host_manager = (HostManager(discovery)
+                             if discovery is not None else None)
+        self.min_replicas = min_replicas
+        n_dev = len(replica_set.devices)
+        self.max_replicas = max_replicas or n_dev
+        self.scale_up_depth = scale_up_depth
+        self.scale_down_depth = scale_down_depth
+        self.cooldown_steps = cooldown_steps
+        self._cooldown = 0
+        self.decisions: List[Dict] = []
+
+    def _valid(self, n: int) -> int:
+        """Clamp to [min, max] and round DOWN to an even partition."""
+        n_dev = len(self.rs.devices)
+        n = max(self.min_replicas, min(self.max_replicas, n, n_dev))
+        while n > 1 and n_dev % n:
+            n -= 1
+        return max(1, n)
+
+    def target(self) -> int:
+        ceiling = self.max_replicas
+        if self.host_manager is not None:
+            self.host_manager.update_available_hosts()
+            hosts = self.host_manager.current_hosts
+            ceiling = min(ceiling, max(self.min_replicas,
+                                       sum(hosts.values())))
+        per_replica = self.rs.queue_depth() / max(1, self.rs.n_replicas)
+        want = self.rs.n_replicas
+        if per_replica > self.scale_up_depth:
+            want = self.rs.n_replicas * 2
+        elif per_replica < self.scale_down_depth and not self.rs.in_flight():
+            want = max(1, self.rs.n_replicas // 2)
+        return self._valid(min(want, ceiling))
+
+    def poll(self, now: float) -> Optional[int]:
+        """One autoscale decision; returns the new count on a resize."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return None
+        tgt = self.target()
+        if tgt == self.rs.n_replicas:
+            return None
+        self.rs.resize(tgt, now)
+        self._cooldown = self.cooldown_steps
+        self.decisions.append({"time": now, "to": tgt})
+        return tgt

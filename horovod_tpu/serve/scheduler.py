@@ -1,0 +1,265 @@
+"""Request scheduling for the continuous-batching engine.
+
+The admission/preemption policy is driven entirely by **page
+availability** (kv_cache.PageAllocator): a request is admitted only when
+a free batch slot exists AND the allocator can atomically grant the pages
+its prompt plus one decode page need; a running sequence that outgrows
+its grant when the pool is empty preempts the *youngest* running
+sequence (LIFO — it has the least sunk prefill work), returning it to
+the head of the queue with its progress folded into the prompt, so
+nothing is ever dropped.
+
+:class:`PoissonTrace` generates the deterministic open-loop arrival
+pattern the bench/SLO story runs against (exponential inter-arrival
+times, seeded).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .kv_cache import PageAllocator, PageConfig
+
+_req_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    """One generation request, mutated as it moves through the system.
+
+    ``prompt`` may grow across preemption/drain cycles: already-generated
+    tokens fold into it (``fold_progress``) so a re-admitted request
+    replays prefill instead of losing work — the tokens count for
+    *throughput* once, but only completed requests count for *goodput*.
+    """
+
+    prompt: List[int]
+    max_new_tokens: int
+    arrival_time: float = 0.0
+    req_id: int = field(default_factory=lambda: next(_req_counter))
+    # Filled in by the engine:
+    generated: List[int] = field(default_factory=list)
+    admit_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: Optional[str] = None   # "eos" | "length"
+    preemptions: int = 0
+    resizes: int = 0
+
+    @property
+    def remaining_new_tokens(self) -> int:
+        return self.max_new_tokens - len(self.generated)
+
+    @property
+    def latency(self) -> Optional[float]:
+        if self.finish_time is None:
+            return None
+        return self.finish_time - self.arrival_time
+
+    def fold_progress(self) -> None:
+        """Fold generated tokens into the prompt (preemption / replica
+        drain): the next admission replays them as prefill and generation
+        resumes exactly where it stopped."""
+        self.max_new_tokens = self.remaining_new_tokens
+        self.prompt = list(self.prompt) + list(self.generated)
+        self.generated = []
+
+
+def _check_request(req: Request, cfg: PageConfig) -> None:
+    total = len(req.prompt) + req.max_new_tokens
+    if total > cfg.tokens_per_slot:
+        raise ValueError(
+            f"request {req.req_id}: prompt {len(req.prompt)} + "
+            f"max_new_tokens {req.max_new_tokens} = {total} exceeds a "
+            f"slot's capacity {cfg.tokens_per_slot} "
+            f"(pages_per_slot {cfg.pages_per_slot} x page_size "
+            f"{cfg.page_size})")
+    if not req.prompt:
+        raise ValueError(f"request {req.req_id}: empty prompt")
+
+
+class PoissonTrace:
+    """Deterministic Poisson arrival trace of synthetic requests.
+
+    Inter-arrival gaps ~ Exp(rate); prompt lengths and generation budgets
+    uniform over the given ranges; token ids uniform over ``vocab_size``
+    (never equal to ``eos_id``, so only length-capped termination is
+    deterministic). Same seed → same trace on every host.
+    """
+
+    def __init__(self, *, rate: float, num_requests: int, seed: int = 0,
+                 prompt_len: Sequence[int] = (4, 16),
+                 max_new_tokens: Sequence[int] = (4, 16),
+                 vocab_size: int = 128, eos_id: int = 1) -> None:
+        if rate <= 0:
+            raise ValueError("rate must be > 0 req/s")
+        self.rate = rate
+        rng = np.random.RandomState(seed)
+        gaps = rng.exponential(1.0 / rate, size=num_requests)
+        arrivals = np.cumsum(gaps)
+        self.requests: List[Request] = []
+        for i in range(num_requests):
+            n_prompt = int(rng.randint(prompt_len[0], prompt_len[1] + 1))
+            n_new = int(rng.randint(max_new_tokens[0],
+                                    max_new_tokens[1] + 1))
+            toks = rng.randint(0, vocab_size, size=n_prompt)
+            toks = np.where(toks == eos_id, (eos_id + 1) % vocab_size, toks)
+            self.requests.append(Request(
+                prompt=[int(t) for t in toks], max_new_tokens=n_new,
+                arrival_time=float(arrivals[i])))
+
+    def __iter__(self):
+        return iter(self.requests)
+
+    def __len__(self):
+        return len(self.requests)
+
+
+class Scheduler:
+    """Slot + page bookkeeping between the queue and the engine.
+
+    Owns the :class:`PageAllocator` and the host mirror of the page
+    table; the engine asks it to ``admit`` before every step and to
+    ``ensure_page``/``evict``/``preempt_for_page`` as sequences grow and
+    finish. Pure host code — the engine pushes the resulting table into
+    the device cache.
+    """
+
+    def __init__(self, cfg: PageConfig,
+                 allocator: Optional[PageAllocator] = None) -> None:
+        self.cfg = cfg
+        self.allocator = allocator or PageAllocator(cfg.num_pages)
+        self.queue: List[Request] = []          # FIFO; preempted go first
+        self.running: Dict[int, Request] = {}   # slot -> request
+        self._admit_order: List[int] = []       # slots, oldest first
+        # Host mirror of KVCache.page_table (engine copies to device).
+        self.page_table = np.zeros(
+            (cfg.max_slots, cfg.pages_per_slot), np.int32)
+
+    # -- queue ------------------------------------------------------------
+
+    def submit(self, req: Request, *, front: bool = False) -> None:
+        _check_request(req, self.cfg)
+        if front:
+            self.queue.insert(0, req)
+        else:
+            self.queue.append(req)
+
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.cfg.max_slots)
+                if s not in self.running]
+
+    # -- admission --------------------------------------------------------
+
+    def _pages_for_admission(self, req: Request) -> int:
+        # The prompt, plus one page of decode headroom so the first
+        # sampled token can never stall a freshly-admitted sequence.
+        return self.cfg.pages_for(len(req.prompt) + 1)
+
+    def admit(self, now: float) -> List[int]:
+        """Admit queued requests (arrival_time <= now) while a free slot
+        and sufficient free pages exist. FIFO — no overtaking: a large
+        head-of-line request blocks later ones (predictable tail latency
+        beats marginal utilization here). Returns the admitted slots."""
+        admitted = []
+        while self.queue and self.queue[0].arrival_time <= now:
+            slots = self.free_slots()
+            if not slots:
+                break
+            req = self.queue[0]
+            need = self._pages_for_admission(req)
+            pages = self.allocator.alloc(req.req_id, need)
+            if pages is None:
+                break  # admission never exceeds free pages
+            self.queue.pop(0)
+            slot = slots[0]
+            self.running[slot] = req
+            self._admit_order.append(slot)
+            req.admit_time = now
+            self.page_table[slot, :] = 0
+            self.page_table[slot, :len(pages)] = pages
+            admitted.append(slot)
+        return admitted
+
+    # -- growth / preemption ----------------------------------------------
+
+    def ensure_page(self, slot: int, pos: int) -> bool:
+        """Make sure the page holding position ``pos`` is granted; grows
+        the sequence by one page when ``pos`` crosses into an ungranted
+        page. False = pool empty (caller decides to preempt)."""
+        req = self.running[slot]
+        page_idx = pos // self.cfg.page_size
+        have = len(self.allocator.pages_of(req.req_id))
+        if page_idx < have:
+            return True
+        if page_idx >= self.cfg.pages_per_slot:
+            raise ValueError(
+                f"slot {slot}: position {pos} beyond slot capacity "
+                f"{self.cfg.tokens_per_slot}")
+        got = self.allocator.extend(req.req_id, 1)
+        if got is None:
+            return False
+        self.page_table[slot, have] = got[0]
+        return True
+
+    def preempt_for_page(self, needy_slot: int) -> Optional[int]:
+        """Free pages for ``needy_slot`` by preempting the YOUNGEST other
+        running sequence; its request re-queues at the front with progress
+        folded in. Returns the preempted slot (None when ``needy_slot`` is
+        the only runner — nothing to take from)."""
+        for slot in reversed(self._admit_order):
+            if slot != needy_slot:
+                req = self._release(slot)
+                req.preemptions += 1
+                req.fold_progress()
+                self.submit(req, front=True)
+                return slot
+        return None
+
+    # -- completion -------------------------------------------------------
+
+    def evict(self, slot: int, now: float, reason: str) -> Request:
+        """Finish a sequence: frees exactly its pages, clears the slot."""
+        req = self._release(slot)
+        req.finish_time = now
+        req.finish_reason = reason
+        return req
+
+    def drain(self) -> List[Request]:
+        """Release every running sequence (replica resize): progress folds
+        into the prompt and the requests go back to the queue front in
+        admission order — in-flight work is migrated, never dropped."""
+        out = []
+        for slot in list(self._admit_order):
+            req = self._release(slot)
+            req.resizes += 1
+            req.fold_progress()
+            out.append(req)
+        for req in reversed(out):
+            self.submit(req, front=True)
+        return out
+
+    def _release(self, slot: int) -> Request:
+        req = self.running.pop(slot)
+        self._admit_order.remove(slot)
+        self.allocator.free(req.req_id)
+        self.page_table[slot, :] = 0
+        return req
+
+    def check_invariants(self) -> None:
+        self.allocator.check_invariants()
+        live = set()
+        for slot, req in self.running.items():
+            pages = self.allocator.pages_of(req.req_id)
+            table = [int(p) for p in self.page_table[slot] if p != 0]
+            assert table == pages, \
+                f"slot {slot}: table {table} != grant {pages}"
+            assert not (set(pages) & live), "live sequences share a page"
+            live |= set(pages)

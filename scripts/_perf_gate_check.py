@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Gate check for scripts/perf_gate.sh: one bench JSON line on argv[1].
+
+Serve leg: compares against the seeded ``BENCH_serve_baseline.json``
+(created on first run; refresh with PERF_GATE_UPDATE=1), after hard
+correctness assertions (no dropped requests, parity probe present).
+Train legs: compares against the best SAME-platform, same-metric value
+recorded in the ``BENCH_r*.json`` trajectory (each of those wraps the
+bench's one-line JSON under ``parsed`` or inside ``tail``).
+
+Exit 0 = within tolerance, 1 = regression, 2 = usage/baseline error.
+"""
+
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SERVE_BASELINE = os.path.join(REPO, "BENCH_serve_baseline.json")
+
+
+def trajectory_records():
+    """Bench metric lines embedded in the recorded BENCH_r*.json trail."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r[0-9]*.json"))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            out.append((os.path.basename(path), parsed))
+            continue
+        for line in reversed(rec.get("tail", "").splitlines()):
+            line = line.strip()
+            if line.startswith("{") and '"metric"' in line:
+                try:
+                    out.append((os.path.basename(path), json.loads(line)))
+                except ValueError:
+                    pass
+                break
+    return out
+
+
+def gate(measured, baseline, tol, what):
+    floor = tol * baseline
+    ok = measured >= floor
+    verdict = "OK" if ok else "REGRESSION"
+    print(f"perf gate [{what}]: measured {measured:.2f} vs baseline "
+          f"{baseline:.2f} (floor {floor:.2f} at tol {tol}) -> {verdict}")
+    return ok
+
+
+def main():
+    if len(sys.argv) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rec = json.loads(sys.argv[1])
+    leg = os.environ.get("PERF_GATE_LEG", "serve")
+    tol = float(os.environ.get("PERF_GATE_TOL", "0.60"))
+    update = os.environ.get("PERF_GATE_UPDATE") == "1"
+
+    if leg == "serve":
+        if rec.get("requests_dropped", 1) != 0:
+            print(f"perf gate [serve]: dropped requests "
+                  f"{rec.get('requests_dropped')} — hard fail")
+            return 1
+        if rec.get("goodput_tokens_per_sec", 0) <= 0:
+            print("perf gate [serve]: zero goodput — hard fail")
+            return 1
+        if update or not os.path.exists(SERVE_BASELINE):
+            with open(SERVE_BASELINE, "w") as f:
+                json.dump(rec, f, indent=1)
+            print(f"perf gate [serve]: seeded baseline "
+                  f"{os.path.basename(SERVE_BASELINE)} at goodput "
+                  f"{rec['goodput_tokens_per_sec']} tok/s")
+            return 0
+        with open(SERVE_BASELINE) as f:
+            base = json.load(f)
+        if base.get("platform") != rec.get("platform"):
+            print(f"perf gate [serve]: platform changed "
+                  f"({base.get('platform')} -> {rec.get('platform')}); "
+                  f"re-seed with PERF_GATE_UPDATE=1")
+            return 2
+        ok = gate(rec["goodput_tokens_per_sec"],
+                  base["goodput_tokens_per_sec"], tol, "serve goodput")
+        ok &= gate(rec["tokens_per_sec"], base["tokens_per_sec"], tol,
+                   "serve throughput")
+        return 0 if ok else 1
+
+    # Training legs: best same-platform value for this metric across the
+    # recorded trajectory.
+    candidates = [
+        (src, r["value"]) for src, r in trajectory_records()
+        if r.get("metric") == rec.get("metric")
+        and r.get("platform") == rec.get("platform")
+        and isinstance(r.get("value"), (int, float))]
+    if not candidates:
+        print(f"perf gate [{leg}]: no recorded {rec.get('metric')!r} on "
+              f"platform {rec.get('platform')!r} in the BENCH_r*.json "
+              f"trajectory — nothing to gate against (pass)")
+        return 0
+    src, best = max(candidates, key=lambda c: c[1])
+    print(f"perf gate [{leg}]: trajectory anchor {src}")
+    return 0 if gate(rec["value"], best, tol, rec["metric"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# Continuous perf regression gate (ROADMAP open item 5, first brick).
+#
+# Turns the BENCH_r*.json artifact trail from a record into a CONTRACT:
+# each gated leg runs bench.py now, extracts the one-line JSON metric,
+# and fails (rc 1) when the measured value regresses below
+# PERF_GATE_TOL (default 0.60, i.e. the run must keep >= 60% of the
+# recorded trajectory's best same-platform value — CPU-mesh numbers are
+# noisy; tighten on real hardware) of:
+#   * the recorded trajectory: best same-platform value for that metric
+#     across BENCH_r*.json (training legs), and
+#   * the seeded serve baseline BENCH_serve_baseline.json (the new
+#     --serve leg) — created by the first run, refreshed with
+#     PERF_GATE_UPDATE=1.
+#
+# Usage:
+#   scripts/perf_gate.sh             # gate the serve leg (default)
+#   PERF_GATE_LEGS="serve train" scripts/perf_gate.sh
+#   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+LEGS="${PERF_GATE_LEGS:-serve}"
+TOL="${PERF_GATE_TOL:-0.60}"
+UPDATE="${PERF_GATE_UPDATE:-0}"
+FAIL=0
+
+run_leg() {  # run_leg <name> <bench args...>
+    local name="$1"; shift
+    echo "== perf gate: $name leg ==" >&2
+    local out
+    out=$(JAX_PLATFORMS=cpu python bench.py "$@" | tail -n 1)
+    echo "$out"
+    PERF_GATE_LEG="$name" PERF_GATE_TOL="$TOL" PERF_GATE_UPDATE="$UPDATE" \
+        python scripts/_perf_gate_check.py "$out" || FAIL=1
+}
+
+for leg in $LEGS; do
+    case "$leg" in
+        serve)
+            run_leg serve --serve --platform cpu --cpu-devices 8 \
+                --serve-requests "${PERF_GATE_SERVE_REQUESTS:-12}" \
+                --serve-rate 50
+            ;;
+        train)
+            run_leg train --platform cpu --cpu-devices 8 \
+                --model resnet18 --batch-size 2 --image-size 64 \
+                --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
+            ;;
+        *)
+            echo "unknown gate leg: $leg (serve|train)" >&2; exit 2
+            ;;
+    esac
+done
+
+if [ "$FAIL" -ne 0 ]; then
+    echo "PERF GATE: REGRESSION DETECTED (see above)" >&2
+    exit 1
+fi
+echo "PERF GATE: all legs within tolerance $TOL" >&2

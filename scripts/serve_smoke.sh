@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Serve-path smoke: a short Poisson trace on the 8-device virtual CPU
+# mesh through `bench.py --serve` (continuous batching + paged KV cache +
+# one elastic replica resize down/up mid-trace, docs/serving.md).
+# Asserts: rc 0 (the bench itself aborts on dropped requests or a
+# decode/full-context parity failure), nonzero goodput, and a clean
+# drain (requests_completed == requests). Runtime ~1 min.
+#
+# Usage: scripts/serve_smoke.sh [extra bench.py args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT=$(JAX_PLATFORMS=cpu python bench.py --serve --platform cpu \
+    --cpu-devices 8 \
+    --serve-requests "${SERVE_SMOKE_REQUESTS:-12}" \
+    --serve-rate "${SERVE_SMOKE_RATE:-50}" \
+    "$@" | tail -n 1)
+echo "$OUT"
+
+python - "$OUT" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"] == "gpt_serve_goodput_tokens_per_sec", rec["metric"]
+assert rec["goodput_tokens_per_sec"] > 0, "zero goodput"
+assert rec["tokens_per_sec"] > 0, "zero throughput"
+assert rec["requests_dropped"] == 0, f"dropped {rec['requests_dropped']}"
+assert rec["requests_completed"] == rec["requests"], "trace did not drain"
+assert rec["latency_p99_ms"] >= rec["latency_p50_ms"] > 0
+print(f"serve smoke OK: goodput {rec['goodput_tokens_per_sec']} tok/s, "
+      f"p50 {rec['latency_p50_ms']} ms, p99 {rec['latency_p99_ms']} ms, "
+      f"{len(rec['resize_events'])} resizes, clean shutdown")
+EOF

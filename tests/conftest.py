@@ -29,6 +29,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: fault-injection (chaos) robustness test — "
         "see docs/robustness.md and scripts/chaos_soak.py")
+    config.addinivalue_line(
+        "markers", "serve: continuous-batching generation engine test "
+        "(horovod_tpu/serve/) — see docs/serving.md and "
+        "scripts/serve_smoke.sh")
 
 
 @pytest.fixture(scope="session", autouse=True)

@@ -5,6 +5,8 @@ every dtype x op x fused/unfused over a real 2-process world) — here the
 world is 8 XLA devices and the collectives are the compiled shard_map path.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -258,3 +260,51 @@ def test_async_duplicate_name_rejected():
 
 def test_join_single_process():
     assert hvd.join() == hvd.rank()
+
+
+def test_uninitialized_collectives_say_call_init_first():
+    """Every compiled-path entry point (allreduce, reduce_scatter,
+    all_gather, and the stream variants) must answer an uninitialized
+    backend with the reference-style "call init() first" error — not the
+    raw KeyError the axis-env lookup used to leak (ISSUE 6 satellite).
+    Subprocess: the session fixture keeps THIS process initialized."""
+    import subprocess
+    import sys
+
+    code = """
+import jax.numpy as jnp
+import horovod_tpu as hvd
+from horovod_tpu.common.basics import HVD_AXES
+from horovod_tpu.common.exceptions import NotInitializedError
+
+calls = [
+    lambda: hvd.allreduce(jnp.ones(4)),
+    lambda: hvd.allreduce(jnp.ones(4), axes=HVD_AXES),
+    lambda: hvd.reduce_scatter(jnp.ones(8), axes=HVD_AXES),
+    lambda: hvd.all_gather(jnp.ones(4), axes=HVD_AXES),
+    lambda: hvd.allreduce_stream(jnp.ones(4), axes=HVD_AXES),
+    lambda: hvd.reduce_scatter_stream(jnp.ones(8), axes=HVD_AXES),
+    lambda: hvd.all_gather_stream(jnp.ones(4), axes=HVD_AXES),
+]
+for fn in calls:
+    try:
+        fn()
+    except NotInitializedError as e:
+        assert "init() first" in str(e), str(e)
+    else:
+        raise SystemExit("no error raised before hvd.init()")
+# initialized but axes unbound outside shard_map: actionable ValueError
+hvd.init()
+try:
+    hvd.allreduce(jnp.ones(4), axes=HVD_AXES)
+except ValueError as e:
+    assert "hvd.shard_map" in str(e), str(e)
+else:
+    raise SystemExit("unbound axes outside shard_map not rejected")
+print("INIT-GUARDS-OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=180,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "INIT-GUARDS-OK" in r.stdout

@@ -1,0 +1,571 @@
+"""Serving subsystem tests (docs/serving.md): paged KV cache, scheduler
+invariants, the continuous-batching engine, and elastic replica groups.
+
+Core invariants (ISSUE 6):
+  * admission never exceeds free pages; eviction frees exactly the
+    finished sequence's pages; page reuse never aliases live sequences;
+  * decode-with-cache logits match the full-context forward within
+    tolerance — single device, 8-way TP over the full mesh, and with the
+    page pool ring-striped across the mesh (contexts longer than one
+    host's pages);
+  * a replica resize mid-trace completes without dropping in-flight
+    requests; the autoscaler follows the elastic discovery layer.
+
+Compiled tests run on the 8-device CPU mesh via ``hvd.shard_map``.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models import GPT, gpt_tiny
+from horovod_tpu.serve import kv_cache as kvlib
+from horovod_tpu.serve import (
+    GenerationEngine,
+    PageAllocator,
+    PageConfig,
+    PoissonTrace,
+    ReplicaAutoscaler,
+    ReplicaSet,
+    Request,
+    Scheduler,
+)
+from horovod_tpu.serve.engine import VirtualClock
+
+pytestmark = pytest.mark.serve
+
+N = 8
+
+
+def tiny_cfg(**over):
+    return gpt_tiny(dtype=jnp.float32, num_heads=8, **over)
+
+
+def tiny_page_cfg(cfg, **over):
+    kw = dict(num_pages=64, page_size=4, max_slots=4, pages_per_slot=16,
+              num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+              head_dim=cfg.d_model // cfg.num_heads)
+    kw.update(over)
+    return PageConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# Page allocator / scheduler invariants
+
+
+class TestPageAllocator:
+    def test_atomic_alloc_and_free(self):
+        a = PageAllocator(8)            # 7 allocatable (page 0 reserved)
+        assert a.free_pages == 7
+        p1 = a.alloc("a", 3)
+        assert len(p1) == 3 and a.free_pages == 4
+        assert a.alloc("b", 5) is None          # atomic: no partial grant
+        assert a.free_pages == 4
+        a.check_invariants()
+        freed = a.free("a")
+        assert sorted(freed) == sorted(p1)
+        assert a.free_pages == 7
+        a.check_invariants()
+
+    def test_null_page_never_granted(self):
+        a = PageAllocator(16)
+        pages = a.alloc("s", 15)
+        assert kvlib.NULL_PAGE not in pages
+        assert a.free_pages == 0
+        a.check_invariants()
+
+    def test_extend_and_double_alloc_rejected(self):
+        a = PageAllocator(8)
+        a.alloc("s", 2)
+        assert a.extend("s", 2) == a.pages_of("s")[2:]
+        with pytest.raises(ValueError):
+            a.alloc("s", 1)
+        with pytest.raises(ValueError):
+            a.extend("ghost", 1)
+
+    def test_no_aliasing_across_reuse(self):
+        """LIFO reuse hands freed pages straight to the next sequence —
+        live grants must still never intersect."""
+        a = PageAllocator(8)
+        a.alloc("a", 3)
+        a.alloc("b", 3)
+        a.free("a")
+        pages_c = a.alloc("c", 3)
+        assert not set(pages_c) & set(a.pages_of("b"))
+        a.check_invariants()
+
+
+class TestScheduler:
+    def cfg(self, **over):
+        return tiny_page_cfg(tiny_cfg(), **over)
+
+    def test_admission_never_exceeds_free_pages(self):
+        # Pool of 6 allocatable pages; each request needs 3 (prompt 8 + 1
+        # headroom at page_size 4) -> exactly 2 admissions.
+        cfg = self.cfg(num_pages=7, max_slots=4, pages_per_slot=4)
+        s = Scheduler(cfg)
+        for _ in range(4):
+            s.submit(Request(prompt=[2] * 8, max_new_tokens=4))
+        admitted = s.admit(now=0.0)
+        assert len(admitted) == 2
+        assert s.allocator.free_pages == 0
+        assert s.queue_depth() == 2
+        s.check_invariants()
+
+    def test_eviction_frees_exactly_the_finished_pages(self):
+        cfg = self.cfg(num_pages=16)
+        s = Scheduler(cfg)
+        s.submit(Request(prompt=[2] * 6, max_new_tokens=4))
+        s.submit(Request(prompt=[3] * 6, max_new_tokens=4))
+        (s1, s2) = s.admit(0.0)
+        free_before = s.allocator.free_pages
+        held = len(s.allocator.pages_of(s.running[s1].req_id))
+        req = s.evict(s1, 1.0, "length")
+        assert req.finish_reason == "length"
+        assert s.allocator.free_pages == free_before + held
+        # the survivor's pages are untouched
+        assert s.page_table[s2].any()
+        s.check_invariants()
+
+    def test_preemption_requeues_front_with_progress(self):
+        cfg = self.cfg(num_pages=7, max_slots=4, pages_per_slot=4)
+        s = Scheduler(cfg)
+        s.submit(Request(prompt=[2] * 8, max_new_tokens=4))
+        s.submit(Request(prompt=[3] * 8, max_new_tokens=4))
+        s.admit(0.0)
+        young = s._admit_order[-1]
+        old = s._admit_order[0]
+        s.running[young].generated = [9, 9]
+        victim = s.preempt_for_page(needy_slot=old)
+        assert victim == young
+        assert s.queue[0].prompt[-2:] == [9, 9]     # progress folded
+        assert s.queue[0].preemptions == 1
+        s.check_invariants()
+
+    def test_oversized_request_rejected(self):
+        cfg = self.cfg(pages_per_slot=2, page_size=4)
+        s = Scheduler(cfg)
+        with pytest.raises(ValueError, match="exceeds"):
+            s.submit(Request(prompt=[2] * 8, max_new_tokens=4))
+
+
+# ---------------------------------------------------------------------------
+# KV cache device ops
+
+
+class TestKVCache:
+    def test_append_gather_roundtrip_and_no_aliasing(self):
+        cfg = PageConfig(num_pages=8, page_size=2, max_slots=2,
+                         pages_per_slot=4, num_layers=1, num_heads=2,
+                         head_dim=2)
+        cache = kvlib.init_cache(cfg)
+        alloc = PageAllocator(cfg.num_pages)
+        table = np.array(cache.page_table)
+        pa = alloc.alloc("a", 2)
+        pb = alloc.alloc("b", 2)
+        table[0, :2] = pa
+        table[1, :2] = pb
+        cache = cache._replace(page_table=jnp.asarray(table))
+        active = jnp.ones((2,), bool)
+        for t in range(4):
+            meta = kvlib.step_meta(cache, active, cfg.page_size)
+            k_new = jnp.full((2, 2, 2), 10.0 * t) + \
+                jnp.arange(2, dtype=jnp.float32)[:, None, None]
+            cache = kvlib.append_layer_kv(cache, 0, k_new, -k_new, meta)
+            cache = kvlib.advance(cache, meta)
+        for slot in range(2):
+            k, v = kvlib.gather_slot_kv(cache, 0, slot, 4)
+            expect = (10.0 * np.arange(4) + slot)[:, None, None]
+            np.testing.assert_allclose(np.asarray(k),
+                                       np.broadcast_to(expect, (4, 2, 2)))
+            np.testing.assert_allclose(np.asarray(v), -np.broadcast_to(
+                expect, (4, 2, 2)))
+        # Evict "a", reuse its pages for "c": b's tokens must not change
+        # (page reuse never aliases a live sequence).
+        b_before = np.asarray(kvlib.gather_slot_kv(cache, 0, 1, 4)[0])
+        alloc.free("a")
+        pc_ = alloc.alloc("c", 2)
+        assert not set(pc_) & set(alloc.pages_of("b"))
+        table[0, :2] = pc_
+        cache = cache._replace(page_table=jnp.asarray(table),
+                               seq_lens=jnp.asarray([0, 4], jnp.int32))
+        meta = kvlib.step_meta(cache, jnp.asarray([True, False]),
+                               cfg.page_size)
+        cache = kvlib.append_layer_kv(
+            cache, 0, jnp.full((2, 2, 2), 99.0),
+            jnp.full((2, 2, 2), -99.0), meta)
+        np.testing.assert_array_equal(
+            np.asarray(kvlib.gather_slot_kv(cache, 0, 1, 4)[0]), b_before)
+
+    def test_inactive_slots_write_null_page_only(self):
+        cfg = PageConfig(num_pages=4, page_size=2, max_slots=2,
+                         pages_per_slot=2, num_layers=1, num_heads=1,
+                         head_dim=2)
+        cache = kvlib.init_cache(cfg)
+        table = np.array(cache.page_table)
+        table[0, 0] = 1
+        cache = cache._replace(page_table=jnp.asarray(table))
+        meta = kvlib.step_meta(cache, jnp.asarray([False, False]),
+                               cfg.page_size)
+        assert np.all(np.asarray(meta.write_page) == kvlib.NULL_PAGE)
+        cache2 = kvlib.append_layer_kv(
+            cache, 0, jnp.ones((2, 1, 2)), jnp.ones((2, 1, 2)), meta)
+        # everything except the null page is untouched
+        np.testing.assert_array_equal(np.asarray(cache2.k[0, 1:]),
+                                      np.asarray(cache.k[0, 1:]))
+        cache2 = kvlib.advance(cache2, meta)
+        assert np.all(np.asarray(cache2.seq_lens) == 0)
+
+
+# ---------------------------------------------------------------------------
+# Decode-vs-full-context logits parity
+
+
+def _full_logits(cfg, params, tokens):
+    return np.asarray(GPT(cfg).apply({"params": params},
+                                     jnp.asarray(tokens)[None])[0])
+
+
+def _alloc_slot0(cache, pc, n_tokens):
+    alloc = PageAllocator(pc.num_pages)
+    pages = alloc.alloc("s0", pc.pages_for(n_tokens))
+    table = np.array(cache.page_table)
+    table[0, :len(pages)] = pages
+    return cache._replace(page_table=jnp.asarray(table))
+
+
+class TestDecodeParity:
+    def test_single_device_parity(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg, max_slots=2)
+        rs = np.random.RandomState(0)
+        T = 20
+        toks = rs.randint(2, cfg.vocab_size, size=T)
+        full = _full_logits(cfg, params, toks)
+        cache = _alloc_slot0(kvlib.init_cache(pc), pc, T)
+        step = jax.jit(lambda t, c: GPT(cfg).apply(
+            {"params": params}, t, cache=c,
+            active=jnp.asarray([True, False])))
+        rows = []
+        for t in toks:
+            logits, cache = step(jnp.asarray([int(t), 0]), cache)
+            rows.append(np.asarray(logits[0]))
+        np.testing.assert_allclose(np.stack(rows), full,
+                                   rtol=2e-4, atol=2e-4)
+        assert int(cache.seq_lens[0]) == T and int(cache.seq_lens[1]) == 0
+
+    def test_tp8_parity_over_full_mesh(self, model):
+        """Decode with the page pools head-sharded P(HVD_AXES) over the
+        8-device mesh == the dense full-context forward."""
+        from horovod_tpu.parallel.tensor import (tp_merge_params,
+                                                 tp_split_params)
+
+        cfg, params = model
+        tp_cfg = dataclasses.replace(cfg, tp_axis=hvd.HVD_AXES)
+        pc = tiny_page_cfg(cfg, max_slots=2)
+        rs = np.random.RandomState(1)
+        T = 12
+        toks = rs.randint(2, cfg.vocab_size, size=T)
+        full = _full_logits(cfg, params, toks)
+
+        mesh = hvd.mesh()
+        stacked, repl = tp_split_params(params, N)
+        cache = _alloc_slot0(kvlib.init_cache(pc), pc, T)
+        pool = P(None, None, None, hvd.HVD_AXES, None)
+        cache_specs = kvlib.KVCache(k=pool, v=pool, page_table=P(),
+                                    seq_lens=P())
+
+        def spmd(stk, rp, c, t):
+            local = tp_merge_params(jax.tree.map(lambda a: a[0], stk), rp)
+            return GPT(tp_cfg).apply(
+                {"params": local}, t, cache=c,
+                active=jnp.asarray([True, False]))
+
+        step = jax.jit(hvd.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(hvd.HVD_AXES), P(), cache_specs, P()),
+            out_specs=(P(), cache_specs)))
+        rows = []
+        for t in toks:
+            logits, cache = step(stacked, repl, cache,
+                                 jnp.asarray([int(t), 0]))
+            rows.append(np.asarray(logits[0]))
+        np.testing.assert_allclose(np.stack(rows), full,
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_ring_striped_pages_parity(self, model):
+        """Context longer than one host's page pool: pages stripe over
+        the whole mesh (per-rank pool holds 8 of 24 tokens) and decode
+        merges per-rank flash partials with the ring combine."""
+        cfg, params = model
+        ring_cfg = dataclasses.replace(cfg, kv_ring_axis=hvd.HVD_AXES)
+        rs = np.random.RandomState(2)
+        T = 24
+        toks = rs.randint(2, cfg.vocab_size, size=T)
+        full = _full_logits(cfg, params, toks)
+
+        # 2 local pages x 4 tokens = 8 tokens/rank < T.
+        pc = tiny_page_cfg(cfg, num_pages=2, max_slots=2, pages_per_slot=8)
+        H, D = cfg.num_heads, cfg.d_model // cfg.num_heads
+        alloc = PageAllocator(kvlib.ring_pool_ids(pc.num_pages, N))
+        pages = alloc.alloc("s0", pc.pages_for(T))
+        table = np.zeros((pc.max_slots, pc.pages_per_slot), np.int32)
+        table[0, :len(pages)] = pages
+        pool_shape = (N, cfg.num_layers, pc.num_pages, pc.page_size, H, D)
+        cache = kvlib.KVCache(
+            k=jnp.zeros(pool_shape, jnp.float32),
+            v=jnp.zeros(pool_shape, jnp.float32),
+            page_table=jnp.asarray(table),
+            seq_lens=jnp.zeros((pc.max_slots,), jnp.int32))
+        specs = kvlib.KVCache(k=P(hvd.HVD_AXES), v=P(hvd.HVD_AXES),
+                              page_table=P(), seq_lens=P())
+        mesh = hvd.mesh()
+
+        def spmd(c, t):
+            local = kvlib.KVCache(k=c.k[0], v=c.v[0],
+                                  page_table=c.page_table,
+                                  seq_lens=c.seq_lens)
+            logits, c2 = GPT(ring_cfg).apply(
+                {"params": params}, t, cache=local,
+                active=jnp.asarray([True, False]))
+            return logits, kvlib.KVCache(
+                k=c2.k[None], v=c2.v[None], page_table=c2.page_table,
+                seq_lens=c2.seq_lens)
+
+        step = jax.jit(hvd.shard_map(
+            spmd, mesh=mesh, in_specs=(specs, P()),
+            out_specs=(P(), specs)))
+        rows = []
+        for t in toks:
+            logits, cache = step(cache, jnp.asarray([int(t), 0]))
+            rows.append(np.asarray(logits[0]))
+        np.testing.assert_allclose(np.stack(rows), full,
+                                   rtol=3e-4, atol=3e-4)
+
+    def test_ring_overlapping_tp_axis_rejected(self, model):
+        """kv_ring_axis inside tp_axis would stripe pages between ranks
+        holding different heads — must fail loudly at trace time."""
+        cfg, params = model
+        bad = dataclasses.replace(cfg, tp_axis=hvd.HVD_AXES,
+                                  kv_ring_axis=hvd.LOCAL_AXIS)
+        pc = tiny_page_cfg(cfg, max_slots=1)
+        from horovod_tpu.parallel.tensor import (tp_merge_params,
+                                                 tp_split_params)
+
+        stacked, repl = tp_split_params(params, N)
+        cache = kvlib.init_cache(pc)
+        mesh = hvd.mesh()
+
+        def spmd(stk, rp, c, t):
+            local = tp_merge_params(jax.tree.map(lambda a: a[0], stk), rp)
+            return GPT(bad).apply({"params": local}, t, cache=c)
+
+        with pytest.raises(ValueError, match="overlaps"):
+            jax.jit(hvd.shard_map(
+                spmd, mesh=mesh,
+                in_specs=(P(hvd.HVD_AXES), P(),
+                          jax.tree.map(lambda _: P(), cache), P()),
+                out_specs=(P(), jax.tree.map(lambda _: P(), cache))))(
+                stacked, repl, cache, jnp.zeros((1,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# Engine / continuous batching
+
+
+class TestEngine:
+    def test_trace_completes_and_greedy_matches_full_context(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        eng = GenerationEngine(cfg, params, pc, eos_id=1)
+        prompt = [5, 9, 3, 7]
+        req = Request(prompt=list(prompt), max_new_tokens=5,
+                      arrival_time=0.0)
+        stats = eng.run([req], clock=VirtualClock())
+        assert len(stats.completed) == 1
+        got = stats.completed[0].generated
+        toks = list(prompt)
+        for _ in range(5):
+            nxt = int(np.argmax(_full_logits(cfg, params, toks)[-1]))
+            toks.append(nxt)
+            if nxt == 1:
+                break
+        assert got == toks[len(prompt):]
+
+    def test_mixed_prefill_decode_and_continuous_admission(self, model):
+        """Requests arriving mid-trace join while earlier ones decode —
+        the same compiled step serves both phases (no static batch)."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg, max_slots=3)
+        eng = GenerationEngine(cfg, params, pc, eos_id=1)
+        trace = PoissonTrace(rate=2.0, num_requests=6, seed=0,
+                             prompt_len=(3, 8), max_new_tokens=(2, 6),
+                             vocab_size=cfg.vocab_size)
+        stats = eng.run(list(trace), clock=VirtualClock(0.25))
+        assert len(stats.completed) == 6
+        assert stats.prefill_tokens > 0 and stats.decode_tokens > 0
+        assert all(r.finish_reason in ("eos", "length")
+                   for r in stats.completed)
+        lat = stats.latency_percentiles()
+        assert lat["p99"] >= lat["p50"] > 0
+
+    def test_preemption_under_page_pressure_completes_all(self, model):
+        """A pool too small for the full load forces preemptions; every
+        request still completes (folded progress, front-of-queue)."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg, num_pages=13, max_slots=4,
+                           pages_per_slot=12)
+        eng = GenerationEngine(cfg, params, pc, eos_id=1)
+        reqs = [Request(prompt=[3 + i] * 6, max_new_tokens=24,
+                        arrival_time=0.0) for i in range(4)]
+        stats = eng.run(reqs, clock=VirtualClock())
+        assert len(stats.completed) == 4
+        assert stats.preemptions > 0
+        eng.sched.check_invariants()
+        assert eng.sched.allocator.free_pages == pc.num_pages - 1
+
+    def test_preempted_request_resumes_identically(self, model):
+        """Preemption must not change WHAT a request generates — only
+        when: compare against an uncontended run."""
+        cfg, params = model
+        prompt = [11, 4, 8, 2, 6, 13]
+        solo = GenerationEngine(cfg, params, tiny_page_cfg(cfg), eos_id=1)
+        want = solo.run(
+            [Request(prompt=list(prompt), max_new_tokens=16)],
+            clock=VirtualClock()).completed[0].generated
+        pc = tiny_page_cfg(cfg, num_pages=13, max_slots=4,
+                           pages_per_slot=12)
+        eng = GenerationEngine(cfg, params, pc, eos_id=1)
+        reqs = [Request(prompt=list(prompt), max_new_tokens=16)] + \
+            [Request(prompt=[3 + i] * 6, max_new_tokens=16)
+             for i in range(3)]
+        stats = eng.run(reqs, clock=VirtualClock())
+        assert stats.preemptions > 0
+        got = next(r for r in stats.completed
+                   if r.req_id == reqs[0].req_id).generated
+        assert got == want
+
+    def test_timeline_spans(self, model, tmp_path):
+        cfg, params = model
+        path = str(tmp_path / "serve_tl.json")
+        tl = hvd.start_timeline(path)
+        try:
+            pc = tiny_page_cfg(cfg)
+            eng = GenerationEngine(cfg, params, pc, eos_id=1)
+            eng.run([Request(prompt=[5, 6, 7], max_new_tokens=3)],
+                    clock=VirtualClock())
+        finally:
+            hvd.stop_timeline()
+        events = json.load(open(path))
+        names = [e["name"] for e in events]
+        assert any(n.startswith("SERVE:ADMIT") for n in names)
+        assert any(n.startswith("SERVE:EVICT") for n in names)
+        assert "SERVE:PREFILL" in names and "SERVE:DECODE" in names
+        for phase in ("SERVE:PREFILL", "SERVE:DECODE"):
+            b = sum(1 for e in events
+                    if e["name"] == phase and e["ph"] == "B")
+            e_ = sum(1 for e in events
+                     if e["name"] == phase and e["ph"] == "E")
+            assert b == e_ > 0
+
+
+# ---------------------------------------------------------------------------
+# Elastic replica groups
+
+
+class TestReplicas:
+    def test_resize_mid_trace_drops_nothing(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        rset = ReplicaSet(cfg, params, pc, n_replicas=2, eos_id=1)
+        trace = PoissonTrace(rate=50.0, num_requests=10, seed=3,
+                             prompt_len=(3, 8), max_new_tokens=(2, 6),
+                             vocab_size=cfg.vocab_size)
+        stats = rset.run(list(trace), clock=VirtualClock(0.05),
+                         resize_plan={4: 1, 8: 2})
+        assert len(stats.completed) == 10           # nothing dropped
+        assert len(rset.resize_events) == 2
+        assert rset.resize_events[0]["in_flight"] > 0   # drained, not idle
+        assert {e["to"] for e in rset.resize_events} == {1, 2}
+
+    def test_resize_preserves_generation(self, model):
+        """A request migrated across a resize generates the same tokens
+        as an undisturbed run (drain replays the folded prompt)."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        prompt = [7, 3, 12, 5]
+        solo = GenerationEngine(cfg, params, pc, eos_id=1)
+        want = solo.run([Request(prompt=list(prompt), max_new_tokens=8)],
+                        clock=VirtualClock()).completed[0].generated
+        rset = ReplicaSet(cfg, params, pc, n_replicas=2, eos_id=1)
+        req = Request(prompt=list(prompt), max_new_tokens=8,
+                      arrival_time=0.0)
+        stats = rset.run([req], clock=VirtualClock(),
+                         resize_plan={3: 1})
+        done = stats.completed[0]
+        assert done.resizes >= 1
+        assert done.generated == want
+
+    def test_autoscaler_follows_discovery_and_queue(self, model):
+        from horovod_tpu.elastic.discovery import HostDiscovery
+
+        class MutableHosts(HostDiscovery):
+            """What the elastic driver's discover loop would see from a
+            discovery script as device groups come and go."""
+
+            def __init__(self, hosts):
+                self.hosts = dict(hosts)
+
+            def find_available_hosts_and_slots(self):
+                return dict(self.hosts)
+
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        rset = ReplicaSet(cfg, params, pc, n_replicas=2, eos_id=1)
+        hosts = MutableHosts({"group0": 1, "group1": 1})
+        auto = ReplicaAutoscaler(rset, hosts, min_replicas=1,
+                                 max_replicas=2, scale_up_depth=2,
+                                 scale_down_depth=1)
+        # discovery loses a group -> forced scale-down (drain, no drop)
+        hosts.hosts = {"group0": 1}
+        for req in PoissonTrace(rate=100.0, num_requests=6, seed=4,
+                                prompt_len=(3, 6), max_new_tokens=(2, 4),
+                                vocab_size=cfg.vocab_size):
+            rset.submit(req)
+        auto.poll(0.0)
+        assert rset.n_replicas == 1
+        # group comes back + queue pressure -> scale-up
+        hosts.hosts = {"group0": 1, "group1": 1}
+        auto.poll(1.0)
+        assert rset.n_replicas == 2
+        stats = rset.run(clock=VirtualClock(0.05))
+        assert len(stats.completed) == 6
+
+
+# ---------------------------------------------------------------------------
+# PoissonTrace determinism
+
+
+def test_poisson_trace_deterministic_and_sorted():
+    a = PoissonTrace(rate=5.0, num_requests=20, seed=9)
+    b = PoissonTrace(rate=5.0, num_requests=20, seed=9)
+    ta = [(r.arrival_time, r.prompt, r.max_new_tokens) for r in a]
+    tb = [(r.arrival_time, r.prompt, r.max_new_tokens) for r in b]
+    assert ta == tb
+    times = [r.arrival_time for r in a]
+    assert times == sorted(times) and times[0] > 0
+    assert all(1 not in r.prompt for r in a)    # never the eos id

@@ -20,6 +20,31 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "tf_worker.py")
 
 
+@pytest.fixture(autouse=True)
+def _tf_state_isolation():
+    """Order-independence guard for the tf.function tests.
+
+    ``tf.function`` tracing depends on process-global state that earlier
+    tier-1 tests can leak: ``tf.config.run_functions_eagerly`` toggles
+    (keras fits flip it), a dangling default FuncGraph from a test that
+    died inside a ``graph.as_default()`` context, and the per-function
+    autograph conversion allowlist — the source of the pre-PR-5
+    order-dependent ``test_allreduce_in_tf_function`` flake, which never
+    reproduced in isolation. Pin the state before every test in this
+    module and restore the caller's afterwards.
+    """
+    was_eager_fns = tf.config.functions_run_eagerly()
+    tf.config.run_functions_eagerly(False)
+    # A leaked graph-mode default context would silently reroute every
+    # hvd_tf op through the graph path — fail loudly instead, naming the
+    # leak, rather than flaking on whatever that path returns.
+    assert tf.executing_eagerly(), (
+        "a previous test left a graph context as default; tf.function "
+        "tests cannot run order-independently")
+    yield
+    tf.config.run_functions_eagerly(was_eager_fns)
+
+
 class TestOpsSingleProcess:
     def test_allreduce_identity(self):
         t = tf.range(6, dtype=tf.float32)
@@ -38,7 +63,12 @@ class TestOpsSingleProcess:
         assert np.allclose(g.numpy(), 1.0)
 
     def test_allreduce_in_tf_function(self):
-        @tf.function
+        # autograph=False: the body is pure TF ops (no python control
+        # flow), so the autograph source-conversion machinery — whose
+        # per-process caches made this test order-dependent — has nothing
+        # to contribute and is excluded outright; _tf_state_isolation
+        # guards the rest of the global tracing state.
+        @tf.function(autograph=False)
         def f(t):
             return hvd_tf.allreduce(t, op=hvd_tf.Sum)
 
