@@ -63,6 +63,26 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def metrics_snapshot(prefixes=("comm.", "step.", "optimizer.")):
+    """Registry snapshot filtered to the bench-relevant metric families —
+    the ``metrics_snapshot`` field every A/B leg embeds in its JSON line
+    (docs/observability.md). Also flushes the configured sinks, so a run
+    with HOROVOD_METRICS_JSONL set leaves a joinable artifact for
+    scripts/obs_report.py."""
+    from horovod_tpu import monitor
+
+    monitor.flush()
+    snap = monitor.snapshot()
+
+    def _filt(d):
+        return {k: v for k, v in sorted(d.items())
+                if k.startswith(tuple(prefixes))}
+
+    return {"counters": _filt(snap["counters"]),
+            "gauges": _filt(snap["gauges"]),
+            "histograms": _filt(snap["histograms"])}
+
+
 # Peak dense bf16 FLOP/s per chip, keyed by substrings of
 # jax.Device.device_kind (public TPU spec sheet numbers).
 _PEAK_BF16_TFLOPS = [
@@ -597,6 +617,16 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
             f"{median_step * 1e3:.2f} ms, min {min(step_times) * 1e3:.2f} ms, "
             f"peak {peak / 1e12:.0f} TFLOP/s/chip)")
 
+    # Unified observability: the measured step times feed the registry's
+    # log2 latency histogram, and the leg's result row carries a metrics
+    # snapshot (wire bytes per hop from the traced program, per-bucket
+    # histograms, hidden fraction) for the JSON artifact.
+    from horovod_tpu import monitor
+
+    step_hist = monitor.metrics().histogram("step.time_ms")
+    for st in step_times:
+        step_hist.observe(st * 1e3)
+
     return {
         "per_chip": per_chip,
         "unit": unit,
@@ -612,6 +642,7 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         "wire_bytes_overlap": wire.overlap_bytes,
         "comm_hidden_fraction": wire.hidden_fraction,
         "opt_state_bytes_per_rank": opt_state_bytes_per_rank,
+        "metrics": metrics_snapshot(),
     }
 
 
@@ -759,6 +790,15 @@ def run_serve(args, devices, platform, mesh_shape):
         f"{len(rset.resize_events)} resizes")
     if dropped:
         raise SystemExit(f"serve trace DROPPED {dropped} requests")
+    # Unified observability: publish the trace-level gauges the engine
+    # counters cannot derive (goodput is completed-requests-only), then
+    # embed the serve+comm snapshot in the JSON artifact.
+    from horovod_tpu import monitor
+
+    monitor.metrics().gauge("serve.goodput_tokens_per_sec").set(
+        stats.goodput_tokens_per_sec())
+    monitor.metrics().gauge("serve.tokens_per_sec").set(
+        stats.tokens_per_sec())
     print(json.dumps({
         "metric": "gpt_serve_goodput_tokens_per_sec",
         "value": round(stats.goodput_tokens_per_sec(), 2),
@@ -787,6 +827,7 @@ def run_serve(args, devices, platform, mesh_shape):
         "num_pages": num_pages,
         "max_slots": max_slots,
         "decode_parity_max_err": parity_err,
+        "metrics_snapshot": metrics_snapshot(prefixes=("serve.", "comm.")),
     }), flush=True)
 
 
@@ -1173,6 +1214,7 @@ def main():
                        "mfu": (round(r["mfu"], 4)
                                if r["mfu"] is not None else None)}
                       for r in rows],
+            "metrics_snapshot": final["metrics"],
             **gpt_fields,
         }), flush=True)
         return
@@ -1221,6 +1263,7 @@ def main():
                            if mesh_shape else None),
             "baseline_per_chip": round(res_d["per_chip"], 2),
             "throughput_delta": round(delta, 4),
+            "metrics_snapshot": res_t["metrics"],
             **gpt_fields,
         }), flush=True)
         return
@@ -1286,6 +1329,7 @@ def main():
             "wire_bytes_overlap": round(res_o["wire_bytes_overlap"], 1),
             "wire_bytes_ici": round(res_o["wire_bytes_ici"], 1),
             "wire_bytes_dcn": round(res_o["wire_bytes_dcn"], 1),
+            "metrics_snapshot": res_o["metrics"],
             **gpt_fields,
         }), flush=True)
         return
@@ -1337,6 +1381,7 @@ def main():
             "wire_bytes_dcn": round(res_z["wire_bytes_dcn"], 1),
             "wire_bytes_ici_baseline": round(res_b["wire_bytes_ici"], 1),
             "wire_bytes_dcn_baseline": round(res_b["wire_bytes_dcn"], 1),
+            "metrics_snapshot": res_z["metrics"],
             **gpt_fields,
         }), flush=True)
         return
@@ -1382,6 +1427,7 @@ def main():
             # (EQuARX's "~4x wire bytes" accounting).
             "wire_reduction_dcn": (round(res_q["wire_reduction_dcn"], 3)
                                    if res_q["wire_reduction_dcn"] else None),
+            "metrics_snapshot": res_q["metrics"],
             **gpt_fields,
         }), flush=True)
         return
@@ -1427,6 +1473,7 @@ def main():
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": res["chips"],
         "per_chip_batch": args.batch_size,
+        "metrics_snapshot": res["metrics"],
         **gpt_fields,
         **({"note": (
             "HBM-roofline bound: profiled device busy time runs at "

@@ -173,6 +173,12 @@ from .autotune import (  # noqa: F401
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
 from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
+from . import monitor  # noqa: F401  (metrics registry / sinks / span audit)
+from .monitor import (  # noqa: F401
+    metrics,
+    profile_window,
+    stalled_tensors,
+)
 
 from jax.sharding import PartitionSpec as _P
 

@@ -265,6 +265,13 @@ def init(
             _state.timeline = Timeline(_state.config.timeline,
                                        mark_cycles=_state.config.timeline_mark_cycles)
         _state.initialized = True
+        # Observability layer: metric sinks (JSONL / Prometheus / timeline
+        # mirrors) and the live StallInspector watchdog. The registry
+        # itself is process-global and survives shutdown→init cycles
+        # (docs/observability.md).
+        from .. import monitor
+
+        monitor.start_from_env(_state.config)
     # Outside the lock (uses eager collectives): multi-host runs verify
     # that every host loaded an identical kernel-autotune cache before
     # any cached block choice may shape a compiled program.
@@ -306,6 +313,13 @@ def shutdown() -> None:
     again afterwards (the elastic reset path relies on this,
     common/elastic.py:147-168)."""
     _warn_autotune_unused(_state.config)
+    if _state.initialized:
+        # Before the timeline closes: final metric flush (the timeline
+        # mirror rides it), stop the stall watchdog / reporter / endpoint.
+        # Registry values persist into the next incarnation.
+        from .. import monitor
+
+        monitor.on_shutdown()
     with _state.lock:
         if _state.timeline is not None:
             _state.timeline.close()

@@ -94,6 +94,12 @@ class Config:
     timeline: Optional[str] = None
     timeline_mark_cycles: bool = False
 
+    # --- metrics registry / sinks (docs/observability.md) ---
+    metrics_jsonl: Optional[str] = None  # snapshot JSONL sink path
+    metrics_port: Optional[int] = None   # Prometheus endpoint (0 = any port)
+    metrics_interval: float = 0.0        # reporter period secs (0 = off)
+    metrics_aggregate: bool = False      # cross-rank aggregate per interval
+
     # --- stall inspector (stall_inspector.h:36-66) ---
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
@@ -149,6 +155,10 @@ def from_env() -> Config:
         ),
         timeline=_env_str("HOROVOD_TIMELINE", None),
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
+        metrics_jsonl=_env_str("HOROVOD_METRICS_JSONL", None),
+        metrics_port=_opt_int("HOROVOD_METRICS_PORT"),
+        metrics_interval=_env_float("HOROVOD_METRICS_INTERVAL", 0.0),
+        metrics_aggregate=_env_bool("HOROVOD_METRICS_AGGREGATE", False),
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE", False),
         stall_warning_time_seconds=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
         stall_shutdown_time_seconds=_env_float(

@@ -37,6 +37,7 @@ def increment(name: str, n: int = 1,
         _incarnation[name] = _incarnation.get(name, 0) + n
         _total[name] = _total.get(name, 0) + n
     _emit_timeline(name, attrs)
+    _emit_registry(name, n)
 
 
 def _emit_timeline(name: str, attrs: Optional[dict]) -> None:
@@ -50,6 +51,19 @@ def _emit_timeline(name: str, attrs: Optional[dict]) -> None:
         return
     if tl is not None:
         tl.instant(f"FAULT:{name}", tid="faults", args=attrs)
+
+
+def _emit_registry(name: str, n: int) -> None:
+    """Mirror into the unified metrics registry (monitor/), which keeps
+    the process-lifetime monotone view and feeds the metric sinks. Stays
+    lazy + guarded for the same launcher-importability reason as the
+    timeline mirror (monitor.registry itself is stdlib-only)."""
+    try:
+        from ..monitor import registry as _mon
+
+        _mon.counter(name).inc(n)
+    except Exception:  # pragma: no cover - partial interpreter teardown
+        return
 
 
 def get(name: str, total: bool = False) -> int:

@@ -497,6 +497,14 @@ class ElasticDriver:
                                          self._max_np or total)
             self._world_id += 1
             self._touch_progress()
+            # Unified observability: world transitions are a first-class
+            # metric (docs/observability.md), alongside the FAULT:*
+            # counters this driver already mirrors onto the Timeline.
+            from ..monitor import registry as _metrics
+
+            _metrics.counter("elastic.world_transitions").inc()
+            _metrics.gauge("elastic.world_id").set(self._world_id)
+            _metrics.gauge("elastic.world_size").set(len(slots))
             if not initial:
                 self._registry.increment_reset_count()
             self._registry.reset()

@@ -1,0 +1,87 @@
+"""Unified observability layer: metrics registry, StallInspector, sinks.
+
+The reference's production posture rests on three pillars — the Timeline,
+the StallInspector (stall_inspector.{h,cc}), and coordinator-side counters
+(operations.cc status queries). This package unifies our reproduction's
+scattered telemetry (Timeline ``FAULT:*``/``AUTOTUNE:*``/``OVERLAP:*``/
+``SERVE:*`` events, ``fault_counters()``, trace-time ``record_wire_stats``)
+behind one typed registry with cross-rank aggregation and pluggable sinks:
+
+* :mod:`.registry` — counters / gauges / histograms (fixed log2 buckets),
+  label support, one-fused-allreduce cross-rank aggregation piggybacked on
+  the existing collective stack (off the step's critical path);
+* :mod:`.sinks` — JSONL snapshots (``HOROVOD_METRICS_JSONL``), a
+  Prometheus text-format endpoint (``HOROVOD_METRICS_PORT``), Timeline
+  counter (``ph:"C"``) mirrors, and the interval reporter thread
+  (``HOROVOD_METRICS_INTERVAL``);
+* :mod:`.stall` — the live StallInspector: a watchdog over in-flight
+  eager collectives and serve requests that emits rank-attributed
+  warnings with the reference's warning structure, ``STALL:*`` timeline
+  instants, and the ``hvd.stalled_tensors()`` API;
+* :mod:`.profile` — host/device trace correlation:
+  ``hvd.profile_window(num_steps)`` brackets a ``jax.profiler`` trace
+  with the Timeline and per-step ``StepTraceAnnotation`` markers;
+* :mod:`.span_audit` — B/E span-balance auditing over Timeline files
+  (the test helper and the ``scripts/obs_report.py`` phase breakdown).
+
+The registry is enabled by default (``HOROVOD_METRICS_DISABLE=1`` turns
+every record into a no-op); its lifecycle rides ``hvd.init()`` /
+``hvd.shutdown()`` but its VALUES survive the elastic shutdown→init
+cycle, so an elastic job reads process-lifetime monotone counters across
+world incarnations. See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    default_registry,
+    gauge,
+    histogram,
+    metrics_enabled,
+)
+from .sinks import (  # noqa: F401
+    JsonlSink,
+    PrometheusSink,
+    TimelineSink,
+)
+from .stall import (  # noqa: F401
+    StallInspector,
+    stall_inspector,
+    stalled_tensors,
+)
+from .profile import profile_window  # noqa: F401
+from .span_audit import SpanAudit, audit_spans  # noqa: F401
+
+from . import lifecycle as _lifecycle
+
+
+def metrics() -> MetricsRegistry:
+    """The process-global metrics registry (``hvd.metrics()``)."""
+    return default_registry()
+
+
+def snapshot(prefix: str = "") -> dict:
+    """One registry snapshot dict (optionally filtered to ``prefix``)."""
+    return default_registry().snapshot(prefix=prefix)
+
+
+def aggregate(prefix: str = "") -> dict:
+    """Cross-rank aggregated snapshot: one small fused allreduce over the
+    process world (identity in a world of one / before init)."""
+    return default_registry().aggregate(prefix=prefix)
+
+
+def flush() -> None:
+    """Push one snapshot through every configured sink now."""
+    _lifecycle.flush()
+
+
+# init()/shutdown() hooks (wired from common/basics.py).
+start_from_env = _lifecycle.start_from_env
+on_shutdown = _lifecycle.on_shutdown
+add_sink = _lifecycle.add_sink

@@ -1,0 +1,124 @@
+"""Monitor lifecycle: wired into ``hvd.init()`` / ``hvd.shutdown()``.
+
+``start_from_env()`` (from ``basics.init``) arms the observability layer
+per the Config's knobs:
+
+* ``HOROVOD_METRICS_JSONL=<path>``   — snapshot JSONL sink;
+* ``HOROVOD_METRICS_PORT=<port>``    — Prometheus text endpoint (0 = any);
+* ``HOROVOD_METRICS_INTERVAL=<s>``   — reporter thread period (0 = only
+  flush at shutdown);
+* ``HOROVOD_METRICS_AGGREGATE=1``    — reporter snapshots are cross-rank
+  aggregated (one small fused allreduce per interval);
+* stall knobs (``HOROVOD_STALL_CHECK_*``) — the live StallInspector
+  watchdog, on by default like the reference.
+
+``on_shutdown()`` (from ``basics.shutdown``) flushes one final snapshot,
+then stops the watchdog / reporter / HTTP server. Registry VALUES are
+never cleared — the next ``init()`` (an elastic world transition) bumps
+``elastic.incarnations`` and re-arms exporters against the same registry,
+so counters stay monotone across incarnations.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from . import registry as _registry
+from . import sinks as _sinks
+from . import stall as _stall
+
+_lock = threading.Lock()
+_active_sinks: List = []
+_reporter: Optional[_sinks.Reporter] = None
+_timeline_sink = _sinks.TimelineSink()
+_prom: Optional[_sinks.PrometheusSink] = None
+_inits = 0
+
+
+def prometheus_port() -> Optional[int]:
+    """Bound port of the live Prometheus endpoint (None when off)."""
+    return _prom.port if _prom is not None else None
+
+
+def add_sink(sink) -> None:
+    """Register an extra snapshot sink (tests, embedders)."""
+    with _lock:
+        _active_sinks.append(sink)
+
+
+def start_from_env(config) -> None:
+    """Arm sinks + stall watchdog from the Config (idempotent)."""
+    global _reporter, _prom, _inits
+    reg = _registry.default_registry()
+    with _lock:
+        _inits += 1
+        if _inits > 1:
+            # Elastic shutdown→init cycle: a world transition on the SAME
+            # persistent registry (the resize-survival contract).
+            reg.counter("elastic.incarnations").inc()
+        if config is None or not reg.enabled:
+            return
+        if config.metrics_jsonl and not any(
+                isinstance(s, _sinks.JsonlSink)
+                and s.path == config.metrics_jsonl
+                for s in _active_sinks):
+            _active_sinks.append(_sinks.JsonlSink(config.metrics_jsonl))
+        if config.metrics_port is not None and _prom is None:
+            _prom = _sinks.PrometheusSink(reg, config.metrics_port)
+            _active_sinks.append(_prom)
+        if config.metrics_interval > 0 and _reporter is None:
+            _reporter = _sinks.Reporter(
+                reg, _active_sinks + [_timeline_sink],
+                config.metrics_interval,
+                aggregate=config.metrics_aggregate)
+    insp = _stall.stall_inspector()
+    if not config.stall_check_disable:
+        insp.warning_secs = config.stall_warning_time_seconds
+        insp.shutdown_secs = config.stall_shutdown_time_seconds
+        insp.check_interval = min(
+            max(insp.warning_secs / 4.0, 0.05), 5.0)
+        insp.start()
+    else:
+        insp.stop()
+
+
+def flush() -> None:
+    """Push one snapshot through every sink (timeline mirror included)."""
+    snap = _registry.default_registry().snapshot()
+    with _lock:
+        targets = list(_active_sinks)
+    for s in targets + [_timeline_sink]:
+        try:
+            s.write(snap)
+        except Exception:  # export must never take the job down
+            pass
+
+
+def on_shutdown() -> None:
+    """Final flush, then stop watchdog / reporter / HTTP server. Values
+    persist in the registry for the next incarnation."""
+    global _reporter, _prom
+    _stall.stall_inspector().stop()
+    flush()
+    with _lock:
+        if _reporter is not None:
+            _reporter.close()
+            _reporter = None
+        if _prom is not None:
+            try:
+                _prom.close()
+            except Exception:
+                pass
+            if _prom in _active_sinks:
+                _active_sinks.remove(_prom)
+            _prom = None
+
+
+def _reset_for_tests() -> None:
+    """Tear everything down AND forget sink registrations (tests only)."""
+    global _reporter, _prom, _inits
+    on_shutdown()
+    with _lock:
+        _active_sinks.clear()
+        _inits = 0

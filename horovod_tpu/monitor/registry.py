@@ -1,0 +1,313 @@
+"""Typed metrics registry: counters, gauges, log2-bucket histograms.
+
+Design notes
+------------
+* **Stdlib-only module.** The launcher/driver processes record metrics
+  too (same contract as :mod:`horovod_tpu.common.counters`), so importing
+  this module must not drag jax/framework state along; the one collective
+  call (:meth:`MetricsRegistry.aggregate`) imports lazily.
+* **Monotone counters.** ``Counter.inc`` rejects negative deltas, so a
+  chaotic run can assert ``counters stay monotone`` as an invariant.
+* **Fixed log2 buckets.** Every histogram shares the same 32-bucket
+  layout (upper bounds ``2^0 .. 2^30`` plus +Inf), so cross-rank
+  aggregation is a pure element-wise sum — no bucket-boundary
+  renegotiation, and one histogram is 34 numbers on the wire.
+* **Process-lifetime values.** The registry is never cleared by
+  ``hvd.shutdown()`` — an elastic job reads monotone counters across
+  world incarnations (:mod:`horovod_tpu.common.counters` keeps the
+  per-incarnation view).
+* **Cross-rank aggregation piggybacks on the collective stack**: one
+  fused eager allreduce of the flat value vector per call, explicitly
+  named (so it never perturbs the auto-name alignment of user
+  collectives), run from the reporter thread — off the step's critical
+  path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+#: Histogram bucket upper bounds: 2^0 .. 2^30, then +Inf. Fixed for every
+#: histogram so aggregation is element-wise and the wire layout is static.
+LOG2_BUCKET_BOUNDS: Tuple[float, ...] = tuple(
+    float(2 ** i) for i in range(31)) + (float("inf"),)
+NUM_BUCKETS = len(LOG2_BUCKET_BOUNDS)
+
+
+def _bucket_index(value: float) -> int:
+    """Index of the first bucket whose upper bound is >= value."""
+    if value <= 1.0:
+        return 0
+    # bit_length of the ceil'd integer is a branch-free log2 ceiling.
+    v = int(value) if float(value).is_integer() else int(value) + 1
+    idx = max(0, (v - 1).bit_length())
+    return min(idx, NUM_BUCKETS - 1)
+
+
+def _key(name: str, labels: Dict[str, str]) -> str:
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+class Counter:
+    """Monotone counter. ``inc(n)`` with ``n >= 0`` only."""
+
+    kind = "counter"
+
+    def __init__(self, registry: "MetricsRegistry", key: str) -> None:
+        self._registry = registry
+        self.key = key
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.key} cannot decrease (n={n})")
+        if not self._registry.enabled:
+            return
+        with self._registry._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Last-value gauge (queue depth, hidden fraction, replica count)."""
+
+    kind = "gauge"
+
+    def __init__(self, registry: "MetricsRegistry", key: str) -> None:
+        self._registry = registry
+        self.key = key
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._registry._lock:
+            self._value = float(v)
+
+    def add(self, n: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._registry._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Histogram over the fixed :data:`LOG2_BUCKET_BOUNDS` layout."""
+
+    kind = "histogram"
+
+    def __init__(self, registry: "MetricsRegistry", key: str) -> None:
+        self._registry = registry
+        self.key = key
+        self.counts: List[int] = [0] * NUM_BUCKETS
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        if not self._registry.enabled:
+            return
+        with self._registry._lock:
+            self.counts[_bucket_index(value)] += 1
+            self.sum += float(value)
+            self.count += 1
+
+    def quantile_bound(self, q: float) -> Optional[float]:
+        """Upper bucket bound at or above quantile ``q`` (None if empty)."""
+        if self.count == 0:
+            return None
+        target = q * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= target:
+                return LOG2_BUCKET_BOUNDS[i]
+        return LOG2_BUCKET_BOUNDS[-1]
+
+
+class MetricsRegistry:
+    """Thread-safe name→metric table with typed get-or-create access."""
+
+    def __init__(self, enabled: Optional[bool] = None) -> None:
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, object] = {}
+        if enabled is None:
+            enabled = os.environ.get("HOROVOD_METRICS_DISABLE", "") not in (
+                "1", "true", "yes", "on")
+        self.enabled = enabled
+        self._aggregate_seq = 0
+
+    # -- typed get-or-create -------------------------------------------
+
+    def _get(self, cls, name: str, labels: Dict[str, str]):
+        key = _key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(self, key)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {key!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def metrics(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._metrics)
+
+    # -- snapshots ------------------------------------------------------
+
+    def snapshot(self, prefix: str = "") -> dict:
+        """Point-in-time snapshot: one dict per metric kind, keys are
+        ``name{label=value,...}`` strings — the JSONL sink's line schema
+        and the shape ``scripts/obs_report.py`` consumes."""
+        with self._lock:
+            counters = {k: m.value for k, m in self._metrics.items()
+                        if isinstance(m, Counter) and k.startswith(prefix)}
+            gauges = {k: m.value for k, m in self._metrics.items()
+                      if isinstance(m, Gauge) and k.startswith(prefix)}
+            hists = {k: {"counts": list(m.counts), "sum": m.sum,
+                         "count": m.count}
+                     for k, m in self._metrics.items()
+                     if isinstance(m, Histogram) and k.startswith(prefix)}
+        return {
+            "ts": time.time(),
+            "kind": "metrics",
+            "world": 1,
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": hists,
+        }
+
+    # -- cross-rank aggregation ----------------------------------------
+
+    def _flat_layout(self, snap: dict) -> Tuple[List[str], List[float]]:
+        keys: List[str] = []
+        vals: List[float] = []
+        for k in sorted(snap["counters"]):
+            keys.append(f"c:{k}")
+            vals.append(snap["counters"][k])
+        for k in sorted(snap["gauges"]):
+            keys.append(f"g:{k}")
+            vals.append(snap["gauges"][k])
+        for k in sorted(snap["histograms"]):
+            h = snap["histograms"][k]
+            keys.append(f"h:{k}")
+            vals.extend(h["counts"])
+            vals.append(h["sum"])
+            vals.append(h["count"])
+        return keys, vals
+
+    def aggregate(self, prefix: str = "") -> dict:
+        """Cross-rank SUM of the snapshot as ONE fused eager allreduce.
+
+        Piggybacks on the existing collective stack (the native
+        controller's process-world data plane): the whole registry rides
+        a single flat float64 vector. The collective name carries the
+        vector length and a schema digest, so ranks whose metric sets
+        diverged fail loudly (name/shape mismatch in the negotiation)
+        instead of silently misaligning values. Identity (world of one)
+        before ``hvd.init()`` or under single-controller SPMD.
+
+        Gauges aggregate as sums too (one wire op); the returned
+        ``world`` field lets consumers divide for means.
+        """
+        snap = self.snapshot(prefix=prefix)
+        try:
+            from ..common import basics
+            from ..ops import collective_ops
+
+            if not basics.is_initialized():
+                return snap
+            world = collective_ops._eager_world()
+        except Exception:
+            return snap
+        snap["world"] = world
+        if world <= 1:
+            return snap
+        import hashlib
+
+        import numpy as np
+
+        keys, vals = self._flat_layout(snap)
+        digest = hashlib.md5("|".join(keys).encode()).hexdigest()[:10]
+        with self._lock:
+            seq = self._aggregate_seq
+            self._aggregate_seq += 1
+        vec = np.asarray(vals, dtype=np.float64)
+        red = collective_ops.allreduce(
+            vec, op=collective_ops.ReduceOp.SUM,
+            name=f"monitor.aggregate.{seq}.{len(vec)}.{digest}")
+        red = np.asarray(red)
+        out = dict(snap)
+        counters, gauges, hists = {}, {}, {}
+        i = 0
+        for key in keys:
+            tag, k = key.split(":", 1)
+            if tag == "c":
+                counters[k] = float(red[i]); i += 1
+            elif tag == "g":
+                gauges[k] = float(red[i]); i += 1
+            else:
+                counts = [int(x) for x in red[i:i + NUM_BUCKETS]]
+                i += NUM_BUCKETS
+                hists[k] = {"counts": counts, "sum": float(red[i]),
+                            "count": int(red[i + 1])}
+                i += 2
+        out["counters"], out["gauges"], out["histograms"] = (
+            counters, gauges, hists)
+        return out
+
+
+_default: Optional[MetricsRegistry] = None
+_default_lock = threading.Lock()
+
+
+def default_registry() -> MetricsRegistry:
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = MetricsRegistry()
+    return _default
+
+
+def metrics_enabled() -> bool:
+    return default_registry().enabled
+
+
+# Module-level shortcuts against the default registry (the handles are
+# cached by hot call sites; these are the cold-path conveniences).
+
+def counter(name: str, **labels: str) -> Counter:
+    return default_registry().counter(name, **labels)
+
+
+def gauge(name: str, **labels: str) -> Gauge:
+    return default_registry().gauge(name, **labels)
+
+
+def histogram(name: str, **labels: str) -> Histogram:
+    return default_registry().histogram(name, **labels)

@@ -1,0 +1,192 @@
+"""Metric sinks: JSONL snapshots, Prometheus endpoint, Timeline mirrors.
+
+Every sink consumes the :meth:`MetricsRegistry.snapshot` dict schema; the
+reporter thread (:class:`Reporter`) pushes one snapshot per interval —
+and, when enabled, one cross-rank :meth:`~MetricsRegistry.aggregate` —
+keeping all exporting off the training step's critical path.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .registry import LOG2_BUCKET_BOUNDS, MetricsRegistry
+
+
+class JsonlSink:
+    """Append one JSON line per snapshot to ``path`` (the artifact
+    ``scripts/obs_report.py`` joins against the Timeline)."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+
+    def write(self, snapshot: dict) -> None:
+        line = json.dumps(snapshot, sort_keys=True)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def close(self) -> None:
+        pass
+
+
+class TimelineSink:
+    """Mirror gauges/counters onto the active Timeline as Chrome counter
+    events (``ph:"C"``, name ``METRIC:<metric>``) — the trace-view
+    rendering of the registry, plotted as per-series area charts right
+    above the span rows they explain."""
+
+    def write(self, snapshot: dict) -> None:
+        from ..common import basics
+
+        tl = basics._state.timeline
+        if tl is None:
+            return
+        for key, v in snapshot["counters"].items():
+            tl.counter(f"METRIC:{key}", {"value": v})
+        for key, v in snapshot["gauges"].items():
+            tl.counter(f"METRIC:{key}", {"value": v})
+        for key, h in snapshot["histograms"].items():
+            tl.counter(f"METRIC:{key}", {"count": h["count"],
+                                         "sum": h["sum"]})
+
+    def close(self) -> None:
+        pass
+
+
+# -- Prometheus text format -------------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(key: str) -> str:
+    """``name{a=b}`` snapshot key → (metric_name, label_string)."""
+    if "{" in key:
+        name, rest = key.split("{", 1)
+        labels = rest.rstrip("}")
+        parts = []
+        for pair in labels.split(","):
+            if not pair:
+                continue
+            k, _, v = pair.partition("=")
+            parts.append(f'{_NAME_RE.sub("_", k)}="{v}"')
+        label_str = "{" + ",".join(parts) + "}"
+    else:
+        name, label_str = key, ""
+    return "horovod_" + _NAME_RE.sub("_", name), label_str
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Render a snapshot in the Prometheus exposition text format."""
+    lines = []
+    seen_types = set()
+
+    def typeline(name: str, kind: str) -> None:
+        if name not in seen_types:
+            lines.append(f"# TYPE {name} {kind}")
+            seen_types.add(name)
+
+    for key, v in sorted(snapshot["counters"].items()):
+        name, labels = _prom_name(key)
+        typeline(name, "counter")
+        lines.append(f"{name}{labels} {v:g}")
+    for key, v in sorted(snapshot["gauges"].items()):
+        name, labels = _prom_name(key)
+        typeline(name, "gauge")
+        lines.append(f"{name}{labels} {v:g}")
+    for key, h in sorted(snapshot["histograms"].items()):
+        name, labels = _prom_name(key)
+        typeline(name, "histogram")
+        inner = labels[1:-1] if labels else ""
+        cum = 0
+        for bound, c in zip(LOG2_BUCKET_BOUNDS, h["counts"]):
+            cum += c
+            le = "+Inf" if bound == float("inf") else f"{bound:g}"
+            sep = "," if inner else ""
+            lines.append(
+                f'{name}_bucket{{{inner}{sep}le="{le}"}} {cum}')
+        lines.append(f"{name}_sum{labels} {h['sum']:g}")
+        lines.append(f"{name}_count{labels} {h['count']}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusSink:
+    """Serve the live registry at ``http://:port/metrics``
+    (``HOROVOD_METRICS_PORT``; port 0 binds an OS-assigned port exposed
+    as ``.port``). Renders at request time — ``write`` is a no-op."""
+
+    def __init__(self, registry: MetricsRegistry, port: int) -> None:
+        sink = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                body = render_prometheus(
+                    sink.registry.snapshot()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-request stderr spam
+                pass
+
+        self.registry = registry
+        self._server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="hvd-metrics-http", daemon=True)
+        self._thread.start()
+
+    def write(self, snapshot: dict) -> None:
+        pass  # pull-model sink: rendered per scrape
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+
+
+class Reporter:
+    """Interval reporter thread: every ``interval`` seconds take one
+    snapshot (cross-rank aggregated when ``aggregate`` is on) and push it
+    through the configured sinks — one small fused allreduce per
+    reporting interval, off the step's critical path."""
+
+    def __init__(self, registry: MetricsRegistry, sinks, interval: float,
+                 aggregate: bool = False) -> None:
+        self.registry = registry
+        self.sinks = sinks
+        self.interval = interval
+        self.aggregate = aggregate
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-metrics-reporter", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:  # never kill the job over an export
+                pass
+
+    def flush(self) -> None:
+        snap = (self.registry.aggregate() if self.aggregate
+                else self.registry.snapshot())
+        for s in self.sinks:
+            s.write(snap)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,110 @@
+"""Span auditing over Timeline files: B/E balance and phase durations.
+
+One helper for the invariant every span-emitting subsystem must hold —
+each ``ph:"B"`` has a matching ``ph:"E"`` on the same tid and depth never
+goes negative — plus the per-activity duration accounting that
+``scripts/obs_report.py`` turns into the phase-time breakdown. Replaces
+the hand-rolled balance loops that used to live in ``tests/test_overlap``
+and ``tests/test_serve``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+
+class SpanImbalanceError(AssertionError):
+    """A tid's B/E events do not balance (or depth went negative)."""
+
+
+@dataclass
+class SpanAudit:
+    """Result of :func:`audit_spans`."""
+
+    #: spans fully closed, per tid
+    spans_per_tid: Dict[str, int] = field(default_factory=dict)
+    #: final (unclosed) depth per tid — all zero when balanced
+    open_depth: Dict[str, int] = field(default_factory=dict)
+    #: summed span duration (µs) per activity name
+    duration_us: Dict[str, float] = field(default_factory=dict)
+    #: span count per activity name
+    count: Dict[str, int] = field(default_factory=dict)
+    #: instant (ph:"i") events seen, per name
+    instants: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def balanced(self) -> bool:
+        return not any(self.open_depth.values())
+
+    @property
+    def total_spans(self) -> int:
+        return sum(self.spans_per_tid.values())
+
+    def by_phase(self) -> Dict[str, float]:
+        """Duration (µs) grouped by the ``PREFIX:`` before the first
+        colon (``OVERLAP``, ``SERVE``, ``PROFILE``, ...)."""
+        out: Dict[str, float] = {}
+        for name, us in self.duration_us.items():
+            phase = name.split(":", 1)[0] if ":" in name else name
+            out[phase] = out.get(phase, 0.0) + us
+        return out
+
+
+def load_events(source: Union[str, list]) -> list:
+    """Timeline events from a path or an already-loaded list."""
+    if isinstance(source, str):
+        with open(source) as f:
+            return json.load(f)
+    return list(source)
+
+
+def audit_spans(source: Union[str, list], prefix: Optional[str] = None,
+                require_balanced: bool = True,
+                require_spans: bool = False) -> SpanAudit:
+    """Audit B/E balance per tid over a Timeline file (or event list).
+
+    ``prefix`` restricts the audit to events whose name starts with it
+    (e.g. ``"OVERLAP"``, ``"SERVE:"``). With ``require_balanced`` (the
+    default) raises :class:`SpanImbalanceError` naming the offending tid
+    when any depth goes negative or fails to return to zero;
+    ``require_spans`` additionally demands at least one matching span
+    closed (guards against a filter that silently matched nothing).
+    """
+    events = load_events(source)
+    audit = SpanAudit()
+    stacks: Dict[str, List[Tuple[str, float]]] = {}
+    for ev in events:
+        name = str(ev.get("name", ""))
+        if prefix is not None and not name.startswith(prefix):
+            continue
+        tid = str(ev.get("tid", "main"))
+        ph = ev.get("ph")
+        if ph == "B":
+            stacks.setdefault(tid, []).append((name, ev.get("ts", 0.0)))
+        elif ph == "E":
+            stack = stacks.setdefault(tid, [])
+            if not stack:
+                raise SpanImbalanceError(
+                    f"tid {tid!r}: 'E' for {name!r} with no open 'B' "
+                    f"(negative depth)")
+            b_name, b_ts = stack.pop()
+            audit.spans_per_tid[tid] = audit.spans_per_tid.get(tid, 0) + 1
+            audit.duration_us[b_name] = (
+                audit.duration_us.get(b_name, 0.0)
+                + max(0.0, ev.get("ts", b_ts) - b_ts))
+            audit.count[b_name] = audit.count.get(b_name, 0) + 1
+        elif ph == "i":
+            audit.instants[name] = audit.instants.get(name, 0) + 1
+    for tid, stack in stacks.items():
+        audit.open_depth[tid] = len(stack)
+        if stack and require_balanced:
+            raise SpanImbalanceError(
+                f"tid {tid!r}: {len(stack)} span(s) never closed "
+                f"(first open: {stack[0][0]!r})")
+    if require_spans and audit.total_spans == 0:
+        raise SpanImbalanceError(
+            f"no spans matched prefix {prefix!r} "
+            f"({len(events)} events scanned)")
+    return audit

@@ -1,0 +1,244 @@
+"""Live StallInspector: watchdog over in-flight host-path operations.
+
+The Python port of the reference's coordinator-side stall inspector
+(``stall_inspector.{h,cc}``, mirrored in ``cc/src/stall_inspector.cc``):
+eager collectives and serve requests register on entry and deregister on
+completion; a watchdog thread wakes every fraction of
+``stall_check_time`` (HOROVOD_STALL_CHECK_TIME_SECONDS) and, for every
+operation in flight longer than the threshold, emits
+
+* a log warning with the reference's exact structure — which ranks are
+  ready, which are missing — attributed to this rank;
+* a ``STALL:<name>`` instant on the active Timeline (tid ``stalls``);
+* a ``stall.warnings`` bump in the metrics registry;
+
+and keeps the entry queryable through :func:`stalled_tensors`
+(``hvd.stalled_tensors()``). ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS > 0``
+escalates a stall past that deadline to an error log and a
+``stall.shutdowns`` counter (the abort itself stays the caller's call —
+under SPMD a unilateral ``os._exit`` would take the whole mesh down).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import registry as _registry
+
+logger = logging.getLogger("horovod_tpu.stall")
+
+
+class _Pending:
+    __slots__ = ("name", "kind", "rank", "start", "warned", "escalated")
+
+    def __init__(self, name: str, kind: str, rank: int) -> None:
+        self.name = name
+        self.kind = kind
+        self.rank = rank
+        self.start = time.monotonic()
+        self.warned = False
+        self.escalated = False
+
+
+def _world_and_rank(rank: Optional[int]):
+    """(world, this-rank) from the live framework state; (1, 0) when the
+    registry is used outside an initialized world (launcher, tests)."""
+    try:
+        from ..common import basics
+
+        if basics.is_initialized():
+            r = basics.rank() if rank is None else rank
+            return basics.size(), int(r)
+    except Exception:
+        pass
+    return 1, 0 if rank is None else rank
+
+
+class StallInspector:
+    """Tracks in-flight operations and warns about stalls.
+
+    ``warning_secs`` mirrors the reference's ``stall_check_time``
+    (stall_inspector.h:36-66); ``shutdown_secs=0`` disables escalation.
+    """
+
+    def __init__(self, warning_secs: float = 60.0,
+                 shutdown_secs: float = 0.0,
+                 check_interval: Optional[float] = None) -> None:
+        self.warning_secs = warning_secs
+        self.shutdown_secs = shutdown_secs
+        # Wake often enough that a warning lands within warning_secs of
+        # the stall crossing the threshold (the acceptance contract).
+        self.check_interval = (
+            min(max(warning_secs / 4.0, 0.05), 5.0)
+            if check_interval is None else check_interval)
+        self._lock = threading.Lock()
+        self._pending: Dict[str, _Pending] = {}
+        self._warnings: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- tracking (any thread) -----------------------------------------
+
+    def record_start(self, name: str, *, kind: str = "collective",
+                     rank: Optional[int] = None) -> None:
+        _, r = _world_and_rank(rank)
+        with self._lock:
+            self._pending[name] = _Pending(name, kind, r)
+
+    def record_done(self, name: str) -> None:
+        with self._lock:
+            self._pending.pop(name, None)
+
+    def track(self, name: str, *, kind: str = "collective",
+              rank: Optional[int] = None):
+        """Context manager: ``with inspector.track("eager.allreduce.0"):``."""
+        inspector = self
+
+        class _Tracked:
+            def __enter__(self):
+                inspector.record_start(name, kind=kind, rank=rank)
+                return self
+
+            def __exit__(self, *exc):
+                inspector.record_done(name)
+                return False
+
+        return _Tracked()
+
+    # -- inspection -----------------------------------------------------
+
+    def stalled(self) -> List[dict]:
+        """Operations currently in flight past ``warning_secs`` — the
+        ``hvd.stalled_tensors()`` payload."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {"name": p.name, "kind": p.kind, "rank": p.rank,
+                 "elapsed_secs": now - p.start}
+                for p in self._pending.values()
+                if now - p.start >= self.warning_secs]
+
+    def warnings(self) -> List[dict]:
+        with self._lock:
+            return list(self._warnings)
+
+    def in_flight(self) -> List[str]:
+        with self._lock:
+            return list(self._pending)
+
+    def check(self) -> List[dict]:
+        """One watchdog pass: warn (once) about every stalled entry."""
+        now = time.monotonic()
+        fired = []
+        with self._lock:
+            pend = [p for p in self._pending.values()
+                    if now - p.start >= self.warning_secs]
+        for p in pend:
+            waited = now - p.start
+            if not p.warned:
+                p.warned = True
+                world, _ = _world_and_rank(p.rank)
+                ready = [p.rank]
+                missing = [r for r in range(world) if r != p.rank]
+                # The reference's warning structure
+                # (stall_inspector.cc:43-49), rank-attributed.
+                msg = (
+                    "One or more tensors were submitted to be reduced, "
+                    "gathered or broadcasted by subset of ranks and are "
+                    "waiting for remainder of ranks for more than "
+                    f"{self.warning_secs} seconds. Stalled tensor: "
+                    f"{p.name} [ready ranks: "
+                    f"{' '.join(str(r) for r in ready)} | missing ranks: "
+                    f"{' '.join(str(r) for r in missing)}]")
+                logger.warning(msg)
+                w = {"name": p.name, "kind": p.kind, "rank": p.rank,
+                     "elapsed_secs": waited, "ready_ranks": ready,
+                     "missing_ranks": missing, "message": msg}
+                with self._lock:
+                    self._warnings.append(w)
+                fired.append(w)
+                _registry.counter("stall.warnings", kind=p.kind).inc()
+                self._timeline_instant(p, waited, ready, missing)
+            if (self.shutdown_secs > 0 and waited >= self.shutdown_secs
+                    and not p.escalated):
+                p.escalated = True
+                logger.error(
+                    f"Tensor {p.name} stalled for {waited:.1f}s, exceeding "
+                    f"the shutdown deadline of {self.shutdown_secs}s.")
+                _registry.counter("stall.shutdowns", kind=p.kind).inc()
+        return fired
+
+    @staticmethod
+    def _timeline_instant(p: _Pending, waited: float, ready, missing):
+        try:
+            from ..common import basics
+
+            tl = basics._state.timeline
+        except Exception:  # pragma: no cover - interpreter teardown
+            return
+        if tl is not None:
+            tl.instant(f"STALL:{p.name}", tid="stalls", args={
+                "kind": p.kind, "rank": p.rank,
+                "elapsed_secs": round(waited, 3),
+                "ready_ranks": ready, "missing_ranks": missing})
+
+    # -- watchdog thread ------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd-stall-inspector", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval):
+            try:
+                self.check()
+            except Exception:  # pragma: no cover - never kill the job
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Process-global inspector. Tracking call sites (eager collectives, the
+# serve engine) talk to this instance; lifecycle.start_from_env() arms
+# the watchdog thread with the Config's stall knobs at hvd.init().
+# ---------------------------------------------------------------------------
+
+_global = StallInspector()
+
+
+def stall_inspector() -> StallInspector:
+    return _global
+
+
+def stalled_tensors() -> List[dict]:
+    """Operations (eager collectives, serve requests) in flight past the
+    stall warning threshold — name, kind, owning rank, elapsed seconds.
+    The live-path analogue of the reference's stall warning state."""
+    return _global.stalled()
+
+
+def track(name: str, *, kind: str = "collective",
+          rank: Optional[int] = None):
+    """Track one in-flight operation on the global inspector."""
+    return _global.track(name, kind=kind, rank=rank)
+
+
+def record_start(name: str, *, kind: str = "collective",
+                 rank: Optional[int] = None) -> None:
+    _global.record_start(name, kind=kind, rank=rank)
+
+
+def record_done(name: str) -> None:
+    _global.record_done(name)

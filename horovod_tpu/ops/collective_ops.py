@@ -33,7 +33,9 @@ from __future__ import annotations
 
 import contextlib
 import enum
+import os
 import threading
+import time
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -45,6 +47,7 @@ from ..common import basics
 from ..common.basics import CROSS_AXIS, HVD_AXES, LOCAL_AXIS
 from ..common.exceptions import (DuplicateTensorNameError,
                                  NotInitializedError)
+from ..monitor import registry as _metrics
 from . import compression as _compression
 from .compression import Compression
 
@@ -247,20 +250,49 @@ class WireStats:
 _wire_recorders: list = []
 
 
+def _acct_enabled() -> bool:
+    """Wire accounting is live: an explicit ``record_wire_stats`` recorder
+    is installed, or the metrics registry (enabled by default,
+    docs/observability.md) is counting trace-time wire bytes. Still a
+    trace-time-only cost — nothing here runs in the compiled step."""
+    return bool(_wire_recorders) or _metrics.metrics_enabled()
+
+
 @contextlib.contextmanager
 def record_wire_stats():
     """Record wire bytes of every collective traced inside the context.
     Trace-time only: wrap ``jit(...).lower(...)`` (or the first call), not
-    the steady-state execution loop."""
+    the steady-state execution loop. On exit the recorded profile is also
+    published to the metrics registry (``comm.wire.*`` gauges — the last
+    traced program's per-device wire bytes, hidden fraction included)."""
     ws = WireStats()
     _wire_recorders.append(ws)
     try:
         yield ws
     finally:
         _wire_recorders.remove(ws)
+        _publish_wire_stats(ws)
+
+
+def _publish_wire_stats(ws: "WireStats") -> None:
+    if not _metrics.metrics_enabled():
+        return
+    r = _metrics.default_registry()
+    r.counter("comm.traces").inc()
+    r.gauge("comm.wire.ici_bytes").set(ws.ici_bytes)
+    r.gauge("comm.wire.dcn_bytes").set(ws.dcn_bytes)
+    r.gauge("comm.wire.dcn_bytes_fp").set(ws.dcn_bytes_fp)
+    r.gauge("comm.wire.overlap_bytes").set(ws.overlap_bytes)
+    r.gauge("comm.wire.streamed_buckets").set(ws.streamed_buckets)
+    r.gauge("comm.wire.hidden_fraction").set(ws.hidden_fraction)
 
 
 def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.bytes", hop=kind).inc(wire_bytes)
+        if kind == "dcn":
+            _metrics.counter("comm.bytes_fp_equiv", hop="dcn").inc(
+                wire_bytes if fp_bytes is None else fp_bytes)
     for ws in _wire_recorders:
         if kind == "dcn":
             ws.dcn_bytes += wire_bytes
@@ -271,7 +303,7 @@ def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
 
 def _acct_psum(x, axes) -> None:
     """Account a flat psum over ``axes`` with the topology-aware model."""
-    if not _wire_recorders:
+    if not _acct_enabled():
         return
     n = float(np.prod(x.shape)) if x.ndim else 1.0
     isz = jnp.dtype(x.dtype).itemsize
@@ -292,7 +324,7 @@ def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
     root reduce/bcast remainder leg at nccl_operations.cc:244-307)."""
     nl = _axis_size(local_axis)
     if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
-        if _wire_recorders:
+        if _acct_enabled():
             n = float(np.prod(x.shape))
             isz = jnp.dtype(x.dtype).itemsize
             nc = _axis_size(cross_axis)
@@ -375,7 +407,7 @@ def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
     sn = n // nl        # shard elements per device after the ICI leg
     seg = sn // nc      # segment elements per cross rank within a shard
     isz = jnp.dtype(x.dtype).itemsize
-    if _wire_recorders:
+    if _acct_enabled():
         pad_n = ((-seg) % blk + seg) * nc  # padded shard elements
         q_unit = pad_n + (pad_n // blk) * 4.0  # int8 payload + fp32 scales
         _acct("ici", n * (nl - 1) / nl * isz)              # psum_scatter
@@ -613,7 +645,7 @@ def reduce_scatter(
         sn = n // nl
         isz = jnp.dtype(flat.dtype).itemsize
         blk = _quant_block_size(block)
-        if _wire_recorders:
+        if _acct_enabled():
             _acct("ici", n * (nl - 1) / nl * isz)          # ICI psum_scatter
             if nc > 1:
                 if quantized:
@@ -651,7 +683,7 @@ def reduce_scatter(
     else:
         # Exact flat scatter: XLA decomposes it topology-aware, and the
         # piece order over an axis tuple is lex (= rank-major) order.
-        if _wire_recorders:
+        if _acct_enabled():
             isz = jnp.dtype(flat.dtype).itemsize
             rem = float(n)
             if LOCAL_AXIS in axes_t:
@@ -728,7 +760,7 @@ def all_gather(
         nc = _axis_size(CROSS_AXIS)
         blk = _quant_block_size(block)
         isz = jnp.dtype(shard.dtype).itemsize
-        if _wire_recorders:
+        if _acct_enabled():
             pad_seg = (-seg) % blk + seg
             q_unit = pad_seg + (pad_seg // blk) * 4.0
             _acct("dcn", 2.0 * q_unit * nc * (nc - 1) / nc,
@@ -824,24 +856,47 @@ def _eager_shard_all_gather(shard, residual, name: Optional[str]):
 # ---------------------------------------------------------------------------
 
 
+def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float) -> float:
+    """Modeled transfer time of a payload at the bench's (env-overridable)
+    link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS model
+    behind bench.py's step_time_breakdown. On the compiled path this is
+    the only per-bucket latency that exists at trace time (XLA owns the
+    runtime schedule); the eager path measures wall time instead."""
+    ici = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
+    dcn = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+    return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)) * 1e3
+
+
 @contextlib.contextmanager
 def _overlap_stream(kind: str, bucket_id):
     """Bracket one streamed bucket collective: emit an ``OVERLAP:<kind>``
-    timeline span (host trace time) and account the bytes the wrapped
-    collective records as overlap-scheduled."""
+    timeline span (host trace time), account the bytes the wrapped
+    collective records as overlap-scheduled, and feed the per-bucket
+    bytes / modeled-latency histograms of the metrics registry."""
     tl = basics._state.timeline if basics.is_initialized() else None
     tid = f"bucket{bucket_id}"
     activity = f"OVERLAP:{kind}"
-    before = [(ws, ws.ici_bytes + ws.dcn_bytes) for ws in _wire_recorders]
+    own = WireStats()  # this bucket's bytes, recorder-independent
+    _wire_recorders.append(own)
+    outer = [ws for ws in _wire_recorders if ws is not own]
     if tl is not None:
         tl.begin(tid, activity)
     try:
         yield
     finally:
-        for ws, b in before:
-            delta = (ws.ici_bytes + ws.dcn_bytes) - b
+        _wire_recorders.remove(own)
+        delta = own.ici_bytes + own.dcn_bytes
+        for ws in outer:
             ws.overlap_bytes += delta
             ws.streamed_buckets += 1
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.counter("comm.streamed_buckets", kind=kind).inc()
+            r.histogram("comm.bucket.bytes").observe(delta)
+            # µs, not ms: the log2 buckets need the resolution (a small
+            # bucket's modeled transfer is far under a millisecond).
+            r.histogram("comm.bucket.latency_us").observe(
+                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes) * 1e3)
         if tl is not None:
             tl.end(tid, activity)
 
@@ -1592,53 +1647,78 @@ def _to_numpy(tensor) -> np.ndarray:
     return np.ascontiguousarray(np.asarray(tensor))
 
 
+@contextlib.contextmanager
+def _eager_instrumented(kind: str, name: str):
+    """Observability bracket for one eager (host-path) collective: the
+    StallInspector tracks it in flight (so a straggler rank — or a chaos
+    ``stall`` injected in ``_eager_ctx`` — surfaces as a rank-attributed
+    ``STALL:*`` warning, docs/observability.md), and the wall time of a
+    completed op feeds the ``comm.eager.latency_ms`` histogram."""
+    from ..monitor import stall as _stall
+
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.eager.calls", kind=kind).inc()
+    t0 = time.perf_counter()
+    with _stall.track(name, kind=kind):
+        yield
+    if _metrics.metrics_enabled():
+        _metrics.histogram("comm.eager.latency_ms", kind=kind).observe(
+            (time.perf_counter() - t0) * 1e3)
+
+
 def _eager_allreduce(tensor, op: ReduceOp, name: Optional[str] = None):
-    ctrl, world = _eager_ctx()
-    if world == 1:
-        return tensor  # sum/avg/min/max/product over a world of one
-    arr = _to_numpy(tensor)
-    opmap = {
-        ReduceOp.SUM: ctrl.SUM,
-        ReduceOp.AVERAGE: ctrl.SUM,
-        ReduceOp.MIN: ctrl.MIN,
-        ReduceOp.MAX: ctrl.MAX,
-        ReduceOp.PRODUCT: ctrl.PRODUCT,
-        ReduceOp.ADASUM: ctrl.ADASUM,
-    }
-    postscale = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
-    out = ctrl.allreduce_async(arr, _eager_name(name, "allreduce"),
-                               op=opmap[op], postscale=postscale).wait()
-    return jnp.asarray(out)
+    name = _eager_name(name, "allreduce")
+    with _eager_instrumented("allreduce", name):
+        ctrl, world = _eager_ctx()
+        if world == 1:
+            return tensor  # sum/avg/min/max/product over a world of one
+        arr = _to_numpy(tensor)
+        opmap = {
+            ReduceOp.SUM: ctrl.SUM,
+            ReduceOp.AVERAGE: ctrl.SUM,
+            ReduceOp.MIN: ctrl.MIN,
+            ReduceOp.MAX: ctrl.MAX,
+            ReduceOp.PRODUCT: ctrl.PRODUCT,
+            ReduceOp.ADASUM: ctrl.ADASUM,
+        }
+        postscale = 1.0 / world if op == ReduceOp.AVERAGE else 1.0
+        out = ctrl.allreduce_async(arr, name,
+                                   op=opmap[op], postscale=postscale).wait()
+        return jnp.asarray(out)
 
 
 def _eager_allgather(tensor, name: Optional[str] = None):
-    ctrl, world = _eager_ctx()
-    if world == 1:
-        return tensor
-    out = ctrl.allgather_async(_to_numpy(tensor),
-                               _eager_name(name, "allgather")).wait()
-    return jnp.asarray(out)
+    name = _eager_name(name, "allgather")
+    with _eager_instrumented("allgather", name):
+        ctrl, world = _eager_ctx()
+        if world == 1:
+            return tensor
+        out = ctrl.allgather_async(_to_numpy(tensor), name).wait()
+        return jnp.asarray(out)
 
 
 def _eager_broadcast(tensor, root_rank: int, name: Optional[str] = None):
-    ctrl, world = _eager_ctx()
-    if world == 1:
-        return tensor
-    out = ctrl.broadcast_async(_to_numpy(tensor),
-                               _eager_name(name, "broadcast"),
-                               root=root_rank).wait()
-    return jnp.asarray(out)
+    name = _eager_name(name, "broadcast")
+    with _eager_instrumented("broadcast", name):
+        ctrl, world = _eager_ctx()
+        if world == 1:
+            return tensor
+        out = ctrl.broadcast_async(_to_numpy(tensor), name,
+                                   root=root_rank).wait()
+        return jnp.asarray(out)
 
 
 def _eager_alltoall(tensor, splits, name: Optional[str] = None):
-    ctrl, world = _eager_ctx()
-    if world == 1:
-        return tensor, None
-    sp = None if splits is None else [int(x) for x in np.asarray(splits)]
-    h = ctrl.alltoall_async(_to_numpy(tensor),
-                            _eager_name(name, "alltoall"), splits=sp)
-    out = h.wait()
-    return jnp.asarray(out), jnp.asarray(h.recv_splits(), dtype=jnp.int32)
+    name = _eager_name(name, "alltoall")
+    with _eager_instrumented("alltoall", name):
+        ctrl, world = _eager_ctx()
+        if world == 1:
+            return tensor, None
+        sp = None if splits is None else [int(x) for x in np.asarray(splits)]
+        h = ctrl.alltoall_async(_to_numpy(tensor), name, splits=sp)
+        out = h.wait()
+        return jnp.asarray(out), jnp.asarray(h.recv_splits(),
+                                             dtype=jnp.int32)
 
 
 # ---------------------------------------------------------------------------

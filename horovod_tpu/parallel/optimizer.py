@@ -47,9 +47,40 @@ from jax import lax
 
 from ..common import basics
 from ..common.config import _env_bool
+from ..monitor import registry as _metrics
 from ..ops import collective_ops as C
 from ..ops import fusion
 from ..ops.compression import Compression
+
+
+def _with_step_marker(tx):
+    """Host-side step markers around a DistributedOptimizer's update.
+
+    When ``update`` runs eagerly (the host path / process-world mode)
+    each call IS one optimizer step: bracket it with
+    ``jax.profiler.StepTraceAnnotation`` — the device-trace step marker
+    that ``hvd.profile_window`` and the serve engine also use, so host
+    steps line up with device activity in a ``jax.profiler`` trace — and
+    count it in the metrics registry. Inside a trace (the compiled path,
+    where the annotation would mark the single retrace rather than the
+    steps) only the ``optimizer.update_traces`` counter advances; the
+    per-step markers there come from :func:`hvd.profile_window`.
+    """
+    inner_update = tx.update
+    step_no = [0]
+
+    def update(grads, state, params=None, **extra):
+        leaves = jax.tree.leaves(grads)
+        if leaves and isinstance(leaves[0], jax.core.Tracer):
+            _metrics.counter("optimizer.update_traces").inc()
+            return inner_update(grads, state, params, **extra)
+        step_no[0] += 1
+        _metrics.counter("optimizer.steps").inc()
+        with jax.profiler.StepTraceAnnotation("hvd_step",
+                                              step_num=step_no[0]):
+            return inner_update(grads, state, params, **extra)
+
+    return optax.GradientTransformationExtraArgs(tx.init, update)
 
 
 class ZeroState(NamedTuple):
@@ -396,7 +427,7 @@ def DistributedOptimizer(
             raise ValueError(
                 f"zero=True supports op=Average/Sum (a reduce-scatter of "
                 f"{op} has no decomposition), got {op}")
-        return _build_zero_transform(
+        return _with_step_marker(_build_zero_transform(
             optimizer,
             compression=compression,
             op=op,
@@ -408,7 +439,7 @@ def DistributedOptimizer(
             overlap=bool(overlap),
             num_comm_streams=num_comm_streams,
             axes=axes,
-        )
+        ))
 
     if gradient_predivide_factor != 1.0:
         # Average == Sum with the divisor split across pre/post scaling.
@@ -450,8 +481,9 @@ def DistributedOptimizer(
         # accumulator owns the reduction (and, when quantized, the EF
         # residual) so microbatch t's backward and microbatch t-1's
         # bucket reduction share a program region dependence-free.
-        return _overlap_multi_steps(optimizer, backward_passes_per_step,
-                                    _allreduce, quantized=quantized)
+        return _with_step_marker(
+            _overlap_multi_steps(optimizer, backward_passes_per_step,
+                                 _allreduce, quantized=quantized))
 
     _res_read, _res_write = _lead_read, _lead_write
 
@@ -481,7 +513,7 @@ def DistributedOptimizer(
         # Accumulate locally, allreduce + apply every k-th microbatch
         # (reference: torch/optimizer.py:133-149).
         tx = optax.MultiSteps(tx, every_k_schedule=backward_passes_per_step)
-    return tx
+    return _with_step_marker(tx)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..common import basics
 from ..models.gpt import GPT, GPTConfig
+from ..monitor import registry as _metrics
+from ..monitor import stall as _stall
 from ..parallel.tensor import tp_merge_params, tp_split_params
 from . import kv_cache as kvlib
 from .kv_cache import KVCache, PageConfig
@@ -237,9 +239,18 @@ class GenerationEngine:
         tl = basics._state.timeline if basics.is_initialized() else None
         for slot in self.sched.admit(now):
             self.slots[slot] = _SlotState(self.sched.running[slot])
+            req_id = self.slots[slot].req.req_id
+            _metrics.counter("serve.admissions").inc()
+            # The StallInspector watches every admitted request: one that
+            # sits in a slot past stall_check_time (a wedged compiled
+            # step, a starved replica) surfaces as a STALL:serve.req*
+            # warning (docs/observability.md).
+            _stall.record_start(f"serve.req{req_id}", kind="serve")
             if tl is not None:
                 tl.instant(f"SERVE:ADMIT slot{slot} "
-                           f"req{self.slots[slot].req.req_id}", tid=self.name)
+                           f"req{req_id}", tid=self.name)
+        _metrics.gauge("serve.queue_depth").set(self.sched.queue_depth())
+        _metrics.gauge("serve.in_flight").set(len(self.slots))
         if not self.slots:
             return 0
 
@@ -257,6 +268,9 @@ class GenerationEngine:
                         f"(slot {slot}, pos {st.consumed}): size the pool "
                         f"to at least pages_for(prompt+max_new_tokens)")
                 self.stats.preemptions += 1
+                _metrics.counter("serve.preemptions").inc()
+                _stall.record_done(
+                    f"serve.req{self.slots[victim].req.req_id}")
                 if tl is not None:
                     tl.instant(
                         f"SERVE:PREEMPT slot{victim} "
@@ -288,9 +302,15 @@ class GenerationEngine:
         if tl is not None:
             for ph, _ in phases:
                 tl.begin(self.name, f"SERVE:{ph}")
-        logits, self.cache = self._step_fn(
-            self._stacked, self._repl, cache,
-            jnp.asarray(tokens), jnp.asarray(active))
+        # StepTraceAnnotation: the device-trace step marker, so a
+        # jax.profiler capture of a serving run shows one annotated step
+        # per engine iteration (the same marker hvd.profile_window and
+        # DistributedOptimizer use — host/device trace correlation).
+        with jax.profiler.StepTraceAnnotation("serve_step",
+                                              step_num=self.stats.steps):
+            logits, self.cache = self._step_fn(
+                self._stacked, self._repl, cache,
+                jnp.asarray(tokens), jnp.asarray(active))
         if tl is not None:
             for ph, _ in reversed(phases):
                 tl.end(self.name, f"SERVE:{ph}")
@@ -299,6 +319,9 @@ class GenerationEngine:
         self.stats.prefill_tokens += n_prefill
         self.stats.decode_tokens += n_decode
         self.stats.steps += 1
+        _metrics.counter("serve.steps").inc()
+        _metrics.counter("serve.prefill_tokens").inc(n_prefill)
+        _metrics.counter("serve.decode_tokens").inc(n_decode)
 
         for slot in list(self.slots):
             st = self.slots[slot]
@@ -314,6 +337,8 @@ class GenerationEngine:
                 req = self.sched.evict(slot, now, reason)
                 del self.slots[slot]
                 self.stats.completed.append(req)
+                _metrics.counter("serve.completions", reason=reason).inc()
+                _stall.record_done(f"serve.req{req.req_id}")
                 if tl is not None:
                     tl.instant(f"SERVE:EVICT slot{slot} req{req.req_id} "
                                f"{reason}", tid=self.name)
@@ -362,6 +387,8 @@ class GenerationEngine:
         if tl is not None and self.slots:
             tl.instant(f"SERVE:DRAIN {self.name} "
                        f"{len(self.slots)} in-flight", tid=self.name)
+        for st in self.slots.values():
+            _stall.record_done(f"serve.req{st.req.req_id}")
         self.slots.clear()
         drained = self.sched.drain()
         self.stats.resizes += len(drained)

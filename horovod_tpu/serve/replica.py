@@ -128,6 +128,11 @@ class ReplicaSet:
         self.resize_events.append({
             "time": now, "from": old, "to": n_replicas,
             "migrated": len(migrated), "in_flight": in_flight})
+        from ..monitor import registry as _metrics
+
+        _metrics.counter("serve.resizes").inc()
+        _metrics.counter("serve.migrated_requests").inc(len(migrated))
+        _metrics.gauge("serve.replicas").set(n_replicas)
         if tl is not None:
             tl.instant(f"SERVE:RESIZE {old}->{n_replicas} "
                        f"migrated{len(migrated)}", tid="serve")

@@ -42,6 +42,7 @@ class Timeline:
         self._queue: "queue.Queue" = queue.Queue()
         self._start = time.perf_counter()
         self._closed = False
+        self._close_lock = threading.Lock()
         self._pid = os.getpid()
         self._file = open(path, "w")
         self._file.write("[\n")
@@ -78,6 +79,13 @@ class Timeline:
                 args: Optional[dict] = None) -> None:
         self.emit(name, "i", tid=tid, args=args)
 
+    def counter(self, name: str, values: dict, *,
+                tid: str = "metrics") -> None:
+        """Chrome counter event (``ph:"C"``): trace viewers plot ``values``
+        as per-series area charts — the Timeline mirror of the metrics
+        registry (monitor/sinks.py TimelineSink)."""
+        self.emit(name, "C", tid=tid, args=values)
+
     def mark_cycle_start(self) -> None:
         """Cycle markers (HOROVOD_TIMELINE_MARK_CYCLES, operations.cc:430)."""
         if self._mark_cycles:
@@ -94,23 +102,47 @@ class Timeline:
 
     # -- writer thread ---------------------------------------------------
 
+    def _write_event(self, ev: dict) -> None:
+        line = json.dumps(ev)
+        if not self._first:
+            self._file.write(",\n")
+        self._first = False
+        self._file.write(line)
+
     def _writer_loop(self) -> None:
         while True:
             ev = self._queue.get()
             if ev is None:
                 return
-            line = json.dumps(ev)
-            if not self._first:
-                self._file.write(",\n")
-            self._first = False
-            self._file.write(line)
+            self._write_event(ev)
 
     def close(self) -> None:
-        if self._closed:
-            return
-        self._closed = True
+        """Flush and close. Idempotent; safe to call from any thread.
+
+        Shutdown ordering contract (regression-tested in
+        tests/test_timeline.py): every event emitted before close() is
+        called reaches the file — the writer drains up to the sentinel,
+        the writer thread is JOINED (with a timeout, not daemon-
+        abandoned), and anything the sentinel raced past (events enqueued
+        while close() was in flight) is drained synchronously before the
+        closing bracket is written.
+        """
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True  # emit() now rejects new events
         self._queue.put(None)
         self._writer.join(timeout=5)
+        # Drain events that were enqueued between the last emit() check
+        # and the sentinel (or left behind if the join timed out while
+        # the writer was wedged).
+        while True:
+            try:
+                ev = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if ev is not None:
+                self._write_event(ev)
         self._file.write("\n]\n")
         self._file.flush()
         self._file.close()
@@ -119,7 +151,8 @@ class Timeline:
 def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
     """Start timeline recording at runtime (reference: hvd.start_timeline,
     basics.py:75-98). Attaches to global state so framework internals emit
-    into it."""
+    into it. Idempotent on restart: an already-attached timeline is
+    flushed and closed (a valid trace) before the new one starts."""
     from ..common import basics
 
     s = basics._require_init()
@@ -130,10 +163,12 @@ def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
 
 
 def stop_timeline() -> None:
-    """Stop recording (reference: hvd.stop_timeline)."""
+    """Stop recording (reference: hvd.stop_timeline). Idempotent: a
+    second stop — or a stop with no timeline attached, or after
+    ``shutdown()`` already closed it — is a no-op."""
     from ..common import basics
 
-    s = basics._require_init()
+    s = basics._state
     if s.timeline is not None:
         s.timeline.close()
         s.timeline = None

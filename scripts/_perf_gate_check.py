@@ -9,6 +9,13 @@ recorded in the ``BENCH_r*.json`` trajectory (each of those wraps the
 bench's one-line JSON under ``parsed`` or inside ``tail``).
 
 Exit 0 = within tolerance, 1 = regression, 2 = usage/baseline error.
+
+Every verdict is ALSO appended as a metrics-JSONL snapshot (the same
+schema the monitor registry's JsonlSink writes, so obs_report.py and any
+JSONL consumer can query the gate history) to PERF_GATE_METRICS_JSONL
+(default: perf_gate_metrics.jsonl in the repo root): per-leg measured vs
+baseline gauges, the tolerance, and pass/fail — regressions become
+queryable data, not just an exit code.
 """
 
 import glob
@@ -18,6 +25,40 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE_BASELINE = os.path.join(REPO, "BENCH_serve_baseline.json")
+
+sys.path.insert(0, REPO)
+
+_VERDICTS = []
+
+
+def record_verdict(leg, what, measured, baseline, tol, ok):
+    _VERDICTS.append({"leg": leg, "what": what, "measured": measured,
+                      "baseline": baseline, "tol": tol, "ok": ok})
+
+
+def write_verdict_snapshot():
+    """One metrics snapshot (monitor-registry schema) per gate run."""
+    path = os.environ.get(
+        "PERF_GATE_METRICS_JSONL",
+        os.path.join(REPO, "perf_gate_metrics.jsonl"))
+    if not path or path == "0":
+        return
+    from horovod_tpu.monitor import JsonlSink, MetricsRegistry
+
+    reg = MetricsRegistry(enabled=True)
+    for v in _VERDICTS:
+        labels = {"leg": v["leg"], "what": v["what"].replace(" ", "_")}
+        reg.gauge("perf_gate.measured", **labels).set(v["measured"])
+        reg.gauge("perf_gate.baseline", **labels).set(v["baseline"])
+        reg.gauge("perf_gate.tolerance", **labels).set(v["tol"])
+        reg.gauge("perf_gate.pass", **labels).set(1.0 if v["ok"] else 0.0)
+        if not v["ok"]:
+            reg.counter("perf_gate.regressions", **labels).inc()
+    snap = reg.snapshot()
+    snap["perf_gate"] = {"legs": sorted({v["leg"] for v in _VERDICTS}),
+                         "pass": all(v["ok"] for v in _VERDICTS)}
+    JsonlSink(path).write(snap)
+    print(f"perf gate: verdict snapshot appended to {path}")
 
 
 def trajectory_records():
@@ -44,16 +85,29 @@ def trajectory_records():
     return out
 
 
-def gate(measured, baseline, tol, what):
+def gate(measured, baseline, tol, what, leg=None):
     floor = tol * baseline
     ok = measured >= floor
     verdict = "OK" if ok else "REGRESSION"
     print(f"perf gate [{what}]: measured {measured:.2f} vs baseline "
           f"{baseline:.2f} (floor {floor:.2f} at tol {tol}) -> {verdict}")
+    record_verdict(leg or os.environ.get("PERF_GATE_LEG", "serve"), what,
+                   measured, baseline, tol, ok)
     return ok
 
 
 def main():
+    try:
+        return _main()
+    finally:
+        try:
+            write_verdict_snapshot()
+        except Exception as e:  # the snapshot must never mask the verdict
+            print(f"perf gate: verdict snapshot failed: {e}",
+                  file=sys.stderr)
+
+
+def _main():
     if len(sys.argv) != 2:
         print(__doc__, file=sys.stderr)
         return 2
@@ -66,9 +120,13 @@ def main():
         if rec.get("requests_dropped", 1) != 0:
             print(f"perf gate [serve]: dropped requests "
                   f"{rec.get('requests_dropped')} — hard fail")
+            record_verdict("serve", "dropped_requests",
+                           rec.get("requests_dropped", -1), 0, tol, False)
             return 1
         if rec.get("goodput_tokens_per_sec", 0) <= 0:
             print("perf gate [serve]: zero goodput — hard fail")
+            record_verdict("serve", "goodput_tokens_per_sec", 0,
+                           rec.get("goodput_tokens_per_sec", 0), tol, False)
             return 1
         if update or not os.path.exists(SERVE_BASELINE):
             with open(SERVE_BASELINE, "w") as f:

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Join a Timeline JSON and a metrics JSONL into one observability report.
+
+Usage:
+    scripts/obs_report.py --timeline TL.json --metrics METRICS.jsonl \
+        [--json OUT.json]
+
+Produce the artifacts with any bench/training run::
+
+    HOROVOD_TIMELINE=tl.json HOROVOD_METRICS_JSONL=metrics.jsonl \
+        python bench.py --overlap ...
+
+Report sections (docs/observability.md):
+
+* **Phase breakdown** — per-activity span time from the Timeline
+  (OVERLAP:*, SERVE:*, PROFILE:*, ...), audited for B/E balance
+  (monitor/span_audit.py);
+* **Stall table** — every STALL:* instant with rank attribution, plus
+  the stall.warnings counters;
+* **Overlap** — comm_hidden_fraction recomputed from the registry's
+  comm.wire.* gauges (overlap / (ici + dcn) bytes of the last traced
+  program) — must reproduce the bench-reported value within 1%;
+* **Wire budget** — measured per-device wire bytes per hop vs the
+  modeled transfer time at HOROVOD_BENCH_ICI_GBPS/DCN_GBPS (the same
+  bandwidth model behind bench.py's step_time_breakdown), and the DCN
+  fp-equivalent reduction of the quantized wire.
+
+Exit 0 on success, 2 on usage/artifact errors. ``--json`` additionally
+writes the report as one machine-readable dict (what obs_smoke.sh
+asserts on).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from horovod_tpu.monitor.span_audit import (  # noqa: E402
+    SpanImbalanceError, audit_spans, load_events)
+
+
+def load_metrics(path):
+    """All snapshots in the JSONL; the LAST one is the report's state."""
+    snaps = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "metrics":
+                snaps.append(rec)
+    return snaps
+
+
+def hidden_fraction(gauges):
+    total = (gauges.get("comm.wire.ici_bytes", 0.0)
+             + gauges.get("comm.wire.dcn_bytes", 0.0))
+    if not total:
+        return 0.0
+    return gauges.get("comm.wire.overlap_bytes", 0.0) / total
+
+
+def build_report(timeline_path, metrics_path):
+    events = load_events(timeline_path)
+    try:
+        audit = audit_spans(events)
+        balanced, imbalance = True, None
+    except SpanImbalanceError as e:
+        audit = audit_spans(events, require_balanced=False)
+        balanced, imbalance = False, str(e)
+
+    snaps = load_metrics(metrics_path)
+    if not snaps:
+        raise SystemExit(f"no metrics snapshots in {metrics_path}")
+    snap = snaps[-1]
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+
+    stalls = [
+        {"name": ev["name"], "ts_us": ev.get("ts"),
+         **(ev.get("args") or {})}
+        for ev in events
+        if ev.get("ph") == "i" and str(ev.get("name", "")).startswith("STALL:")]
+    stall_warnings = sum(v for k, v in counters.items()
+                         if k.startswith("stall.warnings"))
+
+    ici = gauges.get("comm.wire.ici_bytes", 0.0)
+    dcn = gauges.get("comm.wire.dcn_bytes", 0.0)
+    dcn_fp = gauges.get("comm.wire.dcn_bytes_fp", 0.0)
+    ici_gbps = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
+    dcn_gbps = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+    return {
+        "timeline": os.path.abspath(timeline_path),
+        "metrics": os.path.abspath(metrics_path),
+        "snapshots": len(snaps),
+        "events": len(events),
+        "spans_balanced": balanced,
+        "span_imbalance": imbalance,
+        "total_spans": audit.total_spans,
+        "phase_time_us": {k: round(v, 1)
+                          for k, v in sorted(audit.by_phase().items())},
+        "activity_time_us": {k: round(v, 1)
+                             for k, v in sorted(audit.duration_us.items())},
+        "stalls": stalls,
+        "stall_warnings": stall_warnings,
+        "comm_hidden_fraction": hidden_fraction(gauges),
+        "wire_budget": {
+            "ici_bytes_per_step_device": ici,
+            "dcn_bytes_per_step_device": dcn,
+            "dcn_bytes_fp_equiv": dcn_fp,
+            "dcn_reduction": (dcn_fp / dcn) if dcn else None,
+            "modeled_wire_ms": round(
+                (ici / (ici_gbps * 1e9) + dcn / (dcn_gbps * 1e9)) * 1e3, 4),
+            "model": {"ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps},
+        },
+        "streamed_buckets": gauges.get("comm.wire.streamed_buckets", 0.0),
+        "bucket_latency_hist": hists.get("comm.bucket.latency_us"),
+        "step_time_hist": hists.get("step.time_ms"),
+        "eager_calls": {k: v for k, v in counters.items()
+                        if k.startswith("comm.eager.calls")},
+        "serve": {k: v for k, v in {**counters, **gauges}.items()
+                  if k.startswith("serve.")},
+    }
+
+
+def print_report(r):
+    w = print
+    w(f"== observability report ==")
+    w(f"timeline: {r['timeline']} ({r['events']} events, "
+      f"{r['total_spans']} spans, "
+      f"{'balanced' if r['spans_balanced'] else 'IMBALANCED: ' + str(r['span_imbalance'])})")
+    w(f"metrics:  {r['metrics']} ({r['snapshots']} snapshots)")
+    w("")
+    w("-- phase time breakdown (host spans) --")
+    if r["activity_time_us"]:
+        for name, us in sorted(r["activity_time_us"].items(),
+                               key=lambda kv: -kv[1]):
+            w(f"  {name:<32} {us / 1e3:10.3f} ms")
+    else:
+        w("  (no spans)")
+    w("")
+    w("-- stalls --")
+    if r["stalls"]:
+        for s in r["stalls"]:
+            w(f"  {s['name']:<40} rank {s.get('rank', '?')} "
+              f"elapsed {s.get('elapsed_secs', '?')}s "
+              f"missing {s.get('missing_ranks', '?')}")
+    w(f"  stall warnings (registry): {r['stall_warnings']:g}")
+    w("")
+    w("-- overlap --")
+    w(f"  comm_hidden_fraction: {r['comm_hidden_fraction']:.4f} "
+      f"({r['streamed_buckets']:g} streamed buckets)")
+    w("")
+    w("-- wire budget (per step, per device) --")
+    wb = r["wire_budget"]
+    w(f"  ICI {wb['ici_bytes_per_step_device'] / 1e6:.3f} MB, "
+      f"DCN {wb['dcn_bytes_per_step_device'] / 1e6:.3f} MB"
+      + (f" (fp-equiv {wb['dcn_bytes_fp_equiv'] / 1e6:.3f} MB, "
+         f"{wb['dcn_reduction']:.2f}x reduction)"
+         if wb["dcn_reduction"] else ""))
+    w(f"  modeled transfer: {wb['modeled_wire_ms']} ms at "
+      f"ICI {wb['model']['ici_gbps']} GB/s / DCN {wb['model']['dcn_gbps']} GB/s")
+    if r["serve"]:
+        w("")
+        w("-- serve --")
+        for k, v in sorted(r["serve"].items()):
+            w(f"  {k:<40} {v:g}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeline", required=True)
+    ap.add_argument("--metrics", required=True)
+    ap.add_argument("--json", help="also write the report dict here")
+    args = ap.parse_args()
+    for p in (args.timeline, args.metrics):
+        if not os.path.exists(p):
+            ap.error(f"no such file: {p}")
+    report = build_report(args.timeline, args.metrics)
+    print_report(report)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Observability smoke (CI brick for docs/observability.md): run one short
+# bench leg with the timeline AND the metrics JSONL sink enabled, then
+# assert scripts/obs_report.py joins them into a coherent report —
+# nonzero wire bytes, balanced spans, zero stalls, and a
+# comm_hidden_fraction that reproduces the bench-reported value within 1%.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="${OBS_SMOKE_TMP:-$(mktemp -d)}"
+mkdir -p "$TMP"
+trap '[ -z "${OBS_SMOKE_TMP:-}" ] && rm -rf "$TMP"' EXIT
+echo "== obs smoke: artifacts in $TMP ==" >&2
+
+JAX_PLATFORMS=cpu \
+HOROVOD_TIMELINE="$TMP/tl.json" \
+HOROVOD_METRICS_JSONL="$TMP/metrics.jsonl" \
+python bench.py --overlap --platform cpu --cpu-devices 8 \
+    --model resnet18 --batch-size 2 --image-size 64 \
+    --num-warmup 1 --num-iters 2 --num-batches-per-iter 1 \
+    | tail -n 1 > "$TMP/bench.json"
+
+python scripts/obs_report.py --timeline "$TMP/tl.json" \
+    --metrics "$TMP/metrics.jsonl" --json "$TMP/report.json"
+
+python - "$TMP" <<'PY'
+import json, sys
+tmp = sys.argv[1]
+report = json.load(open(f"{tmp}/report.json"))
+bench = json.load(open(f"{tmp}/bench.json"))
+
+assert report["spans_balanced"], report["span_imbalance"]
+assert report["total_spans"] > 0, "no spans recorded"
+wb = report["wire_budget"]
+assert wb["ici_bytes_per_step_device"] > 0, "zero ICI wire bytes"
+assert not report["stalls"] and report["stall_warnings"] == 0, \
+    f"unexpected stalls: {report['stalls']}"
+got, want = report["comm_hidden_fraction"], bench["comm_hidden_fraction"]
+assert abs(got - want) <= 0.01, \
+    f"hidden fraction mismatch: report {got} vs bench {want}"
+assert bench["metrics_snapshot"]["histograms"].get("step.time_ms", {}) \
+    .get("count", 0) > 0, "bench JSON missing the step-latency histogram"
+print(f"obs smoke OK: {report['total_spans']} spans, "
+      f"ICI {wb['ici_bytes_per_step_device']/1e6:.2f} MB/step, "
+      f"hidden fraction {got:.4f} (bench {want:.4f}), 0 stalls")
+PY

@@ -17,6 +17,11 @@
 #   scripts/perf_gate.sh             # gate the serve leg (default)
 #   PERF_GATE_LEGS="serve train" scripts/perf_gate.sh
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
+#
+# Every verdict is also appended as a metrics-JSONL snapshot to
+# PERF_GATE_METRICS_JSONL (default perf_gate_metrics.jsonl; set to 0 to
+# disable): per-leg measured/baseline/tolerance gauges + pass/fail, so
+# the regression history is queryable data (docs/observability.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

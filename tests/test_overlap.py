@@ -345,18 +345,12 @@ def test_timeline_overlap_spans(tmp_path):
     events = json.load(open(path))
     names = {e["name"] for e in events}
     assert any(n.startswith("OVERLAP:ALLREDUCE") for n in names), names
-    # spans, not instants: B/E pairs balance per tid
-    for tid in {e["tid"] for e in events if str(e["name"]).startswith("OVERLAP")}:
-        depth = 0
-        for e in events:
-            if e["tid"] != tid:
-                continue
-            if e["ph"] == "B":
-                depth += 1
-            elif e["ph"] == "E":
-                depth -= 1
-                assert depth >= 0
-        assert depth == 0
+    # spans, not instants: B/E pairs balance per tid (monitor/span_audit
+    # raises SpanImbalanceError on any unbalanced or negative depth)
+    from horovod_tpu.monitor.span_audit import audit_spans
+
+    audit = audit_spans(events, prefix="OVERLAP", require_spans=True)
+    assert audit.balanced
 
 
 def test_wire_stats_overlap_accounting():

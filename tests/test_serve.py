@@ -475,12 +475,13 @@ class TestEngine:
         assert any(n.startswith("SERVE:ADMIT") for n in names)
         assert any(n.startswith("SERVE:EVICT") for n in names)
         assert "SERVE:PREFILL" in names and "SERVE:DECODE" in names
-        for phase in ("SERVE:PREFILL", "SERVE:DECODE"):
-            b = sum(1 for e in events
-                    if e["name"] == phase and e["ph"] == "B")
-            e_ = sum(1 for e in events
-                     if e["name"] == phase and e["ph"] == "E")
-            assert b == e_ > 0
+        # B/E balance per tid via the span-audit helper (raises on any
+        # imbalance); both phases must have closed at least one span.
+        from horovod_tpu.monitor.span_audit import audit_spans
+
+        audit = audit_spans(events, prefix="SERVE:", require_spans=True)
+        assert audit.count["SERVE:PREFILL"] > 0
+        assert audit.count["SERVE:DECODE"] > 0
 
 
 # ---------------------------------------------------------------------------
