@@ -381,7 +381,8 @@ def build_workload(args, global_batch):
 
 
 def run_once(args, devices, platform, *, quantized=False, zero=False,
-             overlap=False, mesh_shape=None, tuned_params=None):
+             overlap=False, mesh_shape=None, tuned_params=None,
+             zero_stage=None, ckpt_probe=False):
     """One full measurement on ``devices``: init the world, build the
     model + DistributedOptimizer step, compile, warm up, time, and return
     the result row (no JSON printing — the caller owns the one-line
@@ -391,12 +392,17 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     ``quantized`` selects the int8 DCN wire with error feedback in the
     DistributedOptimizer; ``zero`` the ZeRO-1 sharded optimizer update
     (reduce-scatter grads → per-rank optax update on 1/world shards →
-    all-gather, docs/zero.md); ``mesh_shape=(cross, local)`` emulates a
-    multi-host topology (a real DCN hop) on a single host. Under
-    ``--quantized``/``--zero`` both A/B legs run the reduce-in-optimizer
-    step structure so the comparison is like-for-like. ``tuned_params``
-    (the frozen winner of an autotune session) overrides the collective
-    tunables for this leg — the ``--autotune`` A/B measures its value."""
+    all-gather, docs/zero.md); ``zero_stage`` (1/2/3) the explicit ZeRO
+    stage — stage 3 restructures the loop: the params live as flat
+    bucket shards and the forward runs on ``hvd.zero3_gather_params``
+    output; ``mesh_shape=(cross, local)`` emulates a multi-host topology
+    (a real DCN hop) on a single host. Under ``--quantized``/``--zero``/
+    ``--zero-stage`` both A/B legs run the reduce-in-optimizer step
+    structure so the comparison is like-for-like. ``tuned_params`` (the
+    frozen winner of an autotune session) overrides the collective
+    tunables for this leg — the ``--autotune`` A/B measures its value.
+    ``ckpt_probe`` saves an async rank-sharded checkpoint twice during
+    the timed window (docs/checkpoint.md) and reports the save stall."""
     import jax
     import numpy as np
     import optax
@@ -410,6 +416,10 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     global_batch = args.batch_size * n_chips
     log(f"world={n_chips} global_batch={global_batch} platform={platform}")
 
+    stage = int(zero_stage) if zero_stage else (2 if zero else 0)
+    zero = stage in (1, 2)
+    zero3 = stage == 3
+
     wl = build_workload(args, global_batch)
     params, batch_stats = wl["params"], wl["batch_stats"]
     images, labels = wl["images"], wl["labels"]
@@ -420,7 +430,8 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     tx = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9),
                                   compression=compression,
                                   quantized=quantized,
-                                  zero=zero,
+                                  zero=None if stage else False,
+                                  zero_stage=stage if stage else None,
                                   overlap=overlap,
                                   tuned_params=tuned_params)
     opt_state = tx.init(params)
@@ -432,7 +443,19 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     # Pin shardings up front so step 2 doesn't recompile on resharded args.
     params = jax.device_put(params, rep)
     batch_stats = jax.device_put(batch_stats, rep)
-    if zero:
+    pshards = pshard_spec = params_tpl = None
+    if zero3:
+        # Stage 3: the loop owns 1/world flat bucket shards; the full
+        # params exist only transiently inside the step (per-bucket JIT
+        # gather, docs/zero.md).
+        params_tpl = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        pshards = hvd.zero3_shard_params(jax.device_get(params))
+        pshard_spec = hvd.zero3_param_pspecs(pshards)
+        pshards = jax.device_put(
+            pshards,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), pshard_spec))
+    if zero or zero3:
         # ZeRO state: flat bucket moments (and EF residuals) shard
         # rank-major over the mesh; scalars replicate
         # (hvd.zero_state_pspecs docstring).
@@ -451,19 +474,51 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     else:
         opt_state = jax.device_put(opt_state, rep)
         state_spec = P()
-    # Optimizer-state bytes this rank actually holds: on the ZeRO leg
+    # Optimizer-state bytes this rank actually holds: on the ZeRO legs
     # every non-scalar leaf shards 1/world over the mesh (the
     # zero_state_pspecs contract), so per-rank bytes shrink world× — the
     # memory metric the A/B reports.
-    if zero:
+    if zero or zero3:
         opt_state_bytes_per_rank = float(sum(
             (l.nbytes / n_chips if getattr(l, "ndim", 0) >= 1 else l.nbytes)
             for l in jax.tree.leaves(opt_state)))
     else:
         opt_state_bytes_per_rank = float(sum(
             getattr(l, "nbytes", 0) for l in jax.tree.leaves(opt_state)))
-    log(f"opt_state bytes/rank: {opt_state_bytes_per_rank / 1e6:.3f} MB"
-        + (" (ZeRO-sharded)" if zero else " (replicated)"))
+    # Parameter bytes: replicated params cost their full size on every
+    # rank; stage-3 shards cost 1/world persistent (+ the per-bucket
+    # transient the JIT gather materializes during the step, reported
+    # separately — docs/zero.md memory math).
+    model_bytes = float(sum(
+        getattr(l, "nbytes", 0) for l in jax.tree.leaves(params)))
+    if zero3:
+        param_bytes_per_rank = float(sum(
+            s.nbytes for s in jax.tree.leaves(pshards))) / n_chips
+        param_bytes_transient = model_bytes
+    else:
+        param_bytes_per_rank = model_bytes
+        param_bytes_transient = 0.0
+    # Persistent gradient-accumulation state (backward_passes_per_step >
+    # 1 only; stage 1 keeps the full classic accumulator, stage 2/3 the
+    # 1/world shard — zero for k == 1, where gradients are transients).
+    grad_accum_bytes_per_rank = 0.0
+    if (zero or zero3) and isinstance(opt_state, hvd.ZeroState):
+        inner = opt_state.inner
+        if isinstance(inner, hvd.ZeroFullMultiStepsState):
+            grad_accum_bytes_per_rank = float(sum(
+                l.nbytes / n_chips for l in jax.tree.leaves(inner.acc)))
+        elif hasattr(inner, "acc_grads"):
+            grad_accum_bytes_per_rank = float(sum(
+                l.nbytes / n_chips
+                for l in jax.tree.leaves(inner.acc_grads)))
+    bytes_per_rank_total = (opt_state_bytes_per_rank + param_bytes_per_rank
+                            + grad_accum_bytes_per_rank)
+    log(f"bytes/rank: params {param_bytes_per_rank / 1e6:.3f} MB"
+        + (f" (+{param_bytes_transient / 1e6:.3f} MB gather transient)"
+           if zero3 else "")
+        + f", opt state {opt_state_bytes_per_rank / 1e6:.3f} MB, "
+        f"grad accum {grad_accum_bytes_per_rank / 1e6:.3f} MB"
+        + (f" (ZeRO stage {stage})" if stage else " (replicated)"))
     images = jax.device_put(images, data_sh)
     labels = jax.device_put(labels, data_sh)
 
@@ -476,12 +531,21 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     # (auto-psummed replicated grads never touch the fusion path).
     reduce_in_optimizer = bool(args.quantized or getattr(args, "zero", False)
                                or getattr(args, "autotune", False)
-                               or getattr(args, "overlap", False))
+                               or getattr(args, "overlap", False)
+                               or getattr(args, "zero_stage", None)
+                               or stage)
 
     def spmd(p, bs, s, xb, yb):
+        if zero3:
+            # p is the shard tuple; the full params exist only between
+            # here and the end of the backward (per-bucket JIT gather,
+            # forward order, overlapping deeper buckets under compute).
+            pfull = hvd.zero3_gather_params(p, params_tpl, overlap=overlap)
+        else:
+            pfull = p
         (loss, nbs), grads = hvd.value_and_grad(
             loss_fn, has_aux=True,
-            reduce=not reduce_in_optimizer)(p, bs, xb, yb)
+            reduce=not reduce_in_optimizer)(pfull, bs, xb, yb)
         nbs = hvd.allreduce_pytree(nbs, op=hvd.Average)
         updates, ns = tx.update(grads, s, p)
         return optax.apply_updates(p, updates), nbs, ns, hvd.allreduce(loss)
@@ -510,17 +574,21 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     # Donate params/batch_stats/opt_state: the step overwrites them, so XLA
     # can update in place instead of allocating fresh HBM buffers — on a
     # bandwidth-bound chip the avoided copy is measurable.
+    param_spec = pshard_spec if zero3 else P()
+    param_arg = pshards if zero3 else params
     train_step = jax.jit(hvd.shard_map(
         step_body, mesh=mesh,
-        in_specs=(P(), P(), state_spec, hvd.data_pspec(), hvd.data_pspec()),
-        out_specs=(P(), P(), state_spec, P())), donate_argnums=(0, 1, 2))
+        in_specs=(param_spec, P(), state_spec, hvd.data_pspec(),
+                  hvd.data_pspec()),
+        out_specs=(param_spec, P(), state_spec, P())),
+        donate_argnums=(0, 1, 2))
 
     t0 = time.perf_counter()
     from horovod_tpu.ops.collective_ops import record_wire_stats
 
     with record_wire_stats() as wire:
-        lowered = train_step.lower(params, batch_stats, opt_state, images,
-                                   labels)
+        lowered = train_step.lower(param_arg, batch_stats, opt_state,
+                                   images, labels)
     compiled = lowered.compile()
     log(f"compile: {time.perf_counter() - t0:.1f}s")
     log(f"wire bytes/step/device: ICI {wire.ici_bytes / 1e6:.2f} MB, "
@@ -560,27 +628,56 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     train_step = compiled
 
     t0 = time.perf_counter()
+    pstate = param_arg
     for _ in range(args.num_warmup):
-        params, batch_stats, opt_state, loss = train_step(
-            params, batch_stats, opt_state, images, labels)
+        pstate, batch_stats, opt_state, loss = train_step(
+            pstate, batch_stats, opt_state, images, labels)
     # Block on EVERY output, not just the loss: the loss allreduce completes
     # early in the step, so blocking on it alone under-times the tail of the
     # parameter update and flattered iter 0 in round 2's numbers.
-    jax.block_until_ready((params, batch_stats, opt_state, loss))
+    jax.block_until_ready((pstate, batch_stats, opt_state, loss))
     log(f"warmup ({args.num_warmup} steps): "
         f"{time.perf_counter() - t0:.1f}s  loss={float(loss):.3f}")
 
+    # Async checkpoint probe: save the sharded training state mid-window
+    # (each rank's 1/world shards, background write) and measure the
+    # trainer-visible stall — the docs/checkpoint.md A/B contract is
+    # stall ≤ 10% of the step budget it interrupts.
+    ckpt_mgr = ckpt_dir = None
+    ckpt_stalls = []
+    if ckpt_probe:
+        import tempfile
+
+        from horovod_tpu import checkpoint as hvd_ckpt
+
+        ckpt_dir = os.environ.get("HOROVOD_BENCH_CKPT_DIR") or \
+            tempfile.mkdtemp(prefix="bench_ckpt_")
+        ckpt_mgr = hvd_ckpt.CheckpointManager(ckpt_dir, keep=2)
+        from horovod_tpu import monitor as _monitor
+
+        ckpt_commits0 = _monitor.metrics().counter("ckpt.commits").value
+
+    def _ckpt_save(step_no):
+        t = time.perf_counter()
+        ckpt_mgr.save(step_no, {"params": pstate, "opt_state": opt_state},
+                      mesh_shape=mesh_shape)
+        ckpt_stalls.append((time.perf_counter() - t) * 1e3)
+
     profile_iter = min(1, args.num_iters - 1) if args.profile else None
+    save_iters = ({max(0, args.num_iters // 3),
+                   max(0, 2 * args.num_iters // 3)} if ckpt_probe else set())
     img_secs = []
     step_times = []
     for i in range(args.num_iters):
         if i == profile_iter:
             jax.profiler.start_trace(args.profile)
+        if i in save_iters:
+            _ckpt_save(i)
         t0 = time.perf_counter()
         for _ in range(args.num_batches_per_iter):
-            params, batch_stats, opt_state, loss = train_step(
-                params, batch_stats, opt_state, images, labels)
-        jax.block_until_ready((params, batch_stats, opt_state, loss))
+            pstate, batch_stats, opt_state, loss = train_step(
+                pstate, batch_stats, opt_state, images, labels)
+        jax.block_until_ready((pstate, batch_stats, opt_state, loss))
         dt = time.perf_counter() - t0
         steps = args.num_batches_per_iter * args.steps_per_call
         rate = items_per_step * steps / dt
@@ -627,7 +724,32 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     for st in step_times:
         step_hist.observe(st * 1e3)
 
+    ckpt_fields = {}
+    if ckpt_probe and ckpt_mgr is not None:
+        ok = ckpt_mgr.wait(120)
+        commits = (monitor.metrics().counter("ckpt.commits").value
+                   - ckpt_commits0)
+        stall_ms = float(np.median(ckpt_stalls)) if ckpt_stalls else 0.0
+        median_ms = float(np.median(step_times)) * 1e3
+        ckpt_fields = {
+            "ckpt_commits": int(commits),
+            "ckpt_save_stall_ms": round(stall_ms, 3),
+            "ckpt_stall_frac": round(stall_ms / max(1e-9, median_ms), 4),
+            "ckpt_dir": ckpt_dir,
+            "ckpt_drained": bool(ok),
+        }
+        log(f"ckpt probe: {len(ckpt_stalls)} async saves, stall "
+            f"{stall_ms:.2f} ms vs step {median_ms:.2f} ms "
+            f"({100 * stall_ms / max(1e-9, median_ms):.1f}% of a step), "
+            f"{int(commits)} commits in {ckpt_dir}")
+        ckpt_mgr.close()
+
     return {
+        "param_bytes_per_rank": param_bytes_per_rank,
+        "param_bytes_transient": param_bytes_transient,
+        "grad_accum_bytes_per_rank": grad_accum_bytes_per_rank,
+        "bytes_per_rank_total": bytes_per_rank_total,
+        **ckpt_fields,
         "per_chip": per_chip,
         "unit": unit,
         "mfu": mfu,
@@ -644,6 +766,96 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         "opt_state_bytes_per_rank": opt_state_bytes_per_rank,
         "metrics": metrics_snapshot(),
     }
+
+
+def run_stage_parity_probe(devices, mesh_shape, steps=3):
+    """Stage 1/2/3 parity on a tiny model: all three updates run
+    side-by-side in ONE compiled step (the repo's established bitwise
+    methodology, tests/test_zero.py::test_sgd_update_bit_identical...),
+    sharing a single gradient computation, over ``steps`` training
+    steps. Returns the probe dict for the JSON line; raises on parity
+    loss. Stage 1 vs 2 must be BIT-identical across the whole
+    trajectory; stage 3 is bit-identical per update (same gshards, same
+    shard updates) and tracked at ≤1e-5 over the trajectory — across
+    structurally different apply paths XLA's fusion choices (FMA
+    formation) round the final ulp differently, which is compiler noise,
+    not decomposition error (docs/zero.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=mesh_shape)
+    mesh = hvd.mesh()
+    world = hvd.size()
+
+    params0 = {"w": jnp.zeros((37, 4)), "b": jnp.zeros((4,))}
+    tpl = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                       params0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(world * 4 * steps, 37).astype(np.float32)
+    y = (x[:, :4] * 0.3 + 0.1).astype(np.float32)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+    txs = [hvd.DistributedOptimizer(optax.sgd(0.1, momentum=0.9),
+                                    zero_stage=s) for s in (1, 2, 3)]
+    states = [tx.init(params0) for tx in txs]
+    sspecs = [hvd.zero_state_pspecs(s) for s in states]
+    put = lambda t, sp: jax.device_put(  # noqa: E731
+        t, jax.tree.map(lambda q: NamedSharding(mesh, q), sp))
+    states = [put(s, sp) for s, sp in zip(states, sspecs)]
+    psh = hvd.zero3_shard_params(params0)
+    pspec = hvd.zero3_param_pspecs(psh)
+    psh = put(psh, pspec)
+
+    @jax.jit
+    def step(p, psh, s1, s2, s3, xb, yb):
+        def spmd(p, psh, s1, s2, s3, xb, yb):
+            pg = hvd.zero3_gather_params(psh, tpl)
+            _, g = hvd.value_and_grad(loss_fn, zero=True)(pg, (xb, yb))
+            u1, ns1 = txs[0].update(g, s1, p)
+            u2, ns2 = txs[1].update(g, s2, p)
+            u3, ns3 = txs[2].update(g, s3, psh)
+            return (optax.apply_updates(p, u1), optax.apply_updates(p, u2),
+                    optax.apply_updates(psh, u3), ns1, ns2, ns3)
+
+        return hvd.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), pspec, *sspecs, hvd.data_pspec(),
+                      hvd.data_pspec()),
+            out_specs=(P(), P(), pspec, *sspecs))(
+            p, psh, s1, s2, s3, xb, yb)
+
+    p = params0
+    bs = world * 4
+    max_rel3 = 0.0
+    for i in range(steps):
+        xb = jnp.asarray(x[i * bs:(i + 1) * bs])
+        yb = jnp.asarray(y[i * bs:(i + 1) * bs])
+        p1, p2, psh, *states = step(p, psh, *states, xb, yb)
+        p3 = hvd.zero3_gather_params(jax.device_get(psh), params0)
+        for k in p1:
+            a1, a2 = np.asarray(p1[k]), np.asarray(p2[k])
+            if not np.array_equal(a1, a2):
+                raise AssertionError(
+                    f"stage 1 vs 2 diverged at step {i} on {k!r}")
+            a3 = np.asarray(p3[k])
+            denom = np.maximum(np.abs(a1), 1e-12)
+            max_rel3 = max(max_rel3,
+                           float(np.max(np.abs(a1 - a3) / denom)))
+            np.testing.assert_allclose(a1, a3, rtol=1e-5, atol=1e-7)
+        p = p1
+    log(f"stage parity probe: stage1==stage2 bit-identical over {steps} "
+        f"steps; stage3 max rel err {max_rel3:.2e} (<=1e-5)")
+    return {"steps": steps, "stage12_bit_identical": True,
+            "stage3_max_rel_err": max_rel3}
 
 
 def run_serve(args, devices, platform, mesh_shape):
@@ -974,6 +1186,19 @@ def main():
                          "reduce-in-optimizer step and reports "
                          "throughput_delta, opt_state_bytes_per_rank and "
                          "wire bytes (docs/zero.md)")
+    ap.add_argument("--zero-stage", type=int, choices=(1, 2, 3),
+                    default=None,
+                    help="A/B one explicit ZeRO stage against the "
+                         "replicated baseline (docs/zero.md): stage 1 = "
+                         "optimizer-state sharding (classic full-grad "
+                         "accumulator), 2 = + gradient-accumulation "
+                         "shards, 3 = + parameter shards with just-in-"
+                         "time per-bucket gather in the forward. "
+                         "Reports param+grad+state bytes-per-rank, an "
+                         "async-checkpoint stall probe "
+                         "(docs/checkpoint.md), and a stage-parity "
+                         "probe (1/2/3 side-by-side in one program, "
+                         "bit-identical)")
     ap.add_argument("--overlap", action="store_true",
                     help="A/B the overlapped gradient reduction "
                          "(HOROVOD_OVERLAP: reverse-layer bucket "
@@ -1053,10 +1278,10 @@ def main():
 
     if args.serve:
         if args.scaling or args.quantized or args.zero or args.overlap \
-                or args.autotune or args.profile:
+                or args.autotune or args.profile or args.zero_stage:
             ap.error("--serve cannot combine with --scaling/--quantized/"
-                     "--zero/--overlap/--autotune/--profile (the serve "
-                     "leg has its own trace structure)")
+                     "--zero/--zero-stage/--overlap/--autotune/--profile "
+                     "(the serve leg has its own trace structure)")
         for flag in ("serve_prompt_len", "serve_max_new"):
             try:
                 lo, hi = (int(v) for v in getattr(args, flag).split(","))
@@ -1080,18 +1305,24 @@ def main():
         if not sweep or sweep[0] < 1:
             ap.error("--scaling sizes must be >= 1")
         if args.quantized or args.mesh_shape or args.autotune or args.zero \
-                or args.overlap:
+                or args.overlap or args.zero_stage:
             ap.error("--scaling cannot combine with --quantized/"
-                     "--mesh-shape/--autotune/--zero/--overlap (the sweep "
-                     "re-shapes the world per size)")
+                     "--mesh-shape/--autotune/--zero/--zero-stage/"
+                     "--overlap (the sweep re-shapes the world per size)")
     if args.autotune and (args.quantized or args.profile or args.zero
-                          or args.overlap):
+                          or args.overlap or args.zero_stage):
         ap.error("--autotune cannot combine with --quantized/--profile/"
-                 "--zero/--overlap (one A/B structure per run)")
+                 "--zero/--zero-stage/--overlap (one A/B structure per "
+                 "run)")
     if args.zero and args.quantized:
         ap.error("--zero cannot combine with --quantized (one A/B "
                  "structure per run; the quantized ZeRO wire is covered "
                  "by DistributedOptimizer(zero=True, quantized=True) and "
+                 "tests/test_zero.py)")
+    if args.zero_stage and (args.zero or args.quantized or args.overlap):
+        ap.error("--zero-stage cannot combine with --zero/--quantized/"
+                 "--overlap (one A/B structure per run; --zero is the "
+                 "stage-2 alias, and the compose matrix is covered by "
                  "tests/test_zero.py)")
     if args.overlap and (args.quantized or args.zero):
         ap.error("--overlap cannot combine with --quantized/--zero (one "
@@ -1147,7 +1378,7 @@ def main():
         raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
                          f"does not cover {len(devices)} devices")
     if (args.quantized or args.autotune or args.zero or args.overlap
-            or args.serve) \
+            or args.serve or args.zero_stage) \
             and mesh_shape is None \
             and len(devices) % 2 == 0 and len(devices) >= 2:
         # A DCN (cross) hop is what quantization compresses, what the
@@ -1158,6 +1389,7 @@ def main():
         # pinned one.
         mesh_shape = (2, len(devices) // 2)
         which = ("quantized" if args.quantized else "zero" if args.zero
+                 else "zero-stage" if args.zero_stage
                  else "overlap" if args.overlap
                  else "serve" if args.serve else "autotune")
         log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
@@ -1330,6 +1562,76 @@ def main():
             "wire_bytes_ici": round(res_o["wire_bytes_ici"], 1),
             "wire_bytes_dcn": round(res_o["wire_bytes_dcn"], 1),
             "metrics_snapshot": res_o["metrics"],
+            **gpt_fields,
+        }), flush=True)
+        return
+
+    if args.zero_stage:
+        # A/B: replicated baseline vs ONE explicit ZeRO stage, identical
+        # reduce-in-optimizer step structure and mesh. The stage leg also
+        # runs the async-checkpoint stall probe (docs/checkpoint.md) and
+        # the run finishes with the stage-1/2/3 parity probe (one
+        # program, bit-identical — the acceptance contract).
+        stage = args.zero_stage
+        log("=== A/B leg 1/2: baseline (replicated optimizer update) ===")
+        res_b = run_once(args, devices, platform, mesh_shape=mesh_shape)
+        log(f"=== A/B leg 2/2: ZeRO stage {stage} ===")
+        res_z = run_once(args, devices, platform, zero_stage=stage,
+                         mesh_shape=mesh_shape, ckpt_probe=True)
+        parity = run_stage_parity_probe(devices, mesh_shape)
+        delta = res_z["per_chip"] / res_b["per_chip"] - 1.0
+        tot_b, tot_z = (res_b["bytes_per_rank_total"],
+                        res_z["bytes_per_rank_total"])
+        log(f"A/B: replicated {res_b['per_chip']:.1f} vs stage {stage} "
+            f"{res_z['per_chip']:.1f} {res_b['unit']} "
+            f"({100 * delta:+.1f}%); param+grad+state "
+            f"{tot_b / 1e6:.3f} -> {tot_z / 1e6:.3f} MB/rank "
+            f"({tot_b / max(1.0, tot_z):.2f}x)"
+            + (f"; ckpt stall {res_z.get('ckpt_save_stall_ms', 0):.2f} ms "
+               f"({100 * res_z.get('ckpt_stall_frac', 0):.1f}% of a step)"
+               if "ckpt_save_stall_ms" in res_z else ""))
+        print(json.dumps({
+            "metric": metric,
+            "value": round(res_z["per_chip"], 2),
+            "unit": res_z["unit"],
+            "vs_baseline": None,
+            "mfu": (round(res_z["mfu"], 4)
+                    if res_z["mfu"] is not None else None),
+            "step_ms_median": round(res_z["step_ms_median"], 3),
+            "platform": platform,
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+            "chips": res_z["chips"],
+            "per_chip_batch": args.batch_size,
+            "zero_stage": stage,
+            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+                           if mesh_shape else None),
+            "baseline_per_chip": round(res_b["per_chip"], 2),
+            "throughput_delta": round(delta, 4),
+            "bytes_per_rank": {
+                "params": round(res_z["param_bytes_per_rank"], 1),
+                "param_gather_transient": round(
+                    res_z["param_bytes_transient"], 1),
+                "grad_accum": round(res_z["grad_accum_bytes_per_rank"], 1),
+                "opt_state": round(res_z["opt_state_bytes_per_rank"], 1),
+                "total": round(tot_z, 1),
+            },
+            "bytes_per_rank_baseline": {
+                "params": round(res_b["param_bytes_per_rank"], 1),
+                "grad_accum": round(res_b["grad_accum_bytes_per_rank"], 1),
+                "opt_state": round(res_b["opt_state_bytes_per_rank"], 1),
+                "total": round(tot_b, 1),
+            },
+            "bytes_per_rank_reduction": round(
+                tot_b / max(1.0, tot_z), 3),
+            "ckpt_commits": res_z.get("ckpt_commits", 0),
+            "ckpt_save_stall_ms": res_z.get("ckpt_save_stall_ms"),
+            "ckpt_stall_frac": res_z.get("ckpt_stall_frac"),
+            "stage_parity": parity,
+            "wire_bytes_ici": round(res_z["wire_bytes_ici"], 1),
+            "wire_bytes_dcn": round(res_z["wire_bytes_dcn"], 1),
+            "wire_bytes_ici_baseline": round(res_b["wire_bytes_ici"], 1),
+            "wire_bytes_dcn_baseline": round(res_b["wire_bytes_dcn"], 1),
+            "metrics_snapshot": res_z["metrics"],
             **gpt_fields,
         }), flush=True)
         return

@@ -126,9 +126,16 @@ from .parallel.optimizer import (  # noqa: F401
     DistributedOptimizer,
     OverlapMultiStepsState,
     QuantizedEFState,
+    ZeroFullMultiStepsState,
+    ZeroMultiStepsState,
     ZeroOverlapMultiStepsState,
     ZeroState,
     overlap_state_pspecs,
+    zero3_gather_params,
+    zero3_param_pspecs,
+    zero3_plan,
+    zero3_reshard_params,
+    zero3_shard_params,
     zero_reshard_state,
     zero_state_pspecs,
 )
@@ -172,6 +179,7 @@ from .autotune import (  # noqa: F401
 )
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
 from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
+from . import checkpoint  # noqa: F401  (async rank-sharded save/restore)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
 from . import monitor  # noqa: F401  (metrics registry / sinks / span audit)
 from .monitor import (  # noqa: F401

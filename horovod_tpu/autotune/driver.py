@@ -32,7 +32,9 @@ log = logging.getLogger("horovod_tpu.autotune")
 # Cache-entry schema version; bump when TunedParams gains/changes knobs.
 # v2: + zero_sharding (ZeRO-1 sharded optimizer).
 # v3: + overlap / num_comm_streams (overlapped gradient reduction).
-_CACHE_VERSION = 3
+# v4: zero_sharding → zero_stage {0,1,2} (ZeRO-2/3; from_dict still
+#     reads pre-v4 entries, but the key's version gates real reuse).
+_CACHE_VERSION = 4
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a

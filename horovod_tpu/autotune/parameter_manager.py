@@ -12,10 +12,14 @@ The compiled-path differences from the native eager manager:
 * knobs are :class:`TunedParams` — fusion threshold (1–256 MiB,
   log-space), ``quant_block`` (64–1024, log-space, power-of-two snapped,
   searched only when the quantized wire is on), the hierarchical
-  allreduce flag, and the ``zero_sharding`` flag (relaxed categoricals
-  at 0.25/0.75; zero is searched only when the session's step accepts
-  it — it restructures the optimizer state, see docs/zero.md). Cycle time and the response cache do not exist on the
-  compiled path (the XLA schedule replaces both — ops/fusion.py);
+  allreduce flag, and the ``zero_stage`` level (0/1/2 as thirds of the
+  unit axis; searched only when the session's step accepts it — it
+  restructures the optimizer state, see docs/zero.md; stage 3 is
+  excluded from the search because it restructures the TRAINING LOOP —
+  the params become shards — which no tuned_params override can do to
+  an already-built step). Cycle time and the response cache do not
+  exist on the compiled path (the XLA schedule replaces both —
+  ops/fusion.py);
 * scores are wall-clock **steps/sec** of a real training window (the
   driver times them), not coordinator bytes/sec — on the compiled path
   the collective schedule is inside the step, so step rate is the
@@ -49,9 +53,11 @@ _DIMS = 6  # fusion, quant_block, hierarchical, zero, overlap, streams
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
+# zero_sharding (= zero_stage > 0) stays a column for log compatibility;
+# zero_stage carries the actual level.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
-              "hierarchical_allreduce", "zero_sharding", "overlap",
-              "num_comm_streams", "score_steps_per_sec")
+              "hierarchical_allreduce", "zero_sharding", "zero_stage",
+              "overlap", "num_comm_streams", "score_steps_per_sec")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,9 +70,15 @@ class TunedParams:
     fusion_threshold_bytes: int = 64 * 1024 * 1024
     quant_block: int = 256
     hierarchical_allreduce: bool = False
-    zero_sharding: bool = False
+    zero_stage: int = 0
     overlap: bool = False
     num_comm_streams: int = 1
+
+    @property
+    def zero_sharding(self) -> bool:
+        """Back-compat boolean view of ``zero_stage`` (the PR-4 knob):
+        True when any ZeRO stage is on."""
+        return self.zero_stage > 0
 
     def as_dict(self) -> dict:
         return {
@@ -74,6 +86,7 @@ class TunedParams:
             "quant_block": int(self.quant_block),
             "hierarchical_allreduce": bool(self.hierarchical_allreduce),
             "zero_sharding": bool(self.zero_sharding),
+            "zero_stage": int(self.zero_stage),
             "overlap": bool(self.overlap),
             "num_comm_streams": int(self.num_comm_streams),
         }
@@ -81,12 +94,17 @@ class TunedParams:
     @classmethod
     def from_dict(cls, d: dict) -> "TunedParams":
         # .get: entries cached before the zero/overlap knobs existed stay
-        # readable (the cache key's schema version gates real reuse).
+        # readable (the cache key's schema version gates real reuse);
+        # a pre-v4 boolean zero_sharding maps to stage 2 (the PR-4
+        # behavior it named).
+        stage = d.get("zero_stage")
+        if stage is None:
+            stage = 2 if d.get("zero_sharding", False) else 0
         return cls(
             fusion_threshold_bytes=int(d["fusion_threshold_bytes"]),
             quant_block=int(d["quant_block"]),
             hierarchical_allreduce=bool(d["hierarchical_allreduce"]),
-            zero_sharding=bool(d.get("zero_sharding", False)),
+            zero_stage=int(stage),
             overlap=bool(d.get("overlap", False)),
             num_comm_streams=int(d.get("num_comm_streams", 1)),
         )
@@ -96,11 +114,14 @@ class TunedParams:
         """Seed from a :class:`horovod_tpu.common.config.Config` (the
         hand-set env knobs are trial 0, as in the reference where tuning
         starts from the configured values)."""
+        stage = getattr(config, "zero_stage", 0)
+        if not stage and getattr(config, "zero_sharding", False):
+            stage = 2
         return cls(
             fusion_threshold_bytes=config.fusion_threshold_bytes,
             quant_block=config.quant_block,
             hierarchical_allreduce=config.hierarchical_allreduce,
-            zero_sharding=getattr(config, "zero_sharding", False),
+            zero_stage=stage,
             overlap=getattr(config, "overlap", False),
             num_comm_streams=getattr(config, "num_comm_streams", 1),
         )
@@ -205,7 +226,9 @@ class ParameterManager:
             # Booleans (relaxed categoricals) sit at 0.25/0.75, well
             # inside the box.
             0.75 if p.hierarchical_allreduce else 0.25,
-            0.75 if p.zero_sharding else 0.25,
+            # zero_stage 0/1/2 sits at the thirds' centers (stage 3
+            # restructures the training loop and is never searched).
+            (min(p.zero_stage, 2) + 0.5) / 3.0,
             0.75 if p.overlap else 0.25,
             s / _MAX_STREAMS_LOG,
         )
@@ -222,8 +245,8 @@ class ParameterManager:
             qblock = self.initial.quant_block
         hier = (u[2] >= 0.5 if self.tune_hierarchical
                 else self.initial.hierarchical_allreduce)
-        zero = (u[3] >= 0.5 if self.tune_zero
-                else self.initial.zero_sharding)
+        stage = (min(2, int(u[3] * 3)) if self.tune_zero
+                 else self.initial.zero_stage)
         if self.tune_overlap:
             ov = u[4] >= 0.5
             # pow2 snap 1-4; only meaningful with overlap on — pin the
@@ -239,7 +262,7 @@ class ParameterManager:
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
             hierarchical_allreduce=hier,
-            zero_sharding=zero,
+            zero_stage=stage,
             overlap=ov,
             num_comm_streams=ns,
         )
@@ -250,7 +273,7 @@ class ParameterManager:
         # Fusion threshold dedups at 1/4-octave resolution — finer than
         # that cannot change a bucket plan by more than rounding.
         return (round(math.log2(max(1, p.fusion_threshold_bytes)) * 4),
-                p.quant_block, p.hierarchical_allreduce, p.zero_sharding,
+                p.quant_block, p.hierarchical_allreduce, p.zero_stage,
                 p.overlap, p.num_comm_streams)
 
     # -- sampling loop ---------------------------------------------------
@@ -291,6 +314,7 @@ class ParameterManager:
                             p.quant_block,
                             int(p.hierarchical_allreduce),
                             int(p.zero_sharding),
+                            int(p.zero_stage),
                             int(p.overlap),
                             int(p.num_comm_streams),
                             f"{score:.6g}"])
@@ -302,11 +326,11 @@ class ParameterManager:
         self.close()
         log.info(
             "autotune converged after %d samples: fusion_threshold=%d "
-            "quant_block=%d hierarchical=%s zero=%s overlap=%s streams=%d "
-            "(best %.3f steps/sec)",
+            "quant_block=%d hierarchical=%s zero_stage=%d overlap=%s "
+            "streams=%d (best %.3f steps/sec)",
             len(self.history), self.best.fusion_threshold_bytes,
             self.best.quant_block, self.best.hierarchical_allreduce,
-            self.best.zero_sharding, self.best.overlap,
+            self.best.zero_stage, self.best.overlap,
             self.best.num_comm_streams, self.best_score)
 
     def _sample_unit(self) -> Tuple[float, ...]:
@@ -366,6 +390,9 @@ def read_log(path: str) -> List[dict]:
     rows: List[dict] = []
     with open(path, newline="") as f:
         for rec in csv.DictReader(f):
+            sharding = bool(int(rec.get("zero_sharding", 0) or 0))
+            # Pre-v4 logs carried only the boolean; it named stage 2.
+            stage = int(rec.get("zero_stage", 2 if sharding else 0) or 0)
             rows.append({
                 "sample": int(rec["sample"]),
                 "fusion_threshold_bytes": int(
@@ -373,7 +400,8 @@ def read_log(path: str) -> List[dict]:
                 "quant_block": int(rec["quant_block"]),
                 "hierarchical_allreduce": bool(
                     int(rec["hierarchical_allreduce"])),
-                "zero_sharding": bool(int(rec.get("zero_sharding", 0))),
+                "zero_sharding": sharding or stage > 0,
+                "zero_stage": stage,
                 "overlap": bool(int(rec.get("overlap", 0) or 0)),
                 "num_comm_streams": int(rec.get("num_comm_streams", 1)
                                         or 1),

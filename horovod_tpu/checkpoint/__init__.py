@@ -1,0 +1,45 @@
+"""Async rank-sharded checkpointing (docs/checkpoint.md).
+
+The scale axis ZeRO opens (docs/zero.md) only holds if recovery fits the
+same budget: a model whose parameters, gradients, and optimizer state
+exist ONLY as 1/world shards must also checkpoint and restore without
+ever materializing the global arrays on one host. This package does
+that:
+
+* each rank writes only its own shards (``addressable_shards`` of the
+  ``P(HVD_AXES)`` leaves — flat bucket moments, stage-3 parameter
+  shards, EF residuals), device→host snapshot at a step boundary, then
+  a background double-buffered writer (:mod:`.writer`) — the trainer
+  stalls for the snapshot only (``ckpt.save_ms``);
+* a manifest-led layout (:mod:`.layout`): world/mesh geometry, a
+  bucket-plan digest, per-shard crc32 checksums, atomic tmp→rename
+  commit, retention of the last K steps;
+* restore (:mod:`.manager`) verifies every checksum (corrupt shards
+  raise :class:`CheckpointCorruptError`, never load), reassembles the
+  exact global form, and — across world-size changes — hands off to the
+  exact ``hvd.zero_reshard_state`` / ``hvd.zero3_reshard_params`` so a
+  resized resume is bit-identical (scripts/ckpt_smoke.sh);
+* :class:`CheckpointedJaxState` (:mod:`.elastic`) rides the
+  ``hvd.elastic`` commit/restore protocol, making chaos-injected crashes
+  and elastic resizes resume from the last committed step
+  (scripts/chaos_soak.py --fault ckpt).
+
+Metrics: ``ckpt.save_ms`` / ``ckpt.write_ms`` / ``ckpt.restore_ms``
+histograms, ``ckpt.commits`` / ``ckpt.restores`` / ``ckpt.bytes``
+counters, ``ckpt.last_step`` gauge; Timeline spans ``CKPT:SNAPSHOT`` /
+``CKPT:WRITE`` / ``CKPT:RESTORE`` and the ``CKPT:COMMIT`` instant
+(docs/observability.md).
+"""
+
+from __future__ import annotations
+
+from .layout import (  # noqa: F401
+    CheckpointCorruptError,
+    LeafEntry,
+    Manifest,
+    list_steps,
+    plan_digest_for,
+)
+from .manager import CheckpointManager  # noqa: F401
+from .writer import AsyncWriter  # noqa: F401
+from .elastic import CheckpointedJaxState  # noqa: F401

@@ -1,0 +1,192 @@
+"""On-disk layout of a rank-sharded checkpoint (docs/checkpoint.md).
+
+A checkpoint directory holds one subdirectory per committed step::
+
+    <directory>/
+      step_0000000042/
+        MANIFEST.json            # world/mesh/plan digest + entry table
+        <key>.treedef.pkl        # pickled pytree structure per top key
+        <key>.leaf0003.rep.npy   # a replicated leaf (written once)
+        <key>.leaf0007.rank002.npy   # rank 2's shard of a sharded leaf
+      step_0000000050/
+        ...
+      step_0000000050.tmp-<pid>/     # in-flight save (never read)
+
+The manifest is written LAST inside the tmp directory, then the whole
+directory commits with one atomic ``os.replace`` — a reader either sees a
+complete checkpoint or none at all, and a crash mid-write leaves only a
+``.tmp-*`` orphan that the next save sweeps. Every payload file carries a
+crc32 in the manifest; restore verifies before deserializing and fails
+loudly on mismatch (:class:`CheckpointCorruptError`) rather than loading
+garbage into a training run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+MANIFEST = "MANIFEST.json"
+LAYOUT_VERSION = 1
+
+_STEP_RE = re.compile(r"^step_(\d{10})$")
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint shard failed its checksum (or the manifest is
+    malformed): the data on disk is NOT what the writer committed. Raised
+    instead of silently loading garbage — restore from an earlier step or
+    re-seed."""
+
+
+def step_dir_name(step: int) -> str:
+    return f"step_{int(step):010d}"
+
+
+def parse_step_dir(name: str) -> Optional[int]:
+    m = _STEP_RE.match(name)
+    return int(m.group(1)) if m else None
+
+
+def list_steps(directory: str) -> List[int]:
+    """Committed steps in ascending order (tmp dirs excluded)."""
+    if not os.path.isdir(directory):
+        return []
+    steps = []
+    for name in os.listdir(directory):
+        s = parse_step_dir(name)
+        if s is not None and os.path.exists(
+                os.path.join(directory, name, MANIFEST)):
+            steps.append(s)
+    return sorted(steps)
+
+
+def checksum(data: bytes) -> str:
+    """crc32 of the payload bytes — cheap enough to run inline on every
+    shard at save AND restore (the corruption this guards against is
+    torn/bit-rotted files, not adversaries)."""
+    return f"crc32:{zlib.crc32(data) & 0xFFFFFFFF:08x}"
+
+
+@dataclasses.dataclass
+class LeafEntry:
+    """One pytree leaf in the manifest entry table.
+
+    ``kind`` is ``"replicated"`` (one file, every rank holds the value)
+    or ``"sharded"`` (``world`` files, rank-major leading-axis shards —
+    the ZeRO flat-bucket / leading-axis-residual convention). ``files``
+    maps a relative path to its checksum; sharded entries also carry the
+    per-file rank in ``ranks`` (aligned with ``files`` order)."""
+
+    key: str
+    index: int
+    kind: str
+    dtype: str
+    shape: Tuple[int, ...]
+    files: Dict[str, str]
+    ranks: Optional[List[int]] = None
+
+    def to_json(self) -> dict:
+        d = {"key": self.key, "index": self.index, "kind": self.kind,
+             "dtype": self.dtype, "shape": list(self.shape),
+             "files": self.files}
+        if self.ranks is not None:
+            d["ranks"] = self.ranks
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "LeafEntry":
+        return cls(key=d["key"], index=int(d["index"]), kind=d["kind"],
+                   dtype=d["dtype"], shape=tuple(d["shape"]),
+                   files=dict(d["files"]),
+                   ranks=list(d["ranks"]) if "ranks" in d else None)
+
+
+@dataclasses.dataclass
+class Manifest:
+    """The checkpoint's self-description — what restore (and the reshard
+    path) needs without touching a payload file: the world/mesh geometry
+    it was written at, the bucket-plan digest (so a restore can detect a
+    changed fusion threshold or model signature before deserializing
+    anything), and the per-leaf entry/checksum table."""
+
+    step: int
+    world: int
+    local_size: int
+    mesh_shape: Optional[Tuple[int, int]]
+    plan_digest: str
+    entries: List[LeafEntry]
+    treedefs: Dict[str, Dict[str, str]]  # key -> {file, checksum}
+    version: int = LAYOUT_VERSION
+    extra: Optional[dict] = None
+
+    def to_json(self) -> dict:
+        return {
+            "version": self.version,
+            "step": self.step,
+            "world": self.world,
+            "local_size": self.local_size,
+            "mesh_shape": (list(self.mesh_shape)
+                           if self.mesh_shape else None),
+            "plan_digest": self.plan_digest,
+            "treedefs": self.treedefs,
+            "entries": [e.to_json() for e in self.entries],
+            "extra": self.extra or {},
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Manifest":
+        return cls(
+            step=int(d["step"]),
+            world=int(d["world"]),
+            local_size=int(d.get("local_size", d["world"])),
+            mesh_shape=(tuple(d["mesh_shape"]) if d.get("mesh_shape")
+                        else None),
+            plan_digest=d.get("plan_digest", ""),
+            entries=[LeafEntry.from_json(e) for e in d.get("entries", [])],
+            treedefs=dict(d.get("treedefs", {})),
+            version=int(d.get("version", 1)),
+            extra=d.get("extra") or {},
+        )
+
+
+def write_manifest(step_dir: str, manifest: Manifest) -> None:
+    path = os.path.join(step_dir, MANIFEST)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest.to_json(), f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_manifest(step_dir: str) -> Manifest:
+    path = os.path.join(step_dir, MANIFEST)
+    try:
+        with open(path) as f:
+            return Manifest.from_json(json.load(f))
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable checkpoint manifest {path}: {e}") from e
+
+
+def plan_digest_for(tree: Any) -> str:
+    """Structure digest of a saved tree: md5 over treedef + leaf
+    shapes/dtypes — the same signature idea as the autotune warm-start
+    cache key (values never enter), so a restore against a DIFFERENT
+    model or leaf order is caught by the manifest, not by a shape error
+    three layers deep."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree.flatten(tree)
+    parts = [str(treedef)]
+    for leaf in leaves:
+        parts.append(f"{jnp.shape(leaf)}:{jnp.asarray(leaf).dtype}")
+    return hashlib.md5("|".join(parts).encode()).hexdigest()

@@ -1,0 +1,423 @@
+"""CheckpointManager: async rank-sharded save / reshard-aware restore.
+
+The save path is built around where a ZeRO step's state already lives
+(docs/zero.md): every sharded leaf — flat bucket moments, stage-3
+parameter shards, leading-axis EF residuals — rides ``P(HVD_AXES)`` on
+its leading axis, so "each rank writes only its 1/world shards" is
+literally iterating ``addressable_shards`` and writing each device's
+slice as its own rank-major file. Nothing gathers: the global array is
+never materialized on any host, which is the point — a model whose
+optimizer state only exists sharded can still checkpoint.
+
+Save is split into a blocking device→host snapshot (jax arrays are
+immutable, but the NEXT step may donate these exact buffers, so the
+host copy must land before the trainer resumes) and a background write
+(serialize + checksum + atomic commit) on the :class:`AsyncWriter`'s
+double buffer. The trainer's stall is the snapshot + an enqueue —
+``ckpt.save_ms`` measures exactly that.
+
+Restore reassembles each sharded leaf by rank-major concatenation into
+its GLOBAL host form (exact — the shard layout is contiguous by
+construction), verifying every file's checksum first. A restore at a
+DIFFERENT world size returns the same global form; the caller (or
+:class:`~horovod_tpu.checkpoint.elastic.CheckpointedJaxState`) then runs
+``hvd.zero_reshard_state`` / ``hvd.zero3_reshard_params`` before
+``device_put`` — both are exact, which is what makes kill→restore at a
+new world bit-identical (scripts/ckpt_smoke.sh proves it end to end).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common import basics
+from ..monitor import registry as _metrics
+from . import layout
+from .layout import CheckpointCorruptError, Manifest, LeafEntry
+from .writer import AsyncWriter
+
+log = logging.getLogger("horovod_tpu.checkpoint")
+
+
+def _timeline():
+    return basics._state.timeline if basics.is_initialized() else None
+
+
+def _tl_span(tid: str, activity: str):
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        tl = _timeline()
+        if tl is not None:
+            tl.begin(tid, activity)
+        try:
+            yield
+        finally:
+            tl = _timeline()
+            if tl is not None:
+                tl.end(tid, activity)
+
+    return cm()
+
+
+def _is_jax_array(x) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except Exception:  # jax missing in a launcher process
+        return False
+
+
+def _snapshot_leaf(leaf) -> Tuple[str, Any]:
+    """Device→host copy of one leaf.
+
+    Returns ``("replicated", ndarray)`` or
+    ``("sharded", [(rank, ndarray_shard), ...], global_shape)``. A leaf
+    counts as sharded when its jax sharding splits the leading axis
+    (every ZeRO state leaf rides ``P(HVD_AXES)``); each addressable
+    shard maps to its rank by offset — rank-major is the flat-bucket
+    contract (ops/fusion.py)."""
+    if _is_jax_array(leaf) and not leaf.is_fully_replicated:
+        shards = []
+        gshape = tuple(leaf.shape)
+        for s in leaf.addressable_shards:
+            data = np.asarray(s.data)
+            start = s.index[0].start or 0
+            seg = data.shape[0]
+            if seg == 0 or gshape[0] % seg:
+                raise ValueError(
+                    f"unsupported sharding for checkpoint: leaf "
+                    f"{gshape} has a {data.shape} shard (not an even "
+                    f"leading-axis split)")
+            shards.append((start // seg, data))
+        # A leaf replicated ACROSS one mesh axis but sharded over the
+        # other can yield duplicate ranks; keep one copy per rank.
+        seen: Dict[int, Any] = {}
+        for r, d in shards:
+            seen.setdefault(r, d)
+        return ("sharded", sorted(seen.items()), gshape)
+    return ("replicated", np.asarray(leaf))
+
+
+class CheckpointManager:
+    """Async rank-sharded checkpointing with manifest-led atomic commits
+    and retention of the last K steps (docs/checkpoint.md).
+
+    ::
+
+        mgr = hvd.checkpoint.CheckpointManager("/ckpt/run1", keep=3)
+        mgr.save(step, {"params": params, "opt_state": state,
+                        "rng": rng_key})          # blocks ~snapshot only
+        ...
+        meta, tree = mgr.restore()                # latest committed step
+        state = hvd.zero_reshard_state(tree["opt_state"], params0,
+                                       from_world=meta.world,
+                                       to_world=hvd.size())
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3,
+                 async_save: bool = True) -> None:
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = os.path.abspath(directory)
+        self.keep = keep
+        self.async_save = async_save
+        os.makedirs(self.directory, exist_ok=True)
+        self._writer = AsyncWriter() if async_save else None
+        self._closed = False
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, step: int, tree: Dict[str, Any], *,
+             world: Optional[int] = None,
+             local_size: Optional[int] = None,
+             mesh_shape: Optional[Tuple[int, int]] = None,
+             extra: Optional[dict] = None,
+             blocking: bool = False) -> None:
+        """Snapshot ``tree`` (a dict of named pytrees) and commit it as
+        ``step`` off the critical path.
+
+        The call blocks only for the device→host snapshot (plus writer
+        backpressure when two saves are already in flight); everything
+        else — serialization, checksums, the atomic tmp→rename commit,
+        retention — runs on the background writer. ``blocking=True``
+        forces the whole write inline (restore-path tests; final save
+        before exit)."""
+        if self._closed:
+            raise RuntimeError("CheckpointManager is closed")
+        if not isinstance(tree, dict) or not tree:
+            raise ValueError("save() takes a non-empty {name: pytree} "
+                             "dict (names become file stems)")
+        for key in tree:
+            if not key or "/" in key or key.startswith("."):
+                raise ValueError(f"bad checkpoint key {key!r}")
+        if world is None:
+            world = basics.size() if basics.is_initialized() else 1
+        if local_size is None:
+            local_size = (basics.local_size()
+                          if basics.is_initialized() else world)
+        t0 = time.perf_counter()
+        with _tl_span("ckpt", "CKPT:SNAPSHOT"):
+            import jax
+
+            snap: Dict[str, Tuple[Any, List[Tuple[str, Any]]]] = {}
+            digest_src: Dict[str, Any] = {}
+            for key, subtree in tree.items():
+                leaves, treedef = jax.tree.flatten(subtree)
+                snap[key] = (treedef, [_snapshot_leaf(l) for l in leaves])
+                digest_src[key] = subtree
+            digest = layout.plan_digest_for(digest_src)
+        manifest = Manifest(step=int(step), world=int(world),
+                            local_size=int(local_size),
+                            mesh_shape=mesh_shape, plan_digest=digest,
+                            entries=[], treedefs={}, extra=extra)
+
+        def job() -> None:
+            self._write_committed(manifest, snap)
+
+        if self._writer is not None and not blocking:
+            self._writer.submit(job)
+        else:
+            job()
+        stall_ms = (time.perf_counter() - t0) * 1e3
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.histogram("ckpt.save_ms").observe(stall_ms)
+            r.counter("ckpt.snapshots").inc()
+
+    def _write_committed(self, manifest: Manifest, snap) -> None:
+        t0 = time.perf_counter()
+        final = os.path.join(self.directory,
+                             layout.step_dir_name(manifest.step))
+        tmp = f"{final}.tmp-{os.getpid()}"
+        total_bytes = 0
+        with _tl_span("ckpt", "CKPT:WRITE"):
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+            for key, (treedef, leaves) in snap.items():
+                td_bytes = pickle.dumps(treedef)
+                td_file = f"{key}.treedef.pkl"
+                with open(os.path.join(tmp, td_file), "wb") as f:
+                    f.write(td_bytes)
+                manifest.treedefs[key] = {
+                    "file": td_file,
+                    "checksum": layout.checksum(td_bytes)}
+                for i, rec in enumerate(leaves):
+                    if rec[0] == "replicated":
+                        _, arr = rec
+                        fname = f"{key}.leaf{i:04d}.rep.npy"
+                        files = {fname: self._write_npy(tmp, fname, arr)}
+                        total_bytes += arr.nbytes
+                        manifest.entries.append(LeafEntry(
+                            key=key, index=i, kind="replicated",
+                            dtype=str(arr.dtype),
+                            shape=tuple(arr.shape), files=files))
+                    else:
+                        _, shards, gshape = rec
+                        files: Dict[str, str] = {}
+                        ranks: List[int] = []
+                        dtype = None
+                        for rank, arr in shards:
+                            fname = f"{key}.leaf{i:04d}.rank{rank:03d}.npy"
+                            files[fname] = self._write_npy(tmp, fname, arr)
+                            ranks.append(rank)
+                            total_bytes += arr.nbytes
+                            dtype = arr.dtype
+                        manifest.entries.append(LeafEntry(
+                            key=key, index=i, kind="sharded",
+                            dtype=str(dtype), shape=tuple(gshape),
+                            files=files, ranks=ranks))
+            layout.write_manifest(tmp, manifest)
+            # The atomic commit. Re-saving an already-committed step
+            # (an elastic resume re-pinning its restore point) swaps the
+            # old directory out first — os.replace cannot replace a
+            # non-empty directory — so a reader never sees a partial
+            # step: either the old commit, the new one, or (crash
+            # between the two renames) no step dir, falling back to the
+            # previous retained step.
+            old = None
+            if os.path.exists(final):
+                old = f"{final}.old-{os.getpid()}"
+                os.replace(final, old)
+            os.replace(tmp, final)
+            if old is not None:
+                shutil.rmtree(old, ignore_errors=True)
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.counter("ckpt.commits").inc()
+            r.counter("ckpt.bytes").inc(float(total_bytes))
+            r.gauge("ckpt.last_step").set(float(manifest.step))
+            r.histogram("ckpt.write_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        tl = _timeline()
+        if tl is not None:
+            tl.instant("CKPT:COMMIT", tid="ckpt",
+                       args={"step": manifest.step,
+                             "bytes": total_bytes})
+        self._apply_retention()
+
+    @staticmethod
+    def _write_npy(dirpath: str, fname: str, arr) -> str:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.asarray(arr), allow_pickle=False)
+        data = buf.getvalue()
+        with open(os.path.join(dirpath, fname), "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        return layout.checksum(data)
+
+    def _apply_retention(self) -> None:
+        """Keep the last K committed steps; sweep stale tmp orphans."""
+        steps = layout.list_steps(self.directory)
+        for s in steps[:-self.keep]:
+            path = os.path.join(self.directory, layout.step_dir_name(s))
+            shutil.rmtree(path, ignore_errors=True)
+            log.info("checkpoint retention: dropped step %d", s)
+        for name in os.listdir(self.directory):
+            if ".tmp-" in name:
+                base = name.split(".tmp-", 1)[0]
+                s = layout.parse_step_dir(base)
+                committed = s is not None and s in steps
+                # An orphan from a crashed writer is safe to sweep once
+                # its step committed, or when nothing is in flight here.
+                if committed or not self.busy:
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+
+    # -- query -----------------------------------------------------------
+
+    @property
+    def busy(self) -> bool:
+        return self._writer is not None and self._writer.busy
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Drain in-flight saves; re-raises background write errors."""
+        if self._writer is None:
+            return True
+        return self._writer.drain(timeout)
+
+    def steps(self) -> List[int]:
+        return layout.list_steps(self.directory)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # -- restore ---------------------------------------------------------
+
+    def restore(self, step: Optional[int] = None, *,
+                verify: bool = True) -> Tuple[Manifest, Dict[str, Any]]:
+        """Load a committed checkpoint into its GLOBAL host form.
+
+        Sharded leaves reassemble by rank-major concatenation (exact);
+        every payload file's checksum is verified first (``verify=False``
+        is for forensics only) — a mismatch raises
+        :class:`CheckpointCorruptError` instead of handing a half-rotten
+        state to a training run. Returns ``(manifest, {key: pytree})``;
+        reshard with ``hvd.zero_reshard_state`` /
+        ``hvd.zero3_reshard_params`` when ``manifest.world`` differs from
+        the world you are restoring into, then ``device_put``."""
+        t0 = time.perf_counter()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no committed checkpoint under {self.directory}")
+        step_dir = os.path.join(self.directory,
+                                layout.step_dir_name(step))
+        with _tl_span("ckpt", "CKPT:RESTORE"):
+            import jax
+
+            manifest = layout.read_manifest(step_dir)
+            by_key: Dict[str, List[LeafEntry]] = {}
+            for e in manifest.entries:
+                by_key.setdefault(e.key, []).append(e)
+            out: Dict[str, Any] = {}
+            for key, td_rec in manifest.treedefs.items():
+                td_path = os.path.join(step_dir, td_rec["file"])
+                td_bytes = self._read_verified(td_path, td_rec["checksum"],
+                                               verify)
+                treedef = pickle.loads(td_bytes)
+                leaves: List[Any] = []
+                for e in sorted(by_key.get(key, []), key=lambda x: x.index):
+                    leaves.append(self._load_entry(step_dir, e, verify))
+                out[key] = jax.tree.unflatten(treedef, leaves)
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.counter("ckpt.restores").inc()
+            r.histogram("ckpt.restore_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+        return manifest, out
+
+    def _load_entry(self, step_dir: str, e: LeafEntry, verify: bool):
+        import io
+
+        if e.kind == "replicated":
+            (fname, csum), = e.files.items()
+            data = self._read_verified(os.path.join(step_dir, fname),
+                                       csum, verify)
+            arr = np.load(io.BytesIO(data), allow_pickle=False)
+        else:
+            by_rank = sorted(zip(e.ranks, e.files.items()))
+            parts = []
+            expect = set(range(len(by_rank)))
+            got = {r for r, _ in by_rank}
+            if got != expect:
+                raise CheckpointCorruptError(
+                    f"sharded leaf {e.key}[{e.index}] has ranks "
+                    f"{sorted(got)}, expected {sorted(expect)} — a rank's "
+                    f"shard files are missing from the commit")
+            for _, (fname, csum) in by_rank:
+                data = self._read_verified(os.path.join(step_dir, fname),
+                                           csum, verify)
+                parts.append(np.load(io.BytesIO(data), allow_pickle=False))
+            arr = np.concatenate(parts, axis=0)
+        if tuple(arr.shape) != e.shape:
+            raise CheckpointCorruptError(
+                f"leaf {e.key}[{e.index}] reassembled to {arr.shape}, "
+                f"manifest says {e.shape}")
+        return arr
+
+    @staticmethod
+    def _read_verified(path: str, csum: str, verify: bool) -> bytes:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"missing checkpoint payload {path}: {e}") from e
+        if verify and layout.checksum(data) != csum:
+            raise CheckpointCorruptError(
+                f"checksum mismatch on {path}: file has "
+                f"{layout.checksum(data)}, manifest committed {csum} — "
+                f"refusing to load corrupt state (restore an earlier "
+                f"step)")
+        return data
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self._writer is not None:
+            self._writer.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -73,9 +73,11 @@ class Config:
     quantized_allreduce: bool = False
     quant_block: int = 256  # elements per int8 scale block
 
-    # --- ZeRO-1 sharded optimizer (no reference analogue; reduce-scatter
-    #     data parallelism with per-rank optax updates, docs/zero.md) ---
+    # --- ZeRO sharded optimizer (no reference analogue; reduce-scatter
+    #     data parallelism with per-rank optax updates, docs/zero.md).
+    #     zero_stage 0-3 wins; the PR-4 boolean maps to stage 2. ---
     zero_sharding: bool = False
+    zero_stage: int = 0
 
     # --- overlapped gradient reduction (docs/overlap.md): stream fused
     #     buckets into collectives while backward compute still runs ---
@@ -141,6 +143,7 @@ def from_env() -> Config:
         quantized_allreduce=_env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False),
         quant_block=_env_int("HOROVOD_QUANT_BLOCK", 256),
         zero_sharding=_env_bool("HOROVOD_ZERO_SHARDING", False),
+        zero_stage=_env_int("HOROVOD_ZERO_STAGE", 0),
         overlap=_env_bool("HOROVOD_OVERLAP", False),
         num_comm_streams=_env_int("HOROVOD_NUM_COMM_STREAMS", 1),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),

@@ -92,7 +92,7 @@ def plan_buckets(
     unit = int(np.lcm(ATOMIC_UNIT, max(1, int(shard_multiple))))
     by_dtype: dict = {}
     for i, leaf in enumerate(leaves):
-        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+        by_dtype.setdefault(_leaf_dtype(leaf), []).append(i)
 
     buckets: List[Bucket] = []
     for dtype, idxs in by_dtype.items():
@@ -101,7 +101,7 @@ def plan_buckets(
         cur_elems = 0
         max_elems = max(ATOMIC_UNIT, threshold_bytes // itemsize)
         for i in idxs:
-            n = int(np.prod(jnp.shape(leaves[i]), dtype=np.int64)) or 1
+            n = int(np.prod(_leaf_shape(leaves[i]), dtype=np.int64)) or 1
             if cur_idx and cur_elems + n > max_elems:
                 buckets.append(_close_bucket(dtype, cur_idx, leaves, unit))
                 cur_idx, cur_elems = [], 0
@@ -116,9 +116,22 @@ def plan_buckets(
     return buckets
 
 
+def _leaf_dtype(leaf):
+    """Leaf dtype without materializing the value — abstract leaves
+    (``jax.ShapeDtypeStruct`` templates, the ZeRO-3 gather path) plan
+    identically to concrete arrays."""
+    dt = getattr(leaf, "dtype", None)
+    return jnp.dtype(dt) if dt is not None else jnp.asarray(leaf).dtype
+
+
+def _leaf_shape(leaf) -> Tuple[int, ...]:
+    s = getattr(leaf, "shape", None)
+    return tuple(s) if s is not None else tuple(jnp.shape(leaf))
+
+
 def _close_bucket(dtype, idxs: List[int], leaves,
                   unit: int = ATOMIC_UNIT) -> Bucket:
-    shapes = tuple(tuple(jnp.shape(leaves[i])) for i in idxs)
+    shapes = tuple(_leaf_shape(leaves[i]) for i in idxs)
     sizes = tuple(int(np.prod(s, dtype=np.int64)) or 1 for s in shapes)
     total = sum(sizes)
     padded = ((total + unit - 1) // unit) * unit
@@ -145,6 +158,23 @@ def stream_order(buckets: Sequence[Bucket]) -> Tuple[int, ...]:
     leaf indices are unique) break by bucket index for determinism."""
     return tuple(sorted(range(len(buckets)),
                         key=lambda j: (-max(buckets[j].leaf_indices), j)))
+
+
+def gather_order(buckets: Sequence[Bucket]) -> Tuple[int, ...]:
+    """Forward-order bucket issue schedule — :func:`stream_order`'s
+    mirror for the ZeRO-3 just-in-time parameter gather (docs/zero.md).
+
+    The forward pass consumes parameters input-side first: for a
+    forward-ordered pytree the LOWEST leaf indices are needed earliest.
+    Issuing the bucket holding the lowest leaf index first lets the
+    latency-hiding scheduler run the gathers of deeper layers' buckets
+    under the compute of the layers already gathered — T3's fine-grained
+    prologue overlap at bucket granularity. Contents are untouched
+    (leaf→bucket assignment comes from :func:`plan_buckets`), so any
+    issue order computes bit-identical values; ties break by bucket
+    index for determinism."""
+    return tuple(sorted(range(len(buckets)),
+                        key=lambda j: (min(buckets[j].leaf_indices), j)))
 
 
 def _resolve_overlap(overlap, num_comm_streams, tuned_params):

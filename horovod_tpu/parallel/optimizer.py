@@ -20,8 +20,8 @@ reference needs its background thread + ready-event machinery
 accumulation (torch/optimizer.py:67-68,133-149): gradients are accumulated
 locally for k microbatches and allreduced once, via ``optax.MultiSteps``.
 
-ZeRO-1 sharded optimizer (``zero=True`` / ``HOROVOD_ZERO_SHARDING=1``)
-----------------------------------------------------------------------
+ZeRO sharded optimizer (``zero_stage={1,2,3}`` / ``HOROVOD_ZERO_STAGE``)
+------------------------------------------------------------------------
 The reference optimizer allreduces full gradients and then has every rank
 redundantly run the identical update on a full replica of the moments —
 on a pod that wastes ``(world-1)/world`` of the optimizer-state HBM and
@@ -34,6 +34,27 @@ leaves riding ``P(HVD_AXES)``, cutting optimizer-state bytes per rank by
 ``world``×, and because the whole step compiles, XLA overlaps the
 all-gather of early buckets with the update math of later ones — the
 compile-time analogue of T3's fine-grained compute/collective overlap.
+
+The three stages shard progressively more of the step's persistent
+state (docs/zero.md):
+
+* **stage 1** — optimizer state only. With
+  ``backward_passes_per_step`` k > 1 the gradient accumulator is the
+  classic FULL local-gradient pytree (per-rank leading-axis state,
+  :class:`ZeroFullMultiStepsState`) — what ZeRO-2 exists to shrink.
+* **stage 2** — + gradient-accumulation state: accumulation happens
+  AFTER the reduce-scatter on the scattered shard
+  (:class:`ZeroMultiStepsState`), so the accumulator is a
+  ``[padded // world]`` leaf — grad-state bytes drop ``world``×.
+  ``zero=True`` (the PR-4 spelling) is an alias for stage 2; with
+  k == 1 stages 1 and 2 are the same program.
+* **stage 3** — + parameters: the training loop holds only this rank's
+  flat bucket shards (:func:`zero3_shard_params`), the forward pass
+  gathers each bucket just in time (:func:`zero3_gather_params`, issued
+  in forward order through the PR-5 stream entry points so later
+  buckets' gathers overlap with earlier layers' compute), and the
+  update returns SHARD updates — no trailing all-gather at all.
+  Param + grad + optimizer-state persistent bytes are all ``1/world``.
 """
 
 from __future__ import annotations
@@ -46,7 +67,7 @@ import optax
 from jax import lax
 
 from ..common import basics
-from ..common.config import _env_bool
+from ..common.config import _env_bool, _env_int
 from ..monitor import registry as _metrics
 from ..ops import collective_ops as C
 from ..ops import fusion
@@ -327,6 +348,7 @@ def DistributedOptimizer(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     zero: Optional[bool] = None,
+    zero_stage: Optional[int] = None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
     axes=None,
@@ -352,20 +374,26 @@ def DistributedOptimizer(
     auto-psummed replicated gradients never touch the wire, so there is
     nothing to quantize.
 
-    ``zero`` (default: the ``HOROVOD_ZERO_SHARDING`` knob) switches to the
-    ZeRO-1 reduce-scatter decomposition: gradients reduce-scatter, the
-    wrapped transformation runs only on this rank's ``1/world`` flat
-    bucket shards (state becomes a :class:`ZeroState`; shard it with
-    :func:`zero_state_pspecs`), and the updates all-gather back.
-    Composes with ``gradient_predivide_factor``,
-    ``backward_passes_per_step`` (the MultiSteps accumulator holds the
-    *scattered* shard, so it shrinks ``world``× too) and ``quantized``
-    (both DCN legs ride the blockwise-int8 wire with shard-local error
-    feedback). Like ``quantized``, it is only meaningful when the
-    gradients reaching ``update`` are per-rank locals
+    ``zero_stage`` (default: the ``HOROVOD_ZERO_STAGE`` knob; ``zero=True``
+    is an alias for stage 2 and ``HOROVOD_ZERO_SHARDING=1`` still maps
+    there) selects the ZeRO reduce-scatter decomposition: gradients
+    reduce-scatter, the wrapped transformation runs only on this rank's
+    ``1/world`` flat bucket shards (state becomes a :class:`ZeroState`;
+    shard it with :func:`zero_state_pspecs`), and — stages 1/2 — the
+    updates all-gather back. Stage 1 keeps the classic full
+    local-gradient accumulator when ``backward_passes_per_step`` k > 1
+    (:class:`ZeroFullMultiStepsState`); stage 2 accumulates AFTER the
+    reduce-scatter on the scattered shard, shrinking gradient state
+    ``world``×; stage 3 additionally expects the PARAMETERS as flat
+    bucket shards (``params=`` is the :func:`zero3_shard_params` tuple,
+    the forward runs on :func:`zero3_gather_params` output) and returns
+    shard updates with no trailing all-gather. All stages compose with
+    ``gradient_predivide_factor`` and ``quantized`` (the DCN legs ride
+    the blockwise-int8 wire with shard-local error feedback). Like
+    ``quantized``, the wire savings need per-rank local gradients
     (``hvd.value_and_grad(..., zero=True)`` or ``reduce=False``);
     already-psummed replicated gradients still shard the update math and
-    the moments, just without the wire savings. See docs/zero.md.
+    the moments. See docs/zero.md.
 
     ``overlap`` (default: the ``HOROVOD_OVERLAP`` knob) streams the fused
     gradient buckets into collectives while backward compute still runs
@@ -396,13 +424,15 @@ def DistributedOptimizer(
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     quant_block = None
+    if zero_stage is None and zero is not None:
+        zero_stage = 2 if zero else 0  # zero=True is the stage-2 alias
     if tuned_params is not None:
         if fusion_threshold_bytes is None:
             fusion_threshold_bytes = tuned_params.fusion_threshold_bytes
         if hierarchical is None:
             hierarchical = tuned_params.hierarchical_allreduce
-        if zero is None:
-            zero = tuned_params.zero_sharding
+        if zero_stage is None:
+            zero_stage = tuned_params.zero_stage
         if overlap is None:
             overlap = tuned_params.overlap
         if num_comm_streams is None:
@@ -411,10 +441,12 @@ def DistributedOptimizer(
         quantized = (basics.config().quantized_allreduce
                      if basics.is_initialized()
                      else _env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False))
-    if zero is None:
-        zero = (basics.config().zero_sharding
-                if basics.is_initialized()
-                else _env_bool("HOROVOD_ZERO_SHARDING", False))
+    if zero_stage is None:
+        zero_stage = _resolve_zero_stage_config()
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(f"zero_stage must be 0, 1, 2, or 3, got "
+                         f"{zero_stage!r}")
+    zero = zero_stage > 0
     if overlap is None:
         overlap = (basics.config().overlap if basics.is_initialized()
                    else _env_bool("HOROVOD_OVERLAP", False))
@@ -439,6 +471,7 @@ def DistributedOptimizer(
             overlap=bool(overlap),
             num_comm_streams=num_comm_streams,
             axes=axes,
+            stage=zero_stage,
         ))
 
     if gradient_predivide_factor != 1.0:
@@ -517,8 +550,23 @@ def DistributedOptimizer(
 
 
 # ---------------------------------------------------------------------------
-# ZeRO-1: reduce-scatter data parallelism with per-rank optax updates.
+# ZeRO: reduce-scatter data parallelism with per-rank optax updates.
 # ---------------------------------------------------------------------------
+
+
+def _resolve_zero_stage_config() -> int:
+    """The configured ZeRO stage: ``HOROVOD_ZERO_STAGE`` (0-3) wins;
+    ``HOROVOD_ZERO_SHARDING=1`` (the PR-4 boolean) maps to stage 2."""
+    if basics.is_initialized():
+        cfg = basics.config()
+        stage = getattr(cfg, "zero_stage", 0)
+        if stage:
+            return stage
+        return 2 if cfg.zero_sharding else 0
+    stage = _env_int("HOROVOD_ZERO_STAGE", 0)
+    if stage:
+        return stage
+    return 2 if _env_bool("HOROVOD_ZERO_SHARDING", False) else 0
 
 
 def _zero_worlds(axes) -> Tuple[int, int, bool]:
@@ -575,6 +623,28 @@ class ZeroMultiStepsState(NamedTuple):
     mini_step: Any  # int32 scalar, 0..k-1
     inner: Any
     acc_grads: Any
+
+
+class ZeroFullMultiStepsState(NamedTuple):
+    """Full-gradient accumulation state (``zero_stage=1`` +
+    ``backward_passes_per_step`` k > 1) — the classic ZeRO-1 layout.
+
+    ``acc`` holds the running sum of this rank's RAW local gradients in
+    model-tree layout (one entry per flattened gradient leaf), i.e. the
+    full-size accumulator stage 2 exists to shrink: per-rank state with
+    a leading per-rank axis riding ``P(HVD_AXES)`` (the residual
+    convention — ``[world, *shape]`` outside the trace, ``[1, *shape]``
+    inside). The mean of the k accumulated microbatches feeds the
+    reduce-scatter on the k-th call; inner state and emitted updates are
+    ``where``-selected (branchless — ``lax.cond`` fails shard_map rep
+    inference on jax 0.4.x), so the wire runs every microbatch but
+    non-final results are discarded. Reshard only at cycle boundaries
+    (``mini_step == 0``, ``acc`` zeros); :func:`zero_reshard_state`
+    rebuilds the accumulator as zeros at the new world."""
+
+    mini_step: Any  # int32 scalar, 0..k-1
+    inner: Any
+    acc: Any        # per grad leaf, [lead, *shape], leading per-rank axis
 
 
 class ZeroOverlapMultiStepsState(NamedTuple):
@@ -655,26 +725,44 @@ def _build_zero_transform(
     axes,
     overlap: bool = False,
     num_comm_streams: int = 1,
+    stage: int = 2,
 ) -> optax.GradientTransformation:
-    """The ZeRO-1 optax wrapper: reduce-scatter → shard update →
-    all-gather, with the wrapped transformation living entirely on this
-    rank's flat bucket shards.
+    """The ZeRO optax wrapper: reduce-scatter → shard update → (stages
+    1/2) all-gather, with the wrapped transformation living entirely on
+    this rank's flat bucket shards.
+
+    ``stage`` picks the accumulation/parameter layout (docs/zero.md):
+    stage 1 accumulates FULL local gradients before the wire
+    (:class:`ZeroFullMultiStepsState`); stage 2 accumulates the scattered
+    shard after it (:class:`ZeroMultiStepsState`, ``1/world`` the
+    state); stage 3 is stage 2 whose ``params`` argument is the
+    :func:`zero3_shard_params` tuple — the inner update runs shard vs
+    shard and the returned updates stay in shard space (the caller
+    applies them to its shard tree; the just-in-time forward gather is
+    :func:`zero3_gather_params`). With k == 1 stages 1 and 2 trace the
+    identical program.
 
     ``overlap`` issues the per-bucket reduce-scatter/all-gather through
     the reverse-layer stream schedule in flights of ``num_comm_streams``
     (docs/overlap.md); with ``backward_passes_per_step`` k > 1 it also
     double-buffers the accumulation loop (:class:`ZeroOverlapMultiSteps
     State`) so each call's reduce-scatter covers the PREVIOUS microbatch
-    and runs dependence-free next to the current backward."""
-    # backward_passes_per_step accumulates INSIDE the shard, so the
-    # accumulator is a [padded // world] leaf, not a full gradient
-    # replica. (The replicated path wraps MultiSteps OUTSIDE and
-    # accumulates full pre-reduce gradients; here the reduce-scatter runs
-    # every microbatch and the accumulation is post-reduce, shard-local.)
+    and runs dependence-free next to the current backward (this shard-
+    level double buffer serves every stage — overlap trades stage 1's
+    full-accumulator layout for the hidden wire)."""
+    # Stage 2/3: backward_passes_per_step accumulates INSIDE the shard,
+    # so the accumulator is a [padded // world] leaf, not a full gradient
+    # replica. Stage 1 keeps the classic full local-gradient accumulator
+    # (per-rank leading-axis state); the wire still runs every microbatch
+    # — branchless where-selection (lax.cond fails shard_map rep
+    # inference on jax 0.4.x) cannot elide a collective — so stage 1's
+    # distinguishing property is the accumulator LAYOUT, which is what
+    # the bench's grad-bytes-per-rank A/B measures.
     k = backward_passes_per_step
     db = overlap and k > 1  # double-buffered accumulation
+    s1 = stage == 1 and k > 1 and not db  # full-grad accumulation
     stx = (_zero_multi_steps(optimizer, k)
-           if k > 1 and not db else optimizer)
+           if k > 1 and not db and not s1 else optimizer)
     num_comm_streams = max(1, int(num_comm_streams))
 
     if gradient_predivide_factor != 1.0:
@@ -723,13 +811,16 @@ def _build_zero_transform(
         return old_entry.at[r].set(new_local)
 
     def init_fn(params):
+        # Every stage's init takes the MODEL-tree params (host-side the
+        # full pytree; stage 3 callers shard the params separately with
+        # zero3_shard_params — the optimizer state layout is identical).
         leaves, _ = jax.tree.flatten(params)
         plan_world, own_world, in_trace = _zero_worlds(axes)
         plan = _plan(leaves, plan_world)
         shards = _shard_params(plan, leaves, own_world, in_trace)
         inner = stx.init(shards)
+        lead = 1 if in_trace else max(1, plan_world)
         if db:
-            lead = 1 if in_trace else max(1, plan_world)
             inner = ZeroOverlapMultiStepsState(
                 mini_step=jnp.zeros((), jnp.int32),
                 inner=inner,
@@ -738,6 +829,13 @@ def _build_zero_transform(
                 pending=tuple(
                     jnp.zeros((lead, b.padded_size), b.dtype)
                     for b in plan))
+        elif s1:
+            inner = ZeroFullMultiStepsState(
+                mini_step=jnp.zeros((), jnp.int32),
+                inner=inner,
+                acc=tuple(
+                    jnp.zeros((lead,) + tuple(jnp.shape(l)), jnp.float32)
+                    for l in leaves))
         if not quantized:
             return ZeroState(inner=inner, residual=None,
                              gather_residual=None)
@@ -745,7 +843,6 @@ def _build_zero_transform(
         # In-trace state carries the [1, ...] per-rank leading axis slice
         # (P(HVD_AXES) convention); host-side init builds the full
         # [world, ...] stack.
-        lead = 1 if in_trace else max(1, plan_world)
         rs, ag = [], []
         for shp in _zero_residual_shapes(plan, plan_world, nl):
             if shp is None:
@@ -754,8 +851,10 @@ def _build_zero_transform(
             else:
                 rs.append(jnp.zeros((lead,) + shp[0], jnp.float32))
                 ag.append(jnp.zeros((lead,) + shp[1], jnp.float32))
+        # Stage 3 has no trailing all-gather, hence no gather residual.
         return ZeroState(inner=inner, residual=tuple(rs),
-                         gather_residual=tuple(ag))
+                         gather_residual=(None if stage == 3
+                                          else tuple(ag)))
 
     def update_fn(grads, state, params=None, **extra):
         gleaves, treedef = jax.tree.flatten(grads)
@@ -792,10 +891,25 @@ def _build_zero_transform(
                  else tuple(range(len(plan))))
         flight = num_comm_streams if overlap else 1
 
-        ms = state.inner if db else None
-        if db:
+        ms = state.inner if (db or s1) else None
+        if db or s1:
             t = ms.mini_step
             is_last = t == (k - 1)
+        new_acc_full: Optional[Tuple[Any, ...]] = None
+        if s1:
+            # Stage 1: accumulate the RAW local gradients (full model
+            # layout, per-rank leading-axis state) BEFORE the wire; the
+            # running mean feeds every call's reduce-scatter and only
+            # the k-th call's result survives the where-selection.
+            acc_loc = tuple(_res_read(a, in_trace) for a in ms.acc)
+            acc_new = tuple(a + g.astype(a.dtype)
+                            for a, g in zip(acc_loc, gleaves))
+            gleaves = [(a / float(k)).astype(jnp.asarray(g).dtype)
+                       for a, g in zip(acc_new, gleaves)]
+            new_acc_full = tuple(
+                _res_write(old, jnp.where(is_last, jnp.zeros_like(n), n),
+                           in_trace)
+                for old, n in zip(ms.acc, acc_new))
         new_pending: List[Any] = [None] * len(plan)
 
         gshards: List[Any] = [None] * len(plan)
@@ -859,7 +973,21 @@ def _build_zero_transform(
         pshards = None
         if params is not None:
             pleaves, _ = jax.tree.flatten(params)
-            pshards = _shard_params(plan, pleaves, own_world, in_trace)
+            if stage == 3:
+                # Stage 3: params arrive ALREADY in shard space — the
+                # zero3_shard_params tuple the training loop owns (each
+                # rank's [padded // world] flat bucket shards in-trace;
+                # the global [padded] buckets host-side).
+                if len(pleaves) != len(plan):
+                    raise ValueError(
+                        f"zero_stage=3 expects params as the "
+                        f"zero3_shard_params tuple ({len(plan)} flat "
+                        f"bucket shards), got {len(pleaves)} leaves — "
+                        f"pass the shard tree the loop applies updates "
+                        f"to, not the gathered model params")
+                pshards = tuple(pleaves)
+            else:
+                pshards = _shard_params(plan, pleaves, own_world, in_trace)
 
         if db:
             acc = tuple(a + g.astype(a.dtype)
@@ -878,9 +1006,37 @@ def _build_zero_transform(
             new_inner = ZeroOverlapMultiStepsState(
                 mini_step=(t + 1) % k, inner=inner_next,
                 acc_shards=acc_next, pending=tuple(new_pending))
+        elif s1:
+            upd, inner_new = optimizer.update(tuple(gshards), ms.inner,
+                                              pshards, **extra)
+            ushards = tuple(
+                jnp.where(is_last, u, jnp.zeros_like(u)) for u in upd)
+            inner_next = jax.tree.map(
+                lambda old, new: jnp.where(is_last, new, old),
+                ms.inner, inner_new)
+            new_inner = ZeroFullMultiStepsState(
+                mini_step=(t + 1) % k, inner=inner_next,
+                acc=new_acc_full)
         else:
             ushards, new_inner = stx.update(tuple(gshards), state.inner,
                                             pshards, **extra)
+
+        if stage == 3:
+            # No trailing all-gather: the updates stay in shard space and
+            # the caller applies them to its shard tree (the next step's
+            # forward re-gathers just in time). This is where stage 3's
+            # wire asymmetry lives — the gather moved from the update's
+            # tail to the forward's head, where it overlaps with compute.
+            new_state = ZeroState(
+                inner=new_inner,
+                residual=None if state.residual is None else tuple(new_rs),
+                gather_residual=None)
+            if params is not None:
+                updates = jax.tree.unflatten(
+                    jax.tree.structure(params), list(ushards))
+            else:
+                updates = tuple(ushards)
+            return updates, new_state
 
         uleaves: List[Any] = [None] * len(gleaves)
         new_ag: List[Any] = [None] * len(plan)
@@ -939,6 +1095,149 @@ def _build_zero_transform(
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 
+# ---------------------------------------------------------------------------
+# ZeRO-3 parameter sharding: the training loop owns flat bucket shards;
+# the forward gathers them just in time (docs/zero.md).
+# ---------------------------------------------------------------------------
+
+
+def _zero3_rank(in_trace: bool, axes=None):
+    if in_trace:
+        return lax.axis_index(C._resolve_axes(axes))
+    return basics.rank() if basics.is_initialized() else 0
+
+
+def zero3_plan(params_template, *, fusion_threshold_bytes=None, axes=None):
+    """The stage-3 bucket plan of a parameter pytree —
+    ``plan_buckets(shard_multiple=world)`` over the flattened leaves, the
+    SAME plan :class:`DistributedOptimizer`'s update derives from the
+    gradient tree, so parameter, gradient, and moment shard layouts all
+    agree (``params_template`` needs only shapes/dtypes)."""
+    leaves, _ = jax.tree.flatten(params_template)
+    plan_world, _, _ = _zero_worlds(axes)
+    return fusion.plan_buckets(leaves, fusion_threshold_bytes,
+                               shard_multiple=plan_world)
+
+
+def zero3_shard_params(params, *, fusion_threshold_bytes=None, axes=None):
+    """Pack a parameter pytree into its flat bucket (shard) tuple — what
+    a ``zero_stage=3`` training loop owns instead of the model tree.
+
+    Host-side (single-controller SPMD) this returns the GLOBAL form —
+    one full ``[padded]`` flat buffer per bucket; ``device_put`` with
+    :func:`zero3_param_pspecs` then hands each rank its rank-major
+    ``1/world`` slice. In-trace (or under the eager process world) it
+    returns this rank's ``[padded // world]`` shards directly. Round-trip
+    with :func:`zero3_gather_params`."""
+    leaves, _ = jax.tree.flatten(params)
+    plan_world, own_world, in_trace = _zero_worlds(axes)
+    plan = fusion.plan_buckets(leaves, fusion_threshold_bytes,
+                               shard_multiple=plan_world)
+    if own_world == 1:
+        return tuple(fusion.pack(b, leaves) for b in plan)
+    r = _zero3_rank(in_trace, axes)
+    return tuple(
+        fusion.shard_slice(fusion.pack(b, leaves), own_world, r)
+        for b in plan)
+
+
+def zero3_param_pspecs(pshards):
+    """PartitionSpec tree for a :func:`zero3_shard_params` tuple: every
+    flat bucket shards rank-major along its (only) axis —
+    ``P(HVD_AXES)``, exactly like the ZeRO moment leaves."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(lambda _: P(basics.HVD_AXES), pshards)
+
+
+def zero3_gather_params(
+    pshards,
+    params_template,
+    *,
+    fusion_threshold_bytes=None,
+    axes=None,
+    overlap: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
+):
+    """Reassemble the full model pytree from stage-3 parameter shards —
+    the just-in-time gather a ``zero_stage=3`` forward runs on.
+
+    In-trace each bucket all-gathers (replicated by construction, so the
+    result feeds replicated consumers directly) in FORWARD order
+    (:func:`~horovod_tpu.ops.fusion.gather_order` — lowest leaf index
+    first, the layers the forward needs soonest), through the PR-5
+    stream entry points in flights of ``num_comm_streams`` when
+    ``overlap`` is on: unpacking is deferred past the flight so the
+    latency-hiding scheduler can run deeper layers' gathers under the
+    already-gathered layers' compute. Host-side, on the GLOBAL shard
+    form, this is a pure unpack (no wire) — the exact inverse of
+    :func:`zero3_shard_params`. ``params_template`` supplies structure
+    and shapes only (``jax.ShapeDtypeStruct`` leaves work)."""
+    tleaves, treedef = jax.tree.flatten(params_template)
+    plan_world, own_world, in_trace = _zero_worlds(axes)
+    plan = fusion.plan_buckets(tleaves, fusion_threshold_bytes,
+                               shard_multiple=plan_world)
+    shards = list(jax.tree.leaves(pshards))
+    if len(shards) != len(plan):
+        raise ValueError(
+            f"pshards has {len(shards)} buckets but the template plans "
+            f"{len(plan)} — pass the tuple zero3_shard_params produced "
+            f"for this parameter tree (same threshold, same world)")
+    overlap_on, flight = fusion._resolve_overlap(overlap, num_comm_streams,
+                                                 None)
+    order = fusion.gather_order(plan)
+    if not overlap_on:
+        flight = 1
+    eager_local = (not in_trace) and own_world == 1
+    uleaves: List[Any] = [None] * len(tleaves)
+    for s in range(0, len(order), flight):
+        issued = []
+        for i in order[s:s + flight]:
+            if eager_local:
+                full = shards[i]  # global form already
+            elif overlap_on:
+                full = C.all_gather_stream(shards[i], bucket_id=i,
+                                           axes=axes)
+            else:
+                full = C.all_gather(shards[i], axes=axes)
+            issued.append((i, full))
+        # Unpack AFTER the whole flight is issued (ops/fusion.py flight
+        # contract): no consumer sits between in-flight gathers.
+        for i, full in issued:
+            for j, leaf in zip(plan[i].leaf_indices,
+                               fusion.unpack(plan[i], full)):
+                uleaves[j] = leaf
+    return jax.tree.unflatten(treedef, uleaves)
+
+
+def zero3_reshard_params(
+    pshards,
+    params_template,
+    *,
+    from_world: int,
+    to_world: int,
+    fusion_threshold_bytes: Optional[int] = None,
+):
+    """Re-shard a GLOBAL (host-side) stage-3 parameter tuple between
+    world sizes — the elastic/checkpoint-restore path, the parameter
+    analogue of :func:`zero_reshard_state`. Exact: each bucket unpacks to
+    parameter layout under the old plan and repacks under the new one
+    (leaf→bucket assignment is world-independent, padding holds zeros),
+    so a round-trip is the identity."""
+    tleaves, _ = jax.tree.flatten(params_template)
+    plan_f = fusion.plan_buckets(tleaves, fusion_threshold_bytes,
+                                 shard_multiple=from_world)
+    plan_t = fusion.plan_buckets(tleaves, fusion_threshold_bytes,
+                                 shard_multiple=to_world)
+    shards = list(jax.tree.leaves(pshards))
+    if len(shards) != len(plan_f):
+        raise ValueError(
+            f"pshards has {len(shards)} buckets, plan has {len(plan_f)}")
+    return tuple(
+        fusion.pack(bt, _scatter_unpack(bf, buf, len(tleaves)))
+        for bf, bt, buf in zip(plan_f, plan_t, shards))
+
+
 def zero_reshard_state(
     state: ZeroState,
     params,
@@ -966,6 +1265,18 @@ def zero_reshard_state(
     ``P(HVD_AXES)``-sharded running state yields); ``params`` is the
     matching parameter pytree. Shard with
     :func:`zero_state_pspecs` after resharding.
+
+    Generalizes across all three stages (stage-3 PARAMETER shards are
+    loop-owned, not optimizer state — reshard those with
+    :func:`zero3_reshard_params`): bucket-flat moment groups (and the
+    stage-2 :class:`ZeroMultiStepsState` shard accumulator, which shares
+    their signature) remap exactly, mid-cycle included. Leading-axis
+    per-rank MICROBATCH state — the stage-1
+    :class:`ZeroFullMultiStepsState` accumulator and the overlap
+    double-buffer's pending buckets — is wire/cycle geometry and is
+    rebuilt as zeros at the new world, so reshard at a cycle boundary
+    (``mini_step == 0``), where those buffers hold zeros anyway and the
+    round-trip stays the identity.
     """
     leaves_p, _ = jax.tree.flatten(params)
     plan_f = fusion.plan_buckets(leaves_p, fusion_threshold_bytes,
@@ -973,7 +1284,9 @@ def zero_reshard_state(
     plan_t = fusion.plan_buckets(leaves_p, fusion_threshold_bytes,
                                  shard_multiple=to_world)
     k = len(plan_f)
+    n_leaves = len(leaves_p)
     sig = [(jnp.dtype(b.dtype), b.padded_size) for b in plan_f]
+    pshapes = [tuple(jnp.shape(l)) for l in leaves_p]
 
     flat, treedef = jax.tree.flatten(state.inner)
     out: List[Any] = []
@@ -991,9 +1304,39 @@ def zero_reshard_state(
                 out.append(
                     fusion.pack(bt, _scatter_unpack(bf, g, len(leaves_p))))
             j += k
-        else:
-            out.append(flat[j])
-            j += 1
+            continue
+        if (len(group) == k and all(
+                getattr(g, "ndim", 0) == 2
+                and jnp.dtype(g.dtype) == d
+                and g.shape == (from_world, p)
+                for g, (d, p) in zip(group, sig))):
+            # Overlap double-buffer pending ([world, padded] per bucket):
+            # cycle-boundary zeros, rebuilt at the new world's padding.
+            if from_world == to_world:
+                out.extend(group)
+            else:
+                out.extend(
+                    jnp.zeros((to_world, bt.padded_size), g.dtype)
+                    for g, bt in zip(group, plan_t))
+            j += k
+            continue
+        groupa = flat[j:j + n_leaves]
+        if (len(groupa) == n_leaves and n_leaves > 0 and all(
+                getattr(g, "ndim", -1) == 1 + len(ps)
+                and tuple(g.shape) == (from_world,) + ps
+                for g, ps in zip(groupa, pshapes))):
+            # Stage-1 full-gradient accumulator ([world, *param_shape]
+            # per leaf): cycle-boundary zeros at the new world.
+            if from_world == to_world:
+                out.extend(groupa)
+            else:
+                out.extend(
+                    jnp.zeros((to_world,) + ps, g.dtype)
+                    for g, ps in zip(groupa, pshapes))
+            j += n_leaves
+            continue
+        out.append(flat[j])
+        j += 1
     inner = jax.tree.unflatten(treedef, out)
 
     if state.residual is None:

@@ -88,6 +88,7 @@ def value_and_grad(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     zero: Optional[bool] = None,
+    zero_stage: Optional[int] = None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
     tuned_params=None,
@@ -105,16 +106,19 @@ def value_and_grad(
     e.g. to keep error-feedback state in the optimizer when
     ``quantized=True``.
 
-    ``zero`` (default: the ``HOROVOD_ZERO_SHARDING`` knob) marks the step
-    as ZeRO-sharded: under ZeRO the gradient reduction IS the optimizer's
-    reduce-scatter, so ``zero=True`` behaves as ``reduce=False`` — raw
-    per-rank local gradients are handed to the
-    ``DistributedOptimizer(zero=True)`` update, whose bucket
+    ``zero`` / ``zero_stage`` (defaults: the ``HOROVOD_ZERO_STAGE`` /
+    ``HOROVOD_ZERO_SHARDING`` knobs; ``zero=True`` aliases stage 2) mark
+    the step as ZeRO-sharded: under ZeRO the gradient reduction IS the
+    optimizer's reduce-scatter, so any stage > 0 behaves as
+    ``reduce=False`` — raw per-rank local gradients are handed to the
+    ``DistributedOptimizer(zero_stage=N)`` update, whose bucket
     reduce-scatter is then the one and only gradient collective. This is
     the knob's thread-through point: a step built with
-    ``hvd.value_and_grad(..., zero=zero)`` + ``DistributedOptimizer(...,
-    zero=zero)`` flips between the replicated and sharded schedules with
-    one flag (see docs/zero.md)."""
+    ``hvd.value_and_grad(..., zero_stage=n)`` + ``DistributedOptimizer(
+    ..., zero_stage=n)`` flips between the replicated and sharded
+    schedules with one flag (see docs/zero.md)."""
+    if zero is None and zero_stage is not None:
+        zero = zero_stage > 0
     if zero is None and tuned_params is not None:
         zero = tuned_params.zero_sharding
     vg = jax.value_and_grad(fun, argnums=argnums, has_aux=has_aux,
@@ -124,10 +128,9 @@ def value_and_grad(
     def wrapped(*args, **kwargs):
         zero_eff = zero
         if zero_eff is None:
-            from ..common import basics
+            from ..parallel.optimizer import _resolve_zero_stage_config
 
-            zero_eff = (basics.config().zero_sharding
-                        if basics.is_initialized() else False)
+            zero_eff = _resolve_zero_stage_config() > 0
         axes_t = C._resolve_axes(axes)
         if axes_t:
             args = list(args)

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Worker for scripts/ckpt_smoke.sh — one phase per invocation.
+
+Phases (argv[1], with artifact dir argv[2] and mesh CxL argv[3]):
+
+``shadow``   uninterrupted run on the 8-device mesh; records the sha256
+             of the gathered parameters after every step (truth.json).
+``train``    same run with async rank-sharded checkpointing every step;
+             hard-kills the process (os._exit) right after SUBMITTING
+             the save at KILL_AT — the background writer dies mid-flight,
+             so the last commit is whatever landed atomically.
+``resume``   runs at a DIFFERENT world (the shell passes a smaller
+             mesh): restores the latest committed step, reshards the
+             stage-3 param shards and the ZeRO optimizer state to the
+             new world, verifies the restored parameters are
+             bit-identical to the truth digest, then trains to the end
+             asserting every step (including the first resumed one)
+             stays bit-identical to the uninterrupted run.
+
+Bitwise comparability across world sizes is by construction: the data is
+integer-valued, the SGD hyperparameters are dyadic rationals, and the
+run is float64 (JAX_ENABLE_X64, set by the shell) — every
+mean/reduce-scatter along the way stays EXACT (the fractional bits grow
+a few per step, far under the 53-bit mantissa), so any summation order
+gives the same bits and the trajectory is world-independent (the same
+trick as the fixed-world determinism of chaos_soak's ckpt fault, pushed
+one step further).
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import checkpoint as hvd_ckpt  # noqa: E402
+
+STEPS = 8
+KILL_AT = 5
+KILL_RC = 17
+D = 5
+GLOBAL_BATCH = 16
+
+
+def digest(params):
+    h = hashlib.sha256()
+    for k in sorted(params):
+        h.update(np.ascontiguousarray(np.asarray(params[k])).tobytes())
+    return h.hexdigest()
+
+
+def main():
+    phase, tmp, mesh_arg = sys.argv[1], sys.argv[2], sys.argv[3]
+    mesh_shape = tuple(int(v) for v in mesh_arg.split("x"))
+    hvd.init(mesh_shape=mesh_shape)
+    world = hvd.size()
+    mesh = hvd.mesh()
+    truth_path = os.path.join(tmp, "truth.json")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+
+    assert jax.config.jax_enable_x64, "run via scripts/ckpt_smoke.sh"
+    rng = np.random.RandomState(0)
+    x = rng.randint(-1, 2, size=(GLOBAL_BATCH * STEPS, D)).astype(np.float64)
+    y = rng.randint(-1, 2, size=(GLOBAL_BATCH * STEPS, 1)).astype(np.float64)
+
+    params0 = {"w": jnp.zeros((D, 1), jnp.float64),
+               "b": jnp.zeros((1,), jnp.float64)}
+    tpl = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                       params0)
+    tx = hvd.DistributedOptimizer(optax.sgd(0.125, momentum=0.5),
+                                  zero_stage=3)
+
+    psh = hvd.zero3_shard_params(params0)
+    pspec = hvd.zero3_param_pspecs(psh)
+    state = tx.init(params0)
+    sspec = hvd.zero_state_pspecs(state)
+
+    def put(tree, spec):
+        return jax.device_put(tree, jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spec))
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] + p["b"] - yb) ** 2)
+
+    @jax.jit
+    def step(psh, s, xb, yb):
+        def spmd(psh, s, xb, yb):
+            p = hvd.zero3_gather_params(psh, tpl)
+            _, g = hvd.value_and_grad(loss_fn, zero=True)(p, (xb, yb))
+            u, ns = tx.update(g, s, psh)
+            return optax.apply_updates(psh, u), ns
+
+        return hvd.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(pspec, sspec, hvd.data_pspec(), hvd.data_pspec()),
+            out_specs=(pspec, sspec))(psh, s, xb, yb)
+
+    def batch(i):  # 1-based step number
+        sl = slice((i - 1) * GLOBAL_BATCH, i * GLOBAL_BATCH)
+        return jnp.asarray(x[sl]), jnp.asarray(y[sl])
+
+    def gathered(psh):
+        return hvd.zero3_gather_params(jax.device_get(psh), params0)
+
+    start = 1
+    if phase == "resume":
+        reg = hvd.monitor.metrics()
+        mgr = hvd_ckpt.CheckpointManager(ckpt_dir, keep=3)
+        latest = mgr.latest_step()
+        assert latest is not None and 1 <= latest <= KILL_AT, \
+            f"no usable committed step after the kill (latest={latest})"
+        manifest, tree = mgr.restore()
+        assert manifest.world != world, \
+            "resume phase must run at a different world size"
+        assert reg.counter("ckpt.restores").value >= 1
+        psh = hvd.zero3_reshard_params(tuple(tree["pshards"]), params0,
+                                       from_world=manifest.world,
+                                       to_world=world)
+        state = hvd.zero_reshard_state(tree["opt_state"], params0,
+                                       from_world=manifest.world,
+                                       to_world=world)
+        truth = json.load(open(truth_path))
+        got = digest(gathered(psh))
+        assert got == truth[str(latest)], \
+            (f"restored params at step {latest} are not bit-identical to "
+             f"the uninterrupted run: {got} != {truth[str(latest)]}")
+        print(f"ckpt smoke: restored step {latest} at world "
+              f"{manifest.world} -> {world}, params bit-identical")
+        start = latest + 1
+    elif phase == "train":
+        mgr = hvd_ckpt.CheckpointManager(ckpt_dir, keep=3)
+        truth = json.load(open(truth_path))
+    else:
+        assert phase == "shadow", phase
+        mgr, truth = None, {}
+
+    psh, state = put(psh, pspec), put(state, sspec)
+    for i in range(start, STEPS + 1):
+        xb, yb = batch(i)
+        psh, state = step(psh, state, xb, yb)
+        d = digest(gathered(psh))
+        if phase == "shadow":
+            truth[str(i)] = d
+        else:
+            assert d == truth[str(i)], \
+                f"step {i} diverged from the uninterrupted run"
+        if mgr is not None:
+            mgr.save(i, {"pshards": psh, "opt_state": state})
+        if phase == "train" and i == KILL_AT:
+            os._exit(KILL_RC)  # writer mid-flight; no drain, no goodbye
+
+    if phase == "shadow":
+        with open(truth_path, "w") as f:
+            json.dump(truth, f, indent=1)
+        print(f"ckpt smoke: recorded {len(truth)}-step truth trajectory "
+              f"at world {world}")
+    else:  # resume
+        assert mgr.wait(60)
+        commits = hvd.monitor.metrics().counter("ckpt.commits").value
+        assert commits >= 1, "resume phase produced no checkpoint commits"
+        mgr.close()
+        print(f"ckpt smoke: resumed steps {start}..{STEPS} bit-identical "
+              f"at world {world}; {int(commits)} commits this process")
+
+
+if __name__ == "__main__":
+    main()

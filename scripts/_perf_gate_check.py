@@ -7,6 +7,13 @@ correctness assertions (no dropped requests, parity probe present).
 Train legs: compares against the best SAME-platform, same-metric value
 recorded in the ``BENCH_r*.json`` trajectory (each of those wraps the
 bench's one-line JSON under ``parsed`` or inside ``tail``).
+zero<stage> legs (``--zero-stage`` A/B): structural memory gates against
+the replicated baseline measured in the SAME run — each component the
+stage claims to shard must be within PERF_GATE_ZERO_SLACK (default 1.30,
+bucket padding headroom) of its 1/world share — the stage-parity probe
+must have passed, the async checkpoint probe must have committed with a
+save stall under PERF_GATE_CKPT_STALL_FRAC (default 0.10) of a step,
+and throughput gates against the trajectory like a train leg.
 
 Exit 0 = within tolerance, 1 = regression, 2 = usage/baseline error.
 
@@ -96,6 +103,74 @@ def gate(measured, baseline, tol, what, leg=None):
     return ok
 
 
+def _zero_leg(rec, leg, tol):
+    """Structural gates for a ``--zero-stage`` A/B record; returns 0 when
+    every sharding/checkpoint invariant holds, 1 on regression."""
+    stage = int(rec.get("zero_stage") or leg[4:])
+    world = int(rec.get("chips") or 0)
+    slack = float(os.environ.get("PERF_GATE_ZERO_SLACK", "1.30"))
+    stall_cap = float(os.environ.get("PERF_GATE_CKPT_STALL_FRAC", "0.10"))
+    mine = rec.get("bytes_per_rank") or {}
+    base = rec.get("bytes_per_rank_baseline") or {}
+    if world < 2 or not mine or not base:
+        print(f"perf gate [{leg}]: record lacks bytes_per_rank A/B "
+              f"(chips={world}) — hard fail")
+        record_verdict(leg, "bytes_per_rank_present", 0, 1, tol, False)
+        return 1
+    ok = True
+
+    def shard_gate(component):
+        # "must not regress" for a byte count means staying at its
+        # 1/world share (plus padding slack) — gate() is >=, so compare
+        # the achieved reduction factor against world/slack.
+        b = float(base.get(component, 0.0))
+        if b <= 0:
+            return  # component absent in this config (e.g. no grad
+            # accumulation at backward_passes_per_step=1)
+        m = max(1.0, float(mine.get(component, 0.0)))
+        nonlocal ok
+        ok &= gate(b / m, float(world), 1.0 / slack,
+                   f"{component} reduction x", leg=leg)
+
+    shard_gate("opt_state")
+    if stage >= 2:
+        shard_gate("grad_accum")
+    if stage >= 3:
+        shard_gate("params")
+
+    parity = rec.get("stage_parity") or {}
+    if not parity.get("stage12_bit_identical"):
+        print(f"perf gate [{leg}]: stage-1/2 parity probe failed — "
+              f"hard fail")
+        record_verdict(leg, "stage12_bit_identical", 0, 1, tol, False)
+        ok = False
+    rel3 = parity.get("stage3_max_rel_err")
+    if rel3 is None or rel3 > 1e-5:
+        print(f"perf gate [{leg}]: stage-3 parity {rel3} exceeds 1e-5 — "
+              f"hard fail")
+        record_verdict(leg, "stage3_max_rel_err", rel3 or -1, 1e-5, tol,
+                       False)
+        ok = False
+
+    if int(rec.get("ckpt_commits") or 0) < 1:
+        print(f"perf gate [{leg}]: no checkpoint commits — hard fail")
+        record_verdict(leg, "ckpt_commits", rec.get("ckpt_commits", 0), 1,
+                       tol, False)
+        ok = False
+    frac = rec.get("ckpt_stall_frac")
+    if frac is not None:
+        # gate() is a >= check; bound the stall from above by gating the
+        # headroom (cap - frac) against zero... keep it direct instead:
+        within = frac <= stall_cap
+        print(f"perf gate [{leg} ckpt_stall_frac]: measured {frac:.4f} "
+              f"vs cap {stall_cap} -> "
+              f"{'OK' if within else 'REGRESSION'}")
+        record_verdict(leg, "ckpt_stall_frac", frac, stall_cap, tol,
+                       within)
+        ok &= within
+    return 0 if ok else 1
+
+
 def main():
     try:
         return _main()
@@ -147,6 +222,12 @@ def _main():
         ok &= gate(rec["tokens_per_sec"], base["tokens_per_sec"], tol,
                    "serve throughput")
         return 0 if ok else 1
+
+    if leg.startswith("zero"):
+        code = _zero_leg(rec, leg, tol)
+        if code:
+            return code
+        # fall through: throughput still gates against the trajectory
 
     # Training legs: best same-platform value for this metric across the
     # recorded trajectory.

@@ -17,7 +17,10 @@ Usage::
 Faults: ``crash`` (hostB worker dies at an eager collective), ``drop``
 (driver slot-grant RPCs go unanswered; retry absorbs), ``stall``
 (hostB worker hangs before rendezvous; the stall watchdog abandons the
-incarnation), ``mixed`` (cycle through all three).
+incarnation), ``ckpt`` (EVERY worker hard-crashes mid-run — only the
+async rank-sharded checkpoint survives; a fresh driver must resume from
+the last committed step with a loss trajectory bit-identical to an
+uninterrupted run, docs/checkpoint.md), ``mixed`` (cycle through all).
 """
 
 import argparse
@@ -33,7 +36,7 @@ sys.path.insert(0, REPO)
 
 WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
 
-FAULTS = ("crash", "drop", "stall")
+FAULTS = ("crash", "drop", "stall", "ckpt")
 
 
 def _read_log(path):
@@ -144,6 +147,142 @@ def run_once(fault, seed, workdir, verbose=False):
                                    else f" PROBLEMS={problems}")
 
 
+def _run_ckpt_leg(script, log_file, worker_args, *, min_np, max_np,
+                  join_timeout=180, quiesce=None):
+    """One driver incarnation of the checkpoint scenario; returns the
+    driver's join verdict (None when ``quiesce`` cut it short).
+
+    ``quiesce`` is a predicate over the parsed log records: when it turns
+    true the job is considered dead-by-design (the all-rank crash leg —
+    the driver cannot re-form a world once every host is blacklisted, so
+    joining would just burn the timeout) and the driver is stopped."""
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner import safe_shell_exec
+
+    driver = ElasticDriver(HostDiscoveryScript(script, 1), min_np=min_np,
+                           max_np=max_np,
+                           controller_addr_override="127.0.0.1")
+
+    def _exec(slot, world_id):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+            "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+            "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+            "HOROVOD_START_TIMEOUT": "30",
+        })
+        cmd = " ".join(shlex.quote(c) for c in [
+            sys.executable, WORKER, "--log-file", log_file, *worker_args])
+        return safe_shell_exec.execute(cmd, env=env)
+
+    ok = None
+    try:
+        driver.start(_exec)
+        if quiesce is None:
+            ok = driver.join(timeout=join_timeout)
+        else:
+            deadline = time.monotonic() + join_timeout
+            while time.monotonic() < deadline:
+                if quiesce(_read_log(log_file)):
+                    time.sleep(1.0)  # let os._exit land driver-side
+                    break
+                time.sleep(0.25)
+    finally:
+        driver.stop()
+        driver.shutdown_service()
+    return ok
+
+
+def run_ckpt_once(seed, workdir, verbose=False):
+    """Checkpoint soak iteration: an uninterrupted REFERENCE run, then a
+    run whose every worker hard-crashes mid-training (in-memory elastic
+    state is gone — min_np equals the world, so no surviving subset can
+    re-form) and a fresh driver that must resume from the last committed
+    checkpoint, finishing with a bit-identical loss trajectory."""
+    from horovod_tpu.checkpoint import layout
+
+    batches, crash_at, world = 8, 4, 3
+    script = os.path.join(workdir, "discover.sh")
+    with open(script, "w") as f:
+        f.write("#!/bin/sh\necho hostA:2\necho hostB:1\n")
+    os.chmod(script, 0o755)
+
+    def leg(log_name, ckpt_dir, extra, **kw):
+        log_file = os.path.join(workdir, log_name)
+        wargs = ["--batches", str(batches), "--batch-sleep", "0.1",
+                 "--ckpt-dir", ckpt_dir, *extra]
+        ok = _run_ckpt_leg(script, log_file, wargs, min_np=world,
+                           max_np=world, **kw)
+        return ok, _read_log(log_file)
+
+    problems = []
+
+    # Leg 1: uninterrupted reference (its own checkpoint dir).
+    ok_ref, ref = leg("ref.jsonl", os.path.join(workdir, "ckpt_ref"), [])
+    ref_by_batch = {}
+    for r in ref:
+        if "batch" in r:
+            ref_by_batch.setdefault(r["batch"], set()).add(r["weights"])
+    if not ok_ref or len([r for r in ref if r.get("done")]) != world:
+        problems.append("reference run did not finish cleanly")
+    if any(len(v) > 1 for v in ref_by_batch.values()):
+        problems.append(f"reference ranks disagree: {ref_by_batch}")
+
+    # Leg 2: whole-job crash after committing batch `crash_at`.
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    _, crashed = leg(
+        "crash.jsonl", ckpt_dir, ["--exit-at-batch", str(crash_at)],
+        join_timeout=60,
+        quiesce=lambda recs: len([r for r in recs
+                                  if r.get("batch") == crash_at]) >= world)
+    committed = layout.list_steps(ckpt_dir)
+    if not committed:
+        problems.append("no committed checkpoint survived the crash")
+    elif not 1 <= committed[-1] <= crash_at:
+        problems.append(f"unexpected committed steps {committed}")
+
+    # Leg 3: fresh driver over the same dir — resume, run to completion.
+    ok_res, resumed = leg("resume.jsonl", ckpt_dir, [], join_timeout=180)
+    done = [r for r in resumed if r.get("done")]
+    starts = {r["resumed_from"] for r in resumed if "resumed_from" in r}
+    if not ok_res or len(done) != world:
+        problems.append(f"resume run: ok={ok_res} done={len(done)}")
+    if starts != {committed[-1] if committed else -1}:
+        problems.append(f"workers resumed from {starts}, last committed "
+                        f"step is {committed}")
+    if 0 in starts:
+        problems.append("resume started from scratch, not the checkpoint")
+
+    # The trajectory invariant: every logged (batch, weights) point of
+    # the crashed + resumed runs must equal the uninterrupted run's.
+    for r in [*crashed, *resumed]:
+        if "batch" not in r:
+            continue
+        want = ref_by_batch.get(r["batch"])
+        if want != {r["weights"]}:
+            problems.append(
+                f"batch {r['batch']}: resumed weights {r['weights']} != "
+                f"uninterrupted {want}")
+            break
+    final = {r["weights"] for r in done}
+    if ref_by_batch.get(batches) and final != ref_by_batch[batches]:
+        problems.append(f"final weights {final} != reference "
+                        f"{ref_by_batch[batches]}")
+
+    detail = (f"committed={committed} resumed_from={sorted(starts)} "
+              f"done={len(done)}")
+    if verbose and problems:
+        detail += f" ref={ref} crashed={crashed} resumed={resumed}"
+    return not problems, detail + ("" if not problems
+                                   else f" PROBLEMS={problems}")
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="loop the chaos-driven elastic recovery scenario")
@@ -165,8 +304,12 @@ def main():
         t0 = time.monotonic()
         with tempfile.TemporaryDirectory(prefix="chaos_soak_") as workdir:
             try:
-                ok, detail = run_once(fault, args.seed + i, workdir,
-                                      verbose=args.verbose)
+                if fault == "ckpt":
+                    ok, detail = run_ckpt_once(args.seed + i, workdir,
+                                               verbose=args.verbose)
+                else:
+                    ok, detail = run_once(fault, args.seed + i, workdir,
+                                          verbose=args.verbose)
             except Exception as e:  # a crash of the harness is a failure
                 ok, detail = False, f"harness exception: {e!r}"
         status = "ok" if ok else "FAIL"

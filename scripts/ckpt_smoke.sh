@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Checkpoint smoke (CI brick for docs/checkpoint.md): prove the
+# kill→restore contract end to end on CPU meshes, in three processes:
+#
+#   1. shadow: uninterrupted ZeRO-3 run on the 8-device 2x4 mesh,
+#      recording a per-step parameter digest (the truth trajectory);
+#   2. train:  the same run checkpointing asynchronously every step,
+#      hard-killed (os._exit) right after submitting the save at step 5 —
+#      the background writer dies mid-flight, so only atomically
+#      committed steps survive;
+#   3. resume: a 4-device 2x2 mesh (DIFFERENT world size) restores the
+#      latest committed step, reshards params + optimizer state 8→4, and
+#      trains to completion — asserting the restored state and every
+#      resumed step (including the first) are bit-identical to the
+#      uninterrupted truth run, and that the process recorded nonzero
+#      ckpt.commits.
+#
+# Bitwise comparability across worlds is by construction (integer data +
+# dyadic hyperparameters → exact fp32 reductions); see the worker's
+# docstring. Runtime ~1 min.
+#
+# Usage: scripts/ckpt_smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="${CKPT_SMOKE_TMP:-$(mktemp -d)}"
+mkdir -p "$TMP"
+trap '[ -z "${CKPT_SMOKE_TMP:-}" ] && rm -rf "$TMP"' EXIT
+echo "== ckpt smoke: artifacts in $TMP ==" >&2
+
+WORKER=scripts/_ckpt_smoke_worker.py
+KILL_RC=17
+
+run_phase() {  # run_phase <phase> <devices> <mesh CxL>
+    JAX_PLATFORMS=cpu \
+    JAX_ENABLE_X64=1 \
+    XLA_FLAGS="--xla_force_host_platform_device_count=$2" \
+    python "$WORKER" "$1" "$TMP" "$3"
+}
+
+echo "== phase 1/3: uninterrupted truth run (world 8) ==" >&2
+run_phase shadow 8 2x4
+
+echo "== phase 2/3: checkpointing run, killed mid-save (world 8) ==" >&2
+rc=0
+run_phase train 8 2x4 || rc=$?
+if [ "$rc" -ne "$KILL_RC" ]; then
+    echo "ckpt smoke: train phase exited rc=$rc, expected the injected" \
+         "kill (rc=$KILL_RC)" >&2
+    exit 1
+fi
+committed=$(ls -d "$TMP"/ckpt/step_*/ 2>/dev/null | wc -l)
+if [ "$committed" -lt 1 ]; then
+    echo "ckpt smoke: no committed checkpoint survived the kill" >&2
+    exit 1
+fi
+echo "ckpt smoke: kill landed (rc=$rc), $committed committed step(s)" >&2
+
+echo "== phase 3/3: restore + reshard at world 4, resume to the end ==" >&2
+run_phase resume 4 2x2
+
+echo "ckpt smoke OK" >&2

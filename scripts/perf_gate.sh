@@ -16,7 +16,18 @@
 # Usage:
 #   scripts/perf_gate.sh             # gate the serve leg (default)
 #   PERF_GATE_LEGS="serve train" scripts/perf_gate.sh
+#   PERF_GATE_LEGS="zero1 zero2 zero3" scripts/perf_gate.sh
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
+#
+# The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
+# the replicated baseline measured in the same run (docs/zero.md): the
+# sharded state components must stay within PERF_GATE_ZERO_SLACK
+# (default 1.30, bucket padding) of 1/world — opt state at every stage,
+# grad accumulation at stage >= 2, params at stage 3 — the async
+# checkpoint stall must stay under PERF_GATE_CKPT_STALL_FRAC (default
+# 0.10) of a step, and the stage-parity probe must have passed.
+# Throughput additionally gates against the recorded trajectory like
+# the train leg.
 #
 # Every verdict is also appended as a metrics-JSONL snapshot to
 # PERF_GATE_METRICS_JSONL (default perf_gate_metrics.jsonl; set to 0 to
@@ -52,8 +63,15 @@ for leg in $LEGS; do
                 --model resnet18 --batch-size 2 --image-size 64 \
                 --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
             ;;
+        zero1|zero2|zero3)
+            run_leg "$leg" --zero-stage "${leg#zero}" --platform cpu \
+                --cpu-devices 8 --model resnet18 --batch-size 2 \
+                --image-size 64 --num-warmup 1 --num-iters 3 \
+                --num-batches-per-iter 2
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train)" >&2; exit 2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3})" >&2
+            exit 2
             ;;
     esac
 done

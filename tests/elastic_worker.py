@@ -9,6 +9,7 @@ hard crash mid-epoch, reference elastic_common.py --exit-schedule).
 
 import argparse
 import json
+import math
 import os
 import sys
 import time
@@ -33,6 +34,12 @@ def main():
     p.add_argument("--batch-sleep", type=float, default=0.1)
     p.add_argument("--exit-at", default=None,
                    help="hostname:local_rank:batch hard-crash injection")
+    p.add_argument("--ckpt-dir", default=None,
+                   help="durable mode: restore from the latest committed "
+                        "checkpoint here and save (rank 0) every batch")
+    p.add_argument("--exit-at-batch", type=int, default=None,
+                   help="EVERY rank hard-crashes after committing this "
+                        "batch (whole-job loss; only disk survives)")
     args = p.parse_args()
 
     identity = (f"{os.environ['HOROVOD_HOSTNAME']}:"
@@ -48,26 +55,69 @@ def main():
         with open(args.log_file, "a") as f:
             f.write(json.dumps(record) + "\n")
 
+    # Durable mode (scripts/chaos_soak.py --fault ckpt): restore from the
+    # last COMMITTED checkpoint before entering the elastic loop. Every
+    # rank reads the same manifest (read-only), so the restored state is
+    # world-consistent without a broadcast; only rank 0 writes (the state
+    # is replicated — one complete copy per commit is the contract).
+    mgr = None
+    start_batch, start_weights = 0, 0.0
+    if args.ckpt_dir:
+        from horovod_tpu import checkpoint as hvd_ckpt
+
+        mgr = hvd_ckpt.CheckpointManager(args.ckpt_dir, keep=3)
+        latest = mgr.latest_step()
+        if latest is not None:
+            manifest, tree = mgr.restore()
+            start_batch = manifest.step
+            start_weights = float(np.asarray(tree["train"]["weights"])[0])
+        log({"resumed_from": latest or 0, "start_weights": start_weights})
+
     @elastic.run
     def train(state):
         while state.batch < args.batches:
             # A real collective every step so peer failure surfaces as
             # HorovodInternalError and state stays world-consistent.
-            contrib = jnp.full((4,), 1.0)
-            total = hvd.allreduce(contrib, op=hvd.Sum,
-                                  name=f"train.step.{state.batch}")
-            assert np.allclose(total, hvd.size()), (total, hvd.size())
-            state.weights = state.weights + float(total[0])
+            if mgr is None:
+                contrib = jnp.full((4,), 1.0)
+                total = hvd.allreduce(contrib, op=hvd.Sum,
+                                      name=f"train.step.{state.batch}")
+                assert np.allclose(total, hvd.size()), (total, hvd.size())
+                state.weights = state.weights + float(total[0])
+            else:
+                # Deterministic batch-dependent "loss" contribution,
+                # normalized by world size: with a FIXED world the whole
+                # trajectory depends only on the batch number, so an
+                # interrupted-and-resumed run must match an uninterrupted
+                # one bit-for-bit.
+                contrib = jnp.full((4,), math.cos(0.3 * state.batch),
+                                   dtype=jnp.float32)
+                total = hvd.allreduce(contrib, op=hvd.Sum,
+                                      name=f"train.step.{state.batch}")
+                state.weights = (state.weights
+                                 + float(total[0]) / hvd.size())
             state.batch += 1
             if exit_at is not None and state.batch == exit_at:
                 os._exit(1)
             log({"rank": hvd.rank(), "size": hvd.size(),
                  "batch": state.batch, "weights": state.weights})
             state.commit()
+            if mgr is not None and hvd.rank() == 0:
+                # Async: blocks ~only for the host snapshot; the commit
+                # lands on the writer thread (double-buffered).
+                mgr.save(state.batch, {"train": {
+                    "weights": np.full((4,), state.weights,
+                                       dtype=np.float64)}})
+            if (args.exit_at_batch is not None
+                    and state.batch >= args.exit_at_batch):
+                os._exit(1)  # post-commit whole-job crash (ckpt soak)
             time.sleep(args.batch_sleep)
 
-    state = elastic.ObjectState(batch=0, weights=0.0)
+    state = elastic.ObjectState(batch=start_batch, weights=start_weights)
     train(state)
+    if mgr is not None:
+        mgr.wait(30)
+        mgr.close()
     log({"rank": hvd.rank(), "size": hvd.size(), "done": True,
          "weights": state.weights})
 

@@ -478,15 +478,19 @@ def test_autotune_overlap_gate_off_never_proposes():
                for p, _ in pm.history)
 
 
-def test_cache_schema_v3_tolerant_from_dict():
+def test_cache_schema_v4_tolerant_from_dict():
     from horovod_tpu.autotune import TunedParams
     from horovod_tpu.autotune import driver as at_driver
 
-    assert at_driver._CACHE_VERSION == 3
-    assert "v3" in at_driver.cache_key_for("x")
+    assert at_driver._CACHE_VERSION == 4
+    assert "v4" in at_driver.cache_key_for("x")
     # v1/v2-era dicts (no overlap keys) stay readable with defaults
     old = {"fusion_threshold_bytes": 1 << 22, "quant_block": 128,
            "hierarchical_allreduce": True}
     p = TunedParams.from_dict(old)
     assert p.overlap is False and p.num_comm_streams == 1
+    assert p.zero_stage == 0
     assert TunedParams.from_dict(p.as_dict()) == p
+    # v2/v3-era boolean zero_sharding names stage 2 (the PR-4 behavior)
+    p = TunedParams.from_dict({**old, "zero_sharding": True})
+    assert p.zero_stage == 2 and p.zero_sharding is True
