@@ -63,6 +63,48 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def parse_mesh_shape(spec: str):
+    """Parse ``--mesh-shape``: ``CROSSxLOCAL`` → (cross, local), or
+    ``CROSSxLOCALxPODS`` → (cross, local, pods) — the 3-level
+    ``(hvd_pod, hvd_cross, hvd_local)`` mesh (docs/wire-plan.md)."""
+    try:
+        parts = tuple(int(v) for v in
+                      spec.lower().replace(",", "x").split("x"))
+    except ValueError:
+        parts = ()
+    if len(parts) not in (2, 3):
+        raise ValueError(f"--mesh-shape expects CROSSxLOCAL or "
+                         f"CROSSxLOCALxPODS ints, got {spec!r}")
+    if any(v < 1 for v in parts):
+        raise ValueError("--mesh-shape sizes must be >= 1")
+    return parts
+
+
+def mesh_shape_str(mesh_shape):
+    return ("x".join(str(v) for v in mesh_shape)
+            if mesh_shape else None)
+
+
+def dump_plan(args, mesh_shape):
+    """``--dump-plan``: print the resolved wire plan as a table and exit
+    — no devices needed (the cost model prices the emulated mesh)."""
+    from horovod_tpu import plan as hvd_plan
+
+    if mesh_shape is None:
+        n = args.chips or args.cpu_devices
+        mesh_shape = (2, n // 2) if n % 2 == 0 and n >= 2 else (1, n)
+        log(f"--dump-plan: no --mesh-shape given, pricing the emulated "
+            f"{mesh_shape_str(mesh_shape)} mesh")
+    step_plan = hvd_plan.describe_plan(
+        quantized=args.quantized or None,
+        zero_stage=(args.zero_stage if args.zero_stage
+                    else (2 if args.zero else None)),
+        overlap=args.overlap or None,
+        mesh_shape=mesh_shape,
+    )
+    print(step_plan.table(payload_bytes=args.dump_plan_bytes))
+
+
 def metrics_snapshot(prefixes=("comm.", "step.", "optimizer.")):
     """Registry snapshot filtered to the bench-relevant metric families —
     the ``metrics_snapshot`` field every A/B leg embeds in its JSON line
@@ -1019,7 +1061,7 @@ def run_serve(args, devices, platform, mesh_shape):
         "platform": platform,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": n_chips,
-        "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+        "mesh_shape": (mesh_shape_str(mesh_shape)
                        if mesh_shape else None),
         "tokens_per_sec": round(stats.tokens_per_sec(), 2),
         "goodput_tokens_per_sec": round(stats.goodput_tokens_per_sec(), 2),
@@ -1242,11 +1284,25 @@ def main():
     ap.add_argument("--serve-resize", type=int, default=1,
                     help="1 (default) = one elastic resize down and back "
                          "up mid-trace; 0 = fixed replica count")
-    ap.add_argument("--mesh-shape", default=None, metavar="CROSSxLOCAL",
+    ap.add_argument("--mesh-shape", default=None,
+                    metavar="CROSSxLOCAL[xPODS]",
                     help="emulate a multi-host (cross, local) topology, "
                          "e.g. 2x4 — gives the collectives a real DCN "
                          "(cross) hop on a single host; default for "
-                         "--quantized on an even device count is 2x(N/2)")
+                         "--quantized on an even device count is 2x(N/2). "
+                         "A third component (e.g. 2x2x2) adds a pods "
+                         "axis: the 3-level (pod, cross, local) mesh the "
+                         "wire-plan tree plans target (docs/wire-plan.md)")
+    ap.add_argument("--dump-plan", action="store_true",
+                    help="print the resolved wire plan for the current "
+                         "knob set (--quantized/--zero-stage/--overlap/"
+                         "HOROVOD_* env) as a table — legs, hops, wire "
+                         "dtypes, streams, predicted wire bytes from the "
+                         "trace-time cost model — and exit "
+                         "(docs/wire-plan.md)")
+    ap.add_argument("--dump-plan-bytes", type=int, default=4 * 1024 * 1024,
+                    help="payload size (bytes) the --dump-plan cost "
+                         "model prices, default 4 MiB")
     ap.add_argument("--space-to-depth", action="store_true",
                     help="resnet50: MLPerf-style folded stem (4x4/1 conv "
                          "on 2x2-blocked input instead of 7x7/2 on 3 "
@@ -1295,6 +1351,19 @@ def main():
         if args.serve_requests < 1 or args.serve_replicas < 1:
             ap.error("--serve-requests/--serve-replicas must be >= 1")
 
+    if args.dump_plan:
+        # Pure plan resolution + cost model — runs before the A/B
+        # exclusivity checks (any knob combination is a valid plan to
+        # inspect) and needs no devices.
+        shape = None
+        if args.mesh_shape:
+            try:
+                shape = parse_mesh_shape(args.mesh_shape)
+            except ValueError as e:
+                ap.error(str(e))
+        dump_plan(args, shape)
+        return
+
     sweep = None
     if args.scaling:
         try:
@@ -1319,27 +1388,24 @@ def main():
                  "structure per run; the quantized ZeRO wire is covered "
                  "by DistributedOptimizer(zero=True, quantized=True) and "
                  "tests/test_zero.py)")
-    if args.zero_stage and (args.zero or args.quantized or args.overlap):
-        ap.error("--zero-stage cannot combine with --zero/--quantized/"
-                 "--overlap (one A/B structure per run; --zero is the "
-                 "stage-2 alias, and the compose matrix is covered by "
-                 "tests/test_zero.py)")
-    if args.overlap and (args.quantized or args.zero):
+    if args.zero_stage and args.zero:
+        ap.error("--zero-stage cannot combine with --zero (--zero is "
+                 "the stage-2 alias). --zero-stage DOES compose with "
+                 "--quantized/--overlap: the stage leg then runs the "
+                 "combined plan-compiled wire (docs/wire-plan.md)")
+    if args.overlap and not args.zero_stage and (args.quantized
+                                                 or args.zero):
         ap.error("--overlap cannot combine with --quantized/--zero (one "
                  "A/B structure per run; the compose matrix is covered "
-                 "by tests/test_overlap.py)")
+                 "by tests/test_overlap.py — or use --zero-stage N "
+                 "--quantized --overlap for the combined plan leg)")
 
     mesh_shape = None
     if args.mesh_shape:
         try:
-            cross, local = (int(v) for v in args.mesh_shape.lower()
-                            .replace(",", "x").split("x"))
-        except ValueError:
-            ap.error(f"--mesh-shape expects CROSSxLOCAL ints, got "
-                     f"{args.mesh_shape!r}")
-        if cross < 1 or local < 1:
-            ap.error("--mesh-shape sizes must be >= 1")
-        mesh_shape = (cross, local)
+            mesh_shape = parse_mesh_shape(args.mesh_shape)
+        except ValueError as e:
+            ap.error(str(e))
 
     if args.platform == "cpu":
         want = max(sweep) if sweep else (args.chips or args.cpu_devices)
@@ -1373,9 +1439,11 @@ def main():
                              f"visible devices")
         devices = devices[:args.chips]
 
-    if mesh_shape is not None and mesh_shape[0] * mesh_shape[1] != \
-            len(devices):
-        raise SystemExit(f"--mesh-shape {mesh_shape[0]}x{mesh_shape[1]} "
+    mesh_world = 1
+    for v in (mesh_shape or ()):
+        mesh_world *= v
+    if mesh_shape is not None and mesh_world != len(devices):
+        raise SystemExit(f"--mesh-shape {mesh_shape_str(mesh_shape)} "
                          f"does not cover {len(devices)} devices")
     if (args.quantized or args.autotune or args.zero or args.overlap
             or args.serve or args.zero_stage) \
@@ -1491,7 +1559,7 @@ def main():
             "trial_history": [
                 {**p.as_dict(), "score_steps_per_sec": round(s, 4)}
                 for p, s in result.history],
-            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+            "mesh_shape": (mesh_shape_str(mesh_shape)
                            if mesh_shape else None),
             "baseline_per_chip": round(res_d["per_chip"], 2),
             "throughput_delta": round(delta, 4),
@@ -1500,12 +1568,14 @@ def main():
         }), flush=True)
         return
 
-    if args.overlap:
+    if args.overlap and not args.zero_stage:
         # A/B: identical step structure (reduce-in-optimizer), identical
         # mesh, same fused bucket plan; only the schedule changes
         # (synchronous post-backward reduction vs reverse-layer bucket
         # streaming). Baseline first so an overlap-path failure still
-        # leaves a reference number in the log.
+        # leaves a reference number in the log. (--overlap WITH
+        # --zero-stage rides the stage leg below as one combined
+        # plan-compiled wire, docs/wire-plan.md.)
         log("=== A/B leg 1/2: baseline (synchronous reduction) ===")
         res_b = run_once(args, devices, platform, overlap=False,
                          mesh_shape=mesh_shape)
@@ -1549,7 +1619,7 @@ def main():
             "chips": res_o["chips"],
             "per_chip_batch": args.batch_size,
             "overlap": True,
-            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+            "mesh_shape": (mesh_shape_str(mesh_shape)
                            if mesh_shape else None),
             "baseline_per_chip": round(res_b["per_chip"], 2),
             "throughput_delta": round(delta, 4),
@@ -1573,12 +1643,21 @@ def main():
         # the run finishes with the stage-1/2/3 parity probe (one
         # program, bit-identical — the acceptance contract).
         stage = args.zero_stage
+        combo = "".join(
+            (" +quantized" if args.quantized else "",
+             " +overlap" if args.overlap else ""))
         log("=== A/B leg 1/2: baseline (replicated optimizer update) ===")
         res_b = run_once(args, devices, platform, mesh_shape=mesh_shape)
-        log(f"=== A/B leg 2/2: ZeRO stage {stage} ===")
+        log(f"=== A/B leg 2/2: ZeRO stage {stage}{combo} ===")
         res_z = run_once(args, devices, platform, zero_stage=stage,
+                         quantized=args.quantized, overlap=args.overlap,
                          mesh_shape=mesh_shape, ckpt_probe=True)
         parity = run_stage_parity_probe(devices, mesh_shape)
+        from horovod_tpu import plan as hvd_plan
+
+        plan_enc = hvd_plan.describe_plan(
+            quantized=args.quantized or None, zero_stage=stage,
+            overlap=args.overlap or None).encode()
         delta = res_z["per_chip"] / res_b["per_chip"] - 1.0
         tot_b, tot_z = (res_b["bytes_per_rank_total"],
                         res_z["bytes_per_rank_total"])
@@ -1603,7 +1682,10 @@ def main():
             "chips": res_z["chips"],
             "per_chip_batch": args.batch_size,
             "zero_stage": stage,
-            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+            "quantized": bool(args.quantized),
+            "overlap": bool(args.overlap),
+            "plan": plan_enc,
+            "mesh_shape": (mesh_shape_str(mesh_shape)
                            if mesh_shape else None),
             "baseline_per_chip": round(res_b["per_chip"], 2),
             "throughput_delta": round(delta, 4),
@@ -1668,7 +1750,7 @@ def main():
             "chips": res_z["chips"],
             "per_chip_batch": args.batch_size,
             "zero": True,
-            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+            "mesh_shape": (mesh_shape_str(mesh_shape)
                            if mesh_shape else None),
             "baseline_per_chip": round(res_b["per_chip"], 2),
             "throughput_delta": round(delta, 4),
@@ -1717,7 +1799,7 @@ def main():
             "chips": res_q["chips"],
             "per_chip_batch": args.batch_size,
             "quantized": True,
-            "mesh_shape": (f"{mesh_shape[0]}x{mesh_shape[1]}"
+            "mesh_shape": (mesh_shape_str(mesh_shape)
                            if mesh_shape else None),
             "baseline_per_chip": round(res_b["per_chip"], 2),
             "throughput_delta": round(delta, 4),

@@ -53,6 +53,7 @@ from .common.basics import (  # noqa: F401
     CROSS_AXIS,
     HVD_AXES,
     LOCAL_AXIS,
+    POD_AXIS,
     cross_rank,
     cross_size,
     data_sharding,
@@ -65,6 +66,7 @@ from .common.basics import (  # noqa: F401
     local_size,
     mesh,
     mpi_threads_supported,
+    pod_size,
     rank,
     replicated_sharding,
     shard_map,
@@ -178,6 +180,12 @@ from .autotune import (  # noqa: F401
     autotune_session,
 )
 from .utils.timeline import start_timeline, stop_timeline  # noqa: F401
+from . import plan  # noqa: F401  (composable wire-plan IR, docs/wire-plan.md)
+from .plan import (  # noqa: F401
+    StepPlan,
+    WirePlan,
+    describe_plan,
+)
 from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import checkpoint  # noqa: F401  (async rank-sharded save/restore)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
@@ -189,11 +197,13 @@ from .monitor import (  # noqa: F401
 )
 
 from jax.sharding import PartitionSpec as _P
+from .common import basics as _basics
 
 
 def data_pspec(*extra):
-    """PartitionSpec splitting the leading (batch) dim over all ranks."""
-    return _P(HVD_AXES, *extra)
+    """PartitionSpec splitting the leading (batch) dim over all ranks
+    (``(pod, cross, local)`` on a 3-level mesh, ``HVD_AXES`` otherwise)."""
+    return _P(_basics.world_axes(), *extra)
 
 
 __version__ = "0.1.0"

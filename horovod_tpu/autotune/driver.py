@@ -34,7 +34,11 @@ log = logging.getLogger("horovod_tpu.autotune")
 # v3: + overlap / num_comm_streams (overlapped gradient reduction).
 # v4: zero_sharding → zero_stage {0,1,2} (ZeRO-2/3; from_dict still
 #     reads pre-v4 entries, but the key's version gates real reuse).
-_CACHE_VERSION = 4
+# v5: + the canonical wire-plan encoding (horovod_tpu.plan encode_tuned:
+#     leg order | per-hop dtype | stream placement) stored alongside the
+#     knobs — the GP now searches plan space (docs/wire-plan.md);
+#     from_dict/load stay tolerant of v3/v4 entries.
+_CACHE_VERSION = 5
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -111,11 +115,14 @@ def load_cached_params(key: str) -> Optional[TunedParams]:
 
 
 def _store_cached_params(key: str, params: TunedParams, *,
-                         score: float, samples: int) -> None:
+                         score: float, samples: int,
+                         quantized: bool = False) -> None:
+    from ..plan import planner as _wire_planner
     from ..ops import kernel_autotune
 
     kernel_autotune.cache_store(key, {
         "params": params.as_dict(),
+        "plan": _wire_planner.encode_tuned(params, quantized=quantized),
         "score_steps_per_sec": score,
         "samples": samples,
     })
@@ -291,6 +298,7 @@ def autotune_session(
         best.quant_block, best.hierarchical_allreduce, pm.best_score)
     if key is not None:
         _store_cached_params(key, best, score=pm.best_score,
-                             samples=pm.samples_done)
+                             samples=pm.samples_done,
+                             quantized=bool(tune_quant_block))
     return AutotuneResult(params=best, history=tuple(pm.history),
                           best_score=pm.best_score)

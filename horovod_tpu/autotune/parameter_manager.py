@@ -38,6 +38,7 @@ import math
 import os
 from typing import IO, List, Optional, Tuple
 
+from ..plan import planner as _wire_planner
 from .gp import GaussianProcess
 
 log = logging.getLogger("horovod_tpu.autotune")
@@ -49,15 +50,25 @@ _MAX_FUSION_LOG = 28.0  # 2^28 = 256 MiB
 _MIN_QBLOCK_LOG = 6.0   # 2^6  = 64
 _MAX_QBLOCK_LOG = 10.0  # 2^10 = 1024
 _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
-_DIMS = 6  # fusion, quant_block, hierarchical, zero, overlap, streams
+# The 6 unit-cube dims now read as a compact PLAN encoding (ISSUE 9,
+# docs/wire-plan.md): fusion threshold, per-hop int8 scale block, leg
+# order (flat/tree vs the ZeRO rs+ag split via the zero dims), and the
+# stream placement (overlap, flight width). Proposals canonicalize
+# through horovod_tpu.plan.encode_tuned/decode_tuned, so two knob
+# settings that compile to the SAME wire plan (e.g. hierarchical under
+# ZeRO, or a stream count with overlap off) collapse to one trial
+# instead of costing two recompiles.
+_DIMS = 6  # fusion, quant_block, leg order (tree), leg order (zero), overlap, streams
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
 # zero_sharding (= zero_stage > 0) stays a column for log compatibility;
-# zero_stage carries the actual level.
+# zero_stage carries the actual level. v5 appends the canonical `plan`
+# encoding column; read_log stays tolerant of v3/v4 logs without it.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
-              "overlap", "num_comm_streams", "score_steps_per_sec")
+              "overlap", "num_comm_streams", "score_steps_per_sec",
+              "plan")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -258,23 +269,43 @@ class ParameterManager:
         else:
             ov = self.initial.overlap
             ns = self.initial.num_comm_streams
-        return TunedParams(
+        return self._canonicalize(TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
             hierarchical_allreduce=hier,
             zero_stage=stage,
             overlap=ov,
             num_comm_streams=ns,
-        )
+        ))
+
+    def _plan_of(self, p: TunedParams) -> str:
+        """The canonical wire-plan encoding of a knob setting — the
+        search-space coordinate the GP actually explores (``plan``
+        column of the CSV, ``plan`` field of the v5 cache entry)."""
+        return _wire_planner.encode_tuned(p, quantized=self.tune_quant_block)
+
+    def _canonicalize(self, p: TunedParams) -> TunedParams:
+        """Snap a proposal onto its wire plan: knobs that are dead in
+        the plan it encodes (hierarchical under the ZeRO rs+ag split,
+        stream count with overlap off) reset to the canonical value, so
+        equal plans are equal TunedParams and dedup as one trial."""
+        d = _wire_planner.decode_tuned(self._plan_of(p))
+        return dataclasses.replace(
+            p,
+            hierarchical_allreduce=d["hierarchical_allreduce"],
+            zero_stage=d["zero_stage"],
+            overlap=d["overlap"],
+            num_comm_streams=d["num_comm_streams"],
+            quant_block=d.get("quant_block", p.quant_block))
 
     def _unit_key(self, p: TunedParams) -> tuple:
-        """Dedup key: the *snapped* knob values, so two unit points that
-        collapse to the same compiled configuration count as one trial."""
+        """Dedup key: the snapped fusion threshold plus the canonical
+        plan encoding, so two unit points that collapse to the same
+        compiled wire plan count as one trial."""
         # Fusion threshold dedups at 1/4-octave resolution — finer than
         # that cannot change a bucket plan by more than rounding.
         return (round(math.log2(max(1, p.fusion_threshold_bytes)) * 4),
-                p.quant_block, p.hierarchical_allreduce, p.zero_stage,
-                p.overlap, p.num_comm_streams)
+                p.quant_block, self._plan_of(p))
 
     # -- sampling loop ---------------------------------------------------
 
@@ -317,7 +348,8 @@ class ParameterManager:
                             int(p.zero_stage),
                             int(p.overlap),
                             int(p.num_comm_streams),
-                            f"{score:.6g}"])
+                            f"{score:.6g}",
+                            self._plan_of(p)])
         self._log.flush()
 
     def _freeze(self) -> None:
@@ -386,14 +418,19 @@ class ParameterManager:
 def read_log(path: str) -> List[dict]:
     """Parse a ``HOROVOD_AUTOTUNE_LOG`` CSV back into typed rows — the
     round-trip counterpart of the manager's writer (tests assert the
-    schema; analysis notebooks get typed values for free)."""
+    schema; analysis notebooks get typed values for free).
+
+    Tolerant of older schemas: pre-v4 logs lack ``zero_stage``/
+    ``overlap``/``num_comm_streams`` (the boolean ``zero_sharding``
+    named stage 2), pre-v5 logs lack the ``plan`` encoding column — it
+    is re-derived from the knob columns so every row carries one."""
     rows: List[dict] = []
     with open(path, newline="") as f:
         for rec in csv.DictReader(f):
             sharding = bool(int(rec.get("zero_sharding", 0) or 0))
             # Pre-v4 logs carried only the boolean; it named stage 2.
             stage = int(rec.get("zero_stage", 2 if sharding else 0) or 0)
-            rows.append({
+            row = {
                 "sample": int(rec["sample"]),
                 "fusion_threshold_bytes": int(
                     rec["fusion_threshold_bytes"]),
@@ -406,5 +443,11 @@ def read_log(path: str) -> List[dict]:
                 "num_comm_streams": int(rec.get("num_comm_streams", 1)
                                         or 1),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
-            })
+            }
+            enc = (rec.get("plan") or "").strip()
+            if not enc:  # pre-v5 log: derive the canonical encoding
+                enc = _wire_planner.encode_tuned(
+                    TunedParams.from_dict(row))
+            row["plan"] = enc
+            rows.append(row)
     return rows

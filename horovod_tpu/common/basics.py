@@ -51,10 +51,16 @@ from .exceptions import NotInitializedError
 
 # Mesh axis names. The pair mirrors the reference's local/cross communicator
 # split (mpi_context.h:78-84). ``HVD_AXES`` is the flat "world" axis tuple —
-# psum over it is the reference's flat ring allreduce.
+# psum over it is the reference's flat ring allreduce. ``POD_AXIS`` is the
+# optional third hierarchy level (multi-pod topologies, ``mesh_shape=
+# (cross, local, pods)`` with pods > 1): when present the mesh is 3-D
+# ``(hvd_pod, hvd_cross, hvd_local)`` and ``ALL_AXES`` in that order is the
+# full world tuple (rank-major lex order matches the mesh layout).
 CROSS_AXIS = "hvd_cross"
 LOCAL_AXIS = "hvd_local"
+POD_AXIS = "hvd_pod"
 HVD_AXES: Tuple[str, str] = (CROSS_AXIS, LOCAL_AXIS)
+ALL_AXES: Tuple[str, str, str] = (POD_AXIS, CROSS_AXIS, LOCAL_AXIS)
 
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; on the
 # pinned 0.4.x line only the experimental spelling exists. This resolver is
@@ -89,7 +95,7 @@ _state = _State()
 
 def _build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
-    mesh_shape: Optional[Tuple[int, int]] = None,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
 ) -> Mesh:
     """Arrange all job devices into the 2-D (cross, local) Horovod mesh.
 
@@ -100,7 +106,10 @@ def _build_mesh(
 
     ``mesh_shape=(cross, local)`` overrides the inferred host/chip split —
     used to emulate a multi-host topology on a single host (tests, dryruns)
-    or to re-slice a multi-slice pod.
+    or to re-slice a multi-slice pod. ``mesh_shape=(cross, local, pods)``
+    with pods > 1 builds the 3-level ``(hvd_pod, hvd_cross, hvd_local)``
+    mesh — the topology the wire-plan compiler's 3-level tree plans
+    target (docs/wire-plan.md); pods == 1 collapses to the 2-D mesh.
     """
     if devices is None:
         from .backend import acquire_devices
@@ -108,10 +117,21 @@ def _build_mesh(
         devices = acquire_devices()
     devices = list(devices)
     if mesh_shape is not None:
-        cross, local = mesh_shape
-        if cross * local != len(devices):
+        if len(mesh_shape) == 3:
+            cross, local, pods = mesh_shape
+        elif len(mesh_shape) == 2:
+            (cross, local), pods = mesh_shape, 1
+        else:
+            raise ValueError(
+                f"mesh_shape must be (cross, local) or "
+                f"(cross, local, pods), got {mesh_shape}")
+        if cross * local * pods != len(devices):
             raise ValueError(
                 f"mesh_shape {mesh_shape} does not cover {len(devices)} devices")
+        if pods > 1:
+            grid = np.array(devices, dtype=object).reshape(
+                pods, cross, local)
+            return Mesh(grid, ALL_AXES)
         grid = np.array(devices, dtype=object).reshape(cross, local)
         return Mesh(grid, HVD_AXES)
     n_proc = max(1, jax.process_count())
@@ -225,7 +245,7 @@ def init(
         _state.mesh = _build_mesh(devices, mesh_shape)
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
-        _state.local_device_count = int(_state.mesh.devices.shape[1])
+        _state.local_device_count = int(_state.mesh.devices.shape[-1])
         # Launcher-injected env contract (HOROVOD_RANK/SIZE +
         # HOROVOD_CONTROLLER_ADDR, gloo_run.py:65-76): start the native
         # control-plane core. It owns the rank-0 coordinator loop and the
@@ -386,7 +406,7 @@ def _bound_axes() -> frozenset:
         return frozenset(get_axis_env().axis_sizes)
     except Exception:  # pragma: no cover - private-API drift fallback
         bound = set()
-        for name in HVD_AXES:
+        for name in ALL_AXES:
             try:
                 jax.lax.axis_index(name)
                 bound.add(name)
@@ -395,10 +415,63 @@ def _bound_axes() -> frozenset:
         return frozenset(bound)
 
 
+def _axis_size(name) -> int:
+    """Size of a bound mesh axis. ``lax.axis_size`` appeared alongside the
+    graduated ``jax.shard_map``; on jax 0.4.x the size comes from the axis
+    env directly (the same source :func:`_bound_axes` reads)."""
+    try:
+        return jax.lax.axis_size(name)
+    except AttributeError:  # jax < 0.6
+        from jax._src.core import get_axis_env
+
+        try:
+            return get_axis_env().axis_sizes[name]
+        except KeyError:
+            raise _unbound_axis_error(name) from None
+    except NameError:
+        raise _unbound_axis_error(name) from None
+
+
+def _unbound_axis_error(name) -> Exception:
+    """A collective asked for a mesh axis that is not bound in the current
+    trace. Uninitialized backend → the reference-style "call hvd.init()
+    first" error instead of the raw KeyError/NameError; initialized →
+    explain the shard_map requirement."""
+    if not is_initialized():
+        return NotInitializedError(
+            f"Horovod-TPU (required by a collective over mesh axis "
+            f"{name!r})")
+    return ValueError(
+        f"mesh axis {name!r} is not bound in the current trace: compiled "
+        f"collectives must run inside hvd.shard_map over the Horovod "
+        f"mesh (hvd.mesh()); omit axes= in eager host code to use the "
+        f"process-world path")
+
+
+def _trace_world_axes() -> Tuple[str, ...]:
+    """Horovod mesh axes bound in the current trace, in rank-major
+    ``(pod, cross, local)`` order — the 3-level-aware source for
+    per-trace rank computation and axis resolution."""
+    bound = _bound_axes()
+    return tuple(a for a in ALL_AXES if a in bound)
+
+
+def world_axes() -> Tuple[str, ...]:
+    """Axis tuple of the full world mesh: ``(hvd_pod, hvd_cross,
+    hvd_local)`` on a 3-level mesh, ``HVD_AXES`` otherwise (including
+    before init — the 2-level names are the back-compat default)."""
+    s = _state
+    if (s.initialized and s.mesh is not None
+            and s.mesh.devices.ndim == 3):
+        return ALL_AXES
+    return HVD_AXES
+
+
 def in_hvd_context() -> bool:
     """True when tracing under shard_map over the Horovod mesh axes."""
     bound = _bound_axes()
-    return CROSS_AXIS in bound or LOCAL_AXIS in bound
+    return (CROSS_AXIS in bound or LOCAL_AXIS in bound
+            or POD_AXIS in bound)
 
 
 def _process_world() -> bool:
@@ -432,7 +505,16 @@ def cross_size() -> int:
     s = _require_init()
     if _process_world():
         return s.controller.cross_size()
-    return int(s.mesh.devices.shape[0])
+    return int(s.mesh.devices.shape[-2])
+
+
+def pod_size() -> int:
+    """Number of pods (the third hierarchy level): the leading mesh dim
+    of a 3-level ``(pod, cross, local)`` mesh, else 1."""
+    s = _require_init()
+    if s.mesh is not None and s.mesh.devices.ndim == 3:
+        return int(s.mesh.devices.shape[0])
+    return 1
 
 
 def rank():
@@ -440,7 +522,7 @@ def rank():
     code. Reference: horovod_rank (operations.cc:771)."""
     s = _require_init()
     if in_hvd_context():
-        return jax.lax.axis_index(HVD_AXES)
+        return jax.lax.axis_index(_trace_world_axes() or HVD_AXES)
     if _process_world():
         return s.controller.rank()
     return s.process_index * s.local_device_count
@@ -487,7 +569,7 @@ def mpi_threads_supported() -> bool:
 
 def data_sharding(extra: Sequence[Optional[str]] = ()) -> NamedSharding:
     """NamedSharding that splits the leading (batch) dim over all ranks."""
-    return NamedSharding(mesh(), PartitionSpec(HVD_AXES, *extra))
+    return NamedSharding(mesh(), PartitionSpec(world_axes(), *extra))
 
 
 def replicated_sharding() -> NamedSharding:

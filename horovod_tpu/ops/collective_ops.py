@@ -48,6 +48,20 @@ from ..common.basics import CROSS_AXIS, HVD_AXES, LOCAL_AXIS
 from ..common.exceptions import (DuplicateTensorNameError,
                                  NotInitializedError)
 from ..monitor import registry as _metrics
+from ..plan import accounting as _accounting
+from ..plan import compiler as _plan_compiler
+from ..plan import planner as _planner
+# Wire accounting + overlap instrumentation live with the plan compiler
+# (horovod_tpu/plan/accounting.py, docs/wire-plan.md); re-exported here
+# for the public `hvd.record_wire_stats` surface and compatibility.
+from ..plan.accounting import (  # noqa: F401
+    WireStats,
+    _acct,
+    _acct_enabled,
+    _modeled_wire_ms,
+    _wire_recorders,
+    record_wire_stats,
+)
 from . import compression as _compression
 from .compression import Compression
 
@@ -74,9 +88,10 @@ Product = ReduceOp.PRODUCT
 
 
 def _hvd_axes_in_trace() -> Tuple[str, ...]:
-    """Horovod mesh axes bound in the current trace, in (cross, local) order."""
-    bound = basics._bound_axes()
-    return tuple(a for a in HVD_AXES if a in bound)
+    """Horovod mesh axes bound in the current trace, in rank-major
+    ``(pod, cross, local)`` order (the pod axis only exists on a 3-level
+    ``mesh_shape=(cross, local, pods)`` mesh)."""
+    return basics._trace_world_axes()
 
 
 def _resolve_axes(axes) -> Tuple[str, ...]:
@@ -87,37 +102,10 @@ def _resolve_axes(axes) -> Tuple[str, ...]:
     return tuple(axes)
 
 
-def _axis_size(name) -> int:
-    """Size of a bound mesh axis. ``lax.axis_size`` appeared alongside the
-    graduated ``jax.shard_map``; on jax 0.4.x the size comes from the axis
-    env directly (the same source ``basics._bound_axes`` reads)."""
-    try:
-        return lax.axis_size(name)
-    except AttributeError:  # jax < 0.6
-        from jax._src.core import get_axis_env
-
-        try:
-            return get_axis_env().axis_sizes[name]
-        except KeyError:
-            raise _unbound_axis_error(name) from None
-    except NameError:
-        raise _unbound_axis_error(name) from None
-
-
-def _unbound_axis_error(name) -> Exception:
-    """A collective asked for a mesh axis that is not bound in the current
-    trace. Uninitialized backend → the reference-style "call hvd.init()
-    first" error instead of the raw KeyError/NameError; initialized →
-    explain the shard_map requirement."""
-    if not basics.is_initialized():
-        return NotInitializedError(
-            f"Horovod-TPU (required by a collective over mesh axis "
-            f"{name!r})")
-    return ValueError(
-        f"mesh axis {name!r} is not bound in the current trace: compiled "
-        f"collectives must run inside hvd.shard_map over the Horovod "
-        f"mesh (hvd.mesh()); omit axes= in eager host code to use the "
-        f"process-world path")
+# Canonical axis helpers live in common/basics.py (the plan compiler uses
+# them too); aliased here for the historical `C._axis_size` call sites.
+_axis_size = basics._axis_size
+_unbound_axis_error = basics._unbound_axis_error
 
 
 def _world_size(axes: Tuple[str, ...]):
@@ -203,152 +191,14 @@ def _scale(tensor, factor):
 
 
 # ---------------------------------------------------------------------------
-# Wire-byte accounting (the bench A/B instrumentation).
-#
-# Collectives are traced once per compile, so accounting at trace time gives
-# exact static per-step byte counts with zero runtime cost. The cost model is
-# per-device bytes SENT under ring/topology-aware schedules: reduce-scatter
-# or all-gather of n elements over k ranks moves n*(k-1)/k, a full allreduce
-# 2*n*(k-1)/k; a flat psum over both Horovod axes is modeled as XLA's
-# topology-aware decomposition (ICI leg on the full payload, DCN leg on the
-# 1/local_size shard). ``dcn_bytes_fp`` tracks what the SAME traffic pattern
-# would cost at the payload's uncompressed dtype, so
-# ``dcn_bytes_fp / dcn_bytes`` is the wire-representation reduction of the
-# quantized path (EQuARX's "~4x wire bytes" accounting).
+# Wire lowering: every compiled collective below routes through the plan
+# compiler (horovod_tpu/plan/, docs/wire-plan.md). The entry points here
+# keep the public reference-parity API — op semantics, scaling,
+# compression casts, replicated short-circuits, eager fallbacks — derive
+# a WirePlan from the knobs (or take an explicit ``plan=``), and hand the
+# wire composition to plan.compiler, which owns the leg lowering rules
+# and the trace-time wire accounting (the bench A/B instrumentation).
 # ---------------------------------------------------------------------------
-
-
-class WireStats:
-    """Accumulated per-device wire bytes for one traced program."""
-
-    def __init__(self) -> None:
-        self.ici_bytes = 0.0
-        self.dcn_bytes = 0.0
-        self.dcn_bytes_fp = 0.0
-        # Bytes issued through the overlap stream schedule (the
-        # allreduce_stream / reduce_scatter_stream / all_gather_stream
-        # entry points, docs/overlap.md) — wire traffic positioned so the
-        # latency-hiding scheduler can run it under independent compute.
-        self.overlap_bytes = 0.0
-        self.streamed_buckets = 0
-
-    @property
-    def dcn_reduction(self) -> Optional[float]:
-        """fp-equivalent / actual bytes on the DCN hop (None if no DCN)."""
-        return (self.dcn_bytes_fp / self.dcn_bytes) if self.dcn_bytes else None
-
-    @property
-    def hidden_fraction(self) -> float:
-        """Fraction of this program's wire bytes issued through the
-        overlap stream schedule (0.0 with overlap off; collectives
-        outside the gradient bucket wire — loss allreduce, batch-stats —
-        keep it below 1.0). The bench's ``comm_hidden_fraction``."""
-        total = self.ici_bytes + self.dcn_bytes
-        return (self.overlap_bytes / total) if total else 0.0
-
-
-_wire_recorders: list = []
-
-
-def _acct_enabled() -> bool:
-    """Wire accounting is live: an explicit ``record_wire_stats`` recorder
-    is installed, or the metrics registry (enabled by default,
-    docs/observability.md) is counting trace-time wire bytes. Still a
-    trace-time-only cost — nothing here runs in the compiled step."""
-    return bool(_wire_recorders) or _metrics.metrics_enabled()
-
-
-@contextlib.contextmanager
-def record_wire_stats():
-    """Record wire bytes of every collective traced inside the context.
-    Trace-time only: wrap ``jit(...).lower(...)`` (or the first call), not
-    the steady-state execution loop. On exit the recorded profile is also
-    published to the metrics registry (``comm.wire.*`` gauges — the last
-    traced program's per-device wire bytes, hidden fraction included)."""
-    ws = WireStats()
-    _wire_recorders.append(ws)
-    try:
-        yield ws
-    finally:
-        _wire_recorders.remove(ws)
-        _publish_wire_stats(ws)
-
-
-def _publish_wire_stats(ws: "WireStats") -> None:
-    if not _metrics.metrics_enabled():
-        return
-    r = _metrics.default_registry()
-    r.counter("comm.traces").inc()
-    r.gauge("comm.wire.ici_bytes").set(ws.ici_bytes)
-    r.gauge("comm.wire.dcn_bytes").set(ws.dcn_bytes)
-    r.gauge("comm.wire.dcn_bytes_fp").set(ws.dcn_bytes_fp)
-    r.gauge("comm.wire.overlap_bytes").set(ws.overlap_bytes)
-    r.gauge("comm.wire.streamed_buckets").set(ws.streamed_buckets)
-    r.gauge("comm.wire.hidden_fraction").set(ws.hidden_fraction)
-
-
-def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
-    if _metrics.metrics_enabled():
-        _metrics.counter("comm.bytes", hop=kind).inc(wire_bytes)
-        if kind == "dcn":
-            _metrics.counter("comm.bytes_fp_equiv", hop="dcn").inc(
-                wire_bytes if fp_bytes is None else fp_bytes)
-    for ws in _wire_recorders:
-        if kind == "dcn":
-            ws.dcn_bytes += wire_bytes
-            ws.dcn_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
-        else:
-            ws.ici_bytes += wire_bytes
-
-
-def _acct_psum(x, axes) -> None:
-    """Account a flat psum over ``axes`` with the topology-aware model."""
-    if not _acct_enabled():
-        return
-    n = float(np.prod(x.shape)) if x.ndim else 1.0
-    isz = jnp.dtype(x.dtype).itemsize
-    if LOCAL_AXIS in axes:
-        nl = _axis_size(LOCAL_AXIS)
-        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
-        n /= nl
-    if CROSS_AXIS in axes:
-        nc = _axis_size(CROSS_AXIS)
-        _acct("dcn", 2.0 * n * (nc - 1) / nc * isz)
-
-
-def _psum_hierarchical(x, *, local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
-    """Hierarchical allreduce: intra-host reduce-scatter → cross-host
-    allreduce → intra-host allgather (reference algorithm:
-    nccl_operations.cc:190-380, including the non-divisible remainder handled
-    separately — here via the flat-psum fallback, matching the reference's
-    root reduce/bcast remainder leg at nccl_operations.cc:244-307)."""
-    nl = _axis_size(local_axis)
-    if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
-        if _acct_enabled():
-            n = float(np.prod(x.shape))
-            isz = jnp.dtype(x.dtype).itemsize
-            nc = _axis_size(cross_axis)
-            _acct("ici", n * (nl - 1) / nl * isz)        # psum_scatter
-            _acct("dcn", 2.0 * (n / nl) * (nc - 1) / nc * isz)  # cross psum
-            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)  # gather-leg psum
-        shard = lax.psum_scatter(x, local_axis, scatter_dimension=0, tiled=True)
-        shard = lax.psum(shard, cross_axis)
-        # Final allgather leg, expressed as a psum of disjointly-placed
-        # shards: numerically identical to lax.all_gather but the result is
-        # provably replicated for the sharding checker (all_gather output is
-        # conservatively treated as device-varying). Note the flat psum
-        # below is usually optimal on TPU — XLA already decomposes a global
-        # AllReduce over ICI/DCN — so hierarchical mode is a tuning knob for
-        # multi-slice topologies, as in the reference (operations.cc:475-487).
-        li = lax.axis_index(local_axis)
-        # Fresh zeros (not zeros_like(x)) so the buffer doesn't inherit x's
-        # cross-axis varying mark — shard is already cross-reduced.
-        full = jnp.zeros(x.shape, x.dtype)
-        full = lax.dynamic_update_slice_in_dim(
-            full, shard, li * shard.shape[0], 0)
-        return lax.psum(full, local_axis)
-    _acct_psum(x, (cross_axis, local_axis))
-    return lax.psum(x, (cross_axis, local_axis))
 
 
 def _quant_block_size(block: Optional[int]) -> int:
@@ -359,117 +209,12 @@ def _quant_block_size(block: Optional[int]) -> int:
     return _compression.QUANT_BLOCK
 
 
-def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
-                    local_axis=LOCAL_AXIS, cross_axis=CROSS_AXIS):
-    """Quantized hierarchical allreduce-SUM with optional error feedback.
-
-    The EQuARX decomposition placed per HiCCL's rule — compress the slow
-    (cross-host/DCN) hop only, never the fast (ICI) one:
-
-    1. intra-host reduce-scatter (ICI, payload dtype);
-    2. cross-host quantized reduce-scatter (DCN): each rank quantizes its
-       whole shard to int8 with one fp32 scale per ``block`` elements, a
-       tiled ``all_to_all`` moves int8 + scales, receivers
-       dequantize-accumulate in fp32;
-    3. cross-host quantized all-gather (DCN): the reduced segment is
-       requantized and re-broadcast as a masked int8 psum — each rank
-       contributes its segment into a zeroed shard buffer, so the sum is
-       exact (disjoint support) and the result is replicated BY
-       CONSTRUCTION in the VMA model (the repo's broadcast idiom; a plain
-       ``all_gather`` would leave a device-varying mark that poisons
-       ``out_specs=P()`` consumers);
-    4. intra-host all-gather (ICI, payload dtype, psum-of-disjoint as in
-       :func:`_psum_hierarchical`).
-
-    Returns ``(sum, new_residual)``. With ``residual`` (error feedback),
-    the residual is added to ``x`` before hop 1 and the returned residual
-    holds this rank's quantization error — hop 2's error on the whole
-    shard it contributed plus hop 3's requantization error on the segment
-    it owns — written at the exact buffer positions where the next step's
-    reduce-scatter re-collects each component exactly once.
-
-    Falls back to an exact flat psum (consuming the residual, returning it
-    as zeros) when there is no cross axis or the flattened size does not
-    shard evenly over ``local_size * cross_size``.
-    """
-    nl = _axis_size(local_axis)
-    nc = _axis_size(cross_axis)
-    blk = _quant_block_size(block)
-    corrected = x if residual is None else x + residual.astype(x.dtype)
-    n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 0
-    if nc == 1 or n == 0 or n % nl or (n // nl) % nc:
-        axes = (cross_axis, local_axis)
-        _acct_psum(corrected, axes)
-        out = lax.psum(corrected, axes)
-        return out, (None if residual is None else jnp.zeros_like(residual))
-
-    flat = jnp.ravel(corrected)
-    sn = n // nl        # shard elements per device after the ICI leg
-    seg = sn // nc      # segment elements per cross rank within a shard
-    isz = jnp.dtype(x.dtype).itemsize
-    if _acct_enabled():
-        pad_n = ((-seg) % blk + seg) * nc  # padded shard elements
-        q_unit = pad_n + (pad_n // blk) * 4.0  # int8 payload + fp32 scales
-        _acct("ici", n * (nl - 1) / nl * isz)              # psum_scatter
-        _acct("dcn", q_unit * (nc - 1) / nc,               # hop-2 all_to_all
-              float(sn) * (nc - 1) / nc * isz)
-        _acct("dcn", 2.0 * q_unit * (nc - 1) / nc,         # hop-3 masked psum
-              2.0 * float(sn) * (nc - 1) / nc * isz)
-        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)        # ICI gather leg
-
-    # Hop 1 — ICI reduce-scatter in the payload dtype.
-    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
-                             tiled=True)
-
-    # Hop 2 — quantized DCN reduce-scatter (all_to_all of int8 + scales).
-    segs = shard.reshape(nc, seg).astype(jnp.float32)
-    pad = (-seg) % blk
-    if pad:
-        segs = jnp.concatenate(
-            [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
-    nb = segs.shape[1] // blk
-    blocks = segs.reshape(nc, nb, blk)
-    scales = _compression._block_scales(blocks)            # [nc, nb]
-    q = jnp.clip(jnp.round(blocks / scales[..., None]),
-                 -127, 127).astype(jnp.int8)
-    err1 = blocks - q.astype(jnp.float32) * scales[..., None]
-    qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
-                        tiled=True)
-    sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
-                        tiled=True)
-    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)  # [nb, blk]
-
-    # Hop 3 — requantize the reduced segment; masked int8 psum gathers the
-    # shard with replication by construction (disjoint segment support).
-    s2 = _compression._block_scales(acc)                   # [nb]
-    q2 = jnp.clip(jnp.round(acc / s2[:, None]), -127, 127).astype(jnp.int8)
-    err2 = acc - q2.astype(jnp.float32) * s2[:, None]
-    ci = lax.axis_index(cross_axis)
-    qfull = lax.dynamic_update_slice_in_dim(
-        jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
-    sfull = lax.dynamic_update_slice_in_dim(
-        jnp.zeros((nc, nb), jnp.float32), s2[None], ci, 0)
-    qg = lax.psum(qfull, cross_axis)
-    sg = lax.psum(sfull, cross_axis)
-    shard_red = (qg.astype(jnp.float32) * sg[..., None]).reshape(
-        nc, nb * blk)[:, :seg].reshape(sn).astype(x.dtype)
-
-    # Hop 4 — ICI gather leg (psum of disjointly-placed shards).
-    li = lax.axis_index(local_axis)
-    full = jnp.zeros((n,), x.dtype)
-    full = lax.dynamic_update_slice_in_dim(full, shard_red, li * sn, 0)
-    out = lax.psum(full, local_axis).reshape(x.shape)
-    if residual is None:
-        return out, None
-
-    # Error feedback: hop-2 error on every segment this rank contributed,
-    # plus hop-3's requantization error on the one segment it owns.
-    rows = jnp.arange(nc)[:, None, None]
-    err_all = err1 + jnp.where(rows == ci, err2[None], 0.0)
-    err_sh = err_all.reshape(nc, nb * blk)[:, :seg].reshape(sn)
-    res_full = lax.dynamic_update_slice_in_dim(
-        jnp.zeros((n,), jnp.float32), err_sh, li * sn, 0)
-    return out, res_full.reshape(x.shape).astype(residual.dtype)
+def _resolve_plan(plan, default_fn):
+    """An explicit validated ``plan=`` wins; otherwise derive the default
+    from the knob set (``default_fn`` is a zero-arg planner call)."""
+    if plan is not None:
+        return plan.validate()
+    return default_fn()
 
 
 # ---------------------------------------------------------------------------
@@ -483,69 +228,14 @@ def _psum_quantized(x, *, residual=None, block: Optional[int] = None,
 # ``P(HVD_AXES)`` splits a leading dim, so sharded optimizer state outside
 # the trace is the flat bucket itself — no permutation.
 #
-# The hierarchical decomposition follows HiCCL's placement rule (the same
-# one _psum_quantized implements): the ICI leg always rides the payload
-# dtype; only the cross-host DCN leg is eligible for the blockwise-int8
-# wire. reduce_scatter is hops 1-2 of _psum_quantized, all_gather is hops
-# 3-4 — ZeRO splits that collective in half and runs the optimizer update
-# in between.
+# The hierarchical decomposition follows HiCCL's placement rule (the
+# compiler enforces it as an IR validation rule): the ICI leg always
+# rides the payload dtype; only the cross-host DCN leg is eligible for
+# the blockwise-int8 wire. The reduce_scatter plan is the reduce half of
+# the quantized-allreduce plan, the all_gather plan its gather half —
+# ZeRO splits that collective around the optimizer update. Both lower
+# through plan.compiler (lower_reduce_scatter / lower_all_gather).
 # ---------------------------------------------------------------------------
-
-
-def _quant_rs_leg(segs, blk: int, cross_axis):
-    """Quantized DCN reduce-scatter leg (hop 2 of :func:`_psum_quantized`):
-    ``segs`` is this rank's ICI-scattered shard viewed ``[nc, seg]`` in
-    fp32, row ``j`` destined to cross rank ``j``. Returns
-    ``(reduced_seg [seg] fp32, err [nc, seg] fp32)`` where ``err`` is this
-    rank's quantization error on everything it sent."""
-    nc, seg = segs.shape
-    pad = (-seg) % blk
-    if pad:
-        segs = jnp.concatenate(
-            [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
-    nb = segs.shape[1] // blk
-    blocks = segs.reshape(nc, nb, blk)
-    scales = _compression._block_scales(blocks)            # [nc, nb]
-    q = jnp.clip(jnp.round(blocks / scales[..., None]),
-                 -127, 127).astype(jnp.int8)
-    err = blocks - q.astype(jnp.float32) * scales[..., None]
-    qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
-                        tiled=True)
-    sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
-                        tiled=True)
-    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)
-    return (acc.reshape(nb * blk)[:seg],
-            err.reshape(nc, nb * blk)[:, :seg])
-
-
-def _quant_ag_leg(seg_vals, blk: int, cross_axis):
-    """Quantized DCN all-gather leg (hop 3 of :func:`_psum_quantized`):
-    quantize this rank's owned segment ``[seg]`` (fp32) and rebroadcast it
-    as a masked int8 psum — disjoint support makes the sum exact and the
-    result replicated over ``cross_axis`` BY CONSTRUCTION. Returns
-    ``(vals [nc, seg] fp32, err [seg] fp32)``."""
-    nc = _axis_size(cross_axis)
-    seg = seg_vals.shape[0]
-    pad = (-seg) % blk
-    padded = (jnp.concatenate([seg_vals, jnp.zeros((pad,), jnp.float32)])
-              if pad else seg_vals)
-    nb = padded.shape[0] // blk
-    blocks = padded.reshape(nb, blk)
-    s2 = _compression._block_scales(blocks)                # [nb]
-    q2 = jnp.clip(jnp.round(blocks / s2[:, None]),
-                  -127, 127).astype(jnp.int8)
-    err = (blocks - q2.astype(jnp.float32) * s2[:, None]).reshape(
-        nb * blk)[:seg]
-    ci = lax.axis_index(cross_axis)
-    qfull = lax.dynamic_update_slice_in_dim(
-        jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
-    sfull = lax.dynamic_update_slice_in_dim(
-        jnp.zeros((nc, nb), jnp.float32), s2[None], ci, 0)
-    qg = lax.psum(qfull, cross_axis)
-    sg = lax.psum(sfull, cross_axis)
-    vals = (qg.astype(jnp.float32) * sg[..., None]).reshape(
-        nc, nb * blk)[:, :seg]
-    return vals, err
 
 
 def _rs_postscale(shard, op: ReduceOp, world: int, postscale_factor: float):
@@ -566,6 +256,7 @@ def reduce_scatter(
     axes=None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    plan=None,
     _presummed: bool = False,
 ):
     """Reduce a flat buffer across all ranks and return this rank's
@@ -580,7 +271,8 @@ def reduce_scatter(
 
     ``quantized`` (default: the ``HOROVOD_QUANTIZED_ALLREDUCE`` knob)
     sends blockwise-int8 on the cross-host (DCN) leg of the hierarchical
-    decomposition — hop 2 of :func:`_psum_quantized`; the ICI leg keeps
+    decomposition (the reduce half of the quantized-allreduce plan,
+    plan/compiler.py); the ICI leg keeps
     the payload dtype. ``residual`` is the error-feedback accumulator for
     that leg, sized ``n / local_size`` (this rank's ICI-scattered shard —
     quantization error lives on what this rank *sends*, which is its
@@ -594,6 +286,10 @@ def reduce_scatter(
     with ``plan_buckets(shard_multiple=world)`` (ops/fusion.py). Eagerly
     the reduction runs over the process world through the native core
     (allreduce + local slice; byte savings are a compiled-path feature).
+
+    ``plan`` (a validated :class:`horovod_tpu.plan.WirePlan` for the
+    ``reduce_scatter`` collective) overrides the knob-derived leg
+    composition; the boolean knobs remain as aliases (docs/wire-plan.md).
     """
     tensor = jnp.asarray(tensor)
     if tensor.ndim != 1:
@@ -603,6 +299,8 @@ def reduce_scatter(
     if op not in (ReduceOp.AVERAGE, ReduceOp.SUM):
         raise ValueError(f"reduce_scatter supports Average/Sum, got {op}")
     axes_t = _resolve_axes(axes)
+    if plan is not None and quantized is None:
+        quantized = plan.is_quantized
     quantized = _resolve_quantized(quantized, Compression.none)
     quantized = quantized and jnp.issubdtype(tensor.dtype, jnp.floating)
 
@@ -637,65 +335,13 @@ def reduce_scatter(
         return shard if residual is None else (shard, new_res)
 
     flat = _scale(pvary_missing(tensor, axes_t), prescale_factor)
-    hierarchical = (set(axes_t) == set(HVD_AXES)
-                    and (quantized or residual is not None))
-    if hierarchical:
-        nl = _axis_size(LOCAL_AXIS)
-        nc = _axis_size(CROSS_AXIS)
-        sn = n // nl
-        isz = jnp.dtype(flat.dtype).itemsize
-        blk = _quant_block_size(block)
-        if _acct_enabled():
-            _acct("ici", n * (nl - 1) / nl * isz)          # ICI psum_scatter
-            if nc > 1:
-                if quantized:
-                    pad_n = ((-seg) % blk + seg) * nc
-                    q_unit = pad_n + (pad_n // blk) * 4.0
-                    _acct("dcn", q_unit * (nc - 1) / nc,
-                          float(sn) * (nc - 1) / nc * isz)
-                else:
-                    _acct("dcn", sn * (nc - 1) / nc * isz)
-        # ICI leg, rank-major: view [nc, nl, seg], scatter the nl dim.
-        h = lax.psum_scatter(flat.reshape(nc, nl, seg), LOCAL_AXIS,
-                             scatter_dimension=1, tiled=True)
-        h = h.reshape(nc, seg)
-        new_res = None
-        if residual is not None:
-            if residual.shape != (sn,):
-                raise ValueError(
-                    f"reduce_scatter residual must be the post-ICI shard "
-                    f"[{sn}] (= n/local_size), got {residual.shape}")
-            h = h + residual.reshape(nc, seg).astype(h.dtype)
-        if nc == 1:
-            shard = h.reshape(seg)
-            if residual is not None:
-                new_res = jnp.zeros_like(residual)
-        elif quantized:
-            red, err = _quant_rs_leg(h.astype(jnp.float32), blk, CROSS_AXIS)
-            shard = red.astype(flat.dtype)
-            if residual is not None:
-                new_res = err.reshape(sn).astype(residual.dtype)
-        else:
-            shard = lax.psum_scatter(h, CROSS_AXIS, scatter_dimension=0,
-                                     tiled=True).reshape(seg)
-            if residual is not None:
-                new_res = jnp.zeros_like(residual)
-    else:
-        # Exact flat scatter: XLA decomposes it topology-aware, and the
-        # piece order over an axis tuple is lex (= rank-major) order.
-        if _acct_enabled():
-            isz = jnp.dtype(flat.dtype).itemsize
-            rem = float(n)
-            if LOCAL_AXIS in axes_t:
-                nl = _axis_size(LOCAL_AXIS)
-                _acct("ici", rem * (nl - 1) / nl * isz)
-                rem /= nl
-            if CROSS_AXIS in axes_t:
-                nc = _axis_size(CROSS_AXIS)
-                _acct("dcn", rem * (nc - 1) / nc * isz)
-        shard = lax.psum_scatter(flat, axes_t, scatter_dimension=0,
-                                 tiled=True)
-        new_res = None if residual is None else jnp.zeros_like(residual)
+    eff_plan = _resolve_plan(
+        plan, lambda: _planner.derive_reduce_scatter(
+            levels=_planner.levels_of(axes_t), quantized=quantized,
+            error_feedback=residual is not None, block=block))
+    shard, new_res = _plan_compiler.lower_reduce_scatter(
+        eff_plan, flat, residual=residual,
+        block=_quant_block_size(block), axes=axes_t, world=world)
     shard = _rs_postscale(shard, op, world, postscale_factor)
     return shard if residual is None else (shard, new_res)
 
@@ -708,6 +354,7 @@ def all_gather(
     axes=None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    plan=None,
 ):
     """Concatenate per-rank flat shards in rank-major order into the full
     replicated buffer — the inverse of :func:`reduce_scatter` and the
@@ -720,8 +367,9 @@ def all_gather(
     ``out_specs=P()`` consumers directly — a plain ``lax.all_gather``
     output carries a device-varying mark that would poison them.
 
-    ``quantized`` sends blockwise-int8 on the cross-host (DCN) leg — hop
-    3 of :func:`_psum_quantized` — with optional error feedback:
+    ``quantized`` sends blockwise-int8 on the cross-host (DCN) leg (the
+    gather half of the quantized-allreduce plan, plan/compiler.py) —
+    with optional error feedback:
     ``residual`` is the accumulator over this rank's OWNED segment
     (shape ``[seg]``); when given the return becomes
     ``(full, new_residual)``. Every rank (owner included) consumes the
@@ -737,6 +385,8 @@ def all_gather(
             f"all_gather operates on flat shard buffers, got shape "
             f"{shard.shape}")
     axes_t = _resolve_axes(axes)
+    if plan is not None and quantized is None:
+        quantized = plan.is_quantized
     quantized = _resolve_quantized(quantized, Compression.none)
     quantized = quantized and jnp.issubdtype(shard.dtype, jnp.floating)
 
@@ -744,8 +394,6 @@ def all_gather(
         return _eager_shard_all_gather(shard, residual, name)
 
     world = _world_size(axes_t)
-    seg = int(shard.shape[0])
-    n = seg * world
 
     if _is_replicated(shard, axes_t):
         # Equal shard everywhere: the gather is a local tile.
@@ -755,47 +403,19 @@ def all_gather(
 
     use_quant = (quantized and set(axes_t) == set(HVD_AXES)
                  and _axis_size(CROSS_AXIS) > 1)
-    if use_quant:
-        nl = _axis_size(LOCAL_AXIS)
-        nc = _axis_size(CROSS_AXIS)
-        blk = _quant_block_size(block)
-        isz = jnp.dtype(shard.dtype).itemsize
-        if _acct_enabled():
-            pad_seg = (-seg) % blk + seg
-            q_unit = pad_seg + (pad_seg // blk) * 4.0
-            _acct("dcn", 2.0 * q_unit * nc * (nc - 1) / nc,
-                  2.0 * float(seg) * nc * (nc - 1) / nc * isz)
-            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
-        x = shard.astype(jnp.float32)
-        if residual is not None:
-            if residual.shape != (seg,):
-                raise ValueError(
-                    f"all_gather residual must match the shard [{seg}], "
-                    f"got {residual.shape}")
-            x = x + residual.astype(jnp.float32)
-        vals, err = _quant_ag_leg(x, blk, CROSS_AXIS)      # [nc, seg]
-        new_res = (None if residual is None
-                   else err.astype(residual.dtype))
-        # ICI leg: place this rank's cross-gathered column at local index
-        # li of the rank-major [nc, nl, seg] layout, psum-of-disjoint.
-        li = lax.axis_index(LOCAL_AXIS)
-        fullb = jnp.zeros((nc, nl, seg), jnp.float32)
-        fullb = lax.dynamic_update_slice(fullb, vals[:, None, :], (0, li, 0))
-        full = lax.psum(fullb, LOCAL_AXIS).reshape(n).astype(shard.dtype)
-        return full if residual is None else (full, new_res)
-
-    # Exact path: one masked psum over all axes (disjoint contributions;
-    # XLA decomposes it over ICI/DCN topology-aware).
-    x = shard
-    new_res = None
-    if residual is not None:
-        x = x + residual.astype(x.dtype)  # exact wire: consume the residual
-        new_res = jnp.zeros_like(residual)
-    rank = lax.axis_index(axes_t)
-    buf = jnp.zeros((n,), x.dtype)
-    buf = lax.dynamic_update_slice_in_dim(buf, x, rank * seg, 0)
-    _acct_psum(buf, axes_t)
-    full = lax.psum(buf, axes_t)
+    eff_plan = _resolve_plan(
+        plan, lambda: _planner.derive_all_gather(
+            levels=_planner.levels_of(axes_t) if use_quant else None,
+            quantized=use_quant, error_feedback=residual is not None,
+            block=block))
+    if eff_plan.is_quantized and not use_quant:
+        # An explicit quantized plan on a mesh with no DCN hop (or
+        # custom axes) has no int8 leg to lower — fall back exact.
+        eff_plan = _planner.flat_plan("all_gather")
+    full, new_res = _plan_compiler.lower_all_gather(
+        eff_plan, shard, residual=residual,
+        block=_quant_block_size(block), axes=axes_t, world=world,
+        rank=lax.axis_index(axes_t))
     return full if residual is None else (full, new_res)
 
 
@@ -852,53 +472,12 @@ def _eager_shard_all_gather(shard, residual, name: Optional[str]):
 # under the still-executing backward. The wrappers change NO numerics —
 # they bracket the exact same collective with trace-time bookkeeping:
 # per-bucket OVERLAP:* timeline spans and WireStats.overlap_bytes (the
-# bench's comm_hidden_fraction numerator).
+# bench's comm_hidden_fraction numerator). The bracket itself
+# (plan/accounting.py overlap_stream) lives with the plan compiler, so
+# any plan-compiled collective is instrumented identically.
 # ---------------------------------------------------------------------------
 
-
-def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float) -> float:
-    """Modeled transfer time of a payload at the bench's (env-overridable)
-    link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS model
-    behind bench.py's step_time_breakdown. On the compiled path this is
-    the only per-bucket latency that exists at trace time (XLA owns the
-    runtime schedule); the eager path measures wall time instead."""
-    ici = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
-    dcn = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
-    return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)) * 1e3
-
-
-@contextlib.contextmanager
-def _overlap_stream(kind: str, bucket_id):
-    """Bracket one streamed bucket collective: emit an ``OVERLAP:<kind>``
-    timeline span (host trace time), account the bytes the wrapped
-    collective records as overlap-scheduled, and feed the per-bucket
-    bytes / modeled-latency histograms of the metrics registry."""
-    tl = basics._state.timeline if basics.is_initialized() else None
-    tid = f"bucket{bucket_id}"
-    activity = f"OVERLAP:{kind}"
-    own = WireStats()  # this bucket's bytes, recorder-independent
-    _wire_recorders.append(own)
-    outer = [ws for ws in _wire_recorders if ws is not own]
-    if tl is not None:
-        tl.begin(tid, activity)
-    try:
-        yield
-    finally:
-        _wire_recorders.remove(own)
-        delta = own.ici_bytes + own.dcn_bytes
-        for ws in outer:
-            ws.overlap_bytes += delta
-            ws.streamed_buckets += 1
-        if _metrics.metrics_enabled():
-            r = _metrics.default_registry()
-            r.counter("comm.streamed_buckets", kind=kind).inc()
-            r.histogram("comm.bucket.bytes").observe(delta)
-            # µs, not ms: the log2 buckets need the resolution (a small
-            # bucket's modeled transfer is far under a millisecond).
-            r.histogram("comm.bucket.latency_us").observe(
-                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes) * 1e3)
-        if tl is not None:
-            tl.end(tid, activity)
+_overlap_stream = _accounting.overlap_stream
 
 
 def allreduce_stream(tensor, residual=None, *, bucket_id=0, **kwargs):
@@ -968,13 +547,14 @@ def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],
     raise ValueError(f"unsupported reduce op {op}")
 
 
-def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...], hierarchical: bool):
+def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...],
+                   hierarchical: bool, plan=None):
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
-        if hierarchical and set(axes) == set(HVD_AXES):
-            red = _psum_hierarchical(x)
-        else:
-            _acct_psum(x, axes)
-            red = lax.psum(x, axes)
+        eff_plan = _resolve_plan(
+            plan, lambda: _planner.derive_allreduce(
+                levels=_planner.levels_of(axes), quantized=False,
+                hierarchical=bool(hierarchical)))
+        red = _plan_compiler.lower_psum(eff_plan, x, axes)
         if op == ReduceOp.AVERAGE:
             n = _world_size(axes)
             if jnp.issubdtype(x.dtype, jnp.integer):
@@ -1016,6 +596,7 @@ def allreduce(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    plan=None,
     _presummed: bool = False,
 ):
     """Allreduce ``tensor`` across all ranks.
@@ -1029,12 +610,18 @@ def allreduce(
     ``quantized`` (default: ``HOROVOD_QUANTIZED_ALLREDUCE``, or implied by
     ``compression=Compression.int8``) sends blockwise-scaled int8 on the
     DCN hop of the hierarchical reduce-scatter/all-gather decomposition —
-    see :func:`_psum_quantized`; ICI legs keep the payload dtype. For
-    error-feedback accumulation use :func:`quantized_allreduce`. With the
-    knob off (the default) this path is bit-identical to the unquantized
-    implementation. ``block`` overrides the ``HOROVOD_QUANT_BLOCK``
-    scale-block size for this call (the autotuner threads its tuned
-    value through here).
+    the ``[ici.rs > dcn.rs[int8] > dcn.ag[int8] > ici.ag]`` wire plan
+    (plan/compiler.py lower_quantized_allreduce); ICI legs keep the
+    payload dtype. For error-feedback accumulation use
+    :func:`quantized_allreduce`. With the knob off (the default) this
+    path is bit-identical to the unquantized implementation. ``block``
+    overrides the ``HOROVOD_QUANT_BLOCK`` scale-block size for this call
+    (the autotuner threads its tuned value through here).
+
+    ``plan`` (a validated :class:`horovod_tpu.plan.WirePlan` for the
+    ``allreduce`` collective) overrides the knob-derived leg composition
+    outright; the ``hierarchical``/``quantized`` booleans remain as
+    aliases that derive the same plans (docs/wire-plan.md).
 
     If ``tensor`` is provably replicated across the requested mesh axes
     (VMA-invariant), no collective is emitted — see
@@ -1046,7 +633,7 @@ def allreduce(
         tensor, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         name=name, axes=axes, hierarchical=hierarchical,
-        quantized=quantized, residual=None, block=block,
+        quantized=quantized, residual=None, block=block, plan=plan,
         _presummed=_presummed)
     return out
 
@@ -1062,6 +649,7 @@ def quantized_allreduce(
     name: Optional[str] = None,
     axes=None,
     block: Optional[int] = None,
+    plan=None,
 ):
     """Quantized allreduce with explicit error-feedback state.
 
@@ -1082,7 +670,7 @@ def quantized_allreduce(
         tensor, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         name=name, axes=axes, hierarchical=None, quantized=True,
-        residual=residual, block=block, _presummed=False)
+        residual=residual, block=block, plan=plan, _presummed=False)
 
 
 def _allreduce_impl(
@@ -1098,10 +686,19 @@ def _allreduce_impl(
     quantized: Optional[bool],
     residual,
     block: Optional[int] = None,
+    plan=None,
     _presummed: bool = False,
 ):
     tensor = jnp.asarray(tensor)
     axes_t = _resolve_axes(axes)
+    if plan is not None:
+        plan = plan.validate()
+        if quantized is None:
+            quantized = plan.is_quantized
+        if hierarchical is None:
+            hierarchical = plan.is_tree and not plan.is_quantized
+        if block is None:
+            block = plan.quant_block
     quantized = _resolve_quantized(quantized, compression)
     # Quantization is defined for float sum/average reductions only; other
     # ops (min/max/product/adasum) always ride the exact wire.
@@ -1141,8 +738,16 @@ def _allreduce_impl(
                 compressed = _pvary(compressed, missing)
             if (quantized and set(axes_t) == set(HVD_AXES)
                     and op in (ReduceOp.SUM, ReduceOp.AVERAGE)):
-                red, new_residual = _psum_quantized(
-                    compressed, residual=residual, block=block)
+                eff_plan = _resolve_plan(
+                    plan if (plan is not None and plan.is_quantized)
+                    else None,
+                    lambda: _planner.quantized_allreduce_plan(
+                        block=block,
+                        error_feedback=residual is not None))
+                red, new_residual = \
+                    _plan_compiler.lower_quantized_allreduce(
+                        eff_plan, compressed, residual=residual,
+                        block=_quant_block_size(block))
                 if op == ReduceOp.AVERAGE:
                     n = _world_size(axes_t)
                     red = red / jnp.asarray(n, dtype=red.dtype)
@@ -1171,8 +776,11 @@ def _allreduce_impl(
                         basics.is_initialized()
                         and basics.config().hierarchical_allreduce
                     )
+                exact_plan = (plan if plan is not None
+                              and plan.collective == "allreduce"
+                              and not plan.is_quantized else None)
                 red = _reduce_in_jit(compressed, op, axes_t,
-                                     bool(hierarchical))
+                                     bool(hierarchical), plan=exact_plan)
     else:
         # hierarchical=False matches what the eager data plane does (flat
         # rings), so only an explicit True is an unsatisfiable request —

@@ -12,7 +12,7 @@ Beyond the reference's dtype casts, ``Compression.int8`` provides
 blockwise-scaled int8 quantization (EQuARX-style: one fp32 scale per
 ``QUANT_BLOCK``-element block, values in [-127, 127]). Inside the compiled
 hierarchical allreduce it rides the wire as real int8 + scales on the
-cross-host (DCN) hop (see ``collective_ops._psum_quantized``); everywhere
+cross-host (DCN) hop (plan/compiler.py lower_quantized_allreduce); everywhere
 else — eager path, partial-axis reductions — ``compress`` degrades to a
 local quantize→dequantize round trip ("fake quant"), which preserves the
 numerics of a quantized contribution without needing an int8-aware wire
@@ -143,7 +143,8 @@ class QuantizedCompressor(Compressor):
     the fake-quantized value in the original dtype — exactly the
     contribution hop-1 of the real quantized collective transmits — and
     ``allreduce`` routes quantized compression to the real int8
-    reduce-scatter/all-gather wire (``collective_ops._psum_quantized``)
+    reduce-scatter/all-gather wire (the quantized allreduce plan,
+    plan/compiler.py)
     whenever it is tracing over the full (cross, local) mesh. Pair with
     error feedback (``quantized_allreduce(residual=...)`` or
     ``DistributedOptimizer(quantized=True)``) to carry the quantization

@@ -285,6 +285,7 @@ def allreduce_pytree(
     tuned_params=None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
+    plan=None,
 ):
     """Allreduce every leaf of a pytree with tensor fusion.
 
@@ -303,7 +304,7 @@ def allreduce_pytree(
     fused buffers and reduced on the wire.
 
     ``quantized`` routes each fused bucket through the blockwise-int8 DCN
-    wire (:func:`collective_ops._psum_quantized`); bucket padding to
+    wire (the quantized allreduce plan, plan/compiler.py); bucket padding to
     ``ATOMIC_UNIT`` keeps the per-block scales aligned with the shard
     layout. ``error_feedback`` is a pytree of per-rank residual
     accumulators matching ``tree`` (zeros initially); when given, the
@@ -329,7 +330,27 @@ def allreduce_pytree(
     with no consumer between them and the latency-hiding scheduler can
     run them under backward compute. Bucket contents and per-bucket math
     are untouched, so overlap mode is bit-identical to off
-    (docs/overlap.md)."""
+    (docs/overlap.md).
+
+    ``plan`` (a :class:`horovod_tpu.plan.WirePlan` for the gradient
+    allreduce) threads the wire composition explicitly instead of the
+    boolean knobs, which remain as aliases: wherever a knob is unset it
+    derives from the plan (``quantized`` from its int8 legs,
+    ``hierarchical`` from its tree shape, ``overlap``/``num_comm_streams``
+    from its stream placement), and the per-bucket collectives lower
+    through exactly this plan (docs/wire-plan.md)."""
+    if plan is not None:
+        plan = plan.validate()
+        if quantized is None:
+            quantized = plan.is_quantized
+        if hierarchical is None:
+            hierarchical = plan.is_tree and not plan.is_quantized
+        if block is None:
+            block = plan.quant_block
+        if overlap is None:
+            overlap = plan.overlap
+        if num_comm_streams is None:
+            num_comm_streams = plan.streams
     if tuned_params is not None:
         if threshold_bytes is None:
             threshold_bytes = tuned_params.fusion_threshold_bytes
@@ -359,7 +380,7 @@ def allreduce_pytree(
                 leaf, op=op, compression=compression, axes=axes,
                 hierarchical=hierarchical, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, quantized=quantized,
-                block=block, _presummed=presummed)
+                block=block, plan=plan, _presummed=presummed)
         else:
             varying_idx.append(i)
 
@@ -387,19 +408,21 @@ def allreduce_pytree(
                             buf, rbuf, bucket_id=j, op=op,
                             compression=compression, axes=axes,
                             prescale_factor=prescale_factor,
-                            postscale_factor=postscale_factor, block=block)
+                            postscale_factor=postscale_factor, block=block,
+                            plan=plan)
                     else:
                         red, rnew = C.quantized_allreduce(
                             buf, rbuf, op=op, compression=compression,
                             axes=axes, prescale_factor=prescale_factor,
-                            postscale_factor=postscale_factor, block=block)
+                            postscale_factor=postscale_factor, block=block,
+                            plan=plan)
                 else:
                     rnew = None
                     kw = dict(op=op, compression=compression, axes=axes,
                               hierarchical=hierarchical,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
-                              quantized=quantized, block=block)
+                              quantized=quantized, block=block, plan=plan)
                     red = (C.allreduce_stream(buf, bucket_id=j, **kw)
                            if overlap_on else C.allreduce(buf, **kw))
                 issued.append((j, red, rnew))

@@ -353,6 +353,7 @@ def DistributedOptimizer(
     num_comm_streams: Optional[int] = None,
     axes=None,
     tuned_params=None,
+    plan=None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with fused gradient allreduce.
 
@@ -416,6 +417,14 @@ def DistributedOptimizer(
     reduction wherever the explicit kwargs above were left unset —
     rebuilding the optimizer with a new override is exactly what one
     autotune trial does (the step retraces with the new bucket plan).
+
+    ``plan`` (a :class:`horovod_tpu.plan.StepPlan`, e.g. from
+    :func:`horovod_tpu.describe_plan`) threads the resolved wire plan
+    instead of the boolean knobs, which remain as aliases: wherever a
+    knob above is unset it derives from the plan's knob record, and the
+    replicated path's bucket collectives lower through exactly
+    ``plan.gradient`` (docs/wire-plan.md). Explicit kwargs still win;
+    ``tuned_params`` applies after the plan.
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -424,6 +433,30 @@ def DistributedOptimizer(
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
     quant_block = None
+    grad_plan = None
+    if plan is not None:
+        step_plan = plan
+        if not hasattr(step_plan, "gradient"):
+            raise ValueError(
+                "DistributedOptimizer(plan=...) expects a StepPlan "
+                "(hvd.describe_plan(...)); pass a bare WirePlan to the "
+                "collective entry points or allreduce_pytree instead")
+        if quantized is None:
+            quantized = step_plan.quantized
+        if zero_stage is None and zero is None:
+            zero_stage = step_plan.zero_stage
+        if overlap is None:
+            overlap = step_plan.overlap
+        if num_comm_streams is None:
+            num_comm_streams = step_plan.num_comm_streams
+        if hierarchical is None:
+            hierarchical = step_plan.hierarchical
+        if fusion_threshold_bytes is None:
+            fusion_threshold_bytes = step_plan.fusion_threshold_bytes
+        if step_plan.quantized:
+            quant_block = step_plan.quant_block
+        if step_plan.zero_stage == 0:
+            grad_plan = step_plan.gradient
     if zero_stage is None and zero is not None:
         zero_stage = 2 if zero else 0  # zero=True is the stage-2 alias
     if tuned_params is not None:
@@ -507,6 +540,7 @@ def DistributedOptimizer(
             block=quant_block,
             overlap=overlap,
             num_comm_streams=num_comm_streams,
+            plan=grad_plan,
         )
 
     if overlap and backward_passes_per_step > 1:

@@ -50,6 +50,7 @@ def allreduce_gradients(
     tuned_params=None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
+    plan=None,
 ):
     """Allreduce a gradient pytree (reference: _make_allreduce_grads_fn,
     tensorflow/__init__.py:246-278). Fused into per-dtype buckets;
@@ -66,14 +67,20 @@ def allreduce_gradients(
     ``overlap`` (default ``HOROVOD_OVERLAP``) issues the buckets through
     the reverse-layer stream schedule in flights of ``num_comm_streams``
     — bit-identical values, overlap-friendly issue order
-    (docs/overlap.md)."""
+    (docs/overlap.md). ``plan`` threads an explicit wire plan (a
+    :class:`horovod_tpu.plan.WirePlan`, or a
+    :class:`~horovod_tpu.plan.StepPlan` whose ``gradient`` is used) in
+    place of the boolean knobs, which remain as aliases
+    (docs/wire-plan.md)."""
+    if plan is not None and hasattr(plan, "gradient"):
+        plan = plan.gradient  # a StepPlan: thread its gradient wire
     return fusion.allreduce_pytree(
         grads, op=op, compression=compression,
         threshold_bytes=fusion_threshold_bytes, axes=axes,
         hierarchical=hierarchical, presummed=True,
         quantized=quantized, error_feedback=error_feedback,
         tuned_params=tuned_params, overlap=overlap,
-        num_comm_streams=num_comm_streams)
+        num_comm_streams=num_comm_streams, plan=plan)
 
 
 def value_and_grad(
@@ -92,6 +99,7 @@ def value_and_grad(
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
     tuned_params=None,
+    plan=None,
     reduce: bool = True,
     **jax_kwargs,
 ):
@@ -116,7 +124,22 @@ def value_and_grad(
     the knob's thread-through point: a step built with
     ``hvd.value_and_grad(..., zero_stage=n)`` + ``DistributedOptimizer(
     ..., zero_stage=n)`` flips between the replicated and sharded
-    schedules with one flag (see docs/zero.md)."""
+    schedules with one flag (see docs/zero.md). ``plan`` (a
+    :class:`horovod_tpu.plan.StepPlan` or bare ``WirePlan``) threads the
+    wire plan instead of the booleans — a StepPlan with ``zero_stage>0``
+    implies ``reduce=False`` exactly like the ``zero`` knob."""
+    if plan is not None and hasattr(plan, "gradient"):
+        if zero is None and zero_stage is None:
+            zero = plan.zero_stage > 0
+        if overlap is None:
+            overlap = plan.overlap
+        if num_comm_streams is None:
+            num_comm_streams = plan.num_comm_streams
+        if quantized is None:
+            quantized = plan.quantized
+        if hierarchical is None:
+            hierarchical = plan.hierarchical
+        plan = plan.gradient if plan.zero_stage == 0 else None
     if zero is None and zero_stage is not None:
         zero = zero_stage > 0
     if zero is None and tuned_params is not None:
@@ -144,7 +167,7 @@ def value_and_grad(
             fusion_threshold_bytes=fusion_threshold_bytes, axes=axes,
             hierarchical=hierarchical, quantized=quantized,
             tuned_params=tuned_params, overlap=overlap,
-            num_comm_streams=num_comm_streams)
+            num_comm_streams=num_comm_streams, plan=plan)
         return val, grads
 
     return wrapped

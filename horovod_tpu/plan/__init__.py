@@ -1,0 +1,58 @@
+"""horovod_tpu.plan: the composable wire-plan IR (docs/wire-plan.md).
+
+A collective is a :class:`WirePlan` — an ordered list of :class:`Leg`\\ s,
+each naming a mesh level (ICI ring / DCN cross / pod axis), a primitive
+(reduce-scatter, all-gather, all-to-all, psum), a wire dtype (payload /
+blockwise-int8 with error-feedback slot), and a stream assignment — plus:
+
+* a **compiler** (:mod:`~horovod_tpu.plan.compiler`) lowering a validated
+  plan to the existing jax primitives, with trace-time wire accounting
+  and overlap instrumentation built into every leg
+  (:mod:`~horovod_tpu.plan.accounting`);
+* a **planner** (:mod:`~horovod_tpu.plan.planner`) deriving the default
+  plan from (mesh shape, quantized, zero_stage, overlap, hierarchical),
+  so today's knob combinations are points in one plan space —
+  :func:`describe_plan` is the debug view, and :func:`encode_tuned` /
+  :func:`decode_tuned` the autotuner's compact search encoding.
+
+Every public collective (``hvd.allreduce`` / ``reduce_scatter`` /
+``all_gather`` and their ``*_stream`` variants) routes through this
+compiler; the bespoke hand-composed paths it replaced live on only as
+leg lowering rules in :mod:`~horovod_tpu.plan.compiler`.
+"""
+
+from .ir import (  # noqa: F401
+    ALL_GATHER,
+    ALL_TO_ALL,
+    DCN,
+    FLAT,
+    ICI,
+    INT8,
+    PAYLOAD,
+    POD,
+    PSUM,
+    REDUCE_SCATTER,
+    Leg,
+    PlanError,
+    WirePlan,
+)
+from .accounting import (  # noqa: F401
+    WireStats,
+    record_wire_stats,
+)
+from .planner import (  # noqa: F401
+    StepPlan,
+    decode_tuned,
+    derive_all_gather,
+    derive_allreduce,
+    derive_reduce_scatter,
+    describe_plan,
+    encode_tuned,
+    flat_plan,
+    predict_leg_bytes,
+    quantized_allreduce_plan,
+    tree_allreduce_plan,
+    zero_all_gather_plan,
+    zero_reduce_scatter_plan,
+)
+from . import compiler  # noqa: F401

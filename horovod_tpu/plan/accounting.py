@@ -1,0 +1,164 @@
+"""Trace-time wire accounting + overlap-stream instrumentation.
+
+This is the instrumentation half of the plan compiler: every lowered leg
+accounts the bytes it puts on each link class at TRACE time (collectives
+are traced once per compile, so static per-step byte counts cost nothing
+at runtime), and every overlap-scheduled bucket collective is bracketed
+with an ``OVERLAP:*`` timeline span plus per-bucket byte/latency
+histograms. Because the lowering rules live in ONE place
+(:mod:`horovod_tpu.plan.compiler`), every plan is instrumented for free —
+no per-path bookkeeping to forget.
+
+The cost model is per-device bytes SENT under ring/topology-aware
+schedules: reduce-scatter or all-gather of n elements over k ranks moves
+``n*(k-1)/k``, a full allreduce ``2*n*(k-1)/k``; a flat psum over the
+mesh axes is modeled as XLA's topology-aware decomposition (ICI leg on
+the full payload, DCN leg on the 1/local_size shard, pod leg on the
+1/(local*cross) shard). ``dcn_bytes_fp`` tracks what the SAME traffic
+pattern would cost at the payload's uncompressed dtype, so
+``dcn_bytes_fp / dcn_bytes`` is the wire-representation reduction of the
+quantized path (EQuARX's "~4x wire bytes" accounting).
+
+Public surface is re-exported through ``ops.collective_ops``
+(``record_wire_stats``/``WireStats``) for compatibility.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from ..common import basics
+from ..monitor import registry as _metrics
+
+
+class WireStats:
+    """Accumulated per-device wire bytes for one traced program."""
+
+    def __init__(self) -> None:
+        self.ici_bytes = 0.0
+        self.dcn_bytes = 0.0
+        self.dcn_bytes_fp = 0.0
+        # Bytes issued through the overlap stream schedule (the
+        # allreduce_stream / reduce_scatter_stream / all_gather_stream
+        # entry points, docs/overlap.md) — wire traffic positioned so the
+        # latency-hiding scheduler can run it under independent compute.
+        self.overlap_bytes = 0.0
+        self.streamed_buckets = 0
+
+    @property
+    def dcn_reduction(self) -> Optional[float]:
+        """fp-equivalent / actual bytes on the DCN hop (None if no DCN)."""
+        return (self.dcn_bytes_fp / self.dcn_bytes) if self.dcn_bytes else None
+
+    @property
+    def hidden_fraction(self) -> float:
+        """Fraction of this program's wire bytes issued through the
+        overlap stream schedule (0.0 with overlap off; collectives
+        outside the gradient bucket wire — loss allreduce, batch-stats —
+        keep it below 1.0). The bench's ``comm_hidden_fraction``."""
+        total = self.ici_bytes + self.dcn_bytes
+        return (self.overlap_bytes / total) if total else 0.0
+
+
+_wire_recorders: list = []
+
+
+def _acct_enabled() -> bool:
+    """Wire accounting is live: an explicit ``record_wire_stats`` recorder
+    is installed, or the metrics registry (enabled by default,
+    docs/observability.md) is counting trace-time wire bytes. Still a
+    trace-time-only cost — nothing here runs in the compiled step."""
+    return bool(_wire_recorders) or _metrics.metrics_enabled()
+
+
+@contextlib.contextmanager
+def record_wire_stats():
+    """Record wire bytes of every collective traced inside the context.
+    Trace-time only: wrap ``jit(...).lower(...)`` (or the first call), not
+    the steady-state execution loop. On exit the recorded profile is also
+    published to the metrics registry (``comm.wire.*`` gauges — the last
+    traced program's per-device wire bytes, hidden fraction included)."""
+    ws = WireStats()
+    _wire_recorders.append(ws)
+    try:
+        yield ws
+    finally:
+        _wire_recorders.remove(ws)
+        _publish_wire_stats(ws)
+
+
+def _publish_wire_stats(ws: "WireStats") -> None:
+    if not _metrics.metrics_enabled():
+        return
+    r = _metrics.default_registry()
+    r.counter("comm.traces").inc()
+    r.gauge("comm.wire.ici_bytes").set(ws.ici_bytes)
+    r.gauge("comm.wire.dcn_bytes").set(ws.dcn_bytes)
+    r.gauge("comm.wire.dcn_bytes_fp").set(ws.dcn_bytes_fp)
+    r.gauge("comm.wire.overlap_bytes").set(ws.overlap_bytes)
+    r.gauge("comm.wire.streamed_buckets").set(ws.streamed_buckets)
+    r.gauge("comm.wire.hidden_fraction").set(ws.hidden_fraction)
+
+
+def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
+    """Account ``wire_bytes`` per-device bytes on one link class.
+    ``kind`` is ``"ici"`` for intra-host links; ``"dcn"`` covers every
+    slow cross-host hop (the pod level is DCN-class wire too)."""
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.bytes", hop=kind).inc(wire_bytes)
+        if kind == "dcn":
+            _metrics.counter("comm.bytes_fp_equiv", hop="dcn").inc(
+                wire_bytes if fp_bytes is None else fp_bytes)
+    for ws in _wire_recorders:
+        if kind == "dcn":
+            ws.dcn_bytes += wire_bytes
+            ws.dcn_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        else:
+            ws.ici_bytes += wire_bytes
+
+
+def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float) -> float:
+    """Modeled transfer time of a payload at the bench's (env-overridable)
+    link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS model
+    behind bench.py's step_time_breakdown. On the compiled path this is
+    the only per-bucket latency that exists at trace time (XLA owns the
+    runtime schedule); the eager path measures wall time instead."""
+    ici = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
+    dcn = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+    return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)) * 1e3
+
+
+@contextlib.contextmanager
+def overlap_stream(kind: str, bucket_id):
+    """Bracket one streamed bucket collective: emit an ``OVERLAP:<kind>``
+    timeline span (host trace time), account the bytes the wrapped
+    collective records as overlap-scheduled, and feed the per-bucket
+    bytes / modeled-latency histograms of the metrics registry."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    tid = f"bucket{bucket_id}"
+    activity = f"OVERLAP:{kind}"
+    own = WireStats()  # this bucket's bytes, recorder-independent
+    _wire_recorders.append(own)
+    outer = [ws for ws in _wire_recorders if ws is not own]
+    if tl is not None:
+        tl.begin(tid, activity)
+    try:
+        yield
+    finally:
+        _wire_recorders.remove(own)
+        delta = own.ici_bytes + own.dcn_bytes
+        for ws in outer:
+            ws.overlap_bytes += delta
+            ws.streamed_buckets += 1
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.counter("comm.streamed_buckets", kind=kind).inc()
+            r.histogram("comm.bucket.bytes").observe(delta)
+            # µs, not ms: the log2 buckets need the resolution (a small
+            # bucket's modeled transfer is far under a millisecond).
+            r.histogram("comm.bucket.latency_us").observe(
+                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes) * 1e3)
+        if tl is not None:
+            tl.end(tid, activity)

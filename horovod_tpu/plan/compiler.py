@@ -1,0 +1,445 @@
+"""The wire-plan compiler: lower a validated :class:`~.ir.WirePlan` to
+the existing jax primitives, leg by leg.
+
+This file is the single home of every collective leg-composition in the
+repo — the bodies that used to live as bespoke paths in
+``ops/collective_ops.py`` (the quantized hierarchical allreduce, the
+quantized DCN reduce-scatter/all-gather legs of the ZeRO wire, the
+hierarchical psum) are now **leg lowering rules** invoked by plan family:
+
+======================  ==============================================
+lowering rule            composition it implements
+======================  ==============================================
+:func:`_leg_flat_psum`   one XLA-decomposed psum over the axis tuple
+:func:`_lower_tree_psum` ici reduce-scatter → dcn psum [→ pod psum] →
+                         ici all-gather (NCCLHierarchicalAllreduce
+                         shape, nccl_operations.cc:190-380)
+:func:`_leg_quant_rs`    quantized DCN reduce-scatter: blockwise int8 +
+                         fp32 scales over a tiled all_to_all,
+                         dequantize-accumulate at the receiver
+:func:`_leg_quant_ag`    quantized DCN all-gather: requantize the owned
+                         segment, masked int8 psum (disjoint support ⇒
+                         exact sum, replicated BY CONSTRUCTION)
+:func:`_leg_ici_gather`  ici gather as a psum of disjointly-placed
+                         shards (the repo's replication-by-construction
+                         idiom)
+======================  ==============================================
+
+Every rule accounts its wire bytes through
+:mod:`horovod_tpu.plan.accounting` at trace time, so every plan is
+instrumented for free. The compiler works on the WIRE composition only:
+op semantics (Average scaling, pre/post scale, compression casts,
+replicated short-circuits, eager fallbacks) stay in the public entry
+points of ``ops/collective_ops.py``, which derive a plan
+(:mod:`horovod_tpu.plan.planner`) and call in here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..common import basics
+from ..common.basics import CROSS_AXIS, LOCAL_AXIS, POD_AXIS
+from ..ops import compression as _compression
+from . import ir
+from .accounting import _acct, _acct_enabled
+
+# Mesh axis carried by each plan level.
+LEVEL_AXIS = {ir.ICI: LOCAL_AXIS, ir.DCN: CROSS_AXIS, ir.POD: POD_AXIS}
+
+
+def _axis_size(name) -> int:
+    return basics._axis_size(name)
+
+
+def quant_wire_bytes(seg: int, blk: int) -> float:
+    """Bytes of one quantized segment on the wire: int8 payload plus one
+    fp32 scale per ``blk`` elements, after padding ``seg`` up to a block
+    multiple (the unit every quantized-leg cost formula is built from)."""
+    pad_seg = (-seg) % blk + seg
+    return pad_seg + (pad_seg // blk) * 4.0
+
+
+# ---------------------------------------------------------------------------
+# Flat legs (one XLA-decomposed collective over the whole axis tuple).
+# ---------------------------------------------------------------------------
+
+
+def _acct_psum_flat(x, axes) -> None:
+    """Account a flat psum over ``axes`` with the topology-aware model:
+    ICI leg on the full payload, DCN leg on the 1/local shard, pod leg on
+    the 1/(local*cross) shard (pod links are DCN-class wire)."""
+    if not _acct_enabled():
+        return
+    n = float(np.prod(x.shape)) if x.ndim else 1.0
+    isz = jnp.dtype(x.dtype).itemsize
+    if LOCAL_AXIS in axes:
+        nl = _axis_size(LOCAL_AXIS)
+        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
+        n /= nl
+    if CROSS_AXIS in axes:
+        nc = _axis_size(CROSS_AXIS)
+        _acct("dcn", 2.0 * n * (nc - 1) / nc * isz)
+        n /= nc
+    if POD_AXIS in axes:
+        npod = _axis_size(POD_AXIS)
+        _acct("dcn", 2.0 * n * (npod - 1) / npod * isz)
+
+
+def _leg_flat_psum(x, axes):
+    _acct_psum_flat(x, axes)
+    return lax.psum(x, axes)
+
+
+# ---------------------------------------------------------------------------
+# Tree (hierarchical) psum: per-level reduction ladder in the payload
+# dtype. Lowering rule for the [ici.rs > dcn.psum (> pod.psum) > ici.ag]
+# plan (reference algorithm: NCCLHierarchicalAllreduce,
+# nccl_operations.cc:190-380, including the non-divisible remainder
+# handled separately — here via the flat-psum fallback, matching the
+# reference's root reduce/bcast remainder leg).
+# ---------------------------------------------------------------------------
+
+
+def _lower_tree_psum(plan: ir.WirePlan, x, axes: Tuple[str, ...]):
+    local_axis, cross_axis = LOCAL_AXIS, CROSS_AXIS
+    cross_levels = [l.level for l in plan.legs
+                    if l.primitive == ir.PSUM and l.level != ir.FLAT]
+    nl = _axis_size(local_axis)
+    if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
+        if _acct_enabled():
+            n = float(np.prod(x.shape))
+            isz = jnp.dtype(x.dtype).itemsize
+            _acct("ici", n * (nl - 1) / nl * isz)        # psum_scatter
+            for lvl in cross_levels:                      # cross psum(s)
+                k = _axis_size(LEVEL_AXIS[lvl])
+                _acct("dcn", 2.0 * (n / nl) * (k - 1) / k * isz)
+            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)  # gather-leg psum
+        shard = lax.psum_scatter(x, local_axis, scatter_dimension=0,
+                                 tiled=True)
+        for lvl in cross_levels:
+            shard = lax.psum(shard, LEVEL_AXIS[lvl])
+        # Final allgather leg, expressed as a psum of disjointly-placed
+        # shards: numerically identical to lax.all_gather but the result is
+        # provably replicated for the sharding checker (all_gather output is
+        # conservatively treated as device-varying). Note the flat psum
+        # below is usually optimal on TPU — XLA already decomposes a global
+        # AllReduce over ICI/DCN — so the tree plan is a tuning knob for
+        # multi-slice topologies, as in the reference (operations.cc:475-487).
+        li = lax.axis_index(local_axis)
+        # Fresh zeros (not zeros_like(x)) so the buffer doesn't inherit x's
+        # cross-axis varying mark — shard is already cross-reduced.
+        full = jnp.zeros(x.shape, x.dtype)
+        full = lax.dynamic_update_slice_in_dim(
+            full, shard, li * shard.shape[0], 0)
+        return lax.psum(full, local_axis)
+    return _leg_flat_psum(x, axes)
+
+
+# ---------------------------------------------------------------------------
+# Quantized DCN legs — the EQuARX decomposition placed per HiCCL's rule
+# (compress the slow cross-host hop only, never the fast ICI one). These
+# two rules are the int8 wire: ``_leg_quant_rs`` is the reduce half,
+# ``_leg_quant_ag`` the gather half; the ZeRO wire runs the optimizer
+# update between them, the quantized allreduce runs them back-to-back.
+# ---------------------------------------------------------------------------
+
+
+def _leg_quant_rs(segs, blk: int, cross_axis):
+    """Quantized DCN reduce-scatter leg: ``segs`` is this rank's
+    ICI-scattered shard viewed ``[nc, seg]`` in fp32, row ``j`` destined
+    to cross rank ``j``. Each row quantizes to int8 with one fp32 scale
+    per ``blk`` elements, a tiled ``all_to_all`` moves int8 + scales,
+    receivers dequantize-accumulate in fp32. Returns
+    ``(reduced_seg [seg] fp32, err [nc, seg] fp32)`` where ``err`` is
+    this rank's quantization error on everything it sent."""
+    nc, seg = segs.shape
+    pad = (-seg) % blk
+    if pad:
+        segs = jnp.concatenate(
+            [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
+    nb = segs.shape[1] // blk
+    blocks = segs.reshape(nc, nb, blk)
+    scales = _compression._block_scales(blocks)            # [nc, nb]
+    q = jnp.clip(jnp.round(blocks / scales[..., None]),
+                 -127, 127).astype(jnp.int8)
+    err = blocks - q.astype(jnp.float32) * scales[..., None]
+    qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
+                        tiled=True)
+    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)
+    return (acc.reshape(nb * blk)[:seg],
+            err.reshape(nc, nb * blk)[:, :seg])
+
+
+def _leg_quant_ag(seg_vals, blk: int, cross_axis):
+    """Quantized DCN all-gather leg: quantize this rank's owned segment
+    ``[seg]`` (fp32) and rebroadcast it as a masked int8 psum — disjoint
+    support makes the sum exact and the result replicated over
+    ``cross_axis`` BY CONSTRUCTION. Returns
+    ``(vals [nc, seg] fp32, err [seg] fp32)``."""
+    nc = _axis_size(cross_axis)
+    seg = seg_vals.shape[0]
+    pad = (-seg) % blk
+    padded = (jnp.concatenate([seg_vals, jnp.zeros((pad,), jnp.float32)])
+              if pad else seg_vals)
+    nb = padded.shape[0] // blk
+    blocks = padded.reshape(nb, blk)
+    s2 = _compression._block_scales(blocks)                # [nb]
+    q2 = jnp.clip(jnp.round(blocks / s2[:, None]),
+                  -127, 127).astype(jnp.int8)
+    err = (blocks - q2.astype(jnp.float32) * s2[:, None]).reshape(
+        nb * blk)[:seg]
+    ci = lax.axis_index(cross_axis)
+    qfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
+    sfull = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((nc, nb), jnp.float32), s2[None], ci, 0)
+    qg = lax.psum(qfull, cross_axis)
+    sg = lax.psum(sfull, cross_axis)
+    vals = (qg.astype(jnp.float32) * sg[..., None]).reshape(
+        nc, nb * blk)[:, :seg]
+    return vals, err
+
+
+def _leg_ici_gather(shard_flat, n: int, offset, local_axis=LOCAL_AXIS):
+    """ICI all-gather leg as a psum of disjointly-placed flat shards —
+    the replication-by-construction gather every tree plan closes with."""
+    full = jnp.zeros((n,), shard_flat.dtype)
+    full = lax.dynamic_update_slice_in_dim(full, shard_flat, offset, 0)
+    return lax.psum(full, local_axis)
+
+
+# ---------------------------------------------------------------------------
+# Allreduce lowerings.
+# ---------------------------------------------------------------------------
+
+
+def lower_psum(plan: ir.WirePlan, x, axes: Tuple[str, ...]):
+    """Lower an exact (payload-dtype) allreduce-SUM plan."""
+    if plan.is_flat:
+        return _leg_flat_psum(x, axes)
+    return _lower_tree_psum(plan, x, axes)
+
+
+def lower_quantized_allreduce(plan: ir.WirePlan, x, *, residual=None,
+                              block: int,
+                              local_axis=LOCAL_AXIS,
+                              cross_axis=CROSS_AXIS):
+    """Lower the quantized allreduce-SUM plan
+    ``[ici.rs > dcn.rs[int8] > dcn.ag[int8] > ici.ag]`` with optional
+    error feedback.
+
+    1. intra-host reduce-scatter (ICI, payload dtype);
+    2. :func:`_leg_quant_rs` — cross-host quantized reduce-scatter;
+    3. :func:`_leg_quant_ag` — cross-host quantized all-gather;
+    4. :func:`_leg_ici_gather` — intra-host gather, payload dtype.
+
+    Returns ``(sum, new_residual)``. With ``residual`` (error feedback),
+    the residual is added to ``x`` before hop 1 and the returned residual
+    holds this rank's quantization error — hop 2's error on the whole
+    shard it contributed plus hop 3's requantization error on the segment
+    it owns — written at the exact buffer positions where the next step's
+    reduce-scatter re-collects each component exactly once.
+
+    Falls back to an exact flat psum (consuming the residual, returning it
+    as zeros) when there is no cross axis or the flattened size does not
+    shard evenly over ``local_size * cross_size``.
+    """
+    nl = _axis_size(local_axis)
+    nc = _axis_size(cross_axis)
+    blk = int(block)
+    corrected = x if residual is None else x + residual.astype(x.dtype)
+    n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 0
+    if nc == 1 or n == 0 or n % nl or (n // nl) % nc:
+        axes = (cross_axis, local_axis)
+        out = _leg_flat_psum(corrected, axes)
+        return out, (None if residual is None else jnp.zeros_like(residual))
+
+    flat = jnp.ravel(corrected)
+    sn = n // nl        # shard elements per device after the ICI leg
+    seg = sn // nc      # segment elements per cross rank within a shard
+    isz = jnp.dtype(x.dtype).itemsize
+    if _acct_enabled():
+        q_unit = quant_wire_bytes(seg, blk) * nc  # padded shard wire bytes
+        _acct("ici", n * (nl - 1) / nl * isz)              # psum_scatter
+        _acct("dcn", q_unit * (nc - 1) / nc,               # hop-2 all_to_all
+              float(sn) * (nc - 1) / nc * isz)
+        _acct("dcn", 2.0 * q_unit * (nc - 1) / nc,         # hop-3 masked psum
+              2.0 * float(sn) * (nc - 1) / nc * isz)
+        _acct("ici", 2.0 * n * (nl - 1) / nl * isz)        # ICI gather leg
+
+    # Leg 1 — ICI reduce-scatter in the payload dtype.
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                             tiled=True)
+
+    # Leg 2 — quantized DCN reduce-scatter (all_to_all of int8 + scales).
+    segs = shard.reshape(nc, seg).astype(jnp.float32)
+    red_seg, err1 = _leg_quant_rs(segs, blk, cross_axis)   # [seg], [nc, seg]
+
+    # Leg 3 — requantize the reduced segment; masked int8 psum gathers the
+    # shard with replication by construction (disjoint segment support).
+    vals, err2 = _leg_quant_ag(red_seg, blk, cross_axis)   # [nc, seg], [seg]
+    shard_red = vals.reshape(sn).astype(x.dtype)
+
+    # Leg 4 — ICI gather (psum of disjointly-placed shards).
+    li = lax.axis_index(local_axis)
+    out = _leg_ici_gather(shard_red, n, li * sn,
+                          local_axis).reshape(x.shape)
+    if residual is None:
+        return out, None
+
+    # Error feedback: leg-2 error on every segment this rank contributed,
+    # plus leg-3's requantization error on the one segment it owns.
+    ci = lax.axis_index(cross_axis)
+    rows = jnp.arange(nc)[:, None]
+    err_sh = (err1 + jnp.where(rows == ci, err2[None], 0.0)).reshape(sn)
+    res_full = lax.dynamic_update_slice_in_dim(
+        jnp.zeros((n,), jnp.float32), err_sh, li * sn, 0)
+    return out, res_full.reshape(x.shape).astype(residual.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Reduce-scatter / all-gather lowerings — the ZeRO wire pair. Rank-major
+# layout: the bucket viewed [nc, nl, seg] so rank r = cross*local + local
+# owns contiguous flat elements [r*seg, (r+1)*seg) — how P(HVD_AXES)
+# splits a leading dim.
+# ---------------------------------------------------------------------------
+
+
+def lower_reduce_scatter(plan: ir.WirePlan, flat, *, residual=None,
+                         block: int, axes: Tuple[str, ...], world: int):
+    """Lower a reduce-scatter plan over a flat [n] bucket; returns
+    ``(shard [n/world], new_residual)``.
+
+    Flat plan: one ``lax.psum_scatter`` over the axis tuple (XLA
+    decomposes it topology-aware; piece order over an axis tuple is lex
+    = rank-major order). Tree plan (``[ici.rs > dcn.rs[int8|payload]]``):
+    rank-major ICI scatter, then the DCN leg in the plan's wire dtype —
+    ``residual`` is the error-feedback accumulator of the int8 leg,
+    sized ``[n / local_size]`` (this rank's post-ICI shard)."""
+    n = int(flat.shape[0])
+    seg = n // world
+    isz = jnp.dtype(flat.dtype).itemsize
+    if plan.is_flat:
+        if _acct_enabled():
+            rem = float(n)
+            if LOCAL_AXIS in axes:
+                nl = _axis_size(LOCAL_AXIS)
+                _acct("ici", rem * (nl - 1) / nl * isz)
+                rem /= nl
+            if CROSS_AXIS in axes:
+                nc = _axis_size(CROSS_AXIS)
+                _acct("dcn", rem * (nc - 1) / nc * isz)
+                rem /= nc
+            if POD_AXIS in axes:
+                npod = _axis_size(POD_AXIS)
+                _acct("dcn", rem * (npod - 1) / npod * isz)
+        shard = lax.psum_scatter(flat, axes, scatter_dimension=0,
+                                 tiled=True)
+        new_res = None if residual is None else jnp.zeros_like(residual)
+        return shard, new_res
+
+    quantized = plan.is_quantized
+    nl = _axis_size(LOCAL_AXIS)
+    nc = _axis_size(CROSS_AXIS)
+    sn = n // nl
+    blk = int(block)
+    if _acct_enabled():
+        _acct("ici", n * (nl - 1) / nl * isz)          # ICI psum_scatter
+        if nc > 1:
+            if quantized:
+                q_unit = quant_wire_bytes(seg, blk) * nc
+                _acct("dcn", q_unit * (nc - 1) / nc,
+                      float(sn) * (nc - 1) / nc * isz)
+            else:
+                _acct("dcn", sn * (nc - 1) / nc * isz)
+    # ICI leg, rank-major: view [nc, nl, seg], scatter the nl dim.
+    h = lax.psum_scatter(flat.reshape(nc, nl, seg), LOCAL_AXIS,
+                         scatter_dimension=1, tiled=True)
+    h = h.reshape(nc, seg)
+    new_res = None
+    if residual is not None:
+        if residual.shape != (sn,):
+            raise ValueError(
+                f"reduce_scatter residual must be the post-ICI shard "
+                f"[{sn}] (= n/local_size), got {residual.shape}")
+        h = h + residual.reshape(nc, seg).astype(h.dtype)
+    if nc == 1:
+        shard = h.reshape(seg)
+        if residual is not None:
+            new_res = jnp.zeros_like(residual)
+    elif quantized:
+        red, err = _leg_quant_rs(h.astype(jnp.float32), blk, CROSS_AXIS)
+        shard = red.astype(flat.dtype)
+        if residual is not None:
+            new_res = err.reshape(sn).astype(residual.dtype)
+    else:
+        shard = lax.psum_scatter(h, CROSS_AXIS, scatter_dimension=0,
+                                 tiled=True).reshape(seg)
+        if residual is not None:
+            new_res = jnp.zeros_like(residual)
+    return shard, new_res
+
+
+def lower_all_gather(plan: ir.WirePlan, shard, *, residual=None,
+                     block: int, axes: Tuple[str, ...], world: int,
+                     rank):
+    """Lower an all-gather plan over a flat [seg] shard; returns
+    ``(full [seg*world], new_residual)`` — replicated BY CONSTRUCTION
+    (masked-psum idiom on every path).
+
+    Flat plan: one masked psum over the axis tuple. Quantized plan
+    (``[dcn.ag[int8] > ici.ag]``): the DCN leg re-broadcasts this rank's
+    owned segment as blockwise int8 (``residual`` is the EF accumulator
+    over that segment), then the ICI leg places the cross-gathered
+    column at this rank's local index of the rank-major
+    ``[nc, nl, seg]`` layout and psums the disjoint contributions."""
+    seg = int(shard.shape[0])
+    n = seg * world
+    if plan.is_quantized:
+        nl = _axis_size(LOCAL_AXIS)
+        nc = _axis_size(CROSS_AXIS)
+        blk = int(block)
+        isz = jnp.dtype(shard.dtype).itemsize
+        if _acct_enabled():
+            q_unit = quant_wire_bytes(seg, blk)
+            _acct("dcn", 2.0 * q_unit * nc * (nc - 1) / nc,
+                  2.0 * float(seg) * nc * (nc - 1) / nc * isz)
+            _acct("ici", 2.0 * n * (nl - 1) / nl * isz)
+        x = shard.astype(jnp.float32)
+        new_res = None
+        if residual is not None:
+            if residual.shape != (seg,):
+                raise ValueError(
+                    f"all_gather residual must match the shard [{seg}], "
+                    f"got {residual.shape}")
+            x = x + residual.astype(jnp.float32)
+        vals, err = _leg_quant_ag(x, blk, CROSS_AXIS)      # [nc, seg]
+        if residual is not None:
+            new_res = err.astype(residual.dtype)
+        # ICI leg: place this rank's cross-gathered column at local index
+        # li of the rank-major [nc, nl, seg] layout, psum-of-disjoint.
+        li = lax.axis_index(LOCAL_AXIS)
+        fullb = jnp.zeros((nc, nl, seg), jnp.float32)
+        fullb = lax.dynamic_update_slice(fullb, vals[:, None, :], (0, li, 0))
+        full = lax.psum(fullb, LOCAL_AXIS).reshape(n).astype(shard.dtype)
+        return full, new_res
+
+    # Exact path: one masked psum over all axes (disjoint contributions;
+    # XLA decomposes it over ICI/DCN topology-aware).
+    x = shard
+    new_res = None
+    if residual is not None:
+        x = x + residual.astype(x.dtype)  # exact wire: consume the residual
+        new_res = jnp.zeros_like(residual)
+    buf = jnp.zeros((n,), x.dtype)
+    buf = lax.dynamic_update_slice_in_dim(buf, x, rank * seg, 0)
+    _acct_psum_flat(buf, axes)
+    return lax.psum(buf, axes), new_res

@@ -1,0 +1,265 @@
+"""The wire-plan IR: a collective as an ordered list of per-level legs.
+
+Following HiCCL (arXiv:2408.05962), a collective over a machine hierarchy
+is best expressed as a *composition of per-level primitives* rather than a
+monolithic hand-written path: an allreduce over a TPU pod is an intra-host
+reduce-scatter (ICI), a cross-host reduction (DCN), and an intra-host
+all-gather — and a quantized allreduce (EQuARX, arXiv:2506.17615) is the
+SAME composition with an int8 wire dtype attribute on the DCN hops, not a
+separate code path.
+
+The IR is deliberately tiny:
+
+* a :class:`Leg` names a mesh **level** (``ici`` ring / ``dcn`` cross /
+  ``pod`` axis, or ``flat`` for one XLA-decomposed collective over the
+  whole axis tuple), a **primitive** (``reduce_scatter`` / ``all_gather``
+  / ``all_to_all`` / ``psum``), a **wire dtype** (``payload`` or
+  blockwise-``int8`` with an fp32 scale per ``block`` elements and an
+  optional error-feedback slot), and a **stream** assignment;
+* a :class:`WirePlan` is an ordered leg tuple plus the stream/overlap
+  placement for the whole collective.
+
+Plans are *validated data*, not code: :meth:`WirePlan.validate` rejects
+illegal compositions (a reduce leg after the gather phase began, int8 on
+a non-DCN hop, a non-power-of-two stream count) with actionable messages,
+and the compiler (:mod:`horovod_tpu.plan.compiler`) lowers a validated
+plan to the existing jax primitives. The planner
+(:mod:`horovod_tpu.plan.planner`) derives the default plan from today's
+knob set, so every (quantized, zero_stage, overlap, hierarchical) knob
+combination is one point in plan space.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+# Mesh levels a leg can ride. ``flat`` is the degenerate single-leg plan:
+# one collective over the whole axis tuple, letting XLA's topology-aware
+# decomposition place the ICI/DCN traffic itself.
+ICI = "ici"
+DCN = "dcn"
+POD = "pod"
+FLAT = "flat"
+LEVELS = (ICI, DCN, POD, FLAT)
+
+# Per-leg primitives (the HiCCL composition alphabet, restricted to what
+# the TPU lowerings use).
+REDUCE_SCATTER = "reduce_scatter"
+ALL_GATHER = "all_gather"
+ALL_TO_ALL = "all_to_all"
+PSUM = "psum"
+PRIMITIVES = (REDUCE_SCATTER, ALL_GATHER, ALL_TO_ALL, PSUM)
+
+# Wire dtypes. ``payload`` rides whatever dtype the caller handed the
+# collective (after any Compression cast); ``int8`` is the blockwise-
+# scaled int8 wire with one fp32 scale per ``block`` elements.
+PAYLOAD = "payload"
+BF16 = "bf16"
+INT8 = "int8"
+WIRE_DTYPES = (PAYLOAD, BF16, INT8)
+
+_REDUCE_PRIMS = (REDUCE_SCATTER, PSUM, ALL_TO_ALL)
+_GATHER_PRIMS = (ALL_GATHER,)
+
+_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather")
+
+
+class PlanError(ValueError):
+    """A wire plan failed validation (illegal leg composition)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Leg:
+    """One hop of a wire plan: a primitive at a mesh level.
+
+    ``wire_dtype``/``block`` describe the bytes on THIS hop only (the
+    EQuARX rule: dtype transforms are per-hop attributes, and int8 is
+    only legal on the slow DCN hop — the ICI leg always rides the
+    payload dtype). ``error_feedback`` marks the hop as carrying an
+    error-feedback residual slot (the quantization error of what this
+    rank sent, re-injected next step). ``stream`` is the comm-stream
+    slot the leg's bucket collective is issued on when the plan is
+    overlap-scheduled (0-based, < :attr:`WirePlan.streams`).
+    """
+
+    level: str
+    primitive: str
+    wire_dtype: str = PAYLOAD
+    block: Optional[int] = None
+    error_feedback: bool = False
+    stream: int = 0
+
+    def describe(self) -> str:
+        d = self.wire_dtype
+        if self.wire_dtype == INT8 and self.block:
+            d = f"int8/{self.block}"
+        if self.error_feedback:
+            d += "+ef"
+        return f"{self.level}.{self.primitive}[{d}]"
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePlan:
+    """An ordered leg composition for one collective.
+
+    ``streams`` is the flight width of the overlap schedule (how many
+    bucket collectives sit in the program with no consumer between
+    them); ``overlap`` marks the plan for reverse-layer stream placement
+    (:func:`horovod_tpu.ops.fusion.stream_order`). Neither changes the
+    math — they are placement attributes, which is why overlap-on is
+    bit-identical to off (docs/overlap.md).
+    """
+
+    collective: str
+    legs: Tuple[Leg, ...]
+    streams: int = 1
+    overlap: bool = False
+
+    # -- structure queries (the compiler and planner dispatch on these) --
+
+    @property
+    def is_flat(self) -> bool:
+        return len(self.legs) == 1 and self.legs[0].level == FLAT
+
+    @property
+    def is_quantized(self) -> bool:
+        return any(l.wire_dtype == INT8 for l in self.legs)
+
+    @property
+    def is_tree(self) -> bool:
+        """A multi-leg hierarchical (per-level) composition."""
+        return not self.is_flat and len(self.legs) > 1
+
+    @property
+    def levels(self) -> Tuple[str, ...]:
+        return tuple(l.level for l in self.legs)
+
+    @property
+    def quant_block(self) -> Optional[int]:
+        for l in self.legs:
+            if l.wire_dtype == INT8 and l.block:
+                return l.block
+        return None
+
+    def encode(self) -> str:
+        """Compact one-line encoding — legs joined with ``>`` plus the
+        stream placement suffix. Stable: the autotuner's CSV/cache plan
+        column and the golden-text plan dumps both use it."""
+        body = ">".join(l.describe() for l in self.legs)
+        tail = f"|s{self.streams}|{'ovl' if self.overlap else 'sync'}"
+        return f"{self.collective}:{body}{tail}"
+
+    # -- validation ------------------------------------------------------
+
+    def validate(self) -> "WirePlan":
+        """Check the composition; raises :class:`PlanError` with an
+        actionable message on the first violation. Returns self so
+        ``WirePlan(...).validate()`` chains."""
+        if self.collective not in _COLLECTIVES:
+            raise PlanError(
+                f"unknown collective {self.collective!r}: a wire plan "
+                f"compiles one of {_COLLECTIVES}")
+        if not self.legs:
+            raise PlanError(
+                f"empty {self.collective} plan: a plan needs at least "
+                f"one leg (use a single flat leg for the XLA-decomposed "
+                f"default)")
+        if self.streams not in (1, 2, 4):
+            raise PlanError(
+                f"stream count {self.streams} is invalid: comm streams "
+                f"must be a power of two in 1..4 "
+                f"(HOROVOD_NUM_COMM_STREAMS contract, docs/overlap.md)")
+        for i, leg in enumerate(self.legs):
+            where = f"leg {i} ({leg.level}.{leg.primitive})"
+            if leg.level not in LEVELS:
+                raise PlanError(
+                    f"{where}: unknown level {leg.level!r} — levels are "
+                    f"{LEVELS} (ici=intra-host ring, dcn=cross-host, "
+                    f"pod=cross-pod, flat=whole axis tuple)")
+            if leg.primitive not in PRIMITIVES:
+                raise PlanError(
+                    f"{where}: unknown primitive {leg.primitive!r} — "
+                    f"primitives are {PRIMITIVES}")
+            if leg.wire_dtype not in WIRE_DTYPES:
+                raise PlanError(
+                    f"{where}: unknown wire dtype {leg.wire_dtype!r} — "
+                    f"wire dtypes are {WIRE_DTYPES}")
+            if leg.wire_dtype == INT8 and leg.level not in (DCN, POD):
+                raise PlanError(
+                    f"{where}: blockwise-int8 wire dtype on a non-DCN "
+                    f"hop — compression belongs on the slow cross-host "
+                    f"links only; the ICI leg always rides the payload "
+                    f"dtype (HiCCL placement rule, docs/wire-plan.md)")
+            if leg.error_feedback and leg.level not in (DCN, POD):
+                raise PlanError(
+                    f"{where}: error-feedback slot on a non-DCN hop — "
+                    f"EF accumulates the quantization error of the "
+                    f"compressed cross-host wire; exact ICI legs have "
+                    f"no error to feed back")
+            if leg.block is not None and leg.wire_dtype != INT8:
+                raise PlanError(
+                    f"{where}: scale block {leg.block} without an int8 "
+                    f"wire dtype — block is the int8 scale granularity")
+            if leg.block is not None and leg.block < 1:
+                raise PlanError(
+                    f"{where}: scale block must be >= 1, got {leg.block}")
+            if not (0 <= leg.stream < self.streams):
+                raise PlanError(
+                    f"{where}: stream {leg.stream} out of range for a "
+                    f"{self.streams}-stream plan (streams are 0-based "
+                    f"flight slots)")
+            if leg.level == FLAT and len(self.legs) > 1:
+                raise PlanError(
+                    f"{where}: a flat leg is the WHOLE plan (one "
+                    f"XLA-decomposed collective over the full axis "
+                    f"tuple) — it cannot compose with per-level legs")
+        self._validate_order()
+        return self
+
+    def _validate_order(self) -> None:
+        prims = [(l.level, l.primitive) for l in self.legs]
+        if self.collective == "allreduce":
+            # Reduce phase (reduce_scatter / psum / all_to_all) first,
+            # gather phase (all_gather) after; every level scattered must
+            # be re-gathered in mirror (LIFO) order.
+            gather_started = False
+            scattered: list = []
+            gathered: list = []
+            for i, (level, prim) in enumerate(prims):
+                if prim in _GATHER_PRIMS:
+                    gather_started = True
+                    gathered.append(level)
+                elif gather_started:
+                    raise PlanError(
+                        f"illegal leg order in {self.encode()}: leg {i} "
+                        f"({level}.{prim}) is a reduce leg after the "
+                        f"gather phase began — an allreduce plan must "
+                        f"finish its reduction ladder before re-"
+                        f"gathering (scatter down, gather back up)")
+                if prim == REDUCE_SCATTER and level != FLAT:
+                    scattered.append(level)
+            if scattered and gathered != list(reversed(scattered)):
+                raise PlanError(
+                    f"unbalanced allreduce plan {self.encode()}: levels "
+                    f"reduce-scattered {scattered} must be re-gathered "
+                    f"in mirror order, got gathers {gathered} — the "
+                    f"output would not be the full replicated sum")
+        elif self.collective == "reduce_scatter":
+            for i, (level, prim) in enumerate(prims):
+                if prim in _GATHER_PRIMS:
+                    raise PlanError(
+                        f"illegal leg in {self.encode()}: leg {i} "
+                        f"({level}.{prim}) — a reduce_scatter plan ends "
+                        f"holding 1/world shards; an all_gather leg "
+                        f"belongs to the all_gather plan (the ZeRO wire "
+                        f"splits the allreduce in half around the "
+                        f"optimizer update)")
+        elif self.collective == "all_gather":
+            for i, (level, prim) in enumerate(prims):
+                if prim not in _GATHER_PRIMS and level != FLAT:
+                    raise PlanError(
+                        f"illegal leg in {self.encode()}: leg {i} "
+                        f"({level}.{prim}) — an all_gather plan only "
+                        f"concatenates shards; reductions belong to the "
+                        f"reduce_scatter/allreduce plans")

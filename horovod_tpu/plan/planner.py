@@ -1,0 +1,529 @@
+"""The planner: derive a wire plan from today's knob set.
+
+Every knob combination the collective stack used to hand-compose —
+``quantized`` × ``zero_stage`` × ``overlap`` × ``hierarchical`` × stream
+count — is one point in plan space:
+
+==============================  =======================================
+knobs                            gradient wire plan
+==============================  =======================================
+(defaults)                       ``allreduce: flat.psum`` (XLA
+                                 decomposes over ICI/DCN itself)
+``hierarchical=True``            ``allreduce: ici.rs > dcn.psum >
+                                 ici.ag`` (+ ``pod.psum`` on a 3-level
+                                 mesh)
+``quantized=True``               ``allreduce: ici.rs > dcn.rs[int8] >
+                                 dcn.ag[int8] > ici.ag``
+``zero_stage>0``                 split in half around the optimizer
+                                 update: a ``reduce_scatter`` plan for
+                                 the gradients + an ``all_gather`` plan
+                                 for the updates (stage 3 moves the
+                                 gather to the next forward)
+``overlap`` / ``streams``        placement attributes on any of the
+                                 above (reverse-layer issue order,
+                                 flight width) — never the math
+==============================  =======================================
+
+:func:`describe_plan` is the debug API (``hvd.describe_plan(**knobs)``):
+it resolves unset knobs exactly like ``DistributedOptimizer`` would (env
+config included) and returns a :class:`StepPlan` whose :meth:`~StepPlan.
+table` renders legs, hops, wire dtypes, streams, and predicted per-device
+wire bytes from the trace-time cost model — ``bench.py --dump-plan``
+prints it, and golden-text tests pin it so plan regressions show up as
+readable diffs.
+
+:func:`encode_tuned` / :func:`decode_tuned` are the autotuner's compact
+plan encoding (leg order, per-hop dtype, stream placement): the GP
+searches this space instead of three disconnected relaxed-categorical
+booleans, and configurations that compile to the SAME wire (e.g.
+``hierarchical`` under ZeRO, where the rs/ag split ignores it) collapse
+to one plan — one trial, not two recompiles.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional, Tuple
+
+from ..common import basics
+from ..common.config import _env_bool, _env_int
+from .ir import (ALL_GATHER, DCN, FLAT, ICI, INT8, PAYLOAD, POD, PSUM,
+                 REDUCE_SCATTER, Leg, PlanError, WirePlan)
+
+_AXIS_LEVEL = {basics.LOCAL_AXIS: ICI, basics.CROSS_AXIS: DCN,
+               basics.POD_AXIS: POD}
+
+
+def levels_of(axes_t) -> Optional[Tuple[str, ...]]:
+    """Map a bound axis tuple onto plan levels, or None when the tuple
+    names non-Horovod axes (custom ``axes=`` — always lowered flat)."""
+    try:
+        return tuple(_AXIS_LEVEL[a] for a in axes_t)
+    except KeyError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Canonical plan constructors.
+# ---------------------------------------------------------------------------
+
+
+def flat_plan(collective: str, *, streams: int = 1,
+              overlap: bool = False) -> WirePlan:
+    prim = {"allreduce": PSUM, "reduce_scatter": REDUCE_SCATTER,
+            "all_gather": ALL_GATHER}[collective]
+    return WirePlan(collective, (Leg(FLAT, prim),), streams=streams,
+                    overlap=overlap).validate()
+
+
+def tree_allreduce_plan(*, pod: bool = False, streams: int = 1,
+                        overlap: bool = False) -> WirePlan:
+    legs = [Leg(ICI, REDUCE_SCATTER), Leg(DCN, PSUM)]
+    if pod:
+        legs.append(Leg(POD, PSUM))
+    legs.append(Leg(ICI, ALL_GATHER))
+    return WirePlan("allreduce", tuple(legs), streams=streams,
+                    overlap=overlap).validate()
+
+
+def quantized_allreduce_plan(*, block: Optional[int] = None,
+                             error_feedback: bool = False,
+                             streams: int = 1,
+                             overlap: bool = False) -> WirePlan:
+    legs = (
+        Leg(ICI, REDUCE_SCATTER),
+        Leg(DCN, REDUCE_SCATTER, INT8, block=block,
+            error_feedback=error_feedback),
+        Leg(DCN, ALL_GATHER, INT8, block=block,
+            error_feedback=error_feedback),
+        Leg(ICI, ALL_GATHER),
+    )
+    return WirePlan("allreduce", legs, streams=streams,
+                    overlap=overlap).validate()
+
+
+def zero_reduce_scatter_plan(*, quantized: bool = False,
+                             block: Optional[int] = None,
+                             error_feedback: bool = False,
+                             streams: int = 1,
+                             overlap: bool = False) -> WirePlan:
+    """The ZeRO gradient wire (the reduce half of the quantized
+    allreduce, stopped before the optimizer update)."""
+    dcn = (Leg(DCN, REDUCE_SCATTER, INT8, block=block,
+               error_feedback=error_feedback) if quantized
+           else Leg(DCN, REDUCE_SCATTER, PAYLOAD,
+                    error_feedback=error_feedback))
+    return WirePlan("reduce_scatter",
+                    (Leg(ICI, REDUCE_SCATTER), dcn),
+                    streams=streams, overlap=overlap).validate()
+
+
+def zero_all_gather_plan(*, quantized: bool = False,
+                         block: Optional[int] = None,
+                         error_feedback: bool = False,
+                         streams: int = 1,
+                         overlap: bool = False) -> WirePlan:
+    """The ZeRO update broadcast (the gather half)."""
+    if quantized:
+        legs = (Leg(DCN, ALL_GATHER, INT8, block=block,
+                    error_feedback=error_feedback),
+                Leg(ICI, ALL_GATHER))
+        return WirePlan("all_gather", legs, streams=streams,
+                        overlap=overlap).validate()
+    return flat_plan("all_gather", streams=streams, overlap=overlap)
+
+
+# ---------------------------------------------------------------------------
+# Knob → plan derivation (what the entry points call per trace).
+# ---------------------------------------------------------------------------
+
+
+def derive_allreduce(*, levels, quantized: bool, hierarchical: bool,
+                     block: Optional[int] = None,
+                     error_feedback: bool = False,
+                     streams: int = 1, overlap: bool = False) -> WirePlan:
+    """Today's allreduce knob combination as a plan. ``levels`` is the
+    bound-axis level tuple (None for custom axes → flat)."""
+    lvls = set(levels or ())
+    if quantized and lvls == {ICI, DCN}:
+        return quantized_allreduce_plan(block=block,
+                                        error_feedback=error_feedback,
+                                        streams=streams, overlap=overlap)
+    if hierarchical and {ICI, DCN} <= lvls:
+        return tree_allreduce_plan(pod=POD in lvls, streams=streams,
+                                   overlap=overlap)
+    return flat_plan("allreduce", streams=streams, overlap=overlap)
+
+
+def derive_reduce_scatter(*, levels, quantized: bool,
+                          error_feedback: bool = False,
+                          block: Optional[int] = None,
+                          streams: int = 1,
+                          overlap: bool = False) -> WirePlan:
+    lvls = set(levels or ())
+    if lvls == {ICI, DCN} and (quantized or error_feedback):
+        return zero_reduce_scatter_plan(
+            quantized=quantized, block=block,
+            error_feedback=error_feedback, streams=streams,
+            overlap=overlap)
+    return flat_plan("reduce_scatter", streams=streams, overlap=overlap)
+
+
+def derive_all_gather(*, levels, quantized: bool,
+                      error_feedback: bool = False,
+                      block: Optional[int] = None,
+                      streams: int = 1, overlap: bool = False) -> WirePlan:
+    lvls = set(levels or ())
+    if quantized and lvls == {ICI, DCN}:
+        return zero_all_gather_plan(
+            quantized=True, block=block, error_feedback=error_feedback,
+            streams=streams, overlap=overlap)
+    return flat_plan("all_gather", streams=streams, overlap=overlap)
+
+
+# ---------------------------------------------------------------------------
+# Cost model: predicted per-device wire bytes per leg (the same formulas
+# the compiler's trace-time accounting charges — docs/wire-plan.md).
+# ---------------------------------------------------------------------------
+
+
+def _mesh_sizes(mesh_shape) -> Tuple[int, int, int]:
+    """(local, cross, pod) sizes of a (cross, local[, pods]) shape."""
+    if len(mesh_shape) == 3:
+        nc, nl, npod = mesh_shape
+    else:
+        (nc, nl), npod = mesh_shape, 1
+    return int(nl), int(nc), int(npod)
+
+
+def _quant_unit(seg: int, blk: int) -> float:
+    pad_seg = (-seg) % blk + seg
+    return pad_seg + (pad_seg // blk) * 4.0
+
+
+def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
+                      mesh_shape) -> List[dict]:
+    """Per-leg predicted wire bytes for a payload of ``n`` elements.
+    Each row: ``{leg, hop, bytes, fp_bytes}`` where ``hop`` is the link
+    class charged (``ici``/``dcn``/``-``) and ``fp_bytes`` the same
+    traffic at the payload dtype (differs only on int8 legs)."""
+    nl, nc, npod = _mesh_sizes(mesh_shape)
+    world = nl * nc * npod
+    isz = itemsize
+    blk = plan.quant_block or 256
+    sn = n // nl if nl else n
+    seg_w = n // world if world else n
+    rows: List[dict] = []
+
+    def row(leg, hop, b, fp=None):
+        rows.append({"leg": leg, "hop": hop, "bytes": b,
+                     "fp_bytes": b if fp is None else fp})
+
+    if plan.is_flat:
+        leg = plan.legs[0]
+        if plan.collective == "allreduce":
+            b = 2.0 * n * (nl - 1) / nl * isz
+            d = 2.0 * (n / nl) * (nc - 1) / nc * isz
+            d += 2.0 * (n / nl / nc) * (npod - 1) / npod * isz
+        elif plan.collective == "reduce_scatter":
+            b = n * (nl - 1) / nl * isz
+            d = (n / nl) * (nc - 1) / nc * isz
+            d += (n / nl / nc) * (npod - 1) / npod * isz
+        else:  # all_gather of the full [n] masked buffer
+            b = 2.0 * n * (nl - 1) / nl * isz
+            d = 2.0 * (n / nl) * (nc - 1) / nc * isz
+            d += 2.0 * (n / nl / nc) * (npod - 1) / npod * isz
+        row(leg, "ici", b)
+        row(leg, "dcn", d)
+        return rows
+
+    for leg in plan.legs:
+        if leg.level == ICI and leg.primitive == REDUCE_SCATTER:
+            row(leg, "ici", n * (nl - 1) / nl * isz)
+        elif leg.level == ICI and leg.primitive == ALL_GATHER:
+            row(leg, "ici", 2.0 * n * (nl - 1) / nl * isz)
+        elif leg.level in (DCN, POD) and leg.primitive == PSUM:
+            k = nc if leg.level == DCN else npod
+            row(leg, "dcn", 2.0 * (n / nl) * (k - 1) / k * isz)
+        elif leg.level == DCN and leg.primitive == REDUCE_SCATTER:
+            if leg.wire_dtype == INT8:
+                seg = (seg_w if plan.collective == "reduce_scatter"
+                       else sn // nc)
+                q = _quant_unit(seg, leg.block or blk) * nc
+                row(leg, "dcn", q * (nc - 1) / nc,
+                    float(sn) * (nc - 1) / nc * isz)
+            else:
+                row(leg, "dcn", sn * (nc - 1) / nc * isz)
+        elif leg.level == DCN and leg.primitive == ALL_GATHER:
+            if plan.collective == "all_gather":
+                # each rank gathers its owned 1/world segment of the
+                # full [n] payload
+                q = _quant_unit(seg_w, leg.block or blk)
+                row(leg, "dcn", 2.0 * q * nc * (nc - 1) / nc,
+                    2.0 * float(seg_w) * nc * (nc - 1) / nc * isz)
+            else:
+                q = _quant_unit(sn // nc, leg.block or blk) * nc
+                row(leg, "dcn", 2.0 * q * (nc - 1) / nc,
+                    2.0 * float(sn) * (nc - 1) / nc * isz)
+        else:  # pragma: no cover - validation rejects other shapes
+            row(leg, "-", 0.0)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# StepPlan: the resolved wire plans of one training step + the knob
+# record they were derived from. ``hvd.describe_plan(**knobs)`` builds it.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class StepPlan:
+    """Resolved plans of a training step's gradient wire.
+
+    ``gradient`` is the gradient collective's plan (an ``allreduce``
+    plan, or the ``reduce_scatter`` half under ZeRO); ``gather`` is the
+    update/parameter ``all_gather`` plan (None outside ZeRO — and under
+    stage 3 it runs at the HEAD of the next forward, not the update
+    tail). Thread a StepPlan into ``DistributedOptimizer(plan=...)`` /
+    ``hvd.value_and_grad(plan=...)`` to replace the boolean knobs (which
+    remain as aliases)."""
+
+    mesh_shape: Tuple[int, ...]
+    quantized: bool
+    quant_block: int
+    zero_stage: int
+    overlap: bool
+    hierarchical: bool
+    num_comm_streams: int
+    fusion_threshold_bytes: int
+    gradient: WirePlan
+    gather: Optional[WirePlan]
+
+    def encode(self) -> str:
+        parts = [self.gradient.encode()]
+        if self.gather is not None:
+            where = "fwd" if self.zero_stage == 3 else "tail"
+            parts.append(f"{where}@{self.gather.encode()}")
+        return " + ".join(parts)
+
+    @property
+    def plans(self) -> Tuple[WirePlan, ...]:
+        return ((self.gradient,) if self.gather is None
+                else (self.gradient, self.gather))
+
+    def table(self, payload_bytes: int = 4 * 1024 * 1024,
+              itemsize: int = 4) -> str:
+        """Render the step plan as a fixed-width text table (legs, hops,
+        wire dtypes, streams, predicted per-device wire bytes for a
+        ``payload_bytes`` gradient payload) — the ``--dump-plan`` /
+        golden-test format."""
+        n = payload_bytes // itemsize
+        mesh = "x".join(str(v) for v in self.mesh_shape)
+        lines = [
+            f"wire plan  mesh={mesh}  payload={payload_bytes}B "
+            f"(itemsize {itemsize})",
+            f"knobs: quantized={_onoff(self.quantized)} "
+            f"block={self.quant_block} zero_stage={self.zero_stage} "
+            f"overlap={_onoff(self.overlap)} "
+            f"hierarchical={_onoff(self.hierarchical)} "
+            f"streams={self.num_comm_streams} "
+            f"fusion_threshold={self.fusion_threshold_bytes}",
+            f"{'collective':<16} {'leg':>3} {'level':<5} "
+            f"{'primitive':<14} {'wire':<10} {'ef':<3} {'stream':>6} "
+            f"{'bytes/dev':>12}",
+        ]
+        tot = {"ici": 0.0, "dcn": 0.0, "fp": 0.0}
+        for plan in self.plans:
+            rows = predict_leg_bytes(plan, n, itemsize, self.mesh_shape)
+            for r in rows:
+                if r["hop"] in tot:
+                    tot[r["hop"]] += r["bytes"]
+                if r["hop"] == "dcn":
+                    tot["fp"] += r["fp_bytes"]
+            for li, leg in enumerate(plan.legs, start=1):
+                b = sum(r["bytes"] for r in rows if r["leg"] is leg)
+                wire = leg.wire_dtype
+                if leg.wire_dtype == INT8:
+                    wire = f"int8/{leg.block or self.quant_block}"
+                lines.append(
+                    f"{plan.collective:<16} {li:>3} {leg.level:<5} "
+                    f"{leg.primitive:<14} {wire:<10} "
+                    f"{'yes' if leg.error_feedback else '-':<3} "
+                    f"{leg.stream:>6} {int(round(b)):>12}")
+        red = (tot["fp"] / tot["dcn"]) if tot["dcn"] else None
+        lines.append(
+            f"totals: ici={int(round(tot['ici']))} "
+            f"dcn={int(round(tot['dcn']))} "
+            f"dcn_fp_equiv={int(round(tot['fp']))} "
+            f"dcn_reduction={red:.2f}x" if red is not None else
+            f"totals: ici={int(round(tot['ici']))} dcn=0")
+        lines.append(f"encoding: {self.encode()}")
+        return "\n".join(lines)
+
+
+def _onoff(v) -> str:
+    return "on" if v else "off"
+
+
+def describe_plan(
+    *,
+    quantized: Optional[bool] = None,
+    zero_stage: Optional[int] = None,
+    zero: Optional[bool] = None,
+    overlap: Optional[bool] = None,
+    hierarchical: Optional[bool] = None,
+    num_comm_streams: Optional[int] = None,
+    quant_block: Optional[int] = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    mesh_shape: Optional[Tuple[int, ...]] = None,
+    error_feedback: Optional[bool] = None,
+    tuned_params=None,
+) -> StepPlan:
+    """Resolve today's knob combination into its :class:`StepPlan` — the
+    debug view of what the gradient wire will compile to.
+
+    Unset knobs resolve exactly like ``DistributedOptimizer`` resolves
+    them (``tuned_params`` override first, then the init-time Config /
+    ``HOROVOD_*`` env). ``mesh_shape`` defaults to the live mesh
+    (``(cross, local[, pods])``), or ``(1, 1)`` before init."""
+    if tuned_params is not None:
+        if fusion_threshold_bytes is None:
+            fusion_threshold_bytes = tuned_params.fusion_threshold_bytes
+        if hierarchical is None:
+            hierarchical = tuned_params.hierarchical_allreduce
+        if zero_stage is None:
+            zero_stage = tuned_params.zero_stage
+        if overlap is None:
+            overlap = tuned_params.overlap
+        if num_comm_streams is None:
+            num_comm_streams = tuned_params.num_comm_streams
+        if quant_block is None:
+            quant_block = tuned_params.quant_block
+    cfg = basics.config() if basics.is_initialized() else None
+    if quantized is None:
+        quantized = (cfg.quantized_allreduce if cfg is not None
+                     else _env_bool("HOROVOD_QUANTIZED_ALLREDUCE", False))
+    if zero_stage is None and zero is not None:
+        zero_stage = 2 if zero else 0
+    if zero_stage is None:
+        from ..parallel.optimizer import _resolve_zero_stage_config
+
+        zero_stage = _resolve_zero_stage_config()
+    if zero_stage not in (0, 1, 2, 3):
+        raise PlanError(f"zero_stage must be 0..3, got {zero_stage!r}")
+    if overlap is None:
+        overlap = (cfg.overlap if cfg is not None
+                   else _env_bool("HOROVOD_OVERLAP", False))
+    if hierarchical is None:
+        hierarchical = (cfg.hierarchical_allreduce if cfg is not None
+                        else _env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                                       False))
+    if num_comm_streams is None:
+        num_comm_streams = (cfg.num_comm_streams if cfg is not None
+                            else _env_int("HOROVOD_NUM_COMM_STREAMS", 1))
+    if quant_block is None:
+        quant_block = (cfg.quant_block if cfg is not None
+                       else _env_int("HOROVOD_QUANT_BLOCK", 256))
+    if fusion_threshold_bytes is None:
+        fusion_threshold_bytes = (
+            cfg.fusion_threshold_bytes if cfg is not None
+            else _env_int("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024))
+    if mesh_shape is None:
+        if basics.is_initialized() and basics.mesh() is not None:
+            shp = basics.mesh().devices.shape
+            mesh_shape = (tuple(shp) if len(shp) == 2
+                          else (shp[1], shp[2], shp[0]))
+        else:
+            mesh_shape = (1, 1)
+    nl, nc, npod = _mesh_sizes(mesh_shape)
+    # The level ladder is structural, not size-gated: a 1-host mesh still
+    # derives the 2-level plan (its DCN legs lower to no-ops at size 1).
+    levels = [ICI, DCN] + ([POD] if npod > 1 else [])
+    ef = quantized if error_feedback is None else error_feedback
+    streams = max(1, int(num_comm_streams)) if overlap else 1
+    overlap = bool(overlap)
+
+    if zero_stage > 0:
+        gradient = derive_reduce_scatter(
+            levels=levels, quantized=quantized, error_feedback=ef,
+            block=quant_block if quantized else None, streams=streams,
+            overlap=overlap)
+        gather = derive_all_gather(
+            levels=levels, quantized=quantized, error_feedback=ef,
+            block=quant_block if quantized else None, streams=streams,
+            overlap=overlap)
+    else:
+        gradient = derive_allreduce(
+            levels=levels, quantized=quantized,
+            hierarchical=hierarchical,
+            block=quant_block if quantized else None,
+            error_feedback=ef, streams=streams, overlap=overlap)
+        gather = None
+    return StepPlan(
+        mesh_shape=tuple(int(v) for v in mesh_shape),
+        quantized=bool(quantized),
+        quant_block=int(quant_block),
+        zero_stage=int(zero_stage),
+        overlap=overlap,
+        hierarchical=bool(hierarchical),
+        num_comm_streams=int(num_comm_streams),
+        fusion_threshold_bytes=int(fusion_threshold_bytes),
+        gradient=gradient,
+        gather=gather,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Autotune plan encoding: the compact search-space string the GP proposes
+# over (cache schema v5, docs/autotune.md). Round-trips through
+# decode_tuned; tolerant of absence in pre-v5 logs/caches.
+# ---------------------------------------------------------------------------
+
+_PLAN_RE = re.compile(
+    r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
+    r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)$")
+
+
+def encode_tuned(params, *, quantized: bool = False) -> str:
+    """Compact plan encoding of a ``TunedParams``-like knob set: gradient
+    leg order | DCN hop wire dtype | stream count | placement. E.g.
+    ``ar.tree|int8/256|s2|ovl`` or ``rs+ag.z2|fp|s1|sync``. Knob sets
+    that compile to the same wire encode identically (``hierarchical``
+    is dead under ZeRO's rs+ag split and drops out)."""
+    stage = int(getattr(params, "zero_stage", 0) or 0)
+    if stage > 0:
+        grad = f"rs+ag.z{stage}"
+    elif getattr(params, "hierarchical_allreduce", False):
+        grad = "ar.tree"
+    else:
+        grad = "ar.flat"
+    wire = (f"int8/{int(getattr(params, 'quant_block', 256))}"
+            if quantized else "fp")
+    streams = int(getattr(params, "num_comm_streams", 1) or 1)
+    sched = "ovl" if getattr(params, "overlap", False) else "sync"
+    if sched == "sync":
+        streams = 1  # dead knob with overlap off: same wire, one trial
+    return f"{grad}|{wire}|s{streams}|{sched}"
+
+
+def decode_tuned(encoding: str) -> dict:
+    """Parse a plan encoding back to the knob dict it derives from.
+    Raises :class:`PlanError` on malformed input (tolerant readers catch
+    it and fall back to the explicit knob columns)."""
+    m = _PLAN_RE.match(encoding.strip())
+    if not m:
+        raise PlanError(
+            f"unparseable plan encoding {encoding!r} — expected "
+            f"'<ar.flat|ar.tree|rs+ag.zN>|<fp|int8/B>|sK|<sync|ovl>'")
+    grad = m.group("grad")
+    out = {
+        "zero_stage": int(grad[-1]) if grad.startswith("rs+ag") else 0,
+        "hierarchical_allreduce": grad == "ar.tree",
+        "quantized": m.group("wire") != "fp",
+        "overlap": m.group("sched") == "ovl",
+        "num_comm_streams": int(m.group("streams")),
+    }
+    if out["quantized"]:
+        out["quant_block"] = int(m.group("wire").split("/", 1)[1])
+    return out

@@ -17,6 +17,8 @@
 #   scripts/perf_gate.sh             # gate the serve leg (default)
 #   PERF_GATE_LEGS="serve train" scripts/perf_gate.sh
 #   PERF_GATE_LEGS="zero1 zero2 zero3" scripts/perf_gate.sh
+#   PERF_GATE_LEGS="plan" scripts/perf_gate.sh  # wire-plan equivalence
+#                     matrix + quantized+zero3+overlap combined leg
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -69,8 +71,26 @@ for leg in $LEGS; do
                 --image-size 64 --num-warmup 1 --num-iters 3 \
                 --num-batches-per-iter 2
             ;;
+        plan)
+            # Wire-plan gate (docs/wire-plan.md): (1) the plan-equivalence
+            # matrix — the compiler must stay bit-identical to the
+            # pre-refactor paths for every knob combination — then (2) a
+            # combined quantized + ZeRO-3 + overlap plan-compiled bench
+            # step, throughput gated against the recorded trajectory.
+            echo "== perf gate: plan leg (equivalence matrix) ==" >&2
+            if ! JAX_PLATFORMS=cpu python -m pytest -q tests/test_plan.py \
+                -k "TestWireEquivalence or TestOptimizerMatrix or TestThreeLevel"
+            then
+                echo "perf gate [plan]: equivalence matrix FAILED" >&2
+                FAIL=1
+            fi
+            run_leg plan --zero-stage 3 --quantized --overlap \
+                --mesh-shape 2x4 --platform cpu --cpu-devices 8 \
+                --model resnet18 --batch-size 2 --image-size 64 \
+                --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3})" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan)" >&2
             exit 2
             ;;
     esac

@@ -205,6 +205,138 @@ class TestTunedParams:
         assert p.hierarchical_allreduce is True
 
 
+class TestPlanSchemaV5:
+    """The v5 plan-encoded schema (docs/wire-plan.md): the GP searches
+    the compact plan encoding, the CSV/cache carry it, and readers stay
+    tolerant of v3/v4 artifacts without it."""
+
+    def test_csv_v5_plan_column_round_trips(self, tmp_path):
+        from horovod_tpu.plan import decode_tuned, encode_tuned
+
+        path = str(tmp_path / "v5.csv")
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=6, log_path=path,
+                              tune_overlap=True, tune_zero=True, seed=11)
+        _run_manager(pm, lambda p: 1.0 + p.num_comm_streams)
+        with open(path) as f:
+            header = f.readline().strip().split(",")
+        assert header == list(pm_mod.CSV_FIELDS)
+        assert header[-1] == "plan"
+        rows = read_log(path)
+        for row, (p, _) in zip(rows, pm.history):
+            assert row["plan"] == encode_tuned(p)
+            # The encoding decodes back to the very knobs in the row.
+            d = decode_tuned(row["plan"])
+            assert d["zero_stage"] == row["zero_stage"]
+            assert d["overlap"] == row["overlap"]
+            assert d["num_comm_streams"] == row["num_comm_streams"]
+            assert d["hierarchical_allreduce"] == \
+                row["hierarchical_allreduce"]
+
+    def test_read_log_tolerant_of_v4_log_without_plan_column(
+            self, tmp_path):
+        path = tmp_path / "v4.csv"
+        path.write_text(
+            "sample,fusion_threshold_bytes,quant_block,"
+            "hierarchical_allreduce,zero_sharding,zero_stage,overlap,"
+            "num_comm_streams,score_steps_per_sec\n"
+            "1,67108864,256,0,0,0,1,2,10.5\n"
+            "2,8388608,256,1,0,0,0,1,11.0\n")
+        rows = read_log(str(path))
+        # The canonical encoding is re-derived from the knob columns.
+        assert rows[0]["plan"] == "ar.flat|fp|s2|ovl"
+        assert rows[1]["plan"] == "ar.tree|fp|s1|sync"
+
+    def test_read_log_tolerant_of_v3_log(self, tmp_path):
+        # Pre-v4: no zero_stage/overlap/streams; boolean zero_sharding
+        # named stage 2.
+        path = tmp_path / "v3.csv"
+        path.write_text(
+            "sample,fusion_threshold_bytes,quant_block,"
+            "hierarchical_allreduce,zero_sharding,score_steps_per_sec\n"
+            "1,67108864,256,0,1,9.0\n")
+        rows = read_log(str(path))
+        assert rows[0]["zero_stage"] == 2
+        assert rows[0]["plan"] == "rs+ag.z2|fp|s1|sync"
+
+    def test_cache_entry_carries_plan_and_v5_key(self, tmp_path,
+                                                 monkeypatch):
+        from horovod_tpu.autotune import driver as at_driver
+        from horovod_tpu.ops import kernel_autotune
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        TestSession._reset_kernel_cache()
+        key = cache_key_for("v5-schema-probe")
+        assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
+        assert key.endswith("|v5")
+        winner = TunedParams(fusion_threshold_bytes=8 * MIB,
+                             zero_stage=2, overlap=True,
+                             num_comm_streams=2)
+        at_driver._store_cached_params(key, winner, score=12.0,
+                                       samples=6, quantized=True)
+        entry = kernel_autotune.cache_lookup(key)
+        assert entry["plan"] == "rs+ag.z2|int8/256|s2|ovl"
+        assert load_cached_params(key) == winner
+
+    def test_load_tolerant_of_v4_entry_without_plan(self, tmp_path,
+                                                    monkeypatch):
+        from horovod_tpu.ops import kernel_autotune
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        TestSession._reset_kernel_cache()
+        # A v4-era entry: params lack overlap/num_comm_streams, no
+        # `plan` field — from_dict defaults apply, nothing crashes.
+        kernel_autotune.cache_store("legacy|v4", {
+            "params": {"fusion_threshold_bytes": 4 * MIB,
+                       "quant_block": 128,
+                       "hierarchical_allreduce": True,
+                       "zero_sharding": True},
+            "score_steps_per_sec": 3.0, "samples": 5})
+        p = load_cached_params("legacy|v4")
+        assert p == TunedParams(fusion_threshold_bytes=4 * MIB,
+                                quant_block=128,
+                                hierarchical_allreduce=True,
+                                zero_stage=2)
+
+    def test_proposals_canonicalized_onto_plan(self):
+        """Dead knobs snap to the plan's canonical value: streams pin
+        to 1 with overlap off, hierarchical drops out under ZeRO's
+        rs+ag split — equal plans dedup as ONE trial."""
+        from horovod_tpu.plan import encode_tuned
+
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=10, tune_zero=True,
+                              tune_overlap=True, seed=5)
+        _run_manager(pm, lambda p: 1.0)
+        seen = set()
+        for p, _ in pm.history:
+            # Dedup key = snapped fusion threshold + the plan encoding:
+            # no two trials may share it (equal wire = one recompile).
+            key = pm._unit_key(p)
+            assert key not in seen, \
+                f"duplicate plan trial {encode_tuned(p)}"
+            seen.add(key)
+            if not p.overlap:
+                assert p.num_comm_streams == 1
+            if p.zero_stage > 0:
+                assert p.hierarchical_allreduce is False
+
+    def test_canonicalize_collapses_dead_knob_pairs(self):
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=1)
+        a = pm._canonicalize(TunedParams(overlap=False,
+                                         num_comm_streams=4))
+        b = pm._canonicalize(TunedParams(overlap=False,
+                                         num_comm_streams=1))
+        assert a == b
+        z = pm._canonicalize(TunedParams(zero_stage=2,
+                                         hierarchical_allreduce=True))
+        assert z.hierarchical_allreduce is False
+        assert pm._unit_key(a) == pm._unit_key(b)
+
+
 def _toy_make_step(tuned, sleep_by_threshold=None):
     """A compiled toy step honoring the TunedParams override: fused
     allreduce of a small gradient tree through the real bucket planner

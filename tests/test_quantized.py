@@ -8,8 +8,9 @@ The numerics tiers mirror how the feature is layered:
 * a 4-rank EF-SGD simulation built from the same primitives — the
   toy-model convergence criterion (quantized-with-EF loss within 1% of
   full precision);
-* the real compiled collective (``collective_ops._psum_quantized``) under
-  ``jax.shard_map`` on a (2, 4) mesh, where the cross axis is the
+* the real compiled collective (the quantized allreduce plan lowered by
+  ``plan/compiler.py lower_quantized_allreduce``, docs/wire-plan.md)
+  under ``jax.shard_map`` on a (2, 4) mesh, where the cross axis is the
   DCN-analogue hop that actually carries int8;
 * the eager multi-process path in ``test_native_core``-style worker
   processes (``quantized_worker.py``).
