@@ -100,6 +100,9 @@ def dump_plan(args, mesh_shape):
         zero_stage=(args.zero_stage if args.zero_stage
                     else (2 if args.zero else None)),
         overlap=args.overlap or None,
+        fused=args.fused or None,
+        quantized_pod=args.quantized_pod or None,
+        hierarchical=args.quantized_pod or None,
         mesh_shape=mesh_shape,
     )
     print(step_plan.table(payload_bytes=args.dump_plan_bytes))
@@ -802,6 +805,7 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         "wire_bytes_ici": wire.ici_bytes,
         "wire_bytes_dcn": wire.dcn_bytes,
         "wire_bytes_dcn_fp": wire.dcn_bytes_fp,
+        "wire_bytes_pod": wire.pod_bytes,
         "wire_reduction_dcn": wire.dcn_reduction,
         "wire_bytes_overlap": wire.overlap_bytes,
         "comm_hidden_fraction": wire.hidden_fraction,
@@ -898,6 +902,263 @@ def run_stage_parity_probe(devices, mesh_shape, steps=3):
         f"steps; stage3 max rel err {max_rel3:.2e} (<=1e-5)")
     return {"steps": steps, "stage12_bit_identical": True,
             "stage3_max_rel_err": max_rel3}
+
+
+def run_fused(args, devices, platform, mesh_shape):
+    """The ``--fused`` leg: fused compute-collective Pallas kernels A/B
+    (docs/fused-kernels.md).
+
+    A synthetic fusion-pair workload — an L-layer linear chain whose
+    weights live in the ZeRO-3 rank-major shard layout
+    (``--zero-stage 3``, the default here) — runs twice with identical
+    math:
+
+    * **unfused**: plan-compiled wire (``hvd.all_gather`` each layer's
+      weight, matmul, then ``hvd.reduce_scatter`` the full weight-grad
+      product; ``--quantized`` puts int8 on the grad wire's DCN leg,
+      ``--overlap`` issues through the stream entry points);
+    * **fused**: the same pairs through
+      :func:`hvd.fused_all_gather_matmul` (ring-gathered shards feed
+      the matmul prologue) and :func:`hvd.fused_matmul_reduce_scatter`
+      (each output tile accumulates into the traveling partial sum) —
+      or, on the quantized grad wire, the plan-compiled legs with the
+      Pallas quantize/dequant kernels (``fused=True``).
+
+    Reports measured steps/sec for both legs plus the MODELED step-time
+    saving from the avoided HBM round-trip (trace-time
+    ``fused_hbm_saved_bytes`` at ``HOROVOD_BENCH_HBM_GBPS``, default
+    819 GB/s — v5e spec) — on the emulated CPU mesh the interpreter-mode
+    kernels measure nothing real, so the HBM-traffic reduction is the
+    asserted contract there; on a TPU the measured delta is the
+    headline. A parity probe (fused vs unfused, one step, identical
+    inputs) hard-fails on divergence beyond float/ulp tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.collective_ops import record_wire_stats
+
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=mesh_shape)
+    n = hvd.size()
+    mesh = hvd.mesh()
+    stage = args.zero_stage or 3
+    zero3 = stage == 3
+    quantized = bool(args.quantized)
+    overlap = bool(args.overlap)
+    D = int(os.environ.get("HOROVOD_BENCH_FUSED_DIM", "256"))
+    L = int(os.environ.get("HOROVOD_BENCH_FUSED_LAYERS", "4"))
+    B = args.batch_size * n
+    log(f"fused A/B: world={n} layers={L} dim={D} global_batch={B} "
+        f"zero_stage={stage} quantized={quantized} overlap={overlap}")
+
+    rng = np.random.RandomState(0)
+    ws_full = np.stack([rng.randn(D, D).astype(np.float32) / np.sqrt(D)
+                        for _ in range(L)])                  # [L, D, D]
+    x = rng.randn(B, D).astype(np.float32)
+    y = rng.randn(B, D).astype(np.float32)
+    if zero3:
+        # rank-major row shards, stacked [n, L, D/n, D] for P(HVD_AXES)
+        w_arg = np.stack([ws_full[:, r * (D // n):(r + 1) * (D // n), :]
+                          for r in range(n)])
+        w_spec = P(hvd.HVD_AXES)
+    else:
+        w_arg = ws_full
+        w_spec = P()
+
+    def make_step(fused):
+        def spmd(wsh, xb, yb):
+            w = wsh[0] if zero3 else wsh                      # [L, ...]
+            h = xb
+            acts = []
+            for li in range(L):
+                acts.append(h)
+                if zero3:
+                    if fused:
+                        h = hvd.fused_all_gather_matmul(h, w[li])
+                    else:
+                        wfull = hvd.all_gather(
+                            w[li].reshape(-1)).reshape(D, D)
+                        h = h @ wfull
+                else:
+                    h = h @ w[li]
+            # Per-rank local cotangent; each layer's weight grad is the
+            # canonical matmul → reduce-scatter pair (the activations
+            # differ per layer, the cotangent is shared — a synthetic
+            # but fixed compute pattern, identical across both legs).
+            dh = (h - yb) * (2.0 / float(B * D))
+            gs = []
+            for li in reversed(range(L)):
+                a = acts[li]
+                if quantized:
+                    # int8 grad wire: the quantize/dequant rides the
+                    # plan-compiled DCN leg — Pallas-backed when fused.
+                    flat = (a.T @ dh).reshape(-1)
+                    if overlap:
+                        g = hvd.reduce_scatter_stream(
+                            flat, bucket_id=li, op=hvd.Sum,
+                            quantized=True, fused=fused)
+                    else:
+                        g = hvd.reduce_scatter(flat, op=hvd.Sum,
+                                               quantized=True,
+                                               fused=fused)
+                    g = g.reshape(D // n, D)
+                elif fused:
+                    g = hvd.fused_matmul_reduce_scatter(a.T, dh)
+                elif overlap:
+                    g = hvd.reduce_scatter_stream(
+                        (a.T @ dh).reshape(-1), bucket_id=li,
+                        op=hvd.Sum).reshape(D // n, D)
+                else:
+                    g = hvd.reduce_scatter(
+                        (a.T @ dh).reshape(-1),
+                        op=hvd.Sum).reshape(D // n, D)
+                gs.append(g)
+            gstack = jnp.stack(gs[::-1])                     # [L, D/n, D]
+            loss = hvd.allreduce(jnp.mean((h - yb) ** 2))
+            if zero3:
+                new_w = wsh - 0.01 * gstack[None]
+            else:
+                # replicated weights: gather the shard grads back (the
+                # stage-1/2 update tail) and apply
+                gfull = jnp.stack([
+                    hvd.all_gather(gstack[li].reshape(-1)).reshape(D, D)
+                    for li in range(L)])
+                new_w = wsh - 0.01 * gfull
+            return new_w, gstack[None], loss
+
+        return jax.jit(hvd.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(w_spec, hvd.data_pspec(), hvd.data_pspec()),
+            out_specs=(w_spec, P(hvd.HVD_AXES), P())))
+
+    data_sh = hvd.data_sharding()
+    xb = jax.device_put(jnp.asarray(x), data_sh)
+    yb = jax.device_put(jnp.asarray(y), data_sh)
+    w0 = jax.device_put(jnp.asarray(w_arg),
+                        NamedSharding(mesh, w_spec))
+
+    legs = {}
+    for name, fused in (("unfused", False), ("fused", True)):
+        log(f"=== A/B leg: {name} ===")
+        step = make_step(fused)
+        with record_wire_stats() as wire:
+            lowered = step.lower(w0, xb, yb)
+        compiled = lowered.compile()
+        wcur, g1, loss = compiled(w0, xb, yb)
+        jax.block_until_ready((wcur, g1, loss))
+        times = []
+        for _ in range(args.num_iters):
+            t0 = time.perf_counter()
+            for _ in range(args.num_batches_per_iter):
+                wcur, gl, loss = compiled(wcur, xb, yb)
+            jax.block_until_ready((wcur, gl, loss))
+            times.append((time.perf_counter() - t0)
+                         / args.num_batches_per_iter)
+        legs[name] = {
+            "step_ms_median": float(np.median(times)) * 1e3,
+            "wire": wire,
+            "grad": np.asarray(g1),
+            "loss": float(loss),
+        }
+        log(f"{name}: step {legs[name]['step_ms_median']:.3f} ms, "
+            f"wire ici {wire.ici_bytes / 1e3:.1f} kB dcn "
+            f"{wire.dcn_bytes / 1e3:.1f} kB, fused kernel calls "
+            f"{wire.fused_calls}, hbm saved "
+            f"{wire.fused_hbm_saved_bytes / 1e3:.1f} kB")
+
+    # Parity: identical inputs, one step — fused vs unfused gradients.
+    ga, gb = legs["unfused"]["grad"], legs["fused"]["grad"]
+    denom = max(1e-12, float(np.abs(ga).max()))
+    max_rel = float(np.abs(ga - gb).max()) / denom
+    # Unquantized: pure float-association noise of the ring accumulate.
+    # Quantized: the fused forward's float-assoc noise can flip a value
+    # across an int8 rounding boundary — one whole quantization step,
+    # scale = block absmax / 127 — so the bound is a couple of quanta
+    # (~2/127), not float ulps.
+    tol = 2e-2 if quantized else 1e-4
+    parity_ok = max_rel <= tol
+    log(f"parity probe: max rel diff {max_rel:.2e} (tol {tol}) "
+        f"{'OK' if parity_ok else 'FAILED'}")
+    if not parity_ok:
+        raise SystemExit(
+            f"--fused parity FAILED: fused grads diverge from unfused "
+            f"by {max_rel:.2e} > {tol}")
+
+    hbm_saved = legs["fused"]["wire"].fused_hbm_saved_bytes
+    if hbm_saved <= 0:
+        raise SystemExit(
+            "--fused: fused leg recorded zero saved HBM bytes — the "
+            "kernels never engaged (check HOROVOD_FUSED_KERNELS "
+            "routing)")
+    hbm_gbps = float(os.environ.get("HOROVOD_BENCH_HBM_GBPS", "819"))
+    modeled_saving_ms = hbm_saved / (hbm_gbps * 1e9) * 1e3
+    unf_ms = legs["unfused"]["step_ms_median"]
+    fus_ms = legs["fused"]["step_ms_median"]
+    measured_delta = unf_ms / fus_ms - 1.0
+    modeled_fused_ms = max(1e-6, unf_ms - modeled_saving_ms)
+    log(f"A/B: unfused {unf_ms:.3f} ms vs fused {fus_ms:.3f} ms "
+        f"measured ({100 * measured_delta:+.1f}%); modeled HBM "
+        f"round-trip saved {hbm_saved / 1e3:.1f} kB/step/dev = "
+        f"{modeled_saving_ms:.4f} ms at {hbm_gbps:.0f} GB/s"
+        + ("" if platform == "tpu" else
+           " [CPU interpret mode: the modeled saving is the contract; "
+           "measured kernel time is interpreter overhead]"))
+
+    from horovod_tpu import plan as hvd_plan
+
+    if quantized:
+        # Kernel-backed int8 legs on the plan-compiled wire.
+        plan_enc = hvd_plan.describe_plan(
+            quantized=True, zero_stage=stage,
+            overlap=overlap or None, fused=True).encode()
+    else:
+        # The matmul⇄collective ring pair (docs/fused-kernels.md).
+        parts = [hvd_plan.fused_matmul_rs_plan(overlap=overlap).encode()]
+        if zero3:
+            parts.append(
+                "fwd@" + hvd_plan.fused_ag_matmul_plan(
+                    overlap=overlap).encode())
+        plan_enc = " + ".join(parts)
+    print(json.dumps({
+        "metric": "fused_matmul_collective_step_ms",
+        "value": round(fus_ms, 4),
+        "unit": "ms/step (lower is better)",
+        "vs_baseline": None,
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "chips": n,
+        "fused": True,
+        "zero_stage": stage,
+        "quantized": quantized,
+        "overlap": overlap,
+        "layers": L,
+        "dim": D,
+        "plan": plan_enc,
+        "mesh_shape": (mesh_shape_str(mesh_shape)
+                       if mesh_shape else None),
+        "unfused_step_ms": round(unf_ms, 4),
+        "throughput_delta_measured": round(measured_delta, 4),
+        "hbm_saved_bytes_per_step": round(hbm_saved, 1),
+        "fused_kernel_calls": legs["fused"]["wire"].fused_calls,
+        "modeled": {
+            "hbm_gbps": hbm_gbps,
+            "saving_ms": round(modeled_saving_ms, 6),
+            "fused_step_ms": round(modeled_fused_ms, 4),
+            "improvement_frac": round(
+                modeled_saving_ms / max(1e-9, unf_ms), 6),
+        },
+        "parity": {"max_rel_err": max_rel, "tol": tol, "ok": parity_ok},
+        "wire_bytes_ici": round(legs["fused"]["wire"].ici_bytes, 1),
+        "wire_bytes_dcn": round(legs["fused"]["wire"].dcn_bytes, 1),
+        "wire_bytes_ici_unfused": round(
+            legs["unfused"]["wire"].ici_bytes, 1),
+        "wire_bytes_dcn_unfused": round(
+            legs["unfused"]["wire"].dcn_bytes, 1),
+        "metrics_snapshot": metrics_snapshot(),
+    }), flush=True)
 
 
 def run_serve(args, devices, platform, mesh_shape):
@@ -1241,6 +1502,18 @@ def main():
                          "(docs/checkpoint.md), and a stage-parity "
                          "probe (1/2/3 side-by-side in one program, "
                          "bit-identical)")
+    ap.add_argument("--fused", action="store_true",
+                    help="A/B the fused compute-collective Pallas "
+                         "kernels (docs/fused-kernels.md) against the "
+                         "plan-compiled unfused wire on the synthetic "
+                         "fusion-pair workload; composes with "
+                         "--zero-stage (default 3 here), --quantized "
+                         "(Pallas int8 legs) and --overlap")
+    ap.add_argument("--quantized-pod", action="store_true",
+                    help="--dump-plan only: show the 3-level tree plan "
+                         "with the pod hop as the blockwise-int8 rs+ag "
+                         "pair (implies hierarchical; "
+                         "HOROVOD_QUANTIZED_POD at runtime)")
     ap.add_argument("--overlap", action="store_true",
                     help="A/B the overlapped gradient reduction "
                          "(HOROVOD_OVERLAP: reverse-layer bucket "
@@ -1378,6 +1651,12 @@ def main():
             ap.error("--scaling cannot combine with --quantized/"
                      "--mesh-shape/--autotune/--zero/--zero-stage/"
                      "--overlap (the sweep re-shapes the world per size)")
+    if args.fused and (args.scaling or args.autotune or args.serve
+                       or args.zero or args.profile):
+        ap.error("--fused cannot combine with --scaling/--autotune/"
+                 "--serve/--zero/--profile (it is its own A/B "
+                 "structure; --zero-stage N, --quantized and --overlap "
+                 "compose as knobs of the fused workload)")
     if args.autotune and (args.quantized or args.profile or args.zero
                           or args.overlap or args.zero_stage):
         ap.error("--autotune cannot combine with --quantized/--profile/"
@@ -1446,7 +1725,7 @@ def main():
         raise SystemExit(f"--mesh-shape {mesh_shape_str(mesh_shape)} "
                          f"does not cover {len(devices)} devices")
     if (args.quantized or args.autotune or args.zero or args.overlap
-            or args.serve or args.zero_stage) \
+            or args.serve or args.zero_stage or args.fused) \
             and mesh_shape is None \
             and len(devices) % 2 == 0 and len(devices) >= 2:
         # A DCN (cross) hop is what quantization compresses, what the
@@ -1459,12 +1738,17 @@ def main():
         which = ("quantized" if args.quantized else "zero" if args.zero
                  else "zero-stage" if args.zero_stage
                  else "overlap" if args.overlap
-                 else "serve" if args.serve else "autotune")
+                 else "serve" if args.serve
+                 else "fused" if args.fused else "autotune")
         log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
             f"collectives have a cross (DCN) hop")
 
     if args.serve:
         run_serve(args, devices, platform, mesh_shape)
+        return
+
+    if args.fused:
+        run_fused(args, devices, platform, mesh_shape)
         return
 
     metric_stem = (f"gpt{args.gpt_scale}" if args.model == "gpt"
@@ -1594,10 +1878,16 @@ def main():
         # top of that compute. Bandwidths are modeled (env-overridable) —
         # on an emulated CPU mesh they are nominal, on a pod they are the
         # chip spec.
-        ici_gbps = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
-        dcn_gbps = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+        # Per-level link model (HOROVOD_BENCH_{ICI,DCN,POD}_GBPS): the
+        # pod knob defaults to the DCN value, so 2-level meshes price
+        # exactly as before; a 3-level mesh can model its slower
+        # cross-pod links separately (docs/wire-plan.md).
+        from horovod_tpu.plan.accounting import bench_gbps
+
+        ici_gbps, dcn_gbps, pod_gbps = bench_gbps()
         wire_ms = (res_b["wire_bytes_ici"] / (ici_gbps * 1e9)
-                   + res_b["wire_bytes_dcn"] / (dcn_gbps * 1e9)) * 1e3
+                   + res_b["wire_bytes_dcn"] / (dcn_gbps * 1e9)
+                   + res_b["wire_bytes_pod"] / (pod_gbps * 1e9)) * 1e3
         compute_ms = max(0.0, res_b["step_ms_median"] - wire_ms)
         exposed_ms = max(0.0, res_o["step_ms_median"] - compute_ms)
         log(f"A/B: sync {res_b['per_chip']:.1f} vs overlap "

@@ -38,7 +38,11 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     leg order | per-hop dtype | stream placement) stored alongside the
 #     knobs — the GP now searches plan space (docs/wire-plan.md);
 #     from_dict/load stay tolerant of v3/v4 entries.
-_CACHE_VERSION = 5
+# v6: + the fused Pallas kernel backend knob (docs/fused-kernels.md) —
+#     the plan encoding gains the trailing `|pl` segment and TunedParams
+#     the `fused` field; from_dict/load stay tolerant of v5 entries
+#     (fused defaults False, the exact pre-v6 wire).
+_CACHE_VERSION = 6
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -144,6 +148,7 @@ def autotune_session(
     tune_hierarchical: bool = True,
     tune_zero: bool = False,
     tune_overlap: bool = False,
+    tune_fused: bool = False,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -179,7 +184,11 @@ def autotune_session(
     would silently score a config it never ran. ``tune_overlap`` gates
     the ``overlap`` + ``num_comm_streams`` pair the same way (overlap ×
     ``backward_passes_per_step`` restructures the accumulation state,
-    docs/overlap.md).
+    docs/overlap.md). ``tune_fused`` adds the fused Pallas kernel
+    backend (docs/fused-kernels.md) to the search — only meaningful on
+    a quantized wire, where the int8 legs have a kernel lowering; on an
+    unquantized wire canonicalization collapses the dimension to one
+    trial.
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -234,6 +243,7 @@ def autotune_session(
         tune_hierarchical=tune_hierarchical,
         tune_zero=tune_zero,
         tune_overlap=tune_overlap,
+        tune_fused=tune_fused,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,

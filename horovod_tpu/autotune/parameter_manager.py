@@ -58,17 +58,20 @@ _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
 # settings that compile to the SAME wire plan (e.g. hierarchical under
 # ZeRO, or a stream count with overlap off) collapse to one trial
 # instead of costing two recompiles.
-_DIMS = 6  # fusion, quant_block, leg order (tree), leg order (zero), overlap, streams
+# v6 adds the fused-kernel backend dimension (docs/fused-kernels.md):
+# dead on an unquantized wire, where canonicalization collapses it.
+_DIMS = 7  # fusion, quant_block, tree, zero, overlap, streams, fused
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
 # zero_sharding (= zero_stage > 0) stays a column for log compatibility;
 # zero_stage carries the actual level. v5 appends the canonical `plan`
-# encoding column; read_log stays tolerant of v3/v4 logs without it.
+# encoding column; v6 the `fused` kernel-backend knob. read_log stays
+# tolerant of v3/v4/v5 logs lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
-              "overlap", "num_comm_streams", "score_steps_per_sec",
-              "plan")
+              "overlap", "num_comm_streams", "fused",
+              "score_steps_per_sec", "plan")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +87,7 @@ class TunedParams:
     zero_stage: int = 0
     overlap: bool = False
     num_comm_streams: int = 1
+    fused: bool = False
 
     @property
     def zero_sharding(self) -> bool:
@@ -100,6 +104,7 @@ class TunedParams:
             "zero_stage": int(self.zero_stage),
             "overlap": bool(self.overlap),
             "num_comm_streams": int(self.num_comm_streams),
+            "fused": bool(self.fused),
         }
 
     @classmethod
@@ -118,6 +123,7 @@ class TunedParams:
             zero_stage=int(stage),
             overlap=bool(d.get("overlap", False)),
             num_comm_streams=int(d.get("num_comm_streams", 1)),
+            fused=bool(d.get("fused", False)),
         )
 
     @classmethod
@@ -135,6 +141,7 @@ class TunedParams:
             zero_stage=stage,
             overlap=getattr(config, "overlap", False),
             num_comm_streams=getattr(config, "num_comm_streams", 1),
+            fused=getattr(config, "fused_kernels", False),
         )
 
 
@@ -181,6 +188,7 @@ class ParameterManager:
         tune_hierarchical: bool = True,
         tune_zero: bool = False,
         tune_overlap: bool = False,
+        tune_fused: bool = False,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -206,6 +214,10 @@ class ParameterManager:
         # num_comm_streams rides the same gate — it only means anything
         # with overlap on.
         self.tune_overlap = tune_overlap
+        # The fused-kernel backend only changes the wire when an int8 leg
+        # exists (quantized); with quantized off, encode_tuned drops the
+        # dimension and canonicalization dedups the trials away.
+        self.tune_fused = tune_fused
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -242,6 +254,7 @@ class ParameterManager:
             (min(p.zero_stage, 2) + 0.5) / 3.0,
             0.75 if p.overlap else 0.25,
             s / _MAX_STREAMS_LOG,
+            0.75 if p.fused else 0.25,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -269,6 +282,7 @@ class ParameterManager:
         else:
             ov = self.initial.overlap
             ns = self.initial.num_comm_streams
+        fz = (u[6] >= 0.5 if self.tune_fused else self.initial.fused)
         return self._canonicalize(TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
@@ -276,6 +290,7 @@ class ParameterManager:
             zero_stage=stage,
             overlap=ov,
             num_comm_streams=ns,
+            fused=fz,
         ))
 
     def _plan_of(self, p: TunedParams) -> str:
@@ -296,6 +311,7 @@ class ParameterManager:
             zero_stage=d["zero_stage"],
             overlap=d["overlap"],
             num_comm_streams=d["num_comm_streams"],
+            fused=d.get("fused", False),
             quant_block=d.get("quant_block", p.quant_block))
 
     def _unit_key(self, p: TunedParams) -> tuple:
@@ -348,6 +364,7 @@ class ParameterManager:
                             int(p.zero_stage),
                             int(p.overlap),
                             int(p.num_comm_streams),
+                            int(p.fused),
                             f"{score:.6g}",
                             self._plan_of(p)])
         self._log.flush()
@@ -359,11 +376,12 @@ class ParameterManager:
         log.info(
             "autotune converged after %d samples: fusion_threshold=%d "
             "quant_block=%d hierarchical=%s zero_stage=%d overlap=%s "
-            "streams=%d (best %.3f steps/sec)",
+            "streams=%d fused=%s (best %.3f steps/sec)",
             len(self.history), self.best.fusion_threshold_bytes,
             self.best.quant_block, self.best.hierarchical_allreduce,
             self.best.zero_stage, self.best.overlap,
-            self.best.num_comm_streams, self.best_score)
+            self.best.num_comm_streams, self.best.fused,
+            self.best_score)
 
     def _sample_unit(self) -> Tuple[float, ...]:
         u = [self._rng.next() for _ in range(_DIMS)]
@@ -374,6 +392,8 @@ class ParameterManager:
         if not self.tune_overlap:
             u[4] = 0.25
             u[5] = 0.0
+        if not self.tune_fused:
+            u[6] = 0.25
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -442,6 +462,7 @@ def read_log(path: str) -> List[dict]:
                 "overlap": bool(int(rec.get("overlap", 0) or 0)),
                 "num_comm_streams": int(rec.get("num_comm_streams", 1)
                                         or 1),
+                "fused": bool(int(rec.get("fused", 0) or 0)),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             }
             enc = (rec.get("plan") or "").strip()

@@ -6,6 +6,11 @@ goes negative — plus the per-activity duration accounting that
 ``scripts/obs_report.py`` turns into the phase-time breakdown. Replaces
 the hand-rolled balance loops that used to live in ``tests/test_overlap``
 and ``tests/test_serve``.
+
+Span vocabularies audited today (docs/observability.md has the full
+event table): ``OVERLAP:*`` (streamed bucket collectives),
+``FUSED:*`` (fused Pallas kernel calls, docs/fused-kernels.md),
+``SERVE:PREFILL/DECODE``, ``PROFILE:*``, ``CKPT:*``.
 """
 
 from __future__ import annotations

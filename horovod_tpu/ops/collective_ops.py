@@ -256,6 +256,7 @@ def reduce_scatter(
     axes=None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
     _presummed: bool = False,
 ):
@@ -338,7 +339,8 @@ def reduce_scatter(
     eff_plan = _resolve_plan(
         plan, lambda: _planner.derive_reduce_scatter(
             levels=_planner.levels_of(axes_t), quantized=quantized,
-            error_feedback=residual is not None, block=block))
+            error_feedback=residual is not None, block=block,
+            fused=fused))
     shard, new_res = _plan_compiler.lower_reduce_scatter(
         eff_plan, flat, residual=residual,
         block=_quant_block_size(block), axes=axes_t, world=world)
@@ -354,6 +356,7 @@ def all_gather(
     axes=None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
 ):
     """Concatenate per-rank flat shards in rank-major order into the full
@@ -407,7 +410,7 @@ def all_gather(
         plan, lambda: _planner.derive_all_gather(
             levels=_planner.levels_of(axes_t) if use_quant else None,
             quantized=use_quant, error_feedback=residual is not None,
-            block=block))
+            block=block, fused=fused))
     if eff_plan.is_quantized and not use_quant:
         # An explicit quantized plan on a mesh with no DCN hop (or
         # custom axes) has no int8 leg to lower — fall back exact.
@@ -548,12 +551,12 @@ def _reduce_replicated(x, op: ReduceOp, axes: Tuple[str, ...],
 
 
 def _reduce_in_jit(x, op: ReduceOp, axes: Tuple[str, ...],
-                   hierarchical: bool, plan=None):
+                   hierarchical: bool, plan=None, fused=None):
     if op in (ReduceOp.AVERAGE, ReduceOp.SUM, ReduceOp.ADASUM):
         eff_plan = _resolve_plan(
             plan, lambda: _planner.derive_allreduce(
                 levels=_planner.levels_of(axes), quantized=False,
-                hierarchical=bool(hierarchical)))
+                hierarchical=bool(hierarchical), fused=fused))
         red = _plan_compiler.lower_psum(eff_plan, x, axes)
         if op == ReduceOp.AVERAGE:
             n = _world_size(axes)
@@ -596,6 +599,7 @@ def allreduce(
     hierarchical: Optional[bool] = None,
     quantized: Optional[bool] = None,
     block: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
     _presummed: bool = False,
 ):
@@ -633,8 +637,8 @@ def allreduce(
         tensor, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         name=name, axes=axes, hierarchical=hierarchical,
-        quantized=quantized, residual=None, block=block, plan=plan,
-        _presummed=_presummed)
+        quantized=quantized, residual=None, block=block, fused=fused,
+        plan=plan, _presummed=_presummed)
     return out
 
 
@@ -649,6 +653,7 @@ def quantized_allreduce(
     name: Optional[str] = None,
     axes=None,
     block: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
 ):
     """Quantized allreduce with explicit error-feedback state.
@@ -670,7 +675,8 @@ def quantized_allreduce(
         tensor, op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         name=name, axes=axes, hierarchical=None, quantized=True,
-        residual=residual, block=block, plan=plan, _presummed=False)
+        residual=residual, block=block, fused=fused, plan=plan,
+        _presummed=False)
 
 
 def _allreduce_impl(
@@ -686,6 +692,7 @@ def _allreduce_impl(
     quantized: Optional[bool],
     residual,
     block: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
     _presummed: bool = False,
 ):
@@ -694,9 +701,11 @@ def _allreduce_impl(
     if plan is not None:
         plan = plan.validate()
         if quantized is None:
-            quantized = plan.is_quantized
+            # Pod-only int8 legs (the quantized pod hop) lower through
+            # the tree ladder, not the 2-level DCN-quantized path.
+            quantized = plan.is_dcn_quantized
         if hierarchical is None:
-            hierarchical = plan.is_tree and not plan.is_quantized
+            hierarchical = plan.is_tree and not plan.is_dcn_quantized
         if block is None:
             block = plan.quant_block
     quantized = _resolve_quantized(quantized, compression)
@@ -739,11 +748,12 @@ def _allreduce_impl(
             if (quantized and set(axes_t) == set(HVD_AXES)
                     and op in (ReduceOp.SUM, ReduceOp.AVERAGE)):
                 eff_plan = _resolve_plan(
-                    plan if (plan is not None and plan.is_quantized)
+                    plan if (plan is not None and plan.is_dcn_quantized)
                     else None,
                     lambda: _planner.quantized_allreduce_plan(
                         block=block,
-                        error_feedback=residual is not None))
+                        error_feedback=residual is not None,
+                        fused=_planner._resolve_fused(fused)))
                 red, new_residual = \
                     _plan_compiler.lower_quantized_allreduce(
                         eff_plan, compressed, residual=residual,
@@ -778,9 +788,10 @@ def _allreduce_impl(
                     )
                 exact_plan = (plan if plan is not None
                               and plan.collective == "allreduce"
-                              and not plan.is_quantized else None)
+                              and not plan.is_dcn_quantized else None)
                 red = _reduce_in_jit(compressed, op, axes_t,
-                                     bool(hierarchical), plan=exact_plan)
+                                     bool(hierarchical), plan=exact_plan,
+                                     fused=fused)
     else:
         # hierarchical=False matches what the eager data plane does (flat
         # rings), so only an explicit True is an unsatisfiable request —

@@ -285,6 +285,7 @@ def allreduce_pytree(
     tuned_params=None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
+    fused: Optional[bool] = None,
     plan=None,
 ):
     """Allreduce every leaf of a pytree with tensor fusion.
@@ -380,7 +381,7 @@ def allreduce_pytree(
                 leaf, op=op, compression=compression, axes=axes,
                 hierarchical=hierarchical, prescale_factor=prescale_factor,
                 postscale_factor=postscale_factor, quantized=quantized,
-                block=block, plan=plan, _presummed=presummed)
+                block=block, fused=fused, plan=plan, _presummed=presummed)
         else:
             varying_idx.append(i)
 
@@ -409,20 +410,21 @@ def allreduce_pytree(
                             compression=compression, axes=axes,
                             prescale_factor=prescale_factor,
                             postscale_factor=postscale_factor, block=block,
-                            plan=plan)
+                            fused=fused, plan=plan)
                     else:
                         red, rnew = C.quantized_allreduce(
                             buf, rbuf, op=op, compression=compression,
                             axes=axes, prescale_factor=prescale_factor,
                             postscale_factor=postscale_factor, block=block,
-                            plan=plan)
+                            fused=fused, plan=plan)
                 else:
                     rnew = None
                     kw = dict(op=op, compression=compression, axes=axes,
                               hierarchical=hierarchical,
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
-                              quantized=quantized, block=block, plan=plan)
+                              quantized=quantized, block=block,
+                              fused=fused, plan=plan)
                     red = (C.allreduce_stream(buf, bucket_id=j, **kw)
                            if overlap_on else C.allreduce(buf, **kw))
                 issued.append((j, red, rnew))

@@ -351,6 +351,7 @@ def DistributedOptimizer(
     zero_stage: Optional[int] = None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
+    fused: Optional[bool] = None,
     axes=None,
     tuned_params=None,
     plan=None,
@@ -410,6 +411,16 @@ def DistributedOptimizer(
     async-collective/latency-hiding flags on TPU (graceful no-op
     elsewhere).
 
+    ``fused`` (default: the ``HOROVOD_FUSED_KERNELS`` knob) lowers the
+    kernel-eligible legs of the gradient wire through the fused Pallas
+    backend (docs/fused-kernels.md): with ``quantized`` on, the
+    blockwise int8 quantize/dequant-accumulate of the DCN legs runs as
+    one VMEM kernel pass instead of separate XLA ops round-tripping the
+    payload + scales through HBM. The wire format and bytes are
+    identical; values agree to the last ulp of the scale division
+    (tests/test_fused_collective.py pins the parity matrix). On an
+    unquantized wire the knob is a no-op (no kernel-eligible leg).
+
     ``tuned_params`` (an ``autotune.TunedParams``, e.g. the winner of
     :func:`horovod_tpu.autotune_session`) overrides the fusion threshold,
     hierarchical flag, int8 scale-block, ZeRO flag, and the
@@ -451,6 +462,8 @@ def DistributedOptimizer(
             num_comm_streams = step_plan.num_comm_streams
         if hierarchical is None:
             hierarchical = step_plan.hierarchical
+        if fused is None:
+            fused = step_plan.fused
         if fusion_threshold_bytes is None:
             fusion_threshold_bytes = step_plan.fusion_threshold_bytes
         if step_plan.quantized:
@@ -470,6 +483,8 @@ def DistributedOptimizer(
             overlap = tuned_params.overlap
         if num_comm_streams is None:
             num_comm_streams = tuned_params.num_comm_streams
+        if fused is None:
+            fused = getattr(tuned_params, "fused", None)
     if quantized is None:
         quantized = (basics.config().quantized_allreduce
                      if basics.is_initialized()
@@ -503,6 +518,7 @@ def DistributedOptimizer(
             quant_block=quant_block,
             overlap=bool(overlap),
             num_comm_streams=num_comm_streams,
+            fused=fused,
             axes=axes,
             stage=zero_stage,
         ))
@@ -540,6 +556,7 @@ def DistributedOptimizer(
             block=quant_block,
             overlap=overlap,
             num_comm_streams=num_comm_streams,
+            fused=fused,
             plan=grad_plan,
         )
 
@@ -759,6 +776,7 @@ def _build_zero_transform(
     axes,
     overlap: bool = False,
     num_comm_streams: int = 1,
+    fused=None,
     stage: int = 2,
 ) -> optax.GradientTransformation:
     """The ZeRO optax wrapper: reduce-scatter → shard update → (stages
@@ -977,7 +995,8 @@ def _build_zero_transform(
                        else _res_read(state.residual[i], in_trace))
                 rs_kw = dict(op=reduce_op, prescale_factor=prescale,
                              postscale_factor=postscale,
-                             block=quant_block, _presummed=True)
+                             block=quant_block, fused=fused,
+                             _presummed=True)
                 if res is not None:
                     if overlap:
                         shard, nres = C.reduce_scatter_stream(
@@ -1093,10 +1112,11 @@ def _build_zero_transform(
                     if overlap:
                         full, nres = C.all_gather_stream(
                             wire, res, bucket_id=i, quantized=True,
-                            block=quant_block)
+                            block=quant_block, fused=fused)
                     else:
                         full, nres = C.all_gather(
-                            wire, res, quantized=True, block=quant_block)
+                            wire, res, quantized=True, block=quant_block,
+                            fused=fused)
                     new_ag[i] = _res_write(state.gather_residual[i], nres,
                                            in_trace)
                 else:
@@ -1104,11 +1124,11 @@ def _build_zero_transform(
                         full = C.all_gather_stream(
                             wire, bucket_id=i,
                             quantized=use_quant and is_float,
-                            block=quant_block)
+                            block=quant_block, fused=fused)
                     else:
                         full = C.all_gather(
                             wire, quantized=use_quant and is_float,
-                            block=quant_block)
+                            block=quant_block, fused=fused)
                     new_ag[i] = (None if state.gather_residual is None
                                  else state.gather_residual[i])
                 issued.append((i, full, ctx))

@@ -24,20 +24,25 @@ leg lowering rules in :mod:`~horovod_tpu.plan.compiler`.
 from .ir import (  # noqa: F401
     ALL_GATHER,
     ALL_TO_ALL,
+    BACKENDS,
     DCN,
     FLAT,
     ICI,
     INT8,
+    PALLAS,
     PAYLOAD,
     POD,
     PSUM,
     REDUCE_SCATTER,
+    XLA,
     Leg,
     PlanError,
     WirePlan,
 )
 from .accounting import (  # noqa: F401
     WireStats,
+    bench_gbps,
+    fused_span,
     record_wire_stats,
 )
 from .planner import (  # noqa: F401
@@ -49,6 +54,9 @@ from .planner import (  # noqa: F401
     describe_plan,
     encode_tuned,
     flat_plan,
+    fused_ag_matmul_plan,
+    fused_matmul_rs_plan,
+    predict_fused_hbm_saved,
     predict_leg_bytes,
     quantized_allreduce_plan,
     tree_allreduce_plan,

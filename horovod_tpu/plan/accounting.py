@@ -40,12 +40,23 @@ class WireStats:
         self.ici_bytes = 0.0
         self.dcn_bytes = 0.0
         self.dcn_bytes_fp = 0.0
+        # Cross-POD hop bytes — DCN-class wire physically, but its own
+        # link class so 3-level meshes can model an asymmetric pod
+        # bandwidth (HOROVOD_BENCH_POD_GBPS) instead of the uniform-DCN
+        # assumption (docs/wire-plan.md).
+        self.pod_bytes = 0.0
+        self.pod_bytes_fp = 0.0
         # Bytes issued through the overlap stream schedule (the
         # allreduce_stream / reduce_scatter_stream / all_gather_stream
         # entry points, docs/overlap.md) — wire traffic positioned so the
         # latency-hiding scheduler can run it under independent compute.
         self.overlap_bytes = 0.0
         self.streamed_buckets = 0
+        # HBM round-trip bytes the fused Pallas kernels avoided vs the
+        # separate-op lowering (docs/fused-kernels.md), plus how many
+        # fused kernel calls the traced program contains.
+        self.fused_hbm_saved_bytes = 0.0
+        self.fused_calls = 0
 
     @property
     def dcn_reduction(self) -> Optional[float]:
@@ -58,7 +69,7 @@ class WireStats:
         overlap stream schedule (0.0 with overlap off; collectives
         outside the gradient bucket wire — loss allreduce, batch-stats —
         keep it below 1.0). The bench's ``comm_hidden_fraction``."""
-        total = self.ici_bytes + self.dcn_bytes
+        total = self.ici_bytes + self.dcn_bytes + self.pod_bytes
         return (self.overlap_bytes / total) if total else 0.0
 
 
@@ -97,37 +108,56 @@ def _publish_wire_stats(ws: "WireStats") -> None:
     r.gauge("comm.wire.ici_bytes").set(ws.ici_bytes)
     r.gauge("comm.wire.dcn_bytes").set(ws.dcn_bytes)
     r.gauge("comm.wire.dcn_bytes_fp").set(ws.dcn_bytes_fp)
+    r.gauge("comm.wire.pod_bytes").set(ws.pod_bytes)
     r.gauge("comm.wire.overlap_bytes").set(ws.overlap_bytes)
     r.gauge("comm.wire.streamed_buckets").set(ws.streamed_buckets)
     r.gauge("comm.wire.hidden_fraction").set(ws.hidden_fraction)
+    r.gauge("comm.wire.fused_hbm_saved_bytes").set(ws.fused_hbm_saved_bytes)
 
 
 def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
     """Account ``wire_bytes`` per-device bytes on one link class.
-    ``kind`` is ``"ici"`` for intra-host links; ``"dcn"`` covers every
-    slow cross-host hop (the pod level is DCN-class wire too)."""
+    ``kind`` is ``"ici"`` for intra-host links, ``"dcn"`` for the
+    cross-host hop, ``"pod"`` for the cross-pod hop of a 3-level mesh
+    (DCN-class wire physically, but modeled at its own bandwidth)."""
     if _metrics.metrics_enabled():
         _metrics.counter("comm.bytes", hop=kind).inc(wire_bytes)
-        if kind == "dcn":
-            _metrics.counter("comm.bytes_fp_equiv", hop="dcn").inc(
+        if kind in ("dcn", "pod"):
+            _metrics.counter("comm.bytes_fp_equiv", hop=kind).inc(
                 wire_bytes if fp_bytes is None else fp_bytes)
     for ws in _wire_recorders:
         if kind == "dcn":
             ws.dcn_bytes += wire_bytes
             ws.dcn_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        elif kind == "pod":
+            ws.pod_bytes += wire_bytes
+            ws.pod_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
         else:
             ws.ici_bytes += wire_bytes
 
 
-def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float) -> float:
-    """Modeled transfer time of a payload at the bench's (env-overridable)
-    link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS model
-    behind bench.py's step_time_breakdown. On the compiled path this is
-    the only per-bucket latency that exists at trace time (XLA owns the
-    runtime schedule); the eager path measures wall time instead."""
+def bench_gbps() -> tuple:
+    """(ici, dcn, pod) modeled link bandwidths in GB/s — the
+    HOROVOD_BENCH_{ICI,DCN,POD}_GBPS knobs behind every modeled-time
+    number (bench.py step_time_breakdown, the per-bucket latency
+    histograms). The pod knob defaults to the DCN value, so 2-level
+    meshes and unset-knob runs behave exactly as before."""
     ici = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
     dcn = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
-    return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)) * 1e3
+    pod = float(os.environ.get("HOROVOD_BENCH_POD_GBPS", str(dcn)))
+    return ici, dcn, pod
+
+
+def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float,
+                     pod_bytes: float = 0.0) -> float:
+    """Modeled transfer time of a payload at the bench's (env-overridable)
+    link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS/POD_GBPS
+    model behind bench.py's step_time_breakdown. On the compiled path this
+    is the only per-bucket latency that exists at trace time (XLA owns the
+    runtime schedule); the eager path measures wall time instead."""
+    ici, dcn, pod = bench_gbps()
+    return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)
+            + pod_bytes / (pod * 1e9)) * 1e3
 
 
 @contextlib.contextmanager
@@ -148,7 +178,7 @@ def overlap_stream(kind: str, bucket_id):
         yield
     finally:
         _wire_recorders.remove(own)
-        delta = own.ici_bytes + own.dcn_bytes
+        delta = own.ici_bytes + own.dcn_bytes + own.pod_bytes
         for ws in outer:
             ws.overlap_bytes += delta
             ws.streamed_buckets += 1
@@ -159,6 +189,43 @@ def overlap_stream(kind: str, bucket_id):
             # µs, not ms: the log2 buckets need the resolution (a small
             # bucket's modeled transfer is far under a millisecond).
             r.histogram("comm.bucket.latency_us").observe(
-                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes) * 1e3)
+                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes,
+                                 own.pod_bytes) * 1e3)
         if tl is not None:
             tl.end(tid, activity)
+
+
+# ---------------------------------------------------------------------------
+# Fused-kernel instrumentation (docs/fused-kernels.md): every fused
+# Pallas kernel call brackets itself in a FUSED:* span at trace time and
+# accounts the HBM round-trip it avoided vs the separate-op lowering.
+# Like the wire accounting this is trace-time-only — a compiled step
+# re-executes with zero instrumentation cost.
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def fused_span(kind: str, hbm_saved_bytes: float = 0.0):
+    """Bracket one fused kernel call: emit a ``FUSED:<kind>`` timeline
+    span (kinds: ``MATMUL_RS``, ``AG_MATMUL``, ``QUANT``, ``DEQUANT``),
+    bump the ``comm.fused.*`` metrics, and credit ``hbm_saved_bytes``
+    (the modeled HBM round-trip the fusion avoids — the epilogue/
+    prologue's intermediate that never materializes) to every active
+    :func:`record_wire_stats` recorder."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    activity = f"FUSED:{kind}"
+    if tl is not None:
+        tl.begin("fused", activity)
+    try:
+        yield
+    finally:
+        if _metrics.metrics_enabled():
+            r = _metrics.default_registry()
+            r.counter("comm.fused.calls", kind=kind).inc()
+            r.counter("comm.fused.hbm_saved_bytes", kind=kind).inc(
+                float(hbm_saved_bytes))
+        for ws in _wire_recorders:
+            ws.fused_calls += 1
+            ws.fused_hbm_saved_bytes += float(hbm_saved_bytes)
+        if tl is not None:
+            tl.end("fused", activity)

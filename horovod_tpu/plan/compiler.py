@@ -73,7 +73,9 @@ def quant_wire_bytes(seg: int, blk: int) -> float:
 def _acct_psum_flat(x, axes) -> None:
     """Account a flat psum over ``axes`` with the topology-aware model:
     ICI leg on the full payload, DCN leg on the 1/local shard, pod leg on
-    the 1/(local*cross) shard (pod links are DCN-class wire)."""
+    the 1/(local*cross) shard (DCN-class wire physically, charged to its
+    own ``pod`` link class so 3-level meshes can model an asymmetric
+    HOROVOD_BENCH_POD_GBPS bandwidth)."""
     if not _acct_enabled():
         return
     n = float(np.prod(x.shape)) if x.ndim else 1.0
@@ -88,7 +90,7 @@ def _acct_psum_flat(x, axes) -> None:
         n /= nc
     if POD_AXIS in axes:
         npod = _axis_size(POD_AXIS)
-        _acct("dcn", 2.0 * n * (npod - 1) / npod * isz)
+        _acct("pod", 2.0 * n * (npod - 1) / npod * isz)
 
 
 def _leg_flat_psum(x, axes):
@@ -110,20 +112,55 @@ def _lower_tree_psum(plan: ir.WirePlan, x, axes: Tuple[str, ...]):
     local_axis, cross_axis = LOCAL_AXIS, CROSS_AXIS
     cross_levels = [l.level for l in plan.legs
                     if l.primitive == ir.PSUM and l.level != ir.FLAT]
+    # Quantized pod hop (docs/fused-kernels.md): the pod level spelled as
+    # the rs[int8] > ag[int8] pair instead of the exact psum.
+    qpod = [l for l in plan.legs
+            if l.level == ir.POD and l.wire_dtype == ir.INT8]
     nl = _axis_size(local_axis)
+    npod = _axis_size(POD_AXIS) if qpod else 1
     if x.ndim >= 1 and x.shape[0] % nl == 0 and x.shape[0] > 0:
+        n_elems = int(np.prod(x.shape, dtype=np.int64))
+        sn = n_elems // nl
+        # The quantized pod pair needs the post-ICI shard to split into
+        # whole per-pod segments; otherwise it falls back to the exact
+        # pod psum (the same remainder contract as the tree plan itself).
+        use_qpod = bool(qpod) and npod > 1 and sn % npod == 0
         if _acct_enabled():
-            n = float(np.prod(x.shape))
+            n = float(n_elems)
             isz = jnp.dtype(x.dtype).itemsize
             _acct("ici", n * (nl - 1) / nl * isz)        # psum_scatter
             for lvl in cross_levels:                      # cross psum(s)
                 k = _axis_size(LEVEL_AXIS[lvl])
-                _acct("dcn", 2.0 * (n / nl) * (k - 1) / k * isz)
+                _acct("pod" if lvl == ir.POD else "dcn",
+                      2.0 * (n / nl) * (k - 1) / k * isz)
+            if use_qpod:
+                blk = int(qpod[0].block or 256)
+                seg = sn // npod
+                q_unit = quant_wire_bytes(seg, blk) * npod
+                _acct("pod", q_unit * (npod - 1) / npod,   # rs[int8]
+                      float(sn) * (npod - 1) / npod * isz)
+                _acct("pod", 2.0 * q_unit * (npod - 1) / npod,  # ag[int8]
+                      2.0 * float(sn) * (npod - 1) / npod * isz)
+            elif qpod:
+                _acct("pod", 2.0 * (n / nl) * (npod - 1) / npod * isz)
             _acct("ici", 2.0 * n * (nl - 1) / nl * isz)  # gather-leg psum
         shard = lax.psum_scatter(x, local_axis, scatter_dimension=0,
                                  tiled=True)
         for lvl in cross_levels:
             shard = lax.psum(shard, LEVEL_AXIS[lvl])
+        if qpod:
+            if use_qpod:
+                blk = int(qpod[0].block or 256)
+                seg = sn // npod
+                shape = shard.shape
+                segs = shard.reshape(npod, seg).astype(jnp.float32)
+                red, _ = _leg_quant_rs(segs, blk, POD_AXIS,
+                                       backend=qpod[0].backend)
+                vals, _ = _leg_quant_ag(red, blk, POD_AXIS,
+                                        backend=qpod[-1].backend)
+                shard = vals.reshape(shape).astype(x.dtype)
+            else:
+                shard = lax.psum(shard, POD_AXIS)
         # Final allgather leg, expressed as a psum of disjointly-placed
         # shards: numerically identical to lax.all_gather but the result is
         # provably replicated for the sharding checker (all_gather output is
@@ -150,14 +187,43 @@ def _lower_tree_psum(plan: ir.WirePlan, x, axes: Tuple[str, ...]):
 # ---------------------------------------------------------------------------
 
 
-def _leg_quant_rs(segs, blk: int, cross_axis):
+def _quantize_blocks(blocks, backend: str):
+    """Blockwise int8 quantize of ``blocks [rows, nb, blk]`` →
+    ``(q, scales, err)``. The ``pallas`` backend runs the fused one-pass
+    VMEM kernel (ops/fused_collective.py — interpret mode off-TPU);
+    ``xla`` is the original separate-op composition. Same wire format
+    either way; values agree to the last ulp of the scale division."""
+    if backend == ir.PALLAS:
+        from ..ops import fused_collective as _fused
+
+        return _fused.quantize_blockwise(blocks.astype(jnp.float32))
+    scales = _compression._block_scales(blocks)
+    q = jnp.clip(jnp.round(blocks / scales[..., None]),
+                 -127, 127).astype(jnp.int8)
+    err = blocks - q.astype(jnp.float32) * scales[..., None]
+    return q, scales, err
+
+
+def _dequant_accumulate(qT, sT, backend: str):
+    """``sum_r qT[r] * sT[r]`` over the contributor axis — the fused
+    kernel never expands the int8 payload to fp32 in HBM."""
+    if backend == ir.PALLAS:
+        from ..ops import fused_collective as _fused
+
+        return _fused.dequantize_accumulate(qT, sT)
+    return jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)
+
+
+def _leg_quant_rs(segs, blk: int, cross_axis, backend: str = ir.XLA):
     """Quantized DCN reduce-scatter leg: ``segs`` is this rank's
     ICI-scattered shard viewed ``[nc, seg]`` in fp32, row ``j`` destined
     to cross rank ``j``. Each row quantizes to int8 with one fp32 scale
     per ``blk`` elements, a tiled ``all_to_all`` moves int8 + scales,
     receivers dequantize-accumulate in fp32. Returns
     ``(reduced_seg [seg] fp32, err [nc, seg] fp32)`` where ``err`` is
-    this rank's quantization error on everything it sent."""
+    this rank's quantization error on everything it sent. ``backend``
+    selects the quantize/dequant lowering (``pallas`` = fused kernels,
+    docs/fused-kernels.md); the wire composition is identical."""
     nc, seg = segs.shape
     pad = (-seg) % blk
     if pad:
@@ -165,20 +231,17 @@ def _leg_quant_rs(segs, blk: int, cross_axis):
             [segs, jnp.zeros((nc, pad), jnp.float32)], axis=1)
     nb = segs.shape[1] // blk
     blocks = segs.reshape(nc, nb, blk)
-    scales = _compression._block_scales(blocks)            # [nc, nb]
-    q = jnp.clip(jnp.round(blocks / scales[..., None]),
-                 -127, 127).astype(jnp.int8)
-    err = blocks - q.astype(jnp.float32) * scales[..., None]
+    q, scales, err = _quantize_blocks(blocks, backend)
     qT = lax.all_to_all(q, cross_axis, split_axis=0, concat_axis=0,
                         tiled=True)
     sT = lax.all_to_all(scales, cross_axis, split_axis=0, concat_axis=0,
                         tiled=True)
-    acc = jnp.sum(qT.astype(jnp.float32) * sT[..., None], axis=0)
+    acc = _dequant_accumulate(qT, sT, backend)
     return (acc.reshape(nb * blk)[:seg],
             err.reshape(nc, nb * blk)[:, :seg])
 
 
-def _leg_quant_ag(seg_vals, blk: int, cross_axis):
+def _leg_quant_ag(seg_vals, blk: int, cross_axis, backend: str = ir.XLA):
     """Quantized DCN all-gather leg: quantize this rank's owned segment
     ``[seg]`` (fp32) and rebroadcast it as a masked int8 psum — disjoint
     support makes the sum exact and the result replicated over
@@ -190,12 +253,9 @@ def _leg_quant_ag(seg_vals, blk: int, cross_axis):
     padded = (jnp.concatenate([seg_vals, jnp.zeros((pad,), jnp.float32)])
               if pad else seg_vals)
     nb = padded.shape[0] // blk
-    blocks = padded.reshape(nb, blk)
-    s2 = _compression._block_scales(blocks)                # [nb]
-    q2 = jnp.clip(jnp.round(blocks / s2[:, None]),
-                  -127, 127).astype(jnp.int8)
-    err = (blocks - q2.astype(jnp.float32) * s2[:, None]).reshape(
-        nb * blk)[:seg]
+    q3, s2, e3 = _quantize_blocks(padded.reshape(1, nb, blk), backend)
+    q2, s2, err = q3[0], s2[0], e3[0]
+    err = err.reshape(nb * blk)[:seg]
     ci = lax.axis_index(cross_axis)
     qfull = lax.dynamic_update_slice_in_dim(
         jnp.zeros((nc, nb, blk), jnp.int8), q2[None], ci, 0)
@@ -206,6 +266,15 @@ def _leg_quant_ag(seg_vals, blk: int, cross_axis):
     vals = (qg.astype(jnp.float32) * sg[..., None]).reshape(
         nc, nb * blk)[:, :seg]
     return vals, err
+
+
+def _int8_leg_backend(plan: ir.WirePlan, primitive: str) -> str:
+    """Backend of the first int8 leg with ``primitive`` (xla when the
+    plan has none — the exact fallback paths)."""
+    for leg in plan.legs:
+        if leg.wire_dtype == ir.INT8 and leg.primitive == primitive:
+            return leg.backend
+    return ir.XLA
 
 
 def _leg_ici_gather(shard_flat, n: int, offset, local_axis=LOCAL_AXIS):
@@ -275,17 +344,22 @@ def lower_quantized_allreduce(plan: ir.WirePlan, x, *, residual=None,
               2.0 * float(sn) * (nc - 1) / nc * isz)
         _acct("ici", 2.0 * n * (nl - 1) / nl * isz)        # ICI gather leg
 
+    rs_backend = _int8_leg_backend(plan, ir.REDUCE_SCATTER)
+    ag_backend = _int8_leg_backend(plan, ir.ALL_GATHER)
+
     # Leg 1 — ICI reduce-scatter in the payload dtype.
     shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
                              tiled=True)
 
     # Leg 2 — quantized DCN reduce-scatter (all_to_all of int8 + scales).
     segs = shard.reshape(nc, seg).astype(jnp.float32)
-    red_seg, err1 = _leg_quant_rs(segs, blk, cross_axis)   # [seg], [nc, seg]
+    red_seg, err1 = _leg_quant_rs(segs, blk, cross_axis,
+                                  backend=rs_backend)     # [seg], [nc, seg]
 
     # Leg 3 — requantize the reduced segment; masked int8 psum gathers the
     # shard with replication by construction (disjoint segment support).
-    vals, err2 = _leg_quant_ag(red_seg, blk, cross_axis)   # [nc, seg], [seg]
+    vals, err2 = _leg_quant_ag(red_seg, blk, cross_axis,
+                               backend=ag_backend)        # [nc, seg], [seg]
     shard_red = vals.reshape(sn).astype(x.dtype)
 
     # Leg 4 — ICI gather (psum of disjointly-placed shards).
@@ -340,7 +414,7 @@ def lower_reduce_scatter(plan: ir.WirePlan, flat, *, residual=None,
                 rem /= nc
             if POD_AXIS in axes:
                 npod = _axis_size(POD_AXIS)
-                _acct("dcn", rem * (npod - 1) / npod * isz)
+                _acct("pod", rem * (npod - 1) / npod * isz)
         shard = lax.psum_scatter(flat, axes, scatter_dimension=0,
                                  tiled=True)
         new_res = None if residual is None else jnp.zeros_like(residual)
@@ -376,7 +450,9 @@ def lower_reduce_scatter(plan: ir.WirePlan, flat, *, residual=None,
         if residual is not None:
             new_res = jnp.zeros_like(residual)
     elif quantized:
-        red, err = _leg_quant_rs(h.astype(jnp.float32), blk, CROSS_AXIS)
+        red, err = _leg_quant_rs(
+            h.astype(jnp.float32), blk, CROSS_AXIS,
+            backend=_int8_leg_backend(plan, ir.REDUCE_SCATTER))
         shard = red.astype(flat.dtype)
         if residual is not None:
             new_res = err.reshape(sn).astype(residual.dtype)
@@ -421,7 +497,9 @@ def lower_all_gather(plan: ir.WirePlan, shard, *, residual=None,
                     f"all_gather residual must match the shard [{seg}], "
                     f"got {residual.shape}")
             x = x + residual.astype(jnp.float32)
-        vals, err = _leg_quant_ag(x, blk, CROSS_AXIS)      # [nc, seg]
+        vals, err = _leg_quant_ag(
+            x, blk, CROSS_AXIS,
+            backend=_int8_leg_backend(plan, ir.ALL_GATHER))  # [nc, seg]
         if residual is not None:
             new_res = err.astype(residual.dtype)
         # ICI leg: place this rank's cross-gathered column at local index

@@ -59,6 +59,17 @@ BF16 = "bf16"
 INT8 = "int8"
 WIRE_DTYPES = (PAYLOAD, BF16, INT8)
 
+# Leg backends. ``xla`` lowers through the stock jax primitives; ``pallas``
+# lowers the leg's local compute (blockwise quantize/dequant-accumulate,
+# matmul prologue/epilogue tiles) through the fused Pallas TPU kernels of
+# ``ops/fused_collective.py`` so it never round-trips HBM between the
+# producing op and the wire (docs/fused-kernels.md). The WIRE composition
+# is identical either way — backend is an execution attribute, like
+# ``stream``.
+XLA = "xla"
+PALLAS = "pallas"
+BACKENDS = (XLA, PALLAS)
+
 _REDUCE_PRIMS = (REDUCE_SCATTER, PSUM, ALL_TO_ALL)
 _GATHER_PRIMS = (ALL_GATHER,)
 
@@ -81,6 +92,8 @@ class Leg:
     rank sent, re-injected next step). ``stream`` is the comm-stream
     slot the leg's bucket collective is issued on when the plan is
     overlap-scheduled (0-based, < :attr:`WirePlan.streams`).
+    ``backend`` selects the lowering of the leg's local compute:
+    ``xla`` (default) or ``pallas`` (fused kernel, docs/fused-kernels.md).
     """
 
     level: str
@@ -89,6 +102,7 @@ class Leg:
     block: Optional[int] = None
     error_feedback: bool = False
     stream: int = 0
+    backend: str = XLA
 
     def describe(self) -> str:
         d = self.wire_dtype
@@ -96,7 +110,8 @@ class Leg:
             d = f"int8/{self.block}"
         if self.error_feedback:
             d += "+ef"
-        return f"{self.level}.{self.primitive}[{d}]"
+        tail = "@pl" if self.backend == PALLAS else ""
+        return f"{self.level}.{self.primitive}[{d}]{tail}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +140,16 @@ class WirePlan:
     @property
     def is_quantized(self) -> bool:
         return any(l.wire_dtype == INT8 for l in self.legs)
+
+    @property
+    def is_dcn_quantized(self) -> bool:
+        """Int8 on the cross-host (DCN) hop — the wire the 2-level
+        quantized lowerings (lower_quantized_allreduce, the ZeRO rs/ag
+        legs) compress. A plan whose only int8 legs ride the POD level
+        (the quantized pod hop) is NOT dcn-quantized: it lowers through
+        the tree ladder, which owns the pod legs."""
+        return any(l.wire_dtype == INT8 and l.level == DCN
+                   for l in self.legs)
 
     @property
     def is_tree(self) -> bool:
@@ -191,6 +216,33 @@ class WirePlan:
                     f"hop — compression belongs on the slow cross-host "
                     f"links only; the ICI leg always rides the payload "
                     f"dtype (HiCCL placement rule, docs/wire-plan.md)")
+            if leg.wire_dtype == INT8 and leg.primitive == PSUM:
+                raise PlanError(
+                    f"{where}: blockwise-int8 on a psum leg — int8 "
+                    f"blocks with per-block scales are not closed under "
+                    f"addition, so the exact psum has no quantized "
+                    f"lowering; spell a quantized hop as the "
+                    f"reduce_scatter[int8] > all_gather[int8] pair "
+                    f"(the quantized pod hop, docs/fused-kernels.md)")
+            if leg.backend not in BACKENDS:
+                raise PlanError(
+                    f"{where}: unknown backend {leg.backend!r} — "
+                    f"backends are {BACKENDS} (xla = stock primitives, "
+                    f"pallas = fused kernels, docs/fused-kernels.md)")
+            if leg.backend == PALLAS and leg.level == FLAT:
+                raise PlanError(
+                    f"{where}: backend='pallas' on a flat leg — the "
+                    f"flat plan is one XLA-decomposed collective with "
+                    f"no leg-local compute to fuse a kernel into; "
+                    f"kernel-backed legs live on the per-level "
+                    f"compositions (docs/fused-kernels.md)")
+            if leg.backend == PALLAS and leg.primitive == PSUM:
+                raise PlanError(
+                    f"{where}: backend='pallas' on a psum leg — the "
+                    f"exact psum has no kernel body; the fused kernels "
+                    f"back the quantize/dequant rs/ag legs and the "
+                    f"matmul prologue/epilogue legs "
+                    f"(docs/fused-kernels.md)")
             if leg.error_feedback and leg.level not in (DCN, POD):
                 raise PlanError(
                     f"{where}: error-feedback slot on a non-DCN hop — "

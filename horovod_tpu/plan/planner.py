@@ -48,11 +48,37 @@ from typing import List, Optional, Tuple
 
 from ..common import basics
 from ..common.config import _env_bool, _env_int
-from .ir import (ALL_GATHER, DCN, FLAT, ICI, INT8, PAYLOAD, POD, PSUM,
-                 REDUCE_SCATTER, Leg, PlanError, WirePlan)
+from .ir import (ALL_GATHER, DCN, FLAT, ICI, INT8, PALLAS, PAYLOAD, POD,
+                 PSUM, REDUCE_SCATTER, XLA, Leg, PlanError, WirePlan)
 
 _AXIS_LEVEL = {basics.LOCAL_AXIS: ICI, basics.CROSS_AXIS: DCN,
                basics.POD_AXIS: POD}
+
+
+def _resolve_fused(fused: Optional[bool]) -> bool:
+    """Per-call arg > init-time Config > HOROVOD_FUSED_KERNELS env —
+    whether kernel-eligible legs lower through the fused Pallas backend
+    (docs/fused-kernels.md)."""
+    if fused is not None:
+        return bool(fused)
+    cfg = basics.config() if basics.is_initialized() else None
+    return (cfg.fused_kernels if cfg is not None
+            else _env_bool("HOROVOD_FUSED_KERNELS", False))
+
+
+def _resolve_quantized_pod(quantized_pod: Optional[bool]) -> bool:
+    """Per-call arg > Config > HOROVOD_QUANTIZED_POD env — whether the
+    3-level tree plan's pod hop rides the blockwise-int8 rs+ag pair
+    instead of the exact psum."""
+    if quantized_pod is not None:
+        return bool(quantized_pod)
+    cfg = basics.config() if basics.is_initialized() else None
+    return (cfg.quantized_pod if cfg is not None
+            else _env_bool("HOROVOD_QUANTIZED_POD", False))
+
+
+def _backend(fused: bool) -> str:
+    return PALLAS if fused else XLA
 
 
 def levels_of(axes_t) -> Optional[Tuple[str, ...]]:
@@ -78,9 +104,20 @@ def flat_plan(collective: str, *, streams: int = 1,
 
 
 def tree_allreduce_plan(*, pod: bool = False, streams: int = 1,
-                        overlap: bool = False) -> WirePlan:
+                        overlap: bool = False,
+                        quantized_pod: bool = False,
+                        block: Optional[int] = None,
+                        fused: bool = False) -> WirePlan:
     legs = [Leg(ICI, REDUCE_SCATTER), Leg(DCN, PSUM)]
-    if pod:
+    if pod and quantized_pod:
+        # The quantized pod hop (docs/fused-kernels.md): the pod level as
+        # the int8 rs+ag pair — the EQuARX decomposition on the slowest
+        # link of a 3-level mesh — instead of the exact psum.
+        legs.append(Leg(POD, REDUCE_SCATTER, INT8, block=block,
+                        backend=_backend(fused)))
+        legs.append(Leg(POD, ALL_GATHER, INT8, block=block,
+                        backend=_backend(fused)))
+    elif pod:
         legs.append(Leg(POD, PSUM))
     legs.append(Leg(ICI, ALL_GATHER))
     return WirePlan("allreduce", tuple(legs), streams=streams,
@@ -90,13 +127,14 @@ def tree_allreduce_plan(*, pod: bool = False, streams: int = 1,
 def quantized_allreduce_plan(*, block: Optional[int] = None,
                              error_feedback: bool = False,
                              streams: int = 1,
-                             overlap: bool = False) -> WirePlan:
+                             overlap: bool = False,
+                             fused: bool = False) -> WirePlan:
     legs = (
         Leg(ICI, REDUCE_SCATTER),
         Leg(DCN, REDUCE_SCATTER, INT8, block=block,
-            error_feedback=error_feedback),
+            error_feedback=error_feedback, backend=_backend(fused)),
         Leg(DCN, ALL_GATHER, INT8, block=block,
-            error_feedback=error_feedback),
+            error_feedback=error_feedback, backend=_backend(fused)),
         Leg(ICI, ALL_GATHER),
     )
     return WirePlan("allreduce", legs, streams=streams,
@@ -107,11 +145,13 @@ def zero_reduce_scatter_plan(*, quantized: bool = False,
                              block: Optional[int] = None,
                              error_feedback: bool = False,
                              streams: int = 1,
-                             overlap: bool = False) -> WirePlan:
+                             overlap: bool = False,
+                             fused: bool = False) -> WirePlan:
     """The ZeRO gradient wire (the reduce half of the quantized
     allreduce, stopped before the optimizer update)."""
     dcn = (Leg(DCN, REDUCE_SCATTER, INT8, block=block,
-               error_feedback=error_feedback) if quantized
+               error_feedback=error_feedback,
+               backend=_backend(fused)) if quantized
            else Leg(DCN, REDUCE_SCATTER, PAYLOAD,
                     error_feedback=error_feedback))
     return WirePlan("reduce_scatter",
@@ -123,15 +163,39 @@ def zero_all_gather_plan(*, quantized: bool = False,
                          block: Optional[int] = None,
                          error_feedback: bool = False,
                          streams: int = 1,
-                         overlap: bool = False) -> WirePlan:
+                         overlap: bool = False,
+                         fused: bool = False) -> WirePlan:
     """The ZeRO update broadcast (the gather half)."""
     if quantized:
         legs = (Leg(DCN, ALL_GATHER, INT8, block=block,
-                    error_feedback=error_feedback),
+                    error_feedback=error_feedback,
+                    backend=_backend(fused)),
                 Leg(ICI, ALL_GATHER))
         return WirePlan("all_gather", legs, streams=streams,
                         overlap=overlap).validate()
     return flat_plan("all_gather", streams=streams, overlap=overlap)
+
+
+def fused_matmul_rs_plan(*, streams: int = 1,
+                         overlap: bool = False) -> WirePlan:
+    """The wire of :func:`~horovod_tpu.ops.fused_collective.
+    fused_matmul_reduce_scatter`: a kernel-backed ring reduce-scatter —
+    same bytes as the per-level rs legs, matmul epilogue riding inside."""
+    return WirePlan("reduce_scatter",
+                    (Leg(ICI, REDUCE_SCATTER, backend=PALLAS),
+                     Leg(DCN, REDUCE_SCATTER, backend=PALLAS)),
+                    streams=streams, overlap=overlap).validate()
+
+
+def fused_ag_matmul_plan(*, streams: int = 1,
+                         overlap: bool = False) -> WirePlan:
+    """The wire of :func:`~horovod_tpu.ops.fused_collective.
+    fused_all_gather_matmul`: a kernel-backed ring all-gather whose
+    arriving shards feed the matmul prologue."""
+    return WirePlan("all_gather",
+                    (Leg(DCN, ALL_GATHER, backend=PALLAS),
+                     Leg(ICI, ALL_GATHER, backend=PALLAS)),
+                    streams=streams, overlap=overlap).validate()
 
 
 # ---------------------------------------------------------------------------
@@ -142,17 +206,27 @@ def zero_all_gather_plan(*, quantized: bool = False,
 def derive_allreduce(*, levels, quantized: bool, hierarchical: bool,
                      block: Optional[int] = None,
                      error_feedback: bool = False,
-                     streams: int = 1, overlap: bool = False) -> WirePlan:
+                     streams: int = 1, overlap: bool = False,
+                     fused: Optional[bool] = None,
+                     quantized_pod: Optional[bool] = None) -> WirePlan:
     """Today's allreduce knob combination as a plan. ``levels`` is the
-    bound-axis level tuple (None for custom axes → flat)."""
+    bound-axis level tuple (None for custom axes → flat). ``fused``
+    (default: HOROVOD_FUSED_KERNELS) puts the Pallas backend on the
+    kernel-eligible legs; ``quantized_pod`` (HOROVOD_QUANTIZED_POD)
+    rides the 3-level tree plan's pod hop as the int8 rs+ag pair."""
     lvls = set(levels or ())
+    fused = _resolve_fused(fused)
     if quantized and lvls == {ICI, DCN}:
         return quantized_allreduce_plan(block=block,
                                         error_feedback=error_feedback,
-                                        streams=streams, overlap=overlap)
+                                        streams=streams, overlap=overlap,
+                                        fused=fused)
     if hierarchical and {ICI, DCN} <= lvls:
-        return tree_allreduce_plan(pod=POD in lvls, streams=streams,
-                                   overlap=overlap)
+        return tree_allreduce_plan(
+            pod=POD in lvls, streams=streams, overlap=overlap,
+            quantized_pod=(POD in lvls
+                           and _resolve_quantized_pod(quantized_pod)),
+            block=block, fused=fused)
     return flat_plan("allreduce", streams=streams, overlap=overlap)
 
 
@@ -160,25 +234,28 @@ def derive_reduce_scatter(*, levels, quantized: bool,
                           error_feedback: bool = False,
                           block: Optional[int] = None,
                           streams: int = 1,
-                          overlap: bool = False) -> WirePlan:
+                          overlap: bool = False,
+                          fused: Optional[bool] = None) -> WirePlan:
     lvls = set(levels or ())
     if lvls == {ICI, DCN} and (quantized or error_feedback):
         return zero_reduce_scatter_plan(
             quantized=quantized, block=block,
             error_feedback=error_feedback, streams=streams,
-            overlap=overlap)
+            overlap=overlap, fused=_resolve_fused(fused) and quantized)
     return flat_plan("reduce_scatter", streams=streams, overlap=overlap)
 
 
 def derive_all_gather(*, levels, quantized: bool,
                       error_feedback: bool = False,
                       block: Optional[int] = None,
-                      streams: int = 1, overlap: bool = False) -> WirePlan:
+                      streams: int = 1, overlap: bool = False,
+                      fused: Optional[bool] = None) -> WirePlan:
     lvls = set(levels or ())
     if quantized and lvls == {ICI, DCN}:
         return zero_all_gather_plan(
             quantized=True, block=block, error_feedback=error_feedback,
-            streams=streams, overlap=overlap)
+            streams=streams, overlap=overlap,
+            fused=_resolve_fused(fused))
     return flat_plan("all_gather", streams=streams, overlap=overlap)
 
 
@@ -206,8 +283,8 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
                       mesh_shape) -> List[dict]:
     """Per-leg predicted wire bytes for a payload of ``n`` elements.
     Each row: ``{leg, hop, bytes, fp_bytes}`` where ``hop`` is the link
-    class charged (``ici``/``dcn``/``-``) and ``fp_bytes`` the same
-    traffic at the payload dtype (differs only on int8 legs)."""
+    class charged (``ici``/``dcn``/``pod``/``-``) and ``fp_bytes`` the
+    same traffic at the payload dtype (differs only on int8 legs)."""
     nl, nc, npod = _mesh_sizes(mesh_shape)
     world = nl * nc * npod
     isz = itemsize
@@ -222,20 +299,34 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
 
     if plan.is_flat:
         leg = plan.legs[0]
-        if plan.collective == "allreduce":
-            b = 2.0 * n * (nl - 1) / nl * isz
-            d = 2.0 * (n / nl) * (nc - 1) / nc * isz
-            d += 2.0 * (n / nl / nc) * (npod - 1) / npod * isz
-        elif plan.collective == "reduce_scatter":
+        if plan.collective == "reduce_scatter":
             b = n * (nl - 1) / nl * isz
             d = (n / nl) * (nc - 1) / nc * isz
-            d += (n / nl / nc) * (npod - 1) / npod * isz
-        else:  # all_gather of the full [n] masked buffer
+            p = (n / nl / nc) * (npod - 1) / npod * isz
+        else:  # allreduce, or all_gather of the full [n] masked buffer
             b = 2.0 * n * (nl - 1) / nl * isz
             d = 2.0 * (n / nl) * (nc - 1) / nc * isz
-            d += 2.0 * (n / nl / nc) * (npod - 1) / npod * isz
+            p = 2.0 * (n / nl / nc) * (npod - 1) / npod * isz
         row(leg, "ici", b)
         row(leg, "dcn", d)
+        if npod > 1:
+            row(leg, "pod", p)
+        return rows
+
+    ring = all(l.backend == PALLAS and l.wire_dtype == PAYLOAD
+               for l in plan.legs)
+    if ring and plan.collective in ("reduce_scatter", "all_gather"):
+        # Fused matmul ring (fused_matmul_rs_plan / fused_ag_matmul_plan):
+        # world-1 hops of the 1/world tile = (w-1)/w * n total per device
+        # (a TRUE ring gather — no masked-psum doubling), of which 1/nl
+        # of the directed links cross a host boundary (the same model
+        # ops/fused_collective.py charges at trace time).
+        total = n * (world - 1) / max(1, world) * isz
+        for leg in plan.legs:
+            if leg.level == ICI:
+                row(leg, "ici", total * (1.0 - 1.0 / nl))
+            else:
+                row(leg, "dcn", total / nl)
         return rows
 
     for leg in plan.legs:
@@ -245,7 +336,19 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
             row(leg, "ici", 2.0 * n * (nl - 1) / nl * isz)
         elif leg.level in (DCN, POD) and leg.primitive == PSUM:
             k = nc if leg.level == DCN else npod
-            row(leg, "dcn", 2.0 * (n / nl) * (k - 1) / k * isz)
+            hop = "dcn" if leg.level == DCN else "pod"
+            row(leg, hop, 2.0 * (n / nl) * (k - 1) / k * isz)
+        elif leg.level == POD and leg.primitive == REDUCE_SCATTER:
+            # Quantized pod hop: rs[int8] on the post-ICI shard [sn].
+            segp = sn // npod if npod else sn
+            q = _quant_unit(segp, leg.block or blk) * npod
+            row(leg, "pod", q * (npod - 1) / max(1, npod),
+                float(sn) * (npod - 1) / max(1, npod) * isz)
+        elif leg.level == POD and leg.primitive == ALL_GATHER:
+            segp = sn // npod if npod else sn
+            q = _quant_unit(segp, leg.block or blk) * npod
+            row(leg, "pod", 2.0 * q * (npod - 1) / max(1, npod),
+                2.0 * float(sn) * (npod - 1) / max(1, npod) * isz)
         elif leg.level == DCN and leg.primitive == REDUCE_SCATTER:
             if leg.wire_dtype == INT8:
                 seg = (seg_w if plan.collective == "reduce_scatter"
@@ -256,7 +359,9 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
             else:
                 row(leg, "dcn", sn * (nc - 1) / nc * isz)
         elif leg.level == DCN and leg.primitive == ALL_GATHER:
-            if plan.collective == "all_gather":
+            if leg.wire_dtype != INT8:
+                row(leg, "dcn", 2.0 * sn * (nc - 1) / nc * isz)
+            elif plan.collective == "all_gather":
                 # each rank gathers its owned 1/world segment of the
                 # full [n] payload
                 q = _quant_unit(seg_w, leg.block or blk)
@@ -269,6 +374,35 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
         else:  # pragma: no cover - validation rejects other shapes
             row(leg, "-", 0.0)
     return rows
+
+
+def predict_fused_hbm_saved(plan: WirePlan, n: int, itemsize: int,
+                            mesh_shape) -> float:
+    """Predicted HBM round-trip bytes the plan's kernel-backed legs avoid
+    vs their separate-op lowering, for a payload of ``n`` elements — the
+    same model the kernels charge at trace time
+    (ops/fused_collective.py: ``quant_hbm_saved``/``dequant_hbm_saved``),
+    rendered by the ``--dump-plan`` table's ``fused:`` line."""
+    from ..ops import fused_collective as _fused
+
+    nl, nc, npod = _mesh_sizes(mesh_shape)
+    blk = plan.quant_block or 256
+    sn = n // nl if nl else n
+    saved = 0.0
+    for leg in plan.legs:
+        if leg.backend != PALLAS or leg.wire_dtype != INT8:
+            continue
+        k = npod if leg.level == POD else nc
+        seg = (n // (nl * nc * npod) if plan.collective != "allreduce"
+               and leg.level == DCN else sn // max(1, k))
+        b = leg.block or blk
+        nb = (seg + b - 1) // b
+        if leg.primitive == REDUCE_SCATTER:
+            saved += _fused.quant_hbm_saved(k, nb, b)
+            saved += _fused.dequant_hbm_saved(k, nb, b)
+        elif leg.primitive == ALL_GATHER:
+            saved += _fused.quant_hbm_saved(1, nb, b)
+    return saved
 
 
 # ---------------------------------------------------------------------------
@@ -299,6 +433,8 @@ class StepPlan:
     fusion_threshold_bytes: int
     gradient: WirePlan
     gather: Optional[WirePlan]
+    fused: bool = False
+    quantized_pod: bool = False
 
     def encode(self) -> str:
         parts = [self.gradient.encode()]
@@ -328,19 +464,27 @@ class StepPlan:
             f"overlap={_onoff(self.overlap)} "
             f"hierarchical={_onoff(self.hierarchical)} "
             f"streams={self.num_comm_streams} "
-            f"fusion_threshold={self.fusion_threshold_bytes}",
+            f"fusion_threshold={self.fusion_threshold_bytes} "
+            f"fused={_onoff(self.fused)} "
+            f"quantized_pod={_onoff(self.quantized_pod)}",
             f"{'collective':<16} {'leg':>3} {'level':<5} "
-            f"{'primitive':<14} {'wire':<10} {'ef':<3} {'stream':>6} "
-            f"{'bytes/dev':>12}",
+            f"{'primitive':<14} {'wire':<10} {'ef':<3} {'backend':<7} "
+            f"{'stream':>6} {'bytes/dev':>12}",
         ]
-        tot = {"ici": 0.0, "dcn": 0.0, "fp": 0.0}
+        tot = {"ici": 0.0, "dcn": 0.0, "pod": 0.0, "fp": 0.0,
+               "pod_fp": 0.0}
+        hbm_saved = 0.0
         for plan in self.plans:
             rows = predict_leg_bytes(plan, n, itemsize, self.mesh_shape)
+            hbm_saved += predict_fused_hbm_saved(plan, n, itemsize,
+                                                 self.mesh_shape)
             for r in rows:
                 if r["hop"] in tot:
                     tot[r["hop"]] += r["bytes"]
                 if r["hop"] == "dcn":
                     tot["fp"] += r["fp_bytes"]
+                elif r["hop"] == "pod":
+                    tot["pod_fp"] += r["fp_bytes"]
             for li, leg in enumerate(plan.legs, start=1):
                 b = sum(r["bytes"] for r in rows if r["leg"] is leg)
                 wire = leg.wire_dtype
@@ -350,14 +494,25 @@ class StepPlan:
                     f"{plan.collective:<16} {li:>3} {leg.level:<5} "
                     f"{leg.primitive:<14} {wire:<10} "
                     f"{'yes' if leg.error_feedback else '-':<3} "
+                    f"{leg.backend:<7} "
                     f"{leg.stream:>6} {int(round(b)):>12}")
         red = (tot["fp"] / tot["dcn"]) if tot["dcn"] else None
-        lines.append(
-            f"totals: ici={int(round(tot['ici']))} "
-            f"dcn={int(round(tot['dcn']))} "
-            f"dcn_fp_equiv={int(round(tot['fp']))} "
-            f"dcn_reduction={red:.2f}x" if red is not None else
-            f"totals: ici={int(round(tot['ici']))} dcn=0")
+        totline = (f"totals: ici={int(round(tot['ici']))} "
+                   f"dcn={int(round(tot['dcn']))} "
+                   f"pod={int(round(tot['pod']))}")
+        if red is not None:
+            totline += (f" dcn_fp_equiv={int(round(tot['fp']))} "
+                        f"dcn_reduction={red:.2f}x")
+        if tot["pod"]:
+            pred = tot["pod_fp"] / tot["pod"]
+            totline += (f" pod_fp_equiv={int(round(tot['pod_fp']))} "
+                        f"pod_reduction={pred:.2f}x")
+        lines.append(totline)
+        if hbm_saved:
+            lines.append(
+                f"fused: predicted hbm round-trip saved "
+                f"{int(round(hbm_saved))} bytes/dev vs unfused "
+                f"(docs/fused-kernels.md)")
         lines.append(f"encoding: {self.encode()}")
         return "\n".join(lines)
 
@@ -379,6 +534,8 @@ def describe_plan(
     mesh_shape: Optional[Tuple[int, ...]] = None,
     error_feedback: Optional[bool] = None,
     tuned_params=None,
+    fused: Optional[bool] = None,
+    quantized_pod: Optional[bool] = None,
 ) -> StepPlan:
     """Resolve today's knob combination into its :class:`StepPlan` — the
     debug view of what the gradient wire will compile to.
@@ -400,6 +557,8 @@ def describe_plan(
             num_comm_streams = tuned_params.num_comm_streams
         if quant_block is None:
             quant_block = tuned_params.quant_block
+        if fused is None:
+            fused = getattr(tuned_params, "fused", None)
     cfg = basics.config() if basics.is_initialized() else None
     if quantized is None:
         quantized = (cfg.quantized_allreduce if cfg is not None
@@ -436,6 +595,8 @@ def describe_plan(
                           else (shp[1], shp[2], shp[0]))
         else:
             mesh_shape = (1, 1)
+    fused = _resolve_fused(fused)
+    quantized_pod = _resolve_quantized_pod(quantized_pod)
     nl, nc, npod = _mesh_sizes(mesh_shape)
     # The level ladder is structural, not size-gated: a 1-host mesh still
     # derives the 2-level plan (its DCN legs lower to no-ops at size 1).
@@ -448,17 +609,18 @@ def describe_plan(
         gradient = derive_reduce_scatter(
             levels=levels, quantized=quantized, error_feedback=ef,
             block=quant_block if quantized else None, streams=streams,
-            overlap=overlap)
+            overlap=overlap, fused=fused)
         gather = derive_all_gather(
             levels=levels, quantized=quantized, error_feedback=ef,
             block=quant_block if quantized else None, streams=streams,
-            overlap=overlap)
+            overlap=overlap, fused=fused)
     else:
         gradient = derive_allreduce(
             levels=levels, quantized=quantized,
             hierarchical=hierarchical,
-            block=quant_block if quantized else None,
-            error_feedback=ef, streams=streams, overlap=overlap)
+            block=quant_block if (quantized or quantized_pod) else None,
+            error_feedback=ef, streams=streams, overlap=overlap,
+            fused=fused, quantized_pod=quantized_pod)
         gather = None
     return StepPlan(
         mesh_shape=tuple(int(v) for v in mesh_shape),
@@ -471,6 +633,8 @@ def describe_plan(
         fusion_threshold_bytes=int(fusion_threshold_bytes),
         gradient=gradient,
         gather=gather,
+        fused=bool(fused),
+        quantized_pod=bool(quantized_pod),
     )
 
 
@@ -482,15 +646,21 @@ def describe_plan(
 
 _PLAN_RE = re.compile(
     r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
-    r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)$")
+    r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)"
+    r"(?P<fused>\|pl)?$")
 
 
 def encode_tuned(params, *, quantized: bool = False) -> str:
     """Compact plan encoding of a ``TunedParams``-like knob set: gradient
-    leg order | DCN hop wire dtype | stream count | placement. E.g.
-    ``ar.tree|int8/256|s2|ovl`` or ``rs+ag.z2|fp|s1|sync``. Knob sets
+    leg order | DCN hop wire dtype | stream count | placement
+    [| kernel backend]. E.g. ``ar.tree|int8/256|s2|ovl`` or
+    ``rs+ag.z2|int8/256|s1|sync|pl`` (schema v6: the trailing ``|pl``
+    marks the fused Pallas backend on the int8 legs; absent for v5
+    readers and for every plan with no kernel-eligible leg). Knob sets
     that compile to the same wire encode identically (``hierarchical``
-    is dead under ZeRO's rs+ag split and drops out)."""
+    is dead under ZeRO's rs+ag split; ``fused`` is dead on an
+    unquantized wire — no int8 leg to back with a kernel — and both
+    drop out)."""
     stage = int(getattr(params, "zero_stage", 0) or 0)
     if stage > 0:
         grad = f"rs+ag.z{stage}"
@@ -504,7 +674,10 @@ def encode_tuned(params, *, quantized: bool = False) -> str:
     sched = "ovl" if getattr(params, "overlap", False) else "sync"
     if sched == "sync":
         streams = 1  # dead knob with overlap off: same wire, one trial
-    return f"{grad}|{wire}|s{streams}|{sched}"
+    enc = f"{grad}|{wire}|s{streams}|{sched}"
+    if quantized and getattr(params, "fused", False):
+        enc += "|pl"  # dead knob without an int8 leg: drops out above
+    return enc
 
 
 def decode_tuned(encoding: str) -> dict:
@@ -515,7 +688,8 @@ def decode_tuned(encoding: str) -> dict:
     if not m:
         raise PlanError(
             f"unparseable plan encoding {encoding!r} — expected "
-            f"'<ar.flat|ar.tree|rs+ag.zN>|<fp|int8/B>|sK|<sync|ovl>'")
+            f"'<ar.flat|ar.tree|rs+ag.zN>|<fp|int8/B>|sK|<sync|ovl>"
+            f"[|pl]'")
     grad = m.group("grad")
     out = {
         "zero_stage": int(grad[-1]) if grad.startswith("rs+ag") else 0,
@@ -523,6 +697,7 @@ def decode_tuned(encoding: str) -> dict:
         "quantized": m.group("wire") != "fp",
         "overlap": m.group("sched") == "ovl",
         "num_comm_streams": int(m.group("streams")),
+        "fused": m.group("fused") is not None,
     }
     if out["quantized"]:
         out["quant_block"] = int(m.group("wire").split("/", 1)[1])

@@ -223,6 +223,54 @@ def _main():
                    "serve throughput")
         return 0 if ok else 1
 
+    if leg == "fused":
+        # Fused compute-collective kernels (docs/fused-kernels.md):
+        # correctness is hard-gated — the fused-vs-unfused parity probe
+        # must have passed and the kernels must actually have engaged
+        # (nonzero saved HBM round-trip) — then step time gates against
+        # the trajectory's best (MINIMUM — the metric is ms/step, lower
+        # is better).
+        ok = True
+        parity = rec.get("parity") or {}
+        if not parity.get("ok"):
+            print(f"perf gate [fused]: parity probe failed "
+                  f"(max_rel_err {parity.get('max_rel_err')}) — "
+                  f"hard fail")
+            record_verdict("fused", "parity",
+                           parity.get("max_rel_err", -1),
+                           parity.get("tol", 0), tol, False)
+            ok = False
+        saved = float(rec.get("hbm_saved_bytes_per_step") or 0)
+        if saved <= 0 or int(rec.get("fused_kernel_calls") or 0) < 1:
+            print("perf gate [fused]: kernels never engaged (zero saved "
+                  "HBM bytes / zero kernel calls) — hard fail")
+            record_verdict("fused", "hbm_saved_bytes", saved, 1, tol,
+                           False)
+            ok = False
+        else:
+            record_verdict("fused", "hbm_saved_bytes", saved, 1, tol,
+                           True)
+        candidates = [
+            (src, r["value"]) for src, r in trajectory_records()
+            if r.get("metric") == rec.get("metric")
+            and r.get("platform") == rec.get("platform")
+            and isinstance(r.get("value"), (int, float))]
+        if candidates:
+            src, best = min(candidates, key=lambda c: c[1])
+            within = rec["value"] <= best / tol
+            print(f"perf gate [fused step_ms]: measured {rec['value']} "
+                  f"vs trajectory best {best} ({src}), cap "
+                  f"{best / tol:.4f} -> "
+                  f"{'OK' if within else 'REGRESSION'}")
+            record_verdict("fused", "step_ms", rec["value"], best, tol,
+                           within)
+            ok &= within
+        else:
+            print(f"perf gate [fused]: no recorded "
+                  f"{rec.get('metric')!r} in the trajectory — step time "
+                  f"not gated (pass)")
+        return 0 if ok else 1
+
     if leg.startswith("zero"):
         code = _zero_leg(rec, leg, tol)
         if code:

@@ -60,7 +60,8 @@ def load_metrics(path):
 
 def hidden_fraction(gauges):
     total = (gauges.get("comm.wire.ici_bytes", 0.0)
-             + gauges.get("comm.wire.dcn_bytes", 0.0))
+             + gauges.get("comm.wire.dcn_bytes", 0.0)
+             + gauges.get("comm.wire.pod_bytes", 0.0))
     if not total:
         return 0.0
     return gauges.get("comm.wire.overlap_bytes", 0.0) / total
@@ -94,8 +95,10 @@ def build_report(timeline_path, metrics_path):
     ici = gauges.get("comm.wire.ici_bytes", 0.0)
     dcn = gauges.get("comm.wire.dcn_bytes", 0.0)
     dcn_fp = gauges.get("comm.wire.dcn_bytes_fp", 0.0)
-    ici_gbps = float(os.environ.get("HOROVOD_BENCH_ICI_GBPS", "100"))
-    dcn_gbps = float(os.environ.get("HOROVOD_BENCH_DCN_GBPS", "25"))
+    pod = gauges.get("comm.wire.pod_bytes", 0.0)
+    from horovod_tpu.plan.accounting import bench_gbps
+
+    ici_gbps, dcn_gbps, pod_gbps = bench_gbps()
     return {
         "timeline": os.path.abspath(timeline_path),
         "metrics": os.path.abspath(metrics_path),
@@ -116,9 +119,14 @@ def build_report(timeline_path, metrics_path):
             "dcn_bytes_per_step_device": dcn,
             "dcn_bytes_fp_equiv": dcn_fp,
             "dcn_reduction": (dcn_fp / dcn) if dcn else None,
+            "pod_bytes_per_step_device": pod,
+            "fused_hbm_saved_bytes": gauges.get(
+                "comm.wire.fused_hbm_saved_bytes", 0.0),
             "modeled_wire_ms": round(
-                (ici / (ici_gbps * 1e9) + dcn / (dcn_gbps * 1e9)) * 1e3, 4),
-            "model": {"ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps},
+                (ici / (ici_gbps * 1e9) + dcn / (dcn_gbps * 1e9)
+                 + pod / (pod_gbps * 1e9)) * 1e3, 4),
+            "model": {"ici_gbps": ici_gbps, "dcn_gbps": dcn_gbps,
+                      "pod_gbps": pod_gbps},
         },
         "streamed_buckets": gauges.get("comm.wire.streamed_buckets", 0.0),
         "bucket_latency_hist": hists.get("comm.bucket.latency_us"),

@@ -19,6 +19,9 @@
 #   PERF_GATE_LEGS="zero1 zero2 zero3" scripts/perf_gate.sh
 #   PERF_GATE_LEGS="plan" scripts/perf_gate.sh  # wire-plan equivalence
 #                     matrix + quantized+zero3+overlap combined leg
+#   PERF_GATE_LEGS="fused" scripts/perf_gate.sh # fused-kernel A/B:
+#                     parity + nonzero saved-HBM hard gates, step time
+#                     vs trajectory (docs/fused-kernels.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -89,8 +92,17 @@ for leg in $LEGS; do
                 --model resnet18 --batch-size 2 --image-size 64 \
                 --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
             ;;
+        fused)
+            # Fused compute-collective kernels (docs/fused-kernels.md):
+            # the --fused A/B hard-fails itself on parity loss or
+            # never-engaged kernels; the checker re-asserts both and
+            # gates step time against the trajectory (lower is better).
+            run_leg fused --fused --zero-stage 3 --overlap \
+                --platform cpu --cpu-devices 8 --batch-size 2 \
+                --num-iters 3 --num-batches-per-iter 2
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan)" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused)" >&2
             exit 2
             ;;
     esac

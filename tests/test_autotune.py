@@ -259,17 +259,18 @@ class TestPlanSchemaV5:
         assert rows[0]["zero_stage"] == 2
         assert rows[0]["plan"] == "rs+ag.z2|fp|s1|sync"
 
-    def test_cache_entry_carries_plan_and_v5_key(self, tmp_path,
-                                                 monkeypatch):
+    def test_cache_entry_carries_plan_and_version_key(self, tmp_path,
+                                                      monkeypatch):
         from horovod_tpu.autotune import driver as at_driver
         from horovod_tpu.ops import kernel_autotune
 
         monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
                            str(tmp_path / "cache.json"))
         TestSession._reset_kernel_cache()
-        key = cache_key_for("v5-schema-probe")
+        key = cache_key_for("v6-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        assert key.endswith("|v5")
+        # v6: the fused-kernel backend knob (docs/fused-kernels.md).
+        assert key.endswith("|v6")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)

@@ -1,0 +1,763 @@
+"""Fused compute-collective Pallas kernels (docs/fused-kernels.md).
+
+Four tiers, mirroring tests/test_plan.py:
+
+* **kernel parity** — the interpret-mode Pallas kernels against the XLA
+  compositions they replace: the int8 quantize kernel's payload is
+  BIT-identical to ``ops/compression.py``'s math under jit (scales/err
+  to the last ulp of the scale division — the documented contract), the
+  ring matmul ops match their gather-then-matmul / matmul-then-scatter
+  references to float-association tolerance;
+* **wire parity matrix** — fused-vs-unfused through the PUBLIC entry
+  points across {rs-epilogue, ag-prologue, quantized} × {zero_stage
+  0/2/3, TP row-parallel}: identical wire bytes, ulp-bounded values,
+  matching EF residual activity;
+* **golden text** — ``describe_plan`` tables with the ``backend``
+  column and the predicted-HBM ``fused:`` line, pinned literally;
+* **satellites** — the quantized pod hop on the 2x2x2 mesh, the
+  per-level HOROVOD_BENCH_POD_GBPS bandwidth model, the autotuner's
+  ``fused`` dimension (schema v6) and its dead-knob canonicalization.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.ops import compression as Z
+from horovod_tpu.ops import fused_collective as F
+from horovod_tpu.plan import (DCN, ICI, INT8, PALLAS, POD, XLA, Leg,
+                              PlanError, WirePlan, decode_tuned,
+                              describe_plan, encode_tuned, planner)
+from horovod_tpu.plan.accounting import bench_gbps, _modeled_wire_ms
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _mesh_2x4():
+    hvd.shutdown()
+    hvd.init(mesh_shape=(2, 4))
+    yield
+    hvd.shutdown()
+    hvd.init()
+
+
+def mesh_2x4() -> Mesh:
+    return hvd.mesh()
+
+
+def _run(fn, in_specs, out_specs, *args):
+    return hvd.shard_map(fn, mesh=mesh_2x4(), in_specs=in_specs,
+                         out_specs=out_specs)(*args)
+
+
+# ---------------------------------------------------------------------------
+# Kernel parity: the Pallas bodies vs the XLA compositions they replace.
+# ---------------------------------------------------------------------------
+
+
+class TestKernelParity:
+    def test_quantize_kernel_bit_parity_under_jit(self):
+        rng = np.random.RandomState(0)
+        blocks = rng.randn(2, 4, 256).astype(np.float32)
+        blocks[0, 1] = 0.0  # all-zero block: scale must snap to 1.0
+
+        @jax.jit
+        def both(b):
+            scales = Z._block_scales(b)
+            q = jnp.clip(jnp.round(b / scales[..., None]),
+                         -127, 127).astype(jnp.int8)
+            err = b - q.astype(jnp.float32) * scales[..., None]
+            qp, sp, ep = F.quantize_blockwise(b)
+            return q, scales, err, qp, sp, ep
+
+        q, s, e, qp, sp, ep = both(jnp.asarray(blocks))
+        # One compiled program, one division lowering: bit-identical.
+        np.testing.assert_array_equal(np.asarray(qp), np.asarray(q))
+        np.testing.assert_array_equal(np.asarray(sp), np.asarray(s))
+        np.testing.assert_array_equal(np.asarray(ep), np.asarray(e))
+
+    def test_dequant_accumulate_bit_parity_under_jit(self):
+        rng = np.random.RandomState(1)
+        q = rng.randint(-127, 128, (4, 3, 256)).astype(np.int8)
+        s = np.abs(rng.randn(4, 3)).astype(np.float32)
+
+        @jax.jit
+        def both(q, s):
+            ref = jnp.sum(q.astype(jnp.float32) * s[..., None], axis=0)
+            return ref, F.dequantize_accumulate(q, s)
+
+        ref, got = both(jnp.asarray(q), jnp.asarray(s))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_matmul_accumulate_matches_jnp(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 512).astype(np.float32)
+        w = rng.randn(512, 8).astype(np.float32)
+        acc = rng.randn(16, 8).astype(np.float32)
+        got = jax.jit(F._matmul_accumulate)(x, w, jnp.asarray(acc))
+        np.testing.assert_allclose(np.asarray(got), acc + x @ w,
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_matmul_accumulate_k_blocking(self):
+        # HOROVOD_FUSED_BLOCK_K-style explicit K blocks: same result.
+        rng = np.random.RandomState(3)
+        x = rng.randn(8, 512).astype(np.float32)
+        w = rng.randn(512, 8).astype(np.float32)
+        z = jnp.zeros((8, 8), jnp.float32)
+        a = jax.jit(lambda x, w: F._matmul_accumulate(
+            x, w, z, block_k=128))(x, w)
+        b = jax.jit(lambda x, w: F._matmul_accumulate(
+            x, w, z, block_k=512))(x, w)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-4)
+
+    def test_hbm_model_formulas(self):
+        # ONE definition, shared by kernels / planner / bench.
+        assert F.matmul_rs_hbm_saved(64, 10, 8, 4) == \
+            2.0 * (64 - 8) * 10 * 4
+        assert F.ag_matmul_hbm_saved(64, 10, 8, 4) == \
+            2.0 * (64 - 8) * 10 * 4
+        assert F.quant_hbm_saved(2, 3, 256) == \
+            2.0 * (2 * 3 * 256 + 2 * 3 * 4)
+        assert F.dequant_hbm_saved(2, 3, 256) == 2.0 * 2 * 3 * 256 * 4
+
+
+# ---------------------------------------------------------------------------
+# Ring ops: fused matmul⇄collective vs the unfused two-op reference.
+# ---------------------------------------------------------------------------
+
+
+class TestRingOps:
+    def test_matmul_rs_epilogue_matches_reference(self):
+        rng = np.random.RandomState(0)
+        M, K, Nc = 32, 24, 16
+        X = rng.randn(N, M, K).astype(np.float32)
+        W = rng.randn(N, K, Nc).astype(np.float32)
+        spec = P(hvd.HVD_AXES)
+        got = _run(lambda xr, wr: hvd.fused_matmul_reduce_scatter(
+            xr[0], wr[0]), (spec, spec), spec, X, W)
+        # Reference: the unfused pair — full local product, then the
+        # plan-compiled reduce-scatter of its flattened rows.
+        ref = _run(lambda xr, wr: hvd.reduce_scatter(
+            (xr[0] @ wr[0]).reshape(-1),
+            op=hvd.Sum).reshape(M // N, Nc), (spec, spec), spec, X, W)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+        # and against numpy truth
+        truth = sum(X[r] @ W[r] for r in range(N))
+        np.testing.assert_allclose(np.asarray(got).reshape(M, Nc),
+                                   truth, rtol=1e-4, atol=1e-3)
+
+    def test_ag_matmul_prologue_matches_reference(self):
+        rng = np.random.RandomState(1)
+        M, K, Nc = 8, 32, 16
+        Wfull = rng.randn(K, Nc).astype(np.float32)
+        x = rng.randn(M, K).astype(np.float32)
+        wsh = Wfull.reshape(N, K // N, Nc)
+        spec = P(hvd.HVD_AXES)
+
+        def fused(w):
+            return hvd.fused_all_gather_matmul(jnp.asarray(x), w[0])[None]
+
+        def unfused(w):
+            wf = hvd.all_gather(w[0].reshape(-1)).reshape(K, Nc)
+            return (jnp.asarray(x) @ wf)[None]
+
+        got = _run(fused, (spec,), spec, wsh)
+        ref = _run(unfused, (spec,), spec, wsh)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-4)
+        for r in range(N):
+            np.testing.assert_allclose(np.asarray(got)[r], x @ Wfull,
+                                       rtol=1e-4, atol=1e-3)
+
+    def test_eager_world_of_one_is_local_matmul(self):
+        x = np.ones((4, 6), np.float32)
+        w = np.ones((6, 2), np.float32)
+        out = hvd.fused_matmul_reduce_scatter(jnp.asarray(x),
+                                              jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out), x @ w)
+        out2 = hvd.fused_all_gather_matmul(jnp.asarray(x),
+                                           jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(out2), x @ w)
+
+    def test_shape_contract_errors(self):
+        spec = P(hvd.HVD_AXES)
+        X = np.zeros((N, 9, 4), np.float32)   # 9 rows !% 8
+        W = np.zeros((N, 4, 4), np.float32)
+        with pytest.raises(ValueError, match="does not divide"):
+            _run(lambda xr, wr: hvd.fused_matmul_reduce_scatter(
+                xr[0], wr[0]), (spec, spec), spec, X, W)
+        Wsh = np.zeros((N, 3, 4), np.float32)  # 3*8 != 4 K columns
+        with pytest.raises(ValueError, match="rank-major"):
+            _run(lambda wr: hvd.fused_all_gather_matmul(
+                jnp.zeros((4, 4)), wr[0])[None], (spec,), spec, Wsh)
+
+    def test_ring_wire_accounting_matches_unfused_rs(self):
+        # The fused ring moves the unfused reduce-scatter's bytes:
+        # (n-1)/n of the payload, split ici/dcn by the host-boundary
+        # link fraction.
+        rng = np.random.RandomState(2)
+        X = rng.randn(N, 32, 8).astype(np.float32)
+        W = rng.randn(N, 8, 16).astype(np.float32)
+        spec = P(hvd.HVD_AXES)
+
+        def trace(fn):
+            with hvd.record_wire_stats() as ws:
+                jax.jit(hvd.shard_map(
+                    fn, mesh=mesh_2x4(), in_specs=(spec, spec),
+                    out_specs=spec)).lower(X, W)
+            return ws
+
+        wf = trace(lambda xr, wr: hvd.fused_matmul_reduce_scatter(
+            xr[0], wr[0]))
+        wu = trace(lambda xr, wr: hvd.reduce_scatter(
+            (xr[0] @ wr[0]).reshape(-1), op=hvd.Sum).reshape(4, 16))
+        assert wf.ici_bytes + wf.dcn_bytes == pytest.approx(
+            wu.ici_bytes + wu.dcn_bytes)
+        assert wf.fused_hbm_saved_bytes == F.matmul_rs_hbm_saved(
+            32, 16, N, 4)
+        assert wu.fused_hbm_saved_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# Wire parity matrix through the public entry points: fused == unfused
+# (ulp-bounded on int8 legs) across the knob matrix, with identical wire
+# bytes.
+# ---------------------------------------------------------------------------
+
+
+def _quant_tol(x):
+    """A couple of int8 quanta of the payload's absmax — the bound a
+    1-ulp scale difference (docs/fused-kernels.md parity contract) can
+    reach after the dequant-accumulate."""
+    return 4.0 * float(np.abs(x).max()) / 127.0
+
+
+class TestEntryPointParity:
+    @pytest.mark.parametrize("with_ef", [False, True])
+    def test_quantized_allreduce(self, with_ef):
+        rng = np.random.RandomState(1)
+        x = rng.randn(8, 1024).astype(np.float32)
+        res = (rng.randn(8, 1024).astype(np.float32) * 1e-3
+               if with_ef else None)
+        spec = P(hvd.HVD_AXES)
+
+        def leg(fused):
+            def fn(xs, rs=None):
+                if with_ef:
+                    return hvd.quantized_allreduce(xs, rs, op=hvd.Sum,
+                                                   fused=fused)
+                return hvd.allreduce(xs, op=hvd.Sum, quantized=True,
+                                     fused=fused)
+
+            if with_ef:
+                return _run(fn, (spec, spec), (P(), spec), x, res)
+            return _run(fn, (spec,), P(), x)
+
+        got, ref = leg(True), leg(False)
+        tol = _quant_tol(x.sum(axis=0))
+        if with_ef:
+            assert np.abs(np.asarray(got[0])
+                          - np.asarray(ref[0])).max() <= tol
+            # residuals bounded by one scale quantum of what was sent
+            assert np.abs(np.asarray(got[1])
+                          - np.asarray(ref[1])).max() <= tol
+        else:
+            assert np.abs(np.asarray(got)
+                          - np.asarray(ref)).max() <= tol
+
+    def test_zero_wire_rs_then_ag(self):
+        # The ZeRO gradient wire halves (stage 2/3's rs + stage 1/2's
+        # ag), fused vs unfused, through the flat bucket entry points.
+        rng = np.random.RandomState(2)
+        flat = rng.randn(N * 512).astype(np.float32)
+        xs = np.broadcast_to(flat, (N,) + flat.shape).copy()
+        spec = P(hvd.HVD_AXES)
+
+        def split(fused):
+            def fn(xrow):
+                shard = hvd.reduce_scatter(xrow[0], op=hvd.Sum,
+                                           quantized=True, fused=fused)
+                return hvd.all_gather(shard, quantized=True,
+                                      fused=fused)
+
+            return _run(fn, (spec,), P(), xs)
+
+        got, ref = split(True), split(False)
+        assert np.abs(np.asarray(got) - np.asarray(ref)).max() <= \
+            _quant_tol(flat * N)
+
+    def test_wire_bytes_identical_fused_vs_unfused(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(8, 2048).astype(np.float32)
+        spec = P(hvd.HVD_AXES)
+
+        def trace(fused):
+            with hvd.record_wire_stats() as ws:
+                jax.jit(hvd.shard_map(
+                    lambda xs: hvd.allreduce(xs, op=hvd.Sum,
+                                             quantized=True, fused=fused),
+                    mesh=mesh_2x4(), in_specs=(spec,),
+                    out_specs=P())).lower(x)
+            return ws
+
+        wf, wu = trace(True), trace(False)
+        assert wf.ici_bytes == wu.ici_bytes
+        assert wf.dcn_bytes == wu.dcn_bytes
+        assert wf.dcn_bytes_fp == wu.dcn_bytes_fp
+        assert wf.fused_hbm_saved_bytes > 0
+        assert wf.fused_calls >= 3     # quant rs, quant ag, dequant
+        assert wu.fused_hbm_saved_bytes == 0 and wu.fused_calls == 0
+
+    @pytest.mark.parametrize("stage", [0, 2, 3])
+    def test_optimizer_matrix_fused_tracks_unfused(self, stage):
+        """DistributedOptimizer(quantized=True, fused=True) trains in
+        lock-step with fused=False across the ZeRO stages: same wire,
+        kernel-lowered quant math, params within int8 quanta."""
+        def train(fused, steps=3):
+            rng = np.random.RandomState(0)
+            d = 8
+            x = rng.randn(96, d).astype(np.float32)
+            y = (x @ rng.randn(d, 1).astype(np.float32))
+            params = {"w": jnp.zeros((d, 1)), "b": jnp.zeros((1,))}
+            tpl = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+            vg = hvd.value_and_grad(
+                lambda p, b: jnp.mean((b[0] @ p["w"] + p["b"]
+                                       - b[1]) ** 2), reduce=False)
+            tx = hvd.DistributedOptimizer(
+                optax.sgd(0.1, momentum=0.9), quantized=True,
+                fused=fused,
+                zero_stage=stage if stage else None)
+            mesh = mesh_2x4()
+            if stage == 3:
+                pshards = hvd.zero3_shard_params(params)
+                pspec = hvd.zero3_param_pspecs(pshards)
+                state = tx.init(params)
+                sspec = hvd.zero_state_pspecs(state)
+                state = jax.device_put(state, jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), sspec))
+                pshards = jax.device_put(pshards, jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), pspec))
+
+                @jax.jit
+                def step(psh, s, xb, yb):
+                    def spmd(psh, s, xb, yb):
+                        pfull = hvd.zero3_gather_params(psh, tpl)
+                        loss, g = vg(pfull, (xb, yb))
+                        u, ns = tx.update(g, s, psh)
+                        return optax.apply_updates(psh, u), ns, \
+                            hvd.allreduce(loss)
+
+                    return hvd.shard_map(
+                        spmd, mesh=mesh,
+                        in_specs=(pspec, sspec, hvd.data_pspec(),
+                                  hvd.data_pspec()),
+                        out_specs=(pspec, sspec, P()))(psh, s, xb, yb)
+
+                carry = pshards
+            else:
+                state = tx.init(params)
+                if stage:
+                    sspec = hvd.zero_state_pspecs(state)
+                else:
+                    sspec = hvd.QuantizedEFState(
+                        inner=jax.tree.map(lambda _: P(), state.inner),
+                        residual=jax.tree.map(
+                            lambda _: P(hvd.HVD_AXES), state.residual))
+                state = jax.device_put(state, jax.tree.map(
+                    lambda s: NamedSharding(mesh, s), sspec))
+
+                @jax.jit
+                def step(p, s, xb, yb):
+                    def spmd(p, s, xb, yb):
+                        loss, g = vg(p, (xb, yb))
+                        u, ns = tx.update(g, s, p)
+                        return optax.apply_updates(p, u), ns, \
+                            hvd.allreduce(loss)
+
+                    return hvd.shard_map(
+                        spmd, mesh=mesh,
+                        in_specs=(P(), sspec, hvd.data_pspec(),
+                                  hvd.data_pspec()),
+                        out_specs=(P(), sspec, P()))(p, s, xb, yb)
+
+                carry = params
+            losses = []
+            bs = 16
+            for i in range(steps):
+                carry, state, loss = step(
+                    carry, state, jnp.asarray(x[i * bs:(i + 1) * bs]),
+                    jnp.asarray(y[i * bs:(i + 1) * bs]))
+                losses.append(float(loss))
+            leaves = np.concatenate([np.asarray(l).ravel()
+                                     for l in jax.tree.leaves(carry)])
+            return leaves, losses
+
+        pf, lf = train(True)
+        pu, lu = train(False)
+        # Same wire format; the fused kernels' scale division may differ
+        # in the last ulp, so the trajectories track within int8 quanta
+        # of the (small) updates, and both actually train.
+        denom = max(1e-9, float(np.abs(pu).max()))
+        assert np.abs(pf - pu).max() / denom <= 5e-2
+        assert lu[-1] < lu[0] and lf[-1] < lf[0]
+
+    def test_tp_row_parallel_psum_vs_fused_rs(self):
+        # TP row-parallel: y = sum_r x[:, K_r] @ W[K_r, :]. The fused
+        # epilogue returns rank-major row shards of the same sum.
+        rng = np.random.RandomState(4)
+        M, K, Nc = 16, 64, 8
+        x = rng.randn(M, K).astype(np.float32)
+        Wfull = rng.randn(K, Nc).astype(np.float32)
+        xs = np.stack(np.split(x, N, axis=1))          # [n, M, K/n]
+        ws = np.stack(np.split(Wfull, N, axis=0))      # [n, K/n, Nc]
+        spec = P(hvd.HVD_AXES)
+        got = _run(lambda xr, wr: hvd.fused_matmul_reduce_scatter(
+            xr[0], wr[0]), (spec, spec), spec, xs, ws)
+        ref = _run(lambda xr, wr: lax.psum(xr[0] @ wr[0],
+                                           hvd.HVD_AXES)[None],
+                   (spec, spec), spec, xs, ws)
+        np.testing.assert_allclose(
+            np.asarray(got).reshape(M, Nc),
+            np.asarray(ref)[0], rtol=1e-4, atol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# FUSED:* spans + comm.fused.* metrics.
+# ---------------------------------------------------------------------------
+
+
+class TestFusedObservability:
+    def test_fused_timeline_spans_balanced(self, tmp_path):
+        path = str(tmp_path / "tl.json")
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 1024).astype(np.float32)
+        spec = P(hvd.HVD_AXES)
+        hvd.start_timeline(path)
+        try:
+            jax.jit(hvd.shard_map(
+                lambda xs: hvd.allreduce(xs, op=hvd.Sum, quantized=True,
+                                         fused=True),
+                mesh=mesh_2x4(), in_specs=(spec,),
+                out_specs=P())).lower(x)
+        finally:
+            hvd.stop_timeline()
+        events = json.load(open(path))
+        names = {e["name"] for e in events}
+        assert any(n.startswith("FUSED:QUANT") for n in names), names
+        assert any(n.startswith("FUSED:DEQUANT") for n in names), names
+        from horovod_tpu.monitor.span_audit import audit_spans
+
+        audit = audit_spans(events, prefix="FUSED", require_spans=True)
+        assert audit.balanced
+
+    def test_comm_fused_metrics_counted(self):
+        from horovod_tpu import monitor
+
+        before = dict(monitor.snapshot()["counters"])
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 512).astype(np.float32)
+        spec = P(hvd.HVD_AXES)
+        jax.jit(hvd.shard_map(
+            lambda xs: hvd.allreduce(xs, op=hvd.Sum, quantized=True,
+                                     fused=True),
+            mesh=mesh_2x4(), in_specs=(spec,), out_specs=P())).lower(x)
+        after = monitor.snapshot()["counters"]
+
+        def delta(name):
+            return after.get(name, 0.0) - before.get(name, 0.0)
+
+        assert delta("comm.fused.calls{kind=QUANT}") >= 1
+        assert delta("comm.fused.calls{kind=DEQUANT}") >= 1
+        assert delta("comm.fused.hbm_saved_bytes{kind=QUANT}") > 0
+
+
+# ---------------------------------------------------------------------------
+# Golden text: the backend column and the predicted-HBM fused line.
+# ---------------------------------------------------------------------------
+
+GOLDEN_FUSED_QUANTIZED_2x4 = """\
+wire plan  mesh=2x4  payload=1048576B (itemsize 4)
+knobs: quantized=on block=256 zero_stage=0 overlap=off hierarchical=off streams=1 fusion_threshold=67108864 fused=on quantized_pod=off
+collective       leg level primitive      wire       ef  backend stream    bytes/dev
+allreduce          1 ici   reduce_scatter payload    -   xla          0       786432
+allreduce          2 dcn   reduce_scatter int8/256   yes pallas       0        33280
+allreduce          3 dcn   all_gather     int8/256   yes pallas       0        66560
+allreduce          4 ici   all_gather     payload    -   xla          0      1572864
+totals: ici=2359296 dcn=99840 pod=0 dcn_fp_equiv=393216 dcn_reduction=3.94x
+fused: predicted hbm round-trip saved 723968 bytes/dev vs unfused (docs/fused-kernels.md)
+encoding: allreduce:ici.reduce_scatter[payload]>dcn.reduce_scatter[int8/256+ef]@pl>dcn.all_gather[int8/256+ef]@pl>ici.all_gather[payload]|s1|sync"""
+
+GOLDEN_QUANTIZED_POD_2x2x2 = """\
+wire plan  mesh=2x2x2  payload=1048576B (itemsize 4)
+knobs: quantized=off block=256 zero_stage=0 overlap=off hierarchical=on streams=1 fusion_threshold=67108864 fused=on quantized_pod=on
+collective       leg level primitive      wire       ef  backend stream    bytes/dev
+allreduce          1 ici   reduce_scatter payload    -   xla          0       524288
+allreduce          2 dcn   psum           payload    -   xla          0       524288
+allreduce          3 pod   reduce_scatter int8/256   -   pallas       0        66560
+allreduce          4 pod   all_gather     int8/256   -   pallas       0       133120
+allreduce          5 ici   all_gather     payload    -   xla          0      1048576
+totals: ici=1572864 dcn=524288 pod=199680 dcn_fp_equiv=524288 dcn_reduction=1.00x pod_fp_equiv=786432 pod_reduction=3.94x
+fused: predicted hbm round-trip saved 1447936 bytes/dev vs unfused (docs/fused-kernels.md)
+encoding: allreduce:ici.reduce_scatter[payload]>dcn.psum[payload]>pod.reduce_scatter[int8/256]@pl>pod.all_gather[int8/256]@pl>ici.all_gather[payload]|s1|sync"""
+
+
+class TestGoldenTables:
+    def test_fused_quantized_table(self):
+        sp = describe_plan(quantized=True, mesh_shape=(2, 4), fused=True,
+                           fusion_threshold_bytes=64 * 1024 * 1024,
+                           quant_block=256)
+        assert sp.table(payload_bytes=1 << 20) == \
+            GOLDEN_FUSED_QUANTIZED_2x4
+
+    def test_quantized_pod_table(self):
+        sp = describe_plan(hierarchical=True, quantized_pod=True,
+                           fused=True, mesh_shape=(2, 2, 2),
+                           fusion_threshold_bytes=64 * 1024 * 1024,
+                           quant_block=256)
+        assert sp.table(payload_bytes=1 << 20) == \
+            GOLDEN_QUANTIZED_POD_2x2x2
+
+    def test_fused_ring_plans_validate_and_encode(self):
+        rs = planner.fused_matmul_rs_plan()
+        ag = planner.fused_ag_matmul_plan()
+        assert all(l.backend == PALLAS for l in rs.legs + ag.legs)
+        assert "@pl" in rs.encode() and "@pl" in ag.encode()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: quantized pod hop (3-level tree plans).
+# ---------------------------------------------------------------------------
+
+
+class TestQuantizedPod:
+    @pytest.fixture()
+    def mesh_2x2x2(self):
+        grid = np.array(jax.devices()[:N]).reshape(2, 2, 2)
+        return Mesh(grid, basics.ALL_AXES)
+
+    def test_validation_rejects_int8_psum(self):
+        p = WirePlan("allreduce", (
+            Leg(ICI, "reduce_scatter"), Leg(POD, "psum", INT8),
+            Leg(ICI, "all_gather")))
+        with pytest.raises(PlanError, match="not closed under addition"):
+            p.validate()
+
+    def test_validation_rejects_pallas_on_flat_and_psum(self):
+        with pytest.raises(PlanError, match="flat leg"):
+            WirePlan("allreduce",
+                     (Leg("flat", "psum", backend=PALLAS),)).validate()
+        with pytest.raises(PlanError, match="no kernel body"):
+            WirePlan("allreduce", (
+                Leg(ICI, "reduce_scatter"),
+                Leg(DCN, "psum", backend=PALLAS),
+                Leg(ICI, "all_gather"))).validate()
+        with pytest.raises(PlanError, match="unknown backend"):
+            WirePlan("allreduce",
+                     (Leg(ICI, "reduce_scatter", backend="cuda"),
+                      Leg(ICI, "all_gather"))).validate()
+
+    def test_planner_knob_builds_pod_rs_ag_pair(self):
+        sp = describe_plan(hierarchical=True, quantized_pod=True,
+                           mesh_shape=(2, 2, 2))
+        assert sp.quantized_pod
+        legs = sp.gradient.legs
+        assert [(l.level, l.primitive) for l in legs] == [
+            (ICI, "reduce_scatter"), (DCN, "psum"),
+            (POD, "reduce_scatter"), (POD, "all_gather"),
+            (ICI, "all_gather")]
+        assert legs[2].wire_dtype == INT8 and legs[3].wire_dtype == INT8
+        assert not sp.gradient.is_dcn_quantized  # routes via the tree
+
+    def test_smoke_2x2x2_numerics_and_accounting(self, mesh_2x2x2):
+        # Per-rank payload dim 0 divisible by local_size=2 AND the
+        # post-ICI shard by pod_size=2 → the quantized pod pair engages.
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 64).astype(np.float32)
+        spec = P(basics.ALL_AXES)
+        sp = describe_plan(hierarchical=True, quantized_pod=True,
+                           mesh_shape=(2, 2, 2))
+
+        def fn(xs):
+            return hvd.allreduce(xs[0], op=hvd.Sum, plan=sp.gradient)
+
+        out = hvd.shard_map(fn, mesh=mesh_2x2x2, in_specs=(spec,),
+                            out_specs=P())(x)
+        ref = x.sum(axis=0)
+        err = np.abs(np.asarray(out) - ref).max()
+        # Quantization error: bounded by quanta of the partial sums the
+        # pod hop carries — and NONZERO, proving int8 actually rode the
+        # pod links (the exact psum would be ~1e-6).
+        bound = 8.0 * np.abs(x).max() / 127.0
+        assert 1e-5 < err <= bound, err
+        with hvd.record_wire_stats() as ws:
+            jax.jit(hvd.shard_map(fn, mesh=mesh_2x2x2, in_specs=(spec,),
+                                  out_specs=P())).lower(x)
+        assert ws.pod_bytes > 0 and ws.pod_bytes_fp > 0
+        assert ws.dcn_bytes > 0 and ws.ici_bytes > 0
+
+    def test_non_divisible_pod_shard_falls_back_exact(self, mesh_2x2x2):
+        x = np.random.RandomState(1).randn(8, 7).astype(np.float32)
+        spec = P(basics.ALL_AXES)
+        sp = describe_plan(hierarchical=True, quantized_pod=True,
+                           mesh_shape=(2, 2, 2))
+        got = hvd.shard_map(
+            lambda xs: hvd.allreduce(xs, op=hvd.Sum, plan=sp.gradient),
+            mesh=mesh_2x2x2, in_specs=(spec,), out_specs=P())(x)
+        ref = hvd.shard_map(
+            lambda xs: lax.psum(xs, basics.ALL_AXES),
+            mesh=mesh_2x2x2, in_specs=(spec,), out_specs=P())(x)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_env_knob_route(self, mesh_2x2x2, monkeypatch):
+        monkeypatch.setenv("HOROVOD_QUANTIZED_POD", "1")
+        monkeypatch.setenv("HOROVOD_FUSED_KERNELS", "1")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            sp = describe_plan(hierarchical=True, mesh_shape=(2, 2, 2))
+            assert sp.quantized_pod and sp.fused
+            assert "pod.reduce_scatter[int8/256]@pl" in \
+                sp.gradient.encode()
+        finally:
+            hvd.shutdown()
+            hvd.init(mesh_shape=(2, 4))
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-level modeled bandwidths (HOROVOD_BENCH_POD_GBPS).
+# ---------------------------------------------------------------------------
+
+
+class TestPodBandwidthModel:
+    def test_pod_defaults_to_dcn(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_BENCH_POD_GBPS", raising=False)
+        monkeypatch.setenv("HOROVOD_BENCH_DCN_GBPS", "40")
+        ici, dcn, pod = bench_gbps()
+        assert dcn == 40.0 and pod == 40.0
+
+    def test_pod_knob_overrides(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BENCH_DCN_GBPS", "25")
+        monkeypatch.setenv("HOROVOD_BENCH_POD_GBPS", "5")
+        ici, dcn, pod = bench_gbps()
+        assert pod == 5.0 and dcn == 25.0
+        # modeled time: the pod term rides its own bandwidth
+        ms = _modeled_wire_ms(0.0, 0.0, 5e9)
+        assert ms == pytest.approx(1000.0)
+        assert _modeled_wire_ms(0.0, 25e9, 0.0) == pytest.approx(1000.0)
+
+    def test_wire_stats_pod_class_separate(self, monkeypatch):
+        # flat psum over a 2x2x2 mesh charges the cross-pod hop to the
+        # pod class, not dcn (the uniform-DCN assumption is gone).
+        grid = np.array(jax.devices()[:N]).reshape(2, 2, 2)
+        mesh = Mesh(grid, basics.ALL_AXES)
+        x = np.random.RandomState(0).randn(8, 64).astype(np.float32)
+        spec = P(basics.ALL_AXES)
+        with hvd.record_wire_stats() as ws:
+            jax.jit(hvd.shard_map(
+                lambda xs: hvd.allreduce(xs, op=hvd.Sum),
+                mesh=mesh, in_specs=(spec,), out_specs=P())).lower(x)
+        assert ws.pod_bytes > 0
+        assert ws.pod_bytes < ws.dcn_bytes < ws.ici_bytes
+
+
+# ---------------------------------------------------------------------------
+# Satellite: autotune `fused` dimension (schema v6).
+# ---------------------------------------------------------------------------
+
+
+class TestAutotuneFused:
+    def test_encode_decode_with_fused(self):
+        from horovod_tpu.autotune import TunedParams
+
+        p = TunedParams(quant_block=128, fused=True)
+        enc = encode_tuned(p, quantized=True)
+        assert enc.endswith("|pl")
+        d = decode_tuned(enc)
+        assert d["fused"] is True and d["quant_block"] == 128
+        # v5 strings (no |pl) stay decodable: fused defaults False.
+        d5 = decode_tuned("ar.tree|int8/256|s2|ovl")
+        assert d5["fused"] is False
+
+    def test_fused_dead_without_quantized_wire(self):
+        from horovod_tpu.autotune import TunedParams
+
+        a = encode_tuned(TunedParams(fused=True), quantized=False)
+        b = encode_tuned(TunedParams(fused=False), quantized=False)
+        assert a == b  # no int8 leg to kernel-back: same wire, one trial
+
+    def test_manager_searches_and_dedups_fused(self):
+        from horovod_tpu.autotune import ParameterManager, TunedParams
+
+        pm = ParameterManager(TunedParams(), tune_quant_block=True,
+                              tune_fused=True, warmup_samples=0,
+                              max_samples=12, seed=7)
+        while not pm.done:
+            pm.record_sample(1.0 + 0.1 * pm.samples_done)
+        tried = [p for p, _ in pm.history]
+        assert any(p.fused for p in tried), "fused never proposed"
+        assert any(not p.fused for p in tried)
+        # dedup key: same plan encoding → one trial
+        keys = [pm._unit_key(p) for p in tried]
+        assert len(keys) == len(set(keys))
+
+    def test_gate_off_never_proposes_fused(self):
+        from horovod_tpu.autotune import ParameterManager, TunedParams
+
+        pm = ParameterManager(TunedParams(), tune_quant_block=True,
+                              warmup_samples=0, max_samples=6, seed=9)
+        while not pm.done:
+            pm.record_sample(1.0)
+        assert all(not p.fused for p, _ in pm.history)
+
+    def test_csv_fused_column_round_trips(self, tmp_path):
+        from horovod_tpu.autotune import (ParameterManager, TunedParams,
+                                          read_log)
+        from horovod_tpu.autotune import parameter_manager as pm_mod
+
+        path = str(tmp_path / "v6.csv")
+        pm = ParameterManager(TunedParams(), tune_quant_block=True,
+                              tune_fused=True, warmup_samples=0,
+                              max_samples=5, log_path=path, seed=3)
+        while not pm.done:
+            pm.record_sample(2.0)
+        with open(path) as f:
+            header = f.readline().strip().split(",")
+        assert header == list(pm_mod.CSV_FIELDS)
+        assert "fused" in header
+        rows = read_log(path)
+        for row, (p, _) in zip(rows, pm.history):
+            assert row["fused"] == p.fused
+            assert row["plan"] == encode_tuned(p, quantized=True)
+
+    def test_read_log_tolerant_of_v5_csv_without_fused(self, tmp_path):
+        from horovod_tpu.autotune import read_log
+
+        path = tmp_path / "v5.csv"
+        path.write_text(
+            "sample,fusion_threshold_bytes,quant_block,"
+            "hierarchical_allreduce,zero_sharding,zero_stage,overlap,"
+            "num_comm_streams,score_steps_per_sec,plan\n"
+            "1,67108864,256,0,0,0,1,2,10.5,ar.flat|fp|s2|ovl\n")
+        rows = read_log(str(path))
+        assert rows[0]["fused"] is False
+        assert rows[0]["plan"] == "ar.flat|fp|s2|ovl"
+
+    def test_tuned_params_fused_threads_to_describe_plan(self):
+        from horovod_tpu.autotune import TunedParams
+
+        sp = describe_plan(quantized=True, mesh_shape=(2, 4),
+                           tuned_params=TunedParams(fused=True))
+        assert sp.fused
+        assert any(l.backend == PALLAS for l in sp.gradient.legs)
