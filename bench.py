@@ -87,7 +87,10 @@ def mesh_shape_str(mesh_shape):
 
 def dump_plan(args, mesh_shape):
     """``--dump-plan``: print the resolved wire plan as a table and exit
-    — no devices needed (the cost model prices the emulated mesh)."""
+    — no devices needed (the cost model prices the emulated mesh). The
+    ``model ms``/``pred ms`` columns are the predicted-vs-measured pair
+    (docs/cost-model.md): modeled bytes-at-bandwidth vs the full
+    calibrated-when-available cost model."""
     from horovod_tpu import plan as hvd_plan
 
     if mesh_shape is None:
@@ -105,7 +108,12 @@ def dump_plan(args, mesh_shape):
         hierarchical=args.quantized_pod or None,
         mesh_shape=mesh_shape,
     )
-    print(step_plan.table(payload_bytes=args.dump_plan_bytes))
+    model = hvd_plan.get_cost_model(mesh_shape=mesh_shape)
+    if model.source != "static":
+        log(f"--dump-plan: pricing with the calibrated link model "
+            f"({model.geometry})")
+    print(step_plan.table(payload_bytes=args.dump_plan_bytes,
+                          model=model))
 
 
 def metrics_snapshot(prefixes=("comm.", "step.", "optimizer.")):
@@ -641,6 +649,41 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         + (f" (fp-equiv {wire.dcn_bytes_fp / 1e6:.3f} MB, "
            f"{wire.dcn_reduction:.2f}x reduction)"
            if wire.dcn_reduction else ""))
+
+    # Cost-model drift pair (docs/cost-model.md): the analytic planner's
+    # predicted wire time for this leg's knob set vs what the traced
+    # program's accounting actually charged at the modeled bandwidths —
+    # scripts/perf_gate.sh's cost leg checks |predicted - measured|.
+    from horovod_tpu import plan as hvd_plan
+    from horovod_tpu.plan.accounting import modeled_wire_ms
+
+    wire_ms_modeled = modeled_wire_ms(wire.ici_bytes, wire.dcn_bytes,
+                                      wire.pod_bytes)
+    cost_fields = {"wire_ms_modeled": wire_ms_modeled,
+                   "wire_ms_predicted": None,
+                   "wire_ms_predicted_total": None,
+                   "cost_model": None}
+    try:
+        payload_elems = sum(int(np.prod(l.shape))
+                            for l in jax.tree.leaves(params))
+        cost_model = hvd_plan.get_cost_model()
+        step_plan = hvd_plan.describe_plan(
+            quantized=quantized, zero_stage=stage, overlap=overlap,
+            tuned_params=tuned_params)
+        step_cost = hvd_plan.price_step(
+            step_plan, model_bytes,
+            itemsize=model_bytes / max(1, payload_elems),
+            model=cost_model)
+        cost_fields.update(
+            wire_ms_predicted=step_cost.wire_ms,
+            wire_ms_predicted_total=step_cost.predicted_ms,
+            cost_model=step_cost.source)
+        log(f"wire ms/step/device: predicted {step_cost.wire_ms:.4f} "
+            f"(total {step_cost.predicted_ms:.4f} with latency+quant"
+            f"{'-overlap' if step_plan.overlap else ''}) vs modeled "
+            f"{wire_ms_modeled:.4f} [{step_cost.source} model]")
+    except Exception as e:  # pricing must never fail a measurement
+        log(f"cost-model prediction unavailable for this leg: {e}")
     # Model FLOPs for MFU. ResNets: XLA cost analysis on the compiled
     # step (analytic fallback ~4.09 GFLOP fwd/image x 3 for fwd+bwd). GPT:
     # ALWAYS the standard analytic count — 6*N matmul FLOPs/token plus the
@@ -810,8 +853,23 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
         "wire_bytes_overlap": wire.overlap_bytes,
         "comm_hidden_fraction": wire.hidden_fraction,
         "opt_state_bytes_per_rank": opt_state_bytes_per_rank,
+        **cost_fields,
         "metrics": metrics_snapshot(),
     }
+
+
+def wire_ms_fields(res):
+    """The ``wire_ms`` JSON block of one measured leg: the cost-model
+    prediction vs the trace-accounted bytes at modeled bandwidths —
+    the drift pair scripts/perf_gate.sh's cost leg checks
+    (docs/cost-model.md)."""
+    rnd = lambda v: round(v, 4) if v is not None else None  # noqa: E731
+    return {"wire_ms": {
+        "predicted": rnd(res.get("wire_ms_predicted")),
+        "predicted_total": rnd(res.get("wire_ms_predicted_total")),
+        "modeled": rnd(res.get("wire_ms_modeled")),
+        "model": res.get("cost_model"),
+    }}
 
 
 def run_stage_parity_probe(devices, mesh_shape, steps=3):
@@ -1405,8 +1463,15 @@ def run_autotune_session(args, devices, platform, mesh_shape):
 
         return step
 
-    return hvd.autotune_session(
-        make_step, cache_key=wl["params"], enabled=True)
+    result = hvd.autotune_session(
+        make_step, cache_key=wl["params"], enabled=True,
+        warm_start=args.autotune_warm_start)
+    if result.shortlist:
+        log("cost-model shortlist (docs/cost-model.md):")
+        for row in result.shortlist:
+            log(f"  {row['predicted_ms']:9.4f} ms  {row['plan']}  "
+                f"thr={row['params']['fusion_threshold_bytes'] >> 20}MiB")
+    return result
 
 
 def main():
@@ -1523,6 +1588,13 @@ def main():
                          "optimizer step and reports throughput_delta, "
                          "comm_hidden_fraction, and a "
                          "step_time_breakdown")
+    ap.add_argument("--autotune-warm-start", type=int, default=5,
+                    metavar="K",
+                    help="seed the tuning session's GP with the top-K "
+                         "cost-model-priced plans from the analytic "
+                         "shortlist (docs/cost-model.md) and shrink the "
+                         "trial budget to K+4 windows; 0 = the cold "
+                         "7-dim search")
     ap.add_argument("--autotune", action="store_true",
                     help="run the online Bayesian tuning session "
                          "(hvd.autotune_session: GP/EI over fusion "
@@ -1839,6 +1911,9 @@ def main():
             "autotune": True,
             "autotune_cache_hit": result.cache_hit,
             "autotune_samples": result.samples,
+            "autotune_warm_start": result.warm_start,
+            "shortlist": list(result.shortlist),
+            **wire_ms_fields(res_t),
             "tuned_params": tuned.as_dict(),
             "trial_history": [
                 {**p.as_dict(), "score_steps_per_sec": round(s, 4)}
@@ -2003,6 +2078,7 @@ def main():
             "wire_bytes_dcn": round(res_z["wire_bytes_dcn"], 1),
             "wire_bytes_ici_baseline": round(res_b["wire_bytes_ici"], 1),
             "wire_bytes_dcn_baseline": round(res_b["wire_bytes_dcn"], 1),
+            **wire_ms_fields(res_z),
             "metrics_snapshot": res_z["metrics"],
             **gpt_fields,
         }), flush=True)
@@ -2096,6 +2172,7 @@ def main():
             "wire_bytes_dcn": round(res_q["wire_bytes_dcn"], 1),
             "wire_bytes_dcn_baseline": round(res_b["wire_bytes_dcn"], 1),
             "wire_bytes_ici": round(res_q["wire_bytes_ici"], 1),
+            **wire_ms_fields(res_q),
             # Representation ratio on the DCN hop: the same quantized
             # traffic pattern at the payload dtype vs as int8+scales
             # (EQuARX's "~4x wire bytes" accounting).
@@ -2147,6 +2224,7 @@ def main():
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": res["chips"],
         "per_chip_batch": args.batch_size,
+        **wire_ms_fields(res),
         "metrics_snapshot": res["metrics"],
         **gpt_fields,
         **({"note": (

@@ -27,6 +27,13 @@ Tunables (the knobs that matter on TPU, ISSUE 3):
   searched only when the quantized wire is on;
 * ``hierarchical_allreduce`` — explicit ICI/DCN decomposition vs the
   flat psum XLA decomposes itself.
+
+Cost-model warm start (docs/cost-model.md): instead of cold-searching
+the 7-dim space, ``autotune_session(warm_start=K)`` asks the analytic
+planner (:func:`horovod_tpu.plan.shortlist`) to enumerate and PRICE the
+legal plan space with the calibrated per-link (bandwidth, latency,
+quant-rate) model and walks the top-K predicted plans first — the GP
+then refines an informed neighborhood in a handful of trials.
 """
 
 from .gp import GaussianProcess  # noqa: F401

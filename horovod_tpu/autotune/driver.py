@@ -42,7 +42,15 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     the plan encoding gains the trailing `|pl` segment and TunedParams
 #     the `fused` field; from_dict/load stay tolerant of v5 entries
 #     (fused defaults False, the exact pre-v6 wire).
-_CACHE_VERSION = 6
+# v7: cost-model-driven warm start (docs/cost-model.md) — the cache key
+#     carries the full geometry fingerprint (mesh shape x world x device
+#     kind, basics.mesh_geometry: a winner tuned on one chip kind never
+#     warm-starts another) and entries record the analytic predicted_ms
+#     of the frozen winner beside its measured score, so drift between
+#     the cost model and reality is auditable from the cache alone.
+#     from_dict/load stay tolerant of v6/v5 entries (the params schema
+#     is unchanged; the version segment in the key gates real reuse).
+_CACHE_VERSION = 7
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -69,6 +77,11 @@ class AutotuneResult:
     history: Tuple[Tuple[TunedParams, float], ...] = ()
     cache_hit: bool = False
     best_score: Optional[float] = None
+    # Cost-model warm start (docs/cost-model.md): how many priced seeds
+    # the session walked before the GP proposed, and the ranked
+    # shortlist rows (plan encoding + predicted_ms) they came from.
+    warm_start: int = 0
+    shortlist: Tuple[dict, ...] = ()
 
     @property
     def samples(self) -> int:
@@ -76,14 +89,17 @@ class AutotuneResult:
 
 
 def cache_key_for(tree, mesh=None) -> str:
-    """Warm-start cache key: (model-tree-hash, mesh shape, world size).
+    """Warm-start cache key: (model-tree-hash, geometry fingerprint).
 
     ``tree`` is any pytree whose *structure and leaf shapes/dtypes*
     identify the workload (pass the parameter tree); values never enter
     the hash, so a checkpoint restore keys the same as a fresh init. The
     bucket plan is a pure function of leaf order/shape/dtype
     (ops/fusion.py plan_buckets is deterministic), which is exactly what
-    makes this key sound.
+    makes this key sound. The geometry half is
+    :func:`~horovod_tpu.common.basics.mesh_geometry` — mesh shape x
+    world x device kind, shared with the link-calibration store, so a
+    winner tuned on one chip kind never warm-starts another.
     """
     import jax
 
@@ -96,13 +112,8 @@ def cache_key_for(tree, mesh=None) -> str:
             parts.append(f"{jax.numpy.shape(leaf)}:"
                          f"{jax.numpy.asarray(leaf).dtype}")
         sig = hashlib.md5("|".join(parts).encode()).hexdigest()
-    if mesh is None and basics.is_initialized():
-        mesh = basics.mesh()
-    shape = ("x".join(str(s) for s in mesh.devices.shape)
-             if mesh is not None else "nomesh")
-    world = basics.size() if basics.is_initialized() else 1
-    return f"collective_tune|{sig}|mesh{shape}|world{world}" \
-           f"|v{_CACHE_VERSION}"
+    geo = basics.mesh_geometry(mesh=mesh)
+    return f"collective_tune|{sig}|{geo}|v{_CACHE_VERSION}"
 
 
 def load_cached_params(key: str) -> Optional[TunedParams]:
@@ -120,16 +131,43 @@ def load_cached_params(key: str) -> Optional[TunedParams]:
 
 def _store_cached_params(key: str, params: TunedParams, *,
                          score: float, samples: int,
-                         quantized: bool = False) -> None:
+                         quantized: bool = False,
+                         predicted_ms: Optional[float] = None) -> None:
     from ..plan import planner as _wire_planner
     from ..ops import kernel_autotune
 
-    kernel_autotune.cache_store(key, {
+    entry = {
         "params": params.as_dict(),
         "plan": _wire_planner.encode_tuned(params, quantized=quantized),
         "score_steps_per_sec": score,
         "samples": samples,
-    })
+        "geometry": basics.mesh_geometry(),
+    }
+    if predicted_ms is not None:
+        # v7: the analytic prediction for the winner, stored beside the
+        # measured score so cost-model drift is auditable from the cache
+        # alone (docs/cost-model.md).
+        entry["predicted_ms"] = round(float(predicted_ms), 6)
+    kernel_autotune.cache_store(key, entry)
+
+
+def _priced_seeds(payload_bytes: float, k: int, *, initial: TunedParams,
+                  quantized: bool, tune_hierarchical: bool,
+                  tune_zero: bool, tune_overlap: bool,
+                  tune_fused: bool):
+    """Top-``k`` cost-model-priced candidates for this session's search
+    space (docs/cost-model.md): the planner enumerates every legal plan
+    the session's gates allow, prices them with the calibrated (or
+    static) link model, and the ranked head seeds the GP."""
+    from ..plan import calibrate as _calibrate
+    from ..plan import planner as _wire_planner
+
+    model = _calibrate.get_cost_model()
+    return _wire_planner.shortlist(
+        payload_bytes, quantized=quantized, k=k,
+        tune_hierarchical=tune_hierarchical, tune_zero=tune_zero,
+        tune_overlap=tune_overlap, tune_fused=tune_fused,
+        initial=initial, model=model)
 
 
 def _timeline_instant(name: str, args: dict) -> None:
@@ -156,6 +194,7 @@ def autotune_session(
     log_path: Optional[str] = None,
     use_cache: bool = True,
     seed: int = 0x9E3779B97F4A7C15,
+    warm_start=None,
 ) -> AutotuneResult:
     """Run an online tuning session and return the frozen winner.
 
@@ -192,10 +231,21 @@ def autotune_session(
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
-    (model, mesh, world) returns immediately with ``cache_hit=True`` and
+    (model, geometry) returns immediately with ``cache_hit=True`` and
     zero trials; a fresh session persists its winner on convergence.
     ``use_cache=False`` forces re-tuning (the winner still overwrites the
     cache entry).
+
+    ``warm_start`` (default: the ``HOROVOD_AUTOTUNE_WARM_START`` config,
+    0 = off) seeds the GP with the cost model's ranked shortlist
+    (docs/cost-model.md): an integer K derives the top-K priced
+    candidates for this session's search space (the gradient payload
+    size comes from the ``cache_key`` pytree, so pass the parameter
+    tree), or pass an explicit sequence of :class:`TunedParams`. Seeds
+    are scored FIRST, in predicted-ms order, before the GP proposes; a
+    warm-started session also shrinks its trial budget to
+    ``len(seeds) + 4`` windows unless ``max_samples`` is set explicitly
+    — the analytic shortlist replaces the cold exploration phase.
     """
     import jax
 
@@ -216,12 +266,15 @@ def autotune_session(
         warmup_samples = cfg.autotune_warmup_samples if cfg else 3
     if steps_per_sample is None:
         steps_per_sample = cfg.autotune_steps_per_sample if cfg else 10
+    explicit_max = max_samples is not None
     if max_samples is None:
         max_samples = cfg.autotune_bayes_opt_max_samples if cfg else 20
     if gp_noise is None:
         gp_noise = cfg.autotune_gaussian_process_noise if cfg else 0.8
     if log_path is None:
         log_path = cfg.autotune_log if cfg else None
+    if warm_start is None:
+        warm_start = getattr(cfg, "autotune_warm_start", 0) if cfg else 0
 
     key = cache_key_for(cache_key) if cache_key is not None else None
     if key is not None and use_cache:
@@ -237,6 +290,42 @@ def autotune_session(
                               {"key": key, **cached.as_dict()})
             return AutotuneResult(params=cached, cache_hit=True)
 
+    # Gradient payload size (for pricing) from the cache_key pytree.
+    payload_bytes = None
+    if cache_key is not None and not isinstance(cache_key, str):
+        try:
+            payload_bytes = float(sum(
+                jax.numpy.asarray(l).nbytes
+                for l in jax.tree.leaves(cache_key)))
+        except Exception:
+            payload_bytes = None
+
+    seeds = []
+    shortlist_rows = ()
+    if isinstance(warm_start, (list, tuple)):
+        seeds = list(warm_start)
+    elif warm_start and int(warm_start) > 0:
+        if payload_bytes:
+            ranked = _priced_seeds(
+                payload_bytes, int(warm_start), initial=initial,
+                quantized=bool(tune_quant_block),
+                tune_hierarchical=tune_hierarchical,
+                tune_zero=tune_zero, tune_overlap=tune_overlap,
+                tune_fused=tune_fused)
+            seeds = [pp.params for pp in ranked]
+            shortlist_rows = tuple(pp.as_dict() for pp in ranked)
+            if ranked:
+                log.warning(
+                    "horovod_tpu autotune: cost-model warm start — %d "
+                    "priced seeds for a %.1f MB payload, top %s @ "
+                    "%.4f predicted ms", len(ranked),
+                    payload_bytes / 1e6, ranked[0].plan.encode(),
+                    ranked[0].predicted_ms)
+        else:
+            log.warning(
+                "horovod_tpu autotune: warm_start=%s requested but "
+                "cache_key is not a pytree (no payload size to price) "
+                "— falling back to the cold search", warm_start)
     pm = ParameterManager(
         initial,
         tune_quant_block=tune_quant_block,
@@ -250,14 +339,23 @@ def autotune_session(
         gp_noise=gp_noise,
         log_path=log_path,
         seed=seed,
+        seeds=seeds,
     )
+    if pm.seeded and not explicit_max:
+        # The priced shortlist replaces the cold exploration phase: the
+        # budget is the (deduplicated) seeds plus a handful of GP
+        # refinements.
+        pm.max_samples = min(pm.max_samples, pm.seeded + 4)
+        max_samples = pm.max_samples
     log.warning(
         "horovod_tpu autotune: tuning session started (%d warmup + up to "
         "%d scored windows of %d steps; each new configuration is a "
-        "recompile)", warmup_samples, max_samples, steps_per_sample)
+        "recompile%s)", warmup_samples, max_samples, steps_per_sample,
+        f"; {pm.seeded} cost-model seeds" if pm.seeded else "")
     _timeline_instant("AUTOTUNE:SESSION_START", {
         "warmup_samples": warmup_samples, "max_samples": max_samples,
-        "steps_per_sample": steps_per_sample})
+        "steps_per_sample": steps_per_sample,
+        "warm_start_seeds": pm.seeded})
 
     built: Optional[Tuple[TunedParams, Callable[[], object]]] = None
     while not pm.done:
@@ -307,8 +405,26 @@ def autotune_session(
         "(%.3f steps/sec)", pm.samples_done, best.fusion_threshold_bytes,
         best.quant_block, best.hierarchical_allreduce, pm.best_score)
     if key is not None:
+        predicted_ms = None
+        if payload_bytes:
+            try:
+                from ..plan import calibrate as _calibrate
+                from ..plan import cost as _cost
+                from ..plan import planner as _wire_planner
+
+                sp = _wire_planner.describe_plan(
+                    tuned_params=best, quantized=bool(tune_quant_block),
+                    quantized_pod=False)
+                predicted_ms = _cost.price_step(
+                    sp, payload_bytes,
+                    model=_calibrate.get_cost_model()).predicted_ms
+            except Exception:  # pricing must never fail the session
+                predicted_ms = None
         _store_cached_params(key, best, score=pm.best_score,
                              samples=pm.samples_done,
-                             quantized=bool(tune_quant_block))
+                             quantized=bool(tune_quant_block),
+                             predicted_ms=predicted_ms)
     return AutotuneResult(params=best, history=tuple(pm.history),
-                          best_score=pm.best_score)
+                          best_score=pm.best_score,
+                          warm_start=pm.seeded,
+                          shortlist=shortlist_rows)

@@ -94,6 +94,34 @@ class GaussianProcess:
         pdf = math.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
         return imp * cdf + sigma * pdf
 
+    def predict_batch(self, xs) -> Tuple[np.ndarray, np.ndarray]:
+        """Posterior (means, stddevs) at ``xs [m, dims]`` in one shot —
+        the EI argmax over the proposal candidates runs O(m) python
+        triangular solves otherwise (m is 1000 per proposal round)."""
+        if not self.fitted:
+            raise RuntimeError("predict_batch() before a successful fit()")
+        xs = np.atleast_2d(np.asarray(xs, dtype=np.float64))
+        kstar = self.kernel(xs, self._x)            # [m, n]
+        means = kstar @ self._alpha
+        # V = L^-1 K*^T, column per candidate; var = 1 - ||v||^2.
+        v = np.linalg.solve(self._l, kstar.T)       # [n, m]
+        var = 1.0 - np.sum(v * v, axis=0)
+        return means, np.sqrt(np.maximum(var, 0.0))
+
+    def expected_improvement_batch(self, xs, best_y: float,
+                                   xi: float = 0.0) -> np.ndarray:
+        """Vectorized :meth:`expected_improvement` over rows of ``xs``."""
+        mu, sigma = self.predict_batch(xs)
+        imp = mu - best_y - xi
+        with np.errstate(divide="ignore", invalid="ignore"):
+            z = np.where(sigma > 1e-12, imp / np.maximum(sigma, 1e-300),
+                         0.0)
+        cdf = 0.5 * np.array([math.erfc(-zz / math.sqrt(2.0))
+                              for zz in z])
+        pdf = np.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+        ei = imp * cdf + sigma * pdf
+        return np.where(sigma > 1e-12, ei, 0.0)
+
 
 def _solve_lower(l: np.ndarray, b: np.ndarray) -> np.ndarray:
     """Forward substitution L z = b (L lower triangular)."""

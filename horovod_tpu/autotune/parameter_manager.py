@@ -36,7 +36,7 @@ import dataclasses
 import logging
 import math
 import os
-from typing import IO, List, Optional, Tuple
+from typing import IO, List, Optional, Sequence, Tuple
 
 from ..plan import planner as _wire_planner
 from .gp import GaussianProcess
@@ -195,6 +195,7 @@ class ParameterManager:
         gp_noise: float = 0.8,
         log_path: Optional[str] = None,
         seed: int = 0x9E3779B97F4A7C15,
+        seeds: Sequence[TunedParams] = (),
     ) -> None:
         if max_samples < 1:
             raise ValueError("max_samples must be >= 1")
@@ -227,6 +228,20 @@ class ParameterManager:
         self._warmups_done = 0
         self._rng = _XorShift(seed)
         self._tried = {self._unit_key(initial)}
+        # Warm-start seeds (docs/cost-model.md): the cost model's ranked
+        # shortlist, walked IN ORDER before the GP proposes — the first
+        # scored trials are the analytically best-priced plans, so the
+        # GP fits an informed neighborhood instead of random exploration.
+        self._seed_queue: List[TunedParams] = []
+        seen_seeds = set(self._tried)
+        for s in seeds:
+            c = self._canonicalize(s)
+            k = self._unit_key(c)
+            if k in seen_seeds:
+                continue
+            seen_seeds.add(k)
+            self._seed_queue.append(c)
+        self.seeded = len(self._seed_queue)
         self._log: Optional[IO[str]] = None
         self._csv = None
         if log_path:
@@ -397,9 +412,18 @@ class ParameterManager:
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
-        """EI-argmax over random candidates once the GP fits; random
-        exploration before that (parameter_manager.cc:88-137). Prefers
-        configurations not yet tried (each repeat costs a recompile)."""
+        """Warm-start seeds first (the cost model's ranked shortlist,
+        in predicted-ms order); then EI-argmax over random candidates
+        once the GP fits, random exploration before that
+        (parameter_manager.cc:88-137). Prefers configurations not yet
+        tried (each repeat costs a recompile)."""
+        while self._seed_queue:
+            cand = self._seed_queue.pop(0)
+            key = self._unit_key(cand)
+            if key in self._tried:
+                continue  # a prior trial already covered this plan
+            self._tried.add(key)
+            return cand
         xs = [self._to_unit(p) for p, _ in self.history]
         ys = [s for _, s in self.history]
         # Normalize scores to zero-mean/unit-variance for the GP.
@@ -412,13 +436,21 @@ class ParameterManager:
 
         # EI-argmax among candidates snapping to an untried configuration;
         # if every candidate collapses onto tried points (degenerate
-        # space), take the overall argmax.
+        # space), take the overall argmax. With a fitted GP, EI
+        # evaluates in one batched predict (gp.predict_batch) over the
+        # 1000-candidate pool; unfitted, each candidate draws its
+        # random score right after its coordinates (the original
+        # interleaved order, so replay seeds keep their trajectories).
+        cands, eis = [], []
+        for _ in range(1000 if fitted else 64):
+            cands.append(self._sample_unit())
+            if not fitted:
+                eis.append(self._rng.next())
+        if fitted:
+            eis = gp.expected_improvement_batch(cands, best_n)
         new_x, new_ei = None, -1.0
         any_x, any_ei = None, -1.0
-        for _ in range(1000 if fitted else 64):
-            cand = self._sample_unit()
-            ei = (gp.expected_improvement(cand, best_n) if fitted
-                  else self._rng.next())
+        for cand, ei in zip(cands, eis):
             if any_x is None or ei > any_ei:
                 any_x, any_ei = cand, ei
             if ei > new_ei and \

@@ -517,6 +517,41 @@ def pod_size() -> int:
     return 1
 
 
+def mesh_geometry(mesh_shape=None, mesh=None) -> str:
+    """Geometry fingerprint ``mesh<CxL[xP]>|world<N>|<device-kind>``.
+
+    Keys every geometry-bound persisted artifact — the autotune
+    warm-start cache entries and the link-calibration store
+    (docs/cost-model.md): a tuned winner or a calibrated (bandwidth,
+    latency, quant-rate) triple only transfers to an identical topology
+    on the same chip kind. ``mesh_shape`` is ``(cross, local[, pods])``;
+    with neither argument the live mesh is used (``nomesh`` before
+    init)."""
+    if mesh is None and mesh_shape is None and is_initialized():
+        mesh = _state.mesh
+    if mesh is not None and mesh_shape is None:
+        shp = mesh.devices.shape
+        mesh_shape = (tuple(int(v) for v in shp) if len(shp) == 2
+                      else (int(shp[1]), int(shp[2]), int(shp[0])))
+    if mesh_shape:
+        shape = "x".join(str(int(v)) for v in mesh_shape)
+        world = 1
+        for v in mesh_shape:
+            world *= int(v)
+    else:
+        shape = "nomesh"
+        world = size() if is_initialized() else 1
+    try:
+        devs = (list(mesh.devices.ravel()) if mesh is not None
+                else jax.devices())
+        kind = getattr(devs[0], "device_kind", "unknown") if devs \
+            else "unknown"
+    except Exception:  # pragma: no cover - backendless processes
+        kind = "unknown"
+    kind = str(kind or "unknown").strip().lower().replace(" ", "-")
+    return f"mesh{shape}|world{world}|{kind}"
+
+
 def rank():
     """Global rank. Traced per-chip inside shard_map; process rank in eager
     code. Reference: horovod_rank (operations.cc:771)."""

@@ -99,6 +99,14 @@ class Config:
     autotune_steps_per_sample: int = 10
     autotune_bayes_opt_max_samples: int = 20
     autotune_gaussian_process_noise: float = 0.8
+    # Cost-model warm start (docs/cost-model.md): seed the GP with the
+    # top-K analytically priced plans (0 = cold search).
+    autotune_warm_start: int = 0
+
+    # --- link-class calibration store (docs/cost-model.md): the
+    #     microbenchmark-fitted (bandwidth, latency, quant-rate) triples,
+    #     kept beside the autotune cache by default ---
+    calibration_cache: Optional[str] = None
 
     # --- timeline (operations.cc:420-434) ---
     timeline: Optional[str] = None
@@ -166,6 +174,8 @@ def from_env() -> Config:
         autotune_gaussian_process_noise=_env_float(
             "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", 0.8
         ),
+        autotune_warm_start=_env_int("HOROVOD_AUTOTUNE_WARM_START", 0),
+        calibration_cache=_env_str("HOROVOD_CALIBRATION_CACHE", None),
         timeline=_env_str("HOROVOD_TIMELINE", None),
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
         metrics_jsonl=_env_str("HOROVOD_METRICS_JSONL", None),

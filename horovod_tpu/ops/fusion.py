@@ -359,6 +359,11 @@ def allreduce_pytree(
             hierarchical = tuned_params.hierarchical_allreduce
         if block is None:
             block = tuned_params.quant_block
+        if fused is None:
+            # Same resolution DistributedOptimizer applies: the tuned
+            # kernel-backend knob steers the wire wherever the caller
+            # left it unset (docs/fused-kernels.md).
+            fused = getattr(tuned_params, "fused", None)
     leaves, treedef = jax.tree.flatten(tree)
     if error_feedback is not None:
         quantized = True if quantized is None else quantized

@@ -19,6 +19,15 @@ Every public collective (``hvd.allreduce`` / ``reduce_scatter`` /
 ``all_gather`` and their ``*_stream`` variants) routes through this
 compiler; the bespoke hand-composed paths it replaced live on only as
 leg lowering rules in :mod:`~horovod_tpu.plan.compiler`.
+
+The plan space is also a **priced design space** (docs/cost-model.md):
+:mod:`~horovod_tpu.plan.cost` gives every link class a calibrated
+``(bandwidth, latency, quant-rate)`` triple
+(:mod:`~horovod_tpu.plan.calibrate` measures them with a
+microbenchmark sweep stored beside the autotune cache) and prices any
+validated plan analytically; :func:`shortlist` enumerates + prices the
+legal plan space for a knob set into the ranked candidate list the GP
+autotuner warm-starts from (``autotune_session(warm_start=K)``).
 """
 
 from .ir import (  # noqa: F401
@@ -43,9 +52,11 @@ from .accounting import (  # noqa: F401
     WireStats,
     bench_gbps,
     fused_span,
+    modeled_wire_ms,
     record_wire_stats,
 )
 from .planner import (  # noqa: F401
+    PricedPlan,
     StepPlan,
     decode_tuned,
     derive_all_gather,
@@ -53,14 +64,30 @@ from .planner import (  # noqa: F401
     derive_reduce_scatter,
     describe_plan,
     encode_tuned,
+    enumerate_tuned,
     flat_plan,
     fused_ag_matmul_plan,
     fused_matmul_rs_plan,
     predict_fused_hbm_saved,
     predict_leg_bytes,
     quantized_allreduce_plan,
+    shortlist,
     tree_allreduce_plan,
     zero_all_gather_plan,
     zero_reduce_scatter_plan,
+)
+from .cost import (  # noqa: F401
+    CostModel,
+    LinkClass,
+    PlanCost,
+    StepCost,
+    price_plan,
+    price_step,
+)
+from .calibrate import (  # noqa: F401
+    Calibration,
+    calibrate_links,
+    get_cost_model,
+    load_calibration,
 )
 from . import compiler  # noqa: F401

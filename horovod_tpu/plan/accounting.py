@@ -148,16 +148,23 @@ def bench_gbps() -> tuple:
     return ici, dcn, pod
 
 
-def _modeled_wire_ms(ici_bytes: float, dcn_bytes: float,
-                     pod_bytes: float = 0.0) -> float:
+def modeled_wire_ms(ici_bytes: float, dcn_bytes: float,
+                    pod_bytes: float = 0.0) -> float:
     """Modeled transfer time of a payload at the bench's (env-overridable)
     link bandwidths — the same HOROVOD_BENCH_ICI_GBPS/DCN_GBPS/POD_GBPS
     model behind bench.py's step_time_breakdown. On the compiled path this
     is the only per-bucket latency that exists at trace time (XLA owns the
-    runtime schedule); the eager path measures wall time instead."""
+    runtime schedule); the eager path measures wall time instead. Applied
+    to a :class:`WireStats` record this is the "measured" side of the
+    cost-model drift gate (docs/cost-model.md): what the traced program's
+    actual wire bytes cost at the modeled bandwidths."""
     ici, dcn, pod = bench_gbps()
     return (ici_bytes / (ici * 1e9) + dcn_bytes / (dcn * 1e9)
             + pod_bytes / (pod * 1e9)) * 1e3
+
+
+# Back-compat private alias (pre-cost-model spelling).
+_modeled_wire_ms = modeled_wire_ms
 
 
 @contextlib.contextmanager
@@ -189,8 +196,8 @@ def overlap_stream(kind: str, bucket_id):
             # µs, not ms: the log2 buckets need the resolution (a small
             # bucket's modeled transfer is far under a millisecond).
             r.histogram("comm.bucket.latency_us").observe(
-                _modeled_wire_ms(own.ici_bytes, own.dcn_bytes,
-                                 own.pod_bytes) * 1e3)
+                modeled_wire_ms(own.ici_bytes, own.dcn_bytes,
+                                own.pod_bytes) * 1e3)
         if tl is not None:
             tl.end(tid, activity)
 

@@ -59,6 +59,11 @@ BF16 = "bf16"
 INT8 = "int8"
 WIRE_DTYPES = (PAYLOAD, BF16, INT8)
 
+# Link class each per-level leg is charged to by the accounting and the
+# cost model (docs/cost-model.md). The flat leg decomposes into all of
+# them — its accounting/pricing rows carry the hop explicitly.
+LEVEL_HOP = {ICI: "ici", DCN: "dcn", POD: "pod"}
+
 # Leg backends. ``xla`` lowers through the stock jax primitives; ``pallas``
 # lowers the leg's local compute (blockwise quantize/dequant-accumulate,
 # matmul prologue/epilogue tiles) through the fused Pallas TPU kernels of

@@ -449,11 +449,22 @@ class StepPlan:
                 else (self.gradient, self.gather))
 
     def table(self, payload_bytes: int = 4 * 1024 * 1024,
-              itemsize: int = 4) -> str:
+              itemsize: int = 4, model=None) -> str:
         """Render the step plan as a fixed-width text table (legs, hops,
-        wire dtypes, streams, predicted per-device wire bytes for a
-        ``payload_bytes`` gradient payload) — the ``--dump-plan`` /
-        golden-test format."""
+        wire dtypes, streams, predicted per-device wire bytes AND
+        predicted milliseconds for a ``payload_bytes`` gradient payload)
+        — the ``--dump-plan`` / golden-test format.
+
+        The ``model ms`` column is the pure bytes-at-modeled-bandwidth
+        number (the trace-time WireStats model, HOROVOD_BENCH_*_GBPS);
+        ``pred ms`` adds the cost model's launch-latency and
+        quantize-kernel terms (docs/cost-model.md). ``model`` is a
+        :class:`~horovod_tpu.plan.cost.CostModel` (default: the static
+        env triples, so golden text stays deterministic; ``--dump-plan``
+        passes the calibrated model when one is stored)."""
+        from . import cost as _cost
+
+        model = model or _cost.CostModel.from_env()
         n = payload_bytes // itemsize
         mesh = "x".join(str(v) for v in self.mesh_shape)
         lines = [
@@ -469,13 +480,16 @@ class StepPlan:
             f"quantized_pod={_onoff(self.quantized_pod)}",
             f"{'collective':<16} {'leg':>3} {'level':<5} "
             f"{'primitive':<14} {'wire':<10} {'ef':<3} {'backend':<7} "
-            f"{'stream':>6} {'bytes/dev':>12}",
+            f"{'stream':>6} {'bytes/dev':>12} {'model ms':>9} "
+            f"{'pred ms':>8}",
         ]
         tot = {"ici": 0.0, "dcn": 0.0, "pod": 0.0, "fp": 0.0,
                "pod_fp": 0.0}
         hbm_saved = 0.0
         for plan in self.plans:
             rows = predict_leg_bytes(plan, n, itemsize, self.mesh_shape)
+            plan_cost = _cost.price_plan(plan, n, itemsize,
+                                         self.mesh_shape, model)
             hbm_saved += predict_fused_hbm_saved(plan, n, itemsize,
                                                  self.mesh_shape)
             for r in rows:
@@ -487,6 +501,7 @@ class StepPlan:
                     tot["pod_fp"] += r["fp_bytes"]
             for li, leg in enumerate(plan.legs, start=1):
                 b = sum(r["bytes"] for r in rows if r["leg"] is leg)
+                modeled_ms, pred_ms = plan_cost.by_leg(leg)
                 wire = leg.wire_dtype
                 if leg.wire_dtype == INT8:
                     wire = f"int8/{leg.block or self.quant_block}"
@@ -495,7 +510,8 @@ class StepPlan:
                     f"{leg.primitive:<14} {wire:<10} "
                     f"{'yes' if leg.error_feedback else '-':<3} "
                     f"{leg.backend:<7} "
-                    f"{leg.stream:>6} {int(round(b)):>12}")
+                    f"{leg.stream:>6} {int(round(b)):>12} "
+                    f"{modeled_ms:>9.4f} {pred_ms:>8.4f}")
         red = (tot["fp"] / tot["dcn"]) if tot["dcn"] else None
         totline = (f"totals: ici={int(round(tot['ici']))} "
                    f"dcn={int(round(tot['dcn']))} "
@@ -513,6 +529,15 @@ class StepPlan:
                 f"fused: predicted hbm round-trip saved "
                 f"{int(round(hbm_saved))} bytes/dev vs unfused "
                 f"(docs/fused-kernels.md)")
+        sc = _cost.price_step(self, payload_bytes, itemsize=itemsize,
+                              mesh_shape=self.mesh_shape, model=model)
+        lines.append(
+            f"predicted: {sc.predicted_ms:.4f} ms step wire = bytes "
+            f"{sc.wire_ms:.4f} + latency {sc.alpha_ms:.4f} + quant "
+            f"{sc.quant_ms:.4f} - hidden {sc.hidden_ms:.4f} "
+            f"(modeled {sc.modeled_ms:.4f} ms, {sc.buckets} bucket"
+            f"{'s' if sc.buckets != 1 else ''}) "
+            f"[cost model: {model.source}]")
         lines.append(f"encoding: {self.encode()}")
         return "\n".join(lines)
 
@@ -678,6 +703,168 @@ def encode_tuned(params, *, quantized: bool = False) -> str:
     if quantized and getattr(params, "fused", False):
         enc += "|pl"  # dead knob without an int8 leg: drops out above
     return enc
+
+
+# ---------------------------------------------------------------------------
+# Plan-space enumeration + analytic shortlist (docs/cost-model.md): the
+# legal plan space of a knob set, priced by the cost model into a ranked
+# shortlist the GP autotuner warm-starts from.
+# ---------------------------------------------------------------------------
+
+# Fusion-threshold candidates: small enough that the alpha term prices
+# bucketing, large enough to span the search box (1-256 MiB, log-space).
+_DEFAULT_THRESHOLDS = (4 * 1024 * 1024, 16 * 1024 * 1024,
+                       64 * 1024 * 1024)
+_DEFAULT_BLOCKS = (128, 256, 512)
+
+
+@dataclasses.dataclass(frozen=True)
+class PricedPlan:
+    """One shortlist row: a knob setting (``params`` is an
+    ``autotune.TunedParams``), the :class:`StepPlan` it derives, and its
+    :class:`~horovod_tpu.plan.cost.StepCost`."""
+
+    params: object
+    plan: StepPlan
+    cost: object
+
+    @property
+    def predicted_ms(self) -> float:
+        return self.cost.predicted_ms
+
+    def as_dict(self) -> dict:
+        return {"plan": self.plan.encode(),
+                "predicted_ms": round(self.cost.predicted_ms, 6),
+                "modeled_ms": round(self.cost.modeled_ms, 6),
+                "params": self.params.as_dict()}
+
+
+def enumerate_tuned(*, quantized: bool = False,
+                    tune_hierarchical: bool = True,
+                    tune_zero: bool = False,
+                    tune_overlap: bool = False,
+                    tune_fused: bool = False,
+                    initial=None,
+                    thresholds=None,
+                    blocks=None) -> list:
+    """Enumerate the legal knob space of one tuning session as
+    ``TunedParams`` candidates: leg order (flat/tree vs the ZeRO rs+ag
+    split) x DCN wire dtype scale block x stream split x fused backend x
+    fusion threshold — gated exactly like the autotuner's search
+    dimensions (a knob the session's step cannot accept is pinned to the
+    initial value), deduplicated on the canonical plan encoding so knob
+    sets that compile to the same wire appear once."""
+    from ..autotune.parameter_manager import TunedParams
+
+    if initial is None:
+        initial = TunedParams()
+    thr_opts = sorted(
+        {int(t) for t in (thresholds or _DEFAULT_THRESHOLDS)}
+        | {int(initial.fusion_threshold_bytes)})
+    blk_opts = (sorted({int(b) for b in (blocks or _DEFAULT_BLOCKS)}
+                       | {int(initial.quant_block)})
+                if quantized else (int(initial.quant_block),))
+    stage_opts = (0, 1, 2) if tune_zero else (initial.zero_stage,)
+    out, seen = [], set()
+    for thr in thr_opts:
+        for blk in blk_opts:
+            for stage in stage_opts:
+                if stage == 0:
+                    hier_opts = ((False, True) if tune_hierarchical
+                                 else (initial.hierarchical_allreduce,))
+                else:
+                    hier_opts = (False,)  # dead under the rs+ag split
+                for hier in hier_opts:
+                    ovl_opts = ((False, True) if tune_overlap
+                                else (bool(initial.overlap),))
+                    for ovl in ovl_opts:
+                        if not ovl:
+                            stream_opts = (1,)
+                        elif tune_overlap:
+                            stream_opts = (1, 2, 4)
+                        else:
+                            stream_opts = (
+                                max(1, initial.num_comm_streams),)
+                        for s in stream_opts:
+                            fz_opts = ((False, True)
+                                       if tune_fused and quantized
+                                       else (initial.fused
+                                             if quantized else False,))
+                            for fz in fz_opts:
+                                p = TunedParams(
+                                    fusion_threshold_bytes=thr,
+                                    quant_block=blk,
+                                    hierarchical_allreduce=hier,
+                                    zero_stage=stage,
+                                    overlap=ovl,
+                                    num_comm_streams=s,
+                                    fused=fz)
+                                key = (thr, blk, encode_tuned(
+                                    p, quantized=quantized))
+                                if key in seen:
+                                    continue
+                                seen.add(key)
+                                out.append(p)
+    return out
+
+
+def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
+              mesh_shape=None, model=None, compute_ms=None,
+              quantized: bool = False, k: Optional[int] = None,
+              tune_hierarchical: bool = True, tune_zero: bool = False,
+              tune_overlap: bool = False, tune_fused: bool = False,
+              initial=None, thresholds=None, blocks=None) -> list:
+    """Enumerate, validate, and PRICE the legal plan space for a knob
+    set, returning :class:`PricedPlan` rows ranked by predicted step-
+    wire milliseconds (ties broken by the stable plan encoding).
+
+    Every candidate is filtered through ``WirePlan.validate`` (via
+    :func:`describe_plan`'s constructors); ``model`` defaults to the
+    calibrated cost model when a matching-geometry sweep is stored,
+    else the static env triples (:func:`horovod_tpu.plan.cost.resolve`).
+    ``k`` truncates to the top-K (None = the full ranked space) — the
+    autotuner's warm-start seeds (docs/cost-model.md)."""
+    from . import cost as _cost
+
+    if mesh_shape is None:
+        if basics.is_initialized() and basics.mesh() is not None:
+            shp = basics.mesh().devices.shape
+            mesh_shape = (tuple(shp) if len(shp) == 2
+                          else (shp[1], shp[2], shp[0]))
+        else:
+            mesh_shape = (1, 1)
+    model = model or _cost.resolve(mesh_shape)
+    priced = []
+    seen = set()
+    for p in enumerate_tuned(quantized=quantized,
+                             tune_hierarchical=tune_hierarchical,
+                             tune_zero=tune_zero,
+                             tune_overlap=tune_overlap,
+                             tune_fused=tune_fused, initial=initial,
+                             thresholds=thresholds, blocks=blocks):
+        try:
+            sp = describe_plan(tuned_params=p, quantized=quantized,
+                               mesh_shape=mesh_shape,
+                               quantized_pod=False)
+        except PlanError:
+            continue  # illegal composition: not a candidate
+        # Dedup on the DERIVED wire (plus the threshold and ZeRO
+        # stage, which the encoding does not carry — stages 1/2 share a
+        # wire but restructure the accumulator): knobs dead in this
+        # knob set's derivation (e.g. hierarchical under a quantized
+        # 2-level wire) must not spend two shortlist rows on one
+        # compiled program.
+        key = (sp.encode(), int(p.fusion_threshold_bytes),
+               int(p.zero_stage))
+        if key in seen:
+            continue
+        seen.add(key)
+        sc = _cost.price_step(sp, payload_bytes, itemsize=itemsize,
+                              mesh_shape=mesh_shape, model=model,
+                              compute_ms=compute_ms)
+        priced.append(PricedPlan(p, sp, sc))
+    priced.sort(key=lambda pp: (pp.predicted_ms, pp.plan.encode()))
+    return priced[:k] if k else priced
 
 
 def decode_tuned(encoding: str) -> dict:

@@ -24,6 +24,7 @@ _ARG_ENV = {
     "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
     "autotune_bayes_opt_max_samples": "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES",
     "autotune_gaussian_process_noise": "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE",
+    "autotune_warm_start": "HOROVOD_AUTOTUNE_WARM_START",
     "timeline_filename": "HOROVOD_TIMELINE",
     "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
     "no_stall_check": "HOROVOD_STALL_CHECK_DISABLE",
@@ -86,6 +87,7 @@ def parse_config_file(path: str, args) -> None:
            autotune.get("bayes-opt-max-samples"))
     _maybe("autotune_gaussian_process_noise",
            autotune.get("gaussian-process-noise"))
+    _maybe("autotune_warm_start", autotune.get("warm-start"))
     stall = config.get("stall-check", {})
     if stall.get("enabled") is False:
         args.no_stall_check = True

@@ -78,6 +78,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                           dest="autotune_bayes_opt_max_samples")
     autotune.add_argument("--autotune-gaussian-process-noise", type=float,
                           dest="autotune_gaussian_process_noise")
+    autotune.add_argument("--autotune-warm-start", type=int,
+                          dest="autotune_warm_start",
+                          help="seed the GP with the top-K cost-model-"
+                               "priced plans (docs/cost-model.md); "
+                               "0 = cold search")
 
     timeline = parser.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", dest="timeline_filename")

@@ -7,6 +7,10 @@ correctness assertions (no dropped requests, parity probe present).
 Train legs: compares against the best SAME-platform, same-metric value
 recorded in the ``BENCH_r*.json`` trajectory (each of those wraps the
 bench's one-line JSON under ``parsed`` or inside ``tail``).
+cost leg (``--quantized`` A/B): gates the cost model's predicted
+wire-ms against the traced program's accounted bytes at the modeled
+bandwidths — |predicted − measured| / measured ≤ PERF_GATE_COST_DRIFT
+(default 0.25, docs/cost-model.md) — then throughput like a train leg.
 zero<stage> legs (``--zero-stage`` A/B): structural memory gates against
 the replicated baseline measured in the SAME run — each component the
 stage claims to shard must be within PERF_GATE_ZERO_SLACK (default 1.30,
@@ -270,6 +274,34 @@ def _main():
                   f"{rec.get('metric')!r} in the trajectory — step time "
                   f"not gated (pass)")
         return 0 if ok else 1
+
+    if leg == "cost":
+        # Cost-model drift gate (docs/cost-model.md): the analytic
+        # planner's predicted wire-ms for this leg's knob set must stay
+        # within PERF_GATE_COST_DRIFT (relative) of the measured side —
+        # the traced program's actual wire bytes at the modeled
+        # bandwidths. Drift means the byte model diverged from what the
+        # compiler charges (a planner/accounting regression).
+        wm = rec.get("wire_ms") or {}
+        pred, mod = wm.get("predicted"), wm.get("modeled")
+        drift_tol = float(os.environ.get("PERF_GATE_COST_DRIFT", "0.25"))
+        if pred is None or mod is None or mod <= 0:
+            print(f"perf gate [cost]: record lacks the wire_ms "
+                  f"predicted/modeled pair ({wm}) — hard fail")
+            record_verdict("cost", "wire_ms_present", 0, 1, drift_tol,
+                           False)
+            return 1
+        drift = abs(pred - mod) / mod
+        within = drift <= drift_tol
+        print(f"perf gate [cost wire-ms drift]: predicted {pred:.4f} ms "
+              f"vs measured-model {mod:.4f} ms (|drift| {drift:.3f} vs "
+              f"cap {drift_tol}) -> "
+              f"{'OK' if within else 'REGRESSION'}")
+        record_verdict("cost", "wire_ms_drift", drift, drift_tol,
+                       drift_tol, within)
+        if not within:
+            return 1
+        # fall through: throughput still gates against the trajectory
 
     if leg.startswith("zero"):
         code = _zero_leg(rec, leg, tol)

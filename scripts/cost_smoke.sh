@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Cost-model smoke (CI brick for docs/cost-model.md): calibrate the link
+# classes on the 8-device virtual CPU mesh, persist + reload the
+# geometry-keyed calibration, enumerate + price the legal plan space
+# (shortlist nonempty, ranked ascending), and lower the top candidate —
+# it must match the unpriced lowering BIT-identically (pricing ranks
+# plans; it never changes what they compute). Runtime ~1 min.
+#
+# Usage: scripts/cost_smoke.sh
+#   COST_SMOKE_TMP=/path scripts/cost_smoke.sh   # keep artifacts
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TMP="${COST_SMOKE_TMP:-$(mktemp -d)}"
+mkdir -p "$TMP"
+trap '[ -z "${COST_SMOKE_TMP:-}" ] && rm -rf "$TMP"' EXIT
+echo "== cost smoke: calibration store in $TMP ==" >&2
+
+JAX_PLATFORMS=cpu \
+HOROVOD_CALIBRATION_CACHE="$TMP/link_calibration.json" \
+HOROVOD_AUTOTUNE_CACHE="$TMP/autotune_cache.json" \
+python scripts/_cost_smoke.py
+
+echo "COST SMOKE: OK" >&2

@@ -22,6 +22,9 @@
 #   PERF_GATE_LEGS="fused" scripts/perf_gate.sh # fused-kernel A/B:
 #                     parity + nonzero saved-HBM hard gates, step time
 #                     vs trajectory (docs/fused-kernels.md)
+#   PERF_GATE_LEGS="cost" scripts/perf_gate.sh  # cost-model drift:
+#                     |predicted - measured| wire-ms within
+#                     PERF_GATE_COST_DRIFT (docs/cost-model.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -101,8 +104,20 @@ for leg in $LEGS; do
                 --platform cpu --cpu-devices 8 --batch-size 2 \
                 --num-iters 3 --num-batches-per-iter 2
             ;;
+        cost)
+            # Cost-model drift gate (docs/cost-model.md): the quantized
+            # A/B's JSON carries wire_ms.predicted (the analytic
+            # planner) vs wire_ms.modeled (the traced program's actual
+            # wire bytes at the modeled bandwidths); the checker gates
+            # |predicted - measured| within PERF_GATE_COST_DRIFT
+            # (default 0.25 relative) and throughput against the
+            # trajectory like a train leg.
+            run_leg cost --quantized --platform cpu --cpu-devices 8 \
+                --model resnet18 --batch-size 2 --image-size 64 \
+                --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused)" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost)" >&2
             exit 2
             ;;
     esac

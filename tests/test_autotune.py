@@ -75,6 +75,29 @@ class TestGaussianProcess:
         assert not gp.fit([[0.5], [0.5]], [1.0, 1.0])
         assert not gp.fitted
 
+    def test_predict_batch_matches_pointwise(self):
+        # The batched path (one matrix solve for the whole EI candidate
+        # pool) must agree with the per-point triangular solves.
+        rng = np.random.RandomState(3)
+        xs = rng.rand(8, 2).tolist()
+        ys = [np.sin(4 * x[0]) + x[1] for x in xs]
+        gp = GaussianProcess(2, length_scale=0.3, noise=0.1)
+        assert gp.fit(xs, ys)
+        cands = rng.rand(50, 2).tolist()
+        mus, sds = gp.predict_batch(cands)
+        eis = gp.expected_improvement_batch(cands, max(ys))
+        for i, c in enumerate(cands):
+            mu, sd = gp.predict(c)
+            assert mus[i] == pytest.approx(mu, abs=1e-10)
+            assert sds[i] == pytest.approx(sd, abs=1e-10)
+            assert eis[i] == pytest.approx(
+                gp.expected_improvement(c, max(ys)), abs=1e-10)
+
+    def test_predict_batch_requires_fit(self):
+        gp = GaussianProcess(2)
+        with pytest.raises(RuntimeError):
+            gp.predict_batch([[0.1, 0.2]])
+
 
 def _run_manager(pm, score_fn):
     while not pm.done:
@@ -267,10 +290,11 @@ class TestPlanSchemaV5:
         monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
                            str(tmp_path / "cache.json"))
         TestSession._reset_kernel_cache()
-        key = cache_key_for("v6-schema-probe")
+        key = cache_key_for("v7-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        # v6: the fused-kernel backend knob (docs/fused-kernels.md).
-        assert key.endswith("|v6")
+        # v7: geometry-fingerprinted key + the stored predicted_ms
+        # (docs/cost-model.md).
+        assert key.endswith("|v7")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)
@@ -336,6 +360,193 @@ class TestPlanSchemaV5:
                                          hierarchical_allreduce=True))
         assert z.hierarchical_allreduce is False
         assert pm._unit_key(a) == pm._unit_key(b)
+
+
+class TestWarmStart:
+    """Cost-model warm start (docs/cost-model.md): the GP seeded with
+    the planner's priced shortlist converges in ≤ half the trials of
+    the cold search on the 2x4 CPU-mesh quadratic-basin fixture (the
+    score surface IS the negated predicted-ms — the model-is-right
+    world the warm start is built for)."""
+
+    PAYLOAD = 32 * MIB
+    MESH = (2, 4)
+
+    def _score(self, p):
+        from horovod_tpu.plan import describe_plan, price_step
+
+        sp = describe_plan(tuned_params=p, quantized=True,
+                           mesh_shape=self.MESH, quantized_pod=False)
+        return -price_step(sp, self.PAYLOAD,
+                           mesh_shape=self.MESH).predicted_ms
+
+    def test_seeds_walk_in_order_before_gp(self):
+        seeds = [TunedParams(fusion_threshold_bytes=2 * MIB),
+                 TunedParams(fusion_threshold_bytes=16 * MIB,
+                             overlap=True, num_comm_streams=2)]
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=6, tune_overlap=True,
+                              seeds=seeds)
+        assert pm.seeded == 2
+        trial_order = [pm.current]
+        while not pm.done:
+            pm.record_sample(1.0)
+            if not pm.done:
+                trial_order.append(pm.current)
+        # Trial 0 is the initial setting; trials 1..2 are the seeds in
+        # the given (predicted-ms) order; the GP takes over after.
+        assert trial_order[0] == TunedParams()
+        assert trial_order[1] == seeds[0]
+        assert trial_order[2] == seeds[1]
+
+    def test_seeds_equal_to_initial_or_duplicates_collapse(self):
+        dup = TunedParams()
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=3,
+                              seeds=[dup, dup,
+                                     TunedParams(
+                                         fusion_threshold_bytes=MIB)])
+        assert pm.seeded == 1  # initial + repeat collapse away
+
+    def test_warm_start_converges_in_half_the_cold_trials(self):
+        from horovod_tpu.plan import shortlist
+
+        initial = TunedParams(fusion_threshold_bytes=1 * MIB)
+
+        def run(pm):
+            while not pm.done:
+                pm.record_sample(self._score(pm.current))
+            return pm
+
+        cold = run(ParameterManager(
+            initial, warmup_samples=0, max_samples=20,
+            tune_quant_block=True, tune_overlap=True, seed=42))
+        seeds = [pp.params for pp in shortlist(
+            self.PAYLOAD, mesh_shape=self.MESH, quantized=True,
+            tune_overlap=True, initial=initial, k=5)]
+        warm = run(ParameterManager(
+            initial, warmup_samples=0, max_samples=9,
+            tune_quant_block=True, tune_overlap=True, seed=42,
+            seeds=seeds))
+        # ≤ half the cold trial budget, and at least as good a winner.
+        assert len(warm.history) <= len(cold.history) // 2
+        assert warm.best_score >= cold.best_score - 1e-9
+        # The priced shortlist hits the basin immediately: within 2% of
+        # the winner by trial 2 (trial 1 is the deliberately-bad
+        # initial), where the cold search needs many times that.
+        target = warm.best_score - abs(warm.best_score) * 0.02
+
+        def first_hit(pm):
+            for i, (_, s) in enumerate(pm.history):
+                if s >= target:
+                    return i + 1
+            return len(pm.history) + 1
+
+        assert first_hit(warm) <= 2
+        assert first_hit(cold) > 2 * first_hit(warm)
+
+    def test_session_warm_start_budget_and_fields(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        TestSession._reset_kernel_cache()
+        tree = {"w": jnp.ones((4096,), jnp.float32)}
+        built = []
+
+        def make_step(tuned):
+            built.append(tuned)
+            return _toy_make_step(tuned)
+
+        res = autotune_session(
+            make_step, cache_key=tree, enabled=True, warmup_samples=0,
+            steps_per_sample=2, tune_hierarchical=False, warm_start=3)
+        assert res.warm_start > 0
+        assert res.shortlist  # the priced rows ride the result
+        for row in res.shortlist:
+            assert "predicted_ms" in row and "plan" in row
+        # Budget shrinks to seeds + 4 refinement windows.
+        assert res.samples <= res.warm_start + 4
+        # The v7 cache entry records the winner's predicted_ms.
+        from horovod_tpu.ops import kernel_autotune
+
+        entry = kernel_autotune.cache_lookup(cache_key_for(tree))
+        assert entry is not None
+        assert "predicted_ms" in entry
+        assert "geometry" in entry
+
+    def test_string_cache_key_falls_back_cold(self, caplog):
+        with caplog.at_level(logging.WARNING,
+                             logger="horovod_tpu.autotune"):
+            res = autotune_session(
+                lambda t: _toy_make_step(t), cache_key=None,
+                enabled=True, warmup_samples=0, steps_per_sample=1,
+                max_samples=3, tune_hierarchical=False, warm_start=4)
+        assert res.warm_start == 0 and res.shortlist == ()
+        assert any("cold search" in r.message for r in caplog.records)
+
+    def test_explicit_seed_list(self):
+        seeds = [TunedParams(fusion_threshold_bytes=8 * MIB)]
+        res = autotune_session(
+            lambda t: _toy_make_step(t), enabled=True,
+            warmup_samples=0, steps_per_sample=1, max_samples=3,
+            tune_hierarchical=False, warm_start=seeds)
+        assert res.warm_start == 1
+        assert any(p.fusion_threshold_bytes == 8 * MIB
+                   for p, _ in res.history)
+
+
+class TestCacheSchemaV7:
+    """v7 = geometry-fingerprinted keys + stored predicted_ms
+    (docs/cost-model.md); reads stay tolerant of v6/v5 entries."""
+
+    def test_key_carries_geometry_fingerprint(self):
+        key = cache_key_for("geo-probe")
+        geo = basics.mesh_geometry()
+        assert f"|{geo}|" in key
+        assert key.endswith("|v7")
+
+    def test_load_tolerant_of_v6_entry(self, tmp_path, monkeypatch):
+        from horovod_tpu.ops import kernel_autotune
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        TestSession._reset_kernel_cache()
+        # A v6-era entry: params carry fused, but no geometry /
+        # predicted_ms fields — reads cleanly.
+        kernel_autotune.cache_store("legacy|v6", {
+            "params": {"fusion_threshold_bytes": 2 * MIB,
+                       "quant_block": 256,
+                       "hierarchical_allreduce": False,
+                       "zero_stage": 2, "overlap": True,
+                       "num_comm_streams": 2, "fused": True},
+            "plan": "rs+ag.z2|int8/256|s2|ovl|pl",
+            "score_steps_per_sec": 5.0, "samples": 9})
+        p = load_cached_params("legacy|v6")
+        assert p == TunedParams(fusion_threshold_bytes=2 * MIB,
+                                zero_stage=2, overlap=True,
+                                num_comm_streams=2, fused=True)
+
+    def test_load_tolerant_of_v5_entry(self, tmp_path, monkeypatch):
+        from horovod_tpu.ops import kernel_autotune
+
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
+                           str(tmp_path / "cache.json"))
+        TestSession._reset_kernel_cache()
+        # v5: no fused knob at all — defaults to False (the exact
+        # pre-v6 wire).
+        kernel_autotune.cache_store("legacy|v5", {
+            "params": {"fusion_threshold_bytes": 4 * MIB,
+                       "quant_block": 128,
+                       "hierarchical_allreduce": True,
+                       "zero_stage": 0, "overlap": False,
+                       "num_comm_streams": 1},
+            "plan": "ar.tree|int8/128|s1|sync",
+            "score_steps_per_sec": 3.0, "samples": 5})
+        p = load_cached_params("legacy|v5")
+        assert p == TunedParams(fusion_threshold_bytes=4 * MIB,
+                                quant_block=128,
+                                hierarchical_allreduce=True)
+        assert p.fused is False
 
 
 def _toy_make_step(tuned, sleep_by_threshold=None):

@@ -490,26 +490,28 @@ class TestFusedObservability:
 GOLDEN_FUSED_QUANTIZED_2x4 = """\
 wire plan  mesh=2x4  payload=1048576B (itemsize 4)
 knobs: quantized=on block=256 zero_stage=0 overlap=off hierarchical=off streams=1 fusion_threshold=67108864 fused=on quantized_pod=off
-collective       leg level primitive      wire       ef  backend stream    bytes/dev
-allreduce          1 ici   reduce_scatter payload    -   xla          0       786432
-allreduce          2 dcn   reduce_scatter int8/256   yes pallas       0        33280
-allreduce          3 dcn   all_gather     int8/256   yes pallas       0        66560
-allreduce          4 ici   all_gather     payload    -   xla          0      1572864
+collective       leg level primitive      wire       ef  backend stream    bytes/dev  model ms  pred ms
+allreduce          1 ici   reduce_scatter payload    -   xla          0       786432    0.0079   0.0109
+allreduce          2 dcn   reduce_scatter int8/256   yes pallas       0        33280    0.0013   0.0276
+allreduce          3 dcn   all_gather     int8/256   yes pallas       0        66560    0.0027   0.0303
+allreduce          4 ici   all_gather     payload    -   xla          0      1572864    0.0157   0.0187
 totals: ici=2359296 dcn=99840 pod=0 dcn_fp_equiv=393216 dcn_reduction=3.94x
 fused: predicted hbm round-trip saved 723968 bytes/dev vs unfused (docs/fused-kernels.md)
+predicted: 0.0875 ms step wire = bytes 0.0276 + latency 0.0560 + quant 0.0039 - hidden 0.0000 (modeled 0.0276 ms, 1 bucket) [cost model: static]
 encoding: allreduce:ici.reduce_scatter[payload]>dcn.reduce_scatter[int8/256+ef]@pl>dcn.all_gather[int8/256+ef]@pl>ici.all_gather[payload]|s1|sync"""
 
 GOLDEN_QUANTIZED_POD_2x2x2 = """\
 wire plan  mesh=2x2x2  payload=1048576B (itemsize 4)
 knobs: quantized=off block=256 zero_stage=0 overlap=off hierarchical=on streams=1 fusion_threshold=67108864 fused=on quantized_pod=on
-collective       leg level primitive      wire       ef  backend stream    bytes/dev
-allreduce          1 ici   reduce_scatter payload    -   xla          0       524288
-allreduce          2 dcn   psum           payload    -   xla          0       524288
-allreduce          3 pod   reduce_scatter int8/256   -   pallas       0        66560
-allreduce          4 pod   all_gather     int8/256   -   pallas       0       133120
-allreduce          5 ici   all_gather     payload    -   xla          0      1048576
+collective       leg level primitive      wire       ef  backend stream    bytes/dev  model ms  pred ms
+allreduce          1 ici   reduce_scatter payload    -   xla          0       524288    0.0052   0.0062
+allreduce          2 dcn   psum           payload    -   xla          0       524288    0.0210   0.0460
+allreduce          3 pod   reduce_scatter int8/256   -   pallas       0        66560    0.0027   0.0303
+allreduce          4 pod   all_gather     int8/256   -   pallas       0       133120    0.0053   0.0356
+allreduce          5 ici   all_gather     payload    -   xla          0      1048576    0.0105   0.0115
 totals: ici=1572864 dcn=524288 pod=199680 dcn_fp_equiv=524288 dcn_reduction=1.00x pod_fp_equiv=786432 pod_reduction=3.94x
 fused: predicted hbm round-trip saved 1447936 bytes/dev vs unfused (docs/fused-kernels.md)
+predicted: 0.1296 ms step wire = bytes 0.0447 + latency 0.0770 + quant 0.0079 - hidden 0.0000 (modeled 0.0447 ms, 1 bucket) [cost model: static]
 encoding: allreduce:ici.reduce_scatter[payload]>dcn.psum[payload]>pod.reduce_scatter[int8/256]@pl>pod.all_gather[int8/256]@pl>ici.all_gather[payload]|s1|sync"""
 
 

@@ -210,21 +210,23 @@ class TestPlanner:
 GOLDEN_QUANTIZED_2x4 = """\
 wire plan  mesh=2x4  payload=1048576B (itemsize 4)
 knobs: quantized=on block=256 zero_stage=0 overlap=off hierarchical=off streams=1 fusion_threshold=67108864 fused=off quantized_pod=off
-collective       leg level primitive      wire       ef  backend stream    bytes/dev
-allreduce          1 ici   reduce_scatter payload    -   xla          0       786432
-allreduce          2 dcn   reduce_scatter int8/256   yes xla          0        33280
-allreduce          3 dcn   all_gather     int8/256   yes xla          0        66560
-allreduce          4 ici   all_gather     payload    -   xla          0      1572864
+collective       leg level primitive      wire       ef  backend stream    bytes/dev  model ms  pred ms
+allreduce          1 ici   reduce_scatter payload    -   xla          0       786432    0.0079   0.0109
+allreduce          2 dcn   reduce_scatter int8/256   yes xla          0        33280    0.0013   0.0290
+allreduce          3 dcn   all_gather     int8/256   yes xla          0        66560    0.0027   0.0329
+allreduce          4 ici   all_gather     payload    -   xla          0      1572864    0.0157   0.0187
 totals: ici=2359296 dcn=99840 pod=0 dcn_fp_equiv=393216 dcn_reduction=3.94x
+predicted: 0.0915 ms step wire = bytes 0.0276 + latency 0.0560 + quant 0.0079 - hidden 0.0000 (modeled 0.0276 ms, 1 bucket) [cost model: static]
 encoding: allreduce:ici.reduce_scatter[payload]>dcn.reduce_scatter[int8/256+ef]>dcn.all_gather[int8/256+ef]>ici.all_gather[payload]|s1|sync"""
 
 GOLDEN_ZERO2_OVERLAP_2x4 = """\
 wire plan  mesh=2x4  payload=1048576B (itemsize 4)
 knobs: quantized=off block=256 zero_stage=2 overlap=on hierarchical=off streams=2 fusion_threshold=67108864 fused=off quantized_pod=off
-collective       leg level primitive      wire       ef  backend stream    bytes/dev
-reduce_scatter     1 flat  reduce_scatter payload    -   xla          0       917504
-all_gather         1 flat  all_gather     payload    -   xla          0      1835008
+collective       leg level primitive      wire       ef  backend stream    bytes/dev  model ms  pred ms
+reduce_scatter     1 flat  reduce_scatter payload    -   xla          0       917504    0.0131   0.0411
+all_gather         1 flat  all_gather     payload    -   xla          0      1835008    0.0262   0.0542
 totals: ici=2359296 dcn=393216 pod=0 dcn_fp_equiv=393216 dcn_reduction=1.00x
+predicted: 0.0953 ms step wire = bytes 0.0393 + latency 0.0560 + quant 0.0000 - hidden 0.0000 (modeled 0.0393 ms, 1 bucket) [cost model: static]
 encoding: reduce_scatter:flat.reduce_scatter[payload]|s2|ovl + tail@all_gather:flat.all_gather[payload]|s2|ovl"""
 
 
