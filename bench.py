@@ -107,6 +107,11 @@ def dump_plan(args, mesh_shape):
         quantized_pod=args.quantized_pod or None,
         hierarchical=args.quantized_pod or None,
         mesh_shape=mesh_shape,
+        pp_stages=args.pp or None,
+        pp_microbatches=args.pp_microbatches if args.pp else None,
+        pp_interleave=args.pp_interleave if args.pp else None,
+        pp_schedule=args.pp_schedule if args.pp else None,
+        pp_quantized=(args.quantized or None) if args.pp else None,
     )
     model = hvd_plan.get_cost_model(mesh_shape=mesh_shape)
     if model.source != "static":
@@ -1219,6 +1224,396 @@ def run_fused(args, devices, platform, mesh_shape):
     }), flush=True)
 
 
+def run_pp(args, devices, platform, mesh_shape):
+    """The ``--pp`` leg: interleaved-1F1B pipeline parallelism A/B
+    (docs/pipeline.md).
+
+    * **dense leg** — the same GPT trained pure-data-parallel over ALL
+      devices (same global batch, same optimizer math): the throughput
+      baseline and the parity reference.
+    * **pipelined leg** — a dedicated ``hvd_pp`` mesh of ``--pp`` stages
+      over the remaining data axes; the model splits into
+      ``stages x --pp-interleave`` round-robin chunks and trains under
+      the ``--pp-schedule`` schedule with the inter-stage hops lowered
+      as wire-plan ``send`` legs. Composes ``--zero-stage`` (the
+      per-stage sharded optimizer), ``--quantized`` (int8+EF on BOTH
+      the gradient wire and, when the hop is DCN/pod-class, the
+      activation sends), and ``--overlap`` (stream-scheduled bucket
+      collectives filling the bubble T3-style) into ONE compiled step.
+
+    The JSON line carries the measured ``bubble_fraction`` (derived
+    from the schedule's ``PP:F``/``PP:B`` spans), the no-overlap GPipe
+    analytic bound ``(S-1)/(M+S-1)`` it must stay strictly under, the
+    per-hop wire bytes, and the send-leg predicted-vs-modeled wire-ms
+    drift pair the perf gate checks (scripts/perf_gate.sh pp)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import plan as hvd_plan
+    from horovod_tpu.models import GPT, gpt_tiny
+    from horovod_tpu.monitor import span_audit
+    from horovod_tpu.ops.collective_ops import record_wire_stats
+    from horovod_tpu.parallel.pipeline import (
+        _send_plan_for_axis, build_interleaved_schedule, pp_split_chunks,
+        pipelined_gpt_train)
+    from horovod_tpu.plan.accounting import bench_gbps
+
+    S = args.pp
+    v = max(1, args.pp_interleave)
+    sched_name = args.pp_schedule
+    if sched_name != "interleaved_1f1b" and v > 1:
+        raise SystemExit(f"--pp-interleave {v} needs "
+                         f"--pp-schedule interleaved_1f1b")
+    ndev = len(devices)
+    if ndev % S:
+        raise SystemExit(f"--pp {S} does not divide {ndev} devices")
+    if mesh_shape is not None:
+        if len(mesh_shape) != 2:
+            raise SystemExit("--pp takes a 2-D --mesh-shape (the DATA "
+                             "mesh; the pp axis is the leading dim)")
+        dmesh = tuple(mesh_shape)
+    else:
+        dp0 = ndev // S
+        dmesh = (2, dp0 // 2) if dp0 % 2 == 0 and dp0 >= 2 else (1, dp0)
+    dp = dmesh[0] * dmesh[1]
+    if S * dp != ndev:
+        raise SystemExit(f"--pp {S} x mesh {dmesh} != {ndev} devices")
+    M = args.pp_microbatches
+    if M % S and sched_name == "interleaved_1f1b" and v > 1:
+        raise SystemExit(f"--pp-microbatches {M} must divide by --pp {S}")
+    stage = args.zero_stage or 0
+    quantized = bool(args.quantized)
+    overlap = bool(args.overlap)
+    lr = 0.05
+
+    chunks_v = v if sched_name == "interleaved_1f1b" else 1
+    L = S * max(chunks_v, v)
+    seq = 16
+    cfg = gpt_tiny(dtype=jnp.float32, num_layers=L)
+    rs = np.random.RandomState(0)
+    B = M * dp
+    tokens = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, seq)))
+    targets = jnp.asarray(rs.randint(0, cfg.vocab_size, (B, seq)))
+    params0 = GPT(cfg).init(jax.random.PRNGKey(0), tokens)["params"]
+    log(f"pp A/B: stages={S} interleave={v} microbatches={M} "
+        f"schedule={sched_name} data_mesh={dmesh} layers={L} "
+        f"global_batch={B} zero_stage={stage} quantized={quantized} "
+        f"overlap={overlap}")
+
+    def dense_loss_fn(p, tok, tgt):
+        logits = GPT(cfg).apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tgt).mean()
+
+    iters = max(2, args.num_iters)
+    spc = max(1, args.num_batches_per_iter)
+
+    # ---- dense leg: pure DP over all devices -------------------------
+    hvd.shutdown()
+    dense_mesh_shape = ((2, ndev // 2) if ndev % 2 == 0 and ndev >= 2
+                        else (1, ndev))
+    hvd.init(devices=devices, mesh_shape=dense_mesh_shape)
+    mesh = hvd.mesh()
+
+    def dense_spmd(p, tok, tgt):
+        loss, g = hvd.value_and_grad(dense_loss_fn)(p, tok, tgt)
+        loss = hvd.allreduce(loss, op=hvd.Average)
+        return loss, jax.tree.map(lambda a, b: a - lr * b, p, g)
+
+    dense_step = jax.jit(hvd.shard_map(
+        dense_spmd, mesh=mesh,
+        in_specs=(P(), hvd.data_pspec(), hvd.data_pspec()),
+        out_specs=(P(), P())))
+    p = params0
+    dense_loss0, p = jax.block_until_ready(dense_step(p, tokens, targets))
+    t0 = time.perf_counter()
+    for _ in range(iters * spc):
+        loss_d, p = dense_step(p, tokens, targets)
+    jax.block_until_ready(loss_d)
+    dense_sps = iters * spc / (time.perf_counter() - t0)
+    dense_tps = dense_sps * B * seq
+    log(f"dense leg: loss0={float(dense_loss0):.4f} "
+        f"{dense_tps:.0f} tok/s ({dense_sps:.2f} steps/s)")
+
+    # ---- pipelined leg ----------------------------------------------
+    hvd.shutdown()
+    tl_path = os.path.join(tempfile.mkdtemp(prefix="bench_pp_"),
+                           "pp_timeline.json")
+    os.environ["HOROVOD_TIMELINE"] = tl_path
+    try:
+        hvd.init(devices=devices, mesh_shape=dmesh, pp_stages=S)
+    finally:
+        del os.environ["HOROVOD_TIMELINE"]
+    mesh = hvd.mesh()
+    assert hvd.pp_size() == S
+    chunks, rest = pp_split_chunks(params0, S, chunks_v)
+    splan = _send_plan_for_axis(hvd.PP_AXIS, quantized=quantized,
+                                block=256, error_feedback=quantized)
+    sched = (build_interleaved_schedule(M, S, v)
+             if sched_name != "gpipe" and S > 1 else None)
+    PPALL = (hvd.PP_AXIS,) + hvd.HVD_AXES
+    data_spec = P(hvd.HVD_AXES)
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(lr, momentum=0.9), zero_stage=stage,
+        quantized=quantized, overlap=overlap,
+        pp_stages=S, pp_microbatches=M, pp_schedule=sched_name,
+        pp_interleave=v) if stage else None
+
+    def pp_grads(cp_local, rest_local, tok, tgt):
+        return pipelined_gpt_train(
+            cfg, cp_local, rest_local, tok, tgt, axis=hvd.PP_AXIS,
+            num_microbatches=M, schedule=sched_name, interleave=v,
+            send_plan=splan if S > 1 else None)
+
+    def state_specs(state):
+        return jax.tree.map(
+            lambda l: P(PPALL) if getattr(l, "ndim", 0) >= 1 else P(),
+            state)
+
+    if stage == 3:
+        tpl = {"chunks": jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), chunks),
+            "rest": jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), rest)}
+        psh_rows = []
+        for r in range(S):
+            ptree_r = {"chunks": jax.tree.map(lambda a: a[r], chunks),
+                       "rest": rest}
+            psh_rows.append(hvd.zero3_shard_params(ptree_r))
+        psh = tuple(jnp.stack([row[i] for row in psh_rows])
+                    for i in range(len(psh_rows[0])))
+        psh_spec = jax.tree.map(lambda _: P(hvd.PP_AXIS, hvd.HVD_AXES),
+                                psh)
+        psh = jax.device_put(psh, jax.tree.map(
+            lambda q: NamedSharding(mesh, q), psh_spec))
+
+        def init_spmd(psh):
+            local = tuple(b[0] for b in psh)
+            ptree = hvd.zero3_gather_params(local, tpl)
+            return tx.init(ptree)
+
+        # Host-side init of ONE stage's tree gives the state STRUCTURE
+        # (leaf ranks match the in-trace form); the values come from the
+        # in-trace init below, sharded per stage x data rank.
+        state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
+                                                    chunks),
+                             "rest": rest})
+        state = jax.jit(hvd.shard_map(
+            init_spmd, mesh=mesh, in_specs=(psh_spec,),
+            out_specs=state_specs(state_tpl)))(psh)
+
+        def step_spmd(psh, state, tok, tgt):
+            local = tuple(b[0] for b in psh)
+            ptree = hvd.zero3_gather_params(local, tpl)
+            loss, g_cp, g_rest = pp_grads(ptree["chunks"], ptree["rest"],
+                                          tok, tgt)
+            grads = {"chunks": g_cp, "rest": g_rest}
+            upd, new_state = tx.update(grads, state, local)
+            new_local = optax.apply_updates(local, upd)
+            loss = hvd.allreduce(loss, op=hvd.Average)
+            return (loss, tuple(u[None] for u in new_local), new_state)
+
+        sspec = state_specs(state)
+        step = jax.jit(hvd.shard_map(
+            step_spmd, mesh=mesh,
+            in_specs=(psh_spec, sspec, data_spec, data_spec),
+            out_specs=(P(), psh_spec, sspec)))
+        carry = (psh, state)
+
+        def drive(tok, tgt):
+            nonlocal carry
+            psh, state = carry
+            loss, psh, state = step(psh, state, tok, tgt)
+            carry = (psh, state)
+            return loss
+    elif stage:
+        ptree = {"chunks": chunks, "rest": rest}
+        pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS), chunks),
+                 "rest": jax.tree.map(lambda _: P(), rest)}
+
+        def init_spmd(pt):
+            local = {"chunks": jax.tree.map(lambda a: a[0],
+                                            pt["chunks"]),
+                     "rest": pt["rest"]}
+            return tx.init(local)
+
+        state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
+                                                    chunks),
+                             "rest": rest})
+        state = jax.jit(hvd.shard_map(
+            init_spmd, mesh=mesh, in_specs=(pspec,),
+            out_specs=state_specs(state_tpl)))(ptree)
+
+        def step_spmd(pt, state, tok, tgt):
+            local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
+            loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok, tgt)
+            grads = {"chunks": g_cp, "rest": g_rest}
+            local = {"chunks": local_c, "rest": pt["rest"]}
+            upd, new_state = tx.update(grads, state, local)
+            new_local = optax.apply_updates(local, upd)
+            loss = hvd.allreduce(loss, op=hvd.Average)
+            # The optimizer's buckets mix pp-varying chunk leaves with
+            # pp-invariant rest leaves, so the updated rest comes back
+            # typed pp-varying although every stage computed the same
+            # value — re-establish the replication by construction
+            # (stage 0's copy, masked psum) so the P() out-spec holds.
+            from jax import lax as _lax
+
+            rpp = _lax.axis_index(hvd.PP_AXIS)
+            new_rest = jax.tree.map(
+                lambda a: _lax.psum(
+                    jnp.where(rpp == 0, a, jnp.zeros_like(a)),
+                    hvd.PP_AXIS), new_local["rest"])
+            new_pt = {"chunks": jax.tree.map(lambda a: a[None],
+                                             new_local["chunks"]),
+                      "rest": new_rest}
+            return loss, new_pt, new_state
+
+        sspec = state_specs(state)
+        step = jax.jit(hvd.shard_map(
+            step_spmd, mesh=mesh,
+            in_specs=(pspec, sspec, data_spec, data_spec),
+            out_specs=(P(), pspec, sspec)))
+        carry = (ptree, state)
+
+        def drive(tok, tgt):
+            nonlocal carry
+            pt, state = carry
+            loss, pt, state = step(pt, state, tok, tgt)
+            carry = (pt, state)
+            return loss
+    else:
+        ptree = {"chunks": chunks, "rest": rest}
+        pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS), chunks),
+                 "rest": jax.tree.map(lambda _: P(), rest)}
+
+        def step_spmd(pt, tok, tgt):
+            local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
+            loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok, tgt)
+            # Chunk grads are pp-VARYING (per stage), rest grads
+            # pp-invariant — reduce them in separate bucket sets so the
+            # rest wire keeps its provable pp replication.
+            g_cp = hvd.allreduce_pytree(g_cp, op=hvd.Average,
+                                        quantized=quantized or None,
+                                        overlap=overlap or None)
+            g_rest = hvd.allreduce_pytree(g_rest, op=hvd.Average,
+                                          quantized=quantized or None,
+                                          overlap=overlap or None)
+            new_c = jax.tree.map(lambda a, b: a - lr * b, local_c, g_cp)
+            new_rest = jax.tree.map(lambda a, b: a - lr * b, pt["rest"],
+                                    g_rest)
+            loss = hvd.allreduce(loss, op=hvd.Average)
+            return loss, {"chunks": jax.tree.map(lambda a: a[None],
+                                                 new_c),
+                          "rest": new_rest}
+
+        step = jax.jit(hvd.shard_map(
+            step_spmd, mesh=mesh,
+            in_specs=(pspec, data_spec, data_spec),
+            out_specs=(P(), pspec)))
+        carry = [ptree]
+
+        def drive(tok, tgt):
+            loss, carry[0] = step(carry[0], tok, tgt)
+            return loss
+
+    with record_wire_stats() as wire:
+        pp_loss0 = jax.block_until_ready(drive(tokens, targets))
+    parity_rel = abs(float(pp_loss0) - float(dense_loss0)) / max(
+        1e-9, abs(float(dense_loss0)))
+    tol = 1e-2 if quantized else 1e-4
+    log(f"pp leg: loss0={float(pp_loss0):.4f} vs dense "
+        f"{float(dense_loss0):.4f} (rel {parity_rel:.2e}, tol {tol})")
+    if parity_rel > tol:
+        raise SystemExit(
+            f"pp parity FAILED: pipelined loss {float(pp_loss0)} vs "
+            f"dense {float(dense_loss0)} (rel {parity_rel:.2e} > {tol})")
+
+    t0 = time.perf_counter()
+    for _ in range(iters * spc):
+        loss_p = drive(tokens, targets)
+    jax.block_until_ready(loss_p)
+    pp_sps = iters * spc / (time.perf_counter() - t0)
+    pp_tps = pp_sps * B * seq
+
+    # Bubble measured from the schedule's PP:F/PP:B spans.
+    bound = hvd_plan.pp_bubble_bound(S, M)
+    if sched is not None:
+        hvd.shutdown()  # flush + close the timeline
+        audit = span_audit.audit_spans(tl_path, prefix="PP:",
+                                       require_spans=True)
+        busy = audit.count.get("PP:F", 0) + audit.count.get("PP:B", 0)
+        # One trace per compiled step; the schedule emits once.
+        per_trace = sched.unit_count()
+        traces = max(1, busy // per_trace)
+        bubble = 1.0 - (busy / traces) / float(S * sched.ticks)
+        ticks = sched.ticks
+    else:
+        bubble = bound  # gpipe baseline: the analytic bound itself
+        ticks = M + S - 1
+    log(f"bubble_fraction={bubble:.4f} (gpipe bound {bound:.4f}, "
+        f"{ticks} ticks)")
+
+    # Send-leg drift pair: predicted (cost model) vs the trace-accounted
+    # bytes at the modeled bandwidths.
+    act_bytes = (B // (M * dp)) * seq * cfg.d_model * 4.0
+    issues = 2 * ticks if sched is not None else (M + S - 1)
+    priced = hvd_plan.price_send(
+        splan, act_bytes, issues=issues, mesh_shape=dmesh,
+        model=hvd_plan.get_cost_model(mesh_shape=dmesh))
+    ici_g, dcn_g, pod_g = bench_gbps()
+    hop = splan.legs[0].level
+    hop_gbps = {"ici": ici_g, "dcn": dcn_g, "pod": pod_g}[hop]
+    pp_wire_ms_modeled = wire.pp_bytes / (hop_gbps * 1e9) * 1e3
+    drift = (abs(priced["modeled_ms"] - pp_wire_ms_modeled)
+             / max(1e-9, pp_wire_ms_modeled))
+    log(f"send wire: accounted {wire.pp_bytes:.0f} B "
+        f"({pp_wire_ms_modeled:.4f} ms modeled) vs predicted "
+        f"{priced['wire_bytes']:.0f} B ({priced['modeled_ms']:.4f} ms); "
+        f"drift {drift:.4f}")
+
+    result = {
+        "metric": f"pp{S}_tokens_per_sec",
+        "value": round(pp_tps, 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "pp": {
+            "stages": S, "interleave": v, "microbatches": M,
+            "schedule": sched_name, "data_mesh": mesh_shape_str(dmesh),
+            "zero_stage": stage, "quantized": quantized,
+            "overlap": overlap, "send_plan": splan.encode(),
+            "ticks": ticks,
+        },
+        "bubble_fraction": round(bubble, 6),
+        "bubble_bound_gpipe": round(bound, 6),
+        "parity_rel_err": parity_rel,
+        "parity_tol": tol,
+        "dense_tokens_per_sec": round(dense_tps, 1),
+        "throughput_delta": round(pp_tps / max(1e-9, dense_tps), 4),
+        "wire_bytes_ici": wire.ici_bytes,
+        "wire_bytes_dcn": wire.dcn_bytes,
+        "wire_bytes_pod": wire.pod_bytes,
+        "pp_send_bytes": wire.pp_bytes,
+        "pp_sends": wire.pp_sends,
+        "wire_ms": {
+            "predicted": round(priced["modeled_ms"], 4),
+            "predicted_total": round(priced["predicted_ms"], 4),
+            "modeled": round(pp_wire_ms_modeled, 4),
+            "model": priced["model"],
+        },
+        "metrics_snapshot": metrics_snapshot(),
+    }
+    print(json.dumps(result))
+    return result
+
+
 def run_serve(args, devices, platform, mesh_shape):
     """The ``--serve`` leg: a continuous-batching generation trace.
 
@@ -1579,6 +1974,23 @@ def main():
                          "with the pod hop as the blockwise-int8 rs+ag "
                          "pair (implies hierarchical; "
                          "HOROVOD_QUANTIZED_POD at runtime)")
+    ap.add_argument("--pp", type=int, default=0, metavar="STAGES",
+                    help="pipeline-parallel A/B leg: dense DP vs a "
+                         "dedicated hvd_pp mesh of STAGES stages under "
+                         "the --pp-schedule schedule, inter-stage "
+                         "activation hops as wire-plan send legs; "
+                         "composes --zero-stage/--quantized/--overlap "
+                         "(docs/pipeline.md)")
+    ap.add_argument("--pp-microbatches", type=int, default=8,
+                    help="microbatches per pipelined step (pow2; must "
+                         "divide by --pp for the interleaved schedule)")
+    ap.add_argument("--pp-interleave", type=int, default=2,
+                    help="virtual stages per rank (interleaved-1F1B "
+                         "degree; 1 = plain 1F1B chunking)")
+    ap.add_argument("--pp-schedule", default="interleaved_1f1b",
+                    choices=["gpipe", "1f1b", "interleaved_1f1b"],
+                    help="pipeline schedule family member "
+                         "(docs/pipeline.md)")
     ap.add_argument("--overlap", action="store_true",
                     help="A/B the overlapped gradient reduction "
                          "(HOROVOD_OVERLAP: reverse-layer bucket "
@@ -1744,12 +2156,24 @@ def main():
                  "the stage-2 alias). --zero-stage DOES compose with "
                  "--quantized/--overlap: the stage leg then runs the "
                  "combined plan-compiled wire (docs/wire-plan.md)")
-    if args.overlap and not args.zero_stage and (args.quantized
-                                                 or args.zero):
+    if args.overlap and not args.zero_stage and not args.pp \
+            and (args.quantized or args.zero):
         ap.error("--overlap cannot combine with --quantized/--zero (one "
                  "A/B structure per run; the compose matrix is covered "
                  "by tests/test_overlap.py — or use --zero-stage N "
                  "--quantized --overlap for the combined plan leg)")
+
+    if args.pp:
+        if args.pp < 2:
+            ap.error("--pp needs >= 2 stages")
+        if args.serve or args.scaling or args.autotune or args.fused \
+                or args.zero:
+            ap.error("--pp composes with --zero-stage/--quantized/"
+                     "--overlap only (one A/B structure per run)")
+        if args.pp_microbatches < 1:
+            ap.error("--pp-microbatches must be >= 1")
+        if args.pp_interleave < 1:
+            ap.error("--pp-interleave must be >= 1")
 
     mesh_shape = None
     if args.mesh_shape:
@@ -1793,9 +2217,14 @@ def main():
     mesh_world = 1
     for v in (mesh_shape or ()):
         mesh_world *= v
+    # Under --pp the --mesh-shape names the DATA mesh; the hvd_pp axis
+    # multiplies it to cover the devices (docs/pipeline.md).
+    if args.pp:
+        mesh_world *= args.pp
     if mesh_shape is not None and mesh_world != len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape_str(mesh_shape)} "
-                         f"does not cover {len(devices)} devices")
+                         f"does not cover {len(devices)} devices"
+                         + (f" (x --pp {args.pp})" if args.pp else ""))
     if (args.quantized or args.autotune or args.zero or args.overlap
             or args.serve or args.zero_stage or args.fused) \
             and mesh_shape is None \
@@ -1814,6 +2243,12 @@ def main():
                  else "fused" if args.fused else "autotune")
         log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
             f"collectives have a cross (DCN) hop")
+
+    if args.pp:
+        run_pp(args, devices, platform,
+               parse_mesh_shape(args.mesh_shape) if args.mesh_shape
+               else None)
+        return
 
     if args.serve:
         run_serve(args, devices, platform, mesh_shape)

@@ -54,8 +54,10 @@ from .common.basics import (  # noqa: F401
     HVD_AXES,
     LOCAL_AXIS,
     POD_AXIS,
+    PP_AXIS,
     cross_rank,
     cross_size,
+    data_mesh_shape,
     data_sharding,
     in_hvd_context,
     init,
@@ -67,6 +69,7 @@ from .common.basics import (  # noqa: F401
     mesh,
     mpi_threads_supported,
     pod_size,
+    pp_size,
     rank,
     replicated_sharding,
     shard_map,
@@ -158,12 +161,18 @@ from .parallel.expert import (  # noqa: F401
     switch_moe_ragged,
 )
 from .parallel.pipeline import (  # noqa: F401
+    PPSchedule,
+    PP_SCHEDULES,
+    build_interleaved_schedule,
     gpipe,
     gpipe_1f1b,
+    interleaved_1f1b,
     pipelined_gpt_apply,
     pipelined_gpt_loss,
+    pipelined_gpt_train,
     pipelined_gpt_train_1f1b,
     pp_split_blocks,
+    pp_split_chunks,
 )
 from .parallel.tensor import (  # noqa: F401
     tp_merge_params,

@@ -50,7 +50,14 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     the cost model and reality is auditable from the cache alone.
 #     from_dict/load stay tolerant of v6/v5 entries (the params schema
 #     is unchanged; the version segment in the key gates real reuse).
-_CACHE_VERSION = 7
+# v8: pipeline parallelism (docs/pipeline.md) — TunedParams gains the
+#     pp_microbatches/pp_interleave pair (tune_pp-gated; the plan
+#     encoding's trailing `|ppM/V` segment), and pipeline meshes carry
+#     a `ppS` marker in the geometry fingerprint so a winner tuned at
+#     one stage count never warm-starts another. from_dict/load stay
+#     tolerant of v7/v6 entries (pp fields default to the dead-knob
+#     0 / 1 values — the exact pre-v8 step).
+_CACHE_VERSION = 8
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -131,14 +138,15 @@ def load_cached_params(key: str) -> Optional[TunedParams]:
 
 def _store_cached_params(key: str, params: TunedParams, *,
                          score: float, samples: int,
-                         quantized: bool = False,
+                         quantized: bool = False, pp: bool = False,
                          predicted_ms: Optional[float] = None) -> None:
     from ..plan import planner as _wire_planner
     from ..ops import kernel_autotune
 
     entry = {
         "params": params.as_dict(),
-        "plan": _wire_planner.encode_tuned(params, quantized=quantized),
+        "plan": _wire_planner.encode_tuned(params, quantized=quantized,
+                                           pp=pp),
         "score_steps_per_sec": score,
         "samples": samples,
         "geometry": basics.mesh_geometry(),
@@ -154,7 +162,8 @@ def _store_cached_params(key: str, params: TunedParams, *,
 def _priced_seeds(payload_bytes: float, k: int, *, initial: TunedParams,
                   quantized: bool, tune_hierarchical: bool,
                   tune_zero: bool, tune_overlap: bool,
-                  tune_fused: bool):
+                  tune_fused: bool, tune_pp: bool = False,
+                  pp_stages: int = 0, pp_max_interleave: int = 1):
     """Top-``k`` cost-model-priced candidates for this session's search
     space (docs/cost-model.md): the planner enumerates every legal plan
     the session's gates allow, prices them with the calibrated (or
@@ -167,6 +176,8 @@ def _priced_seeds(payload_bytes: float, k: int, *, initial: TunedParams,
         payload_bytes, quantized=quantized, k=k,
         tune_hierarchical=tune_hierarchical, tune_zero=tune_zero,
         tune_overlap=tune_overlap, tune_fused=tune_fused,
+        tune_pp=tune_pp, pp_stages=pp_stages,
+        pp_max_interleave=pp_max_interleave,
         initial=initial, model=model)
 
 
@@ -187,6 +198,9 @@ def autotune_session(
     tune_zero: bool = False,
     tune_overlap: bool = False,
     tune_fused: bool = False,
+    tune_pp: bool = False,
+    pp_stages: int = 0,
+    pp_max_interleave: int = 1,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -227,7 +241,13 @@ def autotune_session(
     backend (docs/fused-kernels.md) to the search — only meaningful on
     a quantized wire, where the int8 legs have a kernel lowering; on an
     unquantized wire canonicalization collapses the dimension to one
-    trial.
+    trial. ``tune_pp`` (with ``pp_stages`` = the mesh's stage count and
+    ``pp_max_interleave`` = the deepest virtual-stage split the model's
+    layer count allows) adds the pipeline schedule pair —
+    ``pp_microbatches`` (pow2, snapped to a stage-count multiple) and
+    ``pp_interleave`` (pow2) — gated exactly like zero/overlap: both
+    restructure the traced schedule, so only a step builder that
+    rebuilds at the proposed values may search them (docs/pipeline.md).
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -311,7 +331,9 @@ def autotune_session(
                 quantized=bool(tune_quant_block),
                 tune_hierarchical=tune_hierarchical,
                 tune_zero=tune_zero, tune_overlap=tune_overlap,
-                tune_fused=tune_fused)
+                tune_fused=tune_fused, tune_pp=tune_pp,
+                pp_stages=pp_stages,
+                pp_max_interleave=pp_max_interleave)
             seeds = [pp.params for pp in ranked]
             shortlist_rows = tuple(pp.as_dict() for pp in ranked)
             if ranked:
@@ -333,6 +355,9 @@ def autotune_session(
         tune_zero=tune_zero,
         tune_overlap=tune_overlap,
         tune_fused=tune_fused,
+        tune_pp=tune_pp,
+        pp_stages=pp_stages,
+        pp_max_interleave=pp_max_interleave,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,
@@ -414,7 +439,8 @@ def autotune_session(
 
                 sp = _wire_planner.describe_plan(
                     tuned_params=best, quantized=bool(tune_quant_block),
-                    quantized_pod=False)
+                    quantized_pod=False,
+                    pp_stages=pp_stages if tune_pp else None)
                 predicted_ms = _cost.price_step(
                     sp, payload_bytes,
                     model=_calibrate.get_cost_model()).predicted_ms
@@ -423,6 +449,7 @@ def autotune_session(
         _store_cached_params(key, best, score=pm.best_score,
                              samples=pm.samples_done,
                              quantized=bool(tune_quant_block),
+                             pp=tune_pp,
                              predicted_ms=predicted_ms)
     return AutotuneResult(params=best, history=tuple(pm.history),
                           best_score=pm.best_score,

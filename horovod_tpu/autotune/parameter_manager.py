@@ -60,7 +60,16 @@ _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
 # instead of costing two recompiles.
 # v6 adds the fused-kernel backend dimension (docs/fused-kernels.md):
 # dead on an unquantized wire, where canonicalization collapses it.
-_DIMS = 7  # fusion, quant_block, tree, zero, overlap, streams, fused
+# v8 adds the pipeline schedule pair (docs/pipeline.md): pp_microbatches
+# (pow2, snapped to a multiple of the stage count) and pp_interleave
+# (pow2 virtual-stage degree) — both gated by tune_pp and dead (0 / 1)
+# when the session's step is not pipelined, where canonicalization
+# collapses them to one trial.
+_DIMS = 9  # fusion, qblock, tree, zero, overlap, streams, fused, ppM, ppV
+
+_MIN_PPM_LOG = 1.0   # 2^1 = 2 microbatches
+_MAX_PPM_LOG = 5.0   # 2^5 = 32 microbatches
+_MAX_PPV_LOG = 2.0   # 2^2 = 4 virtual stages per rank
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
@@ -68,9 +77,12 @@ _DIMS = 7  # fusion, quant_block, tree, zero, overlap, streams, fused
 # zero_stage carries the actual level. v5 appends the canonical `plan`
 # encoding column; v6 the `fused` kernel-backend knob. read_log stays
 # tolerant of v3/v4/v5 logs lacking the newer columns.
+# v8 appends the pipeline pair; read_log stays tolerant of v3..v7 logs
+# lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
               "overlap", "num_comm_streams", "fused",
+              "pp_microbatches", "pp_interleave",
               "score_steps_per_sec", "plan")
 
 
@@ -88,6 +100,10 @@ class TunedParams:
     overlap: bool = False
     num_comm_streams: int = 1
     fused: bool = False
+    # Pipeline schedule pair (docs/pipeline.md): 0 / 1 = "not a
+    # pipelined step" — the canonical dead-knob values.
+    pp_microbatches: int = 0
+    pp_interleave: int = 1
 
     @property
     def zero_sharding(self) -> bool:
@@ -105,6 +121,8 @@ class TunedParams:
             "overlap": bool(self.overlap),
             "num_comm_streams": int(self.num_comm_streams),
             "fused": bool(self.fused),
+            "pp_microbatches": int(self.pp_microbatches),
+            "pp_interleave": int(self.pp_interleave),
         }
 
     @classmethod
@@ -124,6 +142,8 @@ class TunedParams:
             overlap=bool(d.get("overlap", False)),
             num_comm_streams=int(d.get("num_comm_streams", 1)),
             fused=bool(d.get("fused", False)),
+            pp_microbatches=int(d.get("pp_microbatches", 0) or 0),
+            pp_interleave=int(d.get("pp_interleave", 1) or 1),
         )
 
     @classmethod
@@ -142,6 +162,8 @@ class TunedParams:
             overlap=getattr(config, "overlap", False),
             num_comm_streams=getattr(config, "num_comm_streams", 1),
             fused=getattr(config, "fused_kernels", False),
+            pp_microbatches=getattr(config, "pp_microbatches", 0) or 0,
+            pp_interleave=getattr(config, "pp_interleave", 1) or 1,
         )
 
 
@@ -189,6 +211,9 @@ class ParameterManager:
         tune_zero: bool = False,
         tune_overlap: bool = False,
         tune_fused: bool = False,
+        tune_pp: bool = False,
+        pp_stages: int = 0,
+        pp_max_interleave: int = 1,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -219,6 +244,16 @@ class ParameterManager:
         # exists (quantized); with quantized off, encode_tuned drops the
         # dimension and canonicalization dedups the trials away.
         self.tune_fused = tune_fused
+        # The pipeline pair restructures the WHOLE training schedule
+        # (microbatch count + virtual-stage interleave are trace-time
+        # schedule geometry), so like zero/overlap it is searched only
+        # when the session's step builder declares it can rebuild at a
+        # proposed (pp_microbatches, pp_interleave)
+        # (autotune_session(tune_pp=True, pp_stages=S)). With pp off the
+        # encoding drops the segment and both knobs canonicalize dead.
+        self.tune_pp = tune_pp
+        self.pp_stages = max(0, int(pp_stages))
+        self.pp_max_interleave = max(1, int(pp_max_interleave))
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -258,6 +293,8 @@ class ParameterManager:
         f = math.log2(max(1, p.fusion_threshold_bytes))
         q = math.log2(max(1, p.quant_block))
         s = math.log2(max(1, p.num_comm_streams))
+        ppm = math.log2(max(2, p.pp_microbatches or 2))
+        ppv = math.log2(max(1, p.pp_interleave))
         return (
             (f - _MIN_FUSION_LOG) / (_MAX_FUSION_LOG - _MIN_FUSION_LOG),
             (q - _MIN_QBLOCK_LOG) / (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG),
@@ -270,6 +307,8 @@ class ParameterManager:
             0.75 if p.overlap else 0.25,
             s / _MAX_STREAMS_LOG,
             0.75 if p.fused else 0.25,
+            (ppm - _MIN_PPM_LOG) / (_MAX_PPM_LOG - _MIN_PPM_LOG),
+            ppv / _MAX_PPV_LOG,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -298,6 +337,21 @@ class ParameterManager:
             ov = self.initial.overlap
             ns = self.initial.num_comm_streams
         fz = (u[6] >= 0.5 if self.tune_fused else self.initial.fused)
+        if self.tune_pp:
+            # pow2 snap, then round up to a multiple of the stage count
+            # (the interleaved grouping needs M % stages == 0).
+            ppm_l = _MIN_PPM_LOG + u[7] * (_MAX_PPM_LOG - _MIN_PPM_LOG)
+            ppm = 1 << max(int(_MIN_PPM_LOG),
+                           min(int(_MAX_PPM_LOG), round(ppm_l)))
+            if self.pp_stages > 1:
+                ppm = max(ppm, self.pp_stages)
+                ppm += (-ppm) % self.pp_stages
+            ppv = 1 << max(0, min(int(_MAX_PPV_LOG),
+                                  round(u[8] * _MAX_PPV_LOG)))
+            ppv = min(ppv, self.pp_max_interleave)
+        else:
+            ppm = self.initial.pp_microbatches
+            ppv = self.initial.pp_interleave
         return self._canonicalize(TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
@@ -306,13 +360,16 @@ class ParameterManager:
             overlap=ov,
             num_comm_streams=ns,
             fused=fz,
+            pp_microbatches=ppm,
+            pp_interleave=ppv,
         ))
 
     def _plan_of(self, p: TunedParams) -> str:
         """The canonical wire-plan encoding of a knob setting — the
         search-space coordinate the GP actually explores (``plan``
         column of the CSV, ``plan`` field of the v5 cache entry)."""
-        return _wire_planner.encode_tuned(p, quantized=self.tune_quant_block)
+        return _wire_planner.encode_tuned(
+            p, quantized=self.tune_quant_block, pp=self.tune_pp)
 
     def _canonicalize(self, p: TunedParams) -> TunedParams:
         """Snap a proposal onto its wire plan: knobs that are dead in
@@ -327,7 +384,9 @@ class ParameterManager:
             overlap=d["overlap"],
             num_comm_streams=d["num_comm_streams"],
             fused=d.get("fused", False),
-            quant_block=d.get("quant_block", p.quant_block))
+            quant_block=d.get("quant_block", p.quant_block),
+            pp_microbatches=d.get("pp_microbatches", 0),
+            pp_interleave=d.get("pp_interleave", 1))
 
     def _unit_key(self, p: TunedParams) -> tuple:
         """Dedup key: the snapped fusion threshold plus the canonical
@@ -380,6 +439,8 @@ class ParameterManager:
                             int(p.overlap),
                             int(p.num_comm_streams),
                             int(p.fused),
+                            int(p.pp_microbatches),
+                            int(p.pp_interleave),
                             f"{score:.6g}",
                             self._plan_of(p)])
         self._log.flush()
@@ -409,6 +470,9 @@ class ParameterManager:
             u[5] = 0.0
         if not self.tune_fused:
             u[6] = 0.25
+        if not self.tune_pp:
+            u[7] = 0.0
+            u[8] = 0.0
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -495,6 +559,9 @@ def read_log(path: str) -> List[dict]:
                 "num_comm_streams": int(rec.get("num_comm_streams", 1)
                                         or 1),
                 "fused": bool(int(rec.get("fused", 0) or 0)),
+                "pp_microbatches": int(rec.get("pp_microbatches", 0)
+                                       or 0),
+                "pp_interleave": int(rec.get("pp_interleave", 1) or 1),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             }
             enc = (rec.get("plan") or "").strip()

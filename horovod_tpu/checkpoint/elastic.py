@@ -98,6 +98,25 @@ class CheckpointedJaxState(JaxState):
         if latest is not None:
             manifest, tree = manager.restore(latest)
             world = basics.size() if basics.is_initialized() else 1
+            # Pipeline geometry guard (docs/pipeline.md): stage params
+            # and their optimizer state are laid out per stage CHUNK —
+            # there is no world-independent reshard across a stage-count
+            # change, so fail loudly with the recovery recipe instead of
+            # silently mis-assembling chunks. A same-stage world resize
+            # falls through to the ordinary reshard path.
+            saved_pp = int((manifest.extra or {}).get("pp_stages", 1)
+                           or 1)
+            cur_pp = basics.pp_size() if basics.is_initialized() else 1
+            if saved_pp != cur_pp:
+                raise ValueError(
+                    f"checkpoint step {manifest.step} was written on a "
+                    f"{saved_pp}-stage pipeline mesh but this process "
+                    f"runs {cur_pp} stages: per-stage chunk parameters "
+                    f"do not reshard across stage counts. Restore on a "
+                    f"mesh with pp_stages={saved_pp}, merge the chunks "
+                    f"back to the dense model (pp_split_chunks is a "
+                    f"pure reshape), and re-split for the new stage "
+                    f"count (docs/pipeline.md).")
             for key, value in tree.items():
                 if key in kwargs:
                     kwargs[key] = _reshard_value(
@@ -123,7 +142,10 @@ class CheckpointedJaxState(JaxState):
         self._mgr.save(step, self._durable_tree(),
                        extra={"obj": {k: getattr(self, k)
                                       for k in self._obj_keys
-                                      if _jsonable(getattr(self, k))}})
+                                      if _jsonable(getattr(self, k))},
+                              "pp_stages": (basics.pp_size()
+                                            if basics.is_initialized()
+                                            else 1)})
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Drain in-flight checkpoint writes (call before exiting)."""

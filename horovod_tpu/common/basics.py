@@ -62,6 +62,14 @@ POD_AXIS = "hvd_pod"
 HVD_AXES: Tuple[str, str] = (CROSS_AXIS, LOCAL_AXIS)
 ALL_AXES: Tuple[str, str, str] = (POD_AXIS, CROSS_AXIS, LOCAL_AXIS)
 
+# Pipeline-parallel mesh axis (docs/pipeline.md). Deliberately NOT part of
+# ALL_AXES: the pp axis carries pipeline *stages*, not data replicas — a
+# gradient collective over the "world" must never sum across ranks that
+# hold different model layers, so every axes=None collective resolves to
+# the data axes only and the pp axis is reached explicitly (the
+# ``send``-leg ppermutes of parallel/pipeline.py).
+PP_AXIS = "hvd_pp"
+
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; on the
 # pinned 0.4.x line only the experimental spelling exists. This resolver is
 # the single home every horovod_tpu caller (and the test suite, via
@@ -96,6 +104,7 @@ _state = _State()
 def _build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, ...]] = None,
+    pp_stages: Optional[int] = None,
 ) -> Mesh:
     """Arrange all job devices into the 2-D (cross, local) Horovod mesh.
 
@@ -116,6 +125,31 @@ def _build_mesh(
 
         devices = acquire_devices()
     devices = list(devices)
+    if pp_stages is not None and pp_stages > 1:
+        # Pipeline mesh: a leading hvd_pp axis of pipeline stages over
+        # the (cross, local) data mesh. Consecutive stages sit a full
+        # data-mesh apart in the device order, so the inter-stage hop
+        # crosses the slowest link class present (docs/pipeline.md).
+        if mesh_shape is not None and len(mesh_shape) == 3:
+            raise ValueError(
+                "pp_stages does not compose with a 3-level "
+                "(cross, local, pods) mesh_shape yet — the pp axis takes "
+                "the leading mesh dimension the pod axis would use")
+        if mesh_shape is not None:
+            cross, local = mesh_shape
+        else:
+            if len(devices) % pp_stages:
+                raise ValueError(
+                    f"pp_stages {pp_stages} does not divide "
+                    f"{len(devices)} devices")
+            cross, local = 1, len(devices) // pp_stages
+        if pp_stages * cross * local != len(devices):
+            raise ValueError(
+                f"pp_stages {pp_stages} x mesh_shape ({cross}, {local}) "
+                f"does not cover {len(devices)} devices")
+        grid = np.array(devices, dtype=object).reshape(
+            pp_stages, cross, local)
+        return Mesh(grid, (PP_AXIS, CROSS_AXIS, LOCAL_AXIS))
     if mesh_shape is not None:
         if len(mesh_shape) == 3:
             cross, local, pods = mesh_shape
@@ -209,6 +243,7 @@ def init(
     comm=None,
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, int]] = None,
+    pp_stages: Optional[int] = None,
 ) -> None:
     """Initialize the framework (reference: hvd.init(), basics.py:33 →
     InitializeHorovodOnce, operations.cc:628-674).
@@ -242,7 +277,9 @@ def init(
             from .backend import enable_overlap_scheduling
 
             enable_overlap_scheduling()
-        _state.mesh = _build_mesh(devices, mesh_shape)
+        if pp_stages is None:
+            pp_stages = _state.config.pp_stages or None
+        _state.mesh = _build_mesh(devices, mesh_shape, pp_stages)
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
         _state.local_device_count = int(_state.mesh.devices.shape[-1])
@@ -462,8 +499,11 @@ def world_axes() -> Tuple[str, ...]:
     before init — the 2-level names are the back-compat default)."""
     s = _state
     if (s.initialized and s.mesh is not None
-            and s.mesh.devices.ndim == 3):
+            and s.mesh.devices.ndim == 3
+            and s.mesh.axis_names[0] == POD_AXIS):
         return ALL_AXES
+    # A pipeline mesh's hvd_pp axis is NOT a world/data axis: data
+    # shards and gradient collectives stay on (cross, local) per stage.
     return HVD_AXES
 
 
@@ -512,9 +552,35 @@ def pod_size() -> int:
     """Number of pods (the third hierarchy level): the leading mesh dim
     of a 3-level ``(pod, cross, local)`` mesh, else 1."""
     s = _require_init()
-    if s.mesh is not None and s.mesh.devices.ndim == 3:
+    if (s.mesh is not None and s.mesh.devices.ndim == 3
+            and s.mesh.axis_names[0] == POD_AXIS):
         return int(s.mesh.devices.shape[0])
     return 1
+
+
+def pp_size() -> int:
+    """Number of pipeline stages: the leading ``hvd_pp`` mesh dim of a
+    pipeline mesh (``init(pp_stages=...)`` / ``HOROVOD_PP_STAGES``),
+    else 1 (docs/pipeline.md)."""
+    s = _require_init()
+    if (s.mesh is not None and s.mesh.devices.ndim == 3
+            and s.mesh.axis_names[0] == PP_AXIS):
+        return int(s.mesh.devices.shape[0])
+    return 1
+
+
+def data_mesh_shape() -> Tuple[int, ...]:
+    """The DATA mesh shape ``(cross, local[, pods])`` — the shape every
+    plan derivation prices. On a pipeline mesh the leading ``hvd_pp``
+    dim is excluded: gradient collectives run per-stage over the data
+    axes only."""
+    s = _require_init()
+    shp = s.mesh.devices.shape
+    if len(shp) == 2:
+        return (int(shp[0]), int(shp[1]))
+    if s.mesh.axis_names[0] == PP_AXIS:
+        return (int(shp[1]), int(shp[2]))
+    return (int(shp[1]), int(shp[2]), int(shp[0]))
 
 
 def mesh_geometry(mesh_shape=None, mesh=None) -> str:
@@ -529,12 +595,21 @@ def mesh_geometry(mesh_shape=None, mesh=None) -> str:
     init)."""
     if mesh is None and mesh_shape is None and is_initialized():
         mesh = _state.mesh
+    pp = ""
     if mesh is not None and mesh_shape is None:
         shp = mesh.devices.shape
-        mesh_shape = (tuple(int(v) for v in shp) if len(shp) == 2
-                      else (int(shp[1]), int(shp[2]), int(shp[0])))
+        if len(shp) == 2:
+            mesh_shape = tuple(int(v) for v in shp)
+        elif mesh.axis_names[0] == PP_AXIS:
+            # Pipeline mesh: the fingerprint is the DATA mesh plus an
+            # explicit pp marker — a winner tuned at one stage count
+            # never warm-starts another (docs/pipeline.md).
+            mesh_shape = (int(shp[1]), int(shp[2]))
+            pp = f"pp{int(shp[0])}"
+        else:
+            mesh_shape = (int(shp[1]), int(shp[2]), int(shp[0]))
     if mesh_shape:
-        shape = "x".join(str(int(v)) for v in mesh_shape)
+        shape = "x".join(str(int(v)) for v in mesh_shape) + pp
         world = 1
         for v in mesh_shape:
             world *= int(v)

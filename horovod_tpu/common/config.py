@@ -92,6 +92,19 @@ class Config:
     # pair instead of the exact psum (docs/wire-plan.md)
     quantized_pod: bool = False
 
+    # --- pipeline parallelism (docs/pipeline.md): a dedicated hvd_pp
+    #     mesh axis of pp_stages stages; the training schedule pumps
+    #     pp_microbatches microbatches through it (gpipe | 1f1b |
+    #     interleaved_1f1b with pp_interleave virtual stages per rank).
+    #     pp_quantized rides the inter-stage activation sends as
+    #     blockwise-int8 wire-plan legs with error feedback (DCN/pod
+    #     hops only — the send leg inherits the EQuARX placement rule).
+    pp_stages: int = 0          # 0/1 = pipeline off
+    pp_microbatches: int = 0    # 0 = schedule default (max(stages, 2))
+    pp_schedule: str = "interleaved_1f1b"
+    pp_interleave: int = 1      # virtual stages per rank (>=1)
+    pp_quantized: bool = False
+
     # --- autotune (common.h:68-73) ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -164,6 +177,12 @@ def from_env() -> Config:
         num_comm_streams=_env_int("HOROVOD_NUM_COMM_STREAMS", 1),
         fused_kernels=_env_bool("HOROVOD_FUSED_KERNELS", False),
         quantized_pod=_env_bool("HOROVOD_QUANTIZED_POD", False),
+        pp_stages=_env_int("HOROVOD_PP_STAGES", 0),
+        pp_microbatches=_env_int("HOROVOD_PP_MICROBATCHES", 0),
+        pp_schedule=_env_str("HOROVOD_PP_SCHEDULE", "interleaved_1f1b")
+        or "interleaved_1f1b",
+        pp_interleave=_env_int("HOROVOD_PP_INTERLEAVE", 1),
+        pp_quantized=_env_bool("HOROVOD_PP_QUANTIZED", False),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),
         autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
         autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

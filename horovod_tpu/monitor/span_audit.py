@@ -10,7 +10,8 @@ and ``tests/test_serve``.
 Span vocabularies audited today (docs/observability.md has the full
 event table): ``OVERLAP:*`` (streamed bucket collectives),
 ``FUSED:*`` (fused Pallas kernel calls, docs/fused-kernels.md),
-``SERVE:PREFILL/DECODE``, ``PROFILE:*``, ``CKPT:*``.
+``PP:*`` (pipeline send legs + per-rank schedule slots,
+docs/pipeline.md), ``SERVE:PREFILL/DECODE``, ``PROFILE:*``, ``CKPT:*``.
 """
 
 from __future__ import annotations

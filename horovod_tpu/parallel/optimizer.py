@@ -355,6 +355,10 @@ def DistributedOptimizer(
     axes=None,
     tuned_params=None,
     plan=None,
+    pp_stages: Optional[int] = None,
+    pp_microbatches: Optional[int] = None,
+    pp_schedule: Optional[str] = None,
+    pp_interleave: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with fused gradient allreduce.
 
@@ -436,6 +440,17 @@ def DistributedOptimizer(
     replicated path's bucket collectives lower through exactly
     ``plan.gradient`` (docs/wire-plan.md). Explicit kwargs still win;
     ``tuned_params`` applies after the plan.
+
+    ``pp_stages`` / ``pp_microbatches`` / ``pp_schedule`` /
+    ``pp_interleave`` (defaults: the live mesh's ``hvd_pp`` axis and the
+    ``HOROVOD_PP_*`` knobs; a ``plan``'s pp record and ``tuned_params``'
+    pp fields fill unset values first) declare the pipeline composition
+    this optimizer's step runs under (docs/pipeline.md). The gradient
+    wire itself is already pipeline-safe — ``axes=None`` resolves to the
+    DATA axes, so per-stage reductions never cross the pp axis — these
+    knobs validate the composition up front (stage count vs mesh,
+    schedule family, microbatch divisibility) and fail loudly instead of
+    letting a mismatched schedule train garbage.
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -443,6 +458,9 @@ def DistributedOptimizer(
             "(reference: tensorflow/__init__.py:452-455)")
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
+    _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
+                       pp_interleave, plan=plan,
+                       tuned_params=tuned_params)
     quant_block = None
     grad_plan = None
     if plan is not None:
@@ -600,6 +618,77 @@ def DistributedOptimizer(
     return _with_step_marker(tx)
 
 
+def _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
+                       pp_interleave, *, plan=None,
+                       tuned_params=None) -> dict:
+    """Resolve + validate the pipeline knobs of a training step
+    (docs/pipeline.md). The optimizer's gradient collectives are already
+    pipeline-safe by construction — ``axes=None`` resolves to the DATA
+    axes, never ``hvd_pp`` — so these knobs exist to fail loudly on a
+    misconfigured composition (a stage count that disagrees with the
+    live mesh, an unknown schedule, an interleave the schedule cannot
+    honor) and to record the resolved values for describe/debug.
+
+    Returns the resolved ``{pp_stages, pp_microbatches, pp_schedule,
+    pp_interleave}`` dict. Shared by :class:`DistributedOptimizer` and
+    :func:`horovod_tpu.value_and_grad`."""
+    from .pipeline import PP_SCHEDULES
+
+    if plan is not None and hasattr(plan, "pp_stages"):
+        if pp_stages is None and getattr(plan, "pp_stages", 0):
+            pp_stages = plan.pp_stages
+        if pp_microbatches is None and getattr(plan, "pp_microbatches", 0):
+            pp_microbatches = plan.pp_microbatches
+        if pp_schedule is None and getattr(plan, "send", None) is not None:
+            pp_schedule = plan.pp_schedule
+        if pp_interleave is None and getattr(plan, "send", None) is not None:
+            pp_interleave = plan.pp_interleave
+    if tuned_params is not None:
+        if pp_microbatches is None:
+            pp_microbatches = getattr(tuned_params, "pp_microbatches",
+                                      0) or None
+        if pp_interleave is None:
+            pp_interleave = getattr(tuned_params, "pp_interleave",
+                                    0) or None
+    cfg = basics.config() if basics.is_initialized() else None
+    if pp_stages is None:
+        pp_stages = (basics.pp_size() if basics.is_initialized()
+                     else (cfg.pp_stages if cfg else 0))
+    if pp_schedule is None:
+        pp_schedule = cfg.pp_schedule if cfg else "interleaved_1f1b"
+    if pp_interleave is None:
+        pp_interleave = (cfg.pp_interleave if cfg else 1) or 1
+    if pp_microbatches is None:
+        pp_microbatches = (cfg.pp_microbatches if cfg else 0)
+    pp_stages = int(pp_stages or 0)
+    pp_interleave = max(1, int(pp_interleave))
+    pp_microbatches = int(pp_microbatches or 0)
+    if pp_stages > 1:
+        if pp_schedule not in PP_SCHEDULES:
+            raise ValueError(
+                f"unknown pp_schedule {pp_schedule!r}: one of "
+                f"{PP_SCHEDULES} (docs/pipeline.md)")
+        if basics.is_initialized() and basics.pp_size() > 1 \
+                and pp_stages != basics.pp_size():
+            raise ValueError(
+                f"pp_stages={pp_stages} disagrees with the live mesh's "
+                f"hvd_pp axis of {basics.pp_size()} stages — the stage "
+                f"count is mesh geometry (hvd.init(pp_stages=...))")
+        if pp_interleave > 1 and pp_schedule != "interleaved_1f1b":
+            raise ValueError(
+                f"pp_interleave={pp_interleave} needs "
+                f"pp_schedule='interleaved_1f1b'; {pp_schedule!r} does "
+                f"not interleave virtual stages")
+        if (pp_schedule == "interleaved_1f1b" and pp_interleave > 1
+                and pp_microbatches and pp_microbatches % pp_stages):
+            raise ValueError(
+                f"pp_microbatches={pp_microbatches} must divide by "
+                f"pp_stages={pp_stages} for the interleaved schedule "
+                f"(docs/pipeline.md)")
+    return {"pp_stages": pp_stages, "pp_microbatches": pp_microbatches,
+            "pp_schedule": pp_schedule, "pp_interleave": pp_interleave}
+
+
 # ---------------------------------------------------------------------------
 # ZeRO: reduce-scatter data parallelism with per-rank optax updates.
 # ---------------------------------------------------------------------------
@@ -637,8 +726,11 @@ def _zero_worlds(axes) -> Tuple[int, int, bool]:
         return w, w, True
     if not basics.is_initialized():
         return 1, 1, False
-    plan_w = basics.size()
-    own_w = basics.size() if basics._process_world() else 1
+    # On a pipeline mesh the ZeRO world is the DATA world: each stage's
+    # shards split over (cross, local) only — exactly what the in-trace
+    # path resolves, since the hvd_pp axis is never a world axis.
+    plan_w = basics.size() // basics.pp_size()
+    own_w = plan_w if basics._process_world() else 1
     return plan_w, own_w, False
 
 

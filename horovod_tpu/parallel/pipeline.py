@@ -29,8 +29,12 @@ Exact vs the dense model (tests/test_pipeline_parallel.py).
 
 from __future__ import annotations
 
+import dataclasses
+from typing import List, Optional, Tuple
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from .sequence import _axis_size
@@ -44,6 +48,34 @@ def _pvary_tree(tree, axes):
     if not axes:
         return tree
     return jax.tree.map(lambda a: pvary_missing(a, tuple(axes)), tree)
+
+
+def _send_plan_for_axis(axis, *, quantized: bool = False,
+                        block: Optional[int] = None,
+                        error_feedback: bool = False):
+    """The send plan of a pipeline hop over ``axis`` (docs/pipeline.md):
+    the leg's level is the slowest link class the axis tuple spans —
+    pod > dcn > ici — because a hop over a multi-level axis crosses its
+    widest stride. Quantization is forced off on an ICI hop (the
+    EQuARX placement rule the IR validates)."""
+    from ..common import basics
+    from ..common.basics import CROSS_AXIS, PP_AXIS, POD_AXIS
+    from ..plan import planner as _planner
+
+    axes = {axis} if isinstance(axis, str) else set(axis)
+    if POD_AXIS in axes:
+        level = _planner.POD
+    elif CROSS_AXIS in axes:
+        level = _planner.DCN
+    elif PP_AXIS in axes and basics.is_initialized():
+        # The dedicated pp axis leads the mesh: one hop jumps a whole
+        # data mesh, i.e. the slowest link class the DATA mesh spans.
+        level = _planner.pp_send_level(basics.data_mesh_shape())
+    else:
+        level = _planner.ICI
+    q = quantized and level != _planner.ICI
+    return _planner.send_plan(level, quantized=q, block=block,
+                              error_feedback=error_feedback and q)
 
 
 def _carry_axes(axis, x_mbs, stage_params):
@@ -73,6 +105,14 @@ def gpipe(stage_fn, stage_params, x_mbs, *, axis):
     M = x_mbs.shape[0]
     steps = M + n - 1
     shift = [(i, i + 1) for i in range(n - 1)]   # non-cyclic: 0→1→...→n-1
+    # The relay hop is a wire-plan send leg (docs/pipeline.md): same
+    # ppermute as always, but lowered by plan/compiler.py so the legacy
+    # GPipe wire finally shows up in WireStats/comm.bytes{hop} (the scan
+    # body traces once — ``repeats=steps`` charges the true per-pass
+    # bytes; the autodiff-transposed backward hop is not re-accounted).
+    from ..plan import compiler as _compiler
+
+    splan = _send_plan_for_axis(axis)
 
     def body(carry, t):
         state, outputs = carry
@@ -92,7 +132,8 @@ def gpipe(stage_fn, stage_params, x_mbs, *, axis):
             jnp.where(valid, write, outputs[idx]))
         # Hop to the next stage (rank n-1's output leaves the ring; rank
         # 0 receives zeros it never reads).
-        state = lax.ppermute(y, axis, shift)
+        state, _ = _compiler.lower_send(splan, y, axis=axis, perm=shift,
+                                        repeats=steps)
         return (state, outputs), None
 
     # Scan carries become varying over the pipeline axis (per-rank stages
@@ -391,6 +432,9 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
     down = [(i + 1, i) for i in range(n - 1)]
     is_last = r == n - 1
     fzero = jnp.float32(0)
+    from ..plan import compiler as _compiler
+
+    splan = _send_plan_for_axis(axis)
 
     from ..ops.collective_ops import _vma, pvary_missing
 
@@ -438,7 +482,9 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
         write_dx = jnp.logical_and(b_valid, r == 0)
         d_x = d_x.at[bidx].set(
             jnp.where(write_dx, gx.astype(jnp.float32), d_x[bidx]))
-        new_gract = lax.ppermute(gx.astype(jnp.float32), ax, down)
+        new_gract, _ = _compiler.lower_send(
+            splan, gx.astype(jnp.float32), axis=ax, perm=down,
+            repeats=T_ticks)
 
         # ---- forward phase: F(m_f) with m_f = t - r ----
         m_f = t - r
@@ -467,7 +513,8 @@ def gpipe_1f1b(stage_fn, loss_fn, stage_params, head_params, x_mbs,
             lambda acc, g: acc + jnp.where(take, g, 0.0).astype(acc.dtype),
             d_hp, g_hp_m)
         dy_state = jnp.where(take, dy.astype(jnp.float32), dy_state)
-        act = lax.ppermute(y, ax, up)
+        act, _ = _compiler.lower_send(splan, y, axis=ax, perm=up,
+                                      repeats=T_ticks)
 
         return (act, new_gract, stash, dy_state, d_sp, d_hp, d_x,
                 loss_acc), None
@@ -535,3 +582,570 @@ def pipelined_gpt_train_1f1b(cfg, stage_params, rest, tokens, targets, *,
         "ln_f": g_hp["ln_f"],
     }
     return loss, g_stages, g_rest
+
+
+# ---------------------------------------------------------------------------
+# Interleaved-1F1B (docs/pipeline.md): the production schedule. The model
+# splits into K = n * v CHUNKS placed round-robin (chunk c on rank c % n,
+# local index j = c // n), so each rank holds v non-contiguous "virtual
+# stages". Per tick every rank executes at most ONE unit — a chunk
+# forward F(m, j) or a chunk backward B(m, j) — and two cyclic ppermutes
+# move the tick's products one hop: activations up (r -> r+1 mod n),
+# activation-grads down. The unit order per rank is Megatron-LM's
+# interleaved-1F1B stream (warmup forwards, strict 1F1B alternation,
+# cooldown backwards); the tick assignment comes from a host-side
+# simulation of that stream under the 1-tick hop latency, so the whole
+# schedule — including every stash slot — is STATIC tables the SPMD scan
+# body indexes with the traced rank. Bubble fraction falls from GPipe's
+# (S-1)/(M+S-1) to ~(S-1)/(Mv+S-1): the interleave divides the fill.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PPSchedule:
+    """Static interleaved-1F1B schedule tables (host-built, rank-major).
+
+    Every table is ``[n, ticks]`` int32, indexed ``[rank, tick]`` inside
+    the scan body. Slot ids index the three stash pools (activation /
+    grad / dy); ``-1`` means "no unit" / "discard" / "read x_mbs".
+    """
+
+    stages: int
+    interleave: int
+    microbatches: int
+    ticks: int
+    act_slots: int
+    grad_slots: int
+    dy_slots: int
+    # forward unit: valid, microbatch, local chunk, input act slot
+    # (-1 = x_mbs), dy slot to write (>=0 marks the LAST chunk)
+    f_valid: np.ndarray
+    f_m: np.ndarray
+    f_j: np.ndarray
+    f_src: np.ndarray
+    f_dy: np.ndarray
+    # backward unit: valid, microbatch, local chunk, remat act slot
+    # (-1 = x_mbs = chunk 0), grad slot to read (-1 = read dy), dy slot
+    b_valid: np.ndarray
+    b_m: np.ndarray
+    b_j: np.ndarray
+    b_src: np.ndarray
+    b_g: np.ndarray
+    b_dy: np.ndarray
+    # arrival routing: where this tick's incoming ppermute values land
+    arr_a: np.ndarray
+    arr_g: np.ndarray
+
+    @property
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the rank x tick grid — the measured bubble
+        (each tick is one chunk-unit of compute; garbage masked units in
+        the bubble cost the same wall time as real ones under SPMD)."""
+        busy = int(self.f_valid.sum() + self.b_valid.sum())
+        return 1.0 - busy / float(self.stages * self.ticks)
+
+    def unit_count(self) -> int:
+        return int(self.f_valid.sum() + self.b_valid.sum())
+
+
+def _interleaved_streams(M: int, n: int, v: int) -> List[List[tuple]]:
+    """Megatron-LM's interleaved-1F1B unit stream per rank: warmup
+    forwards, 1F1B alternation, cooldown backwards. Units are
+    ``("F"|"B", microbatch, local_chunk)``."""
+    total = M * v
+
+    def fwd_unit(k: int) -> tuple:
+        if v == 1:
+            return ("F", k, 0)
+        j = (k // n) % v
+        m = (k // (n * v)) * n + k % n
+        return ("F", m, j)
+
+    def bwd_unit(k: int) -> tuple:
+        if v == 1:
+            return ("B", k, 0)
+        j = v - 1 - (k // n) % v
+        m = (k // (n * v)) * n + k % n
+        return ("B", m, j)
+
+    streams = []
+    for r in range(n):
+        if v == 1:
+            warm = min(n - r - 1, total)
+        else:
+            warm = min((n - r - 1) * 2 + (v - 1) * n, total)
+        seq = [fwd_unit(k) for k in range(warm)]
+        fi, bi = warm, 0
+        while fi < total:
+            seq.append(fwd_unit(fi))
+            seq.append(bwd_unit(bi))
+            fi += 1
+            bi += 1
+        while bi < total:
+            seq.append(bwd_unit(bi))
+            bi += 1
+        streams.append(seq)
+    return streams
+
+
+def _alloc_slots(intervals: List[tuple]) -> Tuple[dict, int]:
+    """Greedy interval-graph coloring: ``intervals`` is a list of
+    ``(key, start, end)`` (inclusive); returns ``(slot_of_key,
+    pool_size)``. Deterministic: sorted by (start, key)."""
+    slot_of = {}
+    free: List[int] = []
+    in_use: List[tuple] = []  # (end, slot)
+    n_slots = 0
+    for key, start, end in sorted(intervals,
+                                  key=lambda it: (it[1], str(it[0]))):
+        still = []
+        for iu_end, iu_slot in in_use:
+            if iu_end < start:
+                free.append(iu_slot)
+            else:
+                still.append((iu_end, iu_slot))
+        in_use = still
+        if free:
+            s = min(free)
+            free.remove(s)
+        else:
+            s = n_slots
+            n_slots += 1
+        slot_of[key] = s
+        in_use.append((end, s))
+    return slot_of, n_slots
+
+
+def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
+    """Simulate the interleaved-1F1B streams under the 1-tick hop
+    latency and freeze the result as static tables (docs/pipeline.md).
+
+    Requires ``M % n == 0`` when ``v > 1`` (the Megatron grouping the
+    forward/backward unit order is built from)."""
+    if n < 2:
+        raise ValueError("build_interleaved_schedule needs >= 2 stages")
+    if v < 1:
+        raise ValueError(f"interleave must be >= 1, got {v}")
+    if v > 1 and M % n:
+        raise ValueError(
+            f"interleaved-1F1B needs microbatches ({M}) divisible by "
+            f"the stage count ({n}): the Megatron unit order pumps "
+            f"groups of <stages> microbatches through each virtual "
+            f"stage (docs/pipeline.md)")
+    K = n * v
+    streams = _interleaved_streams(M, n, v)
+    ptr = [0] * n
+    done_f: dict = {}   # (m, c) -> tick
+    done_b: dict = {}
+    exec_at: List[List[tuple]] = [[] for _ in range(n)]  # (tick, unit)
+    t = 0
+    cap = 8 * (2 * M * v + 2 * K) + 64
+    while any(p < len(s) for p, s in zip(ptr, streams)):
+        if t > cap:
+            raise AssertionError(
+                f"pipeline schedule simulation did not converge "
+                f"(M={M}, n={n}, v={v})")  # pragma: no cover
+        for r in range(n):
+            if ptr[r] >= len(streams[r]):
+                continue
+            kind, m, j = streams[r][ptr[r]]
+            c = j * n + r
+            if kind == "F":
+                ready = c == 0 or done_f.get((m, c - 1), t) <= t - 1
+            elif c == K - 1:
+                ready = done_f.get((m, c), t) <= t - 1
+            else:
+                ready = done_b.get((m, c + 1), t) <= t - 1
+            if not ready:
+                continue
+            (done_f if kind == "F" else done_b)[(m, c)] = t
+            exec_at[r].append((t, (kind, m, j, c)))
+            ptr[r] += 1
+        t += 1
+    T = t
+
+    # --- stash slot allocation (per pool, shared across ranks so the
+    # tables index one pool shape) -------------------------------------
+    act_iv, grad_iv, dy_iv = [], [], []
+    for m in range(M):
+        for c in range(K):
+            tf, tb = done_f[(m, c)], done_b[(m, c)]
+            if c > 0:
+                ta = done_f[(m, c - 1)] + 1
+                act_iv.append(((m, c), ta, tb))
+            if c < K - 1:
+                ta = done_b[(m, c + 1)] + 1
+                grad_iv.append(((m, c), ta, tb))
+            else:
+                dy_iv.append(((m, c), tf, tb))
+    act_slot, n_act = _alloc_slots(act_iv)
+    grad_slot, n_grad = _alloc_slots(grad_iv)
+    dy_slot, n_dy = _alloc_slots(dy_iv)
+
+    full = lambda fill: np.full((n, T), fill, np.int32)  # noqa: E731
+    fv, fm, fj, fsrc, fdy = (full(0), full(0), full(0), full(-1),
+                             full(-1))
+    bv, bm, bj, bsrc, bg, bdy = (full(0), full(0), full(0), full(-1),
+                                 full(-1), full(-1))
+    arr_a, arr_g = full(-1), full(-1)
+    for r in range(n):
+        for tick, (kind, m, j, c) in exec_at[r]:
+            if kind == "F":
+                fv[r, tick], fm[r, tick], fj[r, tick] = 1, m, j
+                if c > 0:
+                    fsrc[r, tick] = act_slot[(m, c)]
+                if c == K - 1:
+                    fdy[r, tick] = dy_slot[(m, c)]
+            else:
+                bv[r, tick], bm[r, tick], bj[r, tick] = 1, m, j
+                if c > 0:
+                    bsrc[r, tick] = act_slot[(m, c)]
+                if c == K - 1:
+                    bdy[r, tick] = dy_slot[(m, c)]
+                else:
+                    bg[r, tick] = grad_slot[(m, c)]
+            # Arrival routing at the CONSUMER: the up hop of F(m, c)
+            # lands the activation of chunk c+1 on rank (r+1) % n one
+            # tick later; the down hop of B(m, c) lands the grad of
+            # chunk c-1 on rank (r-1) % n.
+            if kind == "F" and c < K - 1 and tick + 1 < T:
+                arr_a[(r + 1) % n, tick + 1] = act_slot[(m, c + 1)]
+            if kind == "B" and c > 0 and tick + 1 < T:
+                arr_g[(r - 1) % n, tick + 1] = grad_slot[(m, c - 1)]
+    return PPSchedule(
+        stages=n, interleave=v, microbatches=M, ticks=T,
+        act_slots=max(1, n_act), grad_slots=max(1, n_grad),
+        dy_slots=max(1, n_dy),
+        f_valid=fv, f_m=fm, f_j=fj, f_src=fsrc, f_dy=fdy,
+        b_valid=bv, b_m=bm, b_j=bj, b_src=bsrc, b_g=bg, b_dy=bdy,
+        arr_a=arr_a, arr_g=arr_g)
+
+
+def emit_schedule_spans(sched: PPSchedule) -> None:
+    """Mirror the schedule onto the Timeline as per-rank ``PP:F`` /
+    ``PP:B`` spans (tid ``pp-rank<r>``, tick-indexed timestamps) plus a
+    ``PP:SCHEDULE`` instant carrying the measured bubble fraction —
+    ``span_audit`` audits the balance, ``obs_report``/bench read the
+    bubble (docs/pipeline.md). Trace-time, like every span here."""
+    from ..common import basics
+
+    tl = basics._state.timeline if basics.is_initialized() else None
+    if tl is None:
+        return
+    tl.instant("PP:SCHEDULE", tid="pp", args={
+        "stages": sched.stages, "interleave": sched.interleave,
+        "microbatches": sched.microbatches, "ticks": sched.ticks,
+        "bubble_fraction": round(sched.bubble_fraction, 6)})
+    for r in range(sched.stages):
+        tid = f"pp-rank{r}"
+        for t in range(sched.ticks):
+            if sched.f_valid[r, t]:
+                tl.begin(tid, "PP:F")
+                tl.end(tid, "PP:F")
+            if sched.b_valid[r, t]:
+                tl.begin(tid, "PP:B")
+                tl.end(tid, "PP:B")
+
+
+def pp_split_chunks(params, n: int, v: int = 1):
+    """Dense GPT params → (chunks, rest) for the interleaved schedule.
+
+    ``chunks``: each transformer-block leaf stacked ``[n, v, L/(n*v),
+    ...]`` — rank r's local chunk j holds blocks of GLOBAL chunk
+    ``c = j * n + r`` (round-robin placement), i.e. blocks
+    ``[c*L/K, (c+1)*L/K)``. Pass through shard_map with
+    ``in_specs=P(pp_axis)`` and squeeze the leading dim; ``v = 1``
+    degenerates to :func:`pp_split_blocks`' contiguous split. ``rest``:
+    the replicated embedding/head tree."""
+    blocks = sorted((k for k in params if k.startswith("h")),
+                    key=lambda k: int(k[1:]))
+    L = len(blocks)
+    K = n * v
+    if L % K:
+        raise ValueError(
+            f"{L} blocks not divisible by {n} stages x {v} virtual "
+            f"stages = {K} chunks")
+    per = L // K
+
+    def stack(*leaves):
+        return jnp.stack([
+            jnp.stack([
+                jnp.stack(leaves[(j * n + r) * per:(j * n + r + 1) * per])
+                for j in range(v)])
+            for r in range(n)])
+
+    chunks = jax.tree.map(stack, *[params[b] for b in blocks])
+    rest = {k: p for k, p in params.items() if not k.startswith("h")}
+    return chunks, rest
+
+
+def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
+                     tgt_mbs, *, axis, interleave: int = 1,
+                     send_plan=None, sched: Optional[PPSchedule] = None):
+    """Interleaved-1F1B pipeline: loss + gradients in one fused pass,
+    bubble ~``(S-1)/(Mv+S-1)`` vs GPipe's ``(S-1)/(M+S-1)``.
+
+    Same contract as :func:`gpipe_1f1b` with ``chunk_params`` this
+    rank's ``[v, ...]`` stacked virtual-stage tree (``stage_fn(chunk,
+    x)`` applies ONE chunk); returns ``(loss, d_chunk_params,
+    d_head_params, d_x_mbs)`` with the same replication/per-data-shard
+    semantics. Inter-stage hops are wire-plan ``send`` legs
+    (``send_plan``; default: the payload-dtype plan for ``axis``' link
+    class — pass a quantized plan for the int8+EF activation wire)."""
+    n = _axis_size(axis)
+    v = max(1, int(interleave))
+    M = x_mbs.shape[0]
+    if n == 1:
+        def full_fn(cp, x):
+            for j in range(v):
+                x = stage_fn(jax.tree.map(lambda a: a[j], cp), x)
+            return x
+
+        return gpipe_1f1b(full_fn, loss_fn, chunk_params, head_params,
+                          x_mbs, tgt_mbs, axis=axis)
+
+    from ..plan import compiler as _compiler
+    from ..plan.accounting import pp_span
+
+    if sched is None:
+        sched = build_interleaved_schedule(M, n, v)
+    if sched.microbatches != M or sched.stages != n \
+            or sched.interleave != v:
+        raise ValueError(
+            f"schedule is ({sched.microbatches} microbatches, "
+            f"{sched.stages} stages, x{sched.interleave}), step wants "
+            f"({M}, {n}, x{v})")
+    if send_plan is None:
+        send_plan = _send_plan_for_axis(axis)
+    splan = send_plan.validate()
+    ef = any(l.error_feedback for l in splan.legs)
+    emit_schedule_spans(sched)
+
+    ax = axis if isinstance(axis, str) else tuple(axis)
+    r = lax.axis_index(ax)
+    T = sched.ticks
+    up = [(i, (i + 1) % n) for i in range(n)]
+    down = [(i, (i - 1) % n) for i in range(n)]
+    fzero = jnp.float32(0)
+
+    from ..ops.collective_ops import _vma, pvary_missing
+
+    axes_t = _carry_axes(axis, x_mbs, chunk_params)
+
+    def vary(tree):
+        return _pvary_tree(tree, axes_t)
+
+    tables = {k: jnp.asarray(getattr(sched, k)) for k in (
+        "f_valid", "f_m", "f_j", "f_src", "f_dy",
+        "b_valid", "b_m", "b_j", "b_src", "b_g", "b_dy",
+        "arr_a", "arr_g")}
+
+    mb_shape = x_mbs.shape[1:]
+    zmb = pvary_missing(jnp.zeros(mb_shape, x_mbs.dtype), axes_t)
+    zmb32 = zmb.astype(jnp.float32)
+    pool = lambda k, dt: vary(jnp.zeros((k,) + mb_shape, dt))  # noqa: E731
+    res0 = (zmb32, zmb32) if ef else None
+    carry0 = (
+        zmb,                                   # activation in transit
+        zmb32,                                 # grad in transit
+        pool(sched.act_slots, x_mbs.dtype),    # received-act + remat stash
+        pool(sched.grad_slots, jnp.float32),   # received-grad stash
+        pool(sched.dy_slots, jnp.float32),     # dy stash (last chunk)
+        vary(jax.tree.map(jnp.zeros_like, chunk_params)),   # d_chunks
+        vary(jax.tree.map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), head_params)),
+        vary(jnp.zeros(x_mbs.shape, jnp.float32)),          # d_x_mbs
+        pvary_missing(fzero, axes_t),                       # loss accum
+        res0,                                  # send EF residuals
+    )
+
+    def cell(idx):
+        return lambda tbl: tbl[r, idx]
+
+    def tick(carry, t):
+        (act_in, grad_in, apool, gpool, dypool, d_cp, d_hp, d_x,
+         loss_acc, res) = carry
+        at = cell(t)
+
+        # -- arrivals: last tick's ppermute values land in their slots
+        aslot = at(tables["arr_a"])
+        ai = jnp.clip(aslot, 0, sched.act_slots - 1)
+        apool = apool.at[ai].set(
+            jnp.where(aslot >= 0, act_in, apool[ai]))
+        gslot = at(tables["arr_g"])
+        gi = jnp.clip(gslot, 0, sched.grad_slots - 1)
+        gpool = gpool.at[gi].set(
+            jnp.where(gslot >= 0, grad_in, gpool[gi]))
+
+        # -- backward unit first (consumes only pre-tick state) --------
+        b_on = at(tables["b_valid"]) > 0
+        bm = jnp.clip(at(tables["b_m"]), 0, M - 1)
+        bj = at(tables["b_j"])
+        bsrc = at(tables["b_src"])
+        x_saved = jnp.where(
+            bsrc >= 0,
+            apool[jnp.clip(bsrc, 0, sched.act_slots - 1)],
+            x_mbs[bm])
+        _, chunk_vjp = jax.vjp(
+            lambda p, x: stage_fn(jax.tree.map(lambda a: a[bj], p), x),
+            vary(chunk_params), x_saved)
+        bdy = at(tables["b_dy"])
+        bgs = at(tables["b_g"])
+        gy = jnp.where(
+            bdy >= 0,
+            dypool[jnp.clip(bdy, 0, sched.dy_slots - 1)],
+            gpool[jnp.clip(bgs, 0, sched.grad_slots - 1)])
+        g_cp, gx = chunk_vjp(gy.astype(x_saved.dtype))
+        d_cp = jax.tree.map(
+            lambda acc, g: acc + jnp.where(b_on, g, 0.0).astype(
+                acc.dtype), d_cp, g_cp)
+        write_dx = jnp.logical_and(b_on, bsrc < 0)  # chunk 0 <=> rank 0
+        d_x = d_x.at[bm].set(
+            jnp.where(write_dx, gx.astype(jnp.float32), d_x[bm]))
+
+        # -- forward unit ----------------------------------------------
+        f_on = at(tables["f_valid"]) > 0
+        fm = jnp.clip(at(tables["f_m"]), 0, M - 1)
+        fj = at(tables["f_j"])
+        fsrc = at(tables["f_src"])
+        x_in = jnp.where(
+            fsrc >= 0,
+            apool[jnp.clip(fsrc, 0, sched.act_slots - 1)],
+            x_mbs[fm])
+        y = stage_fn(jax.tree.map(lambda a: a[fj], chunk_params), x_in)
+        # last chunk: per-microbatch loss + head grads + dy stash (the
+        # vjp enters through VARYING copies — see gpipe_1f1b).
+        hp_vary = vary(head_params)
+        tgt = tgt_mbs[fm]
+        loss_m, head_vjp = jax.vjp(
+            lambda hp, yy: loss_fn(hp, yy, tgt), hp_vary, y)
+        g_hp_m, dy = head_vjp(pvary_missing(
+            jnp.float32(1), tuple(sorted(_vma(loss_m)))))
+        fdy = at(tables["f_dy"])
+        take = jnp.logical_and(f_on, fdy >= 0)
+        loss_acc = loss_acc + jnp.where(take, loss_m, fzero)
+        d_hp = jax.tree.map(
+            lambda acc, g: acc + jnp.where(take, g, 0.0).astype(
+                acc.dtype), d_hp, g_hp_m)
+        di = jnp.clip(fdy, 0, sched.dy_slots - 1)
+        dypool = dypool.at[di].set(
+            jnp.where(take, dy.astype(jnp.float32), dypool[di]))
+
+        # -- the tick's two send legs ----------------------------------
+        a_res, g_res = res if ef else (None, None)
+        act_out, a_res = _compiler.lower_send(
+            splan, y, axis=ax, perm=up, residual=a_res, repeats=T)
+        grad_out, g_res = _compiler.lower_send(
+            splan, gx.astype(jnp.float32), axis=ax, perm=down,
+            residual=g_res, repeats=T)
+        new_res = (a_res, g_res) if ef else None
+        return (act_out, grad_out, apool, gpool, dypool, d_cp, d_hp,
+                d_x, loss_acc, new_res), None
+
+    with pp_span("SCHED"):
+        (_, _, _, _, _, d_cp, d_hp, d_x, loss_acc, _), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+    loss = lax.psum(loss_acc, ax) / M
+    d_hp = jax.tree.map(lambda a: lax.psum(a, ax) / M, d_hp)
+    d_x = lax.psum(d_x, ax) / M
+    return loss, jax.tree.map(lambda a: a / M, d_cp), d_hp, d_x
+
+
+# The schedule family (docs/pipeline.md): gpipe is the autodiff baseline,
+# 1f1b the O(depth)-memory hand schedule, interleaved_1f1b the
+# production schedule (1f1b == interleaved with v pinned to 1; the
+# explicit name keeps the baseline selectable).
+PP_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
+
+
+def pipelined_gpt_train(cfg, chunk_params, rest, tokens, targets, *,
+                        axis, num_microbatches: int,
+                        schedule: str = "interleaved_1f1b",
+                        interleave: int = 1, send_plan=None):
+    """One fused GPT training computation under any pipeline schedule:
+    returns ``(loss, d_chunk_params, d_rest)`` — the production entry
+    point behind ``bench.py --pp`` (docs/pipeline.md).
+
+    ``chunk_params`` is this rank's ``[v, L/(n*v), ...]`` stacked tree
+    from :func:`pp_split_chunks` (``v = 1`` for gpipe/1f1b);
+    ``schedule`` picks the family member; ``send_plan`` threads an
+    explicit activation wire (e.g. the int8+EF plan) into the hops."""
+    import optax
+
+    if schedule not in PP_SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}: one of "
+            f"{PP_SCHEDULES} (docs/pipeline.md)")
+    v = max(1, int(interleave))
+    if schedule in ("gpipe", "1f1b") and v > 1:
+        raise ValueError(
+            f"schedule={schedule!r} does not interleave: virtual stages "
+            f"(pp_interleave={v}) need schedule='interleaved_1f1b'")
+    B, T = tokens.shape
+    _validate_pipeline_cfg(cfg, B, T, num_microbatches, axis)
+    M = num_microbatches
+
+    ep = {"wte": rest["wte"], "wpe": rest["wpe"]}
+    from ..ops.collective_ops import _vma
+
+    ep = _pvary_tree(ep, tuple(sorted(_vma(tokens))))
+    x, embed_vjp = jax.vjp(lambda e: _embed(cfg, e, tokens), ep)
+    x_mbs = x.reshape(M, B // M, T, -1)
+    tgt_mbs = targets.reshape(M, B // M, T)
+
+    def loss_fn(hp, y, tgt):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            _head_logits(cfg, hp, y), tgt).mean()
+
+    hp = {"ln_f": rest["ln_f"], "wte": rest["wte"]}
+    stage_fn = _make_stage_fn(cfg)
+
+    if schedule == "gpipe":
+        # Autodiff baseline: differentiate the relay forward + head loss
+        # (O(M) activation memory — the cost 1F1B exists to cut).
+        ring = ({axis} if isinstance(axis, str) else set(axis))
+        union = set()
+        for leaf in (jax.tree.leaves(chunk_params)
+                     + jax.tree.leaves(hp) + [x_mbs, tgt_mbs]):
+            union |= _vma(leaf)
+        union_t = tuple(sorted(union | ring))
+
+        def total(cp, h, xm):
+            sp = jax.tree.map(lambda a: a[0], cp)  # [1, L/n, ...] -> [L/n, ...]
+            ys = gpipe(stage_fn, sp, xm, axis=axis)
+            losses = jax.vmap(
+                lambda ym, tm: loss_fn(h, ym, tm))(
+                ys, _pvary_tree(tgt_mbs, union_t))
+            return losses.mean()
+
+        loss, (g_cp, g_hp, d_x) = jax.value_and_grad(
+            total, argnums=(0, 1, 2))(
+            _pvary_tree(chunk_params, union_t),
+            _pvary_tree(hp, union_t), _pvary_tree(x_mbs, union_t))
+        n = _axis_size(axis)
+        if n > 1:
+            # gpipe() replicates loss/outputs itself; grads of the
+            # replicated head/input come back per-rank — average.
+            ax = axis if isinstance(axis, str) else tuple(axis)
+            g_hp = jax.tree.map(lambda a: lax.psum(a, ax) / n, g_hp)
+            d_x = lax.psum(d_x, ax) / n
+            loss = lax.psum(loss, ax) / n
+    elif schedule == "1f1b":
+        sp = jax.tree.map(lambda a: a[0], chunk_params)
+        loss, g_sp, g_hp, d_x = gpipe_1f1b(
+            stage_fn, loss_fn, sp, hp, x_mbs, tgt_mbs, axis=axis)
+        g_cp = jax.tree.map(lambda a: a[None], g_sp)
+    else:
+        loss, g_cp, g_hp, d_x = interleaved_1f1b(
+            stage_fn, loss_fn, chunk_params, hp, x_mbs, tgt_mbs,
+            axis=axis, interleave=v, send_plan=send_plan)
+
+    (g_ep,) = embed_vjp(d_x.reshape(B, T, -1).astype(x.dtype))
+    g_rest = {
+        # wte is tied: embedding-lookup grad + LM-head grad
+        "wte": g_ep["wte"].astype(jnp.float32) + g_hp["wte"],
+        "wpe": g_ep["wpe"].astype(jnp.float32),
+        "ln_f": g_hp["ln_f"],
+    }
+    return loss, g_cp, g_rest

@@ -102,6 +102,10 @@ def value_and_grad(
     tuned_params=None,
     plan=None,
     reduce: bool = True,
+    pp_stages: Optional[int] = None,
+    pp_microbatches: Optional[int] = None,
+    pp_schedule: Optional[str] = None,
+    pp_interleave: Optional[int] = None,
     **jax_kwargs,
 ):
     """``jax.value_and_grad`` whose gradients are allreduced across ranks —
@@ -128,7 +132,24 @@ def value_and_grad(
     schedules with one flag (see docs/zero.md). ``plan`` (a
     :class:`horovod_tpu.plan.StepPlan` or bare ``WirePlan``) threads the
     wire plan instead of the booleans — a StepPlan with ``zero_stage>0``
-    implies ``reduce=False`` exactly like the ``zero`` knob."""
+    implies ``reduce=False`` exactly like the ``zero`` knob.
+
+    ``pp_stages``/``pp_microbatches``/``pp_schedule``/``pp_interleave``
+    validate the pipeline composition the step runs under exactly like
+    :class:`~horovod_tpu.DistributedOptimizer`'s pp knobs
+    (docs/pipeline.md) — the fused pipeline schedules
+    (:func:`horovod_tpu.pipelined_gpt_train` /
+    :func:`~horovod_tpu.parallel.pipeline.interleaved_1f1b`) compute
+    their own gradients, so here the knobs are a loud-failure contract,
+    not a behavior switch; the returned gradients are still reduced over
+    the DATA axes only (``axes=None`` never includes ``hvd_pp``)."""
+    if any(k is not None for k in (pp_stages, pp_microbatches,
+                                   pp_schedule, pp_interleave)):
+        from .optimizer import _validate_pp_knobs
+
+        _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
+                           pp_interleave, plan=plan,
+                           tuned_params=tuned_params)
     if plan is not None and hasattr(plan, "gradient"):
         if zero is None and zero_stage is None:
             zero = plan.zero_stage > 0

@@ -43,6 +43,7 @@ from .ir import (  # noqa: F401
     POD,
     PSUM,
     REDUCE_SCATTER,
+    SEND,
     XLA,
     Leg,
     PlanError,
@@ -68,9 +69,13 @@ from .planner import (  # noqa: F401
     flat_plan,
     fused_ag_matmul_plan,
     fused_matmul_rs_plan,
+    derive_send,
+    pp_bubble_bound,
+    pp_send_level,
     predict_fused_hbm_saved,
     predict_leg_bytes,
     quantized_allreduce_plan,
+    send_plan,
     shortlist,
     tree_allreduce_plan,
     zero_all_gather_plan,
@@ -82,6 +87,7 @@ from .cost import (  # noqa: F401
     PlanCost,
     StepCost,
     price_plan,
+    price_send,
     price_step,
 )
 from .calibrate import (  # noqa: F401

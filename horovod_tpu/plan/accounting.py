@@ -57,6 +57,15 @@ class WireStats:
         # fused kernel calls the traced program contains.
         self.fused_hbm_saved_bytes = 0.0
         self.fused_calls = 0
+        # Pipeline wire (docs/pipeline.md): bytes moved by send legs —
+        # the inter-stage activation/activation-grad ppermutes of the
+        # hvd_pp axis. Counted ON TOP of the per-hop ici/dcn/pod totals
+        # (a send leg charges both), so the pipeline's share of each
+        # link class is separable. ``pp_sends`` counts ppermute issues
+        # (schedule ticks x directions).
+        self.pp_bytes = 0.0
+        self.pp_bytes_fp = 0.0
+        self.pp_sends = 0
 
     @property
     def dcn_reduction(self) -> Optional[float]:
@@ -113,6 +122,8 @@ def _publish_wire_stats(ws: "WireStats") -> None:
     r.gauge("comm.wire.streamed_buckets").set(ws.streamed_buckets)
     r.gauge("comm.wire.hidden_fraction").set(ws.hidden_fraction)
     r.gauge("comm.wire.fused_hbm_saved_bytes").set(ws.fused_hbm_saved_bytes)
+    r.gauge("comm.wire.pp_bytes").set(ws.pp_bytes)
+    r.gauge("comm.wire.pp_sends").set(ws.pp_sends)
 
 
 def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
@@ -198,6 +209,41 @@ def overlap_stream(kind: str, bucket_id):
             r.histogram("comm.bucket.latency_us").observe(
                 modeled_wire_ms(own.ici_bytes, own.dcn_bytes,
                                 own.pod_bytes) * 1e3)
+        if tl is not None:
+            tl.end(tid, activity)
+
+
+def _acct_pp(hop: str, wire_bytes: float, fp_bytes: Optional[float] = None,
+             sends: int = 1) -> None:
+    """Account a pipeline send leg: charges ``wire_bytes`` to the ``hop``
+    link class exactly like any other leg (so ``comm.bytes{hop}`` and
+    the per-hop WireStats totals include it), and ADDITIONALLY to the
+    pipeline's own counters so bench/obs can separate the inter-stage
+    wire from the gradient wire (docs/pipeline.md)."""
+    _acct(hop, wire_bytes, fp_bytes)
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.pp.bytes", hop=hop).inc(wire_bytes)
+        _metrics.counter("comm.pp.sends", hop=hop).inc(sends)
+    for ws in _wire_recorders:
+        ws.pp_bytes += wire_bytes
+        ws.pp_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        ws.pp_sends += sends
+
+
+@contextlib.contextmanager
+def pp_span(kind: str, tid: str = "pp"):
+    """Bracket one pipeline event in a ``PP:<kind>`` timeline span
+    (kinds today: ``SEND`` — one lowered send leg; ``F``/``B`` — a
+    schedule slot's forward/backward chunk, emitted per rank by
+    :func:`emit_schedule_spans`). Trace-time only, like every span
+    here."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    activity = f"PP:{kind}"
+    if tl is not None:
+        tl.begin(tid, activity)
+    try:
+        yield
+    finally:
         if tl is not None:
             tl.end(tid, activity)
 

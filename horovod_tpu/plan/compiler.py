@@ -47,7 +47,7 @@ from ..common import basics
 from ..common.basics import CROSS_AXIS, LOCAL_AXIS, POD_AXIS
 from ..ops import compression as _compression
 from . import ir
-from .accounting import _acct, _acct_enabled
+from .accounting import _acct, _acct_enabled, _acct_pp, pp_span
 
 # Mesh axis carried by each plan level.
 LEVEL_AXIS = {ir.ICI: LOCAL_AXIS, ir.DCN: CROSS_AXIS, ir.POD: POD_AXIS}
@@ -283,6 +283,70 @@ def _leg_ici_gather(shard_flat, n: int, offset, local_axis=LOCAL_AXIS):
     full = jnp.zeros((n,), shard_flat.dtype)
     full = lax.dynamic_update_slice_in_dim(full, shard_flat, offset, 0)
     return lax.psum(full, local_axis)
+
+
+# ---------------------------------------------------------------------------
+# Send leg — the pipeline wire (docs/pipeline.md). One point-to-point
+# ``lax.ppermute`` hop along ``axis`` (the hvd_pp axis), charged to the
+# link class the leg's level names. The int8 wire dtype quantizes the
+# payload blockwise before the hop and dequantizes after — the EQuARX
+# per-hop rule applied to the activation wire — with an optional
+# error-feedback residual (the quantization error of what THIS rank
+# sent, re-injected into its next send).
+# ---------------------------------------------------------------------------
+
+
+def lower_send(plan: ir.WirePlan, x, *, axis, perm, residual=None,
+               repeats: int = 1):
+    """Lower a validated send plan over payload ``x``; returns
+    ``(received, new_residual)`` (``new_residual`` is None without EF).
+
+    ``perm`` is the ``lax.ppermute`` permutation (pairs); ``repeats`` is
+    the number of times the caller's schedule issues this hop per traced
+    program (a ``lax.scan`` body traces ONCE — the pipeline passes its
+    tick count so the trace-time accounting charges the true per-step
+    wire bytes, garbage bubble sends included: masked SPMD sends move
+    real bytes)."""
+    (leg,) = plan.legs
+    hop = ir.LEVEL_HOP[leg.level]
+    k = 1
+    for a in ((axis,) if isinstance(axis, str) else tuple(axis)):
+        k *= _axis_size(a)
+    n = int(np.prod(x.shape, dtype=np.int64)) if x.ndim else 1
+    isz = jnp.dtype(x.dtype).itemsize
+    frac = len(perm) / max(1, k)  # fraction of ranks sending per issue
+    if leg.wire_dtype != ir.INT8:
+        if _acct_enabled():
+            _acct_pp(hop, float(n) * isz * frac * repeats,
+                     sends=repeats)
+        with pp_span("SEND"):
+            out = lax.ppermute(x, axis, perm)
+        return out, (None if residual is None
+                     else jnp.zeros_like(residual))
+
+    blk = int(leg.block or 256)
+    corrected = (x if residual is None
+                 else x + residual.reshape(x.shape).astype(x.dtype))
+    flat = jnp.ravel(corrected).astype(jnp.float32)
+    pad = (-n) % blk
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    nb = flat.shape[0] // blk
+    q, scales, err = _quantize_blocks(flat.reshape(1, nb, blk),
+                                      backend=ir.XLA)
+    if _acct_enabled():
+        wire = quant_wire_bytes(n, blk)
+        _acct_pp(hop, wire * frac * repeats,
+                 float(n) * isz * frac * repeats, sends=repeats)
+    with pp_span("SEND"):
+        qg = lax.ppermute(q, axis, perm)
+        sg = lax.ppermute(scales, axis, perm)
+    out = (qg.astype(jnp.float32) * sg[..., None]).reshape(
+        nb * blk)[:n].reshape(x.shape).astype(x.dtype)
+    if residual is None:
+        return out, None
+    new_res = err.reshape(nb * blk)[:n].reshape(residual.shape)
+    return out, new_res.astype(residual.dtype)
 
 
 # ---------------------------------------------------------------------------

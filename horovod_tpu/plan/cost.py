@@ -219,6 +219,11 @@ class StepCost:
     quant_ms: float
     hidden_ms: float
     source: str
+    # Pipeline terms (docs/pipeline.md): the inter-stage send wire over
+    # the whole schedule (2 x ticks issues) plus the bubble cost when
+    # compute_ms is known — zero with pp off.
+    pp_ms: float = 0.0
+    pp_bubble_ms: float = 0.0
 
     @property
     def sync_ms(self) -> float:
@@ -226,7 +231,8 @@ class StepCost:
 
     @property
     def predicted_ms(self) -> float:
-        return self.sync_ms - self.hidden_ms
+        return (self.sync_ms - self.hidden_ms + self.pp_ms
+                + self.pp_bubble_ms)
 
     def as_dict(self) -> dict:
         return {
@@ -236,6 +242,8 @@ class StepCost:
             "alpha_ms": round(self.alpha_ms, 6),
             "quant_ms": round(self.quant_ms, 6),
             "hidden_ms": round(self.hidden_ms, 6),
+            "pp_ms": round(self.pp_ms, 6),
+            "pp_bubble_ms": round(self.pp_bubble_ms, 6),
             "buckets": self.buckets,
             "model": self.source,
         }
@@ -269,7 +277,13 @@ def price_plan(plan: ir.WirePlan, n: int, itemsize: float, mesh_shape,
         k = _ring_size(hop, (nl, nc, npod))
         wire_ms = b / (lk.bandwidth_gbps * 1e9) * 1e3
         modeled_ms = b / (static.link(hop).bandwidth_gbps * 1e9) * 1e3
-        alpha_ms = lk.latency_us * max(0, k - 1) * buckets / 1e3
+        if r["leg"].primitive == ir.SEND:
+            # A send leg is ONE point-to-point hop, not a (k-1)-hop
+            # ring: exactly one launch latency per issue
+            # (docs/pipeline.md).
+            alpha_ms = lk.latency_us * buckets / 1e3
+        else:
+            alpha_ms = lk.latency_us * max(0, k - 1) * buckets / 1e3
         quant_ms = 0.0
         if r["leg"].wire_dtype == ir.INT8:
             # Quantize + dequant-accumulate on the fp-equivalent payload
@@ -322,11 +336,53 @@ def price_step(step_plan, payload_bytes: float, *,
         hideable = wire_ms * (1.0 - 1.0 / buckets)
         hidden_ms = (hideable if compute_ms is None
                      else max(0.0, min(hideable, float(compute_ms))))
+    pp_ms = 0.0
+    pp_bubble_ms = 0.0
+    send = getattr(step_plan, "send", None)
+    stages = int(getattr(step_plan, "pp_stages", 0) or 0)
+    if send is not None and stages > 1:
+        # Pipeline pricing (docs/pipeline.md): the schedule issues
+        # ~2*(M*v + S - 1) send hops per step (one activation + one
+        # grad hop per tick) of a per-microbatch activation payload —
+        # approximated as payload/M when the caller has no activation
+        # size to give — and the interleaved bubble idles
+        # (S-1)/(M*v + S - 1) of the step when compute_ms is known.
+        M = max(1, int(step_plan.pp_microbatches or 2 * stages))
+        v = max(1, int(getattr(step_plan, "pp_interleave", 1) or 1))
+        act_n = max(1, n // M)
+        spc = price_plan(send, act_n, itemsize, mesh_shape, model)
+        ticks = 2 * M * v + 2 * (stages - 1)
+        pp_ms = spc.total_ms * ticks
+        if compute_ms is not None:
+            bf = (stages - 1) / (M * v + stages - 1)
+            pp_bubble_ms = float(compute_ms) * bf / max(1e-9, 1.0 - bf)
     return StepCost(plan_costs=plan_costs, buckets=buckets,
                     flights=flights, wire_ms=wire_ms,
                     modeled_ms=modeled_ms, alpha_ms=alpha_ms,
                     quant_ms=quant_ms, hidden_ms=hidden_ms,
-                    source=model.source)
+                    source=model.source, pp_ms=pp_ms,
+                    pp_bubble_ms=pp_bubble_ms)
+
+
+def price_send(plan: ir.WirePlan, payload_bytes: float, *,
+               issues: int = 1, itemsize: float = 4.0,
+               mesh_shape=(1, 1),
+               model: Optional[CostModel] = None) -> dict:
+    """Price ``issues`` identical send-plan hops of a ``payload_bytes``
+    activation: the per-send wire/alpha/quant terms times the schedule's
+    issue count — the predicted side of the bench ``--pp`` leg's
+    send-leg drift pair (docs/pipeline.md). ``modeled_ms`` is the same
+    bytes at the static modeled bandwidths, exactly what the trace-time
+    accounting would charge for the same issues."""
+    model = model or CostModel.from_env()
+    n = max(1, int(payload_bytes / max(1e-9, itemsize)))
+    pc = price_plan(plan, n, itemsize, mesh_shape, model)
+    return {
+        "predicted_ms": pc.total_ms * issues,
+        "modeled_ms": pc.modeled_ms * issues,
+        "wire_bytes": sum(l.bytes for l in pc.legs) * issues,
+        "model": model.source,
+    }
 
 
 def resolve(mesh_shape=None) -> CostModel:

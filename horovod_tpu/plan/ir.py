@@ -44,12 +44,16 @@ FLAT = "flat"
 LEVELS = (ICI, DCN, POD, FLAT)
 
 # Per-leg primitives (the HiCCL composition alphabet, restricted to what
-# the TPU lowerings use).
+# the TPU lowerings use). ``send`` is the point-to-point primitive of the
+# pipeline wire (docs/pipeline.md): one ``lax.ppermute`` hop carrying an
+# inter-stage activation (or activation-grad) along the hvd_pp axis,
+# charged to the link class its ``level`` names.
 REDUCE_SCATTER = "reduce_scatter"
 ALL_GATHER = "all_gather"
 ALL_TO_ALL = "all_to_all"
 PSUM = "psum"
-PRIMITIVES = (REDUCE_SCATTER, ALL_GATHER, ALL_TO_ALL, PSUM)
+SEND = "send"
+PRIMITIVES = (REDUCE_SCATTER, ALL_GATHER, ALL_TO_ALL, PSUM, SEND)
 
 # Wire dtypes. ``payload`` rides whatever dtype the caller handed the
 # collective (after any Compression cast); ``int8`` is the blockwise-
@@ -78,7 +82,7 @@ BACKENDS = (XLA, PALLAS)
 _REDUCE_PRIMS = (REDUCE_SCATTER, PSUM, ALL_TO_ALL)
 _GATHER_PRIMS = (ALL_GATHER,)
 
-_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather")
+_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather", "send")
 
 
 class PlanError(ValueError):
@@ -241,6 +245,28 @@ class WirePlan:
                     f"no leg-local compute to fuse a kernel into; "
                     f"kernel-backed legs live on the per-level "
                     f"compositions (docs/fused-kernels.md)")
+            if (leg.primitive == SEND) != (self.collective == "send"):
+                if leg.primitive == SEND:
+                    raise PlanError(
+                        f"{where}: a send leg only belongs to a 'send' "
+                        f"plan — the point-to-point pipeline hop does "
+                        f"not compose with reduction/gather ladders "
+                        f"(docs/pipeline.md)")
+                raise PlanError(
+                    f"{where}: a send plan carries only send legs, got "
+                    f"{leg.primitive!r} — the inter-stage wire is one "
+                    f"ppermute hop per direction (docs/pipeline.md)")
+            if leg.primitive == SEND and leg.level == FLAT:
+                raise PlanError(
+                    f"{where}: a send leg names the LINK CLASS the "
+                    f"pipeline hop crosses (ici/dcn/pod) — there is no "
+                    f"flat decomposition of a point-to-point hop")
+            if leg.primitive == SEND and leg.backend == PALLAS:
+                raise PlanError(
+                    f"{where}: backend='pallas' on a send leg — the "
+                    f"pipeline hop has no leg-local compute to fuse "
+                    f"beyond the int8 quantize pair, which the compiler "
+                    f"places itself (docs/pipeline.md)")
             if leg.backend == PALLAS and leg.primitive == PSUM:
                 raise PlanError(
                     f"{where}: backend='pallas' on a psum leg — the "
@@ -312,6 +338,13 @@ class WirePlan:
                         f"belongs to the all_gather plan (the ZeRO wire "
                         f"splits the allreduce in half around the "
                         f"optimizer update)")
+        elif self.collective == "send":
+            if len(self.legs) != 1:
+                raise PlanError(
+                    f"illegal send plan {self.encode()}: a send plan is "
+                    f"exactly ONE hop (one ppermute leg on one link "
+                    f"class) — the pipeline schedule composes hops by "
+                    f"issuing one plan per direction, docs/pipeline.md")
         elif self.collective == "all_gather":
             for i, (level, prim) in enumerate(prims):
                 if prim not in _GATHER_PRIMS and level != FLAT:

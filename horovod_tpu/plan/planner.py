@@ -49,7 +49,7 @@ from typing import List, Optional, Tuple
 from ..common import basics
 from ..common.config import _env_bool, _env_int
 from .ir import (ALL_GATHER, DCN, FLAT, ICI, INT8, PALLAS, PAYLOAD, POD,
-                 PSUM, REDUCE_SCATTER, XLA, Leg, PlanError, WirePlan)
+                 PSUM, REDUCE_SCATTER, SEND, XLA, Leg, PlanError, WirePlan)
 
 _AXIS_LEVEL = {basics.LOCAL_AXIS: ICI, basics.CROSS_AXIS: DCN,
                basics.POD_AXIS: POD}
@@ -176,6 +176,53 @@ def zero_all_gather_plan(*, quantized: bool = False,
     return flat_plan("all_gather", streams=streams, overlap=overlap)
 
 
+def send_plan(level: str = DCN, *, quantized: bool = False,
+              block: Optional[int] = None,
+              error_feedback: bool = False) -> WirePlan:
+    """The pipeline's inter-stage activation wire (docs/pipeline.md): a
+    single point-to-point ``send`` leg on the link class the hvd_pp hop
+    crosses. ``quantized`` rides it blockwise-int8 with error feedback —
+    legal on the DCN/pod hops only (the EQuARX placement rule; an ICI
+    send always rides the payload dtype)."""
+    if quantized:
+        leg = Leg(level, SEND, INT8, block=block,
+                  error_feedback=error_feedback)
+    else:
+        leg = Leg(level, SEND, PAYLOAD)
+    return WirePlan("send", (leg,)).validate()
+
+
+def pp_send_level(mesh_shape) -> str:
+    """The link class an hvd_pp hop crosses: the pp axis leads the mesh
+    (consecutive stages sit a whole data-mesh apart in device order), so
+    the hop rides the SLOWEST link class present — pod on a multi-pod
+    mesh, dcn across hosts, ici on a single host."""
+    nl, nc, npod = _mesh_sizes(mesh_shape)
+    if npod > 1:
+        return POD
+    return DCN if nc > 1 else ICI
+
+
+def derive_send(*, mesh_shape, quantized: bool = False,
+                block: Optional[int] = None,
+                error_feedback: Optional[bool] = None) -> WirePlan:
+    """Derive the pipeline send plan for a mesh: the level comes from
+    :func:`pp_send_level`; ``quantized`` is forced off on an ICI hop
+    (int8 is illegal there — compression belongs on slow links)."""
+    level = pp_send_level(mesh_shape)
+    q = bool(quantized) and level in (DCN, POD)
+    ef = q if error_feedback is None else (error_feedback and q)
+    return send_plan(level, quantized=q, block=block, error_feedback=ef)
+
+
+def pp_bubble_bound(stages: int, microbatches: int) -> float:
+    """The no-overlap GPipe analytic bubble bound ``(S-1)/(M+S-1)`` —
+    the fraction the perf gate holds every measured pipeline schedule
+    strictly under (docs/pipeline.md)."""
+    s, m = int(stages), max(1, int(microbatches))
+    return (s - 1) / (m + s - 1) if s > 1 else 0.0
+
+
 def fused_matmul_rs_plan(*, streams: int = 1,
                          overlap: bool = False) -> WirePlan:
     """The wire of :func:`~horovod_tpu.ops.fused_collective.
@@ -296,6 +343,22 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
     def row(leg, hop, b, fp=None):
         rows.append({"leg": leg, "hop": hop, "bytes": b,
                      "fp_bytes": b if fp is None else fp})
+
+    if plan.collective == "send":
+        # One cyclic ppermute issue of the full [n] payload: every rank
+        # sends its activation once (the interleaved schedule's ring);
+        # same formula compiler.lower_send charges per issue at trace
+        # time, so predicted == accounted by construction.
+        (leg,) = plan.legs
+        hop = {ICI: "ici", DCN: "dcn", POD: "pod"}[leg.level]
+        if leg.wire_dtype == INT8:
+            from .compiler import quant_wire_bytes
+
+            row(leg, hop, quant_wire_bytes(n, leg.block or blk),
+                float(n) * isz)
+        else:
+            row(leg, hop, float(n) * isz)
+        return rows
 
     if plan.is_flat:
         leg = plan.legs[0]
@@ -435,12 +498,27 @@ class StepPlan:
     gather: Optional[WirePlan]
     fused: bool = False
     quantized_pod: bool = False
+    # Pipeline parallelism (docs/pipeline.md): the inter-stage
+    # activation wire (a validated send plan; None with pp off) plus the
+    # schedule knobs it compiles under. ``pp_microbatches`` is the
+    # per-step microbatch count M, ``pp_interleave`` the virtual-stage
+    # degree v of the interleaved-1F1B schedule.
+    send: Optional[WirePlan] = None
+    pp_stages: int = 0
+    pp_microbatches: int = 0
+    pp_schedule: str = "interleaved_1f1b"
+    pp_interleave: int = 1
 
     def encode(self) -> str:
         parts = [self.gradient.encode()]
         if self.gather is not None:
             where = "fwd" if self.zero_stage == 3 else "tail"
             parts.append(f"{where}@{self.gather.encode()}")
+        if self.send is not None:
+            parts.append(
+                f"pp{self.pp_stages}v{self.pp_interleave}"
+                f"m{self.pp_microbatches}.{self.pp_schedule}"
+                f"@{self.send.encode()}")
         return " + ".join(parts)
 
     @property
@@ -512,6 +590,27 @@ class StepPlan:
                     f"{leg.backend:<7} "
                     f"{leg.stream:>6} {int(round(b)):>12} "
                     f"{modeled_ms:>9.4f} {pred_ms:>8.4f}")
+        if self.send is not None:
+            # The pipeline wire, priced PER SEND ISSUE (one activation
+            # microbatch over one hop; the schedule issues 2 x ticks of
+            # these per step — bench reports the step total).
+            rows = predict_leg_bytes(self.send, n, itemsize,
+                                     self.mesh_shape)
+            plan_cost = _cost.price_plan(self.send, n, itemsize,
+                                         self.mesh_shape, model)
+            for li, leg in enumerate(self.send.legs, start=1):
+                b = sum(r["bytes"] for r in rows if r["leg"] is leg)
+                modeled_ms, pred_ms = plan_cost.by_leg(leg)
+                wire = leg.wire_dtype
+                if leg.wire_dtype == INT8:
+                    wire = f"int8/{leg.block or self.quant_block}"
+                lines.append(
+                    f"{'send':<16} {li:>3} {leg.level:<5} "
+                    f"{leg.primitive:<14} {wire:<10} "
+                    f"{'yes' if leg.error_feedback else '-':<3} "
+                    f"{leg.backend:<7} "
+                    f"{leg.stream:>6} {int(round(b)):>12} "
+                    f"{modeled_ms:>9.4f} {pred_ms:>8.4f}")
         red = (tot["fp"] / tot["dcn"]) if tot["dcn"] else None
         totline = (f"totals: ici={int(round(tot['ici']))} "
                    f"dcn={int(round(tot['dcn']))} "
@@ -529,6 +628,15 @@ class StepPlan:
                 f"fused: predicted hbm round-trip saved "
                 f"{int(round(hbm_saved))} bytes/dev vs unfused "
                 f"(docs/fused-kernels.md)")
+        if self.send is not None:
+            bound = pp_bubble_bound(self.pp_stages, self.pp_microbatches)
+            lines.append(
+                f"pp: stages={self.pp_stages} "
+                f"interleave={self.pp_interleave} "
+                f"microbatches={self.pp_microbatches} "
+                f"schedule={self.pp_schedule} "
+                f"gpipe_bubble_bound={bound:.4f} "
+                f"(send rows priced per issue, docs/pipeline.md)")
         sc = _cost.price_step(self, payload_bytes, itemsize=itemsize,
                               mesh_shape=self.mesh_shape, model=model)
         lines.append(
@@ -561,6 +669,11 @@ def describe_plan(
     tuned_params=None,
     fused: Optional[bool] = None,
     quantized_pod: Optional[bool] = None,
+    pp_stages: Optional[int] = None,
+    pp_microbatches: Optional[int] = None,
+    pp_schedule: Optional[str] = None,
+    pp_interleave: Optional[int] = None,
+    pp_quantized: Optional[bool] = None,
 ) -> StepPlan:
     """Resolve today's knob combination into its :class:`StepPlan` — the
     debug view of what the gradient wire will compile to.
@@ -584,6 +697,12 @@ def describe_plan(
             quant_block = tuned_params.quant_block
         if fused is None:
             fused = getattr(tuned_params, "fused", None)
+        if pp_microbatches is None:
+            pp_microbatches = getattr(tuned_params, "pp_microbatches",
+                                      None) or None
+        if pp_interleave is None:
+            pp_interleave = getattr(tuned_params, "pp_interleave",
+                                    None) or None
     cfg = basics.config() if basics.is_initialized() else None
     if quantized is None:
         quantized = (cfg.quantized_allreduce if cfg is not None
@@ -615,11 +734,30 @@ def describe_plan(
             else _env_int("HOROVOD_FUSION_THRESHOLD", 64 * 1024 * 1024))
     if mesh_shape is None:
         if basics.is_initialized() and basics.mesh() is not None:
-            shp = basics.mesh().devices.shape
-            mesh_shape = (tuple(shp) if len(shp) == 2
-                          else (shp[1], shp[2], shp[0]))
+            # The DATA mesh: a pipeline mesh's leading hvd_pp dim feeds
+            # pp_stages below, never the collective level ladder.
+            mesh_shape = basics.data_mesh_shape()
         else:
             mesh_shape = (1, 1)
+    if pp_stages is None:
+        if basics.is_initialized() and basics.mesh() is not None:
+            pp_stages = basics.pp_size()
+        else:
+            pp_stages = (cfg.pp_stages if cfg is not None
+                         else _env_int("HOROVOD_PP_STAGES", 0))
+    pp_stages = int(pp_stages or 0)
+    if pp_schedule is None:
+        pp_schedule = (cfg.pp_schedule if cfg is not None
+                       else "interleaved_1f1b")
+    if pp_interleave is None:
+        pp_interleave = (cfg.pp_interleave if cfg is not None else 1) or 1
+    if pp_microbatches is None:
+        pp_microbatches = (cfg.pp_microbatches if cfg is not None else 0)
+    if not pp_microbatches:
+        pp_microbatches = 2 * pp_stages  # schedule default (pow2-ish)
+    if pp_quantized is None:
+        pp_quantized = (cfg.pp_quantized if cfg is not None
+                        else _env_bool("HOROVOD_PP_QUANTIZED", False))
     fused = _resolve_fused(fused)
     quantized_pod = _resolve_quantized_pod(quantized_pod)
     nl, nc, npod = _mesh_sizes(mesh_shape)
@@ -647,7 +785,17 @@ def describe_plan(
             error_feedback=ef, streams=streams, overlap=overlap,
             fused=fused, quantized_pod=quantized_pod)
         gather = None
+    send = None
+    if pp_stages > 1:
+        send = derive_send(mesh_shape=mesh_shape,
+                           quantized=bool(pp_quantized),
+                           block=quant_block if pp_quantized else None)
     return StepPlan(
+        send=send,
+        pp_stages=pp_stages if pp_stages > 1 else 0,
+        pp_microbatches=int(pp_microbatches) if pp_stages > 1 else 0,
+        pp_schedule=str(pp_schedule),
+        pp_interleave=max(1, int(pp_interleave)),
         mesh_shape=tuple(int(v) for v in mesh_shape),
         quantized=bool(quantized),
         quant_block=int(quant_block),
@@ -672,10 +820,11 @@ def describe_plan(
 _PLAN_RE = re.compile(
     r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
     r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)"
-    r"(?P<fused>\|pl)?$")
+    r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+))?$")
 
 
-def encode_tuned(params, *, quantized: bool = False) -> str:
+def encode_tuned(params, *, quantized: bool = False,
+                 pp: bool = False) -> str:
     """Compact plan encoding of a ``TunedParams``-like knob set: gradient
     leg order | DCN hop wire dtype | stream count | placement
     [| kernel backend]. E.g. ``ar.tree|int8/256|s2|ovl`` or
@@ -702,6 +851,14 @@ def encode_tuned(params, *, quantized: bool = False) -> str:
     enc = f"{grad}|{wire}|s{streams}|{sched}"
     if quantized and getattr(params, "fused", False):
         enc += "|pl"  # dead knob without an int8 leg: drops out above
+    if pp:
+        # Schema v8 (docs/pipeline.md): the pipeline schedule knobs —
+        # microbatch count / interleave degree — join the plan encoding
+        # only when the session's step is pipelined; with pp off both
+        # are dead knobs and drop out (one trial, not four).
+        m = int(getattr(params, "pp_microbatches", 0) or 0)
+        v = max(1, int(getattr(params, "pp_interleave", 1) or 1))
+        enc += f"|pp{m}/{v}"
     return enc
 
 
@@ -744,6 +901,9 @@ def enumerate_tuned(*, quantized: bool = False,
                     tune_zero: bool = False,
                     tune_overlap: bool = False,
                     tune_fused: bool = False,
+                    tune_pp: bool = False,
+                    pp_stages: int = 0,
+                    pp_max_interleave: int = 1,
                     initial=None,
                     thresholds=None,
                     blocks=None) -> list:
@@ -765,6 +925,20 @@ def enumerate_tuned(*, quantized: bool = False,
                        | {int(initial.quant_block)})
                 if quantized else (int(initial.quant_block),))
     stage_opts = (0, 1, 2) if tune_zero else (initial.zero_stage,)
+    if tune_pp and pp_stages > 1:
+        # Pipeline candidates (docs/pipeline.md): pow2-ish microbatch
+        # counts that divide by the stage count, crossed with the legal
+        # interleave degrees — the bubble/alpha tradeoff the cost model
+        # prices (more microbatches shrink the bubble, cost more send
+        # launches).
+        ppm_opts = sorted({pp_stages, 2 * pp_stages, 4 * pp_stages}
+                          | ({int(initial.pp_microbatches)}
+                             if initial.pp_microbatches else set()))
+        ppv_opts = sorted({v for v in (1, 2, 4)
+                           if v <= max(1, pp_max_interleave)})
+    else:
+        ppm_opts = (initial.pp_microbatches,)
+        ppv_opts = (initial.pp_interleave,)
     out, seen = [], set()
     for thr in thr_opts:
         for blk in blk_opts:
@@ -791,20 +965,25 @@ def enumerate_tuned(*, quantized: bool = False,
                                        else (initial.fused
                                              if quantized else False,))
                             for fz in fz_opts:
-                                p = TunedParams(
-                                    fusion_threshold_bytes=thr,
-                                    quant_block=blk,
-                                    hierarchical_allreduce=hier,
-                                    zero_stage=stage,
-                                    overlap=ovl,
-                                    num_comm_streams=s,
-                                    fused=fz)
-                                key = (thr, blk, encode_tuned(
-                                    p, quantized=quantized))
-                                if key in seen:
-                                    continue
-                                seen.add(key)
-                                out.append(p)
+                                for ppm in ppm_opts:
+                                    for ppv in ppv_opts:
+                                        p = TunedParams(
+                                            fusion_threshold_bytes=thr,
+                                            quant_block=blk,
+                                            hierarchical_allreduce=hier,
+                                            zero_stage=stage,
+                                            overlap=ovl,
+                                            num_comm_streams=s,
+                                            fused=fz,
+                                            pp_microbatches=ppm,
+                                            pp_interleave=ppv)
+                                        key = (thr, blk, encode_tuned(
+                                            p, quantized=quantized,
+                                            pp=tune_pp))
+                                        if key in seen:
+                                            continue
+                                        seen.add(key)
+                                        out.append(p)
     return out
 
 
@@ -813,6 +992,8 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
               quantized: bool = False, k: Optional[int] = None,
               tune_hierarchical: bool = True, tune_zero: bool = False,
               tune_overlap: bool = False, tune_fused: bool = False,
+              tune_pp: bool = False, pp_stages: int = 0,
+              pp_max_interleave: int = 1,
               initial=None, thresholds=None, blocks=None) -> list:
     """Enumerate, validate, and PRICE the legal plan space for a knob
     set, returning :class:`PricedPlan` rows ranked by predicted step-
@@ -828,9 +1009,7 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
 
     if mesh_shape is None:
         if basics.is_initialized() and basics.mesh() is not None:
-            shp = basics.mesh().devices.shape
-            mesh_shape = (tuple(shp) if len(shp) == 2
-                          else (shp[1], shp[2], shp[0]))
+            mesh_shape = basics.data_mesh_shape()
         else:
             mesh_shape = (1, 1)
     model = model or _cost.resolve(mesh_shape)
@@ -840,12 +1019,17 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
                              tune_hierarchical=tune_hierarchical,
                              tune_zero=tune_zero,
                              tune_overlap=tune_overlap,
-                             tune_fused=tune_fused, initial=initial,
+                             tune_fused=tune_fused,
+                             tune_pp=tune_pp, pp_stages=pp_stages,
+                             pp_max_interleave=pp_max_interleave,
+                             initial=initial,
                              thresholds=thresholds, blocks=blocks):
         try:
             sp = describe_plan(tuned_params=p, quantized=quantized,
                                mesh_shape=mesh_shape,
-                               quantized_pod=False)
+                               quantized_pod=False,
+                               pp_stages=(pp_stages if tune_pp
+                                          else None))
         except PlanError:
             continue  # illegal composition: not a candidate
         # Dedup on the DERIVED wire (plus the threshold and ZeRO
@@ -885,6 +1069,8 @@ def decode_tuned(encoding: str) -> dict:
         "overlap": m.group("sched") == "ovl",
         "num_comm_streams": int(m.group("streams")),
         "fused": m.group("fused") is not None,
+        "pp_microbatches": int(m.group("ppm") or 0),
+        "pp_interleave": int(m.group("ppv") or 1),
     }
     if out["quantized"]:
         out["quant_block"] = int(m.group("wire").split("/", 1)[1])

@@ -275,6 +275,63 @@ def _main():
                   f"not gated (pass)")
         return 0 if ok else 1
 
+    if leg == "pp":
+        # Pipeline-parallel leg (docs/pipeline.md): three hard gates —
+        # (1) pipelined-vs-dense parity within its documented tolerance,
+        # (2) measured bubble fraction at or under PERF_GATE_PP_BUBBLE
+        # (default 1.0 = the analytic no-overlap GPipe bound
+        # (S-1)/(M+S-1); the interleaved schedule sits well below it),
+        # (3) the send-leg predicted-vs-measured wire-ms drift within
+        # the PERF_GATE_COST_DRIFT contract — then throughput gates
+        # against the trajectory like a train leg.
+        ok = True
+        par = rec.get("parity_rel_err")
+        ptol = rec.get("parity_tol", 1e-4)
+        if par is None or par > ptol:
+            print(f"perf gate [pp]: parity {par} exceeds tolerance "
+                  f"{ptol} — hard fail")
+            record_verdict("pp", "parity_rel_err", par or -1, ptol, tol,
+                           False)
+            ok = False
+        else:
+            record_verdict("pp", "parity_rel_err", par, ptol, tol, True)
+        bubble = rec.get("bubble_fraction")
+        bound = rec.get("bubble_bound_gpipe")
+        bcap = float(os.environ.get("PERF_GATE_PP_BUBBLE", "1.0"))
+        if bubble is None or bound is None or bubble > bcap * bound:
+            print(f"perf gate [pp bubble]: measured {bubble} vs cap "
+                  f"{bcap} x gpipe bound {bound} — hard fail")
+            record_verdict("pp", "bubble_fraction", bubble or -1,
+                           (bound or 0) * bcap, tol, False)
+            ok = False
+        else:
+            print(f"perf gate [pp bubble]: measured {bubble:.4f} <= "
+                  f"{bcap} x gpipe bound {bound:.4f} -> OK")
+            record_verdict("pp", "bubble_fraction", bubble, bound * bcap,
+                           tol, True)
+        wm = rec.get("wire_ms") or {}
+        pred, mod = wm.get("predicted"), wm.get("modeled")
+        drift_tol = float(os.environ.get("PERF_GATE_COST_DRIFT", "0.25"))
+        if pred is None or mod is None or mod <= 0:
+            print(f"perf gate [pp]: record lacks the send-leg wire_ms "
+                  f"pair ({wm}) — hard fail")
+            record_verdict("pp", "send_wire_ms_present", 0, 1, drift_tol,
+                           False)
+            ok = False
+        else:
+            drift = abs(pred - mod) / mod
+            within = drift <= drift_tol
+            print(f"perf gate [pp send drift]: predicted {pred:.4f} ms "
+                  f"vs measured-model {mod:.4f} ms (|drift| {drift:.3f} "
+                  f"vs cap {drift_tol}) -> "
+                  f"{'OK' if within else 'REGRESSION'}")
+            record_verdict("pp", "send_wire_ms_drift", drift, drift_tol,
+                           drift_tol, within)
+            ok &= within
+        if not ok:
+            return 1
+        # fall through: throughput still gates against the trajectory
+
     if leg == "cost":
         # Cost-model drift gate (docs/cost-model.md): the analytic
         # planner's predicted wire-ms for this leg's knob set must stay

@@ -25,6 +25,10 @@
 #   PERF_GATE_LEGS="cost" scripts/perf_gate.sh  # cost-model drift:
 #                     |predicted - measured| wire-ms within
 #                     PERF_GATE_COST_DRIFT (docs/cost-model.md)
+#   PERF_GATE_LEGS="pp" scripts/perf_gate.sh    # pipeline parallelism:
+#                     parity + bubble <= PERF_GATE_PP_BUBBLE x the
+#                     GPipe analytic bound + send-leg wire-ms drift
+#                     (docs/pipeline.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -104,6 +108,16 @@ for leg in $LEGS; do
                 --platform cpu --cpu-devices 8 --batch-size 2 \
                 --num-iters 3 --num-batches-per-iter 2
             ;;
+        pp)
+            # Pipeline-parallel gate (docs/pipeline.md): interleaved-1F1B
+            # A/B — parity vs the dense model, measured bubble fraction
+            # at or under PERF_GATE_PP_BUBBLE x the analytic GPipe bound
+            # (S-1)/(M+S-1), send-leg predicted-vs-measured wire-ms
+            # within PERF_GATE_COST_DRIFT, throughput vs trajectory.
+            run_leg pp --pp 4 --zero-stage 3 --quantized --overlap \
+                --platform cpu --cpu-devices 8 \
+                --num-iters 2 --num-batches-per-iter 2
+            ;;
         cost)
             # Cost-model drift gate (docs/cost-model.md): the quantized
             # A/B's JSON carries wire_ms.predicted (the analytic
@@ -117,7 +131,7 @@ for leg in $LEGS; do
                 --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
             ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost)" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp)" >&2
             exit 2
             ;;
     esac

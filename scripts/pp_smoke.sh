@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Pipeline-parallelism CI brick (docs/pipeline.md): the interleaved-1F1B
+# A/B on the emulated 2x2x2 mesh — 2 pipeline stages over a (2, 2)
+# data mesh — asserting the three contracts the pp perf-gate leg hard
+# checks: pipelined-vs-dense parity, measured bubble fraction strictly
+# under the no-overlap GPipe analytic bound (S-1)/(M+S-1), and the
+# send-leg predicted-vs-measured wire-ms drift.
+#
+# Usage: scripts/pp_smoke.sh
+# Env:   PP_SMOKE_KNOBS="--zero-stage 2 --quantized" adds composition.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+KNOBS=${PP_SMOKE_KNOBS:-}
+
+out=$(JAX_PLATFORMS=cpu python bench.py --pp 2 --mesh-shape 2x2 \
+    --pp-microbatches 8 --pp-interleave 2 \
+    --platform cpu --cpu-devices 8 \
+    --num-iters 2 --num-batches-per-iter 2 $KNOBS | tail -n 1)
+echo "$out"
+
+python - "$out" <<'EOF'
+import json, sys
+rec = json.loads(sys.argv[1])
+assert rec["parity_rel_err"] <= rec["parity_tol"], (
+    f"pp smoke: parity {rec['parity_rel_err']} > {rec['parity_tol']}")
+assert rec["bubble_fraction"] < rec["bubble_bound_gpipe"], (
+    f"pp smoke: bubble {rec['bubble_fraction']} not strictly below the "
+    f"GPipe bound {rec['bubble_bound_gpipe']}")
+wm = rec["wire_ms"]
+drift = abs(wm["predicted"] - wm["modeled"]) / max(1e-9, wm["modeled"])
+assert drift <= 0.25, f"pp smoke: send wire drift {drift} > 0.25"
+assert rec["pp_send_bytes"] > 0, "pp smoke: no send-leg wire bytes"
+assert rec["value"] > 0, "pp smoke: zero throughput"
+print(f"pp smoke OK: {rec['value']} tok/s, bubble "
+      f"{rec['bubble_fraction']} < {rec['bubble_bound_gpipe']}, "
+      f"send drift {drift:.4f}")
+EOF
