@@ -121,7 +121,8 @@ def dump_plan(args, mesh_shape):
                           model=model))
 
 
-def metrics_snapshot(prefixes=("comm.", "step.", "optimizer.")):
+def metrics_snapshot(prefixes=("comm.", "step.", "optimizer.",
+                               "straggler.", "link.")):
     """Registry snapshot filtered to the bench-relevant metric families —
     the ``metrics_snapshot`` field every A/B leg embeds in its JSON line
     (docs/observability.md). Also flushes the configured sinks, so a run
@@ -816,6 +817,40 @@ def run_once(args, devices, platform, *, quantized=False, zero=False,
     step_hist = monitor.metrics().histogram("step.time_ms")
     for st in step_times:
         step_hist.observe(st * 1e3)
+
+    # Straggler attribution (monitor/straggler.py): every timed step
+    # records its phase breakdown — per-hop wire at the modeled
+    # bandwidths (the only per-step wire time that exists on the
+    # compiled path), the checkpoint stall when the probe ran, and the
+    # compute remainder — then one detection pass closes the loop (a
+    # clean run must flag nothing; zero false positives is the
+    # acceptance contract). Link health scores each hop's wire against
+    # the resolved cost model's prediction for this rank's traffic.
+    from horovod_tpu.plan.accounting import bench_gbps
+
+    det = monitor.straggler_detector()
+    _gbps = dict(zip(("ici", "dcn", "pod"), bench_gbps()))
+    hop_ms = {hop: getattr(wire, f"{hop}_bytes")
+              / (_gbps[hop] * 1e9) * 1e3 for hop in ("ici", "dcn", "pod")}
+    ckpt_ms_per_save = (float(np.median(ckpt_stalls))
+                        if ckpt_stalls else 0.0)
+    for i, st in enumerate(step_times):
+        step_ms = st * 1e3
+        for hop, ms in hop_ms.items():
+            if ms > 0:
+                det.record_phase(f"wire.{hop}", min(ms, step_ms))
+        if ckpt_probe and i == 0 and ckpt_ms_per_save > 0:
+            det.record_phase("ckpt", ckpt_ms_per_save)
+        det.record_phase(
+            "compute", max(0.0, step_ms - sum(hop_ms.values())))
+        det.end_step(i)
+    for hop, ms in hop_ms.items():
+        nbytes = getattr(wire, f"{hop}_bytes")
+        if nbytes > 0:
+            det.observe_wire(hop, nbytes, ms)
+    stragglers = det.detect()
+    if stragglers:
+        log(f"stragglers detected: {stragglers}")
 
     ckpt_fields = {}
     if ckpt_probe and ckpt_mgr is not None:
@@ -1560,6 +1595,17 @@ def run_pp(args, devices, platform, mesh_shape):
         ticks = M + S - 1
     log(f"bubble_fraction={bubble:.4f} (gpipe bound {bound:.4f}, "
         f"{ticks} ticks)")
+
+    # Straggler attribution: the pipeline leg's step decomposes into the
+    # measured bubble idle and the compute remainder (the send wire is
+    # inside the pp step's hop accounting like every other leg).
+    from horovod_tpu import monitor as _monitor
+
+    pp_step_ms = 1e3 / max(1e-9, pp_sps)
+    det = _monitor.straggler_detector()
+    det.record_phase("pp_bubble", bubble * pp_step_ms)
+    det.record_phase("compute", max(0.0, (1.0 - bubble) * pp_step_ms))
+    det.end_step()
 
     # Send-leg drift pair: predicted (cost model) vs the trace-accounted
     # bytes at the modeled bandwidths.

@@ -204,9 +204,11 @@ from . import checkpoint  # noqa: F401  (async rank-sharded save/restore)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
 from . import monitor  # noqa: F401  (metrics registry / sinks / span audit)
 from .monitor import (  # noqa: F401
+    dump_flight_record,
     metrics,
     profile_window,
     stalled_tensors,
+    straggler_detector,
 )
 
 from jax.sharding import PartitionSpec as _P

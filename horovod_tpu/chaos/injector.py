@@ -130,7 +130,19 @@ class ChaosInjector:
             f"rule #{i}, ctx={ctx})")
         if action == ACTION_CRASH:
             # A hard death: no atexit, no stack unwind — what a kernel
-            # panic or OOM-kill looks like to the rest of the job.
+            # panic or OOM-kill looks like to the rest of the job. The
+            # flight recorder dumps FIRST (monitor/flight.py): a real
+            # kernel panic leaves no black box, but the simulated one
+            # must, so postmortems of chaos runs can name the crashing
+            # rank. No-op unless HOROVOD_FLIGHT_RECORDER_DIR is set.
+            try:
+                from ..monitor import flight as _flight
+
+                _flight.dump_flight_record(
+                    reason="chaos.crash",
+                    extra={"point": point, "where": where})
+            except Exception:
+                pass
             os._exit(spec.exit_code)
         if action == ACTION_DROP:
             raise FaultInjectedError(

@@ -131,6 +131,11 @@ class Config:
     metrics_interval: float = 0.0        # reporter period secs (0 = off)
     metrics_aggregate: bool = False      # cross-rank aggregate per interval
 
+    # --- flight recorder (docs/observability.md): always-on forensic
+    #     ring of recent events, dumped to the dir on crash paths ---
+    flight_recorder_dir: Optional[str] = None
+    flight_recorder_events: int = 4096  # ring capacity (0 disables)
+
     # --- stall inspector (stall_inspector.h:36-66) ---
     stall_check_disable: bool = False
     stall_warning_time_seconds: float = 60.0
@@ -201,6 +206,9 @@ def from_env() -> Config:
         metrics_port=_opt_int("HOROVOD_METRICS_PORT"),
         metrics_interval=_env_float("HOROVOD_METRICS_INTERVAL", 0.0),
         metrics_aggregate=_env_bool("HOROVOD_METRICS_AGGREGATE", False),
+        flight_recorder_dir=_env_str("HOROVOD_FLIGHT_RECORDER_DIR", None),
+        flight_recorder_events=_env_int("HOROVOD_FLIGHT_RECORDER_EVENTS",
+                                        4096),
         stall_check_disable=_env_bool("HOROVOD_STALL_CHECK_DISABLE", False),
         stall_warning_time_seconds=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
         stall_shutdown_time_seconds=_env_float(

@@ -43,6 +43,7 @@ def increment(name: str, n: int = 1,
 def _emit_timeline(name: str, attrs: Optional[dict]) -> None:
     # Lazy import: counters must stay importable from the launcher/runner
     # processes without dragging framework state along.
+    tl = None
     try:
         from . import basics
 
@@ -51,6 +52,16 @@ def _emit_timeline(name: str, attrs: Optional[dict]) -> None:
         return
     if tl is not None:
         tl.instant(f"FAULT:{name}", tid="faults", args=attrs)
+        return
+    # No timeline attached: fault events still reach the flight
+    # recorder's ring directly (a timeline emit would have been tapped),
+    # so a dump from an un-traced process carries its fault trail.
+    try:
+        from ..monitor import flight as _flight
+
+        _flight.instant(f"FAULT:{name}", tid="faults", args=attrs)
+    except Exception:  # pragma: no cover - partial interpreter teardown
+        return
 
 
 def _emit_registry(name: str, n: int) -> None:

@@ -201,6 +201,15 @@ def run(func):
             except (HorovodInternalError, NativeShutdownError) as e:
                 logging.warning(
                     f"step aborted ({e}); rolling back to last commit")
+                # A peer died mid-collective: this survivor's ring holds
+                # the last events before the abort — dump it before the
+                # rollback erases the evidence (scripts/postmortem.py
+                # joins these against the dead rank's chaos/crash dump).
+                # No-op unless HOROVOD_FLIGHT_RECORDER_DIR is set.
+                from ..monitor import flight as _flight
+
+                _flight.dump_flight_record(
+                    reason="elastic.reset", extra={"error": str(e)[:500]})
                 state.restore()
                 skip_sync = False
             except HostsUpdatedInterrupt as e:

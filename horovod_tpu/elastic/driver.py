@@ -439,6 +439,18 @@ class ElasticDriver:
                     f"world {world_id} formation stalled for "
                     f"{stalled_for:.1f}s — abandoning the incarnation; "
                     f"blacklisting {sorted({h for h, _ in missing})}")
+                # Abandon-incarnation is a flight-dump trigger: the
+                # driver's ring (fault counters, stall warnings) plus
+                # the missing-slot list is the postmortem's record of
+                # WHICH hosts never formed (docs/observability.md).
+                from ..monitor import flight as _flight
+
+                _flight.dump_flight_record(
+                    reason="elastic.abandon",
+                    extra={"world_id": world_id,
+                           "stalled_secs": round(stalled_for, 3),
+                           "missing_slots": sorted(
+                               f"{h}:{s}" for h, s in missing)})
                 for host in {h for h, _ in missing}:
                     self._host_manager.blacklist(host)
                 if self._registry.reset_limit_reached():

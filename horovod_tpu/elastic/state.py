@@ -46,6 +46,17 @@ class State:
         (reference common/elastic.py:84-93). Call at the point in the train
         loop where state is consistent."""
         self.save()
+        # Commit points are the elastic loop's step boundaries: mark each
+        # in the flight ring so a postmortem can place every rank's last
+        # consistent state (monitor/flight.py; ``batch`` when the state
+        # carries one — the convention of hvd.elastic examples/tests).
+        from ..monitor import flight as _flight
+
+        batch = getattr(self, "batch", None)
+        _flight.instant(
+            "FLIGHT:COMMIT", tid="flight",
+            args=({"batch": int(batch)}
+                  if isinstance(batch, int) else None))
         self.check_host_updates()
 
     def check_host_updates(self) -> None:

@@ -22,7 +22,21 @@ behind one typed registry with cross-rank aggregation and pluggable sinks:
   ``hvd.profile_window(num_steps)`` brackets a ``jax.profiler`` trace
   with the Timeline and per-step ``StepTraceAnnotation`` markers;
 * :mod:`.span_audit` — B/E span-balance auditing over Timeline files
-  (the test helper and the ``scripts/obs_report.py`` phase breakdown).
+  (the test helper and the ``scripts/obs_report.py`` phase breakdown),
+  with the CHECKED event-vocabulary table (``KNOWN_PREFIXES`` +
+  ``strict=`` mode);
+* :mod:`.flight` — the crash-forensic flight recorder: an always-on
+  bounded ring of recent events (every Timeline event tapped in, plus
+  the timeline-less sources), dumped atomically with a crc32 to
+  ``HOROVOD_FLIGHT_RECORDER_DIR`` on crash paths and by
+  ``hvd.dump_flight_record()`` — the artifact ``scripts/postmortem.py``
+  joins across ranks;
+* :mod:`.straggler` — cross-rank straggler attribution: per-step
+  per-phase durations riding the registry's one-fused-allreduce
+  aggregation, median/MAD outlier detection
+  (``straggler.detected{rank,phase}``, ``step.skew_ms``,
+  ``STRAGGLER:*`` instants), and cost-model-backed link-health scores
+  (``link.health{hop}``, docs/cost-model.md).
 
 The registry is enabled by default (``HOROVOD_METRICS_DISABLE=1`` turns
 every record into a no-op); its lifecycle rides ``hvd.init()`` /
@@ -55,7 +69,21 @@ from .stall import (  # noqa: F401
     stalled_tensors,
 )
 from .profile import profile_window  # noqa: F401
-from .span_audit import SpanAudit, audit_spans  # noqa: F401
+from .span_audit import (  # noqa: F401
+    KNOWN_PREFIXES,
+    SpanAudit,
+    UnknownSpanPrefixError,
+    audit_spans,
+)
+from .flight import (  # noqa: F401
+    FlightRecorder,
+    dump_flight_record,
+    flight_recorder,
+)
+from .straggler import (  # noqa: F401
+    StragglerDetector,
+    straggler_detector,
+)
 
 from . import lifecycle as _lifecycle
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import threading
 from typing import List, Optional
 
+from . import flight as _flight
 from . import registry as _registry
 from . import sinks as _sinks
 from . import stall as _stall
@@ -67,11 +68,23 @@ def start_from_env(config) -> None:
         if config.metrics_port is not None and _prom is None:
             _prom = _sinks.PrometheusSink(reg, config.metrics_port)
             _active_sinks.append(_prom)
+            # Endpoint discovery (docs/observability.md): with port 0 the
+            # OS assigns the port, so scrapers cannot know it a priori —
+            # publish the resolved port as a gauge and, when the JSONL
+            # sink names a path, as a discovery file beside it (what
+            # scripts/obs_report.py reads to locate the endpoint).
+            reg.gauge("metrics.port").set(_prom.port)
+            if config.metrics_jsonl:
+                _write_port_discovery(config.metrics_jsonl, _prom.port)
         if config.metrics_interval > 0 and _reporter is None:
             _reporter = _sinks.Reporter(
                 reg, _active_sinks + [_timeline_sink],
                 config.metrics_interval,
                 aggregate=config.metrics_aggregate)
+    # Forensics: arm the flight recorder's crash-path dump handlers
+    # (excepthook / SIGTERM / faulthandler) when a dump dir is
+    # configured; the ring itself records unconditionally.
+    _flight.arm_from_env(config)
     insp = _stall.stall_inspector()
     if not config.stall_check_disable:
         insp.warning_secs = config.stall_warning_time_seconds
@@ -81,6 +94,23 @@ def start_from_env(config) -> None:
         insp.start()
     else:
         insp.stop()
+
+
+def _write_port_discovery(jsonl_path: str, port: int) -> None:
+    """Atomic ``<jsonl>.port`` discovery file: {"port", "pid",
+    "endpoint"} — crash-safe via the tmp→os.replace discipline."""
+    import json
+    import os
+
+    path = jsonl_path + ".port"
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"port": int(port), "pid": os.getpid(),
+                       "endpoint": f"http://127.0.0.1:{port}/metrics"}, f)
+        os.replace(tmp, path)
+    except OSError:  # discovery is best-effort, never fails init
+        pass
 
 
 def flush() -> None:

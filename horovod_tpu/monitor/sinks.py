@@ -184,6 +184,16 @@ class Reporter:
     def flush(self) -> None:
         snap = (self.registry.aggregate() if self.aggregate
                 else self.registry.snapshot())
+        if self.aggregate:
+            # Straggler detection folds into the SAME aggregated
+            # snapshot the interval allreduce just produced — cross-rank
+            # skew attribution at zero extra wire (monitor/straggler.py).
+            try:
+                from . import straggler as _straggler
+
+                _straggler.straggler_detector().detect(snapshot=snap)
+            except Exception:  # detection must never kill the exporter
+                pass
         for s in self.sinks:
             s.write(snap)
 

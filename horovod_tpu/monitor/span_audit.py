@@ -7,11 +7,12 @@ goes negative — plus the per-activity duration accounting that
 the hand-rolled balance loops that used to live in ``tests/test_overlap``
 and ``tests/test_serve``.
 
-Span vocabularies audited today (docs/observability.md has the full
-event table): ``OVERLAP:*`` (streamed bucket collectives),
-``FUSED:*`` (fused Pallas kernel calls, docs/fused-kernels.md),
-``PP:*`` (pipeline send legs + per-rank schedule slots,
-docs/pipeline.md), ``SERVE:PREFILL/DECODE``, ``PROFILE:*``, ``CKPT:*``.
+The event vocabulary is a CHECKED table (:data:`KNOWN_PREFIXES`,
+docs/observability.md has the full event table): every family a
+subsystem emits is registered here, and ``audit_spans(strict=True)``
+fails on an event whose prefix is not — so a typo'd span name (or a new
+family someone forgot to document) breaks the span tests instead of
+silently skewing a phase breakdown.
 """
 
 from __future__ import annotations
@@ -20,9 +21,40 @@ import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple, Union
 
+#: The unified Timeline event vocabulary: every ``PREFIX:`` an event
+#: family may use (plus the colon-free reference-parity cycle marker).
+#: One row per family in docs/observability.md's event table; new
+#: subsystems register here FIRST.
+KNOWN_PREFIXES = frozenset({
+    "FAULT",       # fault/retry counter instants (common/counters.py)
+    "AUTOTUNE",    # tuning-session lifecycle (autotune/driver.py)
+    "OVERLAP",     # streamed bucket collectives (docs/overlap.md)
+    "SERVE",       # generation-engine events (docs/serving.md)
+    "STALL",       # StallInspector instants (monitor/stall.py)
+    "METRIC",      # TimelineSink registry mirrors (monitor/sinks.py)
+    "PROFILE",     # hvd.profile_window brackets (monitor/profile.py)
+    "CYCLE_START",  # HOROVOD_TIMELINE_MARK_CYCLES (reference parity)
+    "CKPT",        # async checkpoint lifecycle (docs/checkpoint.md)
+    "FUSED",       # fused Pallas kernel spans (docs/fused-kernels.md)
+    "PP",          # pipeline sends + schedule slots (docs/pipeline.md)
+    "STRAGGLER",   # skew / link-health diagnoses (monitor/straggler.py)
+    "FLIGHT",      # flight-recorder marks (monitor/flight.py)
+})
+
+
+def event_prefix(name: str) -> str:
+    """The vocabulary prefix of an event name (the part before the
+    first colon; colon-free names are their own prefix)."""
+    return name.split(":", 1)[0] if ":" in name else name
+
 
 class SpanImbalanceError(AssertionError):
     """A tid's B/E events do not balance (or depth went negative)."""
+
+
+class UnknownSpanPrefixError(AssertionError):
+    """``strict=True``: an event's prefix is not in the checked
+    vocabulary table (:data:`KNOWN_PREFIXES`)."""
 
 
 @dataclass
@@ -68,7 +100,8 @@ def load_events(source: Union[str, list]) -> list:
 
 def audit_spans(source: Union[str, list], prefix: Optional[str] = None,
                 require_balanced: bool = True,
-                require_spans: bool = False) -> SpanAudit:
+                require_spans: bool = False,
+                strict: bool = False) -> SpanAudit:
     """Audit B/E balance per tid over a Timeline file (or event list).
 
     ``prefix`` restricts the audit to events whose name starts with it
@@ -77,8 +110,23 @@ def audit_spans(source: Union[str, list], prefix: Optional[str] = None,
     when any depth goes negative or fails to return to zero;
     ``require_spans`` additionally demands at least one matching span
     closed (guards against a filter that silently matched nothing).
+    ``strict`` checks EVERY scanned event (before the ``prefix``
+    filter) against the vocabulary table, raising
+    :class:`UnknownSpanPrefixError` on the first name whose prefix is
+    not in :data:`KNOWN_PREFIXES` — the mode framework span tests run
+    in, so the vocabulary stays exhaustive.
     """
     events = load_events(source)
+    if strict:
+        for ev in events:
+            name = str(ev.get("name", ""))
+            p = event_prefix(name)
+            if p not in KNOWN_PREFIXES:
+                raise UnknownSpanPrefixError(
+                    f"event {name!r} uses unknown prefix {p!r}: not in "
+                    f"the checked vocabulary table "
+                    f"(monitor/span_audit.KNOWN_PREFIXES — register new "
+                    f"event families there and in docs/observability.md)")
     audit = SpanAudit()
     stacks: Dict[str, List[Tuple[str, float]]] = {}
     for ev in events:

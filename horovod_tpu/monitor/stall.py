@@ -169,6 +169,17 @@ class StallInspector:
                     f"Tensor {p.name} stalled for {waited:.1f}s, exceeding "
                     f"the shutdown deadline of {self.shutdown_secs}s.")
                 _registry.counter("stall.shutdowns", kind=p.kind).inc()
+                # Escalation is a dump trigger (docs/observability.md):
+                # the rank is wedged past the deadline — capture the
+                # black box NOW, while the in-flight set still names the
+                # stalled collective. No-op unless a dump dir is set.
+                from . import flight as _flight
+
+                _flight.dump_flight_record(
+                    reason="stall.escalation",
+                    extra={"tensor": p.name, "kind": p.kind,
+                           "rank": p.rank,
+                           "elapsed_secs": round(waited, 3)})
         return fired
 
     @staticmethod
@@ -179,11 +190,17 @@ class StallInspector:
             tl = basics._state.timeline
         except Exception:  # pragma: no cover - interpreter teardown
             return
-        if tl is not None:
-            tl.instant(f"STALL:{p.name}", tid="stalls", args={
-                "kind": p.kind, "rank": p.rank,
+        args = {"kind": p.kind, "rank": p.rank,
                 "elapsed_secs": round(waited, 3),
-                "ready_ranks": ready, "missing_ranks": missing})
+                "ready_ranks": ready, "missing_ranks": missing}
+        if tl is not None:
+            tl.instant(f"STALL:{p.name}", tid="stalls", args=args)
+        else:
+            # No timeline: the stall still reaches the flight ring (the
+            # timeline path is tapped there automatically).
+            from . import flight as _flight
+
+            _flight.instant(f"STALL:{p.name}", tid="stalls", args=args)
 
     # -- watchdog thread ------------------------------------------------
 

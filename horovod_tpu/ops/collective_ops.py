@@ -1273,16 +1273,29 @@ def _eager_instrumented(kind: str, name: str):
     ``stall`` injected in ``_eager_ctx`` — surfaces as a rank-attributed
     ``STALL:*`` warning, docs/observability.md), and the wall time of a
     completed op feeds the ``comm.eager.latency_ms`` histogram."""
+    from ..monitor import flight as _flight
     from ..monitor import stall as _stall
+    from ..monitor import straggler as _straggler
 
     if _metrics.metrics_enabled():
         _metrics.counter("comm.eager.calls", kind=kind).inc()
     t0 = time.perf_counter()
     with _stall.track(name, kind=kind):
         yield
+    ms = (time.perf_counter() - t0) * 1e3
     if _metrics.metrics_enabled():
-        _metrics.histogram("comm.eager.latency_ms", kind=kind).observe(
-            (time.perf_counter() - t0) * 1e3)
+        _metrics.histogram("comm.eager.latency_ms", kind=kind).observe(ms)
+        # Straggler attribution (monitor/straggler.py): eager wall time
+        # charges the wire.dcn phase — the process-world data plane is
+        # host-to-host TCP, DCN-class wire. A rank whose eager
+        # collectives drag (chaos delay, a sick NIC) shows up as a
+        # (rank, wire.dcn) outlier after cross-rank aggregation.
+        _straggler.record_phase("wire.dcn", ms)
+    # The eager path has no timeline event of its own; the flight ring
+    # records each completed call so a dump shows the collective trail.
+    _flight.instant("FLIGHT:COLLECTIVE", tid="flight",
+                    args={"name": name, "kind": kind,
+                          "ms": round(ms, 3)})
 
 
 def _eager_allreduce(tensor, op: ReduceOp, name: Optional[str] = None):

@@ -385,6 +385,21 @@ def price_send(plan: ir.WirePlan, payload_bytes: float, *,
     }
 
 
+def predict_hop_ms(hop: str, nbytes: float,
+                   model: Optional[CostModel] = None) -> float:
+    """Predicted transfer milliseconds of ``nbytes`` on one link class
+    under the resolved (calibrated-else-static) cost model: the
+    bytes/bandwidth term plus one launch latency. This is the
+    *predicted* side of the monitor layer's link-health score
+    (``monitor/straggler.observe_wire``, docs/observability.md): a hop
+    whose measured wire-ms persistently exceeds this prediction is
+    either degraded or the calibration is stale."""
+    model = model or resolve()
+    lk = model.link(hop)
+    return (float(nbytes) / (lk.bandwidth_gbps * 1e9) * 1e3
+            + lk.latency_us / 1e3)
+
+
 def resolve(mesh_shape=None) -> CostModel:
     """The cost model for ``mesh_shape``: the calibrated triples when a
     matching-geometry sweep is on disk (docs/cost-model.md), else the

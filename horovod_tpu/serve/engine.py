@@ -32,6 +32,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..common import basics
 from ..models.gpt import GPT, GPTConfig
+from ..monitor import flight as _flight
 from ..monitor import registry as _metrics
 from ..monitor import stall as _stall
 from ..parallel.tensor import tp_merge_params, tp_split_params
@@ -322,6 +323,14 @@ class GenerationEngine:
         _metrics.counter("serve.steps").inc()
         _metrics.counter("serve.prefill_tokens").inc(n_prefill)
         _metrics.counter("serve.decode_tokens").inc(n_decode)
+        # Flight ring (monitor/flight.py): one instant per engine step —
+        # the serving analogue of FLIGHT:STEP, so a crashed replica's
+        # dump shows what the batch looked like when it died.
+        _flight.instant("FLIGHT:SERVE_STEP", tid="flight",
+                        args={"engine": self.name,
+                              "step": self.stats.steps,
+                              "prefill": n_prefill, "decode": n_decode,
+                              "slots": len(self.slots)})
 
         for slot in list(self.slots):
             st = self.slots[slot]

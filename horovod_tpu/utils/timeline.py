@@ -66,6 +66,15 @@ class Timeline:
         if args:
             ev["args"] = args
         self._queue.put(ev)
+        # Every Timeline event also lands in the flight recorder's ring
+        # (monitor/flight.py): the crash-forensic black box holds the
+        # last N events even when the timeline file dies with the rank.
+        try:
+            from ..monitor import flight as _flight
+
+            _flight.tap(ev)
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
 
     def begin(self, tensor_name: str, activity: str) -> None:
         """Begin an activity for a tensor (reference: Timeline::ActivityStart)."""

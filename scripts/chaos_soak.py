@@ -82,14 +82,32 @@ def run_once(fault, seed, workdir, verbose=False):
         chaos.configure(chaos.FaultPlan(seed=seed).add(
             "driver.slot_grant", "drop", prob=0.3, max_count=4))
     elif fault == "stall":
+        # Timing contract (three-way): the abandon deadline must exceed
+        # a healthy worker's startup (process spawn + jax import — the
+        # hostA slots must have rendezvoused by then or they get
+        # blacklisted too), stay far below the injected stall (so ONLY
+        # hostB is still missing at abandon time), and stay below the
+        # workers' formation timeout (a failed-formation report resumes
+        # the driver, which resets the very progress clock the watchdog
+        # reads — churn must not outrun the deadline).
         plan = chaos.FaultPlan(seed=seed).add(
-            "bootstrap.rendezvous", "stall", where="hostB:0", secs=8,
+            "bootstrap.rendezvous", "stall", where="hostB:0", secs=45,
             max_count=1)
-        worker_env = {**plan.to_env(), "HOROVOD_START_TIMEOUT": "3"}
+        worker_env = {**plan.to_env(), "HOROVOD_START_TIMEOUT": "15"}
         worker_args = ["--batches", "4", "--batch-sleep", "0.05"]
-        driver_kwargs = dict(stall_warn_secs=1.0, stall_shutdown_secs=2.0)
+        driver_kwargs = dict(stall_warn_secs=2.0,
+                             stall_shutdown_secs=8.0)
     else:
         raise ValueError(f"unknown fault {fault!r}")
+
+    # Forensics armed for the stall leg: the driver's abandon-
+    # incarnation path must leave a postmortem-joinable flight dump
+    # naming the slots that never formed (docs/observability.md).
+    flight_dir = None
+    if fault == "stall":
+        flight_dir = os.path.join(workdir, "flight")
+        os.environ["HOROVOD_FLIGHT_RECORDER_DIR"] = flight_dir
+        worker_env["HOROVOD_FLIGHT_RECORDER_DIR"] = flight_dir
 
     driver = ElasticDriver(HostDiscoveryScript(script, 1), min_np=2,
                            max_np=3, controller_addr_override="127.0.0.1",
@@ -120,6 +138,7 @@ def run_once(fault, seed, workdir, verbose=False):
         driver.stop()
         driver.shutdown_service()
         chaos.reset()
+        os.environ.pop("HOROVOD_FLIGHT_RECORDER_DIR", None)
 
     records = _read_log(log_file)
     done = [r for r in records if r.get("done")]
@@ -137,6 +156,31 @@ def run_once(fault, seed, workdir, verbose=False):
     else:  # drop: absorbed invisibly, full world finishes
         if len(done) != 3:
             problems.append(f"{len(done)} finishers, expected 3")
+    if fault == "stall":
+        # Postmortem assertion: the abandoned incarnation left a flight
+        # dump whose join names the missing hostB slot.
+        import importlib.util
+
+        pm_path = os.path.join(REPO, "scripts", "postmortem.py")
+        spec = importlib.util.spec_from_file_location("_postmortem",
+                                                      pm_path)
+        pm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(pm)
+        report = pm.build_report(flight_dir)
+        if report["corrupt"]:
+            problems.append(f"corrupt flight dumps: {report['corrupt']}")
+        abandons = [r for r in report["ranks"].values()
+                    if r["reason"] == "elastic.abandon"]
+        if not abandons:
+            problems.append(
+                f"no elastic.abandon flight dump in {flight_dir} "
+                f"({report['dumps']} dump(s))")
+        elif not any("hostB" in s for a in abandons
+                     for s in (a.get("extra") or {}).get(
+                         "missing_slots", [])):
+            problems.append(
+                f"abandon dump does not name the missing hostB slot: "
+                f"{[a.get('extra') for a in abandons]}")
     if len({r["weights"] for r in done}) > 1:
         problems.append(f"finishers disagree on weights: {done}")
     detail = (f"world_id={driver.world_id} done={len(done)} "

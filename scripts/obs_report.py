@@ -3,7 +3,7 @@
 
 Usage:
     scripts/obs_report.py --timeline TL.json --metrics METRICS.jsonl \
-        [--json OUT.json]
+        [--flight FLIGHT_DIR] [--json OUT.json]
 
 Produce the artifacts with any bench/training run::
 
@@ -23,7 +23,13 @@ Report sections (docs/observability.md):
 * **Wire budget** — measured per-device wire bytes per hop vs the
   modeled transfer time at HOROVOD_BENCH_ICI_GBPS/DCN_GBPS (the same
   bandwidth model behind bench.py's step_time_breakdown), and the DCN
-  fp-equivalent reduction of the quantized wire.
+  fp-equivalent reduction of the quantized wire;
+* **Straggler table** — per-rank per-phase skew from the
+  ``straggler.*`` gauges (monitor/straggler.py), detections, step-skew
+  gauges, and the cost-model-backed ``link.health{hop}`` scores;
+* **Flight records** — with ``--flight DIR`` (or
+  HOROVOD_FLIGHT_RECORDER_DIR set), the ``scripts/postmortem.py``
+  cross-rank join of any dumps present.
 
 Exit 0 on success, 2 on usage/artifact errors. ``--json`` additionally
 writes the report as one machine-readable dict (what obs_smoke.sh
@@ -67,7 +73,67 @@ def hidden_fraction(gauges):
     return gauges.get("comm.wire.overlap_bytes", 0.0) / total
 
 
-def build_report(timeline_path, metrics_path):
+def straggler_section(counters, gauges):
+    """Per-rank per-phase matrix + detections + link health from the
+    registry families monitor/straggler.py publishes."""
+    import re
+
+    phase_re = re.compile(
+        r"^straggler\.phase_ms\{phase=([^,}]+),rank=(\d+)\}$")
+    matrix = {}
+    for k, v in gauges.items():
+        m = phase_re.match(k)
+        if m:
+            matrix.setdefault(int(m.group(2)), {})[m.group(1)] = v
+    det_re = re.compile(
+        r"^straggler\.detected\{phase=([^,}]+),rank=(\d+)\}$")
+    detected = [{"rank": int(m.group(2)), "phase": m.group(1), "count": v}
+                for k, v in counters.items()
+                for m in [det_re.match(k)] if m]
+    skew = {k.split("phase=", 1)[1].rstrip("}"): v
+            for k, v in gauges.items() if k.startswith("step.skew_ms{")}
+    link = {k.split("hop=", 1)[1].rstrip("}"): v
+            for k, v in gauges.items() if k.startswith("link.health{")}
+    degraded = {k.split("hop=", 1)[1].rstrip("}"): v
+                for k, v in counters.items()
+                if k.startswith("straggler.link_degraded{")}
+    return {
+        "phase_ms_by_rank": {str(r): matrix[r] for r in sorted(matrix)},
+        "detected": sorted(detected,
+                           key=lambda d: (d["rank"], d["phase"])),
+        "step_skew_ms": skew,
+        "link_health": link,
+        "link_degraded": degraded,
+    }
+
+
+def flight_section(flight_dir):
+    """The postmortem join of any flight dumps present (None when the
+    directory is unset/empty — a healthy run has no dumps)."""
+    if not flight_dir or not os.path.isdir(flight_dir):
+        return None
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "postmortem.py")
+    spec = importlib.util.spec_from_file_location("_postmortem", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.build_report(flight_dir)
+    return report if report["dumps"] else None
+
+
+def prometheus_discovery(metrics_path):
+    """The ``<jsonl>.port`` endpoint-discovery file the PrometheusSink
+    leaves when HOROVOD_METRICS_PORT resolves a port (0 = ephemeral)."""
+    try:
+        with open(metrics_path + ".port") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def build_report(timeline_path, metrics_path, flight_dir=None):
     events = load_events(timeline_path)
     try:
         audit = audit_spans(events)
@@ -135,6 +201,10 @@ def build_report(timeline_path, metrics_path):
                         if k.startswith("comm.eager.calls")},
         "serve": {k: v for k, v in {**counters, **gauges}.items()
                   if k.startswith("serve.")},
+        "straggler": straggler_section(counters, gauges),
+        "flight": flight_section(
+            flight_dir or os.environ.get("HOROVOD_FLIGHT_RECORDER_DIR")),
+        "prometheus": prometheus_discovery(metrics_path),
     }
 
 
@@ -180,18 +250,56 @@ def print_report(r):
         w("-- serve --")
         for k, v in sorted(r["serve"].items()):
             w(f"  {k:<40} {v:g}")
+    st = r.get("straggler") or {}
+    if st.get("phase_ms_by_rank") or st.get("link_health"):
+        w("")
+        w("-- stragglers --")
+        for rank, phases in st.get("phase_ms_by_rank", {}).items():
+            row = "  ".join(f"{p}={ms:.1f}ms"
+                            for p, ms in sorted(phases.items()) if ms)
+            w(f"  rank {rank:<4} {row or '(no phases recorded)'}")
+        for p, v in sorted(st.get("step_skew_ms", {}).items()):
+            w(f"  skew {p:<12} {v:.2f} ms (max - median across ranks)")
+        for d in st.get("detected", []):
+            w(f"  DETECTED rank {d['rank']} phase {d['phase']} "
+              f"(x{d['count']:g})")
+        for hop, v in sorted(st.get("link_health", {}).items()):
+            flag = "  DEGRADED" if st.get("link_degraded", {}).get(hop) \
+                else ""
+            w(f"  link {hop:<4} health {v:.2f} "
+              f"(measured/predicted wire-ms){flag}")
+    if r.get("prometheus"):
+        w("")
+        w(f"-- prometheus: {r['prometheus'].get('endpoint')} "
+          f"(pid {r['prometheus'].get('pid')}) --")
+    if r.get("flight"):
+        fl = r["flight"]
+        w("")
+        w(f"-- flight records ({fl['dumps']} dump(s) in "
+          f"{fl['directory']}) --")
+        for key, row in fl["ranks"].items():
+            mark = " CRASHED" if row["crashed"] else ""
+            w(f"  {key:<14} reason={row['reason']} "
+              f"last_step={row['last_step']}{mark}")
+        if fl["crashed_ranks"]:
+            w(f"  crashing rank(s): {', '.join(fl['crashed_ranks'])}; "
+              f"last common step {fl['last_common_step']}")
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeline", required=True)
     ap.add_argument("--metrics", required=True)
+    ap.add_argument("--flight", default=None,
+                    help="flight-record dump dir (default: "
+                         "HOROVOD_FLIGHT_RECORDER_DIR)")
     ap.add_argument("--json", help="also write the report dict here")
     args = ap.parse_args()
     for p in (args.timeline, args.metrics):
         if not os.path.exists(p):
             ap.error(f"no such file: {p}")
-    report = build_report(args.timeline, args.metrics)
+    report = build_report(args.timeline, args.metrics,
+                          flight_dir=args.flight)
     print_report(report)
     if args.json:
         with open(args.json, "w") as f:

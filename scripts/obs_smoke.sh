@@ -4,6 +4,12 @@
 # assert scripts/obs_report.py joins them into a coherent report —
 # nonzero wire bytes, balanced spans, zero stalls, and a
 # comm_hidden_fraction that reproduces the bench-reported value within 1%.
+# A second, forensics leg then closes the crash loop on the CPU mesh:
+# an injected straggler must be detected and attributed with zero
+# clean-run false positives, an injected crash must leave an atomic
+# flight dump scripts/postmortem.py names the crashing rank from, and
+# armed forensics (flight ring + straggler accounting) must cost <1% of
+# the bench leg's measured step.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -40,7 +46,101 @@ assert abs(got - want) <= 0.01, \
     f"hidden fraction mismatch: report {got} vs bench {want}"
 assert bench["metrics_snapshot"]["histograms"].get("step.time_ms", {}) \
     .get("count", 0) > 0, "bench JSON missing the step-latency histogram"
+# the bench leg ran its own straggler detection: a clean run must have
+# flagged nothing, and the per-rank phase gauges must be present
+snap = bench["metrics_snapshot"]
+assert not any(k.startswith("straggler.detected")
+               for k in snap["counters"]), \
+    f"clean-run false positive: {snap['counters']}"
+assert any(k.startswith("straggler.phase_ms")
+           for k in snap["gauges"]), "no straggler phase gauges"
 print(f"obs smoke OK: {report['total_spans']} spans, "
       f"ICI {wb['ici_bytes_per_step_device']/1e6:.2f} MB/step, "
       f"hidden fraction {got:.4f} (bench {want:.4f}), 0 stalls")
+PY
+
+echo "== obs forensics leg ==" >&2
+JAX_PLATFORMS=cpu python - "$TMP" <<'PY'
+import json, os, subprocess, sys, time
+tmp = sys.argv[1]
+bench = json.load(open(f"{tmp}/bench.json"))
+step_ms = bench["step_ms_median"]
+
+# -- 1. injected straggler: detected, attributed, zero clean-run FPs --
+from horovod_tpu.monitor.registry import MetricsRegistry
+from horovod_tpu.monitor.straggler import StragglerDetector
+
+def drive(delay_rank, steps=3):
+    reg = MetricsRegistry(enabled=True)
+    dets = [StragglerDetector(reg, world=4, rank=r) for r in range(4)]
+    found = []
+    for step in range(steps):
+        for r, det in enumerate(dets):
+            det.record_phase("compute", 100.0)
+            det.record_phase("wire.dcn",
+                             10.0 + (90.0 if r == delay_rank else 0.0))
+            det.end_step(step)
+        found += dets[0].detect(snapshot=reg.snapshot())
+    return found
+
+found = drive(delay_rank=2)
+assert found and {(d["rank"], d["phase"]) for d in found} == \
+    {(2, "wire.dcn")}, f"bad attribution: {found}"
+assert drive(delay_rank=None) == [], "clean-run false positive"
+
+# -- 2. injected crash -> atomic dump -> postmortem names the rank --
+flight = os.path.join(tmp, "flight")
+code = (
+    "import horovod_tpu as hvd\n"
+    "from horovod_tpu import chaos\n"
+    "import jax.numpy as jnp\n"
+    "hvd.init()\n"
+    "chaos.configure(chaos.FaultPlan(seed=5).add(\n"
+    "    'collective.eager', 'crash', after=2))\n"
+    "for i in range(9):\n"
+    "    hvd.allreduce(jnp.ones(2), name=f'smoke.{i}')\n"
+    "    hvd.monitor.flight_recorder().mark_step(i)\n")
+env = dict(os.environ, JAX_PLATFORMS="cpu",
+           HOROVOD_FLIGHT_RECORDER_DIR=flight)
+p = subprocess.run([sys.executable, "-c", code], env=env,
+                   capture_output=True, text=True, timeout=300)
+assert p.returncode != 0, "chaos crash did not kill the process"
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__))))
+import importlib.util
+spec = importlib.util.spec_from_file_location(
+    "_postmortem", os.path.join("scripts", "postmortem.py"))
+pm = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(pm)
+report = pm.build_report(flight)
+assert report["dumps"] >= 1 and not report["corrupt"], report
+assert report["crashed_ranks"] == ["rank0"], report["crashed_ranks"]
+row = report["ranks"]["rank0"]
+assert row["reason"] == "chaos.crash"
+assert row["last_step"] is not None and row["last_step"] <= 2
+
+# -- 3. hard overhead gate: armed forensics <1% of the measured step --
+from horovod_tpu.monitor.flight import FlightRecorder
+fr = FlightRecorder(capacity=4096, snapshot_every=1024)
+reg = MetricsRegistry(enabled=True)
+det = StragglerDetector(reg, world=8, rank=0)
+n = 300
+t0 = time.perf_counter()
+for i in range(n):
+    for j in range(4):
+        fr.record("FLIGHT:COLLECTIVE", tid="flight",
+                  args={"name": f"op.{i}.{j}", "ms": 1.0})
+    for ph in ("compute", "wire.ici", "wire.dcn", "wire.pod",
+               "pp_bubble", "ckpt"):
+        det.record_phase(ph, 1.0)
+    det.end_step(i)
+overhead_ms = (time.perf_counter() - t0) / n * 1e3
+frac = overhead_ms / step_ms
+assert frac < 0.01, (
+    f"armed forensics {overhead_ms:.4f} ms vs step {step_ms:.2f} ms "
+    f"({100*frac:.2f}% >= 1%)")
+print(f"obs forensics OK: straggler attributed (rank 2, wire.dcn), "
+      f"crash postmortem named {report['crashed_ranks'][0]} at step "
+      f"{row['last_step']}, armed overhead {100*frac:.3f}% of a "
+      f"{step_ms:.1f} ms step")
 PY

@@ -459,7 +459,10 @@ class TestFusedObservability:
         assert any(n.startswith("FUSED:DEQUANT") for n in names), names
         from horovod_tpu.monitor.span_audit import audit_spans
 
-        audit = audit_spans(events, prefix="FUSED", require_spans=True)
+        # strict=: the whole trace is checked against the event-
+        # vocabulary table, not just the FUSED:* family under audit.
+        audit = audit_spans(events, prefix="FUSED", require_spans=True,
+                            strict=True)
         assert audit.balanced
 
     def test_comm_fused_metrics_counted(self):

@@ -1,7 +1,10 @@
 """Unified observability layer tests (horovod_tpu/monitor/): registry
 semantics, sinks, cross-rank aggregation, StallInspector (including the
 chaos-stall acceptance scenario), host/device profile correlation, span
-audit, and the <1% registry-overhead budget on the 8-device CPU mesh."""
+audit, the forensic layer (flight recorder ring/dumps/triggers,
+straggler attribution with the chaos cross-wiring acceptance scenarios,
+link health, postmortem join), and the <1% overhead budgets (registry,
+and forensics armed) on the 8-device CPU mesh."""
 
 import importlib.util
 import json
@@ -592,3 +595,667 @@ class TestPerfGateSnapshot:
         assert rec["counters"][
             "perf_gate.regressions{leg=serve,what=serve_throughput}"] == 1.0
         assert rec["perf_gate"]["pass"] is False
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder (monitor/flight.py)
+
+
+from horovod_tpu.monitor.flight import FlightRecorder  # noqa: E402
+from horovod_tpu.monitor.span_audit import (  # noqa: E402
+    KNOWN_PREFIXES,
+    UnknownSpanPrefixError,
+    event_prefix,
+)
+from horovod_tpu.monitor.straggler import StragglerDetector  # noqa: E402
+
+
+def _load_postmortem():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "postmortem.py")
+    spec = importlib.util.spec_from_file_location("_postmortem", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_ordered(self):
+        fr = FlightRecorder(capacity=8, snapshot_every=0)
+        for i in range(20):
+            fr.record(f"FLIGHT:E{i}", tid="t")
+        evs = fr.events()
+        assert len(evs) == 8
+        assert [e["name"] for e in evs] == \
+            [f"FLIGHT:E{i}" for i in range(12, 20)]
+        seqs = [e["seq"] for e in evs]
+        assert seqs == sorted(seqs) and seqs[-1] == 19
+        assert all("wall" in e for e in evs)
+
+    def test_capacity_zero_disables(self, tmp_path):
+        fr = FlightRecorder(capacity=0)
+        fr.record("FLIGHT:X")
+        assert fr.events() == []
+        assert fr.dump(directory=str(tmp_path)) is None
+
+    def test_periodic_registry_snapshots(self):
+        monitor.metrics().counter("flight.snap_probe").inc(3)
+        fr = FlightRecorder(capacity=64, snapshot_every=4)
+        for i in range(10):
+            fr.record(f"FLIGHT:S{i}")
+        snaps = [e for e in fr.events() if e["name"] == "FLIGHT:SNAPSHOT"]
+        assert len(snaps) == 2  # after events 4 and 8
+        assert snaps[0]["args"]["counters"]["flight.snap_probe"] >= 3.0
+
+    def test_dump_atomic_crc_and_contents(self, tmp_path):
+        import zlib
+
+        fr = FlightRecorder(capacity=32, snapshot_every=0)
+        fr.record("FLIGHT:A", args={"k": 1})
+        fr.mark_step(7, {"compute": 12.5})
+        path = fr.dump("unit", directory=str(tmp_path),
+                       extra={"note": "x"})
+        assert path and os.path.exists(path)
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+        d = json.load(open(path))
+        assert d["kind"] == "flight_record" and d["reason"] == "unit"
+        assert d["extra"] == {"note": "x"}
+        assert d["identity"]["pid"] == os.getpid()
+        names = [e["name"] for e in d["events"]]
+        assert names == ["FLIGHT:A", "FLIGHT:STEP"]
+        assert d["events"][1]["args"]["step"] == 7
+        payload = json.dumps(d["events"], sort_keys=True).encode()
+        want = f"crc32:{zlib.crc32(payload) & 0xFFFFFFFF:08x}"
+        assert d["events_crc32"] == want
+        assert "registry" in d and "in_flight" in d
+
+    def test_dump_without_destination_is_noop(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_FLIGHT_RECORDER_DIR", raising=False)
+        fr = FlightRecorder(capacity=8)
+        fr.record("FLIGHT:Y")
+        assert fr.dump("nowhere") is None
+
+    def test_timeline_events_are_tapped(self, tmp_path):
+        from horovod_tpu.monitor import flight as flight_mod
+
+        fr = monitor.flight_recorder()
+        hvd.start_timeline(str(tmp_path / "tl.json"))
+        try:
+            hvd.mesh()  # ensure initialized
+            from horovod_tpu.common import basics
+
+            basics._state.timeline.instant("FAULT:tap.probe",
+                                           tid="faults")
+        finally:
+            hvd.stop_timeline()
+        assert any(e["name"] == "FAULT:tap.probe"
+                   for e in fr.events())
+        assert flight_mod.recorder() is fr
+
+    def test_eager_collective_and_stall_reach_ring(self):
+        fr = monitor.flight_recorder()
+        hvd.allreduce(jnp.ones(2), name="flight.eager.probe")
+        colls = [e for e in fr.events()
+                 if e["name"] == "FLIGHT:COLLECTIVE"
+                 and e["args"]["name"] == "flight.eager.probe"]
+        assert colls and colls[-1]["args"]["kind"] == "allreduce"
+        # a stall instant lands in the ring even with no timeline
+        insp = StallInspector(warning_secs=0.01)
+        insp.record_start("flight.stall.probe", rank=0)
+        time.sleep(0.03)
+        insp.check()
+        assert any(e["name"] == "STALL:flight.stall.probe"
+                   for e in fr.events())
+
+    def test_excepthook_dump_in_subprocess(self, tmp_path):
+        import subprocess
+        import sys as _sys
+
+        code = (
+            "import os\n"
+            "import horovod_tpu as hvd\n"
+            "hvd.init()\n"
+            "import jax.numpy as jnp\n"
+            "hvd.allreduce(jnp.ones(2), name='pre.crash')\n"
+            "raise RuntimeError('forensic boom')\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   HOROVOD_FLIGHT_RECORDER_DIR=str(tmp_path))
+        env.pop("HOROVOD_TIMELINE", None)
+        p = subprocess.run([_sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode != 0
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_") and f.endswith(".json")]
+        assert dumps, (p.stdout, p.stderr)
+        d = json.load(open(os.path.join(tmp_path, dumps[0])))
+        assert d["reason"] == "exception"
+        assert d["extra"]["exc_type"] == "RuntimeError"
+        assert "forensic boom" in d["extra"]["exc"]
+        assert any(e["name"] == "FLIGHT:COLLECTIVE"
+                   for e in d["events"])
+
+    def test_sigterm_dump_in_subprocess(self, tmp_path):
+        import signal
+        import subprocess
+        import sys as _sys
+
+        code = (
+            "import os, signal, time\n"
+            "import horovod_tpu as hvd\n"
+            "hvd.init()\n"
+            "os.kill(os.getpid(), signal.SIGTERM)\n"
+            "time.sleep(10)\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   HOROVOD_FLIGHT_RECORDER_DIR=str(tmp_path))
+        p = subprocess.run([_sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        # delivery semantics preserved: the process still dies of SIGTERM
+        assert p.returncode == -signal.SIGTERM or p.returncode == 143
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight_") and f.endswith(".json")]
+        assert dumps, (p.stdout, p.stderr)
+        d = json.load(open(os.path.join(tmp_path, dumps[0])))
+        assert d["reason"] == "sigterm"
+
+    def test_explicit_dump_api(self, tmp_path):
+        path = str(tmp_path / "explicit.json")
+        got = hvd.dump_flight_record(path=path)
+        assert got == path
+        d = json.load(open(path))
+        assert d["reason"] == "explicit"
+        assert d["identity"]["world"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Straggler attribution (monitor/straggler.py)
+
+
+def _rank_farm(world=4, registry=None, **kw):
+    """One detector per emulated rank over ONE shared registry — each
+    writes only its own rank's slots, exactly what the fused-allreduce
+    SUM reconstructs in a real multi-process world."""
+    reg = registry or MetricsRegistry(enabled=True)
+    dets = [StragglerDetector(reg, world=world, rank=r, **kw)
+            for r in range(world)]
+    return reg, dets
+
+
+class TestStragglerDetection:
+    def test_clean_run_zero_false_positives(self):
+        reg, dets = _rank_farm(world=4)
+        for step in range(10):
+            for r, det in enumerate(dets):
+                det.record_phase("compute", 100.0 + 0.3 * r)
+                det.record_phase("wire.dcn", 10.0 + 0.1 * step)
+                det.end_step(step)
+            assert dets[0].detect(snapshot=reg.snapshot()) == []
+        assert not any(k.startswith("straggler.detected")
+                       for k in reg.snapshot()["counters"])
+
+    def test_delayed_rank_detected_and_attributed(self):
+        reg, dets = _rank_farm(world=4)
+        flagged_at = None
+        for step in range(3):
+            for r, det in enumerate(dets):
+                det.record_phase("compute", 100.0)
+                det.record_phase(
+                    "wire.dcn", 10.0 + (80.0 if r == 2 else 0.0))
+                det.end_step(step)
+            found = dets[0].detect(snapshot=reg.snapshot())
+            if found and flagged_at is None:
+                flagged_at = step
+                assert [(d["rank"], d["phase"]) for d in found] == \
+                    [(2, "wire.dcn")]
+        # bounded step count: attributed on the very first detect pass
+        assert flagged_at == 0
+        snap = reg.snapshot()
+        assert snap["counters"][
+            "straggler.detected{phase=wire.dcn,rank=2}"] >= 1
+        assert snap["gauges"]["step.skew_ms{phase=wire.dcn}"] == \
+            pytest.approx(80.0)
+        # history rides the flight dump
+        assert any(d["rank"] == 2 for d in dets[0].history())
+
+    def test_fewer_than_three_ranks_never_flags(self):
+        reg, dets = _rank_farm(world=2)
+        for r, det in enumerate(dets):
+            det.record_phase("compute", 100.0 + 500.0 * r)
+            det.end_step(0)
+        assert dets[0].detect(snapshot=reg.snapshot()) == []
+        # the skew gauge still publishes for operators
+        assert reg.snapshot()["gauges"][
+            "step.skew_ms{phase=compute}"] > 0
+
+    def test_detection_emits_straggler_instant(self, tmp_path):
+        path = str(tmp_path / "tl.json")
+        reg, dets = _rank_farm(world=3)
+        for r, det in enumerate(dets):
+            det.record_phase("ckpt", 5.0 + (200.0 if r == 1 else 0.0))
+            det.end_step(0)
+        hvd.start_timeline(path)
+        try:
+            found = dets[0].detect(snapshot=reg.snapshot())
+        finally:
+            hvd.stop_timeline()
+        assert found and found[0]["rank"] == 1
+        events = json.load(open(path))
+        evs = [e for e in events if e["name"] == "STRAGGLER:CKPT"]
+        assert evs and evs[0]["ph"] == "i"
+        assert evs[0]["args"]["rank"] == 1
+        assert event_prefix(evs[0]["name"]) in KNOWN_PREFIXES
+
+    def test_phase_gauges_ride_registry_aggregation_schema(self):
+        """Every rank pre-creates the full (phase, rank) matrix, so the
+        flat aggregation layout is identical across ranks (the
+        schema-digest contract of MetricsRegistry.aggregate)."""
+        layouts = []
+        for r in range(3):
+            reg = MetricsRegistry(enabled=True)
+            det = StragglerDetector(reg, world=3, rank=r)
+            det.record_phase("compute", 10.0 * (r + 1))
+            det.end_step(0)
+            keys, _ = reg._flat_layout(reg.snapshot())
+            layouts.append(keys)
+        assert layouts[0] == layouts[1] == layouts[2]
+
+    def test_chaos_delay_attributed_through_real_eager_path(
+            self, monkeypatch):
+        """Acceptance (chaos cross-wiring): a seeded ``delay`` fault on
+        one rank's eager collectives is detected and attributed to that
+        (rank, wire.dcn) within a bounded step count, with zero false
+        positives on the clean control run."""
+        from horovod_tpu.monitor import straggler as straggler_mod
+
+        def drive(inject_rank, reg, dets, steps=2):
+            found_all = []
+            for step in range(steps):
+                for r, det in enumerate(dets):
+                    # route the global-path record_phase of
+                    # _eager_instrumented to this emulated rank
+                    monkeypatch.setattr(straggler_mod, "_global", det)
+                    if r == inject_rank:
+                        chaos.configure(chaos.FaultPlan(seed=9).add(
+                            "collective.eager", "delay", secs=0.12))
+                    try:
+                        hvd.allreduce(jnp.ones(2),
+                                      name=f"cw.{step}.{r}")
+                    finally:
+                        chaos.configure(None)
+                    det.record_phase("compute", 50.0)
+                    det.end_step(step)
+                found_all += dets[0].detect(snapshot=reg.snapshot())
+            return found_all
+
+        try:
+            reg, dets = _rank_farm(world=4)
+            found = drive(2, reg, dets)
+            assert found, "injected delay was never detected"
+            assert {(d["rank"], d["phase"]) for d in found} == \
+                {(2, "wire.dcn")}
+            # clean control: no injection, nothing may fire
+            reg2, dets2 = _rank_farm(world=4)
+            assert drive(None, reg2, dets2) == []
+        finally:
+            chaos.reset()
+            straggler_mod._reset_for_tests()
+
+
+class TestLinkHealth:
+    def test_degraded_link_flagged_and_recommends_recalibration(
+            self, caplog):
+        import logging as _logging
+
+        reg = MetricsRegistry(enabled=True)
+        det = StragglerDetector(reg, world=1, rank=0,
+                                link_drift_gate=1.5, patience=2)
+        from horovod_tpu.plan import cost
+
+        predicted = cost.predict_hop_ms("dcn", 1e9)
+        with caplog.at_level(_logging.WARNING,
+                             logger="horovod_tpu.straggler"):
+            # persistently 3x slower than the model predicts
+            r1 = det.observe_wire("dcn", 1e9, predicted * 3.0)
+            assert r1 == pytest.approx(3.0, rel=0.01)
+            assert not reg.snapshot()["counters"].get(
+                "straggler.link_degraded{hop=dcn}")  # patience not met
+            det.observe_wire("dcn", 1e9, predicted * 3.0)
+        snap = reg.snapshot()
+        assert snap["counters"]["straggler.link_degraded{hop=dcn}"] == 1
+        assert snap["gauges"]["link.health{hop=dcn}"] == \
+            pytest.approx(3.0, rel=0.01)
+        assert any(d["kind"] == "link" for d in det.history())
+        assert any("calibrate_links" in r.message for r in caplog.records)
+
+    def test_healthy_link_never_flags(self):
+        reg = MetricsRegistry(enabled=True)
+        det = StragglerDetector(reg, world=1, rank=0,
+                                link_drift_gate=1.5, patience=2)
+        from horovod_tpu.plan import cost
+
+        for _ in range(6):
+            det.observe_wire("ici", 1e8,
+                             cost.predict_hop_ms("ici", 1e8) * 1.05)
+        snap = reg.snapshot()
+        assert "straggler.link_degraded{hop=ici}" not in snap["counters"]
+        assert snap["gauges"]["link.health{hop=ici}"] == \
+            pytest.approx(1.05, rel=0.01)
+
+    def test_recovery_resets_patience(self):
+        reg = MetricsRegistry(enabled=True)
+        det = StragglerDetector(reg, world=1, rank=0,
+                                link_drift_gate=1.5, patience=3)
+        from horovod_tpu.plan import cost
+
+        p = cost.predict_hop_ms("pod", 1e8)
+        # transient blips that recover below the gate between drifts
+        # never accumulate the 3 consecutive over-gate observations
+        for _ in range(3):
+            det.observe_wire("pod", 1e8, p * 2.0)   # EWMA over the gate
+            det.observe_wire("pod", 1e8, p * 0.4)   # EWMA back under
+        assert "straggler.link_degraded{hop=pod}" not in \
+            reg.snapshot()["counters"]
+
+
+# ---------------------------------------------------------------------------
+# Span-audit vocabulary table (strict mode)
+
+
+class TestSpanVocabulary:
+    def test_known_prefixes_cover_the_documented_table(self):
+        for p in ("FAULT", "AUTOTUNE", "OVERLAP", "SERVE", "STALL",
+                  "METRIC", "PROFILE", "CYCLE_START", "CKPT", "FUSED",
+                  "PP", "STRAGGLER", "FLIGHT"):
+            assert p in KNOWN_PREFIXES
+
+    def test_event_prefix(self):
+        assert event_prefix("OVERLAP:ALLREDUCE") == "OVERLAP"
+        assert event_prefix("CYCLE_START") == "CYCLE_START"
+
+    def test_strict_rejects_unknown_prefix(self):
+        events = [
+            {"name": "PP:F", "ph": "B", "tid": "t", "ts": 0.0},
+            {"name": "PP:F", "ph": "E", "tid": "t", "ts": 1.0},
+            {"name": "TYPO:OOPS", "ph": "i", "tid": "t", "ts": 2.0},
+        ]
+        audit_spans(events, prefix="PP:")  # non-strict: fine
+        with pytest.raises(UnknownSpanPrefixError, match="TYPO"):
+            audit_spans(events, prefix="PP:", strict=True)
+
+    def test_strict_accepts_full_vocabulary(self):
+        events = [{"name": f"{p}:X", "ph": "i", "tid": "t", "ts": 0.0}
+                  for p in sorted(KNOWN_PREFIXES - {"CYCLE_START"})]
+        events.append({"name": "CYCLE_START", "ph": "i", "tid": "c",
+                       "ts": 1.0})
+        audit = audit_spans(events, strict=True)
+        assert sum(audit.instants.values()) == len(events)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus ephemeral-port discovery (lifecycle satellite)
+
+
+class TestPrometheusDiscovery:
+    def test_ephemeral_port_published_and_discoverable(
+            self, tmp_path, monkeypatch):
+        from horovod_tpu.monitor import lifecycle
+
+        jsonl = str(tmp_path / "m.jsonl")
+        monkeypatch.setenv("HOROVOD_METRICS_PORT", "0")
+        monkeypatch.setenv("HOROVOD_METRICS_JSONL", jsonl)
+        hvd.shutdown()
+        try:
+            hvd.init()
+            port = lifecycle.prometheus_port()
+            assert port and port > 0
+            assert monitor.metrics().gauge("metrics.port").value == port
+            disc = json.load(open(jsonl + ".port"))
+            assert disc["port"] == port
+            assert disc["pid"] == os.getpid()
+            assert disc["endpoint"].endswith(f":{port}/metrics")
+            body = urllib.request.urlopen(disc["endpoint"],
+                                          timeout=5).read().decode()
+            assert "horovod_" in body
+        finally:
+            hvd.shutdown()
+            lifecycle._reset_for_tests()
+            monkeypatch.delenv("HOROVOD_METRICS_PORT")
+            monkeypatch.delenv("HOROVOD_METRICS_JSONL")
+            hvd.init()
+
+
+# ---------------------------------------------------------------------------
+# Postmortem join (scripts/postmortem.py)
+
+
+def _write_dump(directory, rank, reason, steps, *, world=3,
+                extra_events=(), straggler=(), corrupt=False):
+    import zlib
+
+    events = [{"name": "FLIGHT:STEP", "ph": "i", "tid": "flight",
+               "wall": 1000.0 + s, "seq": s, "args": {"step": s}}
+              for s in range(steps + 1)]
+    events += list(extra_events)
+    payload = json.dumps(events, sort_keys=True).encode()
+    dump = {
+        "version": 1, "kind": "flight_record", "reason": reason,
+        "ts": 2000.0 + rank,
+        "identity": {"rank": rank, "world": world, "pid": 100 + rank,
+                     "hostname": f"host{rank}", "local_rank": "0"},
+        "events": events,
+        "events_crc32":
+            f"crc32:{zlib.crc32(payload) & 0xFFFFFFFF:08x}",
+        "registry": None, "in_flight": [], "stalled": [],
+        "straggler": list(straggler),
+    }
+    if corrupt:
+        dump["events_crc32"] = "crc32:deadbeef"
+    path = os.path.join(directory, f"flight_rank{rank}_pid{100+rank}_"
+                                   f"000.json")
+    with open(path, "w") as f:
+        json.dump(dump, f)
+    return path
+
+
+class TestPostmortem:
+    def test_join_names_crashing_rank_and_divergence(self, tmp_path):
+        pm = _load_postmortem()
+        d = str(tmp_path)
+        _write_dump(d, 0, "elastic.reset", steps=7)
+        _write_dump(d, 1, "elastic.reset", steps=7)
+        _write_dump(d, 2, "chaos.crash", steps=4, extra_events=[
+            {"name": "FAULT:chaos.crash", "ph": "i", "tid": "faults",
+             "wall": 1100.0, "seq": 99}],
+            straggler=[{"kind": "phase", "rank": 2, "phase": "wire.dcn",
+                        "ms": 90.0, "median_ms": 10.0, "ts": 999.0}])
+        report = pm.build_report(d)
+        assert report["dumps"] == 3 and not report["corrupt"]
+        assert report["crashed_ranks"] == ["rank2"]
+        assert report["last_common_step"] == 4
+        assert report["max_step"] == 7
+        assert report["divergence_step"] == 5
+        assert report["diverged_ranks"] == ["rank2"]
+        assert report["ranks"]["rank2"]["faults"] == {"chaos.crash": 1}
+        assert report["straggler_history"][0]["phase"] == "wire.dcn"
+        # the human report renders without crashing
+        pm.print_report(report)
+
+    def test_corrupt_dump_rejected_not_trusted(self, tmp_path):
+        pm = _load_postmortem()
+        d = str(tmp_path)
+        _write_dump(d, 0, "exception", steps=3)
+        bad = _write_dump(d, 1, "exception", steps=9, corrupt=True)
+        report = pm.build_report(d)
+        assert report["dumps"] == 1
+        assert [c["path"] for c in report["corrupt"]] == [bad]
+        # the torn rank-1 file must not have moved last_common_step
+        assert report["last_common_step"] == 3
+
+    def test_empty_dir_exits_2(self, tmp_path, capsys):
+        pm = _load_postmortem()
+        import sys as _sys
+
+        argv = _sys.argv
+        _sys.argv = ["postmortem.py", "--dir", str(tmp_path)]
+        try:
+            assert pm.main() == 2
+        finally:
+            _sys.argv = argv
+
+
+# ---------------------------------------------------------------------------
+# Chaos cross-wiring: injected crash → parseable dumps on every rank →
+# postmortem names the crashing rank (the elastic-driver harness of
+# tests/test_elastic_integration.py, with forensics armed).
+
+
+class TestCrashForensicsIntegration:
+    @pytest.mark.chaos
+    def test_chaos_crash_leaves_dumps_on_every_rank(self, tmp_path):
+        import shlex
+        import subprocess  # noqa: F401  (documents the child mechanism)
+        import sys as _sys
+
+        from horovod_tpu import chaos as chaos_mod
+        from horovod_tpu.common import counters as counters_mod
+        from horovod_tpu.elastic import constants
+        from horovod_tpu.elastic.discovery import HostDiscoveryScript
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.runner import safe_shell_exec
+
+        chaos_mod.reset()
+        counters_mod.reset_all()
+        constants.DISCOVER_HOSTS_FREQUENCY_SECS = 0.25
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        worker = os.path.join(repo, "tests", "elastic_worker.py")
+        flight_dir = str(tmp_path / "flight")
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho hostA:2\necho hostB:1\n")
+        script.chmod(0o755)
+        log_file = str(tmp_path / "log.jsonl")
+        plan = chaos_mod.FaultPlan(seed=23).add(
+            "collective.eager", "crash", where="hostB:0", after=3,
+            max_count=1)
+
+        driver = ElasticDriver(HostDiscoveryScript(str(script), 1),
+                               min_np=2, max_np=3,
+                               controller_addr_override="127.0.0.1")
+
+        def _exec(slot, world_id):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "PYTHONPATH": repo,
+                "HOROVOD_HOSTNAME": slot.hostname,
+                "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+                "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+                "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+                "HOROVOD_START_TIMEOUT": "30",
+                "HOROVOD_FLIGHT_RECORDER_DIR": flight_dir,
+            })
+            env.update(plan.to_env())
+            cmd = " ".join(shlex.quote(c) for c in [
+                _sys.executable, worker, "--log-file", log_file,
+                "--batches", "8", "--batch-sleep", "0.1"])
+            return safe_shell_exec.execute(cmd, env=env)
+
+        try:
+            driver.start(_exec)
+            ok = driver.join(timeout=240)
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+            chaos_mod.reset()
+        assert ok
+
+        pm = _load_postmortem()
+        report = pm.build_report(flight_dir)
+        assert not report["corrupt"], report["corrupt"]
+        # every rank of the crashed incarnation left a parseable dump:
+        # the dead rank's chaos.crash black box + both survivors' reset
+        # dumps
+        assert len(report["ranks"]) == 3, report["ranks"]
+        assert len(report["crashed_ranks"]) == 1, report["ranks"]
+        dead = report["ranks"][report["crashed_ranks"][0]]
+        assert dead["reason"] == "chaos.crash"
+        assert dead["identity"]["hostname"] == "hostB"
+        survivors = [r for k, r in report["ranks"].items()
+                     if k not in report["crashed_ranks"]]
+        assert len(survivors) == 2
+        assert all(r["reason"] == "elastic.reset" for r in survivors)
+        assert all(r["identity"]["hostname"] == "hostA"
+                   for r in survivors)
+        # the postmortem places the divergence: commits stop for the
+        # dead rank at its crash batch while survivors got further
+        assert report["last_common_step"] is not None
+        assert dead["last_step"] <= 4
+        assert report["divergence_step"] is not None
+        assert report["crashed_ranks"][0] in report["diverged_ranks"]
+        # the dead rank's trail ends in real events, not silence
+        assert dead["events"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Armed-forensics overhead budget (<1% of a representative step)
+
+
+class TestForensicsOverhead:
+    def test_armed_forensics_under_one_percent_of_step(self):
+        """Flight recording + straggler phase accounting armed must cost
+        <1% of the same representative 8-device-mesh step the registry
+        budget is measured against (the acceptance gate; the heavier
+        cross-rank detect() runs on the reporter interval, not per
+        step)."""
+        mesh = hvd.mesh()
+        tx = hvd.DistributedOptimizer(__import__("optax").sgd(0.01))
+        params = {f"w{i}": jnp.full((512, 512), 0.01) for i in range(4)}
+        state = tx.init(params)
+
+        def loss_fn(p, x):
+            h = x
+            for i in range(4):
+                h = jnp.tanh(h @ p[f"w{i}"])
+            return jnp.mean(h ** 2)
+
+        def spmd(p, s, x):
+            loss, grads = jax.value_and_grad(loss_fn)(p, x)
+            updates, ns = tx.update(grads, s, p)
+            import optax
+            return optax.apply_updates(p, updates), ns, hvd.allreduce(loss)
+
+        step = jax.jit(hvd.shard_map(
+            spmd, mesh=mesh,
+            in_specs=(P(), P(), P(hvd.HVD_AXES)),
+            out_specs=(P(), P(), P())))
+        x = jnp.ones((64, 512))
+        params, state, loss = step(params, state, x)
+        jax.block_until_ready(loss)
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            params, state, loss = step(params, state, x)
+            jax.block_until_ready(loss)
+            times.append(time.perf_counter() - t0)
+        step_secs = float(np.median(times))
+
+        fr = FlightRecorder(capacity=4096, snapshot_every=1024)
+        det = StragglerDetector(MetricsRegistry(enabled=True),
+                                world=8, rank=0)
+        n = 300
+        t0 = time.perf_counter()
+        for i in range(n):
+            # a generous per-step forensic load: 4 ring events, the
+            # full phase vector, and the end-of-step publication
+            for j in range(4):
+                fr.record("FLIGHT:COLLECTIVE", tid="flight",
+                          args={"name": f"op.{i}.{j}", "ms": 1.0})
+            for ph in ("compute", "wire.ici", "wire.dcn", "wire.pod",
+                       "pp_bubble", "ckpt"):
+                det.record_phase(ph, 1.0)
+            det.end_step(i)
+        per_step = (time.perf_counter() - t0) / n
+        assert per_step < 0.01 * step_secs, (
+            f"armed forensics {per_step * 1e6:.1f}us vs step "
+            f"{step_secs * 1e6:.1f}us "
+            f"({100 * per_step / step_secs:.2f}% >= 1%)")

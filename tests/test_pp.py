@@ -470,7 +470,11 @@ class TestAccounting:
             events = json.load(open(path))
             from horovod_tpu.monitor.span_audit import audit_spans
 
-            audit = audit_spans(events, prefix="PP:", require_spans=True)
+            # strict=: every event in the trace must come from the
+            # CHECKED vocabulary table (span_audit.KNOWN_PREFIXES) — a
+            # typo'd span family fails here, not in a skewed report.
+            audit = audit_spans(events, prefix="PP:", require_spans=True,
+                                strict=True)
             assert audit.balanced
             sched = build_interleaved_schedule(4, 4, 2)
             busy = audit.count.get("PP:F", 0) + audit.count.get("PP:B", 0)
