@@ -112,6 +112,10 @@ def dump_plan(args, mesh_shape):
         pp_interleave=args.pp_interleave if args.pp else None,
         pp_schedule=args.pp_schedule if args.pp else None,
         pp_quantized=(args.quantized or None) if args.pp else None,
+        moe_experts=args.moe or None,
+        moe_topk=args.moe_topk if args.moe else None,
+        moe_capacity=args.moe_capacity if args.moe else None,
+        moe_quantized=(args.quantized or None) if args.moe else None,
     )
     model = hvd_plan.get_cost_model(mesh_shape=mesh_shape)
     if model.source != "static":
@@ -1660,6 +1664,368 @@ def run_pp(args, devices, platform, mesh_shape):
     return result
 
 
+def run_moe(args, devices, platform, mesh_shape):
+    """The ``--moe`` leg: expert-parallel MoE vs iso-FLOP dense A/B
+    (docs/moe.md).
+
+    * **dense leg** — an L-layer residual FFN stack with
+      ``d_ff = topk x expert_d_ff`` (the same per-token FLOPs a top-k
+      MoE spends) trained pure-data-parallel over ALL devices: the
+      throughput baseline.
+    * **moe leg** — the same token budget on a dedicated ``hvd_ep``
+      mesh of ``--moe`` expert groups (one expert per group,
+      ``hvd.init(ep_size=E)``): per-layer top-k routing with
+      capacity-factor dispatch, the dispatch/combine exchanges lowered
+      as wire-plan ``a2a`` legs (``--quantized`` = blockwise-int8 with
+      error feedback on the DCN-class hvd_ep hop). Expert grads reduce
+      only within their expert's data group (the dedicated-axis
+      contract); router grads take their explicit ep-mean.
+
+    Before timing, a forced-routing parity probe hard-checks the wire:
+    every token routed to expert 0 with identity gating must reproduce
+    the dense expert-0 FFN (int8 wire within its documented error
+    bound). The JSON line carries tokens/sec for both legs, per-hop +
+    a2a wire bytes, the per-expert load histogram, the dropped-token
+    fraction, and the a2a predicted-vs-modeled wire-ms drift pair the
+    perf gate checks (scripts/perf_gate.sh moe)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import plan as hvd_plan
+    from horovod_tpu.moe import (default_a2a_plan, ep_mean_dense_grads,
+                                 ep_param_pspecs, ep_stack_params,
+                                 moe_capacity, moe_ef_residuals, moe_ffn)
+    from horovod_tpu.ops.collective_ops import record_wire_stats
+    from horovod_tpu.plan.accounting import bench_gbps
+
+    E = args.moe
+    K = args.moe_topk
+    cf = args.moe_capacity
+    L = args.moe_layers
+    quantized = bool(args.quantized)
+    ndev = len(devices)
+    if ndev % E:
+        raise SystemExit(f"--moe {E} does not divide {ndev} devices")
+    if mesh_shape is not None:
+        if len(mesh_shape) != 2:
+            raise SystemExit("--moe takes a 2-D --mesh-shape (the DATA "
+                             "mesh; the hvd_ep axis is the leading dim)")
+        dmesh = tuple(mesh_shape)
+    else:
+        dp0 = ndev // E
+        # Cross-major default: the hvd_ep hop should cross a DCN-class
+        # link (that is what --quantized compresses), so the data mesh
+        # keeps a cross dim whenever it can.
+        dmesh = ((2, dp0 // 2) if dp0 % 2 == 0 and dp0 >= 4
+                 else (dp0, 1))
+    dp = dmesh[0] * dmesh[1]
+    if E * dp != ndev:
+        raise SystemExit(f"--moe {E} x mesh {dmesh} != {ndev} devices")
+    C, F = 32, 64
+    Nd = 64                       # tokens per device
+    Ng = Nd * ndev                # global tokens per step
+    lr = 0.05
+    blk = 64
+    iters = max(2, args.num_iters)
+    spc = max(1, args.num_batches_per_iter)
+    rs = np.random.RandomState(0)
+    log(f"moe A/B: experts={E} topk={K} capacity_factor={cf} layers={L} "
+        f"data_mesh={dmesh} quantized={quantized} global_tokens={Ng}")
+
+    def init_layer(seed):
+        r = np.random.RandomState(seed)
+        return {
+            "router": jnp.asarray(r.randn(C, E) * 0.1, jnp.float32),
+            "w1": jnp.asarray(r.randn(E, C, F) * 0.1, jnp.float32),
+            "b1": jnp.zeros((E, F), jnp.float32),
+            "w2": jnp.asarray(r.randn(E, F, C) * 0.1, jnp.float32),
+            "b2": jnp.zeros((E, C), jnp.float32),
+        }
+
+    layers = [init_layer(7 + i) for i in range(L)]
+    x_global = jnp.asarray(rs.randn(Ng, C), jnp.float32)
+    y_global = jnp.asarray(rs.randn(Ng, C), jnp.float32)
+
+    # ---- dense iso-FLOP leg: pure DP over all devices ----------------
+    hvd.shutdown()
+    dense_mesh = ((2, ndev // 2) if ndev % 2 == 0 and ndev >= 2
+                  else (1, ndev))
+    hvd.init(devices=devices, mesh_shape=dense_mesh)
+    mesh = hvd.mesh()
+    Fd = K * F                    # iso-FLOP dense width
+    dl = [{"w1": jnp.asarray(np.random.RandomState(70 + i)
+                             .randn(C, Fd) * 0.1, jnp.float32),
+           "b1": jnp.zeros((Fd,), jnp.float32),
+           "w2": jnp.asarray(np.random.RandomState(80 + i)
+                             .randn(Fd, C) * 0.1, jnp.float32),
+           "b2": jnp.zeros((C,), jnp.float32)} for i in range(L)]
+
+    def dense_stack(p, h):
+        import flax.linen as fnn
+
+        for lyr in p:
+            h = h + (fnn.gelu(h @ lyr["w1"] + lyr["b1"]) @ lyr["w2"]
+                     + lyr["b2"])
+        return h
+
+    def dense_spmd(p, xb, yb):
+        def loss_fn(pp):
+            return jnp.mean((dense_stack(pp, xb) - yb) ** 2)
+
+        loss, g = hvd.value_and_grad(loss_fn)(p)
+        loss = hvd.allreduce(loss, op=hvd.Average)
+        return loss, jax.tree.map(lambda a, b: a - lr * b, p, g)
+
+    dense_step = jax.jit(hvd.shard_map(
+        dense_spmd, mesh=mesh,
+        in_specs=(P(), hvd.data_pspec(), hvd.data_pspec()),
+        out_specs=(P(), P())))
+    dstate = dl
+    loss_d, dstate = jax.block_until_ready(
+        dense_step(dstate, x_global, y_global))
+    t0 = time.perf_counter()
+    for _ in range(iters * spc):
+        loss_d, dstate = dense_step(dstate, x_global, y_global)
+    jax.block_until_ready(loss_d)
+    dense_sps = iters * spc / (time.perf_counter() - t0)
+    dense_tps = dense_sps * Ng
+    log(f"dense leg (d_ff={Fd}): {dense_tps:.0f} tok/s "
+        f"({dense_sps:.2f} steps/s), final loss {float(loss_d):.4f}")
+
+    # ---- moe leg on the hvd_ep mesh ----------------------------------
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=dmesh, ep_size=E)
+    mesh = hvd.mesh()
+    assert hvd.ep_size() == E
+    stacked = [ep_stack_params(lyr, E) for lyr in layers]
+    pspec = [ep_param_pspecs(s) for s in stacked]
+    EPALL = (hvd.EP_AXIS,) + hvd.HVD_AXES
+    data_spec = P(EPALL)
+    splan = default_a2a_plan(hvd.EP_AXIS, quantized=quantized, block=blk,
+                             error_feedback=quantized)
+    log(f"a2a plan: {splan.encode()}")
+    cap = moe_capacity(Nd, E, cf, K)
+    if quantized:
+        res0 = [moe_ef_residuals(Nd, C, E, cf, K) for _ in range(L)]
+        res0 = jax.tree.map(lambda a: jnp.broadcast_to(
+            a[None], (ndev,) + a.shape), res0)
+        res_spec = jax.tree.map(lambda _: P(EPALL), res0)
+    else:
+        res0, res_spec = None, None
+
+    def local_params(pt):
+        return [{k: (v[0] if k in ("w1", "b1", "w2", "b2") else v)
+                 for k, v in lyr.items()} for lyr in pt]
+
+    def moe_forward(lp, xb, res, router_logits=None,
+                    capacity_factor=cf):
+        h = xb
+        new_res = []
+        total_load = jnp.zeros((E,), jnp.float32)
+        total_drop = 0.0
+        for i, lyr in enumerate(lp):
+            r = None if res is None else tuple(
+                jnp.squeeze(b, 0) for b in res[i])
+            y, aux, nr = moe_ffn(
+                h, lyr, topk=K, capacity_factor=capacity_factor,
+                ep_axis=hvd.EP_AXIS, a2a_plan=splan, residuals=r,
+                router_logits=router_logits)
+            h = h + y
+            total_load = total_load + aux.load
+            total_drop = total_drop + aux.dropped_fraction / L
+            new_res.append(None if nr is None else tuple(
+                b[None] for b in nr))
+            aux_last = aux
+        return h, (new_res if res is not None else None,
+                   total_load, total_drop, aux_last)
+
+    def moe_spmd(pt, xb, yb, res):
+        lp = local_params(pt)
+
+        def loss_fn(lpp):
+            h, (new_res, load, drop, aux) = moe_forward(lpp, xb, res)
+            mse = jnp.mean((h - yb) ** 2)
+            loss = (mse + 0.01 * aux.load_balance_loss
+                    + 0.001 * aux.z_loss)
+            return loss, (new_res, load, drop)
+
+        (loss, (new_res, load, drop)), g = jax.value_and_grad(
+            loss_fn, has_aux=True)(lp)
+        # Router grads take their explicit ep-mean, expert grads their
+        # 1/ep share; BOTH then reduce over the DATA axes only — but in
+        # separate bucket sets: expert grads are ep-VARYING (per group)
+        # while the router's are ep-invariant, and a shared fused
+        # bucket would destroy the router's provable ep replication.
+        g = [ep_mean_dense_grads(gl) for gl in g]
+        g_exp = [{k: v for k, v in gl.items() if k != "router"}
+                 for gl in g]
+        g_rt = [gl["router"] for gl in g]
+        g_exp = hvd.allreduce_pytree(g_exp, op=hvd.Average,
+                                     quantized=quantized or None)
+        g_rt = hvd.allreduce_pytree(g_rt, op=hvd.Average,
+                                    quantized=quantized or None)
+        g = [dict(ge, router=gr) for ge, gr in zip(g_exp, g_rt)]
+        new_lp = jax.tree.map(lambda a, b: a - lr * b, lp, g)
+        new_pt = [{k: (v[None] if k in ("w1", "b1", "w2", "b2")
+                       else v) for k, v in lyr.items()}
+                  for lyr in new_lp]
+        loss = lax.pmean(loss, EPALL)
+        load = lax.psum(load, EPALL)
+        drop = lax.pmean(drop, EPALL)
+        outs = (loss[None], new_pt, load[None], drop[None])
+        if res is not None:
+            return outs + (new_res,)
+        return outs
+
+    stat_spec = P(EPALL)
+    in_specs = (pspec, data_spec, data_spec)
+    out_specs = (stat_spec, pspec, stat_spec, stat_spec)
+    if quantized:
+        in_specs = in_specs + (res_spec,)
+        out_specs = out_specs + (res_spec,)
+        moe_step = jax.jit(hvd.shard_map(
+            moe_spmd, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs))
+    else:
+        moe_step = jax.jit(hvd.shard_map(
+            lambda pt, xb, yb: moe_spmd(pt, xb, yb, None), mesh=mesh,
+            in_specs=in_specs, out_specs=out_specs))
+
+    # -- forced-routing parity probe (hard gate) -----------------------
+    def parity_spmd(pt, xb):
+        lp = local_params(pt)
+        n_shard = xb.shape[0]
+        forced = jnp.concatenate(
+            [jnp.full((n_shard, 1), 1000.0, jnp.float32),
+             jnp.zeros((n_shard, E - 1), jnp.float32)], axis=1)
+        h, _ = moe_forward(lp, xb, None, router_logits=forced,
+                           capacity_factor=float(E))
+        return h
+
+    parity_fn = jax.jit(hvd.shard_map(
+        parity_spmd, mesh=mesh, in_specs=(pspec, data_spec),
+        out_specs=data_spec))
+    h_moe = np.asarray(parity_fn(stacked, x_global))
+    h_ref = np.asarray(x_global)
+    for lyr in layers:
+        import flax.linen as fnn
+
+        act = np.asarray(fnn.gelu(
+            h_ref @ np.asarray(lyr["w1"][0]) + np.asarray(lyr["b1"][0])))
+        h_ref = h_ref + act @ np.asarray(lyr["w2"][0]) \
+            + np.asarray(lyr["b2"][0])
+    denom = max(1e-9, float(np.abs(h_ref).max()))
+    parity_err = float(np.abs(h_moe - h_ref).max()) / denom
+    tol = 5e-2 if quantized else 1e-5
+    log(f"parity probe (forced expert-0 routing): max rel err "
+        f"{parity_err:.2e} (tol {tol})")
+    if parity_err > tol:
+        raise SystemExit(
+            f"moe parity FAILED: forced-routing MoE vs dense expert-0 "
+            f"rel err {parity_err:.2e} > {tol}")
+
+    # -- timed run -----------------------------------------------------
+    carry = [stacked, res0]
+
+    def drive(xb, yb):
+        if quantized:
+            loss, pt, load, drop, res = moe_step(
+                carry[0], xb, yb, carry[1])
+            carry[0], carry[1] = pt, res
+        else:
+            loss, pt, load, drop = moe_step(carry[0], xb, yb)
+            carry[0] = pt
+        return loss, load, drop
+
+    with record_wire_stats() as wire:
+        loss0, load, drop = jax.block_until_ready(
+            drive(x_global, y_global))
+    expert_tokens = np.zeros((E,), np.float64)
+    t0 = time.perf_counter()
+    for _ in range(iters * spc):
+        loss_m, load, drop = drive(x_global, y_global)
+        expert_tokens += np.asarray(load).reshape(-1, E).sum(0) / ndev
+    jax.block_until_ready(loss_m)
+    moe_sps = iters * spc / (time.perf_counter() - t0)
+    moe_tps = moe_sps * Ng
+    dropped_frac = float(np.asarray(drop).reshape(-1)[0])
+    from horovod_tpu.monitor import registry as _metrics
+
+    for e in range(E):
+        _metrics.counter("moe.expert_tokens", expert=str(e)).inc(
+            float(expert_tokens[e]))
+    log(f"moe leg: {moe_tps:.0f} tok/s ({moe_sps:.2f} steps/s), final "
+        f"loss {float(np.asarray(loss_m).reshape(-1)[0]):.4f}, dropped "
+        f"{dropped_frac:.4f}, expert load {expert_tokens.round(1)}")
+
+    # -- a2a drift pair + straggler attribution ------------------------
+    buf_bytes = E * cap * C * 4.0
+    priced = hvd_plan.price_a2a(
+        splan, buf_bytes, ep=E, issues=max(1, wire.a2a_calls),
+        mesh_shape=dmesh,
+        model=hvd_plan.get_cost_model(mesh_shape=dmesh))
+    ici_g, dcn_g, pod_g = bench_gbps()
+    hop = splan.legs[0].level
+    hop_gbps = {"ici": ici_g, "dcn": dcn_g, "pod": pod_g}[hop]
+    a2a_ms_modeled = wire.a2a_bytes / (hop_gbps * 1e9) * 1e3
+    drift = (abs(priced["modeled_ms"] - a2a_ms_modeled)
+             / max(1e-9, a2a_ms_modeled))
+    log(f"a2a wire: accounted {wire.a2a_bytes:.0f} B "
+        f"({a2a_ms_modeled:.4f} ms modeled, {wire.a2a_calls} exchanges) "
+        f"vs predicted {priced['wire_bytes']:.0f} B "
+        f"({priced['modeled_ms']:.4f} ms); drift {drift:.4f}")
+
+    from horovod_tpu import monitor as _monitor
+
+    moe_step_ms = 1e3 / max(1e-9, moe_sps)
+    det = _monitor.straggler_detector()
+    det.record_phase("wire.a2a", min(moe_step_ms, a2a_ms_modeled))
+    det.record_phase("compute",
+                     max(0.0, moe_step_ms - a2a_ms_modeled))
+    det.end_step()
+
+    result = {
+        "metric": f"moe{E}_tokens_per_sec",
+        "value": round(moe_tps, 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "chips": ndev,
+        "moe": {
+            "experts": E, "topk": K, "capacity_factor": cf,
+            "capacity": cap, "layers": L,
+            "data_mesh": mesh_shape_str(dmesh),
+            "quantized": quantized, "a2a_plan": splan.encode(),
+        },
+        "parity_rel_err": parity_err,
+        "parity_tol": tol,
+        "dropped_token_fraction": round(dropped_frac, 6),
+        "expert_load": {str(e): round(float(expert_tokens[e]), 1)
+                        for e in range(E)},
+        "dense_tokens_per_sec": round(dense_tps, 1),
+        "throughput_delta": round(moe_tps / max(1e-9, dense_tps), 4),
+        "wire_bytes_ici": wire.ici_bytes,
+        "wire_bytes_dcn": wire.dcn_bytes,
+        "wire_bytes_pod": wire.pod_bytes,
+        "a2a_bytes": wire.a2a_bytes,
+        "a2a_bytes_fp": wire.a2a_bytes_fp,
+        "a2a_calls": wire.a2a_calls,
+        "wire_ms": {
+            "predicted": round(priced["modeled_ms"], 4),
+            "predicted_total": round(priced["predicted_ms"], 4),
+            "modeled": round(a2a_ms_modeled, 4),
+            "model": priced["model"],
+        },
+        "metrics_snapshot": metrics_snapshot(
+            prefixes=("comm.", "step.", "moe.", "straggler.", "link.")),
+    }
+    print(json.dumps(result))
+    return result
+
+
 def run_serve(args, devices, platform, mesh_shape):
     """The ``--serve`` leg: a continuous-batching generation trace.
 
@@ -2037,6 +2403,19 @@ def main():
                     choices=["gpipe", "1f1b", "interleaved_1f1b"],
                     help="pipeline schedule family member "
                          "(docs/pipeline.md)")
+    ap.add_argument("--moe", type=int, default=0, metavar="EXPERTS",
+                    help="MoE A/B leg (docs/moe.md): expert-parallel "
+                         "top-k MoE over a dedicated hvd_ep mesh axis "
+                         "of EXPERTS groups vs an iso-FLOP dense FFN "
+                         "stack on the same devices; --quantized rides "
+                         "the dispatch/combine a2a wire blockwise-int8 "
+                         "with error feedback")
+    ap.add_argument("--moe-topk", type=int, default=2,
+                    help="experts per token (top-k gating; default 2)")
+    ap.add_argument("--moe-capacity", type=float, default=1.25,
+                    help="dispatch capacity factor (default 1.25)")
+    ap.add_argument("--moe-layers", type=int, default=2,
+                    help="MoE FFN layers in the bench stack (default 2)")
     ap.add_argument("--overlap", action="store_true",
                     help="A/B the overlapped gradient reduction "
                          "(HOROVOD_OVERLAP: reverse-layer bucket "
@@ -2213,13 +2592,28 @@ def main():
         if args.pp < 2:
             ap.error("--pp needs >= 2 stages")
         if args.serve or args.scaling or args.autotune or args.fused \
-                or args.zero:
+                or args.zero or args.moe:
             ap.error("--pp composes with --zero-stage/--quantized/"
                      "--overlap only (one A/B structure per run)")
         if args.pp_microbatches < 1:
             ap.error("--pp-microbatches must be >= 1")
         if args.pp_interleave < 1:
             ap.error("--pp-interleave must be >= 1")
+
+    if args.moe:
+        if args.moe < 2:
+            ap.error("--moe needs >= 2 experts")
+        if args.serve or args.scaling or args.autotune or args.fused \
+                or args.zero or args.zero_stage or args.overlap:
+            ap.error("--moe composes with --quantized only (one A/B "
+                     "structure per run; the EPxZeRO compose matrix is "
+                     "covered by tests/test_moe.py)")
+        if args.moe_topk < 1 or args.moe_topk > args.moe:
+            ap.error(f"--moe-topk must be in 1..{args.moe}")
+        if args.moe_capacity <= 0:
+            ap.error("--moe-capacity must be > 0")
+        if args.moe_layers < 1:
+            ap.error("--moe-layers must be >= 1")
 
     mesh_shape = None
     if args.mesh_shape:
@@ -2294,6 +2688,12 @@ def main():
         run_pp(args, devices, platform,
                parse_mesh_shape(args.mesh_shape) if args.mesh_shape
                else None)
+        return
+
+    if args.moe:
+        run_moe(args, devices, platform,
+                parse_mesh_shape(args.mesh_shape) if args.mesh_shape
+                else None)
         return
 
     if args.serve:

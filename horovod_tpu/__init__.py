@@ -51,6 +51,7 @@ API surface parity map (reference file → here):
 
 from .common.basics import (  # noqa: F401
     CROSS_AXIS,
+    EP_AXIS,
     HVD_AXES,
     LOCAL_AXIS,
     POD_AXIS,
@@ -59,6 +60,7 @@ from .common.basics import (  # noqa: F401
     cross_size,
     data_mesh_shape,
     data_sharding,
+    ep_size,
     in_hvd_context,
     init,
     is_homogeneous,
@@ -159,6 +161,11 @@ from .parallel.expert import (  # noqa: F401
     ep_split_params,
     switch_moe,
     switch_moe_ragged,
+)
+from . import moe  # noqa: F401  (expert-parallel MoE, docs/moe.md)
+from .moe import (  # noqa: F401
+    MoELayer,
+    moe_ffn,
 )
 from .parallel.pipeline import (  # noqa: F401
     PPSchedule,

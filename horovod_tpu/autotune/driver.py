@@ -57,7 +57,14 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     one stage count never warm-starts another. from_dict/load stay
 #     tolerant of v7/v6 entries (pp fields default to the dead-knob
 #     0 / 1 values — the exact pre-v8 step).
-_CACHE_VERSION = 8
+# v9: expert-parallel MoE (docs/moe.md) — TunedParams gains the
+#     moe_capacity_factor/moe_quantized pair (tune_moe-gated; the plan
+#     encoding's trailing `|moeC/q8|fp` segment), and expert-parallel
+#     meshes carry an `epE` marker in the geometry fingerprint so a
+#     winner tuned at one expert-group count never warm-starts another.
+#     from_dict/load stay tolerant of v8/v7 entries (moe fields default
+#     to the dead-knob 0.0 / False values — the exact pre-v9 step).
+_CACHE_VERSION = 9
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -139,6 +146,7 @@ def load_cached_params(key: str) -> Optional[TunedParams]:
 def _store_cached_params(key: str, params: TunedParams, *,
                          score: float, samples: int,
                          quantized: bool = False, pp: bool = False,
+                         moe: bool = False,
                          predicted_ms: Optional[float] = None) -> None:
     from ..plan import planner as _wire_planner
     from ..ops import kernel_autotune
@@ -146,7 +154,7 @@ def _store_cached_params(key: str, params: TunedParams, *,
     entry = {
         "params": params.as_dict(),
         "plan": _wire_planner.encode_tuned(params, quantized=quantized,
-                                           pp=pp),
+                                           pp=pp, moe=moe),
         "score_steps_per_sec": score,
         "samples": samples,
         "geometry": basics.mesh_geometry(),
@@ -163,7 +171,8 @@ def _priced_seeds(payload_bytes: float, k: int, *, initial: TunedParams,
                   quantized: bool, tune_hierarchical: bool,
                   tune_zero: bool, tune_overlap: bool,
                   tune_fused: bool, tune_pp: bool = False,
-                  pp_stages: int = 0, pp_max_interleave: int = 1):
+                  pp_stages: int = 0, pp_max_interleave: int = 1,
+                  tune_moe: bool = False, moe_experts: int = 0):
     """Top-``k`` cost-model-priced candidates for this session's search
     space (docs/cost-model.md): the planner enumerates every legal plan
     the session's gates allow, prices them with the calibrated (or
@@ -178,6 +187,7 @@ def _priced_seeds(payload_bytes: float, k: int, *, initial: TunedParams,
         tune_overlap=tune_overlap, tune_fused=tune_fused,
         tune_pp=tune_pp, pp_stages=pp_stages,
         pp_max_interleave=pp_max_interleave,
+        tune_moe=tune_moe, moe_experts=moe_experts,
         initial=initial, model=model)
 
 
@@ -201,6 +211,8 @@ def autotune_session(
     tune_pp: bool = False,
     pp_stages: int = 0,
     pp_max_interleave: int = 1,
+    tune_moe: bool = False,
+    moe_experts: int = 0,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -248,6 +260,12 @@ def autotune_session(
     ``pp_interleave`` (pow2) — gated exactly like zero/overlap: both
     restructure the traced schedule, so only a step builder that
     rebuilds at the proposed values may search them (docs/pipeline.md).
+    ``tune_moe`` (with ``moe_experts`` = the mesh's expert-group count)
+    adds the MoE routing pair — ``moe_capacity_factor``
+    (quarter-snapped 1.0–2.0) and ``moe_quantized`` (the int8 a2a
+    wire) — under the same gate: capacity is trace-time dispatch-buffer
+    shape, so only a step builder that rebuilds at the proposed values
+    may search it (docs/moe.md).
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -333,7 +351,8 @@ def autotune_session(
                 tune_zero=tune_zero, tune_overlap=tune_overlap,
                 tune_fused=tune_fused, tune_pp=tune_pp,
                 pp_stages=pp_stages,
-                pp_max_interleave=pp_max_interleave)
+                pp_max_interleave=pp_max_interleave,
+                tune_moe=tune_moe, moe_experts=moe_experts)
             seeds = [pp.params for pp in ranked]
             shortlist_rows = tuple(pp.as_dict() for pp in ranked)
             if ranked:
@@ -358,6 +377,8 @@ def autotune_session(
         tune_pp=tune_pp,
         pp_stages=pp_stages,
         pp_max_interleave=pp_max_interleave,
+        tune_moe=tune_moe,
+        moe_experts=moe_experts,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,
@@ -440,7 +461,10 @@ def autotune_session(
                 sp = _wire_planner.describe_plan(
                     tuned_params=best, quantized=bool(tune_quant_block),
                     quantized_pod=False,
-                    pp_stages=pp_stages if tune_pp else None)
+                    pp_stages=pp_stages if tune_pp else None,
+                    moe_experts=moe_experts if tune_moe else 0,
+                    moe_quantized=(best.moe_quantized if tune_moe
+                                   else None))
                 predicted_ms = _cost.price_step(
                     sp, payload_bytes,
                     model=_calibrate.get_cost_model()).predicted_ms
@@ -449,7 +473,7 @@ def autotune_session(
         _store_cached_params(key, best, score=pm.best_score,
                              samples=pm.samples_done,
                              quantized=bool(tune_quant_block),
-                             pp=tune_pp,
+                             pp=tune_pp, moe=tune_moe,
                              predicted_ms=predicted_ms)
     return AutotuneResult(params=best, history=tuple(pm.history),
                           best_score=pm.best_score,

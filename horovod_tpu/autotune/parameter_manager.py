@@ -65,11 +65,20 @@ _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
 # (pow2 virtual-stage degree) — both gated by tune_pp and dead (0 / 1)
 # when the session's step is not pipelined, where canonicalization
 # collapses them to one trial.
-_DIMS = 9  # fusion, qblock, tree, zero, overlap, streams, fused, ppM, ppV
+# v9 adds the MoE routing pair (docs/moe.md): moe_capacity_factor
+# (quarter-snapped 1.0-2.0 dispatch headroom) and moe_quantized (the
+# int8 a2a wire) — both gated by tune_moe and dead (0.0 / False) when
+# the session's step carries no MoE layer, where canonicalization
+# collapses them to one trial.
+_DIMS = 11  # fusion, qblock, tree, zero, overlap, streams, fused,
+#             ppM, ppV, moeCap, moeQ
 
 _MIN_PPM_LOG = 1.0   # 2^1 = 2 microbatches
 _MAX_PPM_LOG = 5.0   # 2^5 = 32 microbatches
 _MAX_PPV_LOG = 2.0   # 2^2 = 4 virtual stages per rank
+
+_MIN_MOE_CAP = 1.0   # dispatch capacity factor search box
+_MAX_MOE_CAP = 2.0   # (quarter-snapped: 1.0, 1.25, ..., 2.0)
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
@@ -79,10 +88,13 @@ _MAX_PPV_LOG = 2.0   # 2^2 = 4 virtual stages per rank
 # tolerant of v3/v4/v5 logs lacking the newer columns.
 # v8 appends the pipeline pair; read_log stays tolerant of v3..v7 logs
 # lacking the newer columns.
+# v9 appends the MoE pair; read_log stays tolerant of v3..v8 logs
+# lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
               "overlap", "num_comm_streams", "fused",
               "pp_microbatches", "pp_interleave",
+              "moe_capacity_factor", "moe_quantized",
               "score_steps_per_sec", "plan")
 
 
@@ -104,6 +116,10 @@ class TunedParams:
     # pipelined step" — the canonical dead-knob values.
     pp_microbatches: int = 0
     pp_interleave: int = 1
+    # MoE routing pair (docs/moe.md): 0.0 / False = "not an MoE step" —
+    # the canonical dead-knob values.
+    moe_capacity_factor: float = 0.0
+    moe_quantized: bool = False
 
     @property
     def zero_sharding(self) -> bool:
@@ -123,6 +139,8 @@ class TunedParams:
             "fused": bool(self.fused),
             "pp_microbatches": int(self.pp_microbatches),
             "pp_interleave": int(self.pp_interleave),
+            "moe_capacity_factor": float(self.moe_capacity_factor),
+            "moe_quantized": bool(self.moe_quantized),
         }
 
     @classmethod
@@ -144,6 +162,9 @@ class TunedParams:
             fused=bool(d.get("fused", False)),
             pp_microbatches=int(d.get("pp_microbatches", 0) or 0),
             pp_interleave=int(d.get("pp_interleave", 1) or 1),
+            moe_capacity_factor=float(
+                d.get("moe_capacity_factor", 0.0) or 0.0),
+            moe_quantized=bool(d.get("moe_quantized", False)),
         )
 
     @classmethod
@@ -164,6 +185,11 @@ class TunedParams:
             fused=getattr(config, "fused_kernels", False),
             pp_microbatches=getattr(config, "pp_microbatches", 0) or 0,
             pp_interleave=getattr(config, "pp_interleave", 1) or 1,
+            moe_capacity_factor=(
+                getattr(config, "moe_capacity_factor", 0.0)
+                if getattr(config, "moe_experts", 0) else 0.0),
+            moe_quantized=bool(getattr(config, "moe_quantized", False)
+                               and getattr(config, "moe_experts", 0)),
         )
 
 
@@ -214,6 +240,8 @@ class ParameterManager:
         tune_pp: bool = False,
         pp_stages: int = 0,
         pp_max_interleave: int = 1,
+        tune_moe: bool = False,
+        moe_experts: int = 0,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -254,6 +282,16 @@ class ParameterManager:
         self.tune_pp = tune_pp
         self.pp_stages = max(0, int(pp_stages))
         self.pp_max_interleave = max(1, int(pp_max_interleave))
+        # The MoE pair restructures the dispatch-buffer geometry
+        # (capacity is trace-time shape) and the a2a wire dtype, so like
+        # zero/overlap/pp it is searched only when the session's step
+        # builder declares it can rebuild at a proposed
+        # (moe_capacity_factor, moe_quantized)
+        # (autotune_session(tune_moe=True, moe_experts=E)). With moe
+        # off the encoding drops the segment and both knobs
+        # canonicalize dead.
+        self.tune_moe = tune_moe
+        self.moe_experts = max(0, int(moe_experts))
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -295,6 +333,9 @@ class ParameterManager:
         s = math.log2(max(1, p.num_comm_streams))
         ppm = math.log2(max(2, p.pp_microbatches or 2))
         ppv = math.log2(max(1, p.pp_interleave))
+        cap = min(_MAX_MOE_CAP,
+                  max(_MIN_MOE_CAP, p.moe_capacity_factor
+                      or _MIN_MOE_CAP))
         return (
             (f - _MIN_FUSION_LOG) / (_MAX_FUSION_LOG - _MIN_FUSION_LOG),
             (q - _MIN_QBLOCK_LOG) / (_MAX_QBLOCK_LOG - _MIN_QBLOCK_LOG),
@@ -309,6 +350,8 @@ class ParameterManager:
             0.75 if p.fused else 0.25,
             (ppm - _MIN_PPM_LOG) / (_MAX_PPM_LOG - _MIN_PPM_LOG),
             ppv / _MAX_PPV_LOG,
+            (cap - _MIN_MOE_CAP) / (_MAX_MOE_CAP - _MIN_MOE_CAP),
+            0.75 if p.moe_quantized else 0.25,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -352,6 +395,21 @@ class ParameterManager:
         else:
             ppm = self.initial.pp_microbatches
             ppv = self.initial.pp_interleave
+        if self.tune_moe:
+            # Quarter-snap inside the [1.0, 2.0] box: capacity is a
+            # trace-time buffer shape, so the space is effectively
+            # discrete (finer steps cannot change the padded capacity
+            # by more than rounding). Tolerant of pre-v9 unit tuples
+            # lacking the trailing dims.
+            u9 = u[9] if len(u) > 9 else 0.25
+            u10 = u[10] if len(u) > 10 else 0.25
+            cap = _MIN_MOE_CAP + u9 * (_MAX_MOE_CAP - _MIN_MOE_CAP)
+            cap = round(cap * 4) / 4.0
+            moe_cap = min(_MAX_MOE_CAP, max(_MIN_MOE_CAP, cap))
+            moe_q = u10 >= 0.5
+        else:
+            moe_cap = self.initial.moe_capacity_factor
+            moe_q = self.initial.moe_quantized
         return self._canonicalize(TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
@@ -362,6 +420,8 @@ class ParameterManager:
             fused=fz,
             pp_microbatches=ppm,
             pp_interleave=ppv,
+            moe_capacity_factor=moe_cap,
+            moe_quantized=moe_q,
         ))
 
     def _plan_of(self, p: TunedParams) -> str:
@@ -369,7 +429,8 @@ class ParameterManager:
         search-space coordinate the GP actually explores (``plan``
         column of the CSV, ``plan`` field of the v5 cache entry)."""
         return _wire_planner.encode_tuned(
-            p, quantized=self.tune_quant_block, pp=self.tune_pp)
+            p, quantized=self.tune_quant_block, pp=self.tune_pp,
+            moe=self.tune_moe)
 
     def _canonicalize(self, p: TunedParams) -> TunedParams:
         """Snap a proposal onto its wire plan: knobs that are dead in
@@ -386,7 +447,9 @@ class ParameterManager:
             fused=d.get("fused", False),
             quant_block=d.get("quant_block", p.quant_block),
             pp_microbatches=d.get("pp_microbatches", 0),
-            pp_interleave=d.get("pp_interleave", 1))
+            pp_interleave=d.get("pp_interleave", 1),
+            moe_capacity_factor=d.get("moe_capacity_factor", 0.0),
+            moe_quantized=d.get("moe_quantized", False))
 
     def _unit_key(self, p: TunedParams) -> tuple:
         """Dedup key: the snapped fusion threshold plus the canonical
@@ -441,6 +504,8 @@ class ParameterManager:
                             int(p.fused),
                             int(p.pp_microbatches),
                             int(p.pp_interleave),
+                            f"{p.moe_capacity_factor:g}",
+                            int(p.moe_quantized),
                             f"{score:.6g}",
                             self._plan_of(p)])
         self._log.flush()
@@ -473,6 +538,9 @@ class ParameterManager:
         if not self.tune_pp:
             u[7] = 0.0
             u[8] = 0.0
+        if not self.tune_moe:
+            u[9] = 0.25
+            u[10] = 0.25
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -562,6 +630,10 @@ def read_log(path: str) -> List[dict]:
                 "pp_microbatches": int(rec.get("pp_microbatches", 0)
                                        or 0),
                 "pp_interleave": int(rec.get("pp_interleave", 1) or 1),
+                "moe_capacity_factor": float(
+                    rec.get("moe_capacity_factor", 0.0) or 0.0),
+                "moe_quantized": bool(int(rec.get("moe_quantized", 0)
+                                          or 0)),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             }
             enc = (rec.get("plan") or "").strip()

@@ -70,6 +70,15 @@ ALL_AXES: Tuple[str, str, str] = (POD_AXIS, CROSS_AXIS, LOCAL_AXIS)
 # ``send``-leg ppermutes of parallel/pipeline.py).
 PP_AXIS = "hvd_pp"
 
+# Expert-parallel mesh axis (docs/moe.md). The same dedicated-axis
+# pattern as PP_AXIS: the ep axis carries expert *groups*, not data
+# replicas — expert parameters differ per ep rank, so a gradient
+# collective over the "world" must never sum across expert groups. Every
+# axes=None collective resolves to the data axes only; the ep axis is
+# reached explicitly by the MoE dispatch/combine ``a2a`` wire-plan legs
+# (horovod_tpu/moe/layer.py).
+EP_AXIS = "hvd_ep"
+
 # ``jax.shard_map`` graduated from jax.experimental in jax 0.6; on the
 # pinned 0.4.x line only the experimental spelling exists. This resolver is
 # the single home every horovod_tpu caller (and the test suite, via
@@ -105,6 +114,7 @@ def _build_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, ...]] = None,
     pp_stages: Optional[int] = None,
+    ep_size: Optional[int] = None,
 ) -> Mesh:
     """Arrange all job devices into the 2-D (cross, local) Horovod mesh.
 
@@ -125,6 +135,36 @@ def _build_mesh(
 
         devices = acquire_devices()
     devices = list(devices)
+    if ep_size is not None and ep_size > 1:
+        # Expert-parallel mesh (docs/moe.md): a leading hvd_ep axis of
+        # expert groups over the (cross, local) data mesh — the same
+        # leading-axis layout as the pipeline mesh, so consecutive ep
+        # groups sit a full data-mesh apart and the dispatch/combine
+        # all-to-all crosses the slowest link class present.
+        if pp_stages is not None and pp_stages > 1:
+            raise ValueError(
+                "ep_size does not compose with pp_stages yet — both take "
+                "the leading mesh dimension (EP x PP needs a 4-D mesh)")
+        if mesh_shape is not None and len(mesh_shape) == 3:
+            raise ValueError(
+                "ep_size does not compose with a 3-level "
+                "(cross, local, pods) mesh_shape yet — the ep axis takes "
+                "the leading mesh dimension the pod axis would use")
+        if mesh_shape is not None:
+            cross, local = mesh_shape
+        else:
+            if len(devices) % ep_size:
+                raise ValueError(
+                    f"ep_size {ep_size} does not divide "
+                    f"{len(devices)} devices")
+            cross, local = 1, len(devices) // ep_size
+        if ep_size * cross * local != len(devices):
+            raise ValueError(
+                f"ep_size {ep_size} x mesh_shape ({cross}, {local}) "
+                f"does not cover {len(devices)} devices")
+        grid = np.array(devices, dtype=object).reshape(
+            ep_size, cross, local)
+        return Mesh(grid, (EP_AXIS, CROSS_AXIS, LOCAL_AXIS))
     if pp_stages is not None and pp_stages > 1:
         # Pipeline mesh: a leading hvd_pp axis of pipeline stages over
         # the (cross, local) data mesh. Consecutive stages sit a full
@@ -244,6 +284,7 @@ def init(
     devices: Optional[Sequence[jax.Device]] = None,
     mesh_shape: Optional[Tuple[int, int]] = None,
     pp_stages: Optional[int] = None,
+    ep_size: Optional[int] = None,
 ) -> None:
     """Initialize the framework (reference: hvd.init(), basics.py:33 →
     InitializeHorovodOnce, operations.cc:628-674).
@@ -279,7 +320,9 @@ def init(
             enable_overlap_scheduling()
         if pp_stages is None:
             pp_stages = _state.config.pp_stages or None
-        _state.mesh = _build_mesh(devices, mesh_shape, pp_stages)
+        if ep_size is None:
+            ep_size = _state.config.ep_size or None
+        _state.mesh = _build_mesh(devices, mesh_shape, pp_stages, ep_size)
         _state.process_index = jax.process_index()
         _state.process_count = jax.process_count()
         _state.local_device_count = int(_state.mesh.devices.shape[-1])
@@ -502,8 +545,9 @@ def world_axes() -> Tuple[str, ...]:
             and s.mesh.devices.ndim == 3
             and s.mesh.axis_names[0] == POD_AXIS):
         return ALL_AXES
-    # A pipeline mesh's hvd_pp axis is NOT a world/data axis: data
-    # shards and gradient collectives stay on (cross, local) per stage.
+    # A pipeline mesh's hvd_pp axis (and an expert-parallel mesh's
+    # hvd_ep axis) is NOT a world/data axis: data shards and gradient
+    # collectives stay on (cross, local) per stage / per expert group.
     return HVD_AXES
 
 
@@ -569,16 +613,27 @@ def pp_size() -> int:
     return 1
 
 
+def ep_size() -> int:
+    """Number of expert-parallel groups: the leading ``hvd_ep`` mesh dim
+    of an expert-parallel mesh (``init(ep_size=...)`` /
+    ``HOROVOD_EP_SIZE``), else 1 (docs/moe.md)."""
+    s = _require_init()
+    if (s.mesh is not None and s.mesh.devices.ndim == 3
+            and s.mesh.axis_names[0] == EP_AXIS):
+        return int(s.mesh.devices.shape[0])
+    return 1
+
+
 def data_mesh_shape() -> Tuple[int, ...]:
     """The DATA mesh shape ``(cross, local[, pods])`` — the shape every
-    plan derivation prices. On a pipeline mesh the leading ``hvd_pp``
-    dim is excluded: gradient collectives run per-stage over the data
-    axes only."""
+    plan derivation prices. On a pipeline or expert-parallel mesh the
+    leading ``hvd_pp``/``hvd_ep`` dim is excluded: gradient collectives
+    run per-stage / per-expert-group over the data axes only."""
     s = _require_init()
     shp = s.mesh.devices.shape
     if len(shp) == 2:
         return (int(shp[0]), int(shp[1]))
-    if s.mesh.axis_names[0] == PP_AXIS:
+    if s.mesh.axis_names[0] in (PP_AXIS, EP_AXIS):
         return (int(shp[1]), int(shp[2]))
     return (int(shp[1]), int(shp[2]), int(shp[0]))
 
@@ -606,6 +661,12 @@ def mesh_geometry(mesh_shape=None, mesh=None) -> str:
             # never warm-starts another (docs/pipeline.md).
             mesh_shape = (int(shp[1]), int(shp[2]))
             pp = f"pp{int(shp[0])}"
+        elif mesh.axis_names[0] == EP_AXIS:
+            # Expert-parallel mesh: same discipline — a winner tuned at
+            # one expert-group count never warm-starts another
+            # (docs/moe.md).
+            mesh_shape = (int(shp[1]), int(shp[2]))
+            pp = f"ep{int(shp[0])}"
         else:
             mesh_shape = (int(shp[1]), int(shp[2]), int(shp[0]))
     if mesh_shape:

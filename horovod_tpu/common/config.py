@@ -105,6 +105,18 @@ class Config:
     pp_interleave: int = 1      # virtual stages per rank (>=1)
     pp_quantized: bool = False
 
+    # --- expert parallelism / MoE (docs/moe.md): a dedicated hvd_ep
+    #     mesh axis of ep_size expert groups; the MoE layer's
+    #     dispatch/combine all-to-alls lower as wire-plan ``a2a`` legs.
+    #     moe_quantized rides them blockwise-int8 with error feedback
+    #     (DCN/pod hops only — the a2a leg inherits the EQuARX
+    #     placement rule, exactly like the pipeline send leg).
+    ep_size: int = 0            # 0/1 = expert parallelism off
+    moe_experts: int = 0        # global expert count (0 = MoE off)
+    moe_topk: int = 2           # experts per token (top-k gating)
+    moe_capacity_factor: float = 1.25
+    moe_quantized: bool = False
+
     # --- autotune (common.h:68-73) ---
     autotune: bool = False
     autotune_log: Optional[str] = None
@@ -188,6 +200,12 @@ def from_env() -> Config:
         or "interleaved_1f1b",
         pp_interleave=_env_int("HOROVOD_PP_INTERLEAVE", 1),
         pp_quantized=_env_bool("HOROVOD_PP_QUANTIZED", False),
+        ep_size=_env_int("HOROVOD_EP_SIZE", 0),
+        moe_experts=_env_int("HOROVOD_MOE_EXPERTS", 0),
+        moe_topk=_env_int("HOROVOD_MOE_TOPK", 2),
+        moe_capacity_factor=_env_float("HOROVOD_MOE_CAPACITY_FACTOR",
+                                       1.25),
+        moe_quantized=_env_bool("HOROVOD_MOE_QUANTIZED", False),
         autotune=_env_bool("HOROVOD_AUTOTUNE", False),
         autotune_log=_env_str("HOROVOD_AUTOTUNE_LOG", None),
         autotune_warmup_samples=_env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3),

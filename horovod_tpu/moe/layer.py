@@ -1,0 +1,447 @@
+"""Expert-parallel MoE training: top-k gated expert FFN with wire-plan
+all-to-all dispatch (docs/moe.md).
+
+The layer is the training half of the MoE scenario family. Routing math:
+
+1. **route** — ``logits = x @ router`` → softmax probs; ``lax.top_k``
+   picks each token's K experts, the selected gates renormalize to sum
+   one. Two auxiliary losses ride along: the Switch load-balance loss
+   (``E · Σ_e f_e · P_e`` over top-1 assignment fractions ``f_e`` and
+   mean probs ``P_e``) and the router z-loss
+   (``mean(logsumexp(logits)²)``, ST-MoE: keeps logits bounded so the
+   int8 dispatch wire stays well-scaled);
+2. **capacity** — every expert accepts at most
+   ``ceil(K · N · capacity_factor / E)`` token-choices per step.
+   Position-in-expert assignment is DETERMINISTIC: choices are ranked
+   choice-major (all first choices before all second choices, token
+   order within a choice), so a rerun of the same batch dispatches
+   identically — no RNG in the hot path;
+3. **dispatch** — kept choices scatter into a static ``[E, cap, C]``
+   buffer; overflow choices are DROPPED (they contribute zero to the
+   combine, so a fully-dropped token passes through the caller's
+   residual connection untouched — standard Switch semantics);
+4. **exchange** — the buffer crosses the dedicated ``hvd_ep`` mesh axis
+   as a first-class ``a2a`` wire plan
+   (:func:`horovod_tpu.plan.compiler.lower_a2a`): validated IR,
+   blockwise-int8 payload with error feedback on DCN-class hops
+   (EQuARX), ``MOE:DISPATCH``/``MOE:COMBINE`` spans, and
+   ``comm.moe.bytes{hop}`` / ``WireStats.a2a_bytes`` accounting for
+   free;
+5. **expert FFN** — batched einsum over this ep rank's local experts;
+6. **combine** — the reverse exchange returns expert outputs to their
+   source rank; each token sums its kept choices' outputs weighted by
+   the renormalized gates.
+
+The ``hvd_ep`` axis is NOT a data/world axis (the hvd_pp pattern,
+docs/pipeline.md): ``hvd.init(ep_size=E)`` puts it leading the mesh, so
+``axes=None`` gradient collectives resolve to the data axes only and an
+expert's gradients reduce exclusively within its own data group —
+ZeRO stages, overlap, and the quantized gradient wire compose unchanged.
+Router/dense gradients, which ARE data-dependent per ep rank when the
+batch shards over ``hvd_ep``, get their explicit ep-mean via
+:func:`ep_mean_dense_grads`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..common import basics
+from ..common.basics import EP_AXIS
+from ..plan import compiler as _compiler
+from ..plan import planner as _planner
+
+if not hasattr(jax, "shard_map"):
+    # jax < 0.6: the experimental shard_map check_rep loop cannot handle
+    # a multiple-results primitive whose operand replication is the bare
+    # ``None`` of an untracked/constant-derived value — the upstream
+    # ``_standard_check`` rule returns ``None`` un-broadcast and the
+    # loop crashes on ``map(write, e.outvars, None)``. ``lax.top_k``
+    # (the router's expert selection) hits exactly this, so OVERWRITE
+    # its rule with one that always returns the per-output list.
+    try:  # pragma: no cover - version-gated compat
+        from jax.experimental import shard_map as _sm_compat
+        from jax._src.lax.lax import top_k_p as _top_k_p
+
+        def _top_k_rep_rule(mesh, x_rep, **params):
+            # Both outputs (values, indices) replicate exactly like the
+            # operand.
+            return [x_rep, x_rep]
+
+        _sm_compat._check_rules[_top_k_p] = _top_k_rep_rule
+    except Exception:  # pragma: no cover - internal-API drift
+        pass
+
+
+def _axis_size(axis) -> int:
+    if axis is None:
+        return 1
+    n = 1
+    for a in ((axis,) if isinstance(axis, str) else tuple(axis)):
+        n *= basics._axis_size(a)
+    return n
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEAux:
+    """Per-call routing diagnostics (all scalars/arrays are traced
+    values). ``load`` is the kept token-choice count per GLOBAL expert
+    ``[E]`` — the expert-load histogram's source; ``dropped_fraction``
+    the fraction of token-choices that overflowed capacity."""
+
+    load_balance_loss: jnp.ndarray
+    z_loss: jnp.ndarray
+    load: jnp.ndarray
+    dropped_fraction: jnp.ndarray
+
+
+def moe_capacity(n_tokens: int, num_experts: int,
+                 capacity_factor: float, topk: int) -> int:
+    """Per-expert dispatch capacity: ``ceil(K·N·cf / E)``, floor 1."""
+    return max(1, int(-(-topk * n_tokens * float(capacity_factor)
+                        // num_experts)))
+
+
+def moe_router(x, router_kernel, *, topk: int = 2,
+               router_logits=None):
+    """Top-k routing of tokens ``x [N, C]`` through ``router_kernel
+    [C, E]``. Returns ``(experts [N, K] int32, gates [N, K] fp32,
+    load_balance_loss, z_loss, probs [N, E])``.
+
+    ``router_logits`` overrides the computed logits (tests pin routing
+    deterministically with it; shape ``[N, E]``)."""
+    E = router_kernel.shape[-1]
+    if topk < 1 or topk > E:
+        raise ValueError(f"topk must be in 1..{E} (num experts), got "
+                         f"{topk}")
+    if router_logits is None:
+        router_logits = jnp.einsum(
+            "nc,ce->ne", x.astype(jnp.float32),
+            router_kernel.astype(jnp.float32))
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    gate_vals, experts = lax.top_k(probs, topk)          # [N, K]
+    gates = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    # Load-balance aux (Switch eq. 4): f_e from the TOP-1 assignment
+    # (the loss targets the primary routing decision), P_e = mean probs.
+    top1 = jax.nn.one_hot(experts[:, 0], E, dtype=jnp.float32)
+    frac = jnp.mean(top1, axis=0)
+    lb = E * jnp.sum(frac * jnp.mean(probs, axis=0))
+    # Router z-loss (ST-MoE): keeps logits bounded.
+    z = jnp.mean(jax.nn.logsumexp(router_logits, axis=-1) ** 2)
+    return experts.astype(jnp.int32), gates, lb, z, probs
+
+
+def moe_positions(experts, E: int, capacity: int):
+    """Deterministic position-in-expert assignment for the ``[N, K]``
+    expert choices: choices rank CHOICE-MAJOR (every token's first
+    choice before any second choice, token order within a choice), each
+    taking the next slot of its expert's queue. Returns ``(pos [N, K]
+    int32, keep [N, K] bool)`` — ``keep`` is False for choices past
+    ``capacity`` (dropped)."""
+    N, K = experts.shape
+    flat = jnp.transpose(experts).reshape(K * N)          # choice-major
+    oh = jax.nn.one_hot(flat, E, dtype=jnp.int32)         # [KN, E]
+    pos_flat = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1
+    pos = jnp.transpose(pos_flat.reshape(K, N))           # [N, K]
+    keep = pos < capacity
+    return pos.astype(jnp.int32), keep
+
+
+def _exchange(buf, plan, axis, residual, kind):
+    """One a2a hop of the ``[E, cap, C]`` buffer over ``axis`` (size
+    n): canonical row form in, dispatch semantics out. ``kind`` is
+    ``DISPATCH`` (→ ``[E_local, n·cap, C]``) or ``COMBINE`` (the
+    reverse)."""
+    n = _axis_size(axis)
+    E, cap, C = buf.shape
+    if kind == "DISPATCH":
+        out, new_res = _compiler.lower_a2a(plan, buf, axis=axis,
+                                           residual=residual, kind=kind)
+        # Row block j (= [E/n, cap, C] from rank j) concatenates along
+        # the capacity dim: [n, E/n, cap, C] -> [E/n, n*cap, C].
+        e_loc = E // n
+        return (jnp.transpose(out.reshape(n, e_loc, cap, C),
+                              (1, 0, 2, 3)).reshape(e_loc, n * cap, C),
+                new_res)
+    # COMBINE: [E_local, n*cap, C] -> rows [n, E_local, cap, C] -> a2a
+    # -> [E, cap, C] (E = n * E_local, expert-major again).
+    e_loc, ncap, C = buf.shape
+    cap = ncap // n
+    rows = jnp.transpose(buf.reshape(e_loc, n, cap, C),
+                         (1, 0, 2, 3)).reshape(n * e_loc, cap, C)
+    out, new_res = _compiler.lower_a2a(plan, rows, axis=axis,
+                                       residual=residual, kind=kind)
+    return out, new_res
+
+
+def default_a2a_plan(axis=None, *, quantized: bool = False,
+                     block: Optional[int] = None,
+                     error_feedback: Optional[bool] = None,
+                     fused: Optional[bool] = None):
+    """The a2a plan of an hvd_ep hop (docs/moe.md): the leg's level is
+    the slowest link class one ep hop crosses — the ep axis leads the
+    mesh, so it jumps a whole data mesh (``ep_a2a_level``); a custom
+    ``axis`` naming data axes maps onto its own widest level.
+    Quantization is forced off on an ICI-class hop (the EQuARX rule
+    the IR validates)."""
+    from ..common.basics import CROSS_AXIS, POD_AXIS
+
+    axes = ({axis} if isinstance(axis, str)
+            else set(axis) if axis is not None else {EP_AXIS})
+    if POD_AXIS in axes:
+        level = _planner.POD
+    elif CROSS_AXIS in axes:
+        level = _planner.DCN
+    elif EP_AXIS in axes and basics.is_initialized():
+        level = _planner.ep_a2a_level(basics.data_mesh_shape())
+    else:
+        level = _planner.ICI
+    q = bool(quantized) and level != _planner.ICI
+    ef = q if error_feedback is None else (error_feedback and q)
+    return _planner.a2a_plan(level, quantized=q, block=block,
+                             error_feedback=ef,
+                             fused=bool(fused) and q)
+
+
+def moe_ffn(x, params, *, topk: int = 2, capacity_factor: float = 1.25,
+            ep_axis=None, a2a_plan=None, residuals=None,
+            router_logits=None) -> Tuple[jnp.ndarray, MoEAux, object]:
+    """Top-k gated expert FFN over flattened tokens ``x [N, C]``.
+
+    ``params`` is a dict: ``router [C, E]`` (replicated over hvd_ep),
+    ``w1 [E_local, C, F]``, ``b1 [E_local, F]``, ``w2 [E_local, F, C]``,
+    ``b2 [E_local, C]`` (expert-sharded: ``E = E_local · ep`` where
+    ``ep`` is the bound size of ``ep_axis``). Returns ``(y [N, C],
+    :class:`MoEAux`, new_residuals)`` — ``y`` is zero for dropped
+    token-choices (the caller's residual connection passes dropped
+    tokens through).
+
+    ``a2a_plan`` is the validated dispatch/combine wire plan (default:
+    :func:`default_a2a_plan` for ``ep_axis``); ``residuals`` threads the
+    int8 error-feedback state as a ``(dispatch_res, combine_res)`` pair
+    of zero-initialized buffers (:func:`moe_ef_residuals`) — pass None
+    on an exact wire."""
+    N, C = x.shape
+    ep = _axis_size(ep_axis) if ep_axis is not None else 1
+    E_local = params["w1"].shape[0]
+    E = E_local * ep
+    if params["router"].shape[-1] != E:
+        raise ValueError(
+            f"router has {params['router'].shape[-1]} experts but "
+            f"E_local {E_local} x ep {ep} = {E}")
+    capacity = moe_capacity(N, E, capacity_factor, topk)
+
+    experts, gates, lb, z, _probs = moe_router(
+        x, params["router"], topk=topk, router_logits=router_logits)
+    pos, keep = moe_positions(experts, E, capacity)
+    pos_c = jnp.minimum(pos, capacity - 1)
+
+    # Diagnostics: kept choices per global expert + dropped fraction.
+    kept_oh = (jax.nn.one_hot(experts, E, dtype=jnp.float32)
+               * keep[..., None].astype(jnp.float32))
+    load = jnp.sum(kept_oh, axis=(0, 1))                  # [E]
+    dropped = 1.0 - jnp.sum(keep) / float(keep.size)
+    aux = MoEAux(load_balance_loss=lb, z_loss=z, load=load,
+                 dropped_fraction=dropped)
+
+    # Dispatch buffer [E, cap, C]: kept choices scatter-add into their
+    # expert's queue slot (disjoint (expert, pos) per kept choice, so
+    # the add is a pure placement).
+    xk = jnp.broadcast_to(x[:, None, :], (N, topk, C))
+    disp = jnp.zeros((E, capacity, C), x.dtype).at[
+        experts, pos_c].add(jnp.where(keep[..., None], xk, 0))
+
+    res_d = res_c = None
+    if residuals is not None:
+        res_d, res_c = residuals
+    if ep > 1:
+        plan = a2a_plan or default_a2a_plan(ep_axis)
+        recv, new_res_d = _exchange(disp, plan, ep_axis, res_d,
+                                    "DISPATCH")
+    else:
+        recv, new_res_d = disp, (None if res_d is None
+                                 else jnp.zeros_like(res_d))
+
+    h = jnp.einsum("ekc,ecf->ekf", recv, params["w1"]) \
+        + params["b1"][:, None]
+    h = nn.gelu(h)
+    out = jnp.einsum("ekf,efc->ekc", h, params["w2"]) \
+        + params["b2"][:, None]
+
+    if ep > 1:
+        back, new_res_c = _exchange(out, plan, ep_axis, res_c, "COMBINE")
+    else:
+        back, new_res_c = out, (None if res_c is None
+                                else jnp.zeros_like(res_c))
+
+    # Combine: each token sums its kept choices' expert outputs,
+    # weighted by the renormalized gates.
+    yk = back[experts, pos_c]                             # [N, K, C]
+    yk = jnp.where(keep[..., None], yk, 0) \
+        * gates[..., None].astype(back.dtype)
+    y = jnp.sum(yk, axis=1).astype(x.dtype)
+    new_residuals = (None if residuals is None
+                     else (new_res_d, new_res_c))
+    return y, aux, new_residuals
+
+
+def moe_ef_residuals(n_tokens: int, d_model: int, num_experts: int,
+                     capacity_factor: float = 1.25, topk: int = 2,
+                     ep: int = 1, dtype=jnp.float32):
+    """Zero-initialized error-feedback residual pair for
+    :func:`moe_ffn`'s int8 wire: one buffer per exchange direction,
+    each matching the exchanged buffer's shape. Thread the returned
+    pair through the step's carry exactly like the optimizer's
+    ``QuantizedEFState`` residual (docs/moe.md)."""
+    E = num_experts
+    cap = moe_capacity(n_tokens, E, capacity_factor, topk)
+    shape = (E, cap, d_model)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# The flax module.
+# ---------------------------------------------------------------------------
+
+
+class MoELayer(nn.Module):
+    """Top-k gated MoE FFN (docs/moe.md) — the drop-in for a dense MLP
+    block, expert-parallel over the dedicated ``hvd_ep`` axis.
+
+    ``num_experts`` is GLOBAL; with ``ep_axis`` bound inside shard_map
+    each rank creates only its ``num_experts / ep`` experts' weights
+    (the router is replicated). Sows ``moe_aux_loss`` / ``moe_z_loss``
+    / ``moe_expert_load`` / ``moe_dropped_frac`` into
+    ``intermediates``; callers add ``aux_weight · aux + z_weight · z``
+    to the task loss. ``quantized`` rides the dispatch/combine wire
+    blockwise-int8 (error feedback needs the functional
+    :func:`moe_ffn` — the flax layer is stateless)."""
+
+    num_experts: int
+    d_ff: int
+    topk: int = 2
+    capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
+    quantized: bool = False
+    quant_block: int = 256
+    dtype: jnp.dtype = jnp.float32
+    kernel_init_std: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, C = x.shape
+        ep = _axis_size(self.ep_axis) if self.ep_axis else 1
+        if self.num_experts % ep:
+            raise ValueError(
+                f"num_experts {self.num_experts} not divisible by "
+                f"ep axis size {ep}")
+        e_local = self.num_experts // ep
+        init = nn.initializers.normal(self.kernel_init_std)
+        params = {
+            "router": self.param("router", init,
+                                 (C, self.num_experts), jnp.float32),
+            "w1": self.param("w1", init, (e_local, C, self.d_ff),
+                             jnp.float32).astype(self.dtype),
+            "b1": self.param("b1", nn.initializers.zeros,
+                             (e_local, self.d_ff),
+                             jnp.float32).astype(self.dtype),
+            "w2": self.param("w2", init, (e_local, self.d_ff, C),
+                             jnp.float32).astype(self.dtype),
+            "b2": self.param("b2", nn.initializers.zeros, (e_local, C),
+                             jnp.float32).astype(self.dtype),
+        }
+        plan = None
+        if ep > 1:
+            plan = default_a2a_plan(self.ep_axis,
+                                    quantized=self.quantized,
+                                    block=self.quant_block,
+                                    error_feedback=False)
+        y, aux, _ = moe_ffn(x.reshape(B * T, C), params,
+                            topk=self.topk,
+                            capacity_factor=self.capacity_factor,
+                            ep_axis=self.ep_axis, a2a_plan=plan)
+        self.sow("intermediates", "moe_aux_loss", aux.load_balance_loss)
+        self.sow("intermediates", "moe_z_loss", aux.z_loss)
+        self.sow("intermediates", "moe_expert_load", aux.load)
+        self.sow("intermediates", "moe_dropped_frac",
+                 aux.dropped_fraction)
+        return y.reshape(B, T, C)
+
+
+# ---------------------------------------------------------------------------
+# Parameter/gradient plumbing for the hvd_ep mesh.
+# ---------------------------------------------------------------------------
+
+#: Leaf names of the expert-sharded half of an MoE params dict.
+EXPERT_LEAVES = ("w1", "b1", "w2", "b2")
+
+
+def ep_param_pspecs(params, ep_axis: str = EP_AXIS):
+    """PartitionSpecs for a stacked MoE params tree: expert leaves
+    (leading ``[ep, E_local, ...]`` dim) shard over ``ep_axis``,
+    everything else (router, dense trunk) replicates."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, _leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return P(ep_axis) if name in EXPERT_LEAVES else P()
+
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def ep_stack_params(params, ep: int):
+    """Split a dense (world-1) MoE params dict into the ``[ep, ...]``
+    stacked form ``ep_param_pspecs`` shards: expert leaves split their
+    leading expert dim into ``ep`` groups; replicated leaves stay."""
+    def split(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in EXPERT_LEAVES:
+            E = leaf.shape[0]
+            if E % ep:
+                raise ValueError(
+                    f"expert dim {E} of {name!r} not divisible by "
+                    f"ep={ep}")
+            return leaf.reshape((ep, E // ep) + leaf.shape[1:])
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(split, params)
+
+
+def ep_mean_dense_grads(grads, ep_axis: str = EP_AXIS,
+                        expert_leaves=EXPERT_LEAVES):
+    """Normalize a local gradient tree to the GLOBAL-MEAN gradient's ep
+    share, ready for the data-axis reduction machinery (docs/moe.md).
+
+    With the batch sharded over ``(hvd_ep, cross, local)`` and the loss
+    a global token mean:
+
+    * replicated parameters (router, dense trunk) receive a DIFFERENT
+      gradient per ep rank (each saw a different token shard) — they
+      take the explicit ``pmean`` over ``hvd_ep``;
+    * expert leaves are NEVER averaged across groups (that would mix
+      different experts' gradients — the isolation contract the
+      dedicated axis exists for). But the owner's autodiff gradient
+      already SUMS the contributions every ep source routed to it
+      (the combine exchange's backward delivers them), so the
+      global-mean normalization is the ``1/ep`` scale, applied locally
+      with zero wire.
+
+    After this, a plain ``op=Average`` reduction over the data axes
+    (``DistributedOptimizer`` / ``allreduce_pytree``) yields exactly the
+    global-mean gradient for every leaf."""
+    ep = _axis_size(ep_axis)
+
+    def norm(path, g):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in expert_leaves:
+            return g / float(ep)
+        return lax.pmean(g, ep_axis)
+
+    return jax.tree_util.tree_map_with_path(norm, grads)

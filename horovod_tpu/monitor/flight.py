@@ -104,6 +104,36 @@ def _identity() -> Dict[str, object]:
     return ident
 
 
+_EXPERT_KEY_RE = None  # compiled lazily (re import stays cold-path)
+
+
+def _extract_expert_load(registry_snap: Optional[dict]) -> Dict[str, float]:
+    """Fold the per-expert load metrics of a registry snapshot into a
+    compact ``{expert_id: tokens}`` dict: ``serve.expert_tokens{expert}``
+    histogram sums (the serving engines) plus
+    ``moe.expert_tokens{expert}`` counters (the training bench leg)."""
+    if not registry_snap:
+        return {}
+    global _EXPERT_KEY_RE
+    if _EXPERT_KEY_RE is None:
+        import re
+
+        _EXPERT_KEY_RE = re.compile(
+            r"^(?:serve|moe)\.expert_tokens\{expert=(\d+)\}$")
+    load: Dict[str, float] = {}
+    for key, h in (registry_snap.get("histograms") or {}).items():
+        m = _EXPERT_KEY_RE.match(key)
+        if m and isinstance(h, dict):
+            e = m.group(1)
+            load[e] = load.get(e, 0.0) + float(h.get("sum", 0.0))
+    for key, v in (registry_snap.get("counters") or {}).items():
+        m = _EXPERT_KEY_RE.match(key)
+        if m:
+            e = m.group(1)
+            load[e] = load.get(e, 0.0) + float(v)
+    return load
+
+
 class FlightRecorder:
     """Bounded in-memory ring of recent framework events."""
 
@@ -235,6 +265,7 @@ class FlightRecorder:
             straggler_history = _straggler.straggler_detector().history()
         except Exception:
             pass
+        expert_load = _extract_expert_load(registry_snap)
         payload = json.dumps(events, sort_keys=True).encode()
         dump = {
             "version": DUMP_VERSION,
@@ -249,6 +280,12 @@ class FlightRecorder:
             "stalled": stalled,
             "straggler": straggler_history,
         }
+        if expert_load:
+            # Per-expert load (docs/moe.md): the compact {expert: tokens}
+            # view of the serve.expert_tokens/moe.expert_tokens metrics,
+            # so scripts/postmortem.py can name a hot expert without
+            # re-deriving it from raw histogram buckets.
+            dump["expert_load"] = expert_load
         if extra:
             dump["extra"] = extra
         return dump

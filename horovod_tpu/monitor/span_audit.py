@@ -37,6 +37,7 @@ KNOWN_PREFIXES = frozenset({
     "CKPT",        # async checkpoint lifecycle (docs/checkpoint.md)
     "FUSED",       # fused Pallas kernel spans (docs/fused-kernels.md)
     "PP",          # pipeline sends + schedule slots (docs/pipeline.md)
+    "MOE",         # expert dispatch/combine exchanges (docs/moe.md)
     "STRAGGLER",   # skew / link-health diagnoses (monitor/straggler.py)
     "FLIGHT",      # flight-recorder marks (monitor/flight.py)
 })

@@ -62,8 +62,11 @@ logger = logging.getLogger("horovod_tpu.straggler")
 
 #: Canonical phase vocabulary (docs/observability.md). record_phase
 #: accepts any name, but detection/reporting tables order these first.
-PHASES = ("compute", "wire.ici", "wire.dcn", "wire.pod", "pp_bubble",
-          "ckpt")
+#: ``wire.a2a`` is the MoE dispatch/combine wire (docs/moe.md) — fed by
+#: bench's ``--moe`` leg so a straggling expert group attributes to its
+#: exchange phase, separate from the gradient wire's hop classes.
+PHASES = ("compute", "wire.ici", "wire.dcn", "wire.pod", "wire.a2a",
+          "pp_bubble", "ckpt")
 
 HOPS = ("ici", "dcn", "pod")
 

@@ -359,6 +359,9 @@ def DistributedOptimizer(
     pp_microbatches: Optional[int] = None,
     pp_schedule: Optional[str] = None,
     pp_interleave: Optional[int] = None,
+    moe_experts: Optional[int] = None,
+    moe_capacity_factor: Optional[float] = None,
+    moe_topk: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with fused gradient allreduce.
 
@@ -451,6 +454,16 @@ def DistributedOptimizer(
     knobs validate the composition up front (stage count vs mesh,
     schedule family, microbatch divisibility) and fail loudly instead of
     letting a mismatched schedule train garbage.
+
+    ``moe_experts`` / ``moe_capacity_factor`` / ``moe_topk`` (defaults:
+    the live mesh's ``hvd_ep`` axis and the ``HOROVOD_MOE_*`` knobs; a
+    ``plan``'s moe record and ``tuned_params``' moe fields fill unset
+    values first) declare the MoE composition the same way
+    (docs/moe.md): the gradient wire is already expert-parallel-safe —
+    ``axes=None`` resolves to the DATA axes, so an expert's gradients
+    reduce only within its own data group and never across ``hvd_ep``
+    — these knobs validate up front (expert count vs the ep axis,
+    capacity/topk bounds) and fail loudly on a misconfiguration.
     """
     if gradient_predivide_factor != 1.0 and op != C.ReduceOp.AVERAGE:
         raise ValueError(
@@ -461,6 +474,8 @@ def DistributedOptimizer(
     _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
                        pp_interleave, plan=plan,
                        tuned_params=tuned_params)
+    _validate_moe_knobs(moe_experts, moe_capacity_factor, moe_topk,
+                        plan=plan, tuned_params=tuned_params)
     quant_block = None
     grad_plan = None
     if plan is not None:
@@ -687,6 +702,65 @@ def _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
                 f"(docs/pipeline.md)")
     return {"pp_stages": pp_stages, "pp_microbatches": pp_microbatches,
             "pp_schedule": pp_schedule, "pp_interleave": pp_interleave}
+
+
+def _validate_moe_knobs(moe_experts, moe_capacity_factor, moe_topk, *,
+                        plan=None, tuned_params=None) -> dict:
+    """Resolve + validate the MoE knobs of a training step
+    (docs/moe.md). Like the pp knobs, the optimizer's gradient
+    collectives are already expert-parallel-safe by construction —
+    ``axes=None`` resolves to the DATA axes, never ``hvd_ep`` — so these
+    exist to fail loudly on a misconfigured composition: an expert
+    count that does not divide by the live hvd_ep axis, a non-positive
+    capacity factor, a topk out of range.
+
+    Returns the resolved ``{moe_experts, moe_capacity_factor,
+    moe_topk}`` dict. Shared by :class:`DistributedOptimizer` and
+    :func:`horovod_tpu.value_and_grad`."""
+    if plan is not None and hasattr(plan, "moe_experts"):
+        if moe_experts is None and getattr(plan, "moe_experts", 0):
+            moe_experts = plan.moe_experts
+        if moe_capacity_factor is None and getattr(
+                plan, "moe", None) is not None:
+            moe_capacity_factor = plan.moe_capacity_factor
+        if moe_topk is None and getattr(plan, "moe", None) is not None:
+            moe_topk = plan.moe_topk
+    if tuned_params is not None and moe_capacity_factor is None:
+        moe_capacity_factor = getattr(tuned_params,
+                                      "moe_capacity_factor", 0.0) or None
+    cfg = basics.config() if basics.is_initialized() else None
+    if moe_experts is None:
+        if basics.is_initialized() and basics.ep_size() > 1:
+            moe_experts = basics.ep_size()
+        else:
+            moe_experts = cfg.moe_experts if cfg else 0
+    if moe_capacity_factor is None:
+        moe_capacity_factor = (cfg.moe_capacity_factor if cfg else 1.25)
+    if moe_topk is None:
+        moe_topk = cfg.moe_topk if cfg else 2
+    moe_experts = int(moe_experts or 0)
+    moe_topk = int(moe_topk or 0)
+    moe_capacity_factor = float(moe_capacity_factor or 0.0)
+    if moe_experts > 1:
+        if basics.is_initialized() and basics.ep_size() > 1 \
+                and moe_experts % basics.ep_size():
+            raise ValueError(
+                f"moe_experts={moe_experts} does not divide by the live "
+                f"mesh's hvd_ep axis of {basics.ep_size()} expert "
+                f"groups — expert placement is mesh geometry "
+                f"(hvd.init(ep_size=...), docs/moe.md)")
+        if moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got "
+                f"{moe_capacity_factor} — the dispatch buffer needs "
+                f"headroom (docs/moe.md)")
+        if not (1 <= moe_topk <= moe_experts):
+            raise ValueError(
+                f"moe_topk={moe_topk} out of range 1..{moe_experts} "
+                f"(experts per token cannot exceed the expert count)")
+    return {"moe_experts": moe_experts,
+            "moe_capacity_factor": moe_capacity_factor,
+            "moe_topk": moe_topk}
 
 
 # ---------------------------------------------------------------------------

@@ -106,6 +106,9 @@ def value_and_grad(
     pp_microbatches: Optional[int] = None,
     pp_schedule: Optional[str] = None,
     pp_interleave: Optional[int] = None,
+    moe_experts: Optional[int] = None,
+    moe_capacity_factor: Optional[float] = None,
+    moe_topk: Optional[int] = None,
     **jax_kwargs,
 ):
     """``jax.value_and_grad`` whose gradients are allreduced across ranks —
@@ -142,7 +145,13 @@ def value_and_grad(
     :func:`~horovod_tpu.parallel.pipeline.interleaved_1f1b`) compute
     their own gradients, so here the knobs are a loud-failure contract,
     not a behavior switch; the returned gradients are still reduced over
-    the DATA axes only (``axes=None`` never includes ``hvd_pp``)."""
+    the DATA axes only (``axes=None`` never includes ``hvd_pp``).
+
+    ``moe_experts``/``moe_capacity_factor``/``moe_topk`` validate the
+    MoE composition the same way (docs/moe.md): expert gradients stay
+    isolated per expert group because ``axes=None`` never includes
+    ``hvd_ep`` — the knobs fail loudly on a misconfiguration (expert
+    count vs the live ep axis, capacity/topk bounds)."""
     if any(k is not None for k in (pp_stages, pp_microbatches,
                                    pp_schedule, pp_interleave)):
         from .optimizer import _validate_pp_knobs
@@ -150,6 +159,12 @@ def value_and_grad(
         _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
                            pp_interleave, plan=plan,
                            tuned_params=tuned_params)
+    if any(k is not None for k in (moe_experts, moe_capacity_factor,
+                                   moe_topk)):
+        from .optimizer import _validate_moe_knobs
+
+        _validate_moe_knobs(moe_experts, moe_capacity_factor, moe_topk,
+                            plan=plan, tuned_params=tuned_params)
     if plan is not None and hasattr(plan, "gradient"):
         if zero is None and zero_stage is None:
             zero = plan.zero_stage > 0

@@ -54,24 +54,29 @@ from .accounting import (  # noqa: F401
     bench_gbps,
     fused_span,
     modeled_wire_ms,
+    moe_span,
     record_wire_stats,
 )
 from .planner import (  # noqa: F401
     PricedPlan,
     StepPlan,
+    a2a_plan,
     decode_tuned,
+    derive_a2a,
     derive_all_gather,
     derive_allreduce,
     derive_reduce_scatter,
     describe_plan,
     encode_tuned,
     enumerate_tuned,
+    ep_a2a_level,
     flat_plan,
     fused_ag_matmul_plan,
     fused_matmul_rs_plan,
     derive_send,
     pp_bubble_bound,
     pp_send_level,
+    predict_a2a_bytes,
     predict_fused_hbm_saved,
     predict_leg_bytes,
     quantized_allreduce_plan,
@@ -86,6 +91,7 @@ from .cost import (  # noqa: F401
     LinkClass,
     PlanCost,
     StepCost,
+    price_a2a,
     price_plan,
     price_send,
     price_step,

@@ -66,6 +66,15 @@ class WireStats:
         self.pp_bytes = 0.0
         self.pp_bytes_fp = 0.0
         self.pp_sends = 0
+        # MoE wire (docs/moe.md): bytes moved by a2a legs — the expert
+        # dispatch/combine row exchanges of the hvd_ep axis. Same
+        # double-charging discipline as the pipeline wire: an a2a leg
+        # charges its hop's per-hop total AND these counters, so the
+        # MoE share of each link class is separable. ``a2a_calls``
+        # counts exchange issues (layers x directions).
+        self.a2a_bytes = 0.0
+        self.a2a_bytes_fp = 0.0
+        self.a2a_calls = 0
 
     @property
     def dcn_reduction(self) -> Optional[float]:
@@ -124,6 +133,8 @@ def _publish_wire_stats(ws: "WireStats") -> None:
     r.gauge("comm.wire.fused_hbm_saved_bytes").set(ws.fused_hbm_saved_bytes)
     r.gauge("comm.wire.pp_bytes").set(ws.pp_bytes)
     r.gauge("comm.wire.pp_sends").set(ws.pp_sends)
+    r.gauge("comm.wire.a2a_bytes").set(ws.a2a_bytes)
+    r.gauge("comm.wire.a2a_calls").set(ws.a2a_calls)
 
 
 def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
@@ -228,6 +239,40 @@ def _acct_pp(hop: str, wire_bytes: float, fp_bytes: Optional[float] = None,
         ws.pp_bytes += wire_bytes
         ws.pp_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
         ws.pp_sends += sends
+
+
+def _acct_a2a(hop: str, wire_bytes: float,
+              fp_bytes: Optional[float] = None, calls: int = 1) -> None:
+    """Account a MoE a2a leg: charges ``wire_bytes`` to the ``hop`` link
+    class exactly like any other leg (so ``comm.bytes{hop}`` and the
+    per-hop WireStats totals include it), and ADDITIONALLY to the MoE
+    wire's own counters so bench/obs can separate the expert
+    dispatch/combine traffic from the gradient wire (docs/moe.md)."""
+    _acct(hop, wire_bytes, fp_bytes)
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.moe.bytes", hop=hop).inc(wire_bytes)
+        _metrics.counter("comm.moe.calls", hop=hop).inc(calls)
+    for ws in _wire_recorders:
+        ws.a2a_bytes += wire_bytes
+        ws.a2a_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        ws.a2a_calls += calls
+
+
+@contextlib.contextmanager
+def moe_span(kind: str, tid: str = "moe"):
+    """Bracket one MoE wire event in a ``MOE:<kind>`` timeline span
+    (kinds today: ``DISPATCH`` — the token→expert a2a exchange;
+    ``COMBINE`` — the expert→token return exchange). Trace-time only,
+    like every span here (docs/moe.md)."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    activity = f"MOE:{kind}"
+    if tl is not None:
+        tl.begin(tid, activity)
+    try:
+        yield
+    finally:
+        if tl is not None:
+            tl.end(tid, activity)
 
 
 @contextlib.contextmanager

@@ -47,7 +47,8 @@ from ..common import basics
 from ..common.basics import CROSS_AXIS, LOCAL_AXIS, POD_AXIS
 from ..ops import compression as _compression
 from . import ir
-from .accounting import _acct, _acct_enabled, _acct_pp, pp_span
+from .accounting import (_acct, _acct_a2a, _acct_enabled, _acct_pp,
+                         moe_span, pp_span)
 
 # Mesh axis carried by each plan level.
 LEVEL_AXIS = {ir.ICI: LOCAL_AXIS, ir.DCN: CROSS_AXIS, ir.POD: POD_AXIS}
@@ -347,6 +348,111 @@ def lower_send(plan: ir.WirePlan, x, *, axis, perm, residual=None,
         return out, None
     new_res = err.reshape(nb * blk)[:n].reshape(residual.shape)
     return out, new_res.astype(residual.dtype)
+
+
+# ---------------------------------------------------------------------------
+# a2a leg — the MoE wire (docs/moe.md). One tiled ``lax.all_to_all`` row
+# exchange along ``axis`` (the hvd_ep axis): ``x`` is ``[k*m, ...]`` with
+# row block ``j`` (of ``m`` rows) destined to ep rank ``j``; the output
+# has the same shape, block ``j`` holding what rank ``j`` sent this
+# rank. The int8 wire dtype quantizes the k-1 foreign row blocks
+# blockwise before the exchange and dequantizes after — the EQuARX
+# per-hop rule applied to the expert dispatch/combine traffic — with an
+# optional error-feedback residual (this rank's quantization error on
+# everything it sent, re-injected into its next exchange).
+# ---------------------------------------------------------------------------
+
+
+def lower_a2a(plan: ir.WirePlan, x, *, axis, residual=None,
+              kind: str = "DISPATCH"):
+    """Lower a validated a2a plan over buffer ``x [k*m, ...]``; returns
+    ``(received, new_residual)`` (``new_residual`` is None without EF).
+
+    The exchange is the canonical row form (``split_axis=0,
+    concat_axis=0, tiled=True``); callers reshape dispatch semantics
+    around it (horovod_tpu/moe/layer.py). ``kind`` names the
+    ``MOE:<kind>`` span bracketing the exchange."""
+    (leg,) = plan.legs
+    hop = ir.LEVEL_HOP[leg.level]
+    k = 1
+    for a in ((axis,) if isinstance(axis, str) else tuple(axis)):
+        k *= _axis_size(a)
+    if x.shape[0] % k:
+        raise ValueError(
+            f"a2a buffer leading dim {x.shape[0]} does not divide by "
+            f"the {k}-rank exchange axis {axis!r}")
+    n = int(np.prod(x.shape, dtype=np.int64))
+    seg = n // k                       # elements per destination row
+    isz = jnp.dtype(x.dtype).itemsize
+    if k == 1:
+        # Degenerate world: nothing moves; still consume the residual so
+        # the EF state threading is world-size independent.
+        return x, (None if residual is None
+                   else jnp.zeros_like(residual))
+    if leg.wire_dtype != ir.INT8:
+        if _acct_enabled():
+            _acct_a2a(hop, float(seg) * (k - 1) * isz)
+        with moe_span(kind):
+            out = lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                 tiled=True)
+        return out, (None if residual is None
+                     else jnp.zeros_like(residual))
+
+    blk = int(leg.block or 256)
+    corrected = (x if residual is None
+                 else x + residual.reshape(x.shape).astype(x.dtype))
+    rows = jnp.reshape(corrected, (k, seg)).astype(jnp.float32)
+    pad = (-seg) % blk
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((k, pad), jnp.float32)], axis=1)
+    nb = rows.shape[1] // blk
+    backend = leg.backend
+
+    def _exchange_int8(blocks):
+        """One int8 row exchange of ``blocks [k, nb, blk]``; returns
+        ``(vals, err)`` — dequantized received blocks (a permutation,
+        not a reduction: each block scales back independently) and this
+        rank's quantization error on what it sent."""
+        q, scales, err = _quantize_blocks(blocks, backend)
+        if _acct_enabled():
+            _acct_a2a(hop, quant_wire_bytes(seg, blk) * (k - 1),
+                      float(seg) * (k - 1) * isz)
+        with moe_span(kind):
+            qT = lax.all_to_all(q, axis, split_axis=0, concat_axis=0,
+                                tiled=True)
+            sT = lax.all_to_all(scales, axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+        return (qT.astype(jnp.float32) * sT[..., None]), err
+
+    # The transpose of the tiled (split 0, concat 0) row exchange is
+    # ITSELF — (sender r, block j) swaps with (sender j, block r) — so
+    # the backward pass rides the SAME int8 wire instead of a silent
+    # fp fallback (and instead of autodiff's zero-gradient round):
+    # cotangents quantize blockwise, exchange, dequantize. The EF
+    # residual is forward-only state (no cotangent).
+    @jax.custom_vjp
+    def quantized_a2a(blocks):
+        vals, err = _exchange_int8(blocks)
+        return vals, err
+
+    def _fwd(blocks):
+        return _exchange_int8(blocks), None
+
+    def _bwd(_, cots):
+        g_vals, _g_err = cots
+        g_back, _ = _exchange_int8(g_vals)
+        return (g_back,)
+
+    quantized_a2a.defvjp(_fwd, _bwd)
+
+    vals3, err = quantized_a2a(rows.reshape(k, nb, blk))
+    vals = vals3.reshape(k, nb * blk)[:, :seg]
+    out = vals.reshape(x.shape).astype(x.dtype)
+    if residual is None:
+        return out, None
+    new_res = err.reshape(k, nb * blk)[:, :seg].reshape(residual.shape)
+    return out, jax.lax.stop_gradient(new_res).astype(residual.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -224,6 +224,10 @@ class StepCost:
     # compute_ms is known — zero with pp off.
     pp_ms: float = 0.0
     pp_bubble_ms: float = 0.0
+    # MoE term (docs/moe.md): the expert dispatch/combine a2a wire (2
+    # issues per MoE layer of a capacity-factor-scaled buffer) — zero
+    # with MoE off.
+    moe_ms: float = 0.0
 
     @property
     def sync_ms(self) -> float:
@@ -232,7 +236,7 @@ class StepCost:
     @property
     def predicted_ms(self) -> float:
         return (self.sync_ms - self.hidden_ms + self.pp_ms
-                + self.pp_bubble_ms)
+                + self.pp_bubble_ms + self.moe_ms)
 
     def as_dict(self) -> dict:
         return {
@@ -244,6 +248,7 @@ class StepCost:
             "hidden_ms": round(self.hidden_ms, 6),
             "pp_ms": round(self.pp_ms, 6),
             "pp_bubble_ms": round(self.pp_bubble_ms, 6),
+            "moe_ms": round(self.moe_ms, 6),
             "buckets": self.buckets,
             "model": self.source,
         }
@@ -257,16 +262,18 @@ def _ring_size(hop: str, mesh_sizes: Tuple[int, int, int]) -> int:
 
 def price_plan(plan: ir.WirePlan, n: int, itemsize: float, mesh_shape,
                model: Optional[CostModel] = None, *,
-               buckets: int = 1) -> PlanCost:
+               buckets: int = 1, ep: int = 0) -> PlanCost:
     """Price one plan for a payload of ``n`` elements: per-leg bytes
     from the exact trace-time formulas, alpha per ring hop per bucket,
-    quant kernel time on the int8 legs' fp-equivalent payload."""
+    quant kernel time on the int8 legs' fp-equivalent payload. ``ep``
+    is the hvd_ep exchange width of an ``a2a`` plan (docs/moe.md)."""
     from . import planner as _planner  # call-time: planner imports cost
 
     model = model or CostModel.from_env()
     static = CostModel.from_env()
     nl, nc, npod = _planner._mesh_sizes(mesh_shape)
-    rows = _planner.predict_leg_bytes(plan, n, itemsize, mesh_shape)
+    rows = _planner.predict_leg_bytes(plan, n, itemsize, mesh_shape,
+                                      ep=ep)
     legs: List[LegCost] = []
     for r in rows:
         hop, b = r["hop"], float(r["bytes"])
@@ -277,10 +284,10 @@ def price_plan(plan: ir.WirePlan, n: int, itemsize: float, mesh_shape,
         k = _ring_size(hop, (nl, nc, npod))
         wire_ms = b / (lk.bandwidth_gbps * 1e9) * 1e3
         modeled_ms = b / (static.link(hop).bandwidth_gbps * 1e9) * 1e3
-        if r["leg"].primitive == ir.SEND:
-            # A send leg is ONE point-to-point hop, not a (k-1)-hop
-            # ring: exactly one launch latency per issue
-            # (docs/pipeline.md).
+        if r["leg"].primitive in (ir.SEND, ir.ALL_TO_ALL):
+            # A send leg is ONE point-to-point hop, and a tiled
+            # all_to_all lowers to ONE fused exchange — exactly one
+            # launch latency per issue (docs/pipeline.md, docs/moe.md).
             alpha_ms = lk.latency_us * buckets / 1e3
         else:
             alpha_ms = lk.latency_us * max(0, k - 1) * buckets / 1e3
@@ -336,6 +343,23 @@ def price_step(step_plan, payload_bytes: float, *,
         hideable = wire_ms * (1.0 - 1.0 / buckets)
         hidden_ms = (hideable if compute_ms is None
                      else max(0.0, min(hideable, float(compute_ms))))
+    moe_ms = 0.0
+    moe = getattr(step_plan, "moe", None)
+    experts = int(getattr(step_plan, "moe_experts", 0) or 0)
+    if moe is not None and experts > 1:
+        # MoE pricing (docs/moe.md): one MoE layer issues two a2a
+        # exchanges per step (dispatch + combine) of a dispatch buffer
+        # sized capacity_factor x the activation payload — approximated
+        # against the caller's payload when no activation size is
+        # known, which preserves the ranking the shortlist needs: a
+        # bigger capacity factor moves proportionally more bytes, the
+        # int8 wire moves ~4x fewer at quantize-kernel cost.
+        cap = float(getattr(step_plan, "moe_capacity_factor", 0.0)
+                    or 1.0)
+        buf_n = max(1, int(n * max(0.25, cap)))
+        mpc = price_plan(moe, buf_n, itemsize, mesh_shape, model,
+                         ep=experts)
+        moe_ms = mpc.total_ms * 2
     pp_ms = 0.0
     pp_bubble_ms = 0.0
     send = getattr(step_plan, "send", None)
@@ -361,7 +385,29 @@ def price_step(step_plan, payload_bytes: float, *,
                     modeled_ms=modeled_ms, alpha_ms=alpha_ms,
                     quant_ms=quant_ms, hidden_ms=hidden_ms,
                     source=model.source, pp_ms=pp_ms,
-                    pp_bubble_ms=pp_bubble_ms)
+                    pp_bubble_ms=pp_bubble_ms, moe_ms=moe_ms)
+
+
+def price_a2a(plan: ir.WirePlan, payload_bytes: float, *,
+              ep: int, issues: int = 1, itemsize: float = 4.0,
+              mesh_shape=(1, 1),
+              model: Optional[CostModel] = None) -> dict:
+    """Price ``issues`` identical a2a exchanges of a ``payload_bytes``
+    dispatch buffer over ``ep`` expert groups: the per-exchange
+    wire/alpha/quant terms times the layer's issue count (two per MoE
+    layer — dispatch, then combine) — the predicted side of the bench
+    ``--moe`` leg's a2a drift pair (docs/moe.md). ``modeled_ms`` is the
+    same bytes at the static modeled bandwidths, exactly what the
+    trace-time accounting would charge for the same issues."""
+    model = model or CostModel.from_env()
+    n = max(1, int(payload_bytes / max(1e-9, itemsize)))
+    pc = price_plan(plan, n, itemsize, mesh_shape, model, ep=ep)
+    return {
+        "predicted_ms": pc.total_ms * issues,
+        "modeled_ms": pc.modeled_ms * issues,
+        "wire_bytes": sum(l.bytes for l in pc.legs) * issues,
+        "model": model.source,
+    }
 
 
 def price_send(plan: ir.WirePlan, payload_bytes: float, *,

@@ -47,7 +47,10 @@ LEVELS = (ICI, DCN, POD, FLAT)
 # the TPU lowerings use). ``send`` is the point-to-point primitive of the
 # pipeline wire (docs/pipeline.md): one ``lax.ppermute`` hop carrying an
 # inter-stage activation (or activation-grad) along the hvd_pp axis,
-# charged to the link class its ``level`` names.
+# charged to the link class its ``level`` names. ``all_to_all`` is the
+# MoE dispatch/combine primitive (docs/moe.md): one tiled
+# ``lax.all_to_all`` row exchange along the hvd_ep axis, owned by the
+# ``a2a`` plan family.
 REDUCE_SCATTER = "reduce_scatter"
 ALL_GATHER = "all_gather"
 ALL_TO_ALL = "all_to_all"
@@ -79,10 +82,11 @@ XLA = "xla"
 PALLAS = "pallas"
 BACKENDS = (XLA, PALLAS)
 
-_REDUCE_PRIMS = (REDUCE_SCATTER, PSUM, ALL_TO_ALL)
+_REDUCE_PRIMS = (REDUCE_SCATTER, PSUM)
 _GATHER_PRIMS = (ALL_GATHER,)
 
-_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather", "send")
+_COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather", "send",
+                "a2a")
 
 
 class PlanError(ValueError):
@@ -267,6 +271,30 @@ class WirePlan:
                     f"pipeline hop has no leg-local compute to fuse "
                     f"beyond the int8 quantize pair, which the compiler "
                     f"places itself (docs/pipeline.md)")
+            if (leg.primitive == ALL_TO_ALL) != (self.collective == "a2a"):
+                if leg.primitive == ALL_TO_ALL:
+                    raise PlanError(
+                        f"{where}: an all_to_all leg only belongs to an "
+                        f"'a2a' plan — the MoE dispatch/combine exchange "
+                        f"is a permutation, not a reduction/gather "
+                        f"ladder (docs/moe.md)")
+                raise PlanError(
+                    f"{where}: an a2a plan carries only all_to_all "
+                    f"legs, got {leg.primitive!r} — the MoE wire is one "
+                    f"tiled row exchange per direction (docs/moe.md)")
+            if leg.primitive == ALL_TO_ALL and leg.level == FLAT:
+                raise PlanError(
+                    f"{where}: an a2a leg names the LINK CLASS the "
+                    f"expert-parallel hop crosses (ici/dcn/pod) — there "
+                    f"is no flat decomposition of the hvd_ep row "
+                    f"exchange (docs/moe.md)")
+            if (leg.primitive == ALL_TO_ALL and leg.backend == PALLAS
+                    and leg.wire_dtype != INT8):
+                raise PlanError(
+                    f"{where}: backend='pallas' on a payload-dtype a2a "
+                    f"leg — an exact exchange has no leg-local compute; "
+                    f"the fused kernels back the blockwise int8 "
+                    f"quantize/dequant pair only (docs/fused-kernels.md)")
             if leg.backend == PALLAS and leg.primitive == PSUM:
                 raise PlanError(
                     f"{where}: backend='pallas' on a psum leg — the "
@@ -345,6 +373,14 @@ class WirePlan:
                     f"exactly ONE hop (one ppermute leg on one link "
                     f"class) — the pipeline schedule composes hops by "
                     f"issuing one plan per direction, docs/pipeline.md")
+        elif self.collective == "a2a":
+            if len(self.legs) != 1:
+                raise PlanError(
+                    f"illegal a2a plan {self.encode()}: an a2a plan is "
+                    f"exactly ONE exchange (one all_to_all leg on one "
+                    f"link class) — the MoE layer composes the wire by "
+                    f"issuing one plan per direction (dispatch, then "
+                    f"combine), docs/moe.md")
         elif self.collective == "all_gather":
             for i, (level, prim) in enumerate(prims):
                 if prim not in _GATHER_PRIMS and level != FLAT:

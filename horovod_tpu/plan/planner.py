@@ -48,8 +48,9 @@ from typing import List, Optional, Tuple
 
 from ..common import basics
 from ..common.config import _env_bool, _env_int
-from .ir import (ALL_GATHER, DCN, FLAT, ICI, INT8, PALLAS, PAYLOAD, POD,
-                 PSUM, REDUCE_SCATTER, SEND, XLA, Leg, PlanError, WirePlan)
+from .ir import (ALL_GATHER, ALL_TO_ALL, DCN, FLAT, ICI, INT8, PALLAS,
+                 PAYLOAD, POD, PSUM, REDUCE_SCATTER, SEND, XLA, Leg,
+                 PlanError, WirePlan)
 
 _AXIS_LEVEL = {basics.LOCAL_AXIS: ICI, basics.CROSS_AXIS: DCN,
                basics.POD_AXIS: POD}
@@ -215,6 +216,68 @@ def derive_send(*, mesh_shape, quantized: bool = False,
     return send_plan(level, quantized=q, block=block, error_feedback=ef)
 
 
+def a2a_plan(level: str = DCN, *, quantized: bool = False,
+             block: Optional[int] = None,
+             error_feedback: bool = False,
+             fused: bool = False) -> WirePlan:
+    """The MoE dispatch/combine wire (docs/moe.md): a single tiled
+    ``all_to_all`` row exchange on the link class the hvd_ep hop
+    crosses. ``quantized`` rides it blockwise-int8 with optional error
+    feedback — legal on the DCN/pod hops only (the EQuARX placement
+    rule, exactly like the pipeline send leg); ``fused`` backs the int8
+    quantize/dequant pair with the Pallas kernels."""
+    if quantized:
+        leg = Leg(level, ALL_TO_ALL, INT8, block=block,
+                  error_feedback=error_feedback,
+                  backend=_backend(fused))
+    else:
+        leg = Leg(level, ALL_TO_ALL, PAYLOAD)
+    return WirePlan("a2a", (leg,)).validate()
+
+
+def ep_a2a_level(mesh_shape) -> str:
+    """The link class an hvd_ep hop crosses: identical geometry to the
+    pipeline hop — the ep axis leads the mesh, so one hop jumps a whole
+    data mesh and rides the SLOWEST link class present (docs/moe.md)."""
+    return pp_send_level(mesh_shape)
+
+
+def derive_a2a(*, mesh_shape, quantized: bool = False,
+               block: Optional[int] = None,
+               error_feedback: Optional[bool] = None,
+               fused: Optional[bool] = None) -> WirePlan:
+    """Derive the MoE a2a plan for a data mesh: the level comes from
+    :func:`ep_a2a_level`; ``quantized`` is forced off on an ICI hop
+    (int8 is illegal there — compression belongs on slow links)."""
+    level = ep_a2a_level(mesh_shape)
+    q = bool(quantized) and level in (DCN, POD)
+    ef = q if error_feedback is None else (error_feedback and q)
+    return a2a_plan(level, quantized=q, block=block, error_feedback=ef,
+                    fused=_resolve_fused(fused) and q)
+
+
+def predict_a2a_bytes(plan: WirePlan, n: int, itemsize: float,
+                      ep: int) -> List[dict]:
+    """Per-leg predicted wire bytes of ONE a2a exchange of an
+    ``n``-element buffer over ``ep`` expert groups — the same formula
+    :func:`~horovod_tpu.plan.compiler.lower_a2a` charges at trace time
+    (``ep - 1`` of the ``ep`` destination row blocks cross the wire),
+    so predicted == accounted by construction. Row schema matches
+    :func:`predict_leg_bytes`."""
+    (leg,) = plan.legs
+    hop = {ICI: "ici", DCN: "dcn", POD: "pod"}[leg.level]
+    ep = max(1, int(ep))
+    seg = n // ep
+    fp = float(seg) * (ep - 1) * itemsize
+    if leg.wire_dtype == INT8:
+        from .compiler import quant_wire_bytes
+
+        wire = quant_wire_bytes(seg, leg.block or 256) * (ep - 1)
+    else:
+        wire = fp
+    return [{"leg": leg, "hop": hop, "bytes": wire, "fp_bytes": fp}]
+
+
 def pp_bubble_bound(stages: int, microbatches: int) -> float:
     """The no-overlap GPipe analytic bubble bound ``(S-1)/(M+S-1)`` —
     the fraction the perf gate holds every measured pipeline schedule
@@ -327,11 +390,16 @@ def _quant_unit(seg: int, blk: int) -> float:
 
 
 def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
-                      mesh_shape) -> List[dict]:
+                      mesh_shape, *, ep: int = 0) -> List[dict]:
     """Per-leg predicted wire bytes for a payload of ``n`` elements.
     Each row: ``{leg, hop, bytes, fp_bytes}`` where ``hop`` is the link
     class charged (``ici``/``dcn``/``pod``/``-``) and ``fp_bytes`` the
-    same traffic at the payload dtype (differs only on int8 legs)."""
+    same traffic at the payload dtype (differs only on int8 legs).
+    ``ep`` is the expert-group exchange width of an ``a2a`` plan (the
+    hvd_ep axis size — not derivable from the data ``mesh_shape``);
+    a2a rows are zero without it."""
+    if plan.collective == "a2a":
+        return predict_a2a_bytes(plan, n, itemsize, ep)
     nl, nc, npod = _mesh_sizes(mesh_shape)
     world = nl * nc * npod
     isz = itemsize
@@ -508,6 +576,16 @@ class StepPlan:
     pp_microbatches: int = 0
     pp_schedule: str = "interleaved_1f1b"
     pp_interleave: int = 1
+    # Expert parallelism (docs/moe.md): the MoE dispatch/combine wire (a
+    # validated a2a plan; None with MoE off) plus the routing knobs it
+    # compiles under. ``moe_experts`` is the expert-group count E (the
+    # hvd_ep axis size), ``moe_topk`` the per-token expert count K,
+    # ``moe_capacity_factor`` the dispatch-buffer headroom.
+    moe: Optional[WirePlan] = None
+    moe_experts: int = 0
+    moe_topk: int = 0
+    moe_capacity_factor: float = 0.0
+    moe_quantized: bool = False
 
     def encode(self) -> str:
         parts = [self.gradient.encode()]
@@ -519,6 +597,10 @@ class StepPlan:
                 f"pp{self.pp_stages}v{self.pp_interleave}"
                 f"m{self.pp_microbatches}.{self.pp_schedule}"
                 f"@{self.send.encode()}")
+        if self.moe is not None:
+            parts.append(
+                f"ep{self.moe_experts}.k{self.moe_topk}"
+                f"@{self.moe.encode()}")
         return " + ".join(parts)
 
     @property
@@ -611,6 +693,29 @@ class StepPlan:
                     f"{leg.backend:<7} "
                     f"{leg.stream:>6} {int(round(b)):>12} "
                     f"{modeled_ms:>9.4f} {pred_ms:>8.4f}")
+        if self.moe is not None:
+            # The MoE wire, priced PER A2A ISSUE (one dispatch-buffer
+            # exchange over the hvd_ep axis; every MoE layer issues two
+            # of these per step — dispatch, then combine).
+            rows = predict_leg_bytes(self.moe, n, itemsize,
+                                     self.mesh_shape,
+                                     ep=self.moe_experts)
+            plan_cost = _cost.price_plan(self.moe, n, itemsize,
+                                         self.mesh_shape, model,
+                                         ep=self.moe_experts)
+            for li, leg in enumerate(self.moe.legs, start=1):
+                b = sum(r["bytes"] for r in rows if r["leg"] is leg)
+                modeled_ms, pred_ms = plan_cost.by_leg(leg)
+                wire = leg.wire_dtype
+                if leg.wire_dtype == INT8:
+                    wire = f"int8/{leg.block or self.quant_block}"
+                lines.append(
+                    f"{'a2a':<16} {li:>3} {leg.level:<5} "
+                    f"{leg.primitive:<14} {wire:<10} "
+                    f"{'yes' if leg.error_feedback else '-':<3} "
+                    f"{leg.backend:<7} "
+                    f"{leg.stream:>6} {int(round(b)):>12} "
+                    f"{modeled_ms:>9.4f} {pred_ms:>8.4f}")
         red = (tot["fp"] / tot["dcn"]) if tot["dcn"] else None
         totline = (f"totals: ici={int(round(tot['ici']))} "
                    f"dcn={int(round(tot['dcn']))} "
@@ -637,6 +742,14 @@ class StepPlan:
                 f"schedule={self.pp_schedule} "
                 f"gpipe_bubble_bound={bound:.4f} "
                 f"(send rows priced per issue, docs/pipeline.md)")
+        if self.moe is not None:
+            lines.append(
+                f"moe: experts={self.moe_experts} "
+                f"topk={self.moe_topk} "
+                f"capacity_factor={self.moe_capacity_factor:g} "
+                f"quantized={_onoff(self.moe_quantized)} "
+                f"(a2a rows priced per issue — dispatch + combine = 2 "
+                f"per layer, docs/moe.md)")
         sc = _cost.price_step(self, payload_bytes, itemsize=itemsize,
                               mesh_shape=self.mesh_shape, model=model)
         lines.append(
@@ -674,6 +787,10 @@ def describe_plan(
     pp_schedule: Optional[str] = None,
     pp_interleave: Optional[int] = None,
     pp_quantized: Optional[bool] = None,
+    moe_experts: Optional[int] = None,
+    moe_topk: Optional[int] = None,
+    moe_capacity: Optional[float] = None,
+    moe_quantized: Optional[bool] = None,
 ) -> StepPlan:
     """Resolve today's knob combination into its :class:`StepPlan` — the
     debug view of what the gradient wire will compile to.
@@ -703,6 +820,12 @@ def describe_plan(
         if pp_interleave is None:
             pp_interleave = getattr(tuned_params, "pp_interleave",
                                     None) or None
+        if moe_capacity is None:
+            moe_capacity = getattr(tuned_params, "moe_capacity_factor",
+                                   0.0) or None
+        if moe_quantized is None and getattr(
+                tuned_params, "moe_capacity_factor", 0.0):
+            moe_quantized = getattr(tuned_params, "moe_quantized", None)
     cfg = basics.config() if basics.is_initialized() else None
     if quantized is None:
         quantized = (cfg.quantized_allreduce if cfg is not None
@@ -758,6 +881,23 @@ def describe_plan(
     if pp_quantized is None:
         pp_quantized = (cfg.pp_quantized if cfg is not None
                         else _env_bool("HOROVOD_PP_QUANTIZED", False))
+    if moe_experts is None:
+        if basics.is_initialized() and basics.mesh() is not None \
+                and basics.ep_size() > 1:
+            moe_experts = basics.ep_size()
+        else:
+            moe_experts = (cfg.moe_experts if cfg is not None
+                           else _env_int("HOROVOD_MOE_EXPERTS", 0))
+    moe_experts = int(moe_experts or 0)
+    if moe_topk is None:
+        moe_topk = (cfg.moe_topk if cfg is not None
+                    else _env_int("HOROVOD_MOE_TOPK", 2))
+    if moe_capacity is None:
+        moe_capacity = (cfg.moe_capacity_factor if cfg is not None
+                        else 1.25)
+    if moe_quantized is None:
+        moe_quantized = (cfg.moe_quantized if cfg is not None
+                         else _env_bool("HOROVOD_MOE_QUANTIZED", False))
     fused = _resolve_fused(fused)
     quantized_pod = _resolve_quantized_pod(quantized_pod)
     nl, nc, npod = _mesh_sizes(mesh_shape)
@@ -790,7 +930,20 @@ def describe_plan(
         send = derive_send(mesh_shape=mesh_shape,
                            quantized=bool(pp_quantized),
                            block=quant_block if pp_quantized else None)
+    moe = None
+    if moe_experts > 1:
+        moe = derive_a2a(mesh_shape=mesh_shape,
+                         quantized=bool(moe_quantized),
+                         block=quant_block if moe_quantized else None,
+                         fused=fused)
     return StepPlan(
+        moe=moe,
+        moe_experts=moe_experts if moe_experts > 1 else 0,
+        moe_topk=int(moe_topk) if moe_experts > 1 else 0,
+        moe_capacity_factor=(float(moe_capacity)
+                             if moe_experts > 1 else 0.0),
+        moe_quantized=(bool(moe_quantized) and moe is not None
+                       and moe.is_quantized),
         send=send,
         pp_stages=pp_stages if pp_stages > 1 else 0,
         pp_microbatches=int(pp_microbatches) if pp_stages > 1 else 0,
@@ -820,11 +973,12 @@ def describe_plan(
 _PLAN_RE = re.compile(
     r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
     r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)"
-    r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+))?$")
+    r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+))?"
+    r"(\|moe(?P<moecap>[0-9.]+)/(?P<moeq>q8|fp))?$")
 
 
 def encode_tuned(params, *, quantized: bool = False,
-                 pp: bool = False) -> str:
+                 pp: bool = False, moe: bool = False) -> str:
     """Compact plan encoding of a ``TunedParams``-like knob set: gradient
     leg order | DCN hop wire dtype | stream count | placement
     [| kernel backend]. E.g. ``ar.tree|int8/256|s2|ovl`` or
@@ -859,6 +1013,16 @@ def encode_tuned(params, *, quantized: bool = False,
         m = int(getattr(params, "pp_microbatches", 0) or 0)
         v = max(1, int(getattr(params, "pp_interleave", 1) or 1))
         enc += f"|pp{m}/{v}"
+    if moe:
+        # Schema v9 (docs/moe.md): the MoE routing knobs — dispatch
+        # capacity factor / a2a wire dtype — join the plan encoding only
+        # when the session's step carries an MoE layer; with moe off
+        # both are dead knobs and drop out (one trial, not four).
+        cap = float(getattr(params, "moe_capacity_factor", 0.0) or 0.0)
+        if cap <= 0.0:
+            cap = 1.25  # the config default: moe on needs a capacity
+        q = "q8" if getattr(params, "moe_quantized", False) else "fp"
+        enc += f"|moe{cap:g}/{q}"
     return enc
 
 
@@ -896,6 +1060,9 @@ class PricedPlan:
                 "params": self.params.as_dict()}
 
 
+_DEFAULT_MOE_CAPS = (1.0, 1.25, 1.5, 2.0)
+
+
 def enumerate_tuned(*, quantized: bool = False,
                     tune_hierarchical: bool = True,
                     tune_zero: bool = False,
@@ -904,6 +1071,8 @@ def enumerate_tuned(*, quantized: bool = False,
                     tune_pp: bool = False,
                     pp_stages: int = 0,
                     pp_max_interleave: int = 1,
+                    tune_moe: bool = False,
+                    moe_experts: int = 0,
                     initial=None,
                     thresholds=None,
                     blocks=None) -> list:
@@ -939,6 +1108,19 @@ def enumerate_tuned(*, quantized: bool = False,
     else:
         ppm_opts = (initial.pp_microbatches,)
         ppv_opts = (initial.pp_interleave,)
+    if tune_moe and moe_experts > 1:
+        # MoE candidates (docs/moe.md): the capacity/wire tradeoff the
+        # cost model prices — a higher capacity factor drops fewer
+        # tokens but moves a proportionally bigger dispatch buffer; the
+        # int8 a2a wire buys bytes at quantize-kernel cost.
+        init_cap = float(getattr(initial, "moe_capacity_factor", 0.0)
+                         or 0.0)
+        cap_opts = sorted(set(_DEFAULT_MOE_CAPS)
+                          | ({init_cap} if init_cap > 0 else set()))
+        moeq_opts = (False, True)
+    else:
+        cap_opts = (getattr(initial, "moe_capacity_factor", 0.0),)
+        moeq_opts = (getattr(initial, "moe_quantized", False),)
     out, seen = [], set()
     for thr in thr_opts:
         for blk in blk_opts:
@@ -967,23 +1149,30 @@ def enumerate_tuned(*, quantized: bool = False,
                             for fz in fz_opts:
                                 for ppm in ppm_opts:
                                     for ppv in ppv_opts:
-                                        p = TunedParams(
-                                            fusion_threshold_bytes=thr,
-                                            quant_block=blk,
-                                            hierarchical_allreduce=hier,
-                                            zero_stage=stage,
-                                            overlap=ovl,
-                                            num_comm_streams=s,
-                                            fused=fz,
-                                            pp_microbatches=ppm,
-                                            pp_interleave=ppv)
-                                        key = (thr, blk, encode_tuned(
-                                            p, quantized=quantized,
-                                            pp=tune_pp))
-                                        if key in seen:
-                                            continue
-                                        seen.add(key)
-                                        out.append(p)
+                                        for cap in cap_opts:
+                                            for mq in moeq_opts:
+                                                p = TunedParams(
+                                                    fusion_threshold_bytes=thr,
+                                                    quant_block=blk,
+                                                    hierarchical_allreduce=hier,
+                                                    zero_stage=stage,
+                                                    overlap=ovl,
+                                                    num_comm_streams=s,
+                                                    fused=fz,
+                                                    pp_microbatches=ppm,
+                                                    pp_interleave=ppv,
+                                                    moe_capacity_factor=cap,
+                                                    moe_quantized=mq)
+                                                key = (thr, blk,
+                                                       encode_tuned(
+                                                           p,
+                                                           quantized=quantized,
+                                                           pp=tune_pp,
+                                                           moe=tune_moe))
+                                                if key in seen:
+                                                    continue
+                                                seen.add(key)
+                                                out.append(p)
     return out
 
 
@@ -994,6 +1183,7 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
               tune_overlap: bool = False, tune_fused: bool = False,
               tune_pp: bool = False, pp_stages: int = 0,
               pp_max_interleave: int = 1,
+              tune_moe: bool = False, moe_experts: int = 0,
               initial=None, thresholds=None, blocks=None) -> list:
     """Enumerate, validate, and PRICE the legal plan space for a knob
     set, returning :class:`PricedPlan` rows ranked by predicted step-
@@ -1022,6 +1212,7 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
                              tune_fused=tune_fused,
                              tune_pp=tune_pp, pp_stages=pp_stages,
                              pp_max_interleave=pp_max_interleave,
+                             tune_moe=tune_moe, moe_experts=moe_experts,
                              initial=initial,
                              thresholds=thresholds, blocks=blocks):
         try:
@@ -1029,17 +1220,23 @@ def shortlist(payload_bytes: float, *, itemsize: float = 4.0,
                                mesh_shape=mesh_shape,
                                quantized_pod=False,
                                pp_stages=(pp_stages if tune_pp
-                                          else None))
+                                          else None),
+                               moe_experts=(moe_experts if tune_moe
+                                            else 0),
+                               moe_quantized=(p.moe_quantized
+                                              if tune_moe else None))
         except PlanError:
             continue  # illegal composition: not a candidate
-        # Dedup on the DERIVED wire (plus the threshold and ZeRO
-        # stage, which the encoding does not carry — stages 1/2 share a
-        # wire but restructure the accumulator): knobs dead in this
-        # knob set's derivation (e.g. hierarchical under a quantized
-        # 2-level wire) must not spend two shortlist rows on one
-        # compiled program.
+        # Dedup on the DERIVED wire (plus the threshold, ZeRO stage,
+        # and MoE capacity factor, which the encoding does not carry —
+        # stages 1/2 share a wire but restructure the accumulator, and
+        # the capacity factor reshapes the dispatch buffer): knobs dead
+        # in this knob set's derivation (e.g. hierarchical under a
+        # quantized 2-level wire) must not spend two shortlist rows on
+        # one compiled program.
         key = (sp.encode(), int(p.fusion_threshold_bytes),
-               int(p.zero_stage))
+               int(p.zero_stage),
+               float(p.moe_capacity_factor) if tune_moe else 0.0)
         if key in seen:
             continue
         seen.add(key)
@@ -1071,6 +1268,8 @@ def decode_tuned(encoding: str) -> dict:
         "fused": m.group("fused") is not None,
         "pp_microbatches": int(m.group("ppm") or 0),
         "pp_interleave": int(m.group("ppv") or 1),
+        "moe_capacity_factor": float(m.group("moecap") or 0.0),
+        "moe_quantized": m.group("moeq") == "q8",
     }
     if out["quantized"]:
         out["quant_block"] = int(m.group("wire").split("/", 1)[1])

@@ -151,7 +151,8 @@ class GenerationEngine:
                  *, devices: Optional[Sequence] = None,
                  mesh: Optional[Mesh] = None, tp_axis=None,
                  eos_id: int = 1, temperature: float = 0.0,
-                 seed: int = 0, name: str = "replica0") -> None:
+                 seed: int = 0, name: str = "replica0",
+                 moe_experts: int = 0, expert_router=None) -> None:
         import dataclasses
 
         if mesh is None:
@@ -184,6 +185,19 @@ class GenerationEngine:
         self.sched = Scheduler(page_config)
         self.slots: Dict[int, _SlotState] = {}
         self.stats = ServeStats()
+        # Expert-parallel decode accounting (docs/moe.md): with
+        # ``moe_experts`` > 0 every consumed token is attributed to its
+        # routed expert — ``expert_router(token_id) -> expert`` (default:
+        # the deterministic ``token % E`` proxy, replaced by the model's
+        # real router when the served model is MoE) — feeding the
+        # per-expert ``serve.expert_tokens{expert}`` load histograms the
+        # hot-expert replication layer (replica.py) reads.
+        self.moe_experts = max(0, int(moe_experts))
+        self._expert_router = expert_router or (
+            (lambda tok: int(tok) % self.moe_experts)
+            if self.moe_experts else None)
+        self.expert_tokens = (np.zeros((self.moe_experts,), np.int64)
+                              if self.moe_experts else None)
 
         stacked, repl = tp_split_params(params, tp)
         stk_spec = P(tp_axis) if tp > 1 else P()
@@ -320,6 +334,18 @@ class GenerationEngine:
         self.stats.prefill_tokens += n_prefill
         self.stats.decode_tokens += n_decode
         self.stats.steps += 1
+        if self.moe_experts:
+            # Per-expert load this step: one histogram observation per
+            # expert that saw traffic (the registry's log2 buckets give
+            # the load distribution; the count is the step total).
+            step_load = np.zeros((self.moe_experts,), np.int64)
+            for slot in self.slots:
+                step_load[self._expert_router(int(tokens[slot]))] += 1
+            self.expert_tokens += step_load
+            for e in np.nonzero(step_load)[0]:
+                _metrics.histogram("serve.expert_tokens",
+                                   expert=str(int(e))).observe(
+                    float(step_load[e]))
         _metrics.counter("serve.steps").inc()
         _metrics.counter("serve.prefill_tokens").inc(n_prefill)
         _metrics.counter("serve.decode_tokens").inc(n_decode)

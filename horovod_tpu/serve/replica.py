@@ -42,7 +42,11 @@ class ReplicaSet:
     def __init__(self, cfg, params, page_config: PageConfig, *,
                  devices: Optional[Sequence] = None, n_replicas: int = 1,
                  eos_id: int = 1, temperature: float = 0.0,
-                 seed: int = 0) -> None:
+                 seed: int = 0, moe_experts: int = 0,
+                 expert_router=None, hot_expert_factor: float = 2.0,
+                 rebalance_every: int = 8) -> None:
+        import numpy as np
+
         self.cfg = cfg
         self.params = params
         self.page_config = page_config
@@ -55,6 +59,26 @@ class ReplicaSet:
         self.stats = ServeStats()
         self.resize_events: List[Dict] = []
         self.engines: List[GenerationEngine] = []
+        # Hot-expert replication (docs/moe.md): with ``moe_experts`` > 0
+        # each request is affinity-routed to its primary expert's home
+        # engine(s). An expert whose cumulative token share exceeds
+        # ``hot_expert_factor`` x the fair share (1/E) is HOT: its
+        # engine set grows by one replica per ``rebalance_experts``
+        # pass, spreading a skewed expert's traffic over more engines —
+        # the serving answer to "MoE routing under load"
+        # (docs/serving.md).
+        self.moe_experts = max(0, int(moe_experts))
+        self._expert_router = expert_router or (
+            (lambda tok: int(tok) % self.moe_experts)
+            if self.moe_experts else None)
+        self.hot_expert_factor = float(hot_expert_factor)
+        self.rebalance_every = max(1, int(rebalance_every))
+        self.expert_replicas = (np.ones((self.moe_experts,), np.int64)
+                                if self.moe_experts else None)
+        self.hot_expert_events: List[Dict] = []
+        self._drained_expert_tokens = (
+            np.zeros((self.moe_experts,), np.int64)
+            if self.moe_experts else None)
         self._build(n_replicas)
 
     @property
@@ -73,8 +97,17 @@ class ReplicaSet:
                 self.cfg, self.params, self.page_config,
                 devices=self.devices[i * per:(i + 1) * per],
                 eos_id=self.eos_id, temperature=self.temperature,
-                seed=self.seed + i, name=f"replica{i}")
+                seed=self.seed + i, name=f"replica{i}",
+                moe_experts=self.moe_experts,
+                expert_router=self._expert_router)
             for i in range(n_replicas)]
+        if self.expert_replicas is not None:
+            # New partition: replication counts re-clamp to what it can
+            # hold (an expert cannot span more engines than exist).
+            import numpy as np
+
+            self.expert_replicas = np.minimum(
+                self.expert_replicas, len(self.engines))
 
     # -- dispatch ---------------------------------------------------------
 
@@ -91,14 +124,81 @@ class ReplicaSet:
     def has_work(self) -> bool:
         return bool(self.queue) or any(e.has_work for e in self.engines)
 
+    def _engine_set(self, expert: int) -> List[int]:
+        """The engine indices serving ``expert``: the home engine
+        (``expert % n_replicas``) plus one neighbor per replication
+        increment the rebalancer granted."""
+        n = self.n_replicas
+        reps = int(self.expert_replicas[expert])
+        return [(expert + i) % n for i in range(min(reps, n))]
+
     def _dispatch(self, now: float) -> None:
         """Feed due arrivals to the least-loaded replica (queue depth +
-        in-flight); FIFO within the global queue."""
+        in-flight); FIFO within the global queue. With MoE on, a request
+        is affinity-routed to its primary expert's engine set (grown by
+        hot-expert replication) — least-loaded WITHIN the set."""
         while self.queue and self.queue[0].arrival_time <= now:
             req = self.queue.pop(0)
-            eng = min(self.engines,
-                      key=lambda e: e.queue_depth() + e.in_flight())
+            if self.moe_experts and req.prompt:
+                expert = self._expert_router(int(req.prompt[0]))
+                idxs = self._engine_set(expert)
+                eng = min((self.engines[i] for i in idxs),
+                          key=lambda e: e.queue_depth() + e.in_flight())
+            else:
+                eng = min(self.engines,
+                          key=lambda e: e.queue_depth() + e.in_flight())
             eng.submit(req)
+
+    # -- hot-expert replication -------------------------------------------
+
+    def expert_load(self):
+        """Cumulative per-expert token counts across the fleet
+        (resize-survivor: drained engines fold their counts in)."""
+        if not self.moe_experts:
+            return None
+        load = self._drained_expert_tokens.copy()
+        for eng in self.engines:
+            if eng.expert_tokens is not None:
+                load += eng.expert_tokens
+        return load
+
+    def rebalance_experts(self, now: float = 0.0) -> List[int]:
+        """One replication pass: every expert whose cumulative token
+        share exceeds ``hot_expert_factor / moe_experts`` and is not yet
+        fleet-wide gains one engine replica. Returns the experts grown
+        this pass (docs/moe.md)."""
+        if not self.moe_experts or self.n_replicas < 2:
+            return []
+        load = self.expert_load()
+        total = float(load.sum())
+        if total <= 0:
+            return []
+        from ..monitor import registry as _metrics
+
+        tl = basics._state.timeline if basics.is_initialized() else None
+        gate = self.hot_expert_factor / self.moe_experts
+        grown: List[int] = []
+        for e in range(self.moe_experts):
+            share = float(load[e]) / total
+            _metrics.gauge("serve.expert_share", expert=str(e)).set(share)
+            if share > gate and \
+                    int(self.expert_replicas[e]) < self.n_replicas:
+                self.expert_replicas[e] += 1
+                grown.append(e)
+                _metrics.counter("serve.hot_expert_replications",
+                                 expert=str(e)).inc()
+                self.hot_expert_events.append(
+                    {"time": now, "expert": e, "share": round(share, 4),
+                     "replicas": int(self.expert_replicas[e])})
+                if tl is not None:
+                    tl.instant(
+                        f"SERVE:EXPERT_REPLICATE expert{e} "
+                        f"share{share:.2f} "
+                        f"x{int(self.expert_replicas[e])}", tid="serve")
+        for e in range(self.moe_experts):
+            _metrics.gauge("serve.expert_replicas", expert=str(e)).set(
+                float(self.expert_replicas[e]))
+        return grown
 
     def step_all(self, now: float) -> int:
         self._dispatch(now)
@@ -120,6 +220,8 @@ class ReplicaSet:
         for eng in self.engines:
             self.stats.merge(eng.stats)
             eng.stats = ServeStats()
+            if self.moe_experts and eng.expert_tokens is not None:
+                self._drained_expert_tokens += eng.expert_tokens
             migrated.extend(eng.drain())
         in_flight = sum(1 for r in migrated if r.resizes)
         self.queue[:0] = migrated
@@ -161,6 +263,8 @@ class ReplicaSet:
                 self.resize(resize_plan[i], now)
             if autoscaler is not None:
                 autoscaler.poll(now)
+            if self.moe_experts and i and i % self.rebalance_every == 0:
+                self.rebalance_experts(now)
             if self.step_all(now) == 0 and not isinstance(
                     clock, VirtualClock):
                 _time.sleep(1e-3)

@@ -24,9 +24,16 @@ Exit 0 = within tolerance, 1 = regression, 2 = usage/baseline error.
 Every verdict is ALSO appended as a metrics-JSONL snapshot (the same
 schema the monitor registry's JsonlSink writes, so obs_report.py and any
 JSONL consumer can query the gate history) to PERF_GATE_METRICS_JSONL
-(default: perf_gate_metrics.jsonl in the repo root): per-leg measured vs
+(default: .perf_gate/metrics.jsonl — a gitignored directory, so the
+artifact can never land in the repo root again): per-leg measured vs
 baseline gauges, the tolerance, and pass/fail — regressions become
 queryable data, not just an exit code.
+
+moe leg (``--moe`` A/B): hard-gates the forced-routing parity probe,
+the dropped-token fraction (<= PERF_GATE_MOE_DROPPED, default 0.25),
+and the a2a predicted-vs-modeled wire-ms drift (<=
+PERF_GATE_COST_DRIFT) — then throughput vs the trajectory
+(docs/moe.md).
 """
 
 import glob
@@ -51,9 +58,10 @@ def write_verdict_snapshot():
     """One metrics snapshot (monitor-registry schema) per gate run."""
     path = os.environ.get(
         "PERF_GATE_METRICS_JSONL",
-        os.path.join(REPO, "perf_gate_metrics.jsonl"))
+        os.path.join(REPO, ".perf_gate", "metrics.jsonl"))
     if not path or path == "0":
         return
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     from horovod_tpu.monitor import JsonlSink, MetricsRegistry
 
     reg = MetricsRegistry(enabled=True)
@@ -326,6 +334,67 @@ def _main():
                   f"vs cap {drift_tol}) -> "
                   f"{'OK' if within else 'REGRESSION'}")
             record_verdict("pp", "send_wire_ms_drift", drift, drift_tol,
+                           drift_tol, within)
+            ok &= within
+        if not ok:
+            return 1
+        # fall through: throughput still gates against the trajectory
+
+    if leg == "moe":
+        # MoE leg (docs/moe.md): three hard gates — (1) the
+        # forced-routing parity probe within its documented tolerance,
+        # (2) dropped-token fraction at or under PERF_GATE_MOE_DROPPED
+        # (default 0.25 — the capacity factor must actually carry the
+        # traffic), (3) the a2a predicted-vs-measured wire-ms drift
+        # within the PERF_GATE_COST_DRIFT contract — then throughput
+        # gates against the trajectory like a train leg.
+        ok = True
+        par = rec.get("parity_rel_err")
+        ptol = rec.get("parity_tol", 1e-5)
+        if par is None or par > ptol:
+            print(f"perf gate [moe]: parity {par} exceeds tolerance "
+                  f"{ptol} — hard fail")
+            record_verdict("moe", "parity_rel_err", par or -1, ptol, tol,
+                           False)
+            ok = False
+        else:
+            record_verdict("moe", "parity_rel_err", par, ptol, tol, True)
+        dropped = rec.get("dropped_token_fraction")
+        dcap = float(os.environ.get("PERF_GATE_MOE_DROPPED", "0.25"))
+        if dropped is None or dropped > dcap:
+            print(f"perf gate [moe dropped]: fraction {dropped} vs cap "
+                  f"{dcap} — hard fail")
+            record_verdict("moe", "dropped_token_fraction",
+                           dropped if dropped is not None else -1, dcap,
+                           tol, False)
+            ok = False
+        else:
+            print(f"perf gate [moe dropped]: fraction {dropped:.4f} <= "
+                  f"cap {dcap} -> OK")
+            record_verdict("moe", "dropped_token_fraction", dropped,
+                           dcap, tol, True)
+        if float(rec.get("a2a_bytes") or 0) <= 0:
+            print("perf gate [moe]: zero a2a wire bytes — the expert "
+                  "exchange never engaged — hard fail")
+            record_verdict("moe", "a2a_bytes", 0, 1, tol, False)
+            ok = False
+        wm = rec.get("wire_ms") or {}
+        pred, mod = wm.get("predicted"), wm.get("modeled")
+        drift_tol = float(os.environ.get("PERF_GATE_COST_DRIFT", "0.25"))
+        if pred is None or mod is None or mod <= 0:
+            print(f"perf gate [moe]: record lacks the a2a wire_ms pair "
+                  f"({wm}) — hard fail")
+            record_verdict("moe", "a2a_wire_ms_present", 0, 1, drift_tol,
+                           False)
+            ok = False
+        else:
+            drift = abs(pred - mod) / mod
+            within = drift <= drift_tol
+            print(f"perf gate [moe a2a drift]: predicted {pred:.4f} ms "
+                  f"vs measured-model {mod:.4f} ms (|drift| {drift:.3f} "
+                  f"vs cap {drift_tol}) -> "
+                  f"{'OK' if within else 'REGRESSION'}")
+            record_verdict("moe", "a2a_wire_ms_drift", drift, drift_tol,
                            drift_tol, within)
             ok &= within
         if not ok:

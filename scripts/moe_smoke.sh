@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Expert-parallel MoE smoke (docs/moe.md): the `bench.py --moe` A/B on
+# the 8-device virtual CPU mesh — a dedicated hvd_ep mesh axis of 4
+# expert groups, dispatch/combine lowered as wire-plan a2a legs.
+#
+# Asserts: rc 0 (the bench itself hard-fails on forced-routing parity
+# loss), a passed parity probe, nonzero `comm.moe.bytes{hop}` /
+# a2a_bytes accounting, a populated per-expert load histogram, a bounded
+# dropped-token fraction, zero a2a cost-model drift, and balanced MOE:*
+# spans in a timeline probe. Runtime ~1 min.
+#
+# Usage: scripts/moe_smoke.sh [extra bench.py args...]
+#   MOE_SMOKE_KNOBS="--quantized" scripts/moe_smoke.sh   # int8+EF a2a
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+TL_DIR=$(mktemp -d)
+trap 'rm -rf "$TL_DIR"' EXIT
+
+OUT=$(JAX_PLATFORMS=cpu HOROVOD_TIMELINE="$TL_DIR/moe_timeline.json" \
+    python bench.py --moe 4 ${MOE_SMOKE_KNOBS:-} \
+    --platform cpu --cpu-devices 8 \
+    --num-iters 2 --num-batches-per-iter 2 \
+    "$@" | tail -n 1)
+echo "$OUT"
+
+python - "$OUT" "$TL_DIR/moe_timeline.json" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"].startswith("moe"), rec["metric"]
+assert rec["parity_rel_err"] <= rec["parity_tol"], \
+    f"parity failed: {rec['parity_rel_err']} > {rec['parity_tol']}"
+assert rec["a2a_bytes"] > 0, "zero a2a wire bytes"
+assert rec["a2a_calls"] >= 2, "dispatch+combine never both engaged"
+counters = rec["metrics_snapshot"]["counters"]
+moe_bytes = {k: v for k, v in counters.items()
+             if k.startswith("comm.moe.bytes")}
+assert moe_bytes and all(v > 0 for v in moe_bytes.values()), \
+    f"comm.moe.bytes missing or zero: {moe_bytes}"
+load = {k: v for k, v in counters.items()
+        if k.startswith("moe.expert_tokens")}
+assert len(load) == rec["moe"]["experts"] and sum(load.values()) > 0, \
+    f"expert-load histogram not populated: {load}"
+assert rec["dropped_token_fraction"] <= 0.25, \
+    f"dropped fraction {rec['dropped_token_fraction']} > 0.25"
+drift = abs(rec["wire_ms"]["predicted"] - rec["wire_ms"]["modeled"]) \
+    / max(1e-9, rec["wire_ms"]["modeled"])
+assert drift <= 0.25, f"a2a cost-model drift {drift}"
+
+# MOE:* spans balance in the timeline (strict vocabulary check).
+from horovod_tpu.monitor import span_audit
+
+audit = span_audit.audit_spans(sys.argv[2], prefix="MOE:",
+                               require_balanced=True,
+                               require_spans=True, strict=True)
+n = sum(audit.count.values())
+assert audit.count.get("MOE:DISPATCH", 0) > 0, audit.count
+assert audit.count.get("MOE:COMBINE", 0) > 0, audit.count
+print(f"moe smoke OK: parity {rec['parity_rel_err']:.2e}, "
+      f"{rec['a2a_calls']} a2a exchanges "
+      f"({rec['a2a_bytes'] / 1e3:.1f} kB/step/dev), dropped "
+      f"{rec['dropped_token_fraction']:.4f}, drift {drift:.4f}, "
+      f"{n} balanced MOE spans, load {rec['expert_load']}")
+EOF

@@ -29,6 +29,10 @@
 #                     parity + bubble <= PERF_GATE_PP_BUBBLE x the
 #                     GPipe analytic bound + send-leg wire-ms drift
 #                     (docs/pipeline.md)
+#   PERF_GATE_LEGS="moe" scripts/perf_gate.sh   # expert-parallel MoE:
+#                     forced-routing parity + dropped-token fraction
+#                     <= PERF_GATE_MOE_DROPPED + a2a wire-ms drift
+#                     (docs/moe.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -42,9 +46,10 @@
 # the train leg.
 #
 # Every verdict is also appended as a metrics-JSONL snapshot to
-# PERF_GATE_METRICS_JSONL (default perf_gate_metrics.jsonl; set to 0 to
-# disable): per-leg measured/baseline/tolerance gauges + pass/fail, so
-# the regression history is queryable data (docs/observability.md).
+# PERF_GATE_METRICS_JSONL (default .perf_gate/metrics.jsonl — a
+# gitignored directory; set to 0 to disable): per-leg measured/baseline/
+# tolerance gauges + pass/fail, so the regression history is queryable
+# data (docs/observability.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -130,8 +135,18 @@ for leg in $LEGS; do
                 --model resnet18 --batch-size 2 --image-size 64 \
                 --num-warmup 1 --num-iters 3 --num-batches-per-iter 2
             ;;
+        moe)
+            # Expert-parallel MoE gate (docs/moe.md): the --moe A/B
+            # hard-checks its own forced-routing parity; the checker
+            # re-asserts it plus dropped-token fraction and the a2a
+            # predicted-vs-measured wire-ms drift, then throughput vs
+            # the trajectory.
+            run_leg moe --moe 4 --quantized \
+                --platform cpu --cpu-devices 8 \
+                --num-iters 2 --num-batches-per-iter 2
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp)" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp|moe)" >&2
             exit 2
             ;;
     esac

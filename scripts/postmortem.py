@@ -125,6 +125,7 @@ def _summarize_rank(dumps):
         "stalled": latest.get("stalled", []),
         "faults": faults,
         "straggler": latest.get("straggler", []),
+        "expert_load": latest.get("expert_load") or {},
         "extra": latest.get("extra"),
     }
 
@@ -161,6 +162,19 @@ def build_report(directory):
     for r in ranks.values():
         straggler_history.extend(r["straggler"])
     straggler_history.sort(key=lambda d: d.get("ts", 0.0))
+    # Per-expert load (docs/moe.md): merge every rank's expert_load so
+    # the postmortem can NAME the hot expert a skewed run died under.
+    expert_load = {}
+    for r in ranks.values():
+        for e, tokens in (r.get("expert_load") or {}).items():
+            expert_load[e] = expert_load.get(e, 0.0) + float(tokens)
+    hot_expert = None
+    if expert_load:
+        total = sum(expert_load.values())
+        if total > 0:
+            e, tokens = max(expert_load.items(), key=lambda kv: kv[1])
+            hot_expert = {"expert": e, "tokens": tokens,
+                          "share": round(tokens / total, 4)}
     return {
         "directory": os.path.abspath(directory),
         "dumps": len(dumps),
@@ -172,6 +186,8 @@ def build_report(directory):
         "crashed_ranks": crashed,
         "diverged_ranks": laggards,
         "straggler_history": straggler_history,
+        "expert_load": expert_load,
+        "hot_expert": hot_expert,
     }
 
 
@@ -207,6 +223,11 @@ def print_report(r):
         w(f"  divergence at step {r['divergence_step']}: "
           f"{', '.join(r['diverged_ranks'])} never completed it "
           f"(furthest rank reached {r['max_step']})")
+    if r.get("hot_expert"):
+        he = r["hot_expert"]
+        w(f"  hot expert: expert {he['expert']} carried "
+          f"{he['share']:.0%} of the MoE load "
+          f"({he['tokens']:.0f} tokens) — docs/moe.md")
     if r["straggler_history"]:
         w("")
         w("-- straggler history (pre-crash) --")

@@ -290,12 +290,12 @@ class TestPlanSchemaV5:
         monkeypatch.setenv("HOROVOD_AUTOTUNE_CACHE",
                            str(tmp_path / "cache.json"))
         TestSession._reset_kernel_cache()
-        key = cache_key_for("v8-schema-probe")
+        key = cache_key_for("v9-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        # v8: the pipeline pair joins TunedParams (docs/pipeline.md);
-        # v7 added the geometry-fingerprinted key + stored predicted_ms
-        # (docs/cost-model.md).
-        assert key.endswith("|v8")
+        # v9: the MoE pair joins TunedParams (docs/moe.md); v8 added
+        # the pipeline pair (docs/pipeline.md); v7 the geometry-
+        # fingerprinted key + stored predicted_ms (docs/cost-model.md).
+        assert key.endswith("|v9")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)
@@ -499,13 +499,14 @@ class TestWarmStart:
 class TestCacheSchemaV7:
     """v7 = geometry-fingerprinted keys + stored predicted_ms
     (docs/cost-model.md); v8 = the pipeline pair (docs/pipeline.md);
-    reads stay tolerant of older entries."""
+    v9 = the MoE pair (docs/moe.md); reads stay tolerant of older
+    entries."""
 
     def test_key_carries_geometry_fingerprint(self):
         key = cache_key_for("geo-probe")
         geo = basics.mesh_geometry()
         assert f"|{geo}|" in key
-        assert key.endswith("|v8")
+        assert key.endswith("|v9")
 
     def test_load_tolerant_of_v6_entry(self, tmp_path, monkeypatch):
         from horovod_tpu.ops import kernel_autotune
