@@ -210,6 +210,7 @@ from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import checkpoint  # noqa: F401  (async rank-sharded save/restore)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)
 from . import monitor  # noqa: F401  (metrics registry / sinks / span audit)
+from . import resilience  # noqa: F401  (failure-policy supervisor)
 from .monitor import (  # noqa: F401
     dump_flight_record,
     metrics,

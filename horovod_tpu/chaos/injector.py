@@ -4,8 +4,16 @@ Call sites across the framework name their hazard points and call
 ``chaos.inject(point, **ctx)``; with no plan active that is a single flag
 check. With a plan active, the injector deterministically decides whether
 any rule fires (see :mod:`horovod_tpu.chaos.plan` for the decision
-contract), performs ``crash``/``drop``/``delay``/``stall`` inline, and
-hands ``dup``/``flap`` back to the call site to interpret.
+contract), performs ``crash``/``drop``/``delay``/``stall``/``preempt``
+inline, and hands ``dup``/``flap`` back to the call site to interpret.
+
+``preempt`` models a spot/maintenance eviction: the injector delivers
+SIGTERM to its own process, after an optional ``secs`` grace delay (on a
+daemon thread, so the training step that tripped the rule keeps running
+through its grace window — exactly how cloud preemption notices arrive).
+What happens next is up to the installed SIGTERM handler; under the
+flight recorder + resilience supervisor that is a deadline-budgeted
+priority snapshot, then a flight dump, then signal re-delivery.
 
 Registered injection points (ctx keys each site provides):
 
@@ -30,6 +38,7 @@ from __future__ import annotations
 import logging
 import os
 import random
+import signal
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -41,6 +50,7 @@ from .plan import (
     ACTION_DROP,
     ACTION_DUP,
     ACTION_FLAP,
+    ACTION_PREEMPT,
     ACTION_STALL,
     FaultPlan,
 )
@@ -149,6 +159,25 @@ class ChaosInjector:
                 f"chaos: injected drop at {point} (where={where})")
         if action in (ACTION_DELAY, ACTION_STALL):
             time.sleep(spec.secs)
+            return None
+        if action == ACTION_PREEMPT:
+            # A spot eviction notice: SIGTERM to self, optionally after a
+            # `secs` grace delay on a daemon thread so the call site (and
+            # its step) keeps running through the grace window. Delivery
+            # via os.kill routes through whatever handler is installed —
+            # the resilience supervisor's priority-snapshot path when the
+            # job is supervised, plain termination otherwise.
+            def _deliver() -> None:
+                try:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                except Exception:
+                    pass
+            if spec.secs > 0:
+                t = threading.Timer(spec.secs, _deliver)
+                t.daemon = True
+                t.start()
+            else:
+                _deliver()
             return None
         return action  # dup / flap: the call site interprets
 

@@ -38,12 +38,13 @@ ACTION_CRASH = "crash"    # os._exit — a hard worker death, no cleanup
 ACTION_DROP = "drop"      # raise FaultInjectedError (a ConnectionError)
 ACTION_DELAY = "delay"    # sleep `secs`
 ACTION_STALL = "stall"    # sleep `secs`; semantically a hang, not jitter
+ACTION_PREEMPT = "preempt"  # SIGTERM to self after a `secs` grace delay
 #: Actions returned to the call site for interpretation.
 ACTION_DUP = "dup"        # RPC client: deliver the request twice
 ACTION_FLAP = "flap"      # discovery: report an empty host set
 
 ACTIONS = (ACTION_CRASH, ACTION_DROP, ACTION_DELAY, ACTION_STALL,
-           ACTION_DUP, ACTION_FLAP)
+           ACTION_PREEMPT, ACTION_DUP, ACTION_FLAP)
 
 
 @dataclasses.dataclass

@@ -19,9 +19,19 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
+import weakref
 from typing import Callable, Optional
 
 log = logging.getLogger("horovod_tpu.checkpoint")
+
+# Every live AsyncWriter, so a signal handler can quiesce in-flight
+# commits process-wide without plumbing writer references through the
+# monitor layer (flight.py drains here before dumping on SIGTERM — a
+# torn half-written commit is exactly what the manifest-last protocol
+# exists to prevent, and re-delivering the signal mid-write would
+# waste the window the preemption grace period grants us).
+_live_writers: "weakref.WeakSet[AsyncWriter]" = weakref.WeakSet()
 
 
 class AsyncWriter:
@@ -48,6 +58,7 @@ class AsyncWriter:
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
         self._thread.start()
+        _live_writers.add(self)
 
     def _loop(self) -> None:
         while True:
@@ -106,3 +117,28 @@ class AsyncWriter:
         self._queue.put(None)
         self._thread.join(timeout)
         self.raise_pending()
+
+
+def drain_all(timeout: float = 10.0) -> bool:
+    """Drain every live AsyncWriter under one shared deadline.
+
+    Signal-handler safe: never raises (captured writer errors stay
+    captured for the owner's next ``submit``/``drain`` to surface) and
+    never waits past ``timeout`` in total, however many writers exist.
+    Returns True when every writer went idle within the budget.
+    """
+    deadline = time.monotonic() + max(0.0, timeout)
+    all_idle = True
+    for writer in list(_live_writers):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            all_idle = all_idle and not writer.busy
+            continue
+        try:
+            with writer._cond:
+                idle = writer._cond.wait_for(
+                    lambda: writer._pending == 0, remaining)
+        except Exception:
+            idle = False
+        all_idle = all_idle and idle
+    return all_idle

@@ -153,6 +153,12 @@ class Config:
     stall_warning_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
 
+    # --- resilience supervisor (docs/robustness.md): preemption-notice
+    #     priority-snapshot deadline and the restart-from-last-commit
+    #     budget of the failure-policy supervisor ---
+    preempt_snapshot_deadline_secs: float = 5.0
+    resilience_restart_budget: int = 3
+
     # --- logging ---
     log_level: str = "warning"
     log_hide_timestamp: bool = False
@@ -231,6 +237,12 @@ def from_env() -> Config:
         stall_warning_time_seconds=_env_float("HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0),
         stall_shutdown_time_seconds=_env_float(
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0
+        ),
+        preempt_snapshot_deadline_secs=_env_float(
+            "HOROVOD_PREEMPT_SNAPSHOT_DEADLINE_SECS", 5.0
+        ),
+        resilience_restart_budget=_env_int(
+            "HOROVOD_RESILIENCE_RESTART_BUDGET", 3
         ),
         log_level=_env_str("HOROVOD_LOG_LEVEL", "warning") or "warning",
         log_hide_timestamp=_env_bool("HOROVOD_LOG_HIDE_TIME", False),

@@ -14,7 +14,7 @@ import os
 import subprocess
 import threading
 import time
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
 from ..chaos import injector as chaos
 from ..common import counters
@@ -81,10 +81,18 @@ class HostManager:
     reports it as *added*, so the driver builds a new world that includes
     it. A host that fails again is re-blacklisted with a fresh cooldown.
     Default is 0 → infinite blacklist, the reference behavior.
+
+    Health-gated readmission: with a ``readmission_probe`` installed
+    (``host → bool``, set by the resilience supervisor), a cooled-down
+    host re-enters only after the probe passes; a failing probe re-arms
+    the cooldown instead of readmitting (docs/robustness.md). No probe →
+    cooldown expiry alone readmits, the pre-supervisor behavior.
     """
 
     def __init__(self, discovery: HostDiscovery,
-                 cooldown_secs: Optional[float] = None):
+                 cooldown_secs: Optional[float] = None,
+                 readmission_probe:
+                 Optional[Callable[[str], bool]] = None):
         if cooldown_secs is None:
             try:
                 cooldown_secs = float(os.environ.get(
@@ -92,6 +100,7 @@ class HostManager:
             except ValueError:
                 cooldown_secs = 0.0
         self._cooldown = cooldown_secs
+        self._readmission_probe = readmission_probe
         self._discovery = discovery
         self._lock = threading.Lock()
         self._current_hosts: Dict[str, int] = {}
@@ -101,10 +110,34 @@ class HostManager:
         # raw discovery result never changed.
         self._readmitted_pending: Set[str] = set()
 
+    def set_readmission_probe(
+            self, probe: Optional[Callable[[str], bool]]) -> None:
+        """Install (or clear) the readmission health gate."""
+        with self._lock:
+            self._readmission_probe = probe
+
     def _prune_expired_locked(self) -> None:
         """Drop expired blacklist entries (caller holds the lock)."""
         now = time.monotonic()
         for host in [h for h, exp in self._blacklist.items() if exp <= now]:
+            probe = self._readmission_probe
+            if probe is not None:
+                try:
+                    healthy = bool(probe(host))
+                except Exception:
+                    healthy = False
+                if not healthy:
+                    # Probe failed: the host stays out for another full
+                    # cooldown (or forever when cooldown is 0).
+                    self._blacklist[host] = (
+                        now + self._cooldown if self._cooldown > 0
+                        else math.inf)
+                    counters.increment("elastic.blacklist.probe_fail",
+                                       attrs={"host": host})
+                    logging.warning(
+                        f"blacklist cooldown expired for host {host} but "
+                        f"the readmission probe failed — re-arming")
+                    continue
             del self._blacklist[host]
             self._readmitted_pending.add(host)
             counters.increment("elastic.blacklist.readmit",

@@ -385,6 +385,8 @@ def _reset_for_tests() -> None:
     with _recorder_lock:
         _recorder = None
     _armed = False
+    with _sigterm_hook_lock:
+        del _sigterm_hooks[:]
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +399,28 @@ _armed = False
 _prev_excepthook = None
 _prev_sigterm = None
 _faulthandler_file = None
+
+# Callables run (each guarded) at the TOP of the SIGTERM handler, before
+# the checkpoint-writer drain and the flight dump. The resilience
+# supervisor registers its deadline-budgeted priority snapshot here: the
+# ordering contract is snapshot → drain → dump → re-deliver, so the
+# flight record includes the snapshot's RESILIENCE:PREEMPT event and the
+# process never dies holding a torn half-written commit.
+_sigterm_hooks: list = []
+_sigterm_hook_lock = threading.Lock()
+
+
+def register_sigterm_hook(fn) -> None:
+    """Run ``fn()`` on SIGTERM before the flight dump (idempotent)."""
+    with _sigterm_hook_lock:
+        if fn not in _sigterm_hooks:
+            _sigterm_hooks.append(fn)
+
+
+def unregister_sigterm_hook(fn) -> None:
+    with _sigterm_hook_lock:
+        if fn in _sigterm_hooks:
+            _sigterm_hooks.remove(fn)
 
 
 def _flight_excepthook(exc_type, exc, tb):
@@ -413,6 +437,29 @@ def _flight_excepthook(exc_type, exc, tb):
 def _flight_sigterm(signum, frame):
     import signal
 
+    # 1. Pre-dump hooks (e.g. the supervisor's priority snapshot) — each
+    #    guarded so one bad hook can't cost the dump or the drain.
+    with _sigterm_hook_lock:
+        hooks = list(_sigterm_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:
+            pass
+    # 2. Quiesce in-flight checkpoint commits: an AsyncWriter caught
+    #    mid-write must land its manifest before we re-deliver the
+    #    signal, or the grace window ends with a torn commit the restore
+    #    path would silently skip. Budgeted — a wedged disk can't eat
+    #    the whole grace period.
+    try:
+        from ..checkpoint import writer as _ckpt_writer
+
+        budget = float(os.environ.get(
+            "HOROVOD_SIGTERM_DRAIN_SECS", "10"))
+        _ckpt_writer.drain_all(timeout=budget)
+    except Exception:
+        pass
+    # 3. The black box itself.
     try:
         recorder().dump("sigterm")
     except Exception:

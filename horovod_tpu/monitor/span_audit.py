@@ -40,6 +40,7 @@ KNOWN_PREFIXES = frozenset({
     "MOE",         # expert dispatch/combine exchanges (docs/moe.md)
     "STRAGGLER",   # skew / link-health diagnoses (monitor/straggler.py)
     "FLIGHT",      # flight-recorder marks (monitor/flight.py)
+    "RESILIENCE",  # supervisor policy actions (resilience/supervisor.py)
 })
 
 

@@ -316,8 +316,10 @@ class StragglerDetector:
             self._link_ewma[hop] = ewma
             if ewma > self.link_drift_gate:
                 self._link_over[hop] = self._link_over.get(hop, 0) + 1
+                recovered = False
             else:
                 self._link_over[hop] = 0
+                recovered = bool(self._link_degraded.get(hop))
                 self._link_degraded[hop] = False
             over = self._link_over[hop]
             newly_degraded = (over >= self.patience
@@ -345,11 +347,29 @@ class StragglerDetector:
                 f"the cost model is stale; re-run "
                 f"horovod_tpu.plan.calibrate.calibrate_links() to "
                 f"recalibrate (docs/cost-model.md)")
+        if recovered:
+            # The latch cleared: the hop's EWMA dropped back under the
+            # gate. The resilience supervisor keys its replan swap-back
+            # on this transition.
+            reg.counter("straggler.link_recovered", hop=hop).inc()
+            _timeline_instant("STRAGGLER:LINK_RECOVERED",
+                              {"hop": hop, "ratio": round(ewma, 3),
+                               "gate": self.link_drift_gate})
+            logger.info(
+                f"link health: {hop} hop recovered (EWMA ratio "
+                f"{ewma:.2f} back under the gate "
+                f"{self.link_drift_gate:g})")
         return ewma
 
     def link_scores(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._link_ewma)
+
+    def degraded_hops(self) -> Dict[str, float]:
+        """{hop: EWMA ratio} for hops whose degraded latch is set."""
+        with self._lock:
+            return {hop: self._link_ewma.get(hop, 0.0)
+                    for hop, flag in self._link_degraded.items() if flag}
 
     def history(self) -> List[dict]:
         """Detection history (bounded) — rides every flight dump."""

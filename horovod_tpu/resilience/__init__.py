@@ -1,0 +1,46 @@
+"""Self-healing runtime: the failure-policy supervisor.
+
+Thirteen PRs built the detection and recovery *mechanisms* — deterministic
+fault injection (chaos/), elastic blacklist-and-resume (elastic/driver),
+async commit-or-nothing checkpoints with cross-world reshard
+(checkpoint/), crash forensics and link-health scoring (monitor/flight,
+monitor/straggler), and a priced plan space with an int8 wire alternative
+(plan/). This package is the *policy* layer that connects them: failure
+classification with per-class budgets and an escalation ladder
+(:mod:`~horovod_tpu.resilience.policy`), and a supervisor that turns
+detection signals into recovery actions — preemption-notice priority
+snapshots, restart-from-last-commit under a budget, and degraded-link
+replanning onto the quantized wire
+(:mod:`~horovod_tpu.resilience.supervisor`).
+
+All state is observable: ``resilience.*`` counters/gauges in the metrics
+registry and ``RESILIENCE:*`` timeline/flight events (the prefix is
+registered in ``monitor/span_audit.py``). The production contract the
+layer must hold is enforced by ``scripts/soak.py`` (docs/robustness.md).
+"""
+
+from .policy import (  # noqa: F401
+    CLASSES,
+    CLASS_DEGRADED_LINK,
+    CLASS_DISCOVERY_FLAP,
+    CLASS_PREEMPTION,
+    CLASS_RPC_EXHAUSTED,
+    CLASS_STALL,
+    CLASS_WORKER_CRASH,
+    LADDER,
+    RECOVER_ABORT,
+    RECOVER_BLACKLIST,
+    RECOVER_REPLAN,
+    RECOVER_RETRY,
+    RECOVER_SHRINK,
+    RECOVER_SNAPSHOT,
+    Decision,
+    Policy,
+    PolicyEngine,
+    ReadmissionGate,
+    default_policies,
+)
+from .supervisor import (  # noqa: F401
+    ReplanDecision,
+    Supervisor,
+)

@@ -1,0 +1,266 @@
+"""Failure classification and per-class recovery policies.
+
+Every failure signal the runtime can raise maps onto one of six classes;
+each class carries a :class:`Policy` — a retry budget with capped
+exponential backoff, and an escalation ladder entered once the budget is
+exhausted. The ladder is the elastic playbook made explicit::
+
+    retry (budgeted, backed off) → blacklist → shrink_world → abort
+
+The state machine lives in :class:`PolicyEngine`: one counter per
+``(class, key)`` pair (the key names the failing subject — a host, a
+hop, an RPC service), advanced by :meth:`~PolicyEngine.record_failure`
+and reset by :meth:`~PolicyEngine.record_success`. Decisions are pure
+data (:class:`Decision`); the supervisor performs them.
+
+Observability contract (docs/robustness.md): every recorded failure
+bumps ``resilience.failures{cls}``, every decision bumps
+``resilience.actions{cls,action}``, backoff state is the
+``resilience.backoff_secs{cls,key}`` gauge, and class transitions emit
+``RESILIENCE:FAILURE`` / ``RESILIENCE:ESCALATE`` timeline/flight
+instants — all of it rides the flight dump, so a postmortem can replay
+the policy's view of the incident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..monitor import registry as _registry
+from ..monitor.straggler import _timeline_instant
+
+logger = logging.getLogger("horovod_tpu.resilience")
+
+#: The failure classes (docs/robustness.md, failure-class table).
+CLASS_WORKER_CRASH = "worker_crash"      # a worker died (exit, OOM, chaos)
+CLASS_RPC_EXHAUSTED = "rpc_exhausted"    # client retries ran out
+CLASS_STALL = "stall"                    # stall inspector escalated
+CLASS_DISCOVERY_FLAP = "discovery_flap"  # discovery transiently empty
+CLASS_PREEMPTION = "preemption"          # spot/maintenance SIGTERM notice
+CLASS_DEGRADED_LINK = "degraded_link"    # straggler link-health latch
+
+CLASSES = (CLASS_WORKER_CRASH, CLASS_RPC_EXHAUSTED, CLASS_STALL,
+           CLASS_DISCOVERY_FLAP, CLASS_PREEMPTION, CLASS_DEGRADED_LINK)
+
+#: Recovery actions a :class:`Decision` may carry.
+RECOVER_RETRY = "retry"            # wait backoff_secs, try again
+RECOVER_BLACKLIST = "blacklist"    # evict the subject host
+RECOVER_SHRINK = "shrink_world"    # resume with the remaining hosts
+RECOVER_ABORT = "abort"            # budgets exhausted: stop the job
+RECOVER_SNAPSHOT = "snapshot"      # priority checkpoint (preemption)
+RECOVER_REPLAN = "replan"          # re-price the wire (degraded link)
+
+#: The post-budget escalation ladder, in order.
+LADDER = (RECOVER_BLACKLIST, RECOVER_SHRINK, RECOVER_ABORT)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """One class's recovery policy.
+
+    ``retry_budget`` failures get :data:`RECOVER_RETRY` decisions with
+    capped exponential backoff (``backoff_base_secs * 2**(n-1)``, capped
+    at ``backoff_cap_secs``); failures past the budget walk the
+    escalation ladder one rung per failure, starting at
+    ``ladder_start``. Classes whose first response is not a retry
+    (preemption → snapshot, degraded link → replan) set ``on_failure``.
+    """
+
+    retry_budget: int = 3
+    backoff_base_secs: float = 0.5
+    backoff_cap_secs: float = 30.0
+    ladder_start: int = 0           # index into LADDER after the budget
+    on_failure: str = RECOVER_RETRY
+
+    def backoff(self, failures: int) -> float:
+        """Backoff for the n-th consecutive failure (1-based)."""
+        if failures <= 0:
+            return 0.0
+        return min(self.backoff_cap_secs,
+                   self.backoff_base_secs * (2.0 ** (failures - 1)))
+
+
+def default_policies() -> Dict[str, Policy]:
+    """The per-class defaults (docs/robustness.md knob table)."""
+    return {
+        # A crashed worker is the elastic bread-and-butter: a couple of
+        # world rebuilds, then start evicting.
+        CLASS_WORKER_CRASH: Policy(retry_budget=2, backoff_base_secs=1.0),
+        # RPC exhaustion already survived the transport's own retry
+        # loop, so the policy layer retries once and then escalates.
+        CLASS_RPC_EXHAUSTED: Policy(retry_budget=1,
+                                    backoff_base_secs=2.0),
+        # A stall escalation means the watchdog already waited its
+        # shutdown window — go straight to the ladder.
+        CLASS_STALL: Policy(retry_budget=0),
+        # Discovery flaps are usually control-plane noise: generous
+        # budget, short backoff, and shrinking (not blacklisting — no
+        # specific host is at fault) when it persists.
+        CLASS_DISCOVERY_FLAP: Policy(retry_budget=5,
+                                     backoff_base_secs=0.5,
+                                     ladder_start=1),
+        # A preemption notice is not retryable: snapshot now, and the
+        # ladder (for repeat notices past the budget) shrinks.
+        CLASS_PREEMPTION: Policy(retry_budget=3, backoff_base_secs=0.0,
+                                 ladder_start=1,
+                                 on_failure=RECOVER_SNAPSHOT),
+        # A degraded link is a performance failure, not a liveness one:
+        # replan onto the cheaper wire, never abort for it.
+        CLASS_DEGRADED_LINK: Policy(retry_budget=1_000_000,
+                                    backoff_base_secs=0.0,
+                                    on_failure=RECOVER_REPLAN),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """What the policy wants done about one recorded failure."""
+
+    cls: str
+    key: str
+    action: str
+    failures: int          # consecutive failures of this (cls, key)
+    backoff_secs: float    # wait before acting (retry decisions)
+
+    def as_dict(self) -> dict:
+        return {"cls": self.cls, "key": self.key, "action": self.action,
+                "failures": self.failures,
+                "backoff_secs": round(self.backoff_secs, 3)}
+
+
+class PolicyEngine:
+    """The per-(class, key) failure state machine.
+
+    Thread-safe. ``record_failure`` advances the counter and returns the
+    policy's :class:`Decision`; ``record_success`` resets it (a healthy
+    observation ends the escalation). The engine never *performs*
+    actions — the supervisor does — so units can drive it to budget
+    exhaustion without touching a driver.
+    """
+
+    def __init__(self,
+                 policies: Optional[Dict[str, Policy]] = None,
+                 registry: Optional[_registry.MetricsRegistry] = None
+                 ) -> None:
+        self.policies = dict(default_policies())
+        if policies:
+            self.policies.update(policies)
+        self._registry = registry or _registry.default_registry()
+        self._lock = threading.Lock()
+        self._failures: Dict[Tuple[str, str], int] = {}
+        self._decisions: list = []  # bounded below; rides the soak report
+
+    def _policy(self, cls: str) -> Policy:
+        if cls not in CLASSES:
+            raise ValueError(
+                f"unknown failure class {cls!r}; one of {CLASSES}")
+        return self.policies.get(cls, Policy())
+
+    def failures(self, cls: str, key: str = "*") -> int:
+        with self._lock:
+            return self._failures.get((cls, key), 0)
+
+    def decisions(self) -> list:
+        with self._lock:
+            return list(self._decisions)
+
+    def record_failure(self, cls: str, key: str = "*",
+                       detail: Optional[dict] = None) -> Decision:
+        """One failure of ``(cls, key)`` happened; decide the response."""
+        policy = self._policy(cls)
+        with self._lock:
+            n = self._failures.get((cls, key), 0) + 1
+            self._failures[(cls, key)] = n
+        reg = self._registry
+        reg.counter("resilience.failures", cls=cls).inc()
+        if n <= policy.retry_budget:
+            action = policy.on_failure
+            backoff = policy.backoff(n)
+        else:
+            # Past the budget: one ladder rung per further failure,
+            # clamped at abort (the ladder's last rung repeats).
+            rung = min(policy.ladder_start + (n - policy.retry_budget - 1),
+                       len(LADDER) - 1)
+            action = LADDER[rung]
+            backoff = 0.0
+            reg.counter("resilience.escalations", cls=cls,
+                        action=action).inc()
+            _timeline_instant("RESILIENCE:ESCALATE",
+                              {"cls": cls, "key": key, "action": action,
+                               "failures": n})
+            logger.warning(
+                f"resilience: {cls} budget exhausted for {key!r} "
+                f"({n} failures > budget {policy.retry_budget}) — "
+                f"escalating to {action}")
+        reg.counter("resilience.actions", cls=cls, action=action).inc()
+        reg.gauge("resilience.backoff_secs", cls=cls, key=key).set(backoff)
+        decision = Decision(cls=cls, key=key, action=action, failures=n,
+                            backoff_secs=backoff)
+        _timeline_instant("RESILIENCE:FAILURE",
+                          {**decision.as_dict(), **(detail or {})})
+        with self._lock:
+            self._decisions.append(decision)
+            del self._decisions[:-256]
+        return decision
+
+    def record_success(self, cls: str, key: str = "*") -> None:
+        """A healthy observation of ``(cls, key)``: reset its counter."""
+        with self._lock:
+            had = self._failures.pop((cls, key), 0)
+        if had:
+            self._registry.counter("resilience.recoveries", cls=cls).inc()
+            self._registry.gauge("resilience.backoff_secs", cls=cls,
+                                 key=key).set(0.0)
+            _timeline_instant("RESILIENCE:RECOVER",
+                              {"cls": cls, "key": key,
+                               "cleared_failures": had})
+
+    def snapshot(self) -> dict:
+        """Policy state for the flight dump / soak report."""
+        with self._lock:
+            return {
+                "failures": {f"{c}:{k}": n
+                             for (c, k), n in self._failures.items()},
+                "decisions": [d.as_dict() for d in self._decisions[-32:]],
+            }
+
+
+class ReadmissionGate:
+    """Health-gated blacklist readmission (docs/robustness.md).
+
+    Installed on :class:`~horovod_tpu.elastic.discovery.HostManager` as
+    its ``readmission_probe``: when a host's cooldown expires, the gate
+    runs ``probe(host)`` — only a passing probe readmits; a failing (or
+    raising) probe re-arms the cooldown. The default probe passes
+    unconditionally, preserving cooldown-only semantics while still
+    counting readmissions through the resilience metrics.
+    """
+
+    def __init__(self, probe: Optional[Callable[[str], bool]] = None,
+                 registry: Optional[_registry.MetricsRegistry] = None
+                 ) -> None:
+        self._probe = probe
+        self._registry = registry or _registry.default_registry()
+
+    def __call__(self, host: str) -> bool:
+        started = time.monotonic()
+        try:
+            healthy = True if self._probe is None else bool(
+                self._probe(host))
+        except Exception as e:
+            logger.warning(
+                f"resilience: readmission probe for {host} raised "
+                f"{e!r} — treating as unhealthy")
+            healthy = False
+        verdict = "pass" if healthy else "fail"
+        self._registry.counter("resilience.readmission",
+                               verdict=verdict).inc()
+        _timeline_instant("RESILIENCE:READMIT",
+                          {"host": host, "verdict": verdict,
+                           "probe_ms": round(
+                               (time.monotonic() - started) * 1e3, 3)})
+        return healthy

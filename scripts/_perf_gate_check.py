@@ -34,6 +34,19 @@ the dropped-token fraction (<= PERF_GATE_MOE_DROPPED, default 0.25),
 and the a2a predicted-vs-modeled wire-ms drift (<=
 PERF_GATE_COST_DRIFT) — then throughput vs the trajectory
 (docs/moe.md).
+
+soak leg: takes the scripts/soak.py report JSON instead of a bench
+line and hard-fails when ANY of the soak gates (recovery, loss
+trajectory, commit cadence, deadline-met priority snapshot, ...) is
+false — every soak gate also lands in the verdict snapshot
+(docs/robustness.md).
+
+Training legs with an EMPTY trajectory (no same-metric, same-platform
+``BENCH_r*.json`` record — e.g. the cpu trajectory was benched on a
+different model) fall back to the committed
+``BENCH_train_baseline.json``, keyed ``metric|platform``: missing keys
+self-seed on first run (refresh with PERF_GATE_UPDATE=1), so the leg
+still gates instead of silently passing.
 """
 
 import glob
@@ -43,6 +56,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SERVE_BASELINE = os.path.join(REPO, "BENCH_serve_baseline.json")
+TRAIN_BASELINE = os.path.join(REPO, "BENCH_train_baseline.json")
 
 sys.path.insert(0, REPO)
 
@@ -435,21 +449,79 @@ def _main():
             return code
         # fall through: throughput still gates against the trajectory
 
+    if leg == "soak":
+        return _soak_leg(rec)
+
     # Training legs: best same-platform value for this metric across the
-    # recorded trajectory.
+    # recorded trajectory; an empty trajectory falls back to the
+    # committed (self-seeding) train baseline instead of passing.
     candidates = [
         (src, r["value"]) for src, r in trajectory_records()
         if r.get("metric") == rec.get("metric")
         and r.get("platform") == rec.get("platform")
         and isinstance(r.get("value"), (int, float))]
     if not candidates:
-        print(f"perf gate [{leg}]: no recorded {rec.get('metric')!r} on "
-              f"platform {rec.get('platform')!r} in the BENCH_r*.json "
-              f"trajectory — nothing to gate against (pass)")
-        return 0
+        return _train_baseline_gate(rec, leg, tol, update)
     src, best = max(candidates, key=lambda c: c[1])
     print(f"perf gate [{leg}]: trajectory anchor {src}")
     return 0 if gate(rec["value"], best, tol, rec["metric"]) else 1
+
+
+def _train_baseline_gate(rec, leg, tol, update):
+    """Empty-trajectory fallback: gate against (or seed) the committed
+    ``BENCH_train_baseline.json``, keyed ``metric|platform``."""
+    metric, platform = rec.get("metric"), rec.get("platform")
+    value = rec.get("value")
+    if not isinstance(value, (int, float)):
+        print(f"perf gate [{leg}]: record has no numeric 'value' — "
+              f"cannot gate or seed")
+        return 2
+    key = f"{metric}|{platform}"
+    baselines = {}
+    if os.path.exists(TRAIN_BASELINE):
+        try:
+            with open(TRAIN_BASELINE) as f:
+                baselines = json.load(f)
+        except ValueError:
+            print(f"perf gate [{leg}]: unreadable "
+                  f"{os.path.basename(TRAIN_BASELINE)} — re-seeding")
+            baselines = {}
+    entry = baselines.get(key)
+    if update or entry is None:
+        baselines[key] = {"metric": metric, "platform": platform,
+                          "value": value}
+        with open(TRAIN_BASELINE, "w") as f:
+            json.dump(baselines, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"perf gate [{leg}]: no trajectory anchor for {key!r} — "
+              f"seeded {os.path.basename(TRAIN_BASELINE)} at {value}")
+        return 0
+    print(f"perf gate [{leg}]: empty trajectory — baseline anchor "
+          f"{os.path.basename(TRAIN_BASELINE)}[{key}]")
+    return 0 if gate(value, entry["value"], tol, metric) else 1
+
+
+def _soak_leg(rec):
+    """The soak-report JSON (scripts/soak.py) is its own gate set: every
+    named gate must pass; each one also lands in the verdict snapshot."""
+    gates = rec.get("gates") or {}
+    if not gates:
+        print("perf gate [soak]: report has no gates — hard fail")
+        record_verdict("soak", "report_present", 0.0, 1.0, 0.0, False)
+        return 1
+    failed = []
+    for name, g in sorted(gates.items()):
+        ok = bool(g.get("pass"))
+        record_verdict("soak", name, 1.0 if ok else 0.0, 1.0, 0.0, ok)
+        if not ok:
+            failed.append(name)
+            print(f"perf gate [soak]: gate {name} FAILED "
+                  f"({g.get('detail')})")
+    if failed:
+        return 1
+    print(f"perf gate [soak]: all {len(gates)} soak gates passed "
+          f"(wall {rec.get('wall_s')}s)")
+    return 0
 
 
 if __name__ == "__main__":

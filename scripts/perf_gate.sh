@@ -33,6 +33,9 @@
 #                     forced-routing parity + dropped-token fraction
 #                     <= PERF_GATE_MOE_DROPPED + a2a wire-ms drift
 #                     (docs/moe.md)
+#   PERF_GATE_LEGS="soak" scripts/perf_gate.sh  # self-healing soak:
+#                     the smoke gauntlet (preempt + flap + resize) must
+#                     pass every soak-report gate (docs/robustness.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -145,8 +148,29 @@ for leg in $LEGS; do
                 --platform cpu --cpu-devices 8 \
                 --num-iters 2 --num-batches-per-iter 2
             ;;
+        soak)
+            # Self-healing soak gate (docs/robustness.md): the CI-shaped
+            # gauntlet (one preemption + one flap + one resize against
+            # the durable elastic run) must pass every gate in its
+            # soak-report JSON — recovery, loss trajectory vs the
+            # uninterrupted reference, commit cadence, a deadline-met
+            # priority snapshot, monotone counters.
+            echo "== perf gate: soak leg ==" >&2
+            SOAK_REPORT="${TMPDIR:-/tmp}/perf_gate_soak_report.json"
+            rm -f "$SOAK_REPORT"
+            scripts/soak_smoke.sh --report "$SOAK_REPORT" >&2 || FAIL=1
+            if [ -f "$SOAK_REPORT" ]; then
+                PERF_GATE_LEG=soak PERF_GATE_TOL="$TOL" \
+                    PERF_GATE_UPDATE="$UPDATE" \
+                    python scripts/_perf_gate_check.py \
+                    "$(cat "$SOAK_REPORT")" || FAIL=1
+            else
+                echo "perf gate [soak]: no soak report written" >&2
+                FAIL=1
+            fi
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp|moe)" >&2
+            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp|moe|soak)" >&2
             exit 2
             ;;
     esac

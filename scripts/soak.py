@@ -1,0 +1,635 @@
+#!/usr/bin/env python
+"""The production soak gauntlet (docs/robustness.md, soak contract).
+
+One run drives the whole self-healing vertical at once:
+
+* **Reference leg** — an uninterrupted durable elastic training run
+  (tests/soak_worker.py) on a fixed world; its per-batch trajectory and
+  step cadence are the yardstick every later gate measures against.
+* **Gauntlet leg** — the same run under a scripted chaos plan: a spot
+  **preemption** (the chaos ``preempt`` action SIGTERMs a worker
+  mid-collective; its supervisor must land a deadline-met priority
+  snapshot before the flight dump re-delivers the signal), a worker
+  **crash**, a discovery **flap**, a short **stall**, and a world
+  **resize** (the discovery script grows mid-run; the preempted host
+  re-enters through the health-gated readmission path after its
+  blacklist cooldown). Runs on a background thread.
+* **Serve leg** — a live continuous-batching generation trace
+  (ReplicaSet + Poisson arrivals, mid-trace resize down/up) running in
+  the soak process WHILE the gauntlet is under fire. Zero dropped
+  requests is the bar.
+* **Replan leg** — an in-process training loop whose eager collective
+  is chaos-``delay``ed on the DCN hop: the straggler link-health latch
+  must flip, the supervisor must re-price the shortlist under the
+  EWMA-derated cost model and hot-swap the step to the quantized wire,
+  and when the injected delay expires the latch must clear and the
+  swap revert.
+
+Everything lands in one soak-report JSON (--report), and the gates —
+loss trajectory vs reference, step time, serve p99 + zero drops,
+checkpoint commit cadence, monotone counters, >=1 deadline-met priority
+snapshot, >=1 reverted replan — are asserted from that report; exit
+code is the number of failed gates. ``--smoke`` is the CI shape: one
+preemption + one flap + one resize, training legs only
+(scripts/soak_smoke.sh; the full gauntlet is scripts/soak.sh).
+"""
+
+import argparse
+import glob
+import json
+import math
+import os
+import shlex
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+WORKER = os.path.join(REPO, "tests", "soak_worker.py")
+
+TRAJECTORY_TOL = 1e-4  # |gauntlet - reference| per logged batch point
+
+
+def log(msg):
+    print(f"[soak] {msg}", flush=True)
+
+
+def _read_log(path):
+    if not os.path.exists(path):
+        return []
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                try:
+                    records.append(json.loads(line))
+                except ValueError:
+                    pass  # a torn line from a preempted writer
+    return records
+
+
+def _write_discovery(script, hosts):
+    """(Re)write the discovery script atomically — a rewrite mid-run IS
+    the world-resize event."""
+    tmp = script + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("#!/bin/sh\n")
+        for host, slots in hosts:
+            f.write(f"echo {host}:{slots}\n")
+    os.chmod(tmp, 0o755)
+    os.replace(tmp, script)
+
+
+def _step_intervals(records):
+    """Per-identity deltas between consecutive batch log times (the
+    observable step cadence; recovery gaps ride the tail percentiles)."""
+    by_ident = {}
+    for r in records:
+        if "batch" in r and "t" in r:
+            by_ident.setdefault(r["identity"], []).append(
+                (r["batch"], r["t"]))
+    deltas = []
+    for pts in by_ident.values():
+        pts.sort()
+        deltas.extend(t1 - t0 for (b0, t0), (b1, t1)
+                      in zip(pts, pts[1:]) if b1 == b0 + 1)
+    return sorted(deltas)
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1)))
+    return sorted_vals[i]
+
+
+# ---------------------------------------------------------------------------
+# Training legs (reference + gauntlet) — subprocess elastic workers.
+# ---------------------------------------------------------------------------
+
+def run_training_leg(workdir, label, *, batches, batch_sleep, hosts,
+                     min_np, max_np, worker_plans=None, flight_dir=None,
+                     resize_to=None, resize_at_batch=None,
+                     blacklist_cooldown=0.0, join_timeout=300):
+    """One elastic incarnation chain; returns the leg's evidence dict."""
+    from horovod_tpu import resilience
+    from horovod_tpu.checkpoint import layout
+    from horovod_tpu.elastic import constants
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+    from horovod_tpu.elastic.driver import ElasticDriver
+    from horovod_tpu.runner import safe_shell_exec
+
+    constants.DISCOVER_HOSTS_FREQUENCY_SECS = 0.25
+    script = os.path.join(workdir, f"discover_{label}.sh")
+    _write_discovery(script, hosts)
+    log_file = os.path.join(workdir, f"{label}.jsonl")
+    ckpt_dir = os.path.join(workdir, f"ckpt_{label}")
+
+    driver = ElasticDriver(
+        HostDiscoveryScript(script, 1), min_np=min_np, max_np=max_np,
+        controller_addr_override="127.0.0.1",
+        blacklist_cooldown_secs=(blacklist_cooldown or None))
+    # The supervisor on the DRIVER side owns the readmission gate: a
+    # cooled-down host re-enters only through the probe.
+    sup = resilience.Supervisor(driver=driver).attach()
+
+    def _exec(slot, world_id):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": REPO,
+            "HOROVOD_HOSTNAME": slot.hostname,
+            "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+            "HOROVOD_ELASTIC": "1",
+            "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+            "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+            "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+            "HOROVOD_START_TIMEOUT": "30",
+        })
+        if flight_dir:
+            env["HOROVOD_FLIGHT_RECORDER_DIR"] = flight_dir
+        # Chaos plans are keyed by world incarnation: a restarted worker
+        # process re-reads the env with fresh rule counters, so an
+        # unconditioned plan would re-fire the same fault in every
+        # world — the gauntlet wants each fault to land exactly once.
+        if worker_plans is not None:
+            plan = worker_plans.get(world_id)
+            if plan:
+                env.update(plan.to_env())
+        cmd = " ".join(shlex.quote(c) for c in [
+            sys.executable, WORKER, "--log-file", log_file,
+            "--batches", str(batches), "--batch-sleep", str(batch_sleep),
+            "--ckpt-dir", ckpt_dir])
+        return safe_shell_exec.execute(cmd, env=env)
+
+    commit_samples = []  # monotone commit-cadence evidence
+    resized = threading.Event()
+
+    def _monitor():
+        while not done_evt.wait(0.5):
+            steps = layout.list_steps(ckpt_dir)
+            commit_samples.append(
+                {"t": time.time(), "latest": steps[-1] if steps else 0})
+            if (resize_to is not None and not resized.is_set()):
+                recs = _read_log(log_file)
+                top = max((r.get("batch", 0) for r in recs), default=0)
+                if top >= (resize_at_batch or batches // 3):
+                    _write_discovery(script, resize_to)
+                    resized.set()
+                    log(f"{label}: discovery resized to {resize_to} "
+                        f"at batch {top}")
+
+    done_evt = threading.Event()
+    mon = threading.Thread(target=_monitor, daemon=True)
+    ok = False
+    try:
+        driver.start(_exec)
+        mon.start()
+        ok = driver.join(timeout=join_timeout)
+    finally:
+        done_evt.set()
+        driver.stop()
+        driver.shutdown_service()
+        sup.detach()
+        mon.join(timeout=5)
+
+    records = _read_log(log_file)
+    intervals = _step_intervals(records)
+    return {
+        "ok": bool(ok),
+        "label": label,
+        "records": records,
+        "done": [r for r in records if r.get("done")],
+        "world_id": driver.world_id,
+        "committed_steps": layout.list_steps(ckpt_dir),
+        "commit_samples": commit_samples,
+        "resized": resized.is_set() if resize_to is not None else None,
+        "step_p50_s": _pct(intervals, 0.5),
+        "step_p90_s": _pct(intervals, 0.9),
+        "supervisor": sup.report(),
+        "flight_dir": flight_dir,
+        "ckpt_dir": ckpt_dir,
+    }
+
+
+def trajectory_by_batch(records):
+    traj = {}
+    for r in records:
+        if "batch" in r:
+            traj.setdefault(int(r["batch"]), set()).add(
+                float(r["weights"]))
+    return traj
+
+
+def flight_preempt_events(flight_dir):
+    """RESILIENCE:PREEMPT events across every dump in the dir — the
+    preempted worker's black box is the snapshot's proof."""
+    events = []
+    for path in sorted(glob.glob(os.path.join(flight_dir or "",
+                                              "flight_*.json"))):
+        try:
+            with open(path) as f:
+                dump = json.load(f)
+        except Exception:
+            continue
+        for ev in dump.get("events", []):
+            if ev.get("name") == "RESILIENCE:PREEMPT":
+                events.append({"dump": os.path.basename(path),
+                               "reason": dump.get("reason"),
+                               **(ev.get("args") or {})})
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Serve leg — a live generation trace in the soak process.
+# ---------------------------------------------------------------------------
+
+def run_serve_leg(requests=36, rate=30.0, replicas=2):
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import GPT, gpt_tiny
+    from horovod_tpu.serve import PageConfig, PoissonTrace, ReplicaSet
+
+    devices = jax.devices()
+    hvd.shutdown()
+    hvd.init(devices=devices)
+    cfg = gpt_tiny(num_heads=8, dtype=jnp.float32)
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+    page_size, max_slots = 16, 8
+    p_lo, p_hi, n_lo, n_hi = 8, 16, 8, 16
+    pages_per_slot = -(-(p_hi + n_hi + 1) // page_size)
+    num_pages = 1 + max(pages_per_slot,
+                        int(0.75 * max_slots * pages_per_slot))
+    pc = PageConfig(num_pages=num_pages, page_size=page_size,
+                    max_slots=max_slots, pages_per_slot=pages_per_slot,
+                    num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+                    head_dim=cfg.d_model // cfg.num_heads)
+    trace = PoissonTrace(rate=rate, num_requests=requests, seed=12345,
+                         prompt_len=(p_lo, p_hi),
+                         max_new_tokens=(n_lo, n_hi),
+                         vocab_size=cfg.vocab_size, eos_id=1)
+    rset = ReplicaSet(cfg, params, pc, devices=devices,
+                      n_replicas=replicas, eos_id=1)
+    for req in trace:
+        rset.submit(req)
+    total = len(trace)
+    resize_down_at = max(1, total // 3)
+    resize_up_at = max(2, (2 * total) // 3)
+    did_down = did_up = False
+    t0 = time.monotonic()
+    steps = 0
+    while rset.has_work:
+        now = time.monotonic() - t0
+        done = (len(rset.stats.completed)
+                + sum(len(e.stats.completed) for e in rset.engines))
+        if not did_down and done >= resize_down_at and replicas > 1:
+            rset.resize(max(1, replicas // 2), now)
+            did_down = True
+        if did_down and not did_up and done >= resize_up_at \
+                and replicas > 1:
+            rset.resize(replicas, now)
+            did_up = True
+        if rset.step_all(now) == 0:
+            time.sleep(1e-3)
+        steps += 1
+        if steps > 200_000:
+            break
+    wall = time.monotonic() - t0
+    stats = rset.stats
+    for eng in rset.engines:
+        stats.merge(eng.stats)
+    completed = len(stats.completed)
+    lat = stats.latency_percentiles()
+    return {
+        "requests": total,
+        "completed": completed,
+        "dropped": total - completed,
+        "wall_s": round(wall, 3),
+        "latency_p50_ms": round((lat["p50"] or 0) * 1e3, 2),
+        "latency_p99_ms": round((lat["p99"] or 0) * 1e3, 2),
+        "resizes": len(rset.resize_events),
+        "preemptions": stats.preemptions,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Replan leg — chaos delay on the DCN hop → degraded → quantized swap →
+# recovery → swap-back, all in-process at real step boundaries.
+# ---------------------------------------------------------------------------
+
+def run_replan_leg(steps=28, delay_after=6, delay_count=12,
+                   delay_secs=0.02):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import chaos, resilience
+    from horovod_tpu.monitor.straggler import StragglerDetector
+    from horovod_tpu.plan import cost as _cost
+
+    devices = jax.devices()
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=(2, len(devices) // 2))
+    mesh_shape = (2, len(devices) // 2)
+
+    # The injected fault: the step's eager collective slows down for a
+    # window of `delay_count` invocations — a transient congested link.
+    chaos.configure(chaos.FaultPlan(seed=7).add(
+        "collective.eager", "delay", secs=delay_secs,
+        after=delay_after, max_count=delay_count))
+
+    det = StragglerDetector(link_drift_gate=1.5, patience=3)
+    sup = resilience.Supervisor(straggler=det)
+    payload = np.zeros((64, 1024), np.float32)  # 256 KiB
+    nbytes = payload.nbytes
+    predicted = _cost.predict_hop_ms("dcn", nbytes)
+
+    quantized = False
+    baseline_ms = None
+    probe = jnp.zeros((64,), jnp.float32)
+    events = []
+    for step in range(steps):
+        x = jnp.asarray(payload)
+        hvd.allreduce(x, name=f"replan.step.{step}",
+                      quantized=quantized).block_until_ready()
+        # The link-health signal comes from a small fixed-wire probe
+        # collective, not the step itself: after the hot swap the step
+        # runs a *different* (quantized) wire whose cost is not
+        # comparable to the pre-swap baseline, but the probe always
+        # measures the same hop the same way — so its ratio falls back
+        # to ~1 when the congestion clears and the latch can release.
+        t0 = time.perf_counter()
+        hvd.allreduce(probe, name="replan.probe").block_until_ready()
+        measured_ms = (time.perf_counter() - t0) * 1e3
+        if baseline_ms is None:
+            baseline_ms = measured_ms  # first healthy probe calibrates
+        elif measured_ms < 1.5 * baseline_ms:
+            # Keep the healthy baseline honest while un-delayed.
+            baseline_ms = 0.5 * baseline_ms + 0.5 * measured_ms
+        # Score the DCN hop as observed-over-baseline, scaled onto the
+        # model's prediction: the CPU mesh's absolute wire time is not
+        # the model's (no recalibration happens here); the RATIO of a
+        # congested probe to the healthy cadence is what the latch gates.
+        det.observe_wire("dcn", nbytes,
+                         predicted * measured_ms / baseline_ms)
+        directive = sup.maybe_replan(nbytes, mesh_shape=mesh_shape,
+                                     step=step)
+        if directive and "swap" in directive:
+            quantized = True  # the hot swap, at this step boundary
+            events.append({"step": step, "event": "swap",
+                           "plan": directive["decision"].plan_after})
+            log(f"replan: step {step} swapped to quantized wire")
+        elif directive and directive.get("revert"):
+            quantized = False  # the recovery swap-back
+            events.append({"step": step, "event": "revert"})
+            log(f"replan: step {step} reverted to the original wire")
+    report = sup.report()
+    chaos.reset()
+    return {"steps": steps, "events": events,
+            "replans": report["replans"],
+            "swapped": any(e["event"] == "swap" for e in events),
+            "reverted": any(e["event"] == "revert" for e in events)}
+
+
+# ---------------------------------------------------------------------------
+# Gates + report.
+# ---------------------------------------------------------------------------
+
+def check_trajectory(ref_records, gauntlet_records):
+    ref = trajectory_by_batch(ref_records)
+    gnt = trajectory_by_batch(gauntlet_records)
+    worst = 0.0
+    bad = None
+    for batch, values in gnt.items():
+        want = ref.get(batch)
+        if not want:
+            continue
+        w0 = next(iter(want))
+        for v in values:
+            err = abs(v - w0)
+            if err > worst:
+                worst, bad = err, batch
+    return {"max_abs_err": worst, "worst_batch": bad,
+            "batches_compared": len(set(gnt) & set(ref)),
+            "within_tol": worst <= TRAJECTORY_TOL}
+
+
+def run(args):
+    from horovod_tpu import chaos
+    from horovod_tpu.common import counters
+
+    chaos.reset()
+    counters.reset_all()
+    workdir = args.workdir or tempfile.mkdtemp(prefix="soak_")
+    os.makedirs(workdir, exist_ok=True)
+    flight_dir = os.path.join(workdir, "flight")
+    report = {"smoke": args.smoke, "workdir": workdir, "gates": {}}
+    t_start = time.monotonic()
+
+    # ---- reference leg (no chaos anywhere) ----------------------------
+    log("reference leg: uninterrupted durable run")
+    ref = run_training_leg(
+        workdir, "ref", batches=args.batches,
+        batch_sleep=args.batch_sleep,
+        hosts=[("hostA", 2), ("hostB", 1)], min_np=3, max_np=3,
+        join_timeout=args.leg_timeout)
+    report["reference"] = {k: ref[k] for k in
+                           ("ok", "world_id", "committed_steps",
+                            "step_p50_s", "step_p90_s")}
+    report["reference"]["done"] = len(ref["done"])
+
+    # ---- gauntlet leg -------------------------------------------------
+    # Worker-side chaos (ships via env, keyed by world incarnation so
+    # each fault lands exactly once): world 0 takes the spot preemption;
+    # the rebuilt world 1 takes a short stall and then a hard crash;
+    # world 2+ runs clean through the resize and readmissions.
+    worker_plans = {0: chaos.FaultPlan(seed=args.seed).add(
+        "collective.eager", "preempt", where="hostB:0",
+        after=3, max_count=1)}
+    if not args.smoke:
+        worker_plans[1] = (
+            chaos.FaultPlan(seed=args.seed)
+            .add("collective.eager", "stall", where="hostA:0",
+                 after=2, secs=1.0, max_count=1)
+            .add("collective.eager", "crash", where="hostA:1",
+                 after=4, max_count=1, exit_code=1))
+    # Driver-side chaos (this process): one discovery flap.
+    chaos.configure(chaos.FaultPlan(seed=args.seed).add(
+        "discovery.update", "flap", after=8, max_count=1))
+
+    log("gauntlet leg: preempt + flap"
+        + ("" if args.smoke else " + crash + stall") + " + resize")
+    counters_before = dict(counters.counters(total=True))
+    gauntlet_kwargs = dict(
+        batches=args.batches, batch_sleep=args.batch_sleep,
+        hosts=[("hostA", 2), ("hostB", 1)], min_np=2, max_np=4,
+        worker_plans=worker_plans, flight_dir=flight_dir,
+        resize_to=[("hostA", 2), ("hostB", 1), ("hostC", 1)],
+        resize_at_batch=max(2, args.batches // 3),
+        blacklist_cooldown=4.0, join_timeout=args.leg_timeout)
+
+    if args.smoke:
+        gauntlet = run_training_leg(workdir, "gauntlet",
+                                    **gauntlet_kwargs)
+        serve = replan = None
+    else:
+        # The serve trace and the replan loop run LIVE in this process
+        # while the gauntlet burns in the background thread.
+        result = {}
+
+        def _gauntlet_thread():
+            try:
+                result["gauntlet"] = run_training_leg(
+                    workdir, "gauntlet", **gauntlet_kwargs)
+            except Exception as e:
+                result["error"] = repr(e)
+
+        th = threading.Thread(target=_gauntlet_thread, daemon=True)
+        th.start()
+        log("serve leg: live generation trace under the gauntlet")
+        serve = run_serve_leg(requests=args.serve_requests)
+        log(f"serve leg: {serve['completed']}/{serve['requests']} "
+            f"completed, p99 {serve['latency_p99_ms']} ms, "
+            f"{serve['dropped']} dropped")
+        log("replan leg: degraded DCN hop -> quantized swap -> revert")
+        replan = run_replan_leg()
+        th.join(timeout=args.leg_timeout + 60)
+        if "gauntlet" not in result:
+            raise SystemExit(
+                f"gauntlet leg never finished: "
+                f"{result.get('error', 'timeout')}")
+        gauntlet = result["gauntlet"]
+
+    counters_after = dict(counters.counters(total=True))
+    preempts = flight_preempt_events(flight_dir)
+    trajectory = check_trajectory(ref["records"], gauntlet["records"])
+
+    report["gauntlet"] = {k: gauntlet[k] for k in
+                          ("ok", "world_id", "committed_steps",
+                           "resized", "step_p50_s", "step_p90_s",
+                           "supervisor")}
+    report["gauntlet"]["done"] = len(gauntlet["done"])
+    report["gauntlet"]["commit_samples"] = gauntlet["commit_samples"]
+    report["trajectory"] = trajectory
+    report["preempt_events"] = preempts
+    report["serve"] = serve
+    report["replan"] = (None if replan is None else
+                        {k: replan[k] for k in
+                         ("events", "replans", "swapped", "reverted")})
+    report["counters"] = {"before_gauntlet": counters_before,
+                          "after": counters_after}
+
+    # ---- gates --------------------------------------------------------
+    gates = report["gates"]
+    gates["reference_ok"] = {
+        "pass": ref["ok"] and len(ref["done"]) == 3,
+        "detail": f"ok={ref['ok']} done={len(ref['done'])}"}
+    gates["gauntlet_recovered"] = {
+        "pass": (gauntlet["ok"] and gauntlet["world_id"] >= 1
+                 and len(gauntlet["done"]) >= 2
+                 and bool(gauntlet["committed_steps"])
+                 and gauntlet["committed_steps"][-1] == args.batches),
+        "detail": (f"ok={gauntlet['ok']} world_id="
+                   f"{gauntlet['world_id']} done="
+                   f"{len(gauntlet['done'])} committed="
+                   f"{gauntlet['committed_steps']}")}
+    gates["resize_happened"] = {
+        "pass": bool(gauntlet["resized"]),
+        "detail": f"resized={gauntlet['resized']}"}
+    gates["loss_trajectory"] = {
+        "pass": (trajectory["within_tol"]
+                 and trajectory["batches_compared"] > 0),
+        "detail": (f"max|err|={trajectory['max_abs_err']:.2e} over "
+                   f"{trajectory['batches_compared']} batches "
+                   f"(tol {TRAJECTORY_TOL:g})")}
+    ref_p50 = ref["step_p50_s"] or args.batch_sleep
+    gates["step_time"] = {
+        "pass": (gauntlet["step_p50_s"] is not None
+                 and gauntlet["step_p50_s"] <= 10 * ref_p50),
+        "detail": (f"gauntlet p50 {gauntlet['step_p50_s']} s vs "
+                   f"reference p50 {ref_p50} s (gate 10x)")}
+    samples = [s["latest"] for s in gauntlet["commit_samples"]]
+    gates["commit_cadence"] = {
+        "pass": (len(gauntlet["committed_steps"]) >= 2
+                 and all(a <= b for a, b in zip(samples, samples[1:]))),
+        "detail": (f"{len(gauntlet['committed_steps'])} live commits, "
+                   f"latest-step samples monotone="
+                   f"{all(a <= b for a, b in zip(samples, samples[1:]))}")}
+    met = [e for e in preempts if e.get("deadline_met")]
+    gates["priority_snapshot"] = {
+        "pass": len(met) >= 1,
+        "detail": (f"{len(met)} deadline-met of {len(preempts)} "
+                   f"RESILIENCE:PREEMPT events in flight dumps")}
+    flap_seen = (counters_after.get("chaos.flap", 0)
+                 - counters_before.get("chaos.flap", 0))
+    gates["flap_injected"] = {
+        "pass": flap_seen >= 1,
+        "detail": f"chaos.flap delta={flap_seen}"}
+    monotone = all(counters_after.get(k, 0) >= v
+                   for k, v in counters_before.items())
+    gates["counters_monotone"] = {
+        "pass": monotone,
+        "detail": "all driver-process counters non-decreasing"}
+    if not args.smoke:
+        gates["serve_no_drops"] = {
+            "pass": (serve["dropped"] == 0
+                     and serve["latency_p99_ms"] > 0),
+            "detail": (f"{serve['dropped']} dropped, p99 "
+                       f"{serve['latency_p99_ms']} ms, "
+                       f"{serve['resizes']} resizes")}
+        gates["replan_swap_back"] = {
+            "pass": (replan["swapped"] and replan["reverted"]
+                     and any(r["reverted"]
+                             for r in replan["replans"])),
+            "detail": (f"swapped={replan['swapped']} "
+                       f"reverted={replan['reverted']} "
+                       f"replans={len(replan['replans'])}")}
+
+    report["wall_s"] = round(time.monotonic() - t_start, 1)
+    failed = [name for name, g in gates.items() if not g["pass"]]
+    report["ok"] = not failed
+    report_path = args.report or os.path.join(workdir,
+                                              "soak_report.json")
+    with open(report_path, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    log(f"report: {report_path}")
+    for name, g in gates.items():
+        log(f"gate {name}: {'PASS' if g['pass'] else 'FAIL'} "
+            f"({g['detail']})")
+    if failed:
+        log(f"SOAK FAILED: {failed}")
+    else:
+        log(f"SOAK PASSED ({report['wall_s']}s)")
+    return len(failed)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="the production soak gauntlet (docs/robustness.md)")
+    parser.add_argument("--batches", type=int, default=14)
+    parser.add_argument("--batch-sleep", type=float, default=0.15)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--serve-requests", type=int, default=36)
+    parser.add_argument("--leg-timeout", type=float, default=300.0)
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("--report", default=None,
+                        help="soak-report JSON path (default: in the "
+                             "workdir)")
+    parser.add_argument("--smoke", action="store_true",
+                        help="CI shape: one preemption + one flap + one "
+                             "resize, training legs only")
+    args = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.exit(min(run(args), 125))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+#!/bin/sh
+# The production soak gauntlet (docs/robustness.md) on an 8-device CPU
+# mesh: reference run, chaos gauntlet (preempt + crash + stall + flap +
+# resize) with a live serve trace and the degraded-link replan leg, all
+# gated from the soak-report JSON. Exit code = number of failed gates.
+#
+#   scripts/soak.sh [--report out.json] [extra soak.py args]
+set -e
+cd "$(dirname "$0")/.."
+XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+JAX_PLATFORMS=cpu \
+exec python scripts/soak.py "$@"

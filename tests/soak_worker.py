@@ -1,0 +1,119 @@
+"""Soak-gauntlet worker: the durable elastic worker with the resilience
+supervisor attached.
+
+Extends the ``--ckpt-dir`` mode of tests/elastic_worker.py with the
+self-healing pieces scripts/soak.py exercises: the flight recorder is
+armed (SIGTERM handler installed), and a
+:class:`horovod_tpu.resilience.Supervisor` registers a priority-snapshot
+provider so a preemption notice — the chaos ``preempt`` action delivers
+a real SIGTERM mid-collective — commits the newest uncommitted state
+through the AsyncWriter *before* the flight dump re-delivers the signal.
+The deterministic batch-dependent trajectory (world-size-normalized
+``cos(0.3 * batch)`` contributions) depends only on the batch number, so
+the gauntlet's resized/interrupted trajectory is comparable point-for-
+point against an uninterrupted reference run.
+
+Logs one JSON line per batch to --log-file:
+``{identity, rank, size, batch, weights, t}``.
+"""
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic, resilience  # noqa: E402
+from horovod_tpu.monitor import flight  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--log-file", required=True)
+    p.add_argument("--batches", type=int, default=12)
+    p.add_argument("--batch-sleep", type=float, default=0.1)
+    p.add_argument("--ckpt-dir", required=True)
+    args = p.parse_args()
+
+    identity = (f"{os.environ['HOROVOD_HOSTNAME']}:"
+                f"{os.environ['HOROVOD_LOCAL_RANK']}")
+
+    def log(record):
+        record["identity"] = identity
+        record["t"] = time.time()
+        with open(args.log_file, "a") as f:
+            f.write(json.dumps(record) + "\n")
+
+    # Crash forensics + the SIGTERM ordering contract (snapshot → writer
+    # drain → dump → re-delivery) both hang off arm().
+    flight.arm()
+
+    from horovod_tpu import checkpoint as hvd_ckpt
+
+    mgr = hvd_ckpt.CheckpointManager(args.ckpt_dir, keep=4)
+    start_batch, start_weights = 0, 0.0
+    latest = mgr.latest_step()
+    if latest is not None:
+        manifest, tree = mgr.restore()
+        start_batch = manifest.step
+        start_weights = float(np.asarray(tree["train"]["weights"])[0])
+    log({"resumed_from": latest or 0, "start_weights": start_weights})
+
+    # The priority-snapshot provider reads the live (possibly not yet
+    # rank-0-committed) state; weights are replicated, so ANY preempted
+    # rank's snapshot is a valid commit for the whole world.
+    live = {"batch": start_batch, "weights": start_weights}
+
+    def provider():
+        b = int(live["batch"])
+        if b <= 0:
+            return None
+        return b, {"train": {"weights": np.full(
+            (4,), live["weights"], dtype=np.float64)}}, \
+            {"src": "priority", "identity": identity}
+
+    sup = resilience.Supervisor(ckpt_manager=mgr,
+                                snapshot_provider=provider).attach()
+
+    @elastic.run
+    def train(state):
+        while state.batch < args.batches:
+            contrib = jnp.full((4,), math.cos(0.3 * state.batch),
+                               dtype=jnp.float32)
+            total = hvd.allreduce(contrib, op=hvd.Sum,
+                                  name=f"train.step.{state.batch}")
+            state.weights = (state.weights
+                             + float(total[0]) / hvd.size())
+            state.batch += 1
+            live["batch"], live["weights"] = state.batch, state.weights
+            log({"rank": hvd.rank(), "size": hvd.size(),
+                 "batch": state.batch, "weights": state.weights})
+            state.commit()
+            if hvd.rank() == 0:
+                mgr.save(state.batch, {"train": {
+                    "weights": np.full((4,), state.weights,
+                                       dtype=np.float64)}})
+            time.sleep(args.batch_sleep)
+
+    state = elastic.ObjectState(batch=start_batch, weights=start_weights)
+    train(state)
+    mgr.wait(30)
+    sup.detach()
+    mgr.close()
+    log({"rank": hvd.rank(), "size": hvd.size(), "done": True,
+         "weights": state.weights})
+
+
+if __name__ == "__main__":
+    main()

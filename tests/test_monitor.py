@@ -597,6 +597,58 @@ class TestPerfGateSnapshot:
         assert rec["perf_gate"]["pass"] is False
 
 
+class TestPerfGateTrainBaselineFallback:
+    """Empty-trajectory train legs gate against (or self-seed) the
+    committed BENCH_train_baseline.json instead of silently passing."""
+
+    def _mod(self, tmp_path):
+        mod = _load_gate_module()
+        mod.TRAIN_BASELINE = str(tmp_path / "BENCH_train_baseline.json")
+        return mod
+
+    def test_first_run_seeds_then_gates(self, tmp_path):
+        mod = self._mod(tmp_path)
+        rec = {"metric": "img_per_sec", "platform": "testplat",
+               "value": 100.0}
+        assert mod._train_baseline_gate(rec, "train", 0.6, False) == 0
+        seeded = json.loads(open(mod.TRAIN_BASELINE).read())
+        assert seeded["img_per_sec|testplat"]["value"] == 100.0
+        # Within tolerance of the seeded baseline: pass.
+        ok = dict(rec, value=70.0)
+        assert mod._train_baseline_gate(ok, "train", 0.6, False) == 0
+        # A regression below the floor: fail.
+        bad = dict(rec, value=10.0)
+        assert mod._train_baseline_gate(bad, "train", 0.6, False) == 1
+        # PERF_GATE_UPDATE re-seeds instead of gating.
+        assert mod._train_baseline_gate(bad, "train", 0.6, True) == 0
+        seeded = json.loads(open(mod.TRAIN_BASELINE).read())
+        assert seeded["img_per_sec|testplat"]["value"] == 10.0
+
+    def test_keys_are_metric_and_platform_scoped(self, tmp_path):
+        mod = self._mod(tmp_path)
+        a = {"metric": "img_per_sec", "platform": "cpu", "value": 50.0}
+        b = {"metric": "img_per_sec", "platform": "tpu", "value": 9.0}
+        assert mod._train_baseline_gate(a, "train", 0.6, False) == 0
+        # A different platform seeds its own key; no cross-gating.
+        assert mod._train_baseline_gate(b, "train", 0.6, False) == 0
+        seeded = json.loads(open(mod.TRAIN_BASELINE).read())
+        assert set(seeded) == {"img_per_sec|cpu", "img_per_sec|tpu"}
+
+    def test_non_numeric_value_is_a_usage_error(self, tmp_path):
+        mod = self._mod(tmp_path)
+        rec = {"metric": "img_per_sec", "platform": "cpu"}
+        assert mod._train_baseline_gate(rec, "train", 0.6, False) == 2
+
+    def test_corrupt_baseline_reseeds(self, tmp_path):
+        mod = self._mod(tmp_path)
+        with open(mod.TRAIN_BASELINE, "w") as f:
+            f.write("{not json")
+        rec = {"metric": "img_per_sec", "platform": "cpu", "value": 5.0}
+        assert mod._train_baseline_gate(rec, "train", 0.6, False) == 0
+        seeded = json.loads(open(mod.TRAIN_BASELINE).read())
+        assert seeded["img_per_sec|cpu"]["value"] == 5.0
+
+
 # ---------------------------------------------------------------------------
 # Flight recorder (monitor/flight.py)
 

@@ -1,0 +1,560 @@
+"""Tests for horovod_tpu/resilience/: the failure-policy state machine,
+health-gated readmission, the preemption priority-snapshot path (unit +
+mid-save SIGTERM subprocess regression), and degraded-link replanning
+end-to-end through chaos delay → latch → quantized swap → swap-back.
+See docs/robustness.md."""
+
+import glob
+import json
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import chaos, resilience
+from horovod_tpu.common import counters as counters_mod
+from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+from horovod_tpu.monitor.registry import MetricsRegistry
+from horovod_tpu.monitor.straggler import StragglerDetector
+from horovod_tpu.resilience import policy as policy_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Policy state machine (resilience/policy.py)
+
+
+class TestPolicyEngine:
+    def _engine(self, **policies):
+        return policy_mod.PolicyEngine(
+            policies=policies, registry=MetricsRegistry(enabled=True))
+
+    def test_budget_then_escalation_ladder(self):
+        eng = self._engine()
+        # worker_crash: budget 2 → retry, retry, then one ladder rung
+        # per further failure, clamped at abort.
+        actions = [eng.record_failure("worker_crash", key="hostX").action
+                   for _ in range(6)]
+        assert actions == ["retry", "retry", "blacklist", "shrink_world",
+                           "abort", "abort"]
+
+    def test_backoff_doubles_and_caps(self):
+        eng = self._engine(worker_crash=policy_mod.Policy(
+            retry_budget=6, backoff_base_secs=1.0, backoff_cap_secs=4.0))
+        backs = [eng.record_failure("worker_crash").backoff_secs
+                 for _ in range(5)]
+        assert backs == [1.0, 2.0, 4.0, 4.0, 4.0]
+
+    def test_success_resets_the_counter(self):
+        eng = self._engine()
+        eng.record_failure("worker_crash", key="hostX")
+        eng.record_failure("worker_crash", key="hostX")
+        eng.record_success("worker_crash", key="hostX")
+        assert eng.failures("worker_crash", "hostX") == 0
+        # ...and the ladder restarts from retry, not where it left off.
+        assert eng.record_failure("worker_crash",
+                                  key="hostX").action == "retry"
+
+    def test_keys_are_independent(self):
+        eng = self._engine()
+        for _ in range(4):
+            eng.record_failure("worker_crash", key="hostA")
+        assert eng.record_failure("worker_crash",
+                                  key="hostB").action == "retry"
+
+    def test_ladder_start_skips_blacklist_for_flaps(self):
+        # No specific host is at fault in a discovery flap: the ladder
+        # enters at shrink_world.
+        eng = self._engine()
+        for _ in range(5):
+            eng.record_failure("discovery_flap")
+        assert eng.record_failure("discovery_flap").action == \
+            "shrink_world"
+
+    def test_class_specific_first_responses(self):
+        eng = self._engine()
+        assert eng.record_failure("preemption").action == "snapshot"
+        assert eng.record_failure("degraded_link",
+                                  key="dcn").action == "replan"
+        assert eng.record_failure("stall").action == "blacklist"
+
+    def test_unknown_class_rejected(self):
+        with pytest.raises(ValueError):
+            self._engine().record_failure("cosmic_rays")
+
+    def test_counters_and_snapshot(self):
+        reg = MetricsRegistry(enabled=True)
+        eng = policy_mod.PolicyEngine(registry=reg)
+        for _ in range(3):
+            eng.record_failure("worker_crash", key="hostX")
+        eng.record_success("worker_crash", key="hostX")
+        snap = reg.snapshot()
+        assert snap["counters"][
+            "resilience.failures{cls=worker_crash}"] == 3
+        assert snap["counters"][
+            "resilience.escalations{action=blacklist,"
+            "cls=worker_crash}"] == 1
+        assert snap["counters"][
+            "resilience.recoveries{cls=worker_crash}"] == 1
+        state = eng.snapshot()
+        assert state["failures"] == {}
+        assert [d["action"] for d in state["decisions"]] == \
+            ["retry", "retry", "blacklist"]
+
+
+class TestReadmissionGate:
+    def test_default_probe_passes(self):
+        gate = policy_mod.ReadmissionGate(
+            registry=MetricsRegistry(enabled=True))
+        assert gate("hostA") is True
+
+    def test_failing_and_raising_probes_block(self):
+        reg = MetricsRegistry(enabled=True)
+
+        def probe(host):
+            if host == "bad":
+                return False
+            raise RuntimeError("probe transport down")
+
+        gate = policy_mod.ReadmissionGate(probe=probe, registry=reg)
+        assert gate("bad") is False
+        assert gate("worse") is False
+        snap = reg.snapshot()
+        assert snap["counters"][
+            "resilience.readmission{verdict=fail}"] == 2
+
+    def test_host_manager_readmission_is_health_gated(self):
+        # The wiring end-to-end: supervisor attach installs the gate on
+        # the driver's HostManager; a failing probe re-arms the
+        # cooldown, a passing one readmits.
+        counters_mod.reset_all()
+        verdicts = {"b": [False, True]}  # first probe fails, second passes
+
+        class _Driver:
+            host_manager = HostManager(FixedHosts({"a": 1, "b": 1}),
+                                       cooldown_secs=0.15)
+
+        sup = resilience.Supervisor(
+            driver=_Driver(),
+            readmission_probe=lambda h: verdicts[h].pop(0),
+            registry=MetricsRegistry(enabled=True)).attach()
+        try:
+            hm = _Driver.host_manager
+            hm.update_available_hosts()
+            hm.blacklist("b")
+            assert hm.is_blacklisted("b")
+            time.sleep(0.2)
+            assert hm.is_blacklisted("b")  # probe #1 fails → re-armed
+            assert counters_mod.counters()[
+                "elastic.blacklist.probe_fail"] == 1
+            time.sleep(0.2)
+            assert not hm.is_blacklisted("b")  # probe #2 passes
+            assert counters_mod.counters()[
+                "elastic.blacklist.readmit"] == 1
+        finally:
+            sup.detach()
+            counters_mod.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: preemption priority snapshot + restart budget
+
+
+class _FakeCkptManager:
+    def __init__(self, latest=None, wait_result=True):
+        self.latest = latest
+        self.wait_result = wait_result
+        self.saves = []
+        self.waits = []
+
+    def latest_step(self):
+        return self.latest
+
+    def save(self, step, tree, extra=None, **kw):
+        self.saves.append((step, tree, extra))
+        self.latest = step
+
+    def wait(self, timeout=None):
+        self.waits.append(timeout)
+        return self.wait_result
+
+
+class TestSupervisorPreemption:
+    def _sup(self, mgr, provider, **kw):
+        kw.setdefault("registry", MetricsRegistry(enabled=True))
+        return resilience.Supervisor(ckpt_manager=mgr,
+                                     snapshot_provider=provider, **kw)
+
+    def test_priority_snapshot_commits_under_deadline(self):
+        mgr = _FakeCkptManager()
+        sup = self._sup(
+            mgr, lambda: (9, {"w": np.ones(2)}, {"src": "priority"}),
+            snapshot_deadline_secs=5.0)
+        event = sup.on_preemption_notice(source="test")
+        assert event["saved_step"] == 9
+        assert event["committed"] is True
+        assert event["deadline_met"] is True
+        assert event["policy_action"] == "snapshot"
+        step, _tree, extra = mgr.saves[0]
+        assert step == 9 and extra == {"src": "priority"}
+        assert mgr.waits and mgr.waits[0] <= 5.0
+        assert sup.report()["preemptions"][0]["saved_step"] == 9
+
+    def test_nothing_newer_than_last_commit_skips_the_save(self):
+        mgr = _FakeCkptManager(latest=12)
+        sup = self._sup(mgr, lambda: (12, {"w": np.ones(2)}, None))
+        event = sup.on_preemption_notice()
+        assert mgr.saves == []          # no duplicate commit...
+        assert mgr.waits                # ...but in-flight writes drain
+        assert event["saved_step"] == 12
+        assert event["deadline_met"] is True
+
+    def test_missed_deadline_is_reported(self):
+        mgr = _FakeCkptManager(wait_result=False)  # never quiesces
+        sup = self._sup(mgr, lambda: (3, {}, None),
+                        snapshot_deadline_secs=0.01)
+        event = sup.on_preemption_notice()
+        assert event["committed"] is False
+        assert event["deadline_met"] is False
+
+    def test_provider_failure_never_raises(self):
+        def provider():
+            raise RuntimeError("state is mid-update")
+
+        sup = self._sup(_FakeCkptManager(), provider)
+        event = sup.on_preemption_notice()
+        assert event["saved_step"] is None
+
+    def test_restart_budget(self):
+        sup = resilience.Supervisor(
+            restart_budget=2, registry=MetricsRegistry(enabled=True))
+        assert sup.restart_allowed()
+        assert sup.record_restart(restored_step=4) is True
+        assert sup.record_restart(restored_step=7) is True
+        assert not sup.restart_allowed()
+        assert sup.record_restart(restored_step=7) is False
+        rep = sup.report()
+        assert rep["restarts"] == 3 and rep["restart_budget"] == 2
+
+
+MIDSAVE_SCRIPT = textwrap.dedent("""\
+    import os, signal, sys, time
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from horovod_tpu.monitor import flight
+    from horovod_tpu import checkpoint as ck
+
+    flight.arm()
+    mgr = ck.CheckpointManager(sys.argv[1], keep=2)
+    # Occupy the writer thread so the real save below is still in
+    # flight (queued behind it) when the SIGTERM lands: the ordering
+    # contract (hooks -> writer drain -> dump -> re-deliver) must hold
+    # the signal until the commit completes.
+    mgr._writer.submit(lambda: time.sleep(1.0))
+    mgr.save(7, {{"train": {{"w": np.arange(8.0)}}}},
+             extra={{"src": "midsave"}}, blocking=False)
+    os.kill(os.getpid(), signal.SIGTERM)
+    time.sleep(30)  # never reached: the handler re-delivers SIGTERM
+""")
+
+
+class TestSigtermMidSaveOrdering:
+    @pytest.mark.chaos
+    def test_sigterm_drains_the_inflight_save_before_dump(self, tmp_path):
+        """Regression for the SIGTERM ordering contract: a save whose
+        commit is in flight when the signal lands must complete (writer
+        drain) before the flight dump re-delivers SIGTERM."""
+        script = tmp_path / "midsave.py"
+        script.write_text(MIDSAVE_SCRIPT.format(repo=REPO))
+        ckpt_dir = str(tmp_path / "ckpt")
+        flight_dir = str(tmp_path / "flight")
+        env = dict(os.environ, PYTHONPATH=REPO,
+                   HOROVOD_FLIGHT_RECORDER_DIR=flight_dir,
+                   HOROVOD_SIGTERM_DRAIN_SECS="10")
+        env.pop("XLA_FLAGS", None)
+        proc = subprocess.run(
+            [sys.executable, str(script), ckpt_dir],
+            env=env, capture_output=True, text=True, timeout=120)
+        # Re-delivered SIGTERM, not a clean exit.
+        assert proc.returncode in (-signal.SIGTERM, 143), \
+            (proc.returncode, proc.stderr)
+        # The in-flight commit landed whole: manifest-last protocol +
+        # pre-dump drain ⇒ restorable, with the extra payload intact.
+        from horovod_tpu import checkpoint as ck
+
+        mgr = ck.CheckpointManager(ckpt_dir, async_save=False)
+        manifest, tree = mgr.restore()
+        assert manifest.step == 7
+        assert manifest.extra.get("src") == "midsave"
+        np.testing.assert_array_equal(
+            np.asarray(tree["train"]["w"]), np.arange(8.0))
+        # ...and the black box recorded the signal as the reason.
+        dumps = glob.glob(os.path.join(flight_dir, "flight_*.json"))
+        assert dumps, proc.stderr
+        reasons = {json.load(open(p)).get("reason") for p in dumps}
+        assert "sigterm" in reasons
+
+
+# ---------------------------------------------------------------------------
+# Degraded-link replanning
+
+
+class _FakeDetector:
+    def __init__(self):
+        self.state = {}
+
+    def degraded_hops(self):
+        return dict(self.state)
+
+
+class TestSupervisorReplan:
+    def test_swap_holds_and_reverts(self):
+        det = _FakeDetector()
+        sup = resilience.Supervisor(
+            straggler=det, registry=MetricsRegistry(enabled=True))
+        det.state = {"dcn": 4.0}
+        directive = sup.maybe_replan(1 << 20, mesh_shape=(2, 4), step=3)
+        assert directive and "swap" in directive
+        rec = directive["decision"]
+        assert rec.hop == "dcn" and rec.step == 3
+        assert rec.plan_after and "int8" in rec.plan_after
+        assert rec.plan_before and "int8" not in rec.plan_before
+        assert rec.predicted_ms > 0
+        assert "dcn" in sup.active_swaps()
+        # Still degraded: the swap holds, no re-decision every step.
+        assert sup.maybe_replan(1 << 20, mesh_shape=(2, 4),
+                                step=4) is None
+        # Latch cleared: revert, recorded on the same decision.
+        det.state = {}
+        revert = sup.maybe_replan(1 << 20, mesh_shape=(2, 4), step=9)
+        assert revert and revert.get("revert") and revert["hop"] == "dcn"
+        assert sup.active_swaps() == {}
+        report = sup.report()
+        assert report["replans"][0]["reverted"] is True
+        assert report["replans"][0]["step"] == 3
+
+    def test_no_detector_and_no_degradation_are_quiet(self):
+        det = _FakeDetector()
+        sup = resilience.Supervisor(
+            straggler=det, registry=MetricsRegistry(enabled=True))
+        assert sup.maybe_replan(1 << 20, mesh_shape=(2, 4)) is None
+
+    @pytest.mark.chaos
+    def test_chaos_delay_to_quantized_swap_and_back(self):
+        """End-to-end: chaos ``delay`` on the eager collective inflates
+        the probe's wire time → the straggler latch flags the DCN hop →
+        the supervisor re-prices under the EWMA override and swaps the
+        step to the quantized wire → the delay expires, the latch
+        clears, and the swap reverts."""
+        from horovod_tpu.plan import cost as _cost
+
+        chaos.reset()
+        # Gate 4x with patience 2: the injected 60 ms delay scores
+        # hundreds of x over the sub-ms healthy baseline, while CI
+        # scheduling noise on the healthy probe stays within ~2x.
+        det = StragglerDetector(registry=MetricsRegistry(enabled=True),
+                                link_drift_gate=4.0, patience=2)
+        sup = resilience.Supervisor(
+            straggler=det, registry=MetricsRegistry(enabled=True))
+        probe = np.zeros((64,), np.float32)
+        nbytes = float(probe.nbytes)
+        predicted = _cost.predict_hop_ms("dcn", nbytes)
+
+        def probe_ms():
+            t0 = time.perf_counter()
+            hvd.allreduce(probe, name="test.replan.probe") \
+                .block_until_ready()
+            return (time.perf_counter() - t0) * 1e3
+
+        for _ in range(3):
+            probe_ms()  # warm the eager path before baselining
+        baseline = float(np.median([probe_ms() for _ in range(3)]))
+        # A 60 ms injected delay dwarfs any CI timing noise around the
+        # sub-ms healthy baseline.
+        chaos.configure(chaos.FaultPlan(seed=3).add(
+            "collective.eager", "delay", secs=0.06, max_count=3))
+        try:
+            quantized = False
+            swaps, reverts = [], []
+            for step in range(16):
+                hvd.allreduce(np.ones((8,), np.float32),
+                              name=f"test.replan.step.{step}",
+                              quantized=quantized).block_until_ready()
+                measured = probe_ms()
+                det.observe_wire("dcn", nbytes,
+                                 predicted * measured
+                                 / max(baseline, 1e-6))
+                if measured < 1.5 * baseline:
+                    # Track healthy drift so the ratio stays ~1 once
+                    # the injected delay expires (the soak leg's rule).
+                    baseline = 0.5 * baseline + 0.5 * measured
+                d = sup.maybe_replan(nbytes, mesh_shape=(2, 4),
+                                     step=step)
+                if d and "swap" in d:
+                    quantized = True
+                    swaps.append(step)
+                elif d and d.get("revert"):
+                    quantized = False
+                    reverts.append(step)
+                if reverts:
+                    break
+            assert swaps, "degraded latch never produced a swap"
+            assert reverts, "recovered link never reverted the swap"
+            assert swaps[0] < reverts[0]
+            report = sup.report()
+            assert report["replans"][0]["reverted"] is True
+            assert "int8" in report["replans"][0]["plan_after"]
+        finally:
+            chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# Chaos ``preempt`` action (chaos/plan.py + injector.py)
+
+
+class TestPreemptAction:
+    def test_in_grammar_and_round_trips(self):
+        assert "preempt" in chaos.ACTIONS
+        spec = chaos.FaultSpec.parse(
+            "collective.eager:preempt,where=hostB:0,after=3,"
+            "max=1,secs=0.5")
+        assert spec.action == "preempt" and spec.secs == 0.5
+        again = chaos.FaultSpec.parse(spec.serialize())
+        assert again.serialize() == spec.serialize()
+        plan = chaos.FaultPlan(seed=11, specs=[spec])
+        restored = chaos.FaultPlan.from_env(plan.to_env())
+        assert [s.serialize() for s in restored.specs] == \
+            [spec.serialize()]
+
+    def test_immediate_preempt_delivers_sigterm(self):
+        counters_mod.reset_all()
+        got = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda sig, frame: got.append(sig))
+        try:
+            chaos.configure(chaos.FaultPlan(seed=1).add(
+                "test.preempt", "preempt", max_count=1))
+            chaos.inject("test.preempt")
+            deadline = time.monotonic() + 2.0
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert got == [signal.SIGTERM]
+            assert counters_mod.counters()["chaos.preempt"] == 1
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            chaos.reset()
+            counters_mod.reset_all()
+
+    def test_grace_delay_defers_delivery(self):
+        got = []
+        prev = signal.signal(signal.SIGTERM,
+                             lambda sig, frame: got.append(sig))
+        try:
+            chaos.configure(chaos.FaultPlan(seed=1).add(
+                "test.preempt.grace", "preempt", secs=0.15,
+                max_count=1))
+            chaos.inject("test.preempt.grace")
+            assert got == []  # the grace window
+            deadline = time.monotonic() + 3.0
+            while not got and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert got == [signal.SIGTERM]
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            chaos.reset()
+            counters_mod.reset_all()
+
+
+# ---------------------------------------------------------------------------
+# Preemption end-to-end through a real elastic worker (the gauntlet's
+# smallest slice): chaos preempt → SIGTERM → priority snapshot →
+# committed checkpoint + sigterm flight dump, survivors re-form.
+
+
+WORKER = os.path.join(REPO, "tests", "soak_worker.py")
+
+
+class TestPreemptionEndToEnd:
+    @pytest.mark.chaos
+    @pytest.mark.slow
+    def test_preempted_worker_commits_a_priority_snapshot(self, tmp_path):
+        from horovod_tpu.elastic import constants
+        from horovod_tpu.elastic.discovery import HostDiscoveryScript
+        from horovod_tpu.elastic.driver import ElasticDriver
+        from horovod_tpu.runner import safe_shell_exec
+
+        chaos.reset()
+        counters_mod.reset_all()
+        constants.DISCOVER_HOSTS_FREQUENCY_SECS = 0.25
+        flight_dir = str(tmp_path / "flight")
+        ckpt_dir = str(tmp_path / "ckpt")
+        log_file = str(tmp_path / "log.jsonl")
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho hostA:2\necho hostB:1\n")
+        script.chmod(0o755)
+        plan = chaos.FaultPlan(seed=5).add(
+            "collective.eager", "preempt", where="hostB:0", after=3,
+            max_count=1)
+        driver = ElasticDriver(HostDiscoveryScript(str(script), 1),
+                               min_np=2, max_np=3,
+                               controller_addr_override="127.0.0.1")
+
+        def _exec(slot, world_id):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "PYTHONPATH": REPO,
+                "HOROVOD_HOSTNAME": slot.hostname,
+                "HOROVOD_LOCAL_RANK": str(slot.local_rank),
+                "HOROVOD_ELASTIC": "1",
+                "HOROVOD_ELASTIC_DRIVER_ADDR": "127.0.0.1",
+                "HOROVOD_ELASTIC_DRIVER_PORT": str(driver.service_port),
+                "HOROVOD_ELASTIC_DRIVER_KEY": driver.key.hex(),
+                "HOROVOD_START_TIMEOUT": "30",
+                "HOROVOD_FLIGHT_RECORDER_DIR": flight_dir,
+            })
+            if world_id == 0:
+                env.update(plan.to_env())
+            cmd = " ".join(shlex.quote(c) for c in [
+                sys.executable, WORKER, "--log-file", log_file,
+                "--batches", "8", "--batch-sleep", "0.1",
+                "--ckpt-dir", ckpt_dir])
+            return safe_shell_exec.execute(cmd, env=env)
+
+        try:
+            driver.start(_exec)
+            ok = driver.join(timeout=240)
+        finally:
+            driver.stop()
+            driver.shutdown_service()
+            chaos.reset()
+        assert ok
+        assert driver.world_id >= 1  # the preemption forced a re-form
+        # The preempted rank's flight dump carries a deadline-met
+        # RESILIENCE:PREEMPT event.
+        events = []
+        for path in glob.glob(os.path.join(flight_dir, "flight_*.json")):
+            dump = json.load(open(path))
+            events += [(dump.get("reason"), ev.get("args") or {})
+                       for ev in dump.get("events", [])
+                       if ev.get("name") == "RESILIENCE:PREEMPT"]
+        assert events, "no RESILIENCE:PREEMPT in any flight dump"
+        reason, args = events[0]
+        assert reason == "sigterm"
+        assert args.get("deadline_met") is True
+        assert args.get("committed") is True
+        # The run completed all batches on the re-formed world and the
+        # final commit is restorable.
+        from horovod_tpu import checkpoint as ck
+
+        mgr = ck.CheckpointManager(ckpt_dir, async_save=False)
+        manifest, _tree = mgr.restore()
+        assert manifest.step == 8
