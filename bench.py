@@ -2065,7 +2065,10 @@ def run_serve(args, devices, platform, mesh_shape):
     max_slots = args.serve_max_slots
     p_lo, p_hi = args.serve_prompt_len
     n_lo, n_hi = args.serve_max_new
-    pages_per_slot = -(-(p_hi + n_hi + 1) // page_size)
+    shared_len = getattr(args, "shared_prefix_len", 0) or 0
+    spec_k = getattr(args, "spec_decode", 0) or 0
+    disagg = getattr(args, "disagg", None)
+    pages_per_slot = -(-(shared_len + p_hi + n_hi + 1) // page_size)
     # Pool sized for ~75% occupancy at full slots: admission pressure is
     # real (the scheduler's page-availability policy actually gates) but
     # a lone big request can always run.
@@ -2105,59 +2108,159 @@ def run_serve(args, devices, platform, mesh_shape):
         raise SystemExit(f"decode/full-context parity FAILED: "
                          f"{parity_err} > {tol}")
 
-    n_replicas = args.serve_replicas
+    n_replicas = (sum(disagg) if disagg else args.serve_replicas)
     if n_chips % max(1, n_replicas):
-        raise SystemExit(f"--serve-replicas {n_replicas} does not "
-                         f"partition {n_chips} chips")
+        what = (f"--disagg {disagg[0]}:{disagg[1]}" if disagg
+                else f"--serve-replicas {n_replicas}")
+        raise SystemExit(f"{what} does not partition {n_chips} chips")
     trace = PoissonTrace(rate=args.serve_rate,
                          num_requests=args.serve_requests,
                          seed=12345, prompt_len=(p_lo, p_hi),
                          max_new_tokens=(n_lo, n_hi),
                          vocab_size=cfg.vocab_size, eos_id=1)
-    rset = ReplicaSet(cfg, params, pc, devices=devices,
-                      n_replicas=n_replicas, eos_id=1)
-    for req in trace:
-        rset.submit(req)
+    specs = [(list(r.prompt), r.max_new_tokens, r.arrival_time)
+             for r in trace]
+    total = len(specs)
+    if shared_len:
+        # Multi-tenant shared-prefix trace (docs/serving.md): a few
+        # tenants each pin one fixed prefix; request i joins tenant
+        # i % T, so every tenant's later arrivals can hit the prefix
+        # pages its earlier requests registered.
+        n_tenants = max(1, min(3, total // 4))
+        rs_pre = np.random.RandomState(99)
+        prefixes = []
+        for _ in range(n_tenants):
+            toks = rs_pre.randint(0, cfg.vocab_size, size=shared_len)
+            toks = np.where(toks == 1, 2, toks)
+            prefixes.append([int(t) for t in toks])
+        specs = [(prefixes[i % n_tenants] + p, n, a)
+                 for i, (p, n, a) in enumerate(specs)]
+
+    from horovod_tpu.serve import Request
+
+    def mkreqs():
+        # Fresh Request objects per leg: engines mutate them in place.
+        return [Request(req_id=i, prompt=list(p), max_new_tokens=n,
+                        arrival_time=a)
+                for i, (p, n, a) in enumerate(specs)]
 
     # Manual trace loop so the elastic resize triggers on PROGRESS (a
     # third / two-thirds of the trace complete), not a step count that
     # depends on machine speed.
     import time as _time
 
-    total = len(trace)
-    resize_down_at = max(1, total // 3)
-    resize_up_at = max(2, (2 * total) // 3)
-    did_down = did_up = False
-    t0 = _time.monotonic()
-    steps = 0
-    while rset.has_work:
-        now = _time.monotonic() - t0
-        done = (len(rset.stats.completed)
-                + sum(len(e.stats.completed) for e in rset.engines))
-        if args.serve_resize and not did_down and done >= resize_down_at \
-                and n_replicas > 1:
-            rset.resize(max(1, n_replicas // 2), now)
-            did_down = True
-            log(f"resize: {n_replicas} -> {max(1, n_replicas // 2)} "
-                f"replicas at {done}/{total} complete "
-                f"({rset.resize_events[-1]['in_flight']} in-flight "
-                f"migrated)")
-        if args.serve_resize and did_down and not did_up \
-                and done >= resize_up_at and n_replicas > 1:
-            rset.resize(n_replicas, now)
-            did_up = True
-            log(f"resize: back to {n_replicas} replicas at "
-                f"{done}/{total} complete")
-        if rset.step_all(now) == 0:
-            _time.sleep(1e-3)
-        steps += 1
-        if steps > 200_000:
-            raise SystemExit("serve trace did not drain")
-    wall = _time.monotonic() - t0
-    stats = rset.stats
-    for eng in rset.engines:
-        stats.merge(eng.stats)
-    stats.wall_time = wall
+    def _drain(rset, *, resize=False):
+        resize_down_at = max(1, total // 3)
+        resize_up_at = max(2, (2 * total) // 3)
+        did_down = did_up = False
+        t0 = _time.monotonic()
+        steps = 0
+        while rset.has_work:
+            now = _time.monotonic() - t0
+            done = (len(rset.stats.completed)
+                    + sum(len(e.stats.completed) for e in rset.engines))
+            if resize and not did_down and done >= resize_down_at \
+                    and n_replicas > 1:
+                rset.resize(max(1, n_replicas // 2), now)
+                did_down = True
+                log(f"resize: {n_replicas} -> "
+                    f"{max(1, n_replicas // 2)} replicas at "
+                    f"{done}/{total} complete "
+                    f"({rset.resize_events[-1]['in_flight']} in-flight "
+                    f"migrated)")
+            if resize and did_down and not did_up \
+                    and done >= resize_up_at and n_replicas > 1:
+                rset.resize(n_replicas, now)
+                did_up = True
+                log(f"resize: back to {n_replicas} replicas at "
+                    f"{done}/{total} complete")
+            if rset.step_all(now) == 0:
+                _time.sleep(1e-3)
+            steps += 1
+            if steps > 200_000:
+                raise SystemExit("serve trace did not drain")
+        wall = _time.monotonic() - t0
+        stats = rset.stats
+        for eng in rset.engines:
+            stats.merge(eng.stats)
+        stats.wall_time = wall
+        return stats, wall
+
+    from horovod_tpu.serve.engine import ServeStats
+
+    def _warm(rset):
+        """Absorb every engine's compiles (the W=1 step and, with spec
+        on, the W=spec_k+1 window; for decode replicas the migrated-KV
+        admission path) before the timed trace, then zero the stats so
+        both A/B legs measure steady state only."""
+        for i in range(2 * len(rset.engines)):
+            rset.submit(Request(req_id=1_000_000 + i,
+                                prompt=[2 + (i % 7)] * page_size,
+                                max_new_tokens=2, arrival_time=0.0))
+        steps = 0
+        while rset.has_work:
+            if rset.step_all(float(steps)) == 0:
+                _time.sleep(1e-3)
+            steps += 1
+            if steps > 50_000:
+                raise SystemExit("serve warmup did not drain")
+        rset.stats = ServeStats()
+        for eng in rset.engines:
+            eng.stats = ServeStats()
+            eng._spec_proposed = eng._spec_accepted = 0
+            cache = eng.prefix_cache
+            if cache is not None:
+                cache.lookups = cache.hits = cache.hit_tokens = 0
+                cache.insertions = cache.evictions = 0
+        if getattr(rset, "kv_migrations", 0):
+            rset.kv_migrations = 0
+            rset.kv_migration_bytes = 0.0
+            rset.kv_migration_fp_bytes = 0.0
+            rset.kv_stall_steps = 0
+            rset.migration_events = []
+
+    base_stats = base_out = None
+    if disagg:
+        # Symmetric baseline FIRST, over the very same trace — the
+        # disagg leg's acceptance bar is goodput >= this and greedy
+        # outputs bit-identical to it (no mid-trace resize on either
+        # leg: a resize folds progress into prompts, which legitimately
+        # changes the generated continuations).
+        base = ReplicaSet(cfg, params, pc, devices=devices,
+                          n_replicas=n_replicas, eos_id=1)
+        _warm(base)
+        for req in mkreqs():
+            base.submit(req)
+        base_stats, base_wall = _drain(base)
+        base_out = {r.req_id: list(r.generated)
+                    for r in base_stats.completed}
+        blat = base_stats.latency_percentiles()
+        log(f"baseline (symmetric x{n_replicas}): "
+            f"goodput {base_stats.goodput_tokens_per_sec():.1f} tok/s | "
+            f"p99 {blat['p99'] * 1e3:.0f} ms | "
+            f"{len(base_stats.completed)}/{total} completed")
+        # A DCN-class mesh shape for the migration hop: the prefill and
+        # decode halves sit across the slower boundary, so the wire plan
+        # legalizes the blockwise-int8(+EF) compressed leg.
+        kv_shape = (max(1, n_chips // 2), 2) if n_chips > 1 else (1, 1)
+        rset = ReplicaSet(cfg, params, pc, devices=devices,
+                          n_replicas=n_replicas, eos_id=1,
+                          disagg=disagg,
+                          prefix_cache=shared_len > 0,
+                          spec_k=spec_k,
+                          kv_migrate_quantized=n_chips > 1,
+                          kv_mesh_shape=kv_shape)
+        log(f"disagg {disagg[0]}P:{disagg[1]}D | kv plan "
+            f"{rset.kv_plan.encode()} | prefix_cache={shared_len > 0} "
+            f"spec_k={spec_k}")
+    else:
+        rset = ReplicaSet(cfg, params, pc, devices=devices,
+                          n_replicas=n_replicas, eos_id=1)
+    _warm(rset)
+    for req in mkreqs():
+        rset.submit(req)
+    stats, wall = _drain(rset, resize=bool(args.serve_resize)
+                         and not disagg)
 
     completed = len(stats.completed)
     dropped = total - completed
@@ -2170,6 +2273,25 @@ def run_serve(args, devices, platform, mesh_shape):
         f"{len(rset.resize_events)} resizes")
     if dropped:
         raise SystemExit(f"serve trace DROPPED {dropped} requests")
+    if disagg and len(base_stats.completed) != total:
+        raise SystemExit(
+            f"baseline leg DROPPED "
+            f"{total - len(base_stats.completed)} requests")
+    spec_parity_ok = None
+    if disagg:
+        # Greedy bit-exactness: KV migration (int8+EF residual pass) and
+        # speculative verification must not change a single token.
+        dis_out = {r.req_id: list(r.generated) for r in stats.completed}
+        spec_parity_ok = dis_out == base_out
+        if not spec_parity_ok:
+            bad = sorted(i for i in dis_out
+                         if dis_out[i] != base_out.get(i))
+            raise SystemExit(
+                f"disagg outputs DIVERGED from the symmetric baseline "
+                f"on request(s) {bad[:8]} — greedy spec decode + KV "
+                f"migration must be bit-identical")
+        log("parity: disagg outputs bit-identical to the symmetric "
+            "baseline")
     # Unified observability: publish the trace-level gauges the engine
     # counters cannot derive (goodput is completed-requests-only), then
     # embed the serve+comm snapshot in the JSON artifact.
@@ -2179,11 +2301,60 @@ def run_serve(args, devices, platform, mesh_shape):
         stats.goodput_tokens_per_sec())
     monitor.metrics().gauge("serve.tokens_per_sec").set(
         stats.tokens_per_sec())
+    extra = {}
+    if disagg:
+        blat = base_stats.latency_percentiles()
+        base_goodput = base_stats.goodput_tokens_per_sec()
+        predicted = sum(e["predicted_bytes"]
+                        for e in rset.migration_events)
+        pcaches = [e.prefix_cache for e in rset.prefill_engines
+                   if e.prefix_cache is not None]
+        lookups = sum(c.lookups for c in pcaches)
+        hits = sum(c.hits for c in pcaches)
+        proposed = sum(e._spec_proposed for e in rset.decode_engines)
+        accepted = sum(e._spec_accepted for e in rset.decode_engines)
+        extra = {
+            "disagg": f"{disagg[0]}:{disagg[1]}",
+            "prefill_replicas": disagg[0],
+            "decode_replicas": disagg[1],
+            "kv_plan": rset.kv_plan.encode(),
+            "shared_prefix_len": shared_len,
+            "spec_decode_k": spec_k,
+            "baseline_goodput_tokens_per_sec": round(base_goodput, 2),
+            "baseline_tokens_per_sec": round(
+                base_stats.tokens_per_sec(), 2),
+            "baseline_latency_p50_ms": round(blat["p50"] * 1e3, 2),
+            "baseline_latency_p99_ms": round(blat["p99"] * 1e3, 2),
+            "goodput_vs_baseline": round(
+                stats.goodput_tokens_per_sec() / base_goodput, 4)
+                if base_goodput else None,
+            "kv_migrations": rset.kv_migrations,
+            "kv_migration_bytes": rset.kv_migration_bytes,
+            "kv_migration_fp_bytes": rset.kv_migration_fp_bytes,
+            "kv_predicted_bytes": predicted,
+            "kv_bytes_drift": rset.kv_migration_bytes - predicted,
+            "kv_predicted_ms": round(sum(
+                e["predicted_ms"] for e in rset.migration_events), 4),
+            "kv_modeled_ms": round(sum(
+                e["modeled_ms"] for e in rset.migration_events), 4),
+            "kv_stall_steps": rset.kv_stall_steps,
+            "prefix_lookups": lookups,
+            "prefix_hits": hits,
+            "prefix_hit_rate": round(hits / lookups, 4) if lookups
+                else 0.0,
+            "prefix_hit_tokens": sum(c.hit_tokens for c in pcaches),
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_acceptance_rate": round(accepted / proposed, 4)
+                if proposed else 0.0,
+            "spec_parity_ok": spec_parity_ok,
+        }
     print(json.dumps({
         "metric": "gpt_serve_goodput_tokens_per_sec",
         "value": round(stats.goodput_tokens_per_sec(), 2),
         "unit": "tokens/sec",
-        "vs_baseline": None,
+        "vs_baseline": (extra.get("goodput_vs_baseline")
+                        if disagg else None),
         "platform": platform,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "chips": n_chips,
@@ -2207,6 +2378,7 @@ def run_serve(args, devices, platform, mesh_shape):
         "num_pages": num_pages,
         "max_slots": max_slots,
         "decode_parity_max_err": parity_err,
+        **extra,
         "metrics_snapshot": metrics_snapshot(prefixes=("serve.", "comm.")),
     }), flush=True)
 
@@ -2466,6 +2638,27 @@ def main():
     ap.add_argument("--serve-resize", type=int, default=1,
                     help="1 (default) = one elastic resize down and back "
                          "up mid-trace; 0 = fixed replica count")
+    ap.add_argument("--disagg", default=None, metavar="P:D",
+                    help="disaggregated serving (docs/serving.md): split "
+                         "the fleet into P prefill and D decode replicas "
+                         "joined by the kv_migrate wire plan "
+                         "(blockwise-int8+EF on the DCN-class hop), and "
+                         "A/B against a symmetric (P+D)-replica baseline "
+                         "over the SAME trace — greedy outputs must "
+                         "match the baseline bit-identically")
+    ap.add_argument("--shared-prefix-len", type=int, default=None,
+                    metavar="N",
+                    help="multi-tenant trace: requests join one of a few "
+                         "tenants, each with a fixed N-token prompt "
+                         "prefix, so later arrivals hit the copy-on-"
+                         "write prefix cache (default 8 under --disagg, "
+                         "else 0 = independent prompts)")
+    ap.add_argument("--spec-decode", type=int, default=None, metavar="K",
+                    help="speculative decoding on the decode replicas: "
+                         "the n-gram drafter proposes K tokens per step, "
+                         "all verified in ONE batched window step "
+                         "(greedy = bit-identical outputs; default 3 "
+                         "under --disagg, else 0 = off)")
     ap.add_argument("--mesh-shape", default=None,
                     metavar="CROSSxLOCAL[xPODS]",
                     help="emulate a multi-host (cross, local) topology, "
@@ -2532,6 +2725,26 @@ def main():
             ap.error("--serve-rate must be > 0")
         if args.serve_requests < 1 or args.serve_replicas < 1:
             ap.error("--serve-requests/--serve-replicas must be >= 1")
+        if args.disagg is not None:
+            try:
+                p, d = (int(v) for v in args.disagg.split(":"))
+            except ValueError:
+                ap.error("--disagg expects P:D ints, e.g. 3:1")
+            if p < 1 or d < 1:
+                ap.error("--disagg: need P >= 1 and D >= 1")
+            args.disagg = (p, d)
+        # The disagg A/B defaults exercise the whole engine: a shared
+        # prefix (so the cache has something to hit) and a spec window.
+        if args.shared_prefix_len is None:
+            args.shared_prefix_len = 8 if args.disagg else 0
+        if args.spec_decode is None:
+            args.spec_decode = 3 if args.disagg else 0
+        if args.shared_prefix_len < 0 or args.spec_decode < 0:
+            ap.error("--shared-prefix-len/--spec-decode must be >= 0")
+    elif (args.disagg is not None or args.shared_prefix_len is not None
+          or args.spec_decode is not None):
+        ap.error("--disagg/--shared-prefix-len/--spec-decode require "
+                 "--serve")
 
     if args.dump_plan:
         # Pure plan resolution + cost model — runs before the A/B

@@ -64,7 +64,12 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     winner tuned at one expert-group count never warm-starts another.
 #     from_dict/load stay tolerant of v8/v7 entries (moe fields default
 #     to the dead-knob 0.0 / False values — the exact pre-v9 step).
-_CACHE_VERSION = 9
+# v10: disaggregated serving (docs/serving.md) — TunedParams gains the
+#     spec_draft_k/kv_migrate_quantized pair (tune_serve-gated; the plan
+#     encoding's trailing `|svK/q8|fp` segment). from_dict/load stay
+#     tolerant of v9/v8 entries (serve fields default to the dead-knob
+#     0 / False values — the exact pre-v10 step).
+_CACHE_VERSION = 10
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -146,7 +151,7 @@ def load_cached_params(key: str) -> Optional[TunedParams]:
 def _store_cached_params(key: str, params: TunedParams, *,
                          score: float, samples: int,
                          quantized: bool = False, pp: bool = False,
-                         moe: bool = False,
+                         moe: bool = False, serve: bool = False,
                          predicted_ms: Optional[float] = None) -> None:
     from ..plan import planner as _wire_planner
     from ..ops import kernel_autotune
@@ -154,7 +159,7 @@ def _store_cached_params(key: str, params: TunedParams, *,
     entry = {
         "params": params.as_dict(),
         "plan": _wire_planner.encode_tuned(params, quantized=quantized,
-                                           pp=pp, moe=moe),
+                                           pp=pp, moe=moe, serve=serve),
         "score_steps_per_sec": score,
         "samples": samples,
         "geometry": basics.mesh_geometry(),
@@ -213,6 +218,7 @@ def autotune_session(
     pp_max_interleave: int = 1,
     tune_moe: bool = False,
     moe_experts: int = 0,
+    tune_serve: bool = False,
     warmup_samples: Optional[int] = None,
     steps_per_sample: Optional[int] = None,
     max_samples: Optional[int] = None,
@@ -265,7 +271,13 @@ def autotune_session(
     (quarter-snapped 1.0–2.0) and ``moe_quantized`` (the int8 a2a
     wire) — under the same gate: capacity is trace-time dispatch-buffer
     shape, so only a step builder that rebuilds at the proposed values
-    may search it (docs/moe.md).
+    may search it (docs/moe.md). ``tune_serve`` adds the
+    disaggregated-serving pair — ``spec_draft_k`` (the speculative
+    draft window, 0–4) and ``kv_migrate_quantized`` (the int8+EF
+    prefill→decode KV wire) — under the same gate: the window is
+    trace-time decode geometry, so only a serving session whose
+    ``make_step`` rebuilds its engines at the proposed values may
+    search it (docs/serving.md).
 
     ``cache_key`` (a pytree — pass the parameter tree — or a string)
     activates the warm-start cache: a prior frozen winner for the same
@@ -379,6 +391,7 @@ def autotune_session(
         pp_max_interleave=pp_max_interleave,
         tune_moe=tune_moe,
         moe_experts=moe_experts,
+        tune_serve=tune_serve,
         warmup_samples=warmup_samples,
         steps_per_sample=steps_per_sample,
         max_samples=max_samples,
@@ -473,7 +486,7 @@ def autotune_session(
         _store_cached_params(key, best, score=pm.best_score,
                              samples=pm.samples_done,
                              quantized=bool(tune_quant_block),
-                             pp=tune_pp, moe=tune_moe,
+                             pp=tune_pp, moe=tune_moe, serve=tune_serve,
                              predicted_ms=predicted_ms)
     return AutotuneResult(params=best, history=tuple(pm.history),
                           best_score=pm.best_score,

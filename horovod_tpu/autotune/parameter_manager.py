@@ -70,8 +70,13 @@ _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
 # int8 a2a wire) — both gated by tune_moe and dead (0.0 / False) when
 # the session's step carries no MoE layer, where canonicalization
 # collapses them to one trial.
-_DIMS = 11  # fusion, qblock, tree, zero, overlap, streams, fused,
-#             ppM, ppV, moeCap, moeQ
+# v10 adds the disaggregated-serving pair (docs/serving.md):
+# spec_draft_k (speculative draft window 0-4; 0 = plain decode) and
+# kv_migrate_quantized (the int8+EF prefill→decode KV wire) — both
+# gated by tune_serve and dead (0 / False) in a training session,
+# where canonicalization collapses them to one trial.
+_DIMS = 13  # fusion, qblock, tree, zero, overlap, streams, fused,
+#             ppM, ppV, moeCap, moeQ, svK, svQ
 
 _MIN_PPM_LOG = 1.0   # 2^1 = 2 microbatches
 _MAX_PPM_LOG = 5.0   # 2^5 = 32 microbatches
@@ -79,6 +84,8 @@ _MAX_PPV_LOG = 2.0   # 2^2 = 4 virtual stages per rank
 
 _MIN_MOE_CAP = 1.0   # dispatch capacity factor search box
 _MAX_MOE_CAP = 2.0   # (quarter-snapped: 1.0, 1.25, ..., 2.0)
+
+_MAX_SPEC_K = 4      # speculative draft-window search box (0..4)
 
 # CSV schema (reference: parameter_manager.cc:47-50 writes knobs then the
 # window score; same layout here with the compiled-path knob set).
@@ -90,11 +97,14 @@ _MAX_MOE_CAP = 2.0   # (quarter-snapped: 1.0, 1.25, ..., 2.0)
 # lacking the newer columns.
 # v9 appends the MoE pair; read_log stays tolerant of v3..v8 logs
 # lacking the newer columns.
+# v10 appends the serving pair; read_log stays tolerant of v3..v9 logs
+# lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
               "overlap", "num_comm_streams", "fused",
               "pp_microbatches", "pp_interleave",
               "moe_capacity_factor", "moe_quantized",
+              "spec_draft_k", "kv_migrate_quantized",
               "score_steps_per_sec", "plan")
 
 
@@ -120,6 +130,10 @@ class TunedParams:
     # the canonical dead-knob values.
     moe_capacity_factor: float = 0.0
     moe_quantized: bool = False
+    # Disaggregated-serving pair (docs/serving.md): 0 / False = "not a
+    # serving session" — the canonical dead-knob values.
+    spec_draft_k: int = 0
+    kv_migrate_quantized: bool = False
 
     @property
     def zero_sharding(self) -> bool:
@@ -141,6 +155,8 @@ class TunedParams:
             "pp_interleave": int(self.pp_interleave),
             "moe_capacity_factor": float(self.moe_capacity_factor),
             "moe_quantized": bool(self.moe_quantized),
+            "spec_draft_k": int(self.spec_draft_k),
+            "kv_migrate_quantized": bool(self.kv_migrate_quantized),
         }
 
     @classmethod
@@ -165,6 +181,9 @@ class TunedParams:
             moe_capacity_factor=float(
                 d.get("moe_capacity_factor", 0.0) or 0.0),
             moe_quantized=bool(d.get("moe_quantized", False)),
+            spec_draft_k=int(d.get("spec_draft_k", 0) or 0),
+            kv_migrate_quantized=bool(
+                d.get("kv_migrate_quantized", False)),
         )
 
     @classmethod
@@ -190,6 +209,9 @@ class TunedParams:
                 if getattr(config, "moe_experts", 0) else 0.0),
             moe_quantized=bool(getattr(config, "moe_quantized", False)
                                and getattr(config, "moe_experts", 0)),
+            spec_draft_k=getattr(config, "spec_draft_k", 0) or 0,
+            kv_migrate_quantized=bool(
+                getattr(config, "kv_migrate_quantized", False)),
         )
 
 
@@ -242,6 +264,7 @@ class ParameterManager:
         pp_max_interleave: int = 1,
         tune_moe: bool = False,
         moe_experts: int = 0,
+        tune_serve: bool = False,
         warmup_samples: int = 3,
         steps_per_sample: int = 10,
         max_samples: int = 20,
@@ -292,6 +315,14 @@ class ParameterManager:
         # canonicalize dead.
         self.tune_moe = tune_moe
         self.moe_experts = max(0, int(moe_experts))
+        # The serving pair restructures the decode step (the speculative
+        # window W = k+1 is trace-time geometry) and the prefill→decode
+        # KV wire dtype, so like zero/overlap/pp/moe it is searched only
+        # when the session drives a serving engine that can rebuild at a
+        # proposed (spec_draft_k, kv_migrate_quantized)
+        # (autotune_session(tune_serve=True)). In a training session the
+        # encoding drops the segment and both knobs canonicalize dead.
+        self.tune_serve = tune_serve
         self.warmup_samples = max(0, warmup_samples)
         self.steps_per_sample = max(1, steps_per_sample)
         self.max_samples = max_samples
@@ -352,6 +383,8 @@ class ParameterManager:
             ppv / _MAX_PPV_LOG,
             (cap - _MIN_MOE_CAP) / (_MAX_MOE_CAP - _MIN_MOE_CAP),
             0.75 if p.moe_quantized else 0.25,
+            min(_MAX_SPEC_K, max(0, p.spec_draft_k)) / _MAX_SPEC_K,
+            0.75 if p.kv_migrate_quantized else 0.25,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -410,6 +443,18 @@ class ParameterManager:
         else:
             moe_cap = self.initial.moe_capacity_factor
             moe_q = self.initial.moe_quantized
+        if self.tune_serve:
+            # Integer-snap the draft window inside [0, _MAX_SPEC_K]
+            # (the window W = k+1 is trace-time geometry — the space IS
+            # discrete). Tolerant of pre-v10 unit tuples lacking the
+            # trailing dims.
+            u11 = u[11] if len(u) > 11 else 0.0
+            u12 = u[12] if len(u) > 12 else 0.25
+            sv_k = max(0, min(_MAX_SPEC_K, round(u11 * _MAX_SPEC_K)))
+            sv_q = u12 >= 0.5
+        else:
+            sv_k = self.initial.spec_draft_k
+            sv_q = self.initial.kv_migrate_quantized
         return self._canonicalize(TunedParams(
             fusion_threshold_bytes=int(2.0 ** f),
             quant_block=qblock,
@@ -422,6 +467,8 @@ class ParameterManager:
             pp_interleave=ppv,
             moe_capacity_factor=moe_cap,
             moe_quantized=moe_q,
+            spec_draft_k=sv_k,
+            kv_migrate_quantized=sv_q,
         ))
 
     def _plan_of(self, p: TunedParams) -> str:
@@ -430,7 +477,7 @@ class ParameterManager:
         column of the CSV, ``plan`` field of the v5 cache entry)."""
         return _wire_planner.encode_tuned(
             p, quantized=self.tune_quant_block, pp=self.tune_pp,
-            moe=self.tune_moe)
+            moe=self.tune_moe, serve=self.tune_serve)
 
     def _canonicalize(self, p: TunedParams) -> TunedParams:
         """Snap a proposal onto its wire plan: knobs that are dead in
@@ -449,7 +496,9 @@ class ParameterManager:
             pp_microbatches=d.get("pp_microbatches", 0),
             pp_interleave=d.get("pp_interleave", 1),
             moe_capacity_factor=d.get("moe_capacity_factor", 0.0),
-            moe_quantized=d.get("moe_quantized", False))
+            moe_quantized=d.get("moe_quantized", False),
+            spec_draft_k=d.get("spec_draft_k", 0),
+            kv_migrate_quantized=d.get("kv_migrate_quantized", False))
 
     def _unit_key(self, p: TunedParams) -> tuple:
         """Dedup key: the snapped fusion threshold plus the canonical
@@ -506,6 +555,8 @@ class ParameterManager:
                             int(p.pp_interleave),
                             f"{p.moe_capacity_factor:g}",
                             int(p.moe_quantized),
+                            int(p.spec_draft_k),
+                            int(p.kv_migrate_quantized),
                             f"{score:.6g}",
                             self._plan_of(p)])
         self._log.flush()
@@ -541,6 +592,9 @@ class ParameterManager:
         if not self.tune_moe:
             u[9] = 0.25
             u[10] = 0.25
+        if not self.tune_serve:
+            u[11] = 0.0
+            u[12] = 0.25
         return tuple(u)
 
     def _propose_next(self) -> TunedParams:
@@ -634,6 +688,9 @@ def read_log(path: str) -> List[dict]:
                     rec.get("moe_capacity_factor", 0.0) or 0.0),
                 "moe_quantized": bool(int(rec.get("moe_quantized", 0)
                                           or 0)),
+                "spec_draft_k": int(rec.get("spec_draft_k", 0) or 0),
+                "kv_migrate_quantized": bool(
+                    int(rec.get("kv_migrate_quantized", 0) or 0)),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             }
             enc = (rec.get("plan") or "").strip()

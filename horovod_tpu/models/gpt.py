@@ -143,8 +143,14 @@ class _Attention(nn.Module):
             from ..serve import kv_cache as kvlib
 
             cache, meta, layer = decode
-            cache = kvlib.append_layer_kv(cache, layer, k[:, 0], v[:, 0],
-                                          meta)
+            if meta.write_page.ndim == 2:
+                # Windowed verify/prefill chunk: all T = W positions'
+                # k/v land in one scatter, per-query masks keep each
+                # position blind to its future.
+                cache = kvlib.append_layer_kv(cache, layer, k, v, meta)
+            else:
+                cache = kvlib.append_layer_kv(cache, layer, k[:, 0],
+                                              v[:, 0], meta)
             out = kvlib.paged_attention(
                 q, cache.k[layer], cache.v[layer], cache.page_table,
                 meta.attend_len, ring_axis=cfg.kv_ring_axis)
@@ -362,7 +368,13 @@ class GPT(nn.Module):
                     f"{cfg.tp_axis!r}: the page stripe would rotate "
                     f"between ranks holding different heads; use "
                     f"disjoint mesh axes")
-        if tokens.ndim == 2:
+        # Windowed step (speculative verify / chunked prefill): a 2-D
+        # ``active [S, W]`` batches W tokens per slot through ONE apply.
+        # Per-query attend lengths (``seq_lens + w + 1``) keep window
+        # position w blind to positions > w, so the logits are
+        # bit-identical to W chained single-token steps.
+        windowed = active is not None and jnp.ndim(active) == 2
+        if not windowed and tokens.ndim == 2:
             tokens = tokens[:, 0]
         S = tokens.shape[0]
         if active is None:
@@ -377,16 +389,27 @@ class GPT(nn.Module):
         meta = kvlib.step_meta(cache, active,
                                page_size=int(cache.k.shape[2]),
                                ring_axis=cfg.kv_ring_axis)
-        pos = jnp.clip(cache.seq_lens, 0, cfg.max_seq_len - 1)
-        x = (wte[tokens] + wpe[pos]).astype(cfg.dtype)[:, None, :]
+        if windowed:
+            W = tokens.shape[1]
+            pos = jnp.clip(cache.seq_lens[:, None] + jnp.arange(W)[None],
+                           0, cfg.max_seq_len - 1)
+            x = (wte[tokens] + wpe[pos]).astype(cfg.dtype)
+        else:
+            pos = jnp.clip(cache.seq_lens, 0, cfg.max_seq_len - 1)
+            x = (wte[tokens] + wpe[pos]).astype(cfg.dtype)[:, None, :]
         block = _Block
         if cfg.remat:
             block = nn.remat(_Block)
         for i in range(cfg.num_layers):
             x, cache = block(cfg, name=f"h{i}")(x, decode=(cache, meta, i))
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
-        logits = jnp.einsum("sc,vc->sv", x[:, 0], wte.astype(cfg.dtype),
-                            preferred_element_type=jnp.float32)
+        if windowed:
+            logits = jnp.einsum("swc,vc->swv", x, wte.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
+        else:
+            logits = jnp.einsum("sc,vc->sv", x[:, 0],
+                                wte.astype(cfg.dtype),
+                                preferred_element_type=jnp.float32)
         return logits, kvlib.advance(cache, meta)
 
 

@@ -134,6 +134,55 @@ def _extract_expert_load(registry_snap: Optional[dict]) -> Dict[str, float]:
     return load
 
 
+#: Registry keys folded into the dump's compact ``serve_cache`` view
+#: (docs/serving.md): the disaggregated-serving health triple — prefix
+#: cache effectiveness, speculative acceptance, and KV-migration wire
+#: state — so scripts/postmortem.py can name a migration-stalled
+#: replica or a cold prefix cache without walking the raw registry.
+_SERVE_CACHE_GAUGES = (
+    "serve.prefix_lookups", "serve.prefix_hits",
+    "serve.prefix_hit_tokens", "serve.prefix_hit_rate",
+    "serve.prefix_cached_pages", "serve.spec.acceptance_rate",
+    "serve.prefill_replicas", "serve.decode_replicas",
+)
+_SERVE_CACHE_COUNTERS = (
+    "serve.spec.proposed", "serve.spec.accepted",
+    "serve.prefill_handoffs", "serve.kv.migrations",
+    "serve.kv.migrations_in", "serve.kv.stall_steps",
+)
+
+
+def _extract_serve_cache(registry_snap: Optional[dict]) -> dict:
+    """The disaggregated-serving view of a registry snapshot: flat
+    prefix/speculation/migration scalars, per-hop ``comm.kv.bytes``,
+    and the per-replica stall attribution
+    (``serve.kv.stall_steps_by{replica}``)."""
+    if not registry_snap:
+        return {}
+    gauges = registry_snap.get("gauges") or {}
+    counters = registry_snap.get("counters") or {}
+    view: dict = {}
+    for key in _SERVE_CACHE_GAUGES:
+        if key in gauges:
+            view[key] = float(gauges[key])
+    for key in _SERVE_CACHE_COUNTERS:
+        if key in counters:
+            view[key] = float(counters[key])
+    kv_bytes: Dict[str, float] = {}
+    stall_by: Dict[str, float] = {}
+    for key, v in counters.items():
+        if key.startswith("comm.kv.bytes{hop="):
+            kv_bytes[key[len("comm.kv.bytes{hop="):-1]] = float(v)
+        elif key.startswith("serve.kv.stall_steps_by{replica="):
+            stall_by[key[len("serve.kv.stall_steps_by{replica="):-1]] = \
+                float(v)
+    if kv_bytes:
+        view["kv_bytes"] = kv_bytes
+    if stall_by:
+        view["stall_steps_by_replica"] = stall_by
+    return view
+
+
 class FlightRecorder:
     """Bounded in-memory ring of recent framework events."""
 
@@ -266,6 +315,7 @@ class FlightRecorder:
         except Exception:
             pass
         expert_load = _extract_expert_load(registry_snap)
+        serve_cache = _extract_serve_cache(registry_snap)
         payload = json.dumps(events, sort_keys=True).encode()
         dump = {
             "version": DUMP_VERSION,
@@ -286,6 +336,12 @@ class FlightRecorder:
             # so scripts/postmortem.py can name a hot expert without
             # re-deriving it from raw histogram buckets.
             dump["expert_load"] = expert_load
+        if serve_cache:
+            # Disaggregated-serving health (docs/serving.md): compact
+            # prefix-cache / speculative-acceptance / KV-migration view,
+            # including the per-replica stall attribution postmortem
+            # uses to name a migration-stalled replica.
+            dump["serve_cache"] = serve_cache
         if extra:
             dump["extra"] = extra
         return dump

@@ -65,8 +65,11 @@ logger = logging.getLogger("horovod_tpu.straggler")
 #: ``wire.a2a`` is the MoE dispatch/combine wire (docs/moe.md) — fed by
 #: bench's ``--moe`` leg so a straggling expert group attributes to its
 #: exchange phase, separate from the gradient wire's hop classes.
+#: ``wire.kv`` is disaggregated serving's KV-migration wire
+#: (docs/serving.md) — a replica stuck in it is blocked on a
+#: prefill→decode handoff, not on compute.
 PHASES = ("compute", "wire.ici", "wire.dcn", "wire.pod", "wire.a2a",
-          "pp_bubble", "ckpt")
+          "wire.kv", "pp_bubble", "ckpt")
 
 HOPS = ("ici", "dcn", "pod")
 

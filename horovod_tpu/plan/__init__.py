@@ -53,6 +53,7 @@ from .accounting import (  # noqa: F401
     WireStats,
     bench_gbps,
     fused_span,
+    kv_span,
     modeled_wire_ms,
     moe_span,
     record_wire_stats,
@@ -73,10 +74,14 @@ from .planner import (  # noqa: F401
     flat_plan,
     fused_ag_matmul_plan,
     fused_matmul_rs_plan,
+    derive_kv_migrate,
     derive_send,
+    kv_migrate_level,
+    kv_migrate_plan,
     pp_bubble_bound,
     pp_send_level,
     predict_a2a_bytes,
+    predict_kv_migrate_bytes,
     predict_fused_hbm_saved,
     predict_leg_bytes,
     quantized_allreduce_plan,
@@ -92,6 +97,7 @@ from .cost import (  # noqa: F401
     PlanCost,
     StepCost,
     price_a2a,
+    price_kv_migrate,
     price_plan,
     price_send,
     price_step,

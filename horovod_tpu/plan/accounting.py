@@ -75,6 +75,16 @@ class WireStats:
         self.a2a_bytes = 0.0
         self.a2a_bytes_fp = 0.0
         self.a2a_calls = 0
+        # Serving KV-migration wire (docs/serving.md): bytes moved by
+        # kv_migrate send legs — prefill→decode page handoffs between
+        # replica groups. Same double-charging discipline as the
+        # pipeline/MoE wires: a migration charges its hop's per-hop
+        # total AND these counters, so the handoff share of each link
+        # class is separable. ``kv_transfers`` counts whole-slot
+        # migrations (not chunks).
+        self.kv_bytes = 0.0
+        self.kv_bytes_fp = 0.0
+        self.kv_transfers = 0
 
     @property
     def dcn_reduction(self) -> Optional[float]:
@@ -135,6 +145,8 @@ def _publish_wire_stats(ws: "WireStats") -> None:
     r.gauge("comm.wire.pp_sends").set(ws.pp_sends)
     r.gauge("comm.wire.a2a_bytes").set(ws.a2a_bytes)
     r.gauge("comm.wire.a2a_calls").set(ws.a2a_calls)
+    r.gauge("comm.wire.kv_bytes").set(ws.kv_bytes)
+    r.gauge("comm.wire.kv_transfers").set(ws.kv_transfers)
 
 
 def _acct(kind: str, wire_bytes: float, fp_bytes: Optional[float] = None):
@@ -256,6 +268,46 @@ def _acct_a2a(hop: str, wire_bytes: float,
         ws.a2a_bytes += wire_bytes
         ws.a2a_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
         ws.a2a_calls += calls
+
+
+def _acct_kv(hop: str, wire_bytes: float,
+             fp_bytes: Optional[float] = None,
+             transfers: int = 0) -> None:
+    """Account a KV-migration send leg: charges ``wire_bytes`` to the
+    ``hop`` link class exactly like any other leg (so
+    ``comm.bytes{hop}`` and the per-hop WireStats totals include it),
+    and ADDITIONALLY to the serving handoff's own counters so bench/obs
+    can separate prefill→decode migration traffic from the training and
+    pipeline wires (docs/serving.md). ``transfers`` bumps only when a
+    whole slot finished migrating — chunked transfers charge bytes per
+    chunk but one transfer per slot."""
+    _acct(hop, wire_bytes, fp_bytes)
+    if _metrics.metrics_enabled():
+        _metrics.counter("comm.kv.bytes", hop=hop).inc(wire_bytes)
+        if transfers:
+            _metrics.counter("comm.kv.transfers", hop=hop).inc(transfers)
+    for ws in _wire_recorders:
+        ws.kv_bytes += wire_bytes
+        ws.kv_bytes_fp += wire_bytes if fp_bytes is None else fp_bytes
+        ws.kv_transfers += transfers
+
+
+@contextlib.contextmanager
+def kv_span(kind: str = "MIGRATE", tid: str = "serve"):
+    """Bracket one KV-handoff wire event in a ``SERVE:KV_<kind>``
+    timeline span (kinds today: ``MIGRATE`` — one chunk of a
+    prefill→decode page transfer crossing the wire). Host-time span:
+    unlike the trace-time collective spans, migrations run eagerly
+    between engine steps (docs/serving.md)."""
+    tl = basics._state.timeline if basics.is_initialized() else None
+    activity = f"SERVE:KV_{kind}"
+    if tl is not None:
+        tl.begin(tid, activity)
+    try:
+        yield
+    finally:
+        if tl is not None:
+            tl.end(tid, activity)
 
 
 @contextlib.contextmanager

@@ -47,8 +47,8 @@ from ..common import basics
 from ..common.basics import CROSS_AXIS, LOCAL_AXIS, POD_AXIS
 from ..ops import compression as _compression
 from . import ir
-from .accounting import (_acct, _acct_a2a, _acct_enabled, _acct_pp,
-                         moe_span, pp_span)
+from .accounting import (_acct, _acct_a2a, _acct_enabled, _acct_kv,
+                         _acct_pp, moe_span, pp_span)
 
 # Mesh axis carried by each plan level.
 LEVEL_AXIS = {ir.ICI: LOCAL_AXIS, ir.DCN: CROSS_AXIS, ir.POD: POD_AXIS}
@@ -348,6 +348,74 @@ def lower_send(plan: ir.WirePlan, x, *, axis, perm, residual=None,
         return out, None
     new_res = err.reshape(nb * blk)[:n].reshape(residual.shape)
     return out, new_res.astype(residual.dtype)
+
+
+# ---------------------------------------------------------------------------
+# kv_migrate leg — the serving KV handoff wire (docs/serving.md). Unlike
+# every other lowering here this one runs HOST-side: a prefill replica
+# and its decode replica are two separate engine meshes with no shared
+# program, so the migrator gathers a finished slot's KV pages on the
+# source, pushes them through this wire (the encode→transfer→decode
+# composition the plan names), and scatters the received pages on the
+# destination between its decode steps. The wire composition is the
+# plan's, exactly like the in-program legs: payload dtype passes
+# through; int8 quantizes blockwise with one fp32 scale per block, and
+# the error-feedback slot means the RESIDUAL pass — a second int8
+# payload over the first pass's quantization error on the same hop
+# (one-shot transfers have no next step to feed the error into), which
+# collapses the reconstruction error to ~(absmax/127)^2.
+# ---------------------------------------------------------------------------
+
+
+def _host_quant_blocks(flat: np.ndarray, blk: int):
+    """Host-side mirror of :func:`_quantize_blocks` over a flat fp32
+    payload: ``(dequantized, err)`` after one blockwise int8
+    round-trip. Same scale rule (absmax/127 per block, floored away
+    from zero) so the wire format matches the device kernels."""
+    n = flat.shape[0]
+    pad = (-n) % blk
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), np.float32)])
+    blocks = flat.reshape(-1, blk)
+    scales = np.abs(blocks).max(axis=1) / 127.0
+    scales = np.maximum(scales, 1e-12).astype(np.float32)
+    q = np.clip(np.round(blocks / scales[:, None]), -127, 127)
+    deq = (q.astype(np.float32) * scales[:, None]).reshape(-1)[:n]
+    return deq, flat.reshape(-1)[:n] - deq
+
+
+def lower_kv_migrate(plan: ir.WirePlan, x: np.ndarray, *,
+                     transfers: int = 0) -> Tuple[np.ndarray, float]:
+    """Lower a validated kv_migrate plan over host payload ``x`` (one
+    chunk of a slot's gathered KV pages, any shape/float dtype);
+    returns ``(received, wire_bytes)`` — the array the decode replica
+    scatters into its pools, plus the bytes this chunk put on the
+    plan's hop (charged to ``comm.kv.bytes{hop}`` and the per-hop
+    totals via :func:`~horovod_tpu.plan.accounting._acct_kv`).
+    ``transfers=1`` on the LAST chunk of a slot marks the whole-slot
+    migration complete in the transfer counter."""
+    (leg,) = plan.legs
+    hop = ir.LEVEL_HOP[leg.level]
+    n = int(x.size)
+    isz = np.dtype(x.dtype).itemsize
+    if leg.wire_dtype != ir.INT8:
+        wire = float(n) * isz
+        if _acct_enabled():
+            _acct_kv(hop, wire, transfers=transfers)
+        return np.array(x, copy=True), wire
+    blk = int(leg.block or 256)
+    flat = np.asarray(x, np.float32).reshape(-1)
+    deq, err = _host_quant_blocks(flat, blk)
+    wire = quant_wire_bytes(n, blk)
+    if leg.error_feedback:
+        # Residual pass: quantize the first pass's error and ship it on
+        # the same wire — 2x the quantized bytes, argmax-safe decode.
+        deq_err, _ = _host_quant_blocks(err, blk)
+        deq = deq + deq_err
+        wire *= 2.0
+    if _acct_enabled():
+        _acct_kv(hop, wire, float(n) * isz, transfers=transfers)
+    return deq.reshape(x.shape).astype(x.dtype), wire
 
 
 # ---------------------------------------------------------------------------

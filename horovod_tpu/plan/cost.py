@@ -431,6 +431,30 @@ def price_send(plan: ir.WirePlan, payload_bytes: float, *,
     }
 
 
+def price_kv_migrate(plan: ir.WirePlan, payload_bytes: float, *,
+                     transfers: int = 1, itemsize: float = 4.0,
+                     mesh_shape=(1, 1),
+                     model: Optional[CostModel] = None) -> dict:
+    """Price ``transfers`` prefill→decode KV handoffs of a
+    ``payload_bytes`` slot payload each: the per-migration
+    wire/alpha/quant terms times the handoff count — the predicted side
+    of the bench ``--disagg`` leg's migration drift pair
+    (docs/serving.md). ``modeled_ms`` is the same bytes at the static
+    modeled bandwidths, exactly what :func:`~horovod_tpu.plan.compiler.
+    lower_kv_migrate` charges for the same transfers (residual pass
+    included — the leg-byte predictor doubles quantized bytes when the
+    plan carries the error-feedback residual slot)."""
+    model = model or CostModel.from_env()
+    n = max(1, int(payload_bytes / max(1e-9, itemsize)))
+    pc = price_plan(plan, n, itemsize, mesh_shape, model)
+    return {
+        "predicted_ms": pc.total_ms * transfers,
+        "modeled_ms": pc.modeled_ms * transfers,
+        "wire_bytes": sum(l.bytes for l in pc.legs) * transfers,
+        "model": model.source,
+    }
+
+
 def predict_hop_ms(hop: str, nbytes: float,
                    model: Optional[CostModel] = None) -> float:
     """Predicted transfer milliseconds of ``nbytes`` on one link class

@@ -47,10 +47,13 @@ LEVELS = (ICI, DCN, POD, FLAT)
 # the TPU lowerings use). ``send`` is the point-to-point primitive of the
 # pipeline wire (docs/pipeline.md): one ``lax.ppermute`` hop carrying an
 # inter-stage activation (or activation-grad) along the hvd_pp axis,
-# charged to the link class its ``level`` names. ``all_to_all`` is the
-# MoE dispatch/combine primitive (docs/moe.md): one tiled
-# ``lax.all_to_all`` row exchange along the hvd_ep axis, owned by the
-# ``a2a`` plan family.
+# charged to the link class its ``level`` names. The same primitive also
+# carries the ``kv_migrate`` plan family (docs/serving.md): one
+# prefill→decode KV-page handoff between serving replicas, lowered
+# host-side between two engine meshes rather than as an in-program
+# collective. ``all_to_all`` is the MoE dispatch/combine primitive
+# (docs/moe.md): one tiled ``lax.all_to_all`` row exchange along the
+# hvd_ep axis, owned by the ``a2a`` plan family.
 REDUCE_SCATTER = "reduce_scatter"
 ALL_GATHER = "all_gather"
 ALL_TO_ALL = "all_to_all"
@@ -86,7 +89,13 @@ _REDUCE_PRIMS = (REDUCE_SCATTER, PSUM)
 _GATHER_PRIMS = (ALL_GATHER,)
 
 _COLLECTIVES = ("allreduce", "reduce_scatter", "all_gather", "send",
-                "a2a")
+                "a2a", "kv_migrate")
+
+# Plan families whose legs are point-to-point ``send`` hops rather than
+# reduction/gather ladder rungs: the pipeline wire and the serving KV
+# handoff share the primitive but differ in who lowers them (in-program
+# ppermute vs host-side replica-to-replica transfer).
+_SEND_COLLECTIVES = ("send", "kv_migrate")
 
 
 class PlanError(ValueError):
@@ -249,17 +258,19 @@ class WirePlan:
                     f"no leg-local compute to fuse a kernel into; "
                     f"kernel-backed legs live on the per-level "
                     f"compositions (docs/fused-kernels.md)")
-            if (leg.primitive == SEND) != (self.collective == "send"):
+            if ((leg.primitive == SEND)
+                    != (self.collective in _SEND_COLLECTIVES)):
                 if leg.primitive == SEND:
                     raise PlanError(
                         f"{where}: a send leg only belongs to a 'send' "
-                        f"plan — the point-to-point pipeline hop does "
-                        f"not compose with reduction/gather ladders "
-                        f"(docs/pipeline.md)")
+                        f"or 'kv_migrate' plan — the point-to-point hop "
+                        f"does not compose with reduction/gather "
+                        f"ladders (docs/pipeline.md, docs/serving.md)")
                 raise PlanError(
-                    f"{where}: a send plan carries only send legs, got "
-                    f"{leg.primitive!r} — the inter-stage wire is one "
-                    f"ppermute hop per direction (docs/pipeline.md)")
+                    f"{where}: a {self.collective} plan carries only "
+                    f"send legs, got {leg.primitive!r} — the point-to-"
+                    f"point wire is one hop per direction "
+                    f"(docs/pipeline.md, docs/serving.md)")
             if leg.primitive == SEND and leg.level == FLAT:
                 raise PlanError(
                     f"{where}: a send leg names the LINK CLASS the "
@@ -373,6 +384,14 @@ class WirePlan:
                     f"exactly ONE hop (one ppermute leg on one link "
                     f"class) — the pipeline schedule composes hops by "
                     f"issuing one plan per direction, docs/pipeline.md")
+        elif self.collective == "kv_migrate":
+            if len(self.legs) != 1:
+                raise PlanError(
+                    f"illegal kv_migrate plan {self.encode()}: a KV "
+                    f"migration is exactly ONE hop (one send leg on the "
+                    f"link class the prefill→decode handoff crosses) — "
+                    f"the migrator streams a whole slot's pages through "
+                    f"one wire, docs/serving.md")
         elif self.collective == "a2a":
             if len(self.legs) != 1:
                 raise PlanError(

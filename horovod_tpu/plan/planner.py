@@ -216,6 +216,69 @@ def derive_send(*, mesh_shape, quantized: bool = False,
     return send_plan(level, quantized=q, block=block, error_feedback=ef)
 
 
+def kv_migrate_plan(level: str = DCN, *, quantized: bool = False,
+                    block: Optional[int] = None,
+                    error_feedback: bool = False) -> WirePlan:
+    """The disaggregated-serving KV handoff wire (docs/serving.md): a
+    single point-to-point ``send`` leg carrying one finished prefill's
+    KV pages from a prefill replica to its decode replica. ``quantized``
+    rides it blockwise-int8 (DCN/pod hops only, the EQuARX placement
+    rule). ``error_feedback`` on a migration leg means the RESIDUAL
+    pass: a one-shot transfer has no next step to feed the error into,
+    so the compiler ships a second int8 pass over the first pass's
+    quantization error on the same wire — 2x the quantized bytes,
+    error collapsed to ~(absmax/127)^2, argmax-safe for decode."""
+    if quantized:
+        leg = Leg(level, SEND, INT8, block=block,
+                  error_feedback=error_feedback)
+    else:
+        leg = Leg(level, SEND, PAYLOAD)
+    return WirePlan("kv_migrate", (leg,)).validate()
+
+
+def kv_migrate_level(mesh_shape) -> str:
+    """The link class a prefill→decode handoff crosses: replica groups
+    partition the device list contiguously (docs/serving.md), so the
+    hop between two replicas rides the SLOWEST link class present —
+    the same geometry argument as the pipeline/expert hops."""
+    return pp_send_level(mesh_shape)
+
+
+def derive_kv_migrate(*, mesh_shape, quantized: bool = False,
+                      block: Optional[int] = None,
+                      error_feedback: Optional[bool] = None) -> WirePlan:
+    """Derive the KV migration plan for a mesh: the level comes from
+    :func:`kv_migrate_level`; ``quantized`` is forced off on an ICI hop
+    (int8 is illegal there), and a quantized migration defaults to the
+    residual (error-feedback) pass so the handoff stays argmax-safe."""
+    level = kv_migrate_level(mesh_shape)
+    q = bool(quantized) and level in (DCN, POD)
+    ef = q if error_feedback is None else (error_feedback and q)
+    return kv_migrate_plan(level, quantized=q, block=block,
+                           error_feedback=ef)
+
+
+def predict_kv_migrate_bytes(plan: WirePlan, n: int,
+                             itemsize: float) -> List[dict]:
+    """Per-leg predicted wire bytes of ONE migration of an ``n``-element
+    KV payload — the same formula :func:`~horovod_tpu.plan.compiler.
+    lower_kv_migrate` charges at transfer time (the residual pass rides
+    the same wire again), so predicted == accounted by construction.
+    Row schema matches :func:`predict_leg_bytes`."""
+    (leg,) = plan.legs
+    hop = {ICI: "ici", DCN: "dcn", POD: "pod"}[leg.level]
+    fp = float(n) * itemsize
+    if leg.wire_dtype == INT8:
+        from .compiler import quant_wire_bytes
+
+        wire = quant_wire_bytes(n, leg.block or 256)
+        if leg.error_feedback:
+            wire *= 2.0
+    else:
+        wire = fp
+    return [{"leg": leg, "hop": hop, "bytes": wire, "fp_bytes": fp}]
+
+
 def a2a_plan(level: str = DCN, *, quantized: bool = False,
              block: Optional[int] = None,
              error_feedback: bool = False,
@@ -400,6 +463,8 @@ def predict_leg_bytes(plan: WirePlan, n: int, itemsize: int,
     a2a rows are zero without it."""
     if plan.collective == "a2a":
         return predict_a2a_bytes(plan, n, itemsize, ep)
+    if plan.collective == "kv_migrate":
+        return predict_kv_migrate_bytes(plan, n, itemsize)
     nl, nc, npod = _mesh_sizes(mesh_shape)
     world = nl * nc * npod
     isz = itemsize
@@ -974,11 +1039,13 @@ _PLAN_RE = re.compile(
     r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
     r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)"
     r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+))?"
-    r"(\|moe(?P<moecap>[0-9.]+)/(?P<moeq>q8|fp))?$")
+    r"(\|moe(?P<moecap>[0-9.]+)/(?P<moeq>q8|fp))?"
+    r"(\|sv(?P<svk>\d+)/(?P<svq>q8|fp))?$")
 
 
 def encode_tuned(params, *, quantized: bool = False,
-                 pp: bool = False, moe: bool = False) -> str:
+                 pp: bool = False, moe: bool = False,
+                 serve: bool = False) -> str:
     """Compact plan encoding of a ``TunedParams``-like knob set: gradient
     leg order | DCN hop wire dtype | stream count | placement
     [| kernel backend]. E.g. ``ar.tree|int8/256|s2|ovl`` or
@@ -1023,6 +1090,14 @@ def encode_tuned(params, *, quantized: bool = False,
             cap = 1.25  # the config default: moe on needs a capacity
         q = "q8" if getattr(params, "moe_quantized", False) else "fp"
         enc += f"|moe{cap:g}/{q}"
+    if serve:
+        # Schema v10 (docs/serving.md): the disaggregated-serving knobs —
+        # speculative draft length / KV-migration wire dtype — join the
+        # plan encoding only when the session tunes a serving engine;
+        # in a training session both are dead knobs and drop out.
+        k = int(getattr(params, "spec_draft_k", 0) or 0)
+        q = "q8" if getattr(params, "kv_migrate_quantized", False) else "fp"
+        enc += f"|sv{k}/{q}"
     return enc
 
 
@@ -1270,6 +1345,8 @@ def decode_tuned(encoding: str) -> dict:
         "pp_interleave": int(m.group("ppv") or 1),
         "moe_capacity_factor": float(m.group("moecap") or 0.0),
         "moe_quantized": m.group("moeq") == "q8",
+        "spec_draft_k": int(m.group("svk") or 0),
+        "kv_migrate_quantized": m.group("svq") == "q8",
     }
     if out["quantized"]:
         out["quant_block"] = int(m.group("wire").split("/", 1)[1])

@@ -8,10 +8,16 @@ millions of users"), built on the training stack's primitives:
 * :mod:`.scheduler` — request queue, Poisson arrival traces, and the
   page-availability-driven admission/preemption policy;
 * :mod:`.engine` — the continuous-batching step loop: mixed prefill/
-  decode in ONE compiled step, eviction + admission every iteration;
+  decode in ONE compiled step, eviction + admission every iteration,
+  with opt-in shared-prefix copy-on-write caching, speculative
+  windowed decoding, and prefill-only/migrated-KV disaggregation
+  hooks;
+* :mod:`.spec` — drafters for the speculative window (the model-free
+  n-gram prompt-lookup drafter by default);
 * :mod:`.replica` — elastic replica groups over device partitions,
   drained (never dropped) across resizes, scaled through the elastic
-  discovery layer.
+  discovery layer; ``disagg=(P, D)`` splits the fleet into prefill and
+  decode halves joined by the ``kv_migrate`` wire plan.
 
 See docs/serving.md for the architecture and the page math.
 """
@@ -21,6 +27,7 @@ from .kv_cache import (  # noqa: F401
     KVCache,
     PageAllocator,
     PageConfig,
+    PrefixCache,
     init_cache,
     kv_cache_pspecs,
     paged_attention,
@@ -31,3 +38,4 @@ from .scheduler import (  # noqa: F401
     Request,
     Scheduler,
 )
+from .spec import NGramDrafter  # noqa: F401

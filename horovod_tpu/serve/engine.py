@@ -17,6 +17,24 @@ the identical collective stack. Timeline spans: ``SERVE:PREFILL`` /
 ``SERVE:DECODE`` bracket the compiled call (whichever phases the step
 contains), ``SERVE:ADMIT`` / ``SERVE:EVICT`` / ``SERVE:PREEMPT`` are
 instants with the slot/request in the name.
+
+Three opt-in extensions (docs/serving.md) compose with the base loop:
+
+* ``prefix_cache=True`` — shared-prefix copy-on-write paging: admitted
+  prompts alias the cached full pages of any previously-prefilled
+  prompt prefix and skip their prefill (the consume cursor starts past
+  the hit); finished prefills register their pages back into the trie.
+* ``spec_k > 0`` — speculative decoding: every decode slot feeds a
+  window of ``1 + k`` tokens (real next token + ``k`` drafter
+  proposals) through ONE compiled windowed step; the model's own argmax
+  verifies the chain, so greedy output is bit-identical to plain
+  decode while accepted drafts advance multiple tokens per step.
+  Prefill slots use the same window to chunk ``W`` prompt tokens/step.
+* ``prefill_only=True`` — the prefill half of a disaggregated pair
+  (replica.py): slots leave at the prefill boundary as
+  ``(request, KV payload, n_tokens)`` handoffs on ``prefill_done``,
+  wire-migrated to a decode replica that resumes them via
+  ``submit_migrated`` with zero prefill replay.
 """
 
 from __future__ import annotations
@@ -39,6 +57,7 @@ from ..parallel.tensor import tp_merge_params, tp_split_params
 from . import kv_cache as kvlib
 from .kv_cache import KVCache, PageConfig
 from .scheduler import Request, Scheduler
+from .spec import NGramDrafter
 
 SERVE_TP_AXIS = "serve_tp"
 
@@ -119,6 +138,7 @@ class ServeStats:
 class _SlotState:
     req: Request
     consumed: int = 0   # tokens fed = this slot's device write cursor
+    prefix_registered: bool = False  # prompt pages offered to the cache
 
     @property
     def n_prompt(self) -> int:
@@ -152,7 +172,9 @@ class GenerationEngine:
                  mesh: Optional[Mesh] = None, tp_axis=None,
                  eos_id: int = 1, temperature: float = 0.0,
                  seed: int = 0, name: str = "replica0",
-                 moe_experts: int = 0, expert_router=None) -> None:
+                 moe_experts: int = 0, expert_router=None,
+                 prefix_cache: bool = False, spec_k: int = 0,
+                 drafter=None, prefill_only: bool = False) -> None:
         import dataclasses
 
         if mesh is None:
@@ -182,9 +204,25 @@ class GenerationEngine:
         self.temperature = temperature
         self.name = name
         self._rng = np.random.RandomState(seed)
-        self.sched = Scheduler(page_config)
+        allocator = kvlib.PageAllocator(page_config.num_pages)
+        self.prefix_cache = (
+            kvlib.PrefixCache(allocator, page_config.page_size)
+            if prefix_cache else None)
+        self.sched = Scheduler(page_config, allocator,
+                               prefix_cache=self.prefix_cache)
         self.slots: Dict[int, _SlotState] = {}
         self.stats = ServeStats()
+        # Disaggregation + speculation state (module docstring).
+        self.prefill_only = bool(prefill_only)
+        self.spec_k = max(0, int(spec_k))
+        self.drafter = drafter if drafter is not None else (
+            NGramDrafter() if self.spec_k else None)
+        # (request, (k, v) [L, n, H, D], n_tokens) tuples awaiting
+        # migration — replica.py drains this after every prefill step.
+        self.prefill_done: List[tuple] = []
+        self._migrated: Dict[object, tuple] = {}  # req_id -> (kv, n_tok)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # Expert-parallel decode accounting (docs/moe.md): with
         # ``moe_experts`` > 0 every consumed token is attributed to its
         # routed expert — ``expert_router(token_id) -> expert`` (default:
@@ -226,6 +264,27 @@ class GenerationEngine:
             in_specs=(stk_spec, P(), cache_specs, P(), P()),
             out_specs=(P(), cache_specs)))
 
+        # Speculative window: ONE compiled program feeding W = spec_k+1
+        # tokens per slot — a single batched apply returning logits
+        # [S, W, V]. The window's k/v land in the cache pages first and
+        # per-query attend lengths (seq_lens + w + 1) keep position w
+        # blind to positions > w, so greedy verification is bit-identical
+        # to W chained single-token steps at ~1/W the dispatch cost (the
+        # whole point of verifying the draft in one batched step).
+        self._window_fn = None
+        if self.spec_k:
+
+            def spmd_w(stk, rp, cache, tokens, valid):
+                local = tp_merge_params(
+                    jax.tree.map(lambda a: a[0], stk), rp)
+                return GPT(model_cfg).apply(
+                    {"params": local}, tokens, cache=cache, active=valid)
+
+            self._window_fn = jax.jit(basics.shard_map(
+                spmd_w, mesh=mesh,
+                in_specs=(stk_spec, P(), cache_specs, P(), P()),
+                out_specs=(P(), cache_specs)))
+
         cache = kvlib.init_cache(page_config, tp=1)  # global-shaped pools
         cache_sh = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(mesh, s), cache_specs)
@@ -235,6 +294,16 @@ class GenerationEngine:
 
     def submit(self, req: Request) -> None:
         self.sched.submit(req)
+
+    def submit_migrated(self, req: Request, kv, n_tokens: int) -> None:
+        """Admit a request whose prefill ran elsewhere: ``kv`` is the
+        migrated ``(k, v)`` payload ([L, n_tokens, H, D] each, host
+        arrays — what ``prefill_done`` hands off, post-wire). It is
+        scattered into this replica's pages at admission and decode
+        resumes at the migrated cursor — no prefill replay. Queued at
+        the front: the payload is already paid for."""
+        self._migrated[req.req_id] = (kv, int(n_tokens))
+        self.sched.submit(req, front=True)
 
     @property
     def has_work(self) -> bool:
@@ -252,22 +321,21 @@ class GenerationEngine:
         """Admit, run ONE compiled mixed prefill/decode step, sample,
         evict. Returns the number of tokens processed (0 = idle)."""
         tl = basics._state.timeline if basics.is_initialized() else None
-        for slot in self.sched.admit(now):
-            self.slots[slot] = _SlotState(self.sched.running[slot])
-            req_id = self.slots[slot].req.req_id
-            _metrics.counter("serve.admissions").inc()
-            # The StallInspector watches every admitted request: one that
-            # sits in a slot past stall_check_time (a wedged compiled
-            # step, a starved replica) surfaces as a STALL:serve.req*
-            # warning (docs/observability.md).
-            _stall.record_start(f"serve.req{req_id}", kind="serve")
-            if tl is not None:
-                tl.instant(f"SERVE:ADMIT slot{slot} "
-                           f"req{req_id}", tid=self.name)
+        self._admit(now, tl)
         _metrics.gauge("serve.queue_depth").set(self.sched.queue_depth())
         _metrics.gauge("serve.in_flight").set(len(self.slots))
+        if self.prefill_only:
+            # Slots already past the boundary (a fully-cached prompt
+            # admitted with its whole prefill aliased) leave before the
+            # step — a prefill replica never decodes.
+            for slot in list(self.slots):
+                if self.slots[slot].consumed >= \
+                        self.slots[slot].n_prompt - 1:
+                    self._handoff(slot, now, tl)
         if not self.slots:
             return 0
+        if self.spec_k:
+            return self._spec_step(now, tl)
 
         # Page growth for this step's write position; preempt youngest on
         # an empty pool (the preempted slot leaves the batch mid-flight).
@@ -361,9 +429,64 @@ class GenerationEngine:
         for slot in list(self.slots):
             st = self.slots[slot]
             st.consumed += 1
+            if self.prefill_only and st.consumed >= st.n_prompt - 1:
+                self._handoff(slot, now, tl)
+                continue
+            self._register_prefix(slot, st)
             if st.consumed < st.n_prompt:
                 continue  # still prefilling: logits discarded
-            tok = self._sample(logits[slot])
+            self._emit(slot, st, [self._sample(logits[slot])], now, tl)
+        return n_prefill + n_decode
+
+    # -- admission / eviction / handoff helpers ---------------------------
+
+    def _admit(self, now: float, tl) -> None:
+        for slot in self.sched.admit(now):
+            req = self.sched.running[slot]
+            st = _SlotState(req, consumed=self.sched.take_prefix_len(slot))
+            self.slots[slot] = st
+            payload = self._migrated.pop(req.req_id, None)
+            if payload is not None:
+                kv, n_tok = payload
+                # Shared prefix pages (if any) already hold EXACT KV —
+                # scatter only past them so a quantized payload never
+                # perturbs pages other tenants read.
+                self._scatter_migrated(slot, kv, n_tok, skip=st.consumed)
+                st.consumed = max(st.consumed, n_tok)
+                _metrics.counter("serve.kv.migrations_in").inc()
+            _metrics.counter("serve.admissions").inc()
+            # The StallInspector watches every admitted request: one that
+            # sits in a slot past stall_check_time (a wedged compiled
+            # step, a starved replica) surfaces as a STALL:serve.req*
+            # warning (docs/observability.md).
+            _stall.record_start(f"serve.req{req.req_id}", kind="serve")
+            if tl is not None:
+                tl.instant(f"SERVE:ADMIT slot{slot} "
+                           f"req{req.req_id}", tid=self.name)
+        if self.prefix_cache is not None:
+            pc = self.prefix_cache
+            _metrics.gauge("serve.prefix_lookups").set(pc.lookups)
+            _metrics.gauge("serve.prefix_hits").set(pc.hits)
+            _metrics.gauge("serve.prefix_hit_tokens").set(pc.hit_tokens)
+            _metrics.gauge("serve.prefix_hit_rate").set(pc.hit_rate)
+            _metrics.gauge("serve.prefix_cached_pages").set(
+                pc.cached_pages)
+
+    def _register_prefix(self, slot: int, st: _SlotState) -> None:
+        # Offer the prompt's full pages to the trie once its KV is
+        # complete (consumed >= n_prompt-1 covers every insertable page:
+        # insert caps at (n_prompt-1)//page_size full pages).
+        if (st.prefix_registered or self.prefix_cache is None
+                or st.consumed < st.n_prompt - 1):
+            return
+        st.prefix_registered = True
+        self.sched.register_prefix(slot)
+
+    def _emit(self, slot: int, st: _SlotState, toks: Sequence[int],
+              now: float, tl) -> None:
+        """Append sampled tokens in order, finishing (and truncating the
+        remainder) at EOS or the new-token budget."""
+        for tok in toks:
             st.req.generated.append(tok)
             if st.req.first_token_time is None:
                 st.req.first_token_time = now
@@ -377,7 +500,238 @@ class GenerationEngine:
                 if tl is not None:
                     tl.instant(f"SERVE:EVICT slot{slot} req{req.req_id} "
                                f"{reason}", tid=self.name)
-        return n_prefill + n_decode
+                return
+
+    def _handoff(self, slot: int, now: float, tl) -> None:
+        """Prefill boundary reached on a prefill-only replica: register
+        the prompt with the prefix cache, pull the slot's KV off-device,
+        release the pages, and queue the handoff for migration."""
+        st = self.slots.pop(slot)
+        self._register_prefix(slot, st)
+        n_tok = st.n_prompt - 1
+        kv = self._gather_slot_kv(slot, n_tok)
+        req = self.sched.release(slot)
+        self.prefill_done.append((req, kv, n_tok))
+        _metrics.counter("serve.prefill_handoffs").inc()
+        _stall.record_done(f"serve.req{req.req_id}")
+        if tl is not None:
+            tl.instant(f"SERVE:PREFILL_DONE slot{slot} req{req.req_id}",
+                       tid=self.name)
+
+    def _gather_slot_kv(self, slot: int, n_tok: int):
+        """Contiguous KV for one slot, all layers: ``(k, v)`` host
+        arrays [L, n_tok, H, D]. Indexes with the HOST page table (the
+        device copy can be one admission stale). Always gathers the FULL
+        slot row (ungranted entries hit the zero null page) so the
+        compiled gather has ONE shape per engine — per-request lengths
+        would otherwise recompile it mid-trace."""
+        ps = self.page_config.page_size
+        n_pages = self.page_config.pages_for(n_tok)
+        table = jnp.asarray(self.sched.page_table[slot])
+        k = np.asarray(self.cache.k[:, table])   # [L, Pps, ps, H, D]
+        v = np.asarray(self.cache.v[:, table])
+        L = k.shape[0]
+        k = k.reshape(L, table.shape[0] * ps, *k.shape[3:])[:, :n_tok]
+        v = v.reshape(L, table.shape[0] * ps, *v.shape[3:])[:, :n_tok]
+        return k, v
+
+    def _scatter_migrated(self, slot: int, kv, n_tok: int,
+                          skip: int = 0) -> None:
+        """Write a migrated KV payload into the slot's granted pages,
+        skipping the first ``skip`` tokens (full shared-prefix pages —
+        ``skip`` is always a page multiple)."""
+        k, v = kv
+        ps = self.page_config.page_size
+        Pps = self.page_config.pages_per_slot
+        n_pages = self.page_config.pages_for(n_tok)
+        start = skip // ps
+        if start >= n_pages:
+            return
+        L = k.shape[0]
+        # Fixed-shape scatter: always write the full [Pps] slot row so
+        # the compiled scatter has ONE shape per engine (per-request
+        # lengths would recompile it mid-trace). Entries outside
+        # [start, n_pages) redirect to the null page with zero payload —
+        # the null page stays zero and real pages outside the span are
+        # untouched.
+        pad = Pps * ps - n_tok
+        if pad:
+            zk = np.zeros((L, pad) + k.shape[2:], k.dtype)
+            k = np.concatenate([k, zk], axis=1)
+            v = np.concatenate([v, np.zeros_like(zk)], axis=1)
+        kp = k.reshape(L, Pps, ps, *k.shape[2:])
+        vp = v.reshape(L, Pps, ps, *v.shape[2:])
+        live = np.zeros((Pps,), bool)
+        live[start:n_pages] = True
+        kp = np.where(live[None, :, None, None, None], kp, 0)
+        vp = np.where(live[None, :, None, None, None], vp, 0)
+        pages = jnp.asarray(np.where(live, self.sched.page_table[slot],
+                                     kvlib.NULL_PAGE))
+        dt = self.cache.k.dtype
+        self.cache = self.cache._replace(
+            k=self.cache.k.at[:, pages].set(jnp.asarray(kp, dt)),
+            v=self.cache.v.at[:, pages].set(jnp.asarray(vp, dt)))
+
+    # -- the speculative windowed step ------------------------------------
+
+    def _spec_step(self, now: float, tl) -> int:
+        """One compiled W = spec_k+1 token window per slot: prefill
+        slots chunk W prompt tokens; decode slots feed the real next
+        token plus spec_k drafts and keep the longest argmax-verified
+        chain (module docstring — greedy output is bit-identical to the
+        W=1 path because each window position's logits condition on
+        exactly the verified prefix)."""
+        W = self.spec_k + 1
+        S = self.page_config.max_slots
+
+        # Per-slot window plan (before page growth: preemption below
+        # drops victims from the plan).
+        plans: Dict[int, tuple] = {}
+        for slot, st in self.slots.items():
+            if st.consumed < st.n_prompt:
+                cap = st.n_prompt - st.consumed
+                if self.prefill_only:
+                    cap = max(1, st.n_prompt - 1 - st.consumed)
+                w_valid = min(W, cap)
+                toks = list(st.req.prompt[
+                    st.consumed:st.consumed + w_valid])
+            else:
+                w_valid = max(1, min(
+                    W, st.req.remaining_new_tokens,
+                    self.page_config.tokens_per_slot - st.consumed))
+                drafts = self.drafter.propose(
+                    st.req.prompt + st.req.generated, w_valid - 1)
+                toks = [st.next_token()] + list(drafts)
+            plans[slot] = (w_valid, [int(t) for t in toks])
+
+        # Page growth over the whole window; preempt youngest on an
+        # empty pool, exactly as the W=1 path.
+        for slot in sorted(plans):
+            if slot not in self.slots:
+                continue
+            st = self.slots[slot]
+            w_valid, _ = plans[slot]
+            for off in range(w_valid):
+                while not self.sched.ensure_page(slot, st.consumed + off):
+                    victim = self.sched.preempt_for_page(slot)
+                    if victim is None:
+                        raise RuntimeError(
+                            f"page pool exhausted by a single sequence "
+                            f"(slot {slot}, pos {st.consumed + off}): "
+                            f"size the pool to at least "
+                            f"pages_for(prompt+max_new_tokens)")
+                    self.stats.preemptions += 1
+                    _metrics.counter("serve.preemptions").inc()
+                    _stall.record_done(
+                        f"serve.req{self.slots[victim].req.req_id}")
+                    if tl is not None:
+                        tl.instant(
+                            f"SERVE:PREEMPT slot{victim} "
+                            f"req{self.slots[victim].req.req_id}",
+                            tid=self.name)
+                    del self.slots[victim]
+                    plans.pop(victim, None)
+
+        tokens = np.zeros((S, W), np.int32)
+        valid = np.zeros((S, W), bool)
+        lens = np.zeros((S,), np.int32)
+        n_prefill = n_decode = 0
+        for slot, st in self.slots.items():
+            w_valid, toks = plans[slot]
+            tokens[slot, :w_valid] = toks
+            valid[slot, :w_valid] = True
+            lens[slot] = st.consumed
+            if st.in_prefill:
+                n_prefill += 1
+            else:
+                n_decode += 1
+
+        cache = self.cache._replace(
+            page_table=jnp.asarray(self.sched.page_table),
+            seq_lens=jnp.asarray(lens))
+        phases = ([("PREFILL", n_prefill)] if n_prefill else []) + \
+                 ([("DECODE", n_decode)] if n_decode else [])
+        if tl is not None:
+            for ph, _ in phases:
+                tl.begin(self.name, f"SERVE:{ph}")
+        with jax.profiler.StepTraceAnnotation("serve_step",
+                                              step_num=self.stats.steps):
+            logits, self.cache = self._window_fn(
+                self._stacked, self._repl, cache,
+                jnp.asarray(tokens), jnp.asarray(valid))
+        if tl is not None:
+            for ph, _ in reversed(phases):
+                tl.end(self.name, f"SERVE:{ph}")
+        logits = np.asarray(logits)   # [S, W, V]
+
+        step_prefill = step_decode = 0
+        proposed = accepted = 0
+        for slot in list(self.slots):
+            st = self.slots[slot]
+            w_valid, toks = plans[slot]
+            old = st.consumed
+            if old < st.n_prompt:
+                # Chunked prefill: positions feeding prompt indices
+                # below n_prompt-1 count as prefill, the boundary
+                # position (whose logits sample the first token) as
+                # decode — same accounting as W=1 steps.
+                st.consumed = old + w_valid
+                step_prefill += min(w_valid, st.n_prompt - 1 - old)
+                if self.prefill_only and \
+                        st.consumed >= st.n_prompt - 1:
+                    self._handoff(slot, now, tl)
+                    continue
+                self._register_prefix(slot, st)
+                if st.consumed >= st.n_prompt:
+                    step_decode += 1
+                    self._emit(slot, st,
+                               [self._sample(logits[slot, w_valid - 1])],
+                               now, tl)
+                continue
+            # Decode: verify the draft chain against this window's own
+            # argmax. Window position w's logits condition on tokens
+            # through position w; draft w (fed at position w+1) is
+            # accepted iff it equals that argmax — then position w+1's
+            # logits are the true next conditional and the chain
+            # continues. The first mismatch's argmax is the correction
+            # token (always emitted), exactly what plain decode would
+            # have produced.
+            emitted: List[int] = []
+            acc = 0
+            for w in range(w_valid):
+                tok = self._sample(logits[slot, w])
+                emitted.append(tok)
+                if w + 1 < w_valid and tok == toks[w + 1]:
+                    acc += 1
+                else:
+                    break
+            proposed += w_valid - 1
+            accepted += acc
+            # KV through old+acc is verified; the last emitted token
+            # (the correction) has not been fed yet.
+            st.consumed = old + 1 + acc
+            step_decode += len(emitted)
+            self._emit(slot, st, emitted, now, tl)
+
+        self.stats.prefill_tokens += step_prefill
+        self.stats.decode_tokens += step_decode
+        self.stats.steps += 1
+        _metrics.counter("serve.steps").inc()
+        _metrics.counter("serve.prefill_tokens").inc(step_prefill)
+        _metrics.counter("serve.decode_tokens").inc(step_decode)
+        if proposed:
+            self._spec_proposed += proposed
+            self._spec_accepted += accepted
+            _metrics.counter("serve.spec.proposed").inc(proposed)
+            _metrics.counter("serve.spec.accepted").inc(accepted)
+            _metrics.gauge("serve.spec.acceptance_rate").set(
+                self._spec_accepted / max(1, self._spec_proposed))
+        _flight.instant("FLIGHT:SERVE_STEP", tid="flight",
+                        args={"engine": self.name,
+                              "step": self.stats.steps,
+                              "prefill": n_prefill, "decode": n_decode,
+                              "slots": len(self.slots), "window": W})
+        return step_prefill + step_decode
 
     def _sample(self, row: np.ndarray) -> int:
         if self.temperature <= 0.0:
@@ -425,6 +779,10 @@ class GenerationEngine:
         for st in self.slots.values():
             _stall.record_done(f"serve.req{st.req.req_id}")
         self.slots.clear()
+        # Pending migrated payloads are dropped with the drain — their
+        # requests are still queued and simply replay prefill wherever
+        # they land next.
+        self._migrated.clear()
         drained = self.sched.drain()
         self.stats.resizes += len(drained)
         queued, self.sched.queue = self.sched.queue, []

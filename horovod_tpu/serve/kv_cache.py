@@ -136,6 +136,13 @@ class StepMeta(NamedTuple):
     for inactive slots). attend_len: ``[S]`` tokens visible to the step's
     query AFTER its own k/v lands (``seq_lens + 1``; min 1 on inactive
     slots so the masked softmax stays finite). active: ``[S]`` bool.
+
+    **Windowed steps** (speculative verify / chunked prefill): when
+    ``active`` is ``[S, W]`` every field is ``[S, W]`` — window position
+    ``w`` of slot ``s`` writes at sequence position ``seq_lens[s] + w``
+    and attends ``seq_lens[s] + w + 1`` tokens, so one batched apply
+    reproduces ``W`` sequential single-token steps bit-exactly (each
+    query's mask admits exactly the positions the chain had written).
     """
 
     write_page: jnp.ndarray
@@ -148,11 +155,18 @@ def step_meta(cache: KVCache, active, page_size: int,
               ring_axis=None) -> StepMeta:
     """Build the step's write coordinates. In ring mode (``ring_axis``)
     the owner of the write page is ``global_page % n``; non-owners (and
-    inactive slots) write to their null page."""
-    pos = cache.seq_lens
+    inactive slots) write to their null page. ``active [S, W]`` builds
+    windowed coordinates (see :class:`StepMeta`); window validity must be
+    a contiguous prefix per slot."""
     active = jnp.asarray(active, bool)
     slot = jnp.arange(cache.page_table.shape[0])
-    gpage = cache.page_table[slot, pos // page_size]
+    if active.ndim == 2:
+        W = active.shape[1]
+        pos = cache.seq_lens[:, None] + jnp.arange(W)[None, :]
+        gpage = cache.page_table[slot[:, None], pos // page_size]
+    else:
+        pos = cache.seq_lens
+        gpage = cache.page_table[slot, pos // page_size]
     off = pos % page_size
     if ring_axis is not None:
         n = _ring_size(ring_axis)
@@ -198,10 +212,11 @@ def ring_pool_ids(total_pages: int, n: int) -> int:
 
 def append_layer_kv(cache: KVCache, layer: int, k_new, v_new,
                     meta: StepMeta) -> KVCache:
-    """Scatter one step's k/v (``[S, H, D]``) into layer ``layer`` at the
-    step's write coordinates. Inactive (and, in ring mode, non-owner)
-    slots land on the null page — duplicate indices there are harmless
-    because the null page is never read."""
+    """Scatter one step's k/v (``[S, H, D]``, or ``[S, W, H, D]`` with
+    windowed meta) into layer ``layer`` at the step's write coordinates.
+    Inactive (and, in ring mode, non-owner) slots land on the null page —
+    duplicate indices there are harmless because the null page is never
+    read."""
     k = cache.k.at[layer, meta.write_page, meta.write_off].set(
         k_new.astype(cache.k.dtype))
     v = cache.v.at[layer, meta.write_page, meta.write_off].set(
@@ -211,9 +226,12 @@ def append_layer_kv(cache: KVCache, layer: int, k_new, v_new,
 
 def advance(cache: KVCache, meta: StepMeta) -> KVCache:
     """Commit the step: bump write cursors of active slots (call once per
-    step, after every layer appended)."""
-    return cache._replace(
-        seq_lens=cache.seq_lens + meta.active.astype(jnp.int32))
+    step, after every layer appended). Windowed meta advances each slot
+    by its count of valid window positions."""
+    inc = meta.active.astype(jnp.int32)
+    if inc.ndim == 2:
+        inc = inc.sum(axis=-1)
+    return cache._replace(seq_lens=cache.seq_lens + inc)
 
 
 def _gather_pages(pool, page_table):
@@ -226,22 +244,24 @@ def _gather_pages(pool, page_table):
 
 
 def _attend(q, keys, vals, mask, scale):
-    """Masked single-query attention partials.
+    """Masked few-query attention partials.
 
-    q ``[S, 1, H, D]``, keys/vals ``[S, T, H, D]``, mask ``[S, T]`` →
-    flash accumulator ``(o [S,1,H,D] fp32 unnormalized, m [S,1,H],
-    l [S,1,H])`` so callers can either normalize locally or merge partials
+    q ``[S, Q, H, D]``, keys/vals ``[S, T, H, D]``, mask ``[S, T]``
+    (shared by every query) or ``[S, Q, T]`` (per-query, windowed steps)
+    → flash accumulator ``(o [S,Q,H,D] fp32 unnormalized, m [S,Q,H],
+    l [S,Q,H])`` so callers can either normalize locally or merge partials
     across a mesh axis (ring mode)."""
+    mb = mask[:, None, None, :] if mask.ndim == 2 else mask[:, :, None, :]
     s = jnp.einsum("sqhd,skhd->sqhk", q.astype(jnp.float32),
                    keys.astype(jnp.float32),
                    preferred_element_type=jnp.float32) * scale
-    s = jnp.where(mask[:, None, None, :], s, _NEG_INF)
-    m = jnp.max(s, axis=-1)                                 # [S,1,H]
+    s = jnp.where(mb, s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                 # [S,Q,H]
     # Guard fully-masked rows: exp(-inf - -inf) would be NaN.
     m_safe = jnp.maximum(m, _NEG_INF / 2)
     p = jnp.exp(s - m_safe[..., None])
-    p = jnp.where(mask[:, None, None, :], p, 0.0)
-    l = jnp.sum(p, axis=-1)                                 # [S,1,H]
+    p = jnp.where(mb, p, 0.0)
+    l = jnp.sum(p, axis=-1)                                 # [S,Q,H]
     o = jnp.einsum("sqhk,skhd->sqhd", p, vals.astype(jnp.float32))
     return o, m_safe, l
 
@@ -249,11 +269,13 @@ def _attend(q, keys, vals, mask, scale):
 def paged_attention_partial(q, k_pool, v_pool, page_table, attend_len,
                             scale: Optional[float] = None,
                             page_mask=None, page_positions=None):
-    """Flash-softmax partials of a single decode query over this rank's
-    pages. ``page_mask`` ``[S, Pps]`` (default: all table entries count)
-    masks entries another rank owns; ``page_positions`` ``[S, Pps]``
-    (default ``j``) gives each entry's GLOBAL page index within the
-    sequence so position masking survives ring striping."""
+    """Flash-softmax partials of a decode query over this rank's pages.
+    ``attend_len`` is ``[S]`` (one query per slot) or ``[S, W]``
+    (windowed verify: per-query visible lengths). ``page_mask``
+    ``[S, Pps]`` (default: all table entries count) masks entries another
+    rank owns; ``page_positions`` ``[S, Pps]`` (default ``j``) gives each
+    entry's GLOBAL page index within the sequence so position masking
+    survives ring striping."""
     S, Pps = page_table.shape
     ps = k_pool.shape[1]
     D = q.shape[-1]
@@ -265,9 +287,13 @@ def paged_attention_partial(q, k_pool, v_pool, page_table, attend_len,
     # Position of table entry j, offset t: page_positions[s,j]*ps + t.
     pos = (page_positions[:, :, None] * ps
            + jnp.arange(ps)[None, None, :]).reshape(S, Pps * ps)
-    mask = pos < attend_len[:, None]
+    if jnp.ndim(attend_len) == 2:
+        mask = pos[:, None, :] < attend_len[:, :, None]     # [S, W, T]
+    else:
+        mask = pos < attend_len[:, None]                    # [S, T]
     if page_mask is not None:
-        mask = mask & jnp.repeat(page_mask, ps, axis=1)
+        pm = jnp.repeat(page_mask, ps, axis=1)
+        mask = mask & (pm[:, None, :] if mask.ndim == 3 else pm)
     return _attend(q, keys, vals, mask, scale)
 
 
@@ -293,8 +319,10 @@ def merge_attention_partials(o, m, l, axis):
 
 def paged_attention(q, k_pool, v_pool, page_table, attend_len,
                     scale: Optional[float] = None, ring_axis=None):
-    """Single-token paged attention: ``q [S, 1, H, D]`` against the slot's
-    cached pages, masked to ``attend_len`` tokens. With ``ring_axis`` the
+    """Paged attention: ``q [S, 1, H, D]`` (or ``[S, W, H, D]`` with
+    ``attend_len [S, W]`` — the batched speculative-verify window)
+    against the slot's cached pages, masked per query to ``attend_len``
+    tokens. With ``ring_axis`` the
     table holds GLOBAL page ids striped ``g % n`` across the axis: each
     rank attends its local stripe and the partials merge ring-style."""
     if ring_axis is not None:
@@ -344,13 +372,22 @@ def gather_slot_kv(cache: KVCache, layer: int, slot: int,
 
 
 class PageAllocator:
-    """Host-side free-list over the page pool (ring mode: over GLOBAL page
-    ids ``1..total_pages-1``; page 0 is the null page).
+    """Host-side refcounted free-list over the page pool (ring mode: over
+    GLOBAL page ids ``1..total_pages-1``; page 0 is the null page).
 
     All-or-nothing grants: ``alloc``/``extend`` either return the pages or
     ``None`` with no state change — the scheduler's admission invariant
     ("admission never exceeds free pages") falls out of that atomicity.
-    ``check_invariants`` is O(pages) and meant for tests/debug asserts.
+
+    **Copy-on-write aliasing** (docs/serving.md): a page may have several
+    readers — the sequences whose page-table rows list it, plus the
+    prefix cache's own hold (``retain``/``release``). ``_refs[p]`` counts
+    them all; a page returns to the free list exactly when the LAST
+    reader lets go, so an aliased shared-prefix page can never be
+    recycled under a live reader. Writes stay exclusive by construction:
+    the scheduler only hands out FULL (immutable) prefix pages, and a
+    tenant's write cursor starts past them — ``check_invariants``
+    cross-checks the refcount bookkeeping, O(pages), for tests/debug.
     """
 
     def __init__(self, total_pages: int) -> None:
@@ -361,6 +398,13 @@ class PageAllocator:
         # aliasing test's worst case, on purpose).
         self._free: List[int] = list(range(total_pages - 1, 0, -1))
         self._owner: Dict[int, List[int]] = {}
+        # Total readers per granted page (owner-list memberships plus
+        # external retain() holds); absent == page is free.
+        self._refs: Dict[int, int] = {}
+        # The externally-held component of _refs (the prefix cache's
+        # holds) — tracked separately so check_invariants can verify
+        # refs == owner-list count + external holds exactly.
+        self._held: Dict[int, int] = {}
 
     @property
     def free_pages(self) -> int:
@@ -369,42 +413,245 @@ class PageAllocator:
     def pages_of(self, seq_id) -> List[int]:
         return list(self._owner.get(seq_id, ()))
 
-    def alloc(self, seq_id, n: int) -> Optional[List[int]]:
-        """Grant ``n`` pages to a NEW sequence, or None if short."""
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def alloc(self, seq_id, n: int,
+              shared: Optional[List[int]] = None) -> Optional[List[int]]:
+        """Grant ``n`` FRESH pages to a NEW sequence, or None if short.
+        ``shared`` prepends already-granted (copy-on-write) pages to the
+        owner list — each gains this sequence as a reader (+1 ref) —
+        so a prefix-hit admission is ``alloc(seq, n_private,
+        shared=prefix_pages)``. Atomic: a short pool leaves the shared
+        pages' refcounts untouched."""
         if seq_id in self._owner:
             raise ValueError(f"sequence {seq_id!r} already live")
         if n > len(self._free):
             return None
+        shared = list(shared or ())
+        for p in shared:
+            if p not in self._refs:
+                raise ValueError(f"shared page {p} is not granted")
         pages = [self._free.pop() for _ in range(n)]
-        self._owner[seq_id] = pages
-        return pages
+        for p in shared:
+            self._refs[p] += 1
+        for p in pages:
+            self._refs[p] = 1
+        self._owner[seq_id] = shared + pages
+        return self._owner[seq_id]
 
     def extend(self, seq_id, n: int = 1) -> Optional[List[int]]:
-        """Grow a live sequence by ``n`` pages, or None if short."""
+        """Grow a live sequence by ``n`` fresh pages, or None if short."""
         if seq_id not in self._owner:
             raise ValueError(f"sequence {seq_id!r} not live")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
         self._owner[seq_id].extend(pages)
         return pages
 
+    def retain(self, pages: List[int]) -> None:
+        """Add an external reader hold on already-granted pages (the
+        prefix cache pinning the pages it indexes)."""
+        for p in pages:
+            if p not in self._refs:
+                raise ValueError(f"cannot retain free page {p}")
+        for p in pages:
+            self._refs[p] += 1
+            self._held[p] = self._held.get(p, 0) + 1
+
+    def release(self, pages: List[int]) -> List[int]:
+        """Drop an external reader hold; returns the pages whose LAST
+        reader this was (now back on the free list)."""
+        freed: List[int] = []
+        for p in pages:
+            held = self._held.get(p, 0)
+            if held <= 0:
+                raise ValueError(f"page {p} has no external hold")
+            self._held[p] = held - 1
+            if self._held[p] == 0:
+                del self._held[p]
+            freed.extend(self._unref(p))
+        return freed
+
+    def _unref(self, p: int) -> List[int]:
+        self._refs[p] -= 1
+        if self._refs[p] == 0:
+            del self._refs[p]
+            self._free.append(p)
+            return [p]
+        return []
+
     def free(self, seq_id) -> List[int]:
-        """Release exactly the sequence's pages back to the pool."""
+        """Remove the sequence as a reader of its pages; pages whose last
+        reader it was return to the pool (and are the return value —
+        aliased prefix pages with other live readers stay granted)."""
         pages = self._owner.pop(seq_id)
-        self._free.extend(pages)
-        return pages
+        freed: List[int] = []
+        for p in pages:
+            freed.extend(self._unref(p))
+        return freed
 
     def live_sequences(self) -> List:
         return list(self._owner)
 
     def check_invariants(self) -> None:
-        """No page double-owned, none both free and owned, null page never
-        granted, and the pool accounts for every page."""
-        owned = [p for pages in self._owner.values() for p in pages]
-        assert len(owned) == len(set(owned)), "page owned twice"
-        assert NULL_PAGE not in owned, "null page allocated"
+        """Refcount bookkeeping is exact: every granted page's refcount
+        equals its owner-list memberships plus its external holds (a COW
+        page is freed exactly when the last reader releases), a page is
+        listed at most once per owner (cross-tenant aliasing never turns
+        into intra-tenant duplication), the null page is never granted,
+        free and granted sets are disjoint, and the pool accounts for
+        every page."""
+        owner_count: Dict[int, int] = {}
+        for seq_id, pages in self._owner.items():
+            assert len(pages) == len(set(pages)), \
+                f"sequence {seq_id!r} lists a page twice"
+            for p in pages:
+                owner_count[p] = owner_count.get(p, 0) + 1
+        granted = set(self._refs)
+        assert set(owner_count) <= granted, "owned page with no refcount"
+        assert set(self._held) <= granted, "held page with no refcount"
+        for p in granted:
+            expect = owner_count.get(p, 0) + self._held.get(p, 0)
+            assert self._refs[p] == expect, (
+                f"page {p}: refcount {self._refs[p]} != "
+                f"{owner_count.get(p, 0)} owners + "
+                f"{self._held.get(p, 0)} holds")
+            assert self._refs[p] >= 1, f"granted page {p} with zero refs"
+        assert NULL_PAGE not in granted, "null page allocated"
         assert NULL_PAGE not in self._free, "null page in free list"
-        assert not (set(owned) & set(self._free)), "page both free and owned"
-        assert len(owned) + len(self._free) == self.total_pages - 1, \
+        assert not (granted & set(self._free)), "page both free and granted"
+        assert len(granted) + len(self._free) == self.total_pages - 1, \
             "pages leaked"
+
+
+class PrefixCache:
+    """Copy-on-write shared-prefix page cache (docs/serving.md).
+
+    A trie over FULL pages of prompt tokens: each node is keyed by
+    ``(parent_node, page_tokens)`` and pins one physical page whose KV
+    holds exactly those tokens at those positions (prefix KV depends
+    only on the token ids and absolute positions, so it is identical
+    across tenants). The cache holds one allocator reference per cached
+    page (``retain``), so a cached page can never be recycled while the
+    cache — or any tenant reading through it — is alive; eviction
+    (``evict_unreferenced``) releases only pages no tenant currently
+    reads (refcount == the cache's own hold), leaf-first so chains stay
+    walkable.
+
+    Sharing is capped at ``len(prompt) - 1`` tokens: a tenant must
+    consume at least one prompt token itself to produce its first
+    logits, and the cap keeps every shared page FULL — tenants write
+    from their first private page, never into an aliased one.
+    """
+
+    def __init__(self, allocator: PageAllocator, page_size: int) -> None:
+        self.allocator = allocator
+        self.page_size = int(page_size)
+        # (parent_node_id, page token tuple) -> node record.
+        self._nodes: Dict[tuple, dict] = {}
+        self._children: Dict[int, int] = {}   # node id -> cached children
+        self._next_id = 1
+        self._clock = 0
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0
+        self.insertions = 0
+        self.evictions = 0
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def hit_rate(self) -> float:
+        """Fraction of lookups that matched at least one full page."""
+        return self.hits / self.lookups if self.lookups else 0.0
+
+    def _shareable_pages(self, prompt) -> int:
+        return max(0, (len(prompt) - 1) // self.page_size)
+
+    def _walk(self, prompt, limit: int):
+        """Yield ``(key, node_or_None)`` down the trie for each full
+        page of ``prompt`` up to ``limit`` pages."""
+        ps = self.page_size
+        parent = 0
+        for i in range(limit):
+            key = (parent, tuple(prompt[i * ps:(i + 1) * ps]))
+            node = self._nodes.get(key)
+            yield key, node
+            if node is None:
+                return
+            parent = node["id"]
+
+    def lookup(self, prompt, *, count: bool = True):
+        """Longest cached prefix of ``prompt``: ``(pages, n_tokens)``
+        where ``pages`` are the aliased physical pages (NOT yet
+        retained — the caller's ``alloc(..., shared=pages)`` adds the
+        tenant's reader refs atomically with its private grant).
+        ``count=False`` re-walks without touching the hit/lookup stats
+        (the post-eviction retry path)."""
+        self._clock += 1
+        if count:
+            self.lookups += 1
+        pages: List[int] = []
+        for _key, node in self._walk(prompt, self._shareable_pages(prompt)):
+            if node is None:
+                break
+            node["last_use"] = self._clock
+            pages.append(node["page"])
+        if pages and count:
+            self.hits += 1
+            self.hit_tokens += len(pages) * self.page_size
+        return pages, len(pages) * self.page_size
+
+    def insert(self, prompt, pages: List[int]) -> int:
+        """Register a prefilled sequence's full prompt pages (``pages``
+        is its page-table row, shared prefix first — the walk order):
+        new trie nodes retain their page; existing nodes are left as-is
+        (first writer wins). Returns the number of NEW pages cached."""
+        added = 0
+        ps = self.page_size
+        limit = min(self._shareable_pages(prompt), len(pages))
+        parent = 0
+        for i in range(limit):
+            key = (parent, tuple(prompt[i * ps:(i + 1) * ps]))
+            node = self._nodes.get(key)
+            if node is None:
+                self.allocator.retain([pages[i]])
+                node = {"id": self._next_id, "page": pages[i],
+                        "last_use": self._clock}
+                self._next_id += 1
+                self._nodes[key] = node
+                self._children[parent] = self._children.get(parent, 0) + 1
+                added += 1
+            parent = node["id"]
+        self.insertions += added
+        return added
+
+    def evict_unreferenced(self, need: Optional[int] = None) -> int:
+        """Release cached pages no tenant currently reads (allocator
+        refcount == 1, the cache's own hold), LRU-first and leaf-only
+        (a node with cached children stays — chains must remain
+        walkable). Stops after freeing ``need`` pages when given.
+        Never touches a page with live readers."""
+        freed = 0
+        while need is None or freed < need:
+            victims = sorted(
+                (node["last_use"], key)
+                for key, node in self._nodes.items()
+                if not self._children.get(node["id"], 0)
+                and self.allocator.refcount(node["page"]) == 1)
+            if not victims:
+                break
+            for _, key in victims:
+                node = self._nodes.pop(key)
+                self._children[key[0]] = self._children.get(key[0], 1) - 1
+                freed += len(self.allocator.release([node["page"]]))
+                self.evictions += 1
+                if need is not None and freed >= need:
+                    return freed
+        return freed

@@ -19,16 +19,37 @@ elastic discovery layer** (elastic/discovery.py): a
 ``min(available groups, queue-pressure target)`` — discovery shrinking
 the fleet forces a scale-down, discovery re-adding capacity (plus queue
 depth beyond ``scale_up_depth``) grows it back.
+
+**Disaggregated serving** (``disagg=(P, D)``, docs/serving.md): the
+first ``P`` replicas run prefill-only (prefix cache attached — that is
+where a shared-prompt hit skips work), the remaining ``D`` decode-only
+(speculative window attached — that is where per-step latency
+dominates). A finished prefill's KV pages ride the ``kv_migrate`` wire
+plan to the least-loaded decode replica — layer chunks pumped BETWEEN
+decode steps (``migrate_layers_per_step``) so the destination batch
+keeps stepping while the handoff is on the wire; a decode step that
+finds no work while a migration is pending counts into
+``serve.kv.stall_steps``, the disagg leg's stall budget. The
+autoscaler re-splits ``P:D`` by measured prefill:decode token demand.
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 
 from ..common import basics
 from ..elastic.discovery import HostDiscovery, HostManager
+from ..monitor import registry as _metrics
+from ..monitor import straggler as _straggler
+from ..plan import compiler as _wire
+from ..plan import ir as _ir
+from ..plan.accounting import kv_span
+from ..plan.cost import predict_hop_ms, price_kv_migrate
+from ..plan.planner import derive_kv_migrate, predict_kv_migrate_bytes
 from .engine import GenerationEngine, ServeStats, VirtualClock, WallClock
 from .kv_cache import PageConfig
 from .scheduler import Request
@@ -44,9 +65,13 @@ class ReplicaSet:
                  eos_id: int = 1, temperature: float = 0.0,
                  seed: int = 0, moe_experts: int = 0,
                  expert_router=None, hot_expert_factor: float = 2.0,
-                 rebalance_every: int = 8) -> None:
-        import numpy as np
-
+                 rebalance_every: int = 8,
+                 disagg: Optional[Tuple[int, int]] = None,
+                 prefix_cache: bool = False, spec_k: int = 0,
+                 kv_migrate_quantized: bool = False,
+                 kv_migrate_block: Optional[int] = None,
+                 kv_mesh_shape: Optional[Tuple[int, ...]] = None,
+                 migrate_layers_per_step: int = 2) -> None:
         self.cfg = cfg
         self.params = params
         self.page_config = page_config
@@ -79,6 +104,27 @@ class ReplicaSet:
         self._drained_expert_tokens = (
             np.zeros((self.moe_experts,), np.int64)
             if self.moe_experts else None)
+        # Disaggregation state (module docstring). The migration wire
+        # plan is derived once for the fleet's replica-to-replica hop
+        # (``kv_mesh_shape`` names the geometry — the default single
+        # host is an ICI hop, where int8 is forced off by the planner's
+        # placement rule).
+        self._disagg = (int(disagg[0]), int(disagg[1])) if disagg \
+            else None
+        self.prefix_cache_enabled = bool(prefix_cache)
+        self.spec_k = max(0, int(spec_k))
+        self.kv_mesh_shape = (tuple(kv_mesh_shape) if kv_mesh_shape
+                              else (len(self.devices), 1))
+        self.kv_plan = derive_kv_migrate(
+            mesh_shape=self.kv_mesh_shape,
+            quantized=kv_migrate_quantized, block=kv_migrate_block)
+        self.migrate_layers_per_step = max(1, int(migrate_layers_per_step))
+        self._migrations: List[Dict] = []     # in flight, FIFO
+        self.migration_events: List[Dict] = []
+        self.kv_migrations = 0
+        self.kv_migration_bytes = 0.0
+        self.kv_migration_fp_bytes = 0.0
+        self.kv_stall_steps = 0
         self._build(n_replicas)
 
     @property
@@ -91,16 +137,37 @@ class ReplicaSet:
             raise ValueError(
                 f"{n_replicas} replicas do not evenly partition "
                 f"{n_dev} devices")
+        if self._disagg is not None:
+            p, d = self._disagg
+            if p < 1 or d < 1 or p + d != n_replicas:
+                raise ValueError(
+                    f"disagg split {self._disagg} must be two positive "
+                    f"counts summing to n_replicas={n_replicas}")
         per = n_dev // n_replicas
-        self.engines = [
-            GenerationEngine(
+        self.engines = []
+        for i in range(n_replicas):
+            is_prefill = self._disagg is not None and i < self._disagg[0]
+            is_decode = self._disagg is not None and not is_prefill
+            name = (f"prefill{i}" if is_prefill else
+                    f"decode{i - self._disagg[0]}" if is_decode else
+                    f"replica{i}")
+            self.engines.append(GenerationEngine(
                 self.cfg, self.params, self.page_config,
                 devices=self.devices[i * per:(i + 1) * per],
                 eos_id=self.eos_id, temperature=self.temperature,
-                seed=self.seed + i, name=f"replica{i}",
+                seed=self.seed + i, name=name,
                 moe_experts=self.moe_experts,
-                expert_router=self._expert_router)
-            for i in range(n_replicas)]
+                expert_router=self._expert_router,
+                prefill_only=is_prefill,
+                # The cache pays on the prefill side (aliased pages skip
+                # prefill); the window pays on BOTH sides — decode slots
+                # verify spec_k drafts per step, prefill slots chunk
+                # spec_k+1 prompt tokens per step (chunked prefill: the
+                # same compiled window program, fed prompt instead of
+                # drafts, so a P-replica drains prompts W× faster).
+                prefix_cache=(self.prefix_cache_enabled
+                              and not is_decode),
+                spec_k=self.spec_k))
         if self.expert_replicas is not None:
             # New partition: replication counts re-clamp to what it can
             # hold (an expert cannot span more engines than exist).
@@ -122,7 +189,23 @@ class ReplicaSet:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.queue) or any(e.has_work for e in self.engines)
+        return (bool(self.queue) or bool(self._migrations)
+                or any(e.has_work for e in self.engines)
+                or any(e.prefill_done for e in self.engines))
+
+    @property
+    def prefill_engines(self) -> List[GenerationEngine]:
+        """The replicas taking fresh arrivals (all of them when not
+        disaggregated)."""
+        if self._disagg is None:
+            return self.engines
+        return self.engines[:self._disagg[0]]
+
+    @property
+    def decode_engines(self) -> List[GenerationEngine]:
+        if self._disagg is None:
+            return self.engines
+        return self.engines[self._disagg[0]:]
 
     def _engine_set(self, expert: int) -> List[int]:
         """The engine indices serving ``expert``: the home engine
@@ -136,17 +219,30 @@ class ReplicaSet:
         """Feed due arrivals to the least-loaded replica (queue depth +
         in-flight); FIFO within the global queue. With MoE on, a request
         is affinity-routed to its primary expert's engine set (grown by
-        hot-expert replication) — least-loaded WITHIN the set."""
+        hot-expert replication) — least-loaded WITHIN the set. With the
+        prefix cache on, a request is affinity-routed by its FIRST
+        PROMPT PAGE — tenant-mates sharing a prefix land on the same
+        prefill engine, whose cache is the only one that can alias
+        their pages."""
         while self.queue and self.queue[0].arrival_time <= now:
             req = self.queue.pop(0)
-            if self.moe_experts and req.prompt:
+            if self.moe_experts and req.prompt and self._disagg is None:
                 expert = self._expert_router(int(req.prompt[0]))
                 idxs = self._engine_set(expert)
                 eng = min((self.engines[i] for i in idxs),
                           key=lambda e: e.queue_depth() + e.in_flight())
             else:
-                eng = min(self.engines,
-                          key=lambda e: e.queue_depth() + e.in_flight())
+                # Disaggregated: arrivals only ever enter the prefill
+                # side (expert affinity is a decode-locality concern and
+                # the decode destination is picked at migration time).
+                pool = self.prefill_engines
+                ps = self.page_config.page_size
+                if (self.prefix_cache_enabled and len(pool) > 1
+                        and len(req.prompt) > ps):
+                    eng = pool[hash(tuple(req.prompt[:ps])) % len(pool)]
+                else:
+                    eng = min(pool, key=lambda e: e.queue_depth()
+                              + e.in_flight())
             eng.submit(req)
 
     # -- hot-expert replication -------------------------------------------
@@ -202,18 +298,172 @@ class ReplicaSet:
 
     def step_all(self, now: float) -> int:
         self._dispatch(now)
-        return sum(e.step(now) for e in self.engines)
+        if self._disagg is None:
+            return sum(e.step(now) for e in self.engines)
+        # Disaggregated order: prefill steps produce handoffs, the wire
+        # pumps a bounded chunk of the head migration, decode steps keep
+        # their in-flight batches moving while the rest of the payload
+        # is still on the wire (overlap — the batch never waits for a
+        # whole slot's KV).
+        tok = sum(e.step(now) for e in self.prefill_engines)
+        self._collect_handoffs(now)
+        self._pump_migrations(now)
+        for eng in self.decode_engines:
+            t = eng.step(now)
+            if t == 0 and self._migrations:
+                # Idle decode replica while KV is stuck on the wire:
+                # the migration IS the bottleneck this step.
+                self.kv_stall_steps += 1
+                _metrics.counter("serve.kv.stall_steps").inc()
+                _metrics.counter("serve.kv.stall_steps_by",
+                                 replica=eng.name).inc()
+            tok += t
+        return tok
+
+    # -- KV migration (disaggregation) ------------------------------------
+
+    def _decode_load(self, j: int) -> float:
+        eng = self.decode_engines[j]
+        return (eng.queue_depth() + eng.in_flight()
+                + sum(1 for m in self._migrations if m["dst"] == j))
+
+    def _collect_handoffs(self, now: float) -> None:
+        """Turn finished prefills into in-flight migrations, destined
+        for the least-loaded decode replica (in-flight migrations count
+        toward its load — a burst spreads)."""
+        tl = basics._state.timeline if basics.is_initialized() else None
+        for eng in self.prefill_engines:
+            while eng.prefill_done:
+                req, kv, n_tok = eng.prefill_done.pop(0)
+                dst = min(range(len(self.decode_engines)),
+                          key=self._decode_load)
+                self._migrations.append(
+                    {"req": req, "kv": kv, "n_tok": n_tok, "dst": dst,
+                     "layer": 0, "k_out": [], "v_out": [],
+                     "bytes": 0.0, "src": eng.name, "t0": now})
+                if tl is not None:
+                    tl.instant(
+                        f"SERVE:KV_MIGRATE_START req{req.req_id} "
+                        f"{eng.name}->{self.decode_engines[dst].name} "
+                        f"{n_tok}tok", tid="serve")
+
+    def _pump_migrations(self, now: float) -> None:
+        """Advance EVERY pending migration by up to
+        ``migrate_layers_per_step`` layer chunks through the
+        ``kv_migrate`` wire plan; deliver to the destination engine when
+        a migration's last layer lands. Chunking (not whole-payload
+        sends) is what overlaps the transfers with decode steps;
+        pumping all pending migrations per step (not just the head)
+        keeps the aggregate migration rate off the completion critical
+        path when a burst of prefills hands off together. Each chunk
+        charges ``comm.kv.bytes{hop}`` (plan/accounting), records into
+        the straggler's ``wire.kv`` phase, and scores the hop's link
+        health at the cost model's modeled duration."""
+        if not self._migrations:
+            return
+        (leg,) = self.kv_plan.legs
+        hop = _ir.LEVEL_HOP[leg.level]
+        chunk_bytes = 0.0
+        t0 = time.perf_counter()
+        with kv_span("MIGRATE", tid="serve"):
+            for m in self._migrations:
+                k, v = m["kv"]
+                L = int(k.shape[0])
+                for _ in range(self.migrate_layers_per_step):
+                    if m["layer"] >= L:
+                        break
+                    lay = m["layer"]
+                    chunk = np.stack([k[lay], v[lay]])
+                    recv, wire = _wire.lower_kv_migrate(
+                        self.kv_plan, chunk,
+                        transfers=1 if lay == L - 1 else 0)
+                    m["k_out"].append(recv[0])
+                    m["v_out"].append(recv[1])
+                    m["bytes"] += wire
+                    chunk_bytes += wire
+                    m["layer"] += 1
+        _straggler.record_phase(
+            "wire.kv", (time.perf_counter() - t0) * 1e3)
+        if chunk_bytes > 0:
+            # Score link health at the modeled duration (host-simulated
+            # wire — a real deployment feeds the measured transfer time).
+            _straggler.observe_wire(
+                hop, chunk_bytes, predict_hop_ms(hop, chunk_bytes))
+        while self._migrations and \
+                self._migrations[0]["layer"] >= int(
+                    self._migrations[0]["kv"][0].shape[0]):
+            self._finish_migration(self._migrations.pop(0), now)
+
+    def _finish_migration(self, m: Dict, now: float) -> None:
+        tl = basics._state.timeline if basics.is_initialized() else None
+        k, v = m["kv"]
+        dst = self.decode_engines[m["dst"]]
+        dst.submit_migrated(
+            m["req"], (np.stack(m["k_out"]), np.stack(m["v_out"])),
+            m["n_tok"])
+        n_elems = int(k.size) + int(v.size)
+        isz = float(np.dtype(k.dtype).itemsize)
+        # Predict at the pump's actual granularity — one [2, n, H, D]
+        # chunk per layer — so blockwise padding and scale overhead
+        # match what lower_kv_migrate charged (predicted == accounted).
+        L = int(k.shape[0])
+        chunk_elems = int(k[0].size) + int(v[0].size)
+        (row,) = predict_kv_migrate_bytes(self.kv_plan, chunk_elems, isz)
+        pr = price_kv_migrate(self.kv_plan, chunk_elems * isz,
+                              transfers=L, itemsize=isz,
+                              mesh_shape=self.kv_mesh_shape)
+        self.kv_migrations += 1
+        self.kv_migration_bytes += m["bytes"]
+        self.kv_migration_fp_bytes += n_elems * isz
+        self.migration_events.append({
+            "req_id": m["req"].req_id, "src": m["src"], "dst": dst.name,
+            "n_tokens": m["n_tok"], "hop": row["hop"],
+            "wire_bytes": m["bytes"], "fp_bytes": n_elems * isz,
+            "predicted_bytes": row["bytes"] * L,
+            "predicted_ms": pr["predicted_ms"],
+            "modeled_ms": pr["modeled_ms"],
+            "start": m["t0"], "finish": now})
+        _metrics.counter("serve.kv.migrations").inc()
+        if tl is not None:
+            tl.instant(
+                f"SERVE:KV_MIGRATE req{m['req'].req_id} "
+                f"{m['src']}->{dst.name} {int(m['bytes'])}B", tid="serve")
+
+    def token_demand(self) -> Tuple[int, int]:
+        """Cumulative fleet (prefill_tokens, decode_tokens) — the
+        measured demand ratio the autoscaler splits capacity by."""
+        pf = self.stats.prefill_tokens + sum(
+            e.stats.prefill_tokens for e in self.engines)
+        dc = self.stats.decode_tokens + sum(
+            e.stats.decode_tokens for e in self.engines)
+        return pf, dc
 
     # -- elastic resize ---------------------------------------------------
 
-    def resize(self, n_replicas: int, now: float = 0.0) -> int:
+    def resize(self, n_replicas: int, now: float = 0.0, *,
+               split: Optional[Tuple[int, int]] = None) -> int:
         """Drain every engine and rebuild over ``n_replicas`` groups.
 
         In-flight requests fold generated progress into their prompts and
         re-enter the global queue ahead of untouched arrivals — the
-        resize migrates work, it never drops it. Returns how many
-        requests were migrated."""
-        if n_replicas == self.n_replicas:
+        resize migrates work, it never drops it. On a disaggregated set,
+        ``split`` rebalances the prefill:decode partition (a resize
+        proceeds when EITHER the count or the split changes); in-flight
+        KV migrations and undelivered handoffs requeue their requests
+        (the payload is dropped — the new partition replays those
+        prefills). Returns how many requests were migrated."""
+        if split is not None:
+            split = (int(split[0]), int(split[1]))
+            if self._disagg is None:
+                raise ValueError("split= requires a disaggregated set")
+        elif self._disagg is not None and n_replicas != self.n_replicas:
+            # Count change with no explicit split: keep the ratio.
+            p, d = self._disagg
+            p_new = max(1, min(n_replicas - 1,
+                               round(n_replicas * p / (p + d))))
+            split = (p_new, n_replicas - p_new)
+        if n_replicas == self.n_replicas and \
+                (split is None or split == self._disagg):
             return 0
         tl = basics._state.timeline if basics.is_initialized() else None
         migrated: List[Request] = []
@@ -222,22 +472,35 @@ class ReplicaSet:
             eng.stats = ServeStats()
             if self.moe_experts and eng.expert_tokens is not None:
                 self._drained_expert_tokens += eng.expert_tokens
+            for req, _kv, _n in eng.prefill_done:
+                migrated.append(req)
+            eng.prefill_done.clear()
             migrated.extend(eng.drain())
+        for m in self._migrations:
+            migrated.append(m["req"])
+        self._migrations.clear()
         in_flight = sum(1 for r in migrated if r.resizes)
         self.queue[:0] = migrated
         old = self.n_replicas
+        old_split = self._disagg
+        if split is not None:
+            self._disagg = split
         self._build(n_replicas)
         self.resize_events.append({
             "time": now, "from": old, "to": n_replicas,
+            "from_split": old_split, "to_split": self._disagg,
             "migrated": len(migrated), "in_flight": in_flight})
-        from ..monitor import registry as _metrics
-
         _metrics.counter("serve.resizes").inc()
         _metrics.counter("serve.migrated_requests").inc(len(migrated))
         _metrics.gauge("serve.replicas").set(n_replicas)
+        if self._disagg is not None:
+            _metrics.gauge("serve.prefill_replicas").set(self._disagg[0])
+            _metrics.gauge("serve.decode_replicas").set(self._disagg[1])
         if tl is not None:
+            suffix = (f" split{old_split}->{self._disagg}"
+                      if self._disagg is not None else "")
             tl.instant(f"SERVE:RESIZE {old}->{n_replicas} "
-                       f"migrated{len(migrated)}", tid="serve")
+                       f"migrated{len(migrated)}{suffix}", tid="serve")
         return len(migrated)
 
     # -- trace loop -------------------------------------------------------
@@ -289,13 +552,22 @@ class ReplicaAutoscaler:
     queue pressure picks the target: above ``scale_up_depth`` queued
     requests per replica grow, below ``scale_down_depth`` shrink. Replica
     counts are restricted to even partitions of the device count.
+
+    On a disaggregated set the autoscaler also owns the **prefill:decode
+    split**: once ``split_min_tokens`` of fleet traffic have been
+    measured, the target split is ``P = round(n * prefill_tokens /
+    (prefill_tokens + decode_tokens))`` clamped to ``[1, n-1]`` — a
+    prompt-heavy trace shifts capacity toward prefill replicas, a
+    generation-heavy one toward decode, and a split change alone is
+    enough to trigger a resize.
     """
 
     def __init__(self, replica_set: ReplicaSet,
                  discovery: Optional[HostDiscovery] = None, *,
                  min_replicas: int = 1, max_replicas: Optional[int] = None,
                  scale_up_depth: int = 8, scale_down_depth: int = 1,
-                 cooldown_steps: int = 0) -> None:
+                 cooldown_steps: int = 0,
+                 split_min_tokens: int = 256) -> None:
         self.rs = replica_set
         self.host_manager = (HostManager(discovery)
                              if discovery is not None else None)
@@ -305,6 +577,7 @@ class ReplicaAutoscaler:
         self.scale_up_depth = scale_up_depth
         self.scale_down_depth = scale_down_depth
         self.cooldown_steps = cooldown_steps
+        self.split_min_tokens = int(split_min_tokens)
         self._cooldown = 0
         self.decisions: List[Dict] = []
 
@@ -331,15 +604,35 @@ class ReplicaAutoscaler:
             want = max(1, self.rs.n_replicas // 2)
         return self._valid(min(want, ceiling))
 
+    def split_target(self, n: int) -> Optional[Tuple[int, int]]:
+        """Demand-proportional prefill:decode split of ``n`` replicas,
+        or None before ``split_min_tokens`` of traffic (or when the set
+        is not disaggregated / too small to split)."""
+        if self.rs._disagg is None or n < 2:
+            return None
+        pf, dc = self.rs.token_demand()
+        if pf + dc < self.split_min_tokens:
+            return None
+        p = max(1, min(n - 1, round(n * pf / (pf + dc))))
+        return (p, n - p)
+
     def poll(self, now: float) -> Optional[int]:
-        """One autoscale decision; returns the new count on a resize."""
+        """One autoscale decision; returns the new count on a resize
+        (a split-only rebalance returns the unchanged count)."""
         if self._cooldown > 0:
             self._cooldown -= 1
             return None
         tgt = self.target()
-        if tgt == self.rs.n_replicas:
+        if self.rs._disagg is not None:
+            tgt = self._valid(max(2, tgt))
+            if tgt < 2:
+                return None  # device count cannot host a split
+        split = self.split_target(tgt)
+        if tgt == self.rs.n_replicas and \
+                (split is None or split == self.rs._disagg):
             return None
-        self.rs.resize(tgt, now)
+        self.rs.resize(tgt, now, split=split)
         self._cooldown = self.cooldown_steps
-        self.decisions.append({"time": now, "to": tgt})
+        self.decisions.append(
+            {"time": now, "to": tgt, "split": split})
         return tgt

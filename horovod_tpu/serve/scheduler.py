@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from .kv_cache import PageAllocator, PageConfig
+from .kv_cache import PageAllocator, PageConfig, PrefixCache
 
 _req_counter = itertools.count()
 
@@ -130,12 +130,18 @@ class Scheduler:
     """
 
     def __init__(self, cfg: PageConfig,
-                 allocator: Optional[PageAllocator] = None) -> None:
+                 allocator: Optional[PageAllocator] = None, *,
+                 prefix_cache: Optional[PrefixCache] = None) -> None:
         self.cfg = cfg
         self.allocator = allocator or PageAllocator(cfg.num_pages)
+        self.prefix_cache = prefix_cache
         self.queue: List[Request] = []          # FIFO; preempted go first
         self.running: Dict[int, Request] = {}   # slot -> request
         self._admit_order: List[int] = []       # slots, oldest first
+        # Shared-prefix tokens already in cache per freshly-admitted
+        # slot (copy-on-write pages, docs/serving.md); the engine pops
+        # them via take_prefix_len to seed the slot's consume cursor.
+        self._prefix_len: Dict[int, int] = {}
         # Host mirror of KVCache.page_table (engine copies to device).
         self.page_table = np.zeros(
             (cfg.max_slots, cfg.pages_per_slot), np.int32)
@@ -167,15 +173,21 @@ class Scheduler:
         """Admit queued requests (arrival_time <= now) while a free slot
         and sufficient free pages exist. FIFO — no overtaking: a large
         head-of-line request blocks later ones (predictable tail latency
-        beats marginal utilization here). Returns the admitted slots."""
+        beats marginal utilization here). With a prefix cache attached,
+        the cached full pages of the prompt come in as copy-on-write
+        shared pages (the tenant allocates only the tail privately and
+        skips their prefill — ``take_prefix_len``); a short pool first
+        evicts reader-less cached pages before giving up. Returns the
+        admitted slots."""
         admitted = []
         while self.queue and self.queue[0].arrival_time <= now:
             slots = self.free_slots()
             if not slots:
                 break
             req = self.queue[0]
-            need = self._pages_for_admission(req)
-            pages = self.allocator.alloc(req.req_id, need)
+            if self._prefix_pending(req):
+                break  # a running tenant-mate is about to register it
+            pages, matched = self._admit_pages(req)
             if pages is None:
                 break  # admission never exceeds free pages
             self.queue.pop(0)
@@ -183,10 +195,76 @@ class Scheduler:
             self.running[slot] = req
             self._admit_order.append(slot)
             req.admit_time = now
+            self._prefix_len[slot] = matched
             self.page_table[slot, :] = 0
             self.page_table[slot, :len(pages)] = pages
             admitted.append(slot)
         return admitted
+
+    def _prefix_pending(self, req: Request) -> bool:
+        """True when ``req``'s shared prefix is not cached YET but a
+        RUNNING request with the same leading full page is mid-prefill —
+        admitting now would duplicate the prefix pages, while a step or
+        two of patience turns the miss into a copy-on-write hit (the
+        mate registers its prompt pages the moment its prefill
+        completes). Self-clearing: the mate either registers (lookup
+        hits) or leaves ``running`` (preempted/finished), so the queue
+        head can never defer forever."""
+        if self.prefix_cache is None:
+            return False
+        ps = self.cfg.page_size
+        if len(req.prompt) <= ps:
+            return False  # no full shared page to wait for
+        _, matched = self.prefix_cache.lookup(req.prompt, count=False)
+        if matched:
+            return False  # already cached: admit with the hit
+        head = tuple(req.prompt[:ps])
+        return any(r is not req and len(r.prompt) > ps
+                   and tuple(r.prompt[:ps]) == head
+                   for r in self.running.values())
+
+    def _admit_pages(self, req: Request):
+        """Atomic page grant for one admission: ``(pages, prefix_tokens)``
+        with the shared prefix pages leading, or ``(None, 0)`` when the
+        pool is short even after evicting reader-less cached pages."""
+        need_total = self._pages_for_admission(req)
+        if self.prefix_cache is None:
+            return self.allocator.alloc(req.req_id, need_total), 0
+        shared, matched = self.prefix_cache.lookup(req.prompt)
+        need = need_total - len(shared)
+        pages = self.allocator.alloc(req.req_id, need, shared=shared)
+        if pages is None:
+            short = need - self.allocator.free_pages
+            if self.prefix_cache.evict_unreferenced(short) == 0:
+                return None, 0
+            # Eviction may have reclaimed reader-less pages of THIS
+            # prefix — re-walk so the shared list only names pages
+            # still pinned by the cache.
+            shared, matched = self.prefix_cache.lookup(
+                req.prompt, count=False)
+            need = need_total - len(shared)
+            pages = self.allocator.alloc(req.req_id, need, shared=shared)
+            if pages is None:
+                return None, 0
+        return pages, matched
+
+    def take_prefix_len(self, slot: int) -> int:
+        """Tokens of ``slot``'s prompt already covered by shared prefix
+        pages at admission — the engine seeds the slot's consume cursor
+        with this (prefill starts after the cached prefix). Pops: one
+        read per admission."""
+        return self._prefix_len.pop(slot, 0)
+
+    def register_prefix(self, slot: int) -> int:
+        """Offer a prefilled slot's full prompt pages to the prefix
+        cache (no-op without one). The engine calls this once per slot
+        when its prefill completes — the moment the prompt's full pages
+        hold final KV. Returns the number of pages newly cached."""
+        if self.prefix_cache is None:
+            return 0
+        req = self.running[slot]
+        return self.prefix_cache.insert(
+            req.prompt, self.allocator.pages_of(req.req_id))
 
     # -- growth / preemption ----------------------------------------------
 
@@ -204,6 +282,9 @@ class Scheduler:
                 f"slot {slot}: position {pos} beyond slot capacity "
                 f"{self.cfg.tokens_per_slot}")
         got = self.allocator.extend(req.req_id, 1)
+        if got is None and self.prefix_cache is not None \
+                and self.prefix_cache.evict_unreferenced(1):
+            got = self.allocator.extend(req.req_id, 1)
         if got is None:
             return False
         self.page_table[slot, have] = got[0]
@@ -246,20 +327,35 @@ class Scheduler:
             self.submit(req, front=True)
         return out
 
+    def release(self, slot: int) -> Request:
+        """Release a slot WITHOUT finishing its request (the migration
+        handoff: the prefill replica lets go once the decode replica owns
+        the KV — the request itself finishes over there)."""
+        return self._release(slot)
+
     def _release(self, slot: int) -> Request:
         req = self.running.pop(slot)
         self._admit_order.remove(slot)
+        self._prefix_len.pop(slot, None)
         self.allocator.free(req.req_id)
         self.page_table[slot, :] = 0
         return req
 
     def check_invariants(self) -> None:
         self.allocator.check_invariants()
-        live = set()
+        readers: Dict[int, int] = {}
         for slot, req in self.running.items():
             pages = self.allocator.pages_of(req.req_id)
             table = [int(p) for p in self.page_table[slot] if p != 0]
             assert table == pages, \
                 f"slot {slot}: table {table} != grant {pages}"
-            assert not (set(pages) & live), "live sequences share a page"
-            live |= set(pages)
+            for p in pages:
+                readers[p] = readers.get(p, 0) + 1
+        for p, k in readers.items():
+            if k > 1:
+                # Cross-tenant aliasing is legal ONLY through the prefix
+                # cache: a multi-reader page must carry the cache's own
+                # hold, so a private page can never leak between tenants.
+                assert (self.prefix_cache is not None
+                        and self.allocator._held.get(p, 0) > 0), \
+                    f"live sequences share non-prefix page {p}"

@@ -1,0 +1,56 @@
+"""Speculative-decoding drafters for the generation engine.
+
+The engine's speculative mode (``spec_k > 0``, docs/serving.md) feeds a
+window of ``k + 1`` tokens per decode slot per compiled step: the real
+next token plus ``k`` *draft* proposals, verified against the model's
+own greedy argmax in ONE batched engine step. The drafter only shapes
+the proposals — acceptance is decided by the target model, so greedy
+output is bit-identical to plain decode no matter how bad the drafts
+are; a better drafter only raises the accepted-per-step rate
+(``serve.spec.acceptance_rate``).
+
+:class:`NGramDrafter` is the model-free prompt-lookup drafter (the
+"prompt lookup decoding" trick): propose the tokens that followed the
+longest recent match of the current context suffix earlier in the
+context. Zero extra FLOPs, deterministic, and strong exactly where
+speculative decoding pays best — prompts the output echoes (extraction,
+code edits, shared-prefix chat with repetitive structure). A learned
+drafter model drops in behind the same ``propose`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class NGramDrafter:
+    """Prompt-lookup drafter: longest-suffix n-gram match over the
+    sequence so far (prompt + generated), proposing its continuation.
+
+    ``max_ngram`` bounds the suffix length tried (longest first —
+    longer matches are more specific, so their continuations accept
+    more often); a context with no match repeats the last token (a
+    cheap bet that still wins on runs).
+    """
+
+    def __init__(self, max_ngram: int = 3) -> None:
+        if max_ngram < 1:
+            raise ValueError("max_ngram must be >= 1")
+        self.max_ngram = int(max_ngram)
+
+    def propose(self, context: Sequence[int], k: int) -> List[int]:
+        """``k`` draft tokens continuing ``context`` (never empty when
+        ``k > 0`` — the engine pads windows with real proposals only)."""
+        ctx = list(context)
+        if k <= 0 or not ctx:
+            return []
+        for n in range(min(self.max_ngram, len(ctx) - 1), 0, -1):
+            suffix = ctx[-n:]
+            # Last earlier occurrence of the suffix (most recent wins:
+            # local repetition dominates generation structure).
+            for start in range(len(ctx) - n - 1, -1, -1):
+                if ctx[start:start + n] == suffix:
+                    cont = ctx[start + n:start + n + k]
+                    if cont:
+                        return (cont + [ctx[-1]] * (k - len(cont)))[:k]
+        return [ctx[-1]] * k

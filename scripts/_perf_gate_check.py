@@ -249,6 +249,91 @@ def _main():
                    "serve throughput")
         return 0 if ok else 1
 
+    if leg == "serve_disagg":
+        # Disaggregated serving (docs/serving.md): the record carries its
+        # OWN symmetric baseline (measured in the same run), so every
+        # gate is structural — no seeded baseline file needed.
+        ok = True
+        if rec.get("requests_dropped", 1) != 0:
+            print(f"perf gate [serve_disagg]: dropped requests "
+                  f"{rec.get('requests_dropped')} — hard fail")
+            record_verdict(leg, "dropped_requests",
+                           rec.get("requests_dropped", -1), 0, tol, False)
+            ok = False
+        if not rec.get("spec_parity_ok"):
+            print("perf gate [serve_disagg]: greedy spec-decode parity "
+                  "probe failed — hard fail")
+            record_verdict(leg, "spec_parity_ok", 0, 1, tol, False)
+            ok = False
+        hit_rate = float(rec.get("prefix_hit_rate") or 0)
+        if hit_rate <= 0:
+            print("perf gate [serve_disagg]: prefix cache never hit — "
+                  "hard fail")
+            record_verdict(leg, "prefix_hit_rate", hit_rate, 0, tol,
+                           False)
+            ok = False
+        else:
+            record_verdict(leg, "prefix_hit_rate", hit_rate, 0, tol, True)
+        if int(rec.get("kv_migrations") or 0) < 1:
+            print("perf gate [serve_disagg]: no KV migrations — the "
+                  "prefill/decode handoff never engaged — hard fail")
+            record_verdict(leg, "kv_migrations",
+                           rec.get("kv_migrations", 0), 1, tol, False)
+            ok = False
+        drift = rec.get("kv_bytes_drift")
+        drift_tol = float(os.environ.get("PERF_GATE_COST_DRIFT", "0.25"))
+        if drift is None or abs(drift) > drift_tol:
+            print(f"perf gate [serve_disagg]: migration byte drift "
+                  f"{drift} exceeds cap {drift_tol} — hard fail")
+            record_verdict(leg, "kv_bytes_drift",
+                           drift if drift is not None else -1, drift_tol,
+                           tol, False)
+            ok = False
+        else:
+            record_verdict(leg, "kv_bytes_drift", drift, drift_tol, tol,
+                           True)
+        stalls = int(rec.get("kv_stall_steps") or 0)
+        stall_cap = int(os.environ.get("PERF_GATE_DISAGG_STALLS", "5"))
+        within = stalls <= stall_cap
+        print(f"perf gate [serve_disagg stalls]: {stalls} decode steps "
+              f"stalled on migration vs budget {stall_cap} -> "
+              f"{'OK' if within else 'REGRESSION'}")
+        record_verdict(leg, "kv_stall_steps", stalls, stall_cap, tol,
+                       within)
+        ok &= within
+        base_goodput = float(
+            rec.get("baseline_goodput_tokens_per_sec") or 0)
+        if base_goodput <= 0:
+            print("perf gate [serve_disagg]: record lacks the symmetric "
+                  "baseline leg — hard fail")
+            record_verdict(leg, "baseline_present", 0, 1, tol, False)
+            ok = False
+        else:
+            # The disaggregated split must not lose to the symmetric
+            # baseline it displaced (PERF_GATE_DISAGG_GOODPUT scales the
+            # floor; 1.0 = must match or beat).
+            floor_x = float(
+                os.environ.get("PERF_GATE_DISAGG_GOODPUT", "1.0"))
+            ok &= gate(rec.get("goodput_tokens_per_sec", 0),
+                       base_goodput, floor_x, "disagg goodput vs baseline")
+        base_p99 = float(rec.get("baseline_latency_p99_ms") or 0)
+        if base_p99 > 0:
+            # Tail latency must stay within PERF_GATE_DISAGG_P99 x the
+            # symmetric baseline's p99 (default 1.5 — the CPU mesh's
+            # tails are noisy; on hardware the split should WIN the
+            # tail, since decode never queues behind a prefill burst).
+            p99_cap = base_p99 * float(
+                os.environ.get("PERF_GATE_DISAGG_P99", "1.5"))
+            p99 = float(rec.get("latency_p99_ms") or 0)
+            within = 0 < p99 <= p99_cap
+            print(f"perf gate [serve_disagg p99]: {p99} ms vs cap "
+                  f"{p99_cap:.2f} ms (baseline {base_p99} ms) -> "
+                  f"{'OK' if within else 'REGRESSION'}")
+            record_verdict(leg, "latency_p99_ms", p99, p99_cap, tol,
+                           within)
+            ok &= within
+        return 0 if ok else 1
+
     if leg == "fused":
         # Fused compute-collective kernels (docs/fused-kernels.md):
         # correctness is hard-gated — the fused-vs-unfused parity probe

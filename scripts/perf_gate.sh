@@ -33,6 +33,11 @@
 #                     forced-routing parity + dropped-token fraction
 #                     <= PERF_GATE_MOE_DROPPED + a2a wire-ms drift
 #                     (docs/moe.md)
+#   PERF_GATE_LEGS="serve_disagg" scripts/perf_gate.sh # disaggregated
+#                     serving A/B: goodput >= the same-run symmetric
+#                     baseline, bit-identical outputs, nonzero prefix
+#                     hits, migrations with zero byte drift, stall
+#                     budget (docs/serving.md)
 #   PERF_GATE_LEGS="soak" scripts/perf_gate.sh  # self-healing soak:
 #                     the smoke gauntlet (preempt + flap + resize) must
 #                     pass every soak-report gate (docs/robustness.md)
@@ -75,6 +80,22 @@ for leg in $LEGS; do
     case "$leg" in
         serve)
             run_leg serve --serve --platform cpu --cpu-devices 8 \
+                --serve-requests "${PERF_GATE_SERVE_REQUESTS:-12}" \
+                --serve-rate 50
+            ;;
+        serve_disagg)
+            # Disaggregated serving gate (docs/serving.md): the --disagg
+            # A/B measures a symmetric baseline in the SAME run, so the
+            # gate is structural — goodput >= the baseline's (x
+            # PERF_GATE_DISAGG_GOODPUT), zero drops on both legs,
+            # bit-identical greedy outputs (migration + prefix COW +
+            # spec decode), nonzero prefix hit rate, >= 1 migration with
+            # zero predicted-vs-accounted byte drift, the migration
+            # stall budget (PERF_GATE_DISAGG_STALLS decode steps), and
+            # p99 within PERF_GATE_DISAGG_P99 x the baseline's tail.
+            run_leg serve_disagg --serve \
+                --disagg "${PERF_GATE_DISAGG_SPLIT:-3:1}" \
+                --platform cpu --cpu-devices 8 \
                 --serve-requests "${PERF_GATE_SERVE_REQUESTS:-12}" \
                 --serve-rate 50
             ;;
@@ -170,7 +191,7 @@ for leg in $LEGS; do
             fi
             ;;
         *)
-            echo "unknown gate leg: $leg (serve|train|zero{1,2,3}|plan|fused|cost|pp|moe|soak)" >&2
+            echo "unknown gate leg: $leg (serve|serve_disagg|train|zero{1,2,3}|plan|fused|cost|pp|moe|soak)" >&2
             exit 2
             ;;
     esac

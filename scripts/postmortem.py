@@ -126,6 +126,7 @@ def _summarize_rank(dumps):
         "faults": faults,
         "straggler": latest.get("straggler", []),
         "expert_load": latest.get("expert_load") or {},
+        "serve_cache": latest.get("serve_cache") or {},
         "extra": latest.get("extra"),
     }
 
@@ -175,6 +176,26 @@ def build_report(directory):
             e, tokens = max(expert_load.items(), key=lambda kv: kv[1])
             hot_expert = {"expert": e, "tokens": tokens,
                           "share": round(tokens / total, 4)}
+    # Disaggregated-serving view (docs/serving.md): merge every rank's
+    # serve_cache snapshot (scalars take the max — each rank reports its
+    # own fleet totals — and the per-replica stall map folds by sum) so
+    # the postmortem can NAME the replica that idled on a migration.
+    serve_cache = {}
+    for r in ranks.values():
+        for key, val in (r.get("serve_cache") or {}).items():
+            if isinstance(val, dict):
+                bucket = serve_cache.setdefault(key, {})
+                for sub, x in val.items():
+                    bucket[sub] = bucket.get(sub, 0.0) + float(x)
+            else:
+                serve_cache[key] = max(
+                    float(val), float(serve_cache.get(key, 0.0)))
+    stalled_replica = None
+    stall_by = serve_cache.get("stall_steps_by_replica") or {}
+    if stall_by:
+        name, steps_stalled = max(stall_by.items(), key=lambda kv: kv[1])
+        if steps_stalled > 0:
+            stalled_replica = {"replica": name, "stall_steps": steps_stalled}
     return {
         "directory": os.path.abspath(directory),
         "dumps": len(dumps),
@@ -188,6 +209,8 @@ def build_report(directory):
         "straggler_history": straggler_history,
         "expert_load": expert_load,
         "hot_expert": hot_expert,
+        "serve_cache": serve_cache,
+        "migration_stalled_replica": stalled_replica,
     }
 
 
@@ -228,6 +251,26 @@ def print_report(r):
         w(f"  hot expert: expert {he['expert']} carried "
           f"{he['share']:.0%} of the MoE load "
           f"({he['tokens']:.0f} tokens) — docs/moe.md")
+    if r.get("migration_stalled_replica"):
+        ms = r["migration_stalled_replica"]
+        w(f"  migration-stalled replica: {ms['replica']} idled "
+          f"{ms['stall_steps']:.0f} decode step(s) waiting on KV "
+          f"migrations — docs/serving.md")
+    sc = r.get("serve_cache") or {}
+    if sc:
+        hits = sc.get("serve.prefix_hits")
+        rate = sc.get("serve.prefix_hit_rate")
+        acc = sc.get("serve.spec.acceptance_rate")
+        migs = sc.get("serve.kv.migrations")
+        parts = []
+        if hits is not None and rate is not None:
+            parts.append(f"prefix hits {hits:.0f} (rate {rate:.2f})")
+        if acc is not None:
+            parts.append(f"spec acceptance {acc:.2f}")
+        if migs is not None:
+            parts.append(f"kv migrations {migs:.0f}")
+        if parts:
+            w(f"  serving cache: {', '.join(parts)}")
     if r["straggler_history"]:
         w("")
         w("-- straggler history (pre-crash) --")

@@ -292,10 +292,10 @@ class TestPlanSchemaV5:
         TestSession._reset_kernel_cache()
         key = cache_key_for("v9-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        # v9: the MoE pair joins TunedParams (docs/moe.md); v8 added
-        # the pipeline pair (docs/pipeline.md); v7 the geometry-
-        # fingerprinted key + stored predicted_ms (docs/cost-model.md).
-        assert key.endswith("|v9")
+        # v10: the serve pair joins TunedParams (docs/serving.md); v9
+        # added the MoE pair (docs/moe.md); v8 the pipeline pair; v7
+        # the geometry-fingerprinted key + stored predicted_ms.
+        assert key.endswith("|v10")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)
@@ -506,7 +506,7 @@ class TestCacheSchemaV7:
         key = cache_key_for("geo-probe")
         geo = basics.mesh_geometry()
         assert f"|{geo}|" in key
-        assert key.endswith("|v9")
+        assert key.endswith("|v10")
 
     def test_load_tolerant_of_v6_entry(self, tmp_path, monkeypatch):
         from horovod_tpu.ops import kernel_autotune

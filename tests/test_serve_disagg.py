@@ -1,0 +1,638 @@
+"""Disaggregated serving tests (ISSUE 17, docs/serving.md): the
+kv_migrate wire-plan family, copy-on-write prefix caching, the batched
+speculative-verify window, and the prefill/decode replica split.
+
+Core invariants:
+  * kv_migrate plans validate (one SEND leg; int8 only on DCN/pod
+    hops) and their predicted wire bytes equal what the lowering
+    charges — including the error-feedback residual doubling;
+  * PageAllocator refcounts aliased (COW) pages exactly — a shared
+    page returns to the pool only when its LAST reader lets go, even
+    under worst-case LIFO preemption churn;
+  * the prefix cache shares only FULL prompt pages, first writer wins,
+    and eviction never frees a page a live tenant reads;
+  * a windowed (W-token) decode step is bit-identical to W chained
+    single-token steps — the property that makes greedy speculative
+    decoding exact;
+  * a disaggregated ReplicaSet (prefill -> kv_migrate -> decode, both
+    fp and int8+EF wires, prefix cache and spec decode on) produces
+    bit-identical outputs to the symmetric baseline, with zero
+    predicted-vs-accounted migration byte drift;
+  * the flight recorder's ``serve_cache`` view and the postmortem's
+    migration-stall attribution name the replica that idled.
+
+Compiled tests run single-device engines to keep compiles cheap.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu.models import GPT, gpt_tiny
+from horovod_tpu.plan import ir
+from horovod_tpu.plan.compiler import lower_kv_migrate, quant_wire_bytes
+from horovod_tpu.plan.cost import predict_hop_ms, price_kv_migrate
+from horovod_tpu.plan.planner import (
+    derive_kv_migrate,
+    predict_kv_migrate_bytes,
+)
+from horovod_tpu.serve import kv_cache as kvlib
+from horovod_tpu.serve import (
+    PageAllocator,
+    PageConfig,
+    ReplicaAutoscaler,
+    ReplicaSet,
+    Request,
+    Scheduler,
+)
+from horovod_tpu.serve.engine import GenerationEngine, VirtualClock
+from horovod_tpu.serve.kv_cache import PrefixCache
+
+pytestmark = pytest.mark.serve
+
+
+def tiny_cfg(**over):
+    return gpt_tiny(dtype=jnp.float32, num_heads=8, **over)
+
+
+def tiny_page_cfg(cfg, **over):
+    kw = dict(num_pages=96, page_size=4, max_slots=4, pages_per_slot=24,
+              num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+              head_dim=cfg.d_model // cfg.num_heads)
+    kw.update(over)
+    return PageConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = tiny_cfg()
+    params = GPT(cfg).init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 8), jnp.int32))["params"]
+    return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# kv_migrate plan family: validation, byte accounting, pricing
+
+
+class TestKvMigratePlan:
+    def test_level_derivation_and_int8_legality(self):
+        # Single host (cross dim 1): ICI hop, int8 forced off.
+        ici = derive_kv_migrate(mesh_shape=(1, 4), quantized=True)
+        (leg,) = ici.legs
+        assert leg.level == ir.ICI and leg.wire_dtype != ir.INT8
+        # Cross-host column: DCN hop, int8 + the EF residual by default.
+        dcn = derive_kv_migrate(mesh_shape=(2, 4), quantized=True)
+        (leg,) = dcn.legs
+        assert leg.level == ir.DCN and leg.wire_dtype == ir.INT8
+        assert leg.error_feedback
+        assert "int8+ef" in dcn.encode()
+        # Pod dimension present: pod hop.
+        pod = derive_kv_migrate(mesh_shape=(2, 2, 2), quantized=True)
+        assert pod.legs[0].level == ir.POD
+
+    def test_exactly_one_send_leg(self):
+        plan = derive_kv_migrate(mesh_shape=(2, 4))
+        assert plan.collective == "kv_migrate" and len(plan.legs) == 1
+        plan.validate()
+
+    def test_predicted_bytes_match_lowering_fp(self):
+        plan = derive_kv_migrate(mesh_shape=(2, 4), quantized=False)
+        x = np.random.RandomState(0).randn(2, 37, 8, 8).astype(np.float32)
+        recv, wire = lower_kv_migrate(plan, x)
+        np.testing.assert_array_equal(recv, x)  # fp wire is lossless
+        (row,) = predict_kv_migrate_bytes(plan, x.size, 4)
+        assert row["bytes"] == wire == x.size * 4.0
+        assert row["hop"] == "dcn"
+
+    def test_predicted_bytes_match_lowering_int8_ef(self):
+        plan = derive_kv_migrate(mesh_shape=(2, 4), quantized=True,
+                                 block=64)
+        rs = np.random.RandomState(1)
+        for n_tok in (5, 16, 33):  # odd sizes exercise block padding
+            x = rs.randn(2, n_tok, 8, 8).astype(np.float32)
+            recv, wire = lower_kv_migrate(plan, x)
+            (row,) = predict_kv_migrate_bytes(plan, x.size, 4)
+            assert row["bytes"] == wire
+            # EF residual rides the same wire: 2x the one-pass bytes.
+            assert wire == 2.0 * quant_wire_bytes(x.size, 64)
+            # Two blockwise-int8 passes reconstruct closely (the EF
+            # residual quantizes the first pass's error).
+            assert np.max(np.abs(recv - x)) < np.max(np.abs(x)) * 0.05
+
+    def test_price_and_hop_prediction(self):
+        plan = derive_kv_migrate(mesh_shape=(2, 4), quantized=True)
+        priced = price_kv_migrate(plan, 4096.0, transfers=3,
+                                  mesh_shape=(2, 4))
+        assert priced["predicted_ms"] > 0
+        assert priced["wire_bytes"] > 0
+        assert predict_hop_ms("dcn", 1 << 20) > predict_hop_ms("dcn", 1)
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator: COW refcounts and LIFO-preemption worst case
+
+
+class TestAllocatorCOW:
+    def test_aliased_page_freed_at_last_reader(self):
+        alloc = PageAllocator(16)
+        a = alloc.alloc("a", 3)
+        b = alloc.alloc("b", 1, shared=a[:2])
+        assert alloc.refcount(a[0]) == 2 and alloc.refcount(a[2]) == 1
+        alloc.check_invariants()
+        freed = alloc.free("a")
+        # Only the exclusive page returns; the aliased two stay granted.
+        assert freed == [a[2]]
+        assert alloc.refcount(a[0]) == 1
+        alloc.check_invariants()
+        freed = alloc.free("b")
+        # Last reader: both aliased pages AND b's fresh page return.
+        assert set(freed) == set(a[:2]) | {b[-1]}
+        alloc.check_invariants()
+        assert alloc.free_pages == 15  # everything but the null page
+
+    def test_external_hold_keeps_page_granted(self):
+        alloc = PageAllocator(8)
+        a = alloc.alloc("a", 2)
+        alloc.retain([a[0]])          # the prefix cache's pin
+        assert alloc.free("a") == [a[1]]
+        alloc.check_invariants()
+        assert alloc.refcount(a[0]) == 1
+        assert alloc.release([a[0]]) == [a[0]]
+        alloc.check_invariants()
+
+    def test_check_invariants_catches_double_listing(self):
+        alloc = PageAllocator(8)
+        a = alloc.alloc("a", 1)
+        alloc._owner["a"].append(a[0])  # corrupt: same page twice
+        with pytest.raises(AssertionError):
+            alloc.check_invariants()
+
+    def test_check_invariants_catches_refcount_drift(self):
+        alloc = PageAllocator(8)
+        a = alloc.alloc("a", 1)
+        b = alloc.alloc("b", 1, shared=a)
+        del b
+        alloc._refs[a[0]] += 1          # corrupt: phantom reader
+        with pytest.raises(AssertionError):
+            alloc.check_invariants()
+
+    def test_lifo_preemption_worst_case(self):
+        """Admission churn under page pressure: tenants alias one shared
+        prefix page, the pool runs dry, and the YOUNGEST tenant is
+        repeatedly preempted (freed) and re-admitted. The shared page
+        must survive every round with an exact refcount, and no page
+        may leak across any number of rounds."""
+        alloc = PageAllocator(10)       # null + 9 usable
+        prefix = alloc.alloc("prefix_owner", 1)
+        alloc.retain(prefix)            # cache pin outlives tenants
+        alloc.free("prefix_owner")
+        live = []
+        for round_ in range(25):
+            # Fill until the pool refuses (each tenant: shared + 2).
+            i = 0
+            while True:
+                seq = (round_, i)
+                got = alloc.alloc(seq, 2, shared=prefix)
+                if got is None:
+                    break
+                live.append(seq)
+                alloc.check_invariants()
+                i += 1
+            assert alloc.alloc((round_, "x"), alloc.free_pages + 1,
+                               shared=prefix) is None
+            alloc.check_invariants()
+            # LIFO: preempt the youngest admissions first.
+            for _ in range(min(2, len(live))):
+                victim = live.pop()
+                freed = alloc.free(victim)
+                assert prefix[0] not in freed
+                alloc.check_invariants()
+        assert alloc.refcount(prefix[0]) == 1 + len(live)
+        for seq in live:
+            alloc.free(seq)
+        alloc.check_invariants()
+        assert alloc.release(prefix) == prefix
+        assert alloc.free_pages == 9
+
+
+# ---------------------------------------------------------------------------
+# PrefixCache: full-page sharing, first-writer-wins, safe eviction
+
+
+class TestPrefixCache:
+    def _mk(self, pages=32, ps=4):
+        alloc = PageAllocator(pages)
+        return alloc, PrefixCache(alloc, ps)
+
+    def test_share_cap_keeps_last_token_private(self):
+        _, cache = self._mk()
+        # 9 tokens at ps=4: only 2 FULL pages are shareable (the tenant
+        # must consume >= 1 prompt token itself).
+        assert cache._shareable_pages(list(range(9))) == 2
+        assert cache._shareable_pages(list(range(8))) == 1
+        assert cache._shareable_pages(list(range(4))) == 0
+
+    def test_insert_lookup_and_stats(self):
+        alloc, cache = self._mk()
+        prompt = list(range(10, 19))
+        pages = alloc.alloc("t0", 3)
+        assert cache.insert(prompt, pages) == 2
+        hit, matched = cache.lookup(prompt)
+        assert hit == pages[:2] and matched == 8
+        assert cache.hits == 1 and cache.hit_tokens == 8
+        miss, matched = cache.lookup([99] * 9)
+        assert miss == [] and matched == 0
+        assert cache.lookups == 2 and cache.hit_rate == 0.5
+
+    def test_first_writer_wins(self):
+        alloc, cache = self._mk()
+        prompt = list(range(20, 29))
+        p0 = alloc.alloc("t0", 3)
+        p1 = alloc.alloc("t1", 3)
+        cache.insert(prompt, p0)
+        assert cache.insert(prompt, p1) == 0   # existing nodes kept
+        hit, _ = cache.lookup(prompt)
+        assert hit == p0[:2]
+
+    def test_eviction_never_frees_live_reader_pages(self):
+        alloc, cache = self._mk(pages=16)
+        prompt = list(range(30, 39))
+        p0 = alloc.alloc("writer", 3)
+        cache.insert(prompt, p0)
+        alloc.free("writer")               # cache pin keeps the 2 cached
+        alloc.check_invariants()
+        shared, matched = cache.lookup(prompt)
+        reader = alloc.alloc("reader", 1, shared=shared)
+        assert cache.evict_unreferenced() == 0  # live reader: untouchable
+        assert alloc.refcount(shared[0]) == 2
+        alloc.free("reader")
+        assert cache.evict_unreferenced() == 2  # now reclaimable
+        alloc.check_invariants()
+        assert cache.cached_pages == 0
+
+    def test_scheduler_defers_prefix_mate_then_shares(self, model):
+        """Two queued requests share a full first page: the scheduler
+        admits the first, DEFERS the second while the prefix is
+        uncached, then admits it as a COW hit once the first registers
+        its prompt pages."""
+        cfg, _ = model
+        pc = tiny_page_cfg(cfg)
+        alloc = PageAllocator(pc.num_pages)
+        cache = PrefixCache(alloc, pc.page_size)
+        sched = Scheduler(pc, alloc, prefix_cache=cache)
+        shared = [7, 8, 9, 10]
+        sched.submit(Request(req_id=0, prompt=shared + [11, 12],
+                             max_new_tokens=2))
+        sched.submit(Request(req_id=1, prompt=shared + [13, 14],
+                             max_new_tokens=2))
+        slots = sched.admit(0.0)
+        assert len(slots) == 1            # mate deferred, not admitted
+        assert sched.queue_depth() == 1
+        sched.register_prefix(slots[0])   # prefill "completed"
+        slots2 = sched.admit(1.0)
+        assert len(slots2) == 1
+        assert sched.take_prefix_len(slots2[0]) == pc.page_size
+        # The mate reads the SAME physical first page (COW alias).
+        assert sched.page_table[slots[0]][0] == \
+            sched.page_table[slots2[0]][0]
+        alloc.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Windowed decode: one batched apply == W chained single-token steps
+
+
+def _cache_with_slots(pc, n_slots, n_tokens):
+    alloc = PageAllocator(pc.num_pages)
+    cache = kvlib.init_cache(pc)
+    table = np.array(cache.page_table)
+    for s in range(n_slots):
+        pages = alloc.alloc(s, pc.pages_for(n_tokens))
+        table[s, :len(pages)] = pages
+    return cache._replace(page_table=jnp.asarray(table))
+
+
+class TestWindowedDecode:
+    def test_window_meta_and_advance(self, model):
+        cfg, _ = model
+        pc = tiny_page_cfg(cfg, max_slots=2)
+        cache = _cache_with_slots(pc, 2, 12)
+        cache = cache._replace(seq_lens=jnp.asarray([3, 5], jnp.int32))
+        valid = jnp.asarray([[True, True, True, False],
+                             [True, False, False, False]])
+        meta = kvlib.step_meta(cache, valid, page_size=pc.page_size)
+        assert meta.write_page.shape == (2, 4)
+        np.testing.assert_array_equal(
+            np.asarray(meta.attend_len),
+            [[4, 5, 6, 1], [6, 1, 1, 1]])
+        # Invalid positions write the null page.
+        assert int(meta.write_page[0, 3]) == kvlib.NULL_PAGE
+        assert int(meta.write_page[1, 1]) == kvlib.NULL_PAGE
+        out = kvlib.advance(cache, meta)
+        np.testing.assert_array_equal(np.asarray(out.seq_lens), [6, 6])
+
+    def test_windowed_apply_matches_chained(self, model):
+        """The batched W-token verify step must match W sequential
+        single-token steps: identical greedy argmax at EVERY window
+        position (the invariant that makes greedy speculative decoding
+        lossless), logits/cache equal to float tolerance (XLA
+        vectorizes [S,1,C] and [S,W,C] shapes differently, so raw
+        bit-equality across shapes is not a property to demand), and
+        exactly-equal sequence lengths."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg, max_slots=2)
+        rs = np.random.RandomState(3)
+        prompt = rs.randint(2, cfg.vocab_size, size=(2, 6))
+        W = 3    # same window shape as the partial-validity test below
+        window = rs.randint(2, cfg.vocab_size, size=(2, W))
+
+        def single(tokens, cache, active):
+            return GPT(cfg).apply({"params": params},
+                                  jnp.asarray(tokens, jnp.int32),
+                                  cache=cache, active=jnp.asarray(active))
+
+        # Shared warm state: both slots prefilled token by token.
+        cache = _cache_with_slots(pc, 2, prompt.shape[1] + W)
+        for t in range(prompt.shape[1]):
+            _, cache = single(prompt[:, t], cache, [True, True])
+
+        # Path A: W chained single-token steps.
+        seq_cache = cache
+        seq_logits = []
+        for w in range(W):
+            lg, seq_cache = single(window[:, w], seq_cache, [True, True])
+            seq_logits.append(np.asarray(lg))
+        seq_logits = np.stack(seq_logits, axis=1)       # [S, W, V]
+
+        # Path B: ONE batched windowed apply.
+        win_logits, win_cache = GPT(cfg).apply(
+            {"params": params}, jnp.asarray(window, jnp.int32),
+            cache=cache, active=jnp.ones((2, W), bool))
+
+        win_logits = np.asarray(win_logits)
+        np.testing.assert_array_equal(win_logits.argmax(-1),
+                                      seq_logits.argmax(-1))
+        np.testing.assert_allclose(win_logits, seq_logits,
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(win_cache.seq_lens),
+                                      np.asarray(seq_cache.seq_lens))
+        np.testing.assert_allclose(np.asarray(win_cache.k),
+                                   np.asarray(seq_cache.k),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(win_cache.v),
+                                   np.asarray(seq_cache.v),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_windowed_apply_partial_validity(self, model):
+        """Contiguous-prefix validity: a slot with fewer valid window
+        positions advances by its own count and its valid logits match
+        the chained path exactly."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg, max_slots=2)
+        rs = np.random.RandomState(4)
+        prompt = rs.randint(2, cfg.vocab_size, size=(2, 5))
+        window = rs.randint(2, cfg.vocab_size, size=(2, 3))
+        valid = np.array([[True, True, True], [True, False, False]])
+
+        def single(tokens, cache, active):
+            return GPT(cfg).apply({"params": params},
+                                  jnp.asarray(tokens, jnp.int32),
+                                  cache=cache, active=jnp.asarray(active))
+
+        cache = _cache_with_slots(pc, 2, prompt.shape[1] + 3)
+        for t in range(prompt.shape[1]):
+            _, cache = single(prompt[:, t], cache, [True, True])
+
+        seq_cache = cache
+        seq_logits = []
+        for w in range(3):
+            lg, seq_cache = single(window[:, w], seq_cache, valid[:, w])
+            seq_logits.append(np.asarray(lg))
+
+        win_logits, win_cache = GPT(cfg).apply(
+            {"params": params}, jnp.asarray(window, jnp.int32),
+            cache=cache, active=jnp.asarray(valid))
+        np.testing.assert_array_equal(np.asarray(win_cache.seq_lens),
+                                      np.asarray(seq_cache.seq_lens))
+        for w in range(3):
+            for s in range(2):
+                if valid[s, w]:
+                    got = np.asarray(win_logits[s, w])
+                    want = seq_logits[w][s]
+                    assert got.argmax() == want.argmax()
+                    np.testing.assert_allclose(got, want,
+                                               rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Engine / ReplicaSet: spec-decode parity, migration bit-exactness,
+# prefix hits, demand-split autoscaling
+
+
+def _mkreqs(n=8, shared_len=9, tail=3, new=8, seed=0):
+    rs = np.random.RandomState(seed)
+    shared = [int(t) for t in rs.randint(2, 100, shared_len)]
+    return [Request(req_id=i,
+                    prompt=shared + [int(t) for t in
+                                     rs.randint(2, 100, tail)],
+                    max_new_tokens=new, arrival_time=float(3 * i))
+            for i in range(n)]
+
+
+def _outs(stats):
+    return {r.req_id: list(r.generated) for r in stats.completed}
+
+
+class TestEngineSpecDecode:
+    def test_greedy_spec_parity_bit_identical(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        dev = [jax.devices()[0]]
+        outs = []
+        for spec_k in (0, 3):
+            eng = GenerationEngine(cfg, params, pc, devices=dev,
+                                   spec_k=spec_k)
+            for r in _mkreqs(4, seed=5):
+                eng.submit(Request(req_id=r.req_id,
+                                   prompt=list(r.prompt),
+                                   max_new_tokens=r.max_new_tokens))
+            clock = VirtualClock()
+            steps = 0
+            while (eng.queue_depth() or eng.in_flight()) and steps < 500:
+                eng.step(clock.now)
+                clock.tick()
+                steps += 1
+            outs.append(_outs(eng.stats))
+        assert outs[0] and outs[0] == outs[1]
+        # The drafter must actually have verified something in a window.
+        assert eng._spec_proposed > 0 and eng._spec_accepted > 0
+
+    def test_spec_metrics_counted(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        eng = GenerationEngine(cfg, params, pc,
+                               devices=[jax.devices()[0]], spec_k=2)
+        eng.submit(Request(req_id=0, prompt=[3, 3, 3, 3, 3, 3],
+                           max_new_tokens=12))
+        clock = VirtualClock()
+        for _ in range(60):
+            if not (eng.queue_depth() or eng.in_flight()):
+                break
+            eng.step(clock.now)
+            clock.tick()
+        # A constant prompt makes the n-gram drafter near-perfect.
+        assert eng._spec_accepted > 0
+        assert eng._spec_accepted <= eng._spec_proposed
+
+
+class TestDisaggReplicaSet:
+    def _run(self, cfg, params, pc, reqs, **kw):
+        rset = ReplicaSet(cfg, params, pc, **kw)
+        stats = rset.run(reqs, clock=VirtualClock())
+        return rset, stats
+
+    def test_migration_bit_exact_fp_and_int8_ef(self, model):
+        """Both wire flavors against ONE shared symmetric baseline:
+        fp on an ICI-class mesh (lossless), then int8+EF with the
+        prefix cache and spec decoding on a DCN-class mesh — every
+        greedy output dict-equal to the undisturbed run."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        devs = jax.devices()[:2]
+        _, sym = self._run(cfg, params, pc, _mkreqs(6), n_replicas=2,
+                           devices=devs)
+
+        dis, d_stats = self._run(
+            cfg, params, pc, _mkreqs(6), n_replicas=2, devices=devs,
+            disagg=(1, 1), kv_mesh_shape=(1, 2))
+        assert _outs(d_stats) == _outs(sym)
+        assert dis.kv_migrations > 0
+        # ICI mesh: fp wire (int8 would be illegal on this hop).
+        assert dis.kv_plan.legs[0].wire_dtype != ir.INT8
+        assert dis.kv_migration_bytes == dis.kv_migration_fp_bytes
+
+        dis, d_stats = self._run(
+            cfg, params, pc, _mkreqs(6), n_replicas=2, devices=devs,
+            disagg=(1, 1), prefix_cache=True, spec_k=3,
+            kv_migrate_quantized=True, kv_mesh_shape=(2, 2))
+        assert _outs(d_stats) == _outs(sym)
+        assert "int8+ef" in dis.kv_plan.encode()
+        assert dis.kv_migrations > 0
+        # The quantized wire must actually compress vs fp.
+        assert dis.kv_migration_bytes < dis.kv_migration_fp_bytes
+        # Prefix cache engaged across tenants.
+        cache = dis.prefill_engines[0].prefix_cache
+        assert cache.hits > 0 and cache.hit_tokens > 0
+        # Spec decoding engaged on the decode replica.
+        dec = dis.decode_engines[0]
+        assert dec._spec_accepted > 0
+        # Zero predicted-vs-accounted drift, event by event.
+        predicted = sum(e["predicted_bytes"] for e in
+                        dis.migration_events)
+        assert abs(predicted - dis.kv_migration_bytes) < 1e-6
+        for ev in dis.migration_events:
+            assert ev["hop"] in ("ici", "dcn", "pod")
+            assert ev["predicted_ms"] > 0
+
+    @pytest.mark.slow
+    def test_no_cross_tenant_leak_through_shared_pages(self, model):
+        """Tenants aliasing a quantized-migrated prefix must still match
+        the symmetric baseline EXACTLY — the scatter skips shared pages,
+        so one tenant's (lossy) migrated KV can never perturb another's
+        reads."""
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        devs = jax.devices()[:2]
+        reqs = _mkreqs(6, shared_len=13, tail=2, seed=9)
+        _, sym = self._run(cfg, params, pc,
+                           [Request(req_id=r.req_id,
+                                    prompt=list(r.prompt),
+                                    max_new_tokens=r.max_new_tokens,
+                                    arrival_time=r.arrival_time)
+                            for r in reqs],
+                           n_replicas=2, devices=devs)
+        _, d_stats = self._run(
+            cfg, params, pc, reqs, n_replicas=2, devices=devs,
+            disagg=(1, 1), prefix_cache=True,
+            kv_migrate_quantized=True, kv_mesh_shape=(2, 2))
+        assert _outs(d_stats) == _outs(sym)
+
+    @pytest.mark.slow
+    def test_demand_split_autoscaler(self, model):
+        cfg, params = model
+        pc = tiny_page_cfg(cfg)
+        rset = ReplicaSet(cfg, params, pc, n_replicas=4,
+                          devices=jax.devices()[:4], disagg=(2, 2),
+                          prefix_cache=True, kv_mesh_shape=(2, 2))
+        auto = ReplicaAutoscaler(rset, min_replicas=4, max_replicas=4,
+                                 split_min_tokens=50)
+        stats = rset.run(_mkreqs(8, new=10, seed=11),
+                         clock=VirtualClock(), autoscaler=auto)
+        assert len(stats.completed) == 8
+        # The measured prefill:decode demand drove at least one re-split
+        # decision, and the final split still covers both roles.
+        assert auto.decisions
+        p, d = rset._disagg
+        assert p >= 1 and d >= 1 and p + d == 4
+
+
+# ---------------------------------------------------------------------------
+# Flight serve_cache view + postmortem migration-stall attribution
+
+
+def _load_postmortem():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "postmortem.py")
+    spec = importlib.util.spec_from_file_location("_postmortem_disagg",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestServeCacheForensics:
+    def test_flight_dump_carries_serve_cache_view(self, tmp_path):
+        from horovod_tpu import monitor
+        from horovod_tpu.monitor.flight import FlightRecorder
+
+        reg = monitor.metrics()
+        reg.gauge("serve.prefix_hit_rate").set(0.75)
+        reg.counter("serve.kv.migrations").inc(4)
+        reg.counter("serve.kv.stall_steps_by", replica="decode1").inc(6)
+        reg.counter("comm.kv.bytes", hop="dcn").inc(1234.0)
+        fr = FlightRecorder(capacity=32, snapshot_every=0)
+        fr.record("FLIGHT:SERVE_STEP", tid="flight",
+                  args={"engine": "decode1", "step": 1})
+        dump = fr.build_dump("test")
+        view = dump.get("serve_cache") or {}
+        assert view.get("serve.prefix_hit_rate") == 0.75
+        assert view.get("serve.kv.migrations", 0) >= 4
+        assert view.get("kv_bytes", {}).get("dcn", 0) >= 1234.0
+        assert view.get("stall_steps_by_replica", {}).get(
+            "decode1", 0) >= 6
+
+    def test_postmortem_names_migration_stalled_replica(self, tmp_path):
+        from horovod_tpu.monitor.flight import FlightRecorder
+
+        pm = _load_postmortem()
+        fr = FlightRecorder(capacity=16, snapshot_every=0)
+        fr.record("FLIGHT:SERVE_STEP", tid="flight",
+                  args={"engine": "decode0", "step": 3})
+        dump = fr.build_dump("watchdog_abort")
+        dump["serve_cache"] = {
+            "serve.prefix_hit_rate": 0.5,
+            "stall_steps_by_replica": {"decode0": 9.0, "decode1": 1.0},
+        }
+        path = tmp_path / "flight_rank0.json"
+        path.write_text(json.dumps(dump))
+        report = pm.build_report(str(tmp_path))
+        named = report["migration_stalled_replica"]
+        assert named and named["replica"] == "decode0"
+        assert named["stall_steps"] == 9.0
+        assert report["serve_cache"]["serve.prefix_hit_rate"] == 0.5
