@@ -1280,11 +1280,21 @@ def run_pp(args, devices, platform, mesh_shape):
       activation sends), and ``--overlap`` (stream-scheduled bucket
       collectives filling the bubble T3-style) into ONE compiled step.
 
+    When the requested schedule is in the interleaved table family,
+    BOTH ``interleaved_1f1b`` and the zero-bubble ``zb1`` table run on
+    the same geometry (schedule A/B) and the zb1 measured bubble must
+    land strictly below the 1F1B one; under ``--zero-stage 3`` the
+    forward's bucket all-gathers stream against the schedule's
+    idle-tick table and the leg hard-gates predicted == accounted
+    ``bubble_hidden_bytes`` (docs/pipeline.md).
+
     The JSON line carries the measured ``bubble_fraction`` (derived
-    from the schedule's ``PP:F``/``PP:B`` spans), the no-overlap GPipe
-    analytic bound ``(S-1)/(M+S-1)`` it must stay strictly under, the
-    per-hop wire bytes, and the send-leg predicted-vs-modeled wire-ms
-    drift pair the perf gate checks (scripts/perf_gate.sh pp)."""
+    from the schedule's ``PP:F``/``PP:B``/``PP:W`` spans), the
+    no-overlap GPipe analytic bound ``(S-1)/(M+S-1)`` it must stay
+    strictly under, ``bubble_hidden_fraction`` + the fill byte pair,
+    the per-hop wire bytes, and the send-leg predicted-vs-modeled
+    wire-ms drift pair the perf gate checks (scripts/perf_gate.sh
+    pp)."""
     import tempfile
 
     import jax
@@ -1306,9 +1316,9 @@ def run_pp(args, devices, platform, mesh_shape):
     S = args.pp
     v = max(1, args.pp_interleave)
     sched_name = args.pp_schedule
-    if sched_name != "interleaved_1f1b" and v > 1:
+    if sched_name not in ("interleaved_1f1b", "zb1") and v > 1:
         raise SystemExit(f"--pp-interleave {v} needs "
-                         f"--pp-schedule interleaved_1f1b")
+                         f"--pp-schedule interleaved_1f1b or zb1")
     ndev = len(devices)
     if ndev % S:
         raise SystemExit(f"--pp {S} does not divide {ndev} devices")
@@ -1324,14 +1334,14 @@ def run_pp(args, devices, platform, mesh_shape):
     if S * dp != ndev:
         raise SystemExit(f"--pp {S} x mesh {dmesh} != {ndev} devices")
     M = args.pp_microbatches
-    if M % S and sched_name == "interleaved_1f1b" and v > 1:
+    if M % S and sched_name in ("interleaved_1f1b", "zb1") and v > 1:
         raise SystemExit(f"--pp-microbatches {M} must divide by --pp {S}")
     stage = args.zero_stage or 0
     quantized = bool(args.quantized)
     overlap = bool(args.overlap)
     lr = 0.05
 
-    chunks_v = v if sched_name == "interleaved_1f1b" else 1
+    chunks_v = v if sched_name in ("interleaved_1f1b", "zb1") else 1
     L = S * max(chunks_v, v)
     seq = 16
     cfg = gpt_tiny(dtype=jnp.float32, num_layers=L)
@@ -1380,285 +1390,715 @@ def run_pp(args, devices, platform, mesh_shape):
     log(f"dense leg: loss0={float(dense_loss0):.4f} "
         f"{dense_tps:.0f} tok/s ({dense_sps:.2f} steps/s)")
 
-    # ---- pipelined leg ----------------------------------------------
-    hvd.shutdown()
-    tl_path = os.path.join(tempfile.mkdtemp(prefix="bench_pp_"),
-                           "pp_timeline.json")
-    os.environ["HOROVOD_TIMELINE"] = tl_path
-    try:
-        hvd.init(devices=devices, mesh_shape=dmesh, pp_stages=S)
-    finally:
-        del os.environ["HOROVOD_TIMELINE"]
-    mesh = hvd.mesh()
-    assert hvd.pp_size() == S
-    chunks, rest = pp_split_chunks(params0, S, chunks_v)
-    splan = _send_plan_for_axis(hvd.PP_AXIS, quantized=quantized,
-                                block=256, error_feedback=quantized)
-    sched = (build_interleaved_schedule(M, S, v)
-             if sched_name != "gpipe" and S > 1 else None)
-    PPALL = (hvd.PP_AXIS,) + hvd.HVD_AXES
-    data_spec = P(hvd.HVD_AXES)
-
-    tx = hvd.DistributedOptimizer(
-        optax.sgd(lr, momentum=0.9), zero_stage=stage,
-        quantized=quantized, overlap=overlap,
-        pp_stages=S, pp_microbatches=M, pp_schedule=sched_name,
-        pp_interleave=v) if stage else None
-
-    def pp_grads(cp_local, rest_local, tok, tgt):
-        return pipelined_gpt_train(
-            cfg, cp_local, rest_local, tok, tgt, axis=hvd.PP_AXIS,
-            num_microbatches=M, schedule=sched_name, interleave=v,
-            send_plan=splan if S > 1 else None)
-
-    def state_specs(state):
-        return jax.tree.map(
-            lambda l: P(PPALL) if getattr(l, "ndim", 0) >= 1 else P(),
-            state)
-
-    if stage == 3:
-        tpl = {"chunks": jax.tree.map(
-            lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype), chunks),
-            "rest": jax.tree.map(
-                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), rest)}
-        psh_rows = []
-        for r in range(S):
-            ptree_r = {"chunks": jax.tree.map(lambda a: a[r], chunks),
-                       "rest": rest}
-            psh_rows.append(hvd.zero3_shard_params(ptree_r))
-        psh = tuple(jnp.stack([row[i] for row in psh_rows])
-                    for i in range(len(psh_rows[0])))
-        psh_spec = jax.tree.map(lambda _: P(hvd.PP_AXIS, hvd.HVD_AXES),
-                                psh)
-        psh = jax.device_put(psh, jax.tree.map(
-            lambda q: NamedSharding(mesh, q), psh_spec))
-
-        def init_spmd(psh):
-            local = tuple(b[0] for b in psh)
-            ptree = hvd.zero3_gather_params(local, tpl)
-            return tx.init(ptree)
-
-        # Host-side init of ONE stage's tree gives the state STRUCTURE
-        # (leaf ranks match the in-trace form); the values come from the
-        # in-trace init below, sharded per stage x data rank.
-        state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
-                                                    chunks),
-                             "rest": rest})
-        state = jax.jit(hvd.shard_map(
-            init_spmd, mesh=mesh, in_specs=(psh_spec,),
-            out_specs=state_specs(state_tpl)))(psh)
-
-        def step_spmd(psh, state, tok, tgt):
-            local = tuple(b[0] for b in psh)
-            ptree = hvd.zero3_gather_params(local, tpl)
-            loss, g_cp, g_rest = pp_grads(ptree["chunks"], ptree["rest"],
-                                          tok, tgt)
-            grads = {"chunks": g_cp, "rest": g_rest}
-            upd, new_state = tx.update(grads, state, local)
-            new_local = optax.apply_updates(local, upd)
-            loss = hvd.allreduce(loss, op=hvd.Average)
-            return (loss, tuple(u[None] for u in new_local), new_state)
-
-        sspec = state_specs(state)
-        step = jax.jit(hvd.shard_map(
-            step_spmd, mesh=mesh,
-            in_specs=(psh_spec, sspec, data_spec, data_spec),
-            out_specs=(P(), psh_spec, sspec)))
-        carry = (psh, state)
-
-        def drive(tok, tgt):
-            nonlocal carry
-            psh, state = carry
-            loss, psh, state = step(psh, state, tok, tgt)
-            carry = (psh, state)
-            return loss
-    elif stage:
-        ptree = {"chunks": chunks, "rest": rest}
-        pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS), chunks),
-                 "rest": jax.tree.map(lambda _: P(), rest)}
-
-        def init_spmd(pt):
-            local = {"chunks": jax.tree.map(lambda a: a[0],
-                                            pt["chunks"]),
-                     "rest": pt["rest"]}
-            return tx.init(local)
-
-        state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
-                                                    chunks),
-                             "rest": rest})
-        state = jax.jit(hvd.shard_map(
-            init_spmd, mesh=mesh, in_specs=(pspec,),
-            out_specs=state_specs(state_tpl)))(ptree)
-
-        def step_spmd(pt, state, tok, tgt):
-            local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
-            loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok, tgt)
-            grads = {"chunks": g_cp, "rest": g_rest}
-            local = {"chunks": local_c, "rest": pt["rest"]}
-            upd, new_state = tx.update(grads, state, local)
-            new_local = optax.apply_updates(local, upd)
-            loss = hvd.allreduce(loss, op=hvd.Average)
-            # The optimizer's buckets mix pp-varying chunk leaves with
-            # pp-invariant rest leaves, so the updated rest comes back
-            # typed pp-varying although every stage computed the same
-            # value — re-establish the replication by construction
-            # (stage 0's copy, masked psum) so the P() out-spec holds.
-            from jax import lax as _lax
-
-            rpp = _lax.axis_index(hvd.PP_AXIS)
-            new_rest = jax.tree.map(
-                lambda a: _lax.psum(
-                    jnp.where(rpp == 0, a, jnp.zeros_like(a)),
-                    hvd.PP_AXIS), new_local["rest"])
-            new_pt = {"chunks": jax.tree.map(lambda a: a[None],
-                                             new_local["chunks"]),
-                      "rest": new_rest}
-            return loss, new_pt, new_state
-
-        sspec = state_specs(state)
-        step = jax.jit(hvd.shard_map(
-            step_spmd, mesh=mesh,
-            in_specs=(pspec, sspec, data_spec, data_spec),
-            out_specs=(P(), pspec, sspec)))
-        carry = (ptree, state)
-
-        def drive(tok, tgt):
-            nonlocal carry
-            pt, state = carry
-            loss, pt, state = step(pt, state, tok, tgt)
-            carry = (pt, state)
-            return loss
-    else:
-        ptree = {"chunks": chunks, "rest": rest}
-        pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS), chunks),
-                 "rest": jax.tree.map(lambda _: P(), rest)}
-
-        def step_spmd(pt, tok, tgt):
-            local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
-            loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok, tgt)
-            # Chunk grads are pp-VARYING (per stage), rest grads
-            # pp-invariant — reduce them in separate bucket sets so the
-            # rest wire keeps its provable pp replication.
-            g_cp = hvd.allreduce_pytree(g_cp, op=hvd.Average,
-                                        quantized=quantized or None,
-                                        overlap=overlap or None)
-            g_rest = hvd.allreduce_pytree(g_rest, op=hvd.Average,
-                                          quantized=quantized or None,
-                                          overlap=overlap or None)
-            new_c = jax.tree.map(lambda a, b: a - lr * b, local_c, g_cp)
-            new_rest = jax.tree.map(lambda a, b: a - lr * b, pt["rest"],
-                                    g_rest)
-            loss = hvd.allreduce(loss, op=hvd.Average)
-            return loss, {"chunks": jax.tree.map(lambda a: a[None],
-                                                 new_c),
-                          "rest": new_rest}
-
-        step = jax.jit(hvd.shard_map(
-            step_spmd, mesh=mesh,
-            in_specs=(pspec, data_spec, data_spec),
-            out_specs=(P(), pspec)))
-        carry = [ptree]
-
-        def drive(tok, tgt):
-            loss, carry[0] = step(carry[0], tok, tgt)
-            return loss
-
-    with record_wire_stats() as wire:
-        pp_loss0 = jax.block_until_ready(drive(tokens, targets))
-    parity_rel = abs(float(pp_loss0) - float(dense_loss0)) / max(
-        1e-9, abs(float(dense_loss0)))
-    tol = 1e-2 if quantized else 1e-4
-    log(f"pp leg: loss0={float(pp_loss0):.4f} vs dense "
-        f"{float(dense_loss0):.4f} (rel {parity_rel:.2e}, tol {tol})")
-    if parity_rel > tol:
-        raise SystemExit(
-            f"pp parity FAILED: pipelined loss {float(pp_loss0)} vs "
-            f"dense {float(dense_loss0)} (rel {parity_rel:.2e} > {tol})")
-
-    t0 = time.perf_counter()
-    for _ in range(iters * spc):
-        loss_p = drive(tokens, targets)
-    jax.block_until_ready(loss_p)
-    pp_sps = iters * spc / (time.perf_counter() - t0)
-    pp_tps = pp_sps * B * seq
-
-    # Bubble measured from the schedule's PP:F/PP:B spans.
-    bound = hvd_plan.pp_bubble_bound(S, M)
-    if sched is not None:
-        hvd.shutdown()  # flush + close the timeline
-        audit = span_audit.audit_spans(tl_path, prefix="PP:",
-                                       require_spans=True)
-        busy = audit.count.get("PP:F", 0) + audit.count.get("PP:B", 0)
-        # One trace per compiled step; the schedule emits once.
-        per_trace = sched.unit_count()
-        traces = max(1, busy // per_trace)
-        bubble = 1.0 - (busy / traces) / float(S * sched.ticks)
-        ticks = sched.ticks
-    else:
-        bubble = bound  # gpipe baseline: the analytic bound itself
-        ticks = M + S - 1
-    log(f"bubble_fraction={bubble:.4f} (gpipe bound {bound:.4f}, "
-        f"{ticks} ticks)")
-
-    # Straggler attribution: the pipeline leg's step decomposes into the
-    # measured bubble idle and the compute remainder (the send wire is
-    # inside the pp step's hop accounting like every other leg).
+    # ---- pipelined leg(s) -------------------------------------------
+    # Schedule A/B (docs/pipeline.md): when the requested schedule is in
+    # the interleaved table family, BOTH interleaved-1F1B and the
+    # zero-bubble zb1 table run on the same (S, M, v) geometry and land
+    # in ONE JSON line — the zb1 measured bubble must come out strictly
+    # below the 1F1B one, and each leg parity-gates against dense.
     from horovod_tpu import monitor as _monitor
+    from horovod_tpu.ops import fusion as _fusion
 
-    pp_step_ms = 1e3 / max(1e-9, pp_sps)
-    det = _monitor.straggler_detector()
-    det.record_phase("pp_bubble", bubble * pp_step_ms)
-    det.record_phase("compute", max(0.0, (1.0 - bubble) * pp_step_ms))
-    det.end_step()
+    def pp_leg(leg_sched):
+        family = "zb1" if leg_sched == "zb1" else "1f1b"
+        hvd.shutdown()
+        tl_path = os.path.join(tempfile.mkdtemp(prefix="bench_pp_"),
+                               "pp_timeline.json")
+        os.environ["HOROVOD_TIMELINE"] = tl_path
+        try:
+            hvd.init(devices=devices, mesh_shape=dmesh, pp_stages=S)
+        finally:
+            del os.environ["HOROVOD_TIMELINE"]
+        mesh = hvd.mesh()
+        assert hvd.pp_size() == S
+        chunks, rest = pp_split_chunks(params0, S, chunks_v)
+        splan = _send_plan_for_axis(hvd.PP_AXIS, quantized=quantized,
+                                    block=256, error_feedback=quantized)
+        sched = (build_interleaved_schedule(M, S, v, family=family)
+                 if leg_sched != "gpipe" and S > 1 else None)
+        # T3-style bubble fill (docs/pipeline.md): under ZeRO-3 the
+        # forward's bucket all-gathers stream against the schedule's
+        # idle-tick table, so up to idle_ticks_per_rank flights price as
+        # bubble-hidden instead of exposed wire.
+        fill_on = stage == 3 and sched is not None
+        PPALL = (hvd.PP_AXIS,) + hvd.HVD_AXES
+        data_spec = P(hvd.HVD_AXES)
 
-    # Send-leg drift pair: predicted (cost model) vs the trace-accounted
-    # bytes at the modeled bandwidths.
-    act_bytes = (B // (M * dp)) * seq * cfg.d_model * 4.0
-    issues = 2 * ticks if sched is not None else (M + S - 1)
-    priced = hvd_plan.price_send(
-        splan, act_bytes, issues=issues, mesh_shape=dmesh,
-        model=hvd_plan.get_cost_model(mesh_shape=dmesh))
-    ici_g, dcn_g, pod_g = bench_gbps()
-    hop = splan.legs[0].level
-    hop_gbps = {"ici": ici_g, "dcn": dcn_g, "pod": pod_g}[hop]
-    pp_wire_ms_modeled = wire.pp_bytes / (hop_gbps * 1e9) * 1e3
-    drift = (abs(priced["modeled_ms"] - pp_wire_ms_modeled)
-             / max(1e-9, pp_wire_ms_modeled))
-    log(f"send wire: accounted {wire.pp_bytes:.0f} B "
-        f"({pp_wire_ms_modeled:.4f} ms modeled) vs predicted "
-        f"{priced['wire_bytes']:.0f} B ({priced['modeled_ms']:.4f} ms); "
-        f"drift {drift:.4f}")
+        tx = hvd.DistributedOptimizer(
+            optax.sgd(lr, momentum=0.9), zero_stage=stage,
+            quantized=quantized, overlap=overlap,
+            pp_stages=S, pp_microbatches=M, pp_schedule=leg_sched,
+            pp_interleave=v) if stage else None
 
+        def pp_grads(cp_local, rest_local, tok, tgt):
+            return pipelined_gpt_train(
+                cfg, cp_local, rest_local, tok, tgt, axis=hvd.PP_AXIS,
+                num_microbatches=M, schedule=leg_sched, interleave=v,
+                send_plan=splan if S > 1 else None)
+
+        def state_specs(state):
+            return jax.tree.map(
+                lambda l: P(PPALL) if getattr(l, "ndim", 0) >= 1 else P(),
+                state)
+
+        if stage == 3:
+            tpl = {"chunks": jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                chunks),
+                "rest": jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    rest)}
+            psh_rows = []
+            for r in range(S):
+                ptree_r = {"chunks": jax.tree.map(lambda a: a[r], chunks),
+                           "rest": rest}
+                psh_rows.append(hvd.zero3_shard_params(ptree_r))
+            psh = tuple(jnp.stack([row[i] for row in psh_rows])
+                        for i in range(len(psh_rows[0])))
+            psh_spec = jax.tree.map(
+                lambda _: P(hvd.PP_AXIS, hvd.HVD_AXES), psh)
+            psh = jax.device_put(psh, jax.tree.map(
+                lambda q: NamedSharding(mesh, q), psh_spec))
+
+            def init_spmd(psh):
+                local = tuple(b[0] for b in psh)
+                ptree = hvd.zero3_gather_params(local, tpl)
+                return tx.init(ptree)
+
+            # Host-side init of ONE stage's tree gives the state
+            # STRUCTURE (leaf ranks match the in-trace form); the values
+            # come from the in-trace init below, sharded per stage x
+            # data rank.
+            state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
+                                                        chunks),
+                                 "rest": rest})
+            state = jax.jit(hvd.shard_map(
+                init_spmd, mesh=mesh, in_specs=(psh_spec,),
+                out_specs=state_specs(state_tpl)))(psh)
+
+            def step_spmd(psh, state, tok, tgt):
+                local = tuple(b[0] for b in psh)
+                ptree = hvd.zero3_gather_params(
+                    local, tpl, overlap=True if fill_on else None,
+                    fill_sched=sched if fill_on else None)
+                loss, g_cp, g_rest = pp_grads(ptree["chunks"],
+                                              ptree["rest"], tok, tgt)
+                grads = {"chunks": g_cp, "rest": g_rest}
+                upd, new_state = tx.update(grads, state, local)
+                new_local = optax.apply_updates(local, upd)
+                loss = hvd.allreduce(loss, op=hvd.Average)
+                return (loss, tuple(u[None] for u in new_local),
+                        new_state)
+
+            sspec = state_specs(state)
+            step = jax.jit(hvd.shard_map(
+                step_spmd, mesh=mesh,
+                in_specs=(psh_spec, sspec, data_spec, data_spec),
+                out_specs=(P(), psh_spec, sspec)))
+            carry = (psh, state)
+
+            def drive(tok, tgt):
+                nonlocal carry
+                psh, state = carry
+                loss, psh, state = step(psh, state, tok, tgt)
+                carry = (psh, state)
+                return loss
+        elif stage:
+            ptree = {"chunks": chunks, "rest": rest}
+            pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS),
+                                            chunks),
+                     "rest": jax.tree.map(lambda _: P(), rest)}
+
+            def init_spmd(pt):
+                local = {"chunks": jax.tree.map(lambda a: a[0],
+                                                pt["chunks"]),
+                         "rest": pt["rest"]}
+                return tx.init(local)
+
+            state_tpl = tx.init({"chunks": jax.tree.map(lambda a: a[0],
+                                                        chunks),
+                                 "rest": rest})
+            state = jax.jit(hvd.shard_map(
+                init_spmd, mesh=mesh, in_specs=(pspec,),
+                out_specs=state_specs(state_tpl)))(ptree)
+
+            def step_spmd(pt, state, tok, tgt):
+                local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
+                loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok,
+                                              tgt)
+                grads = {"chunks": g_cp, "rest": g_rest}
+                local = {"chunks": local_c, "rest": pt["rest"]}
+                upd, new_state = tx.update(grads, state, local)
+                new_local = optax.apply_updates(local, upd)
+                loss = hvd.allreduce(loss, op=hvd.Average)
+                # The optimizer's buckets mix pp-varying chunk leaves
+                # with pp-invariant rest leaves, so the updated rest
+                # comes back typed pp-varying although every stage
+                # computed the same value — re-establish the replication
+                # by construction (stage 0's copy, masked psum) so the
+                # P() out-spec holds.
+                from jax import lax as _lax
+
+                rpp = _lax.axis_index(hvd.PP_AXIS)
+                new_rest = jax.tree.map(
+                    lambda a: _lax.psum(
+                        jnp.where(rpp == 0, a, jnp.zeros_like(a)),
+                        hvd.PP_AXIS), new_local["rest"])
+                new_pt = {"chunks": jax.tree.map(lambda a: a[None],
+                                                 new_local["chunks"]),
+                          "rest": new_rest}
+                return loss, new_pt, new_state
+
+            sspec = state_specs(state)
+            step = jax.jit(hvd.shard_map(
+                step_spmd, mesh=mesh,
+                in_specs=(pspec, sspec, data_spec, data_spec),
+                out_specs=(P(), pspec, sspec)))
+            carry = (ptree, state)
+
+            def drive(tok, tgt):
+                nonlocal carry
+                pt, state = carry
+                loss, pt, state = step(pt, state, tok, tgt)
+                carry = (pt, state)
+                return loss
+        else:
+            ptree = {"chunks": chunks, "rest": rest}
+            pspec = {"chunks": jax.tree.map(lambda _: P(hvd.PP_AXIS),
+                                            chunks),
+                     "rest": jax.tree.map(lambda _: P(), rest)}
+
+            def step_spmd(pt, tok, tgt):
+                local_c = jax.tree.map(lambda a: a[0], pt["chunks"])
+                loss, g_cp, g_rest = pp_grads(local_c, pt["rest"], tok,
+                                              tgt)
+                # Chunk grads are pp-VARYING (per stage), rest grads
+                # pp-invariant — reduce them in separate bucket sets so
+                # the rest wire keeps its provable pp replication.
+                g_cp = hvd.allreduce_pytree(g_cp, op=hvd.Average,
+                                            quantized=quantized or None,
+                                            overlap=overlap or None)
+                g_rest = hvd.allreduce_pytree(
+                    g_rest, op=hvd.Average, quantized=quantized or None,
+                    overlap=overlap or None)
+                new_c = jax.tree.map(lambda a, b: a - lr * b, local_c,
+                                     g_cp)
+                new_rest = jax.tree.map(lambda a, b: a - lr * b,
+                                        pt["rest"], g_rest)
+                loss = hvd.allreduce(loss, op=hvd.Average)
+                return loss, {"chunks": jax.tree.map(lambda a: a[None],
+                                                     new_c),
+                              "rest": new_rest}
+
+            step = jax.jit(hvd.shard_map(
+                step_spmd, mesh=mesh,
+                in_specs=(pspec, data_spec, data_spec),
+                out_specs=(P(), pspec)))
+            carry = [ptree]
+
+            def drive(tok, tgt):
+                loss, carry[0] = step(carry[0], tok, tgt)
+                return loss
+
+        with record_wire_stats() as wire:
+            pp_loss0 = jax.block_until_ready(drive(tokens, targets))
+        parity_rel = abs(float(pp_loss0) - float(dense_loss0)) / max(
+            1e-9, abs(float(dense_loss0)))
+        tol = 1e-2 if quantized else 1e-4
+        log(f"pp[{leg_sched}] leg: loss0={float(pp_loss0):.4f} vs dense "
+            f"{float(dense_loss0):.4f} (rel {parity_rel:.2e}, tol {tol})")
+        if parity_rel > tol:
+            raise SystemExit(
+                f"pp parity FAILED ({leg_sched}): pipelined loss "
+                f"{float(pp_loss0)} vs dense {float(dense_loss0)} "
+                f"(rel {parity_rel:.2e} > {tol})")
+
+        # Bubble-fill contract hard-gate (docs/pipeline.md): the cost
+        # model's predicted flat all-gather bytes for the first
+        # min(buckets, idle ticks) forward-order flights must equal the
+        # trace-accounted bubble_hidden_bytes exactly.
+        fill = {"capacity_ticks": (sched.idle_ticks_per_rank
+                                   if sched is not None else 0),
+                "filled_ticks": wire.filled_ticks,
+                "bubble_hidden_bytes": wire.bubble_hidden_bytes,
+                "predicted_bytes": 0.0,
+                "bubble_hidden_fraction": 0.0}
+        if fill_on:
+            planb = hvd.zero3_plan(tpl)
+            cap = sched.idle_ticks_per_rank
+            exp_filled = min(len(planb), cap)
+            pred = 0.0
+            for i in _fusion.gather_order(planb)[:exp_filled]:
+                rows = hvd_plan.predict_leg_bytes(
+                    hvd_plan.flat_plan("all_gather"),
+                    planb[i].padded_size, 4, dmesh)
+                pred += sum(r["bytes"] for r in rows)
+            fill["predicted_bytes"] = pred
+            fill["bubble_hidden_fraction"] = exp_filled / max(1, cap)
+            fdrift = abs(pred - wire.bubble_hidden_bytes) / max(1.0, pred)
+            log(f"bubble fill[{leg_sched}]: {wire.filled_ticks}/{cap} "
+                f"idle ticks filled, accounted "
+                f"{wire.bubble_hidden_bytes:.0f} B vs predicted "
+                f"{pred:.0f} B")
+            if wire.filled_ticks != exp_filled or fdrift > 1e-6:
+                raise SystemExit(
+                    f"pp bubble-fill drift FAILED ({leg_sched}): filled "
+                    f"{wire.filled_ticks} ticks vs {exp_filled} "
+                    f"expected; accounted {wire.bubble_hidden_bytes:.0f}"
+                    f" B vs predicted {pred:.0f} B")
+
+        t0 = time.perf_counter()
+        for _ in range(iters * spc):
+            loss_p = drive(tokens, targets)
+        jax.block_until_ready(loss_p)
+        pp_sps = iters * spc / (time.perf_counter() - t0)
+        pp_tps = pp_sps * B * seq
+
+        # Bubble measured from the schedule's PP:F/PP:B/PP:W spans (the
+        # zb1 table emits the deferred W units as first-class spans).
+        bound = hvd_plan.pp_bubble_bound(S, M)
+        if sched is not None:
+            hvd.shutdown()  # flush + close the timeline
+            audit = span_audit.audit_spans(tl_path, prefix="PP:",
+                                           require_spans=True)
+            busy = (audit.count.get("PP:F", 0)
+                    + audit.count.get("PP:B", 0)
+                    + audit.count.get("PP:W", 0))
+            # One trace per compiled step; the schedule emits once.
+            per_trace = sched.unit_count()
+            traces = max(1, busy // per_trace)
+            bubble = 1.0 - (busy / traces) / float(S * sched.ticks)
+            ticks = sched.ticks
+        else:
+            bubble = bound  # gpipe baseline: the analytic bound itself
+            ticks = M + S - 1
+        log(f"bubble_fraction[{leg_sched}]={bubble:.4f} "
+            f"(gpipe bound {bound:.4f}, {ticks} ticks)")
+
+        # Straggler attribution: the measured idle ticks feed the
+        # pp_bubble phase NET of the fill credit (monitor/straggler.py);
+        # the compute remainder gets the rest.
+        pp_step_ms = 1e3 / max(1e-9, pp_sps)
+        det = _monitor.straggler_detector()
+        if sched is not None:
+            _monitor.record_pp_bubble(
+                sched.idle_ticks_per_rank, sched.ticks, pp_step_ms,
+                filled_ticks=wire.filled_ticks, detector=det)
+        else:
+            det.record_phase("pp_bubble", bubble * pp_step_ms)
+        det.record_phase("compute", max(0.0, (1.0 - bubble) * pp_step_ms))
+        det.end_step()
+
+        # Send-leg drift pair: predicted (cost model) vs the
+        # trace-accounted bytes at the modeled bandwidths.
+        act_bytes = (B // (M * dp)) * seq * cfg.d_model * 4.0
+        issues = 2 * ticks if sched is not None else (M + S - 1)
+        priced = hvd_plan.price_send(
+            splan, act_bytes, issues=issues, mesh_shape=dmesh,
+            model=hvd_plan.get_cost_model(mesh_shape=dmesh))
+        ici_g, dcn_g, pod_g = bench_gbps()
+        hop = splan.legs[0].level
+        hop_gbps = {"ici": ici_g, "dcn": dcn_g, "pod": pod_g}[hop]
+        pp_wire_ms_modeled = wire.pp_bytes / (hop_gbps * 1e9) * 1e3
+        drift = (abs(priced["modeled_ms"] - pp_wire_ms_modeled)
+                 / max(1e-9, pp_wire_ms_modeled))
+        log(f"send wire[{leg_sched}]: accounted {wire.pp_bytes:.0f} B "
+            f"({pp_wire_ms_modeled:.4f} ms modeled) vs predicted "
+            f"{priced['wire_bytes']:.0f} B ({priced['modeled_ms']:.4f} "
+            f"ms); drift {drift:.4f}")
+
+        return {
+            "schedule": leg_sched, "family": family,
+            "parity_rel_err": parity_rel, "parity_tol": tol,
+            "tokens_per_sec": pp_tps, "steps_per_sec": pp_sps,
+            "bubble_fraction": bubble, "bubble_bound": bound,
+            "ticks": ticks, "send_plan": splan.encode(),
+            "wire": wire, "priced": priced,
+            "pp_wire_ms_modeled": pp_wire_ms_modeled, "drift": drift,
+            "fill": fill,
+        }
+
+    ab = sched_name in ("interleaved_1f1b", "zb1") and S > 1
+    leg_names = ["interleaved_1f1b", "zb1"] if ab else [sched_name]
+    legs = {name: pp_leg(name) for name in leg_names}
+    prim = legs[sched_name]
+    if ab:
+        b1 = legs["interleaved_1f1b"]["bubble_fraction"]
+        bz = legs["zb1"]["bubble_fraction"]
+        log(f"schedule A/B: interleaved-1F1B bubble {b1:.4f} vs zb1 "
+            f"{bz:.4f}")
+        if not bz < b1:
+            raise SystemExit(
+                f"zb1 bubble FAILED: {bz:.4f} not strictly below the "
+                f"interleaved-1F1B bubble {b1:.4f} on the same geometry "
+                f"(S={S}, M={M}, v={v})")
+
+    wire = prim["wire"]
+    priced = prim["priced"]
     result = {
         "metric": f"pp{S}_tokens_per_sec",
-        "value": round(pp_tps, 1),
+        "value": round(prim["tokens_per_sec"], 1),
         "unit": "tokens/sec",
         "platform": platform,
         "pp": {
             "stages": S, "interleave": v, "microbatches": M,
             "schedule": sched_name, "data_mesh": mesh_shape_str(dmesh),
             "zero_stage": stage, "quantized": quantized,
-            "overlap": overlap, "send_plan": splan.encode(),
-            "ticks": ticks,
+            "overlap": overlap, "send_plan": prim["send_plan"],
+            "ticks": prim["ticks"],
         },
-        "bubble_fraction": round(bubble, 6),
-        "bubble_bound_gpipe": round(bound, 6),
-        "parity_rel_err": parity_rel,
-        "parity_tol": tol,
+        "bubble_fraction": round(prim["bubble_fraction"], 6),
+        "bubble_bound_gpipe": round(prim["bubble_bound"], 6),
+        "parity_rel_err": prim["parity_rel_err"],
+        "parity_tol": prim["parity_tol"],
         "dense_tokens_per_sec": round(dense_tps, 1),
-        "throughput_delta": round(pp_tps / max(1e-9, dense_tps), 4),
+        "throughput_delta": round(
+            prim["tokens_per_sec"] / max(1e-9, dense_tps), 4),
         "wire_bytes_ici": wire.ici_bytes,
         "wire_bytes_dcn": wire.dcn_bytes,
         "wire_bytes_pod": wire.pod_bytes,
         "pp_send_bytes": wire.pp_bytes,
         "pp_sends": wire.pp_sends,
+        "bubble_hidden_fraction": round(
+            prim["fill"]["bubble_hidden_fraction"], 6),
+        "bubble_hidden_bytes": prim["fill"]["bubble_hidden_bytes"],
+        "filled_ticks": prim["fill"]["filled_ticks"],
+        "fill_capacity_ticks": prim["fill"]["capacity_ticks"],
+        "fill_predicted_bytes": round(
+            prim["fill"]["predicted_bytes"], 1),
         "wire_ms": {
             "predicted": round(priced["modeled_ms"], 4),
             "predicted_total": round(priced["predicted_ms"], 4),
-            "modeled": round(pp_wire_ms_modeled, 4),
+            "modeled": round(prim["pp_wire_ms_modeled"], 4),
             "model": priced["model"],
         },
         "metrics_snapshot": metrics_snapshot(),
+    }
+    if ab:
+        result["bubble_fraction_1f1b"] = round(
+            legs["interleaved_1f1b"]["bubble_fraction"], 6)
+        result["bubble_fraction_zb1"] = round(
+            legs["zb1"]["bubble_fraction"], 6)
+        result["schedules"] = {
+            name: {
+                "bubble_fraction": round(r["bubble_fraction"], 6),
+                "tokens_per_sec": round(r["tokens_per_sec"], 1),
+                "parity_rel_err": r["parity_rel_err"],
+                "bubble_hidden_fraction": round(
+                    r["fill"]["bubble_hidden_fraction"], 6),
+            } for name, r in legs.items()}
+    print(json.dumps(result))
+    return result
+
+
+def run_pp4d(args, devices, platform, mesh_shape):
+    """The combined ``--pp S --moe E --zero-stage 3`` leg: the 4-D
+    composed mesh ``(hvd_pp, hvd_ep, hvd_cross, hvd_local)``
+    (docs/parallelism.md).
+
+    One residual top-k MoE FFN stage per hvd_pp rank, expert groups on
+    the stage-LOCAL hvd_ep axis (the dispatch/combine exchanges lowered
+    as wire-plan ``a2a`` legs; ``--quantized`` rides them
+    blockwise-int8), ZeRO-3 parameter shards per (stage, expert-group)
+    cell over the trailing data mesh, and the forward's bucket
+    all-gathers streamed against the pipeline schedule's idle-tick
+    table (the T3-style bubble fill; ``--pp-schedule zb1`` runs the
+    zero-bubble table). Hard gates: one-step loss parity vs the dense
+    single-device reference, and predicted == accounted bubble-fill
+    bytes. The JSON line carries the composed plan encodings, the
+    ``ppS.epE`` geometry fingerprint, per-hop + a2a + pp-send wire
+    bytes, the fill pair, and the a2a predicted-vs-modeled drift the
+    perf gate checks (scripts/perf_gate.sh pp4d)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import monitor as _monitor
+    from horovod_tpu import plan as hvd_plan
+    from horovod_tpu.common import basics as _basics
+    from horovod_tpu.moe import (EXPERT_LEAVES, default_a2a_plan,
+                                 ep_mean_dense_grads, ep_stack_params,
+                                 moe_capacity, moe_ffn)
+    from horovod_tpu.ops import fusion as _fusion
+    from horovod_tpu.ops.collective_ops import record_wire_stats
+    from horovod_tpu.parallel.pipeline import (
+        build_interleaved_schedule, interleaved_1f1b)
+    from horovod_tpu.plan.accounting import bench_gbps
+
+    S, E = args.pp, args.moe
+    K = min(args.moe_topk, E)
+    sched_name = args.pp_schedule
+    if sched_name == "gpipe":
+        raise SystemExit("--pp --moe needs a table-family schedule "
+                         "(interleaved_1f1b or zb1), not gpipe")
+    family = "zb1" if sched_name == "zb1" else "1f1b"
+    if (args.zero_stage or 0) != 3:
+        raise SystemExit("--pp --moe is the combined 4-D ZeRO-3 leg: "
+                         "pass --zero-stage 3 (the EPxPP stage<=2 "
+                         "matrix is covered by tests/test_pp4d.py)")
+    quantized = bool(args.quantized)
+    overlap = bool(args.overlap)
+    ndev = len(devices)
+    if ndev % (S * E):
+        raise SystemExit(f"--pp {S} x --moe {E} does not divide {ndev} "
+                         f"devices")
+    if mesh_shape is not None:
+        if len(mesh_shape) != 2:
+            raise SystemExit("--pp --moe takes a 2-D --mesh-shape (the "
+                             "per-cell DATA mesh)")
+        dmesh = tuple(mesh_shape)
+    else:
+        dp0 = ndev // (S * E)
+        dmesh = (2, dp0 // 2) if dp0 % 2 == 0 and dp0 >= 2 else (1, dp0)
+    dp = dmesh[0] * dmesh[1]
+    if S * E * dp != ndev:
+        raise SystemExit(f"--pp {S} x --moe {E} x mesh {dmesh} != "
+                         f"{ndev} devices")
+    M = args.pp_microbatches
+    C, F = 32, 64
+    NL = 16                        # tokens per device per microbatch
+    Nb = NL * E * dp               # tokens per microbatch (pp-replicated)
+    lr = 0.05
+    blk = 64
+    cf = float(E)                  # lossless capacity: parity is exact
+    iters = max(2, args.num_iters)
+    spc = max(1, args.num_batches_per_iter)
+    log(f"pp4d leg: stages={S} experts={E} topk={K} microbatches={M} "
+        f"schedule={sched_name} data_mesh={dmesh} zero_stage=3 "
+        f"quantized={quantized} overlap={overlap} "
+        f"tokens_per_step={M * Nb}")
+
+    def init_stage(seed):
+        r = np.random.RandomState(seed)
+        return {
+            "router": jnp.asarray(r.randn(C, E) * 0.1, jnp.float32),
+            "w1": jnp.asarray(r.randn(E, C, F) * 0.1, jnp.float32),
+            "b1": jnp.zeros((E, F), jnp.float32),
+            "w2": jnp.asarray(r.randn(E, F, C) * 0.1, jnp.float32),
+            "b2": jnp.zeros((E, C), jnp.float32),
+        }
+
+    stage_params = [init_stage(11 + s) for s in range(S)]
+    rs = np.random.RandomState(5)
+    hp = {"wh": jnp.asarray(rs.randn(C, C) * 0.1, jnp.float32)}
+    x = jnp.asarray(rs.randn(M, Nb, C), jnp.float32)
+    tgt = jnp.asarray(rs.randn(M, Nb, C), jnp.float32)
+
+    # Dense single-device reference (eager, no mesh): the same routing
+    # math on the full batch — lossless capacity keeps it exact.
+    h_ref = x.reshape(-1, C)
+    for p in stage_params:
+        y_ref, _, _ = moe_ffn(h_ref, p, topk=K, capacity_factor=cf)
+        h_ref = h_ref + y_ref
+    dense_loss = float(jnp.mean((h_ref @ hp["wh"]
+                                 - tgt.reshape(-1, C)) ** 2))
+
+    hvd.shutdown()
+    hvd.init(devices=devices, mesh_shape=dmesh, ep_size=E, pp_stages=S)
+    mesh = hvd.mesh()
+    assert hvd.pp_size() == S and hvd.ep_size() == E
+    geometry = _basics.mesh_geometry()
+    EPALL = (hvd.EP_AXIS,) + hvd.HVD_AXES
+    SALL = (hvd.PP_AXIS, hvd.EP_AXIS) + hvd.HVD_AXES
+    splan = default_a2a_plan(hvd.EP_AXIS, quantized=quantized,
+                             block=blk, error_feedback=False)
+    sched = build_interleaved_schedule(M, S, 1, family=family)
+    log(f"a2a plan: {splan.encode()} geometry: {geometry}")
+
+    stacked = [ep_stack_params(p, E) for p in stage_params]
+    chunks = jax.tree.map(lambda *ls: jnp.stack(ls), *stacked)
+
+    def leaf_name(path):
+        return (path[-1].key if hasattr(path[-1], "key")
+                else str(path[-1]))
+
+    def cell_local(s, g):
+        """Cell (stage s, expert-group g)'s LOCAL tree — the form the
+        in-trace ``b[0, 0]`` slices reproduce (expert leaves keep the
+        ep-singleton lead that doubles as the schedule's v dim)."""
+        def pick(path, a):
+            if leaf_name(path) in EXPERT_LEAVES:
+                return a[s, g][None]
+            return a[s][None]
+
+        return {"chunks": jax.tree_util.tree_map_with_path(pick, chunks),
+                "head": hp}
+
+    lc_tpl = jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+        cell_local(0, 0))
+    cells = [[hvd.zero3_shard_params(cell_local(s, g)) for g in range(E)]
+             for s in range(S)]
+    nb = len(cells[0][0])
+    psh = tuple(jnp.stack([jnp.stack([cells[s][g][i] for g in range(E)])
+                           for s in range(S)]) for i in range(nb))
+    psh_spec = jax.tree.map(
+        lambda _: P(hvd.PP_AXIS, hvd.EP_AXIS, hvd.HVD_AXES), psh)
+    psh = jax.device_put(psh, jax.tree.map(
+        lambda q: NamedSharding(mesh, q), psh_spec))
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(lr, momentum=0.9), zero_stage=3, quantized=quantized,
+        overlap=overlap, pp_stages=S, pp_microbatches=M,
+        pp_schedule=sched_name, moe_experts=E, moe_capacity_factor=cf)
+
+    def stage_fn(p, xx):
+        y, _, _ = moe_ffn(xx, p, topk=K, capacity_factor=cf,
+                          ep_axis=hvd.EP_AXIS, a2a_plan=splan)
+        return xx + y
+
+    def loss_fn(hp_, y, tg):
+        return jnp.mean((y @ hp_["wh"] - tg) ** 2)
+
+    def state_specs(state):
+        return jax.tree.map(
+            lambda l: P(SALL) if getattr(l, "ndim", 0) >= 1 else P(),
+            state)
+
+    def init_spmd(psh):
+        local = tuple(b[0, 0] for b in psh)
+        lc = hvd.zero3_gather_params(local, lc_tpl)
+        return tx.init(lc)
+
+    state_tpl = tx.init(cell_local(0, 0))
+    state = jax.jit(hvd.shard_map(
+        init_spmd, mesh=mesh, in_specs=(psh_spec,),
+        out_specs=state_specs(state_tpl)))(psh)
+    sspec = state_specs(state)
+
+    def step_spmd(psh, state, xb, tg):
+        local = tuple(b[0, 0] for b in psh)
+        lc = hvd.zero3_gather_params(local, lc_tpl, overlap=True,
+                                     fill_sched=sched)
+        loss, g_cp, g_hp, _ = interleaved_1f1b(
+            stage_fn, loss_fn, lc["chunks"], lc["head"], xb, tg,
+            axis=hvd.PP_AXIS, interleave=1, family=family)
+        # Global-mean gradient shares (docs/moe.md): router/head pmean
+        # over hvd_ep, expert leaves 1/ep — never a reduction over
+        # hvd_pp; the stage-3 update then averages over the data axes.
+        g = ep_mean_dense_grads({"chunks": g_cp, "head": g_hp})
+        upd, new_state = tx.update(g, state, local)
+        new_local = optax.apply_updates(local, upd)
+        loss = hvd.allreduce(loss, op=hvd.Average, axes=EPALL)
+        return (loss, tuple(u[None, None] for u in new_local), new_state)
+
+    data_spec = P(None, EPALL)
+    step = jax.jit(hvd.shard_map(
+        step_spmd, mesh=mesh,
+        in_specs=(psh_spec, sspec, data_spec, data_spec),
+        out_specs=(P(), psh_spec, sspec)))
+    carry = [psh, state]
+
+    def drive(xb, tg):
+        loss, carry[0], carry[1] = step(carry[0], carry[1], xb, tg)
+        return loss
+
+    with record_wire_stats() as wire:
+        loss0 = jax.block_until_ready(drive(x, tgt))
+    parity_rel = abs(float(loss0) - dense_loss) / max(1e-9,
+                                                      abs(dense_loss))
+    tol = 5e-2 if quantized else 1e-4
+    log(f"pp4d parity: loss0={float(loss0):.5f} vs dense "
+        f"{dense_loss:.5f} (rel {parity_rel:.2e}, tol {tol})")
+    if parity_rel > tol:
+        raise SystemExit(
+            f"pp4d parity FAILED: pipelined MoE ZeRO-3 loss "
+            f"{float(loss0)} vs dense {dense_loss} "
+            f"(rel {parity_rel:.2e} > {tol})")
+
+    # Bubble-fill contract hard-gate, same as the --pp leg.
+    planb = hvd.zero3_plan(lc_tpl)
+    cap = sched.idle_ticks_per_rank
+    exp_filled = min(len(planb), cap)
+    pred = 0.0
+    for i in _fusion.gather_order(planb)[:exp_filled]:
+        rows = hvd_plan.predict_leg_bytes(
+            hvd_plan.flat_plan("all_gather"), planb[i].padded_size, 4,
+            dmesh)
+        pred += sum(r["bytes"] for r in rows)
+    fdrift = abs(pred - wire.bubble_hidden_bytes) / max(1.0, pred)
+    log(f"bubble fill: {wire.filled_ticks}/{cap} idle ticks filled, "
+        f"accounted {wire.bubble_hidden_bytes:.0f} B vs predicted "
+        f"{pred:.0f} B")
+    if wire.filled_ticks != exp_filled or fdrift > 1e-6:
+        raise SystemExit(
+            f"pp4d bubble-fill drift FAILED: filled {wire.filled_ticks} "
+            f"ticks vs {exp_filled} expected; accounted "
+            f"{wire.bubble_hidden_bytes:.0f} B vs predicted {pred:.0f} B")
+
+    t0 = time.perf_counter()
+    for _ in range(iters * spc):
+        loss_p = drive(x, tgt)
+    jax.block_until_ready(loss_p)
+    sps = iters * spc / (time.perf_counter() - t0)
+    tps = sps * M * Nb
+
+    # a2a drift pair (run_moe's formula on the stage-local plan) +
+    # straggler attribution with the fill credit.
+    a2a_cap = moe_capacity(NL, E, cf, K)
+    buf_bytes = E * a2a_cap * C * 4.0
+    priced = hvd_plan.price_a2a(
+        splan, buf_bytes, ep=E, issues=max(1, wire.a2a_calls),
+        mesh_shape=dmesh, model=hvd_plan.get_cost_model(mesh_shape=dmesh))
+    ici_g, dcn_g, pod_g = bench_gbps()
+    hop = splan.legs[0].level
+    hop_gbps = {"ici": ici_g, "dcn": dcn_g, "pod": pod_g}[hop]
+    a2a_ms_modeled = wire.a2a_bytes / (hop_gbps * 1e9) * 1e3
+    drift = (abs(priced["modeled_ms"] - a2a_ms_modeled)
+             / max(1e-9, a2a_ms_modeled))
+    log(f"a2a wire: accounted {wire.a2a_bytes:.0f} B "
+        f"({a2a_ms_modeled:.4f} ms modeled, {wire.a2a_calls} exchanges) "
+        f"vs predicted {priced['wire_bytes']:.0f} B "
+        f"({priced['modeled_ms']:.4f} ms); drift {drift:.4f}")
+
+    step_ms = 1e3 / max(1e-9, sps)
+    det = _monitor.straggler_detector()
+    _monitor.record_pp_bubble(sched.idle_ticks_per_rank, sched.ticks,
+                              step_ms, filled_ticks=wire.filled_ticks,
+                              detector=det)
+    det.record_phase("wire.a2a", min(step_ms, a2a_ms_modeled))
+    det.record_phase("compute", max(0.0, step_ms - a2a_ms_modeled))
+    det.end_step()
+
+    result = {
+        "metric": f"pp{S}ep{E}_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "chips": ndev,
+        "pp4d": {
+            "stages": S, "experts": E, "topk": K, "microbatches": M,
+            "schedule": sched_name, "family": family,
+            "data_mesh": mesh_shape_str(dmesh), "geometry": geometry,
+            "zero_stage": 3, "quantized": quantized, "overlap": overlap,
+            "a2a_plan": splan.encode(), "ticks": sched.ticks,
+        },
+        "parity_rel_err": parity_rel,
+        "parity_tol": tol,
+        "bubble_fraction": round(sched.bubble_fraction, 6),
+        "bubble_hidden_fraction": round(exp_filled / max(1, cap), 6),
+        "bubble_hidden_bytes": wire.bubble_hidden_bytes,
+        "filled_ticks": wire.filled_ticks,
+        "fill_capacity_ticks": cap,
+        "fill_predicted_bytes": round(pred, 1),
+        "wire_bytes_ici": wire.ici_bytes,
+        "wire_bytes_dcn": wire.dcn_bytes,
+        "wire_bytes_pod": wire.pod_bytes,
+        "a2a_bytes": wire.a2a_bytes,
+        "a2a_calls": wire.a2a_calls,
+        "pp_send_bytes": wire.pp_bytes,
+        "pp_sends": wire.pp_sends,
+        "wire_ms": {
+            "predicted": round(priced["modeled_ms"], 4),
+            "predicted_total": round(priced["predicted_ms"], 4),
+            "modeled": round(a2a_ms_modeled, 4),
+            "model": priced["model"],
+        },
+        "metrics_snapshot": metrics_snapshot(
+            prefixes=("comm.", "step.", "moe.", "straggler.", "link.")),
     }
     print(json.dumps(result))
     return result
@@ -2572,9 +3012,11 @@ def main():
                     help="virtual stages per rank (interleaved-1F1B "
                          "degree; 1 = plain 1F1B chunking)")
     ap.add_argument("--pp-schedule", default="interleaved_1f1b",
-                    choices=["gpipe", "1f1b", "interleaved_1f1b"],
+                    choices=["gpipe", "1f1b", "interleaved_1f1b", "zb1"],
                     help="pipeline schedule family member "
-                         "(docs/pipeline.md)")
+                         "(docs/pipeline.md; zb1 = zero-bubble B/W "
+                         "split — the leg then A/Bs it against "
+                         "interleaved-1F1B on the same geometry)")
     ap.add_argument("--moe", type=int, default=0, metavar="EXPERTS",
                     help="MoE A/B leg (docs/moe.md): expert-parallel "
                          "top-k MoE over a dedicated hvd_ep mesh axis "
@@ -2805,8 +3247,8 @@ def main():
         if args.pp < 2:
             ap.error("--pp needs >= 2 stages")
         if args.serve or args.scaling or args.autotune or args.fused \
-                or args.zero or args.moe:
-            ap.error("--pp composes with --zero-stage/--quantized/"
+                or args.zero:
+            ap.error("--pp composes with --moe/--zero-stage/--quantized/"
                      "--overlap only (one A/B structure per run)")
         if args.pp_microbatches < 1:
             ap.error("--pp-microbatches must be >= 1")
@@ -2817,10 +3259,14 @@ def main():
         if args.moe < 2:
             ap.error("--moe needs >= 2 experts")
         if args.serve or args.scaling or args.autotune or args.fused \
-                or args.zero or args.zero_stage or args.overlap:
+                or args.zero:
+            ap.error("--moe composes with --quantized (and, with --pp, "
+                     "the combined 4-D leg) only")
+        if not args.pp and (args.zero_stage or args.overlap):
             ap.error("--moe composes with --quantized only (one A/B "
                      "structure per run; the EPxZeRO compose matrix is "
-                     "covered by tests/test_moe.py)")
+                     "covered by tests/test_moe.py — or use --pp S "
+                     "--moe E --zero-stage 3 for the combined 4-D leg)")
         if args.moe_topk < 1 or args.moe_topk > args.moe:
             ap.error(f"--moe-topk must be in 1..{args.moe}")
         if args.moe_capacity <= 0:
@@ -2871,9 +3317,12 @@ def main():
     for v in (mesh_shape or ()):
         mesh_world *= v
     # Under --pp the --mesh-shape names the DATA mesh; the hvd_pp axis
-    # multiplies it to cover the devices (docs/pipeline.md).
+    # multiplies it to cover the devices (docs/pipeline.md) — and the
+    # hvd_ep axis too on the combined 4-D leg (docs/parallelism.md).
     if args.pp:
         mesh_world *= args.pp
+        if args.moe:
+            mesh_world *= args.moe
     if mesh_shape is not None and mesh_world != len(devices):
         raise SystemExit(f"--mesh-shape {mesh_shape_str(mesh_shape)} "
                          f"does not cover {len(devices)} devices"
@@ -2896,6 +3345,12 @@ def main():
                  else "fused" if args.fused else "autotune")
         log(f"--{which}: emulating mesh_shape {mesh_shape} so the "
             f"collectives have a cross (DCN) hop")
+
+    if args.pp and args.moe:
+        run_pp4d(args, devices, platform,
+                 parse_mesh_shape(args.mesh_shape) if args.mesh_shape
+                 else None)
+        return
 
     if args.pp:
         run_pp(args, devices, platform,

@@ -69,7 +69,12 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     encoding's trailing `|svK/q8|fp` segment). from_dict/load stay
 #     tolerant of v9/v8 entries (serve fields default to the dead-knob
 #     0 / False values — the exact pre-v10 step).
-_CACHE_VERSION = 10
+# v11: zero-bubble pipelines (docs/pipeline.md) — TunedParams gains the
+#     pp_schedule family knob (tune_pp-gated; the plan encoding's
+#     optional `|zb1` segment riding the `|ppM/V` group). from_dict/load
+#     stay tolerant of v10/v9 entries (pp_schedule defaults to the
+#     dead-knob "interleaved_1f1b" value — the exact pre-v11 step).
+_CACHE_VERSION = 11
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a

@@ -75,8 +75,13 @@ _MAX_STREAMS_LOG = 2.0  # 2^2  = 4 bucket collectives in flight
 # kv_migrate_quantized (the int8+EF prefill→decode KV wire) — both
 # gated by tune_serve and dead (0 / False) in a training session,
 # where canonicalization collapses them to one trial.
-_DIMS = 13  # fusion, qblock, tree, zero, overlap, streams, fused,
-#             ppM, ppV, moeCap, moeQ, svK, svQ
+# v11 adds the pipeline schedule family (docs/pipeline.md):
+# pp_schedule ("interleaved_1f1b" vs the zero-bubble "zb1" B/W split) —
+# gated by tune_pp like the v8 pair and dead ("interleaved_1f1b") when
+# the session's step is not pipelined, where canonicalization
+# collapses it to one trial.
+_DIMS = 14  # fusion, qblock, tree, zero, overlap, streams, fused,
+#             ppM, ppV, moeCap, moeQ, svK, svQ, ppZb
 
 _MIN_PPM_LOG = 1.0   # 2^1 = 2 microbatches
 _MAX_PPM_LOG = 5.0   # 2^5 = 32 microbatches
@@ -99,12 +104,15 @@ _MAX_SPEC_K = 4      # speculative draft-window search box (0..4)
 # lacking the newer columns.
 # v10 appends the serving pair; read_log stays tolerant of v3..v9 logs
 # lacking the newer columns.
+# v11 appends the pipeline schedule family; read_log stays tolerant of
+# v3..v10 logs lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
               "overlap", "num_comm_streams", "fused",
               "pp_microbatches", "pp_interleave",
               "moe_capacity_factor", "moe_quantized",
               "spec_draft_k", "kv_migrate_quantized",
+              "pp_schedule",
               "score_steps_per_sec", "plan")
 
 
@@ -123,9 +131,13 @@ class TunedParams:
     num_comm_streams: int = 1
     fused: bool = False
     # Pipeline schedule pair (docs/pipeline.md): 0 / 1 = "not a
-    # pipelined step" — the canonical dead-knob values.
+    # pipelined step" — the canonical dead-knob values. pp_schedule
+    # picks the table family ("interleaved_1f1b" vs the zero-bubble
+    # "zb1" B/W split); "interleaved_1f1b" is also the canonical dead
+    # value when pp is off.
     pp_microbatches: int = 0
     pp_interleave: int = 1
+    pp_schedule: str = "interleaved_1f1b"
     # MoE routing pair (docs/moe.md): 0.0 / False = "not an MoE step" —
     # the canonical dead-knob values.
     moe_capacity_factor: float = 0.0
@@ -153,6 +165,7 @@ class TunedParams:
             "fused": bool(self.fused),
             "pp_microbatches": int(self.pp_microbatches),
             "pp_interleave": int(self.pp_interleave),
+            "pp_schedule": str(self.pp_schedule),
             "moe_capacity_factor": float(self.moe_capacity_factor),
             "moe_quantized": bool(self.moe_quantized),
             "spec_draft_k": int(self.spec_draft_k),
@@ -178,6 +191,8 @@ class TunedParams:
             fused=bool(d.get("fused", False)),
             pp_microbatches=int(d.get("pp_microbatches", 0) or 0),
             pp_interleave=int(d.get("pp_interleave", 1) or 1),
+            pp_schedule=str(d.get("pp_schedule", "interleaved_1f1b")
+                            or "interleaved_1f1b"),
             moe_capacity_factor=float(
                 d.get("moe_capacity_factor", 0.0) or 0.0),
             moe_quantized=bool(d.get("moe_quantized", False)),
@@ -204,6 +219,9 @@ class TunedParams:
             fused=getattr(config, "fused_kernels", False),
             pp_microbatches=getattr(config, "pp_microbatches", 0) or 0,
             pp_interleave=getattr(config, "pp_interleave", 1) or 1,
+            pp_schedule=str(getattr(config, "pp_schedule",
+                                    "interleaved_1f1b")
+                            or "interleaved_1f1b"),
             moe_capacity_factor=(
                 getattr(config, "moe_capacity_factor", 0.0)
                 if getattr(config, "moe_experts", 0) else 0.0),
@@ -385,6 +403,7 @@ class ParameterManager:
             0.75 if p.moe_quantized else 0.25,
             min(_MAX_SPEC_K, max(0, p.spec_draft_k)) / _MAX_SPEC_K,
             0.75 if p.kv_migrate_quantized else 0.25,
+            0.75 if p.pp_schedule == "zb1" else 0.25,
         )
 
     def _from_unit(self, u) -> TunedParams:
@@ -425,9 +444,14 @@ class ParameterManager:
             ppv = 1 << max(0, min(int(_MAX_PPV_LOG),
                                   round(u[8] * _MAX_PPV_LOG)))
             ppv = min(ppv, self.pp_max_interleave)
+            # Schedule family (v11): a relaxed boolean at the tail so
+            # pre-v11 unit tuples stay valid coordinates.
+            u13 = u[13] if len(u) > 13 else 0.25
+            pps = "zb1" if u13 >= 0.5 else "interleaved_1f1b"
         else:
             ppm = self.initial.pp_microbatches
             ppv = self.initial.pp_interleave
+            pps = self.initial.pp_schedule
         if self.tune_moe:
             # Quarter-snap inside the [1.0, 2.0] box: capacity is a
             # trace-time buffer shape, so the space is effectively
@@ -465,6 +489,7 @@ class ParameterManager:
             fused=fz,
             pp_microbatches=ppm,
             pp_interleave=ppv,
+            pp_schedule=pps,
             moe_capacity_factor=moe_cap,
             moe_quantized=moe_q,
             spec_draft_k=sv_k,
@@ -495,6 +520,7 @@ class ParameterManager:
             quant_block=d.get("quant_block", p.quant_block),
             pp_microbatches=d.get("pp_microbatches", 0),
             pp_interleave=d.get("pp_interleave", 1),
+            pp_schedule=d.get("pp_schedule", "interleaved_1f1b"),
             moe_capacity_factor=d.get("moe_capacity_factor", 0.0),
             moe_quantized=d.get("moe_quantized", False),
             spec_draft_k=d.get("spec_draft_k", 0),
@@ -557,6 +583,7 @@ class ParameterManager:
                             int(p.moe_quantized),
                             int(p.spec_draft_k),
                             int(p.kv_migrate_quantized),
+                            p.pp_schedule,
                             f"{score:.6g}",
                             self._plan_of(p)])
         self._log.flush()
@@ -576,7 +603,11 @@ class ParameterManager:
             self.best_score)
 
     def _sample_unit(self) -> Tuple[float, ...]:
-        u = [self._rng.next() for _ in range(_DIMS)]
+        # The v11 tail dim (pp_schedule) draws from the stream only
+        # when the pp pair is live, so pre-v11 seed trajectories — and
+        # any replayed logs — are unchanged for non-pipelined sessions.
+        u = [self._rng.next() for _ in range(_DIMS - 1)]
+        u.append(self._rng.next() if self.tune_pp else 0.25)
         if not self.tune_hierarchical:
             u[2] = 0.25
         if not self.tune_zero:
@@ -691,6 +722,8 @@ def read_log(path: str) -> List[dict]:
                 "spec_draft_k": int(rec.get("spec_draft_k", 0) or 0),
                 "kv_migrate_quantized": bool(
                     int(rec.get("kv_migrate_quantized", 0) or 0)),
+                "pp_schedule": str(rec.get("pp_schedule")
+                                   or "interleaved_1f1b"),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
             }
             enc = (rec.get("plan") or "").strip()

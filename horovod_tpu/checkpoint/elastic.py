@@ -117,6 +117,26 @@ class CheckpointedJaxState(JaxState):
                     f"back to the dense model (pp_split_chunks is a "
                     f"pure reshape), and re-split for the new stage "
                     f"count (docs/pipeline.md).")
+            # Expert-parallel geometry guard (docs/moe.md): expert
+            # leaves are laid out per ep GROUP (each rank holds
+            # E/ep_size experts) — resharding across expert-group
+            # counts would silently re-assign experts to the wrong
+            # groups, so fail loudly with the recovery recipe. A
+            # same-ep world resize falls through as above.
+            saved_ep = int((manifest.extra or {}).get("ep_size", 1)
+                           or 1)
+            cur_ep = basics.ep_size() if basics.is_initialized() else 1
+            if saved_ep != cur_ep:
+                raise ValueError(
+                    f"checkpoint step {manifest.step} was written on a "
+                    f"{saved_ep}-group expert-parallel mesh but this "
+                    f"process runs {cur_ep} groups: per-group expert "
+                    f"parameters do not reshard across expert-group "
+                    f"counts. Restore on a mesh with "
+                    f"ep_size={saved_ep}, merge the expert shards back "
+                    f"to the dense model (ep_stack_params is a pure "
+                    f"reshape), and re-split for the new group count "
+                    f"(docs/moe.md).")
             for key, value in tree.items():
                 if key in kwargs:
                     kwargs[key] = _reshard_value(
@@ -145,7 +165,10 @@ class CheckpointedJaxState(JaxState):
                                       if _jsonable(getattr(self, k))},
                               "pp_stages": (basics.pp_size()
                                             if basics.is_initialized()
-                                            else 1)})
+                                            else 1),
+                              "ep_size": (basics.ep_size()
+                                          if basics.is_initialized()
+                                          else 1)})
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Drain in-flight checkpoint writes (call before exiting)."""

@@ -135,16 +135,44 @@ def _build_mesh(
 
         devices = acquire_devices()
     devices = list(devices)
+    if (ep_size is not None and ep_size > 1
+            and pp_stages is not None and pp_stages > 1):
+        # 4-D composed mesh (docs/parallelism.md): (hvd_pp, hvd_ep,
+        # hvd_cross, hvd_local). The pp axis leads so consecutive
+        # stages sit a full (ep x data)-mesh apart — the inter-stage
+        # send still crosses the slowest link class present — and the
+        # ep axis nests inside a stage so expert dispatch/combine
+        # all-to-alls stay STAGE-LOCAL (an a2a must never mix tokens
+        # that belong to different pipeline stages). Data shards and
+        # gradient collectives stay on (cross, local) per (stage,
+        # expert-group) cell.
+        if mesh_shape is not None and len(mesh_shape) == 3:
+            raise ValueError(
+                "pp_stages x ep_size does not compose with a 3-level "
+                "(cross, local, pods) mesh_shape — the pp/ep axes take "
+                "the leading mesh dimensions the pod axis would use")
+        if mesh_shape is not None:
+            cross, local = mesh_shape
+        else:
+            if len(devices) % (pp_stages * ep_size):
+                raise ValueError(
+                    f"pp_stages {pp_stages} x ep_size {ep_size} does "
+                    f"not divide {len(devices)} devices")
+            cross, local = 1, len(devices) // (pp_stages * ep_size)
+        if pp_stages * ep_size * cross * local != len(devices):
+            raise ValueError(
+                f"pp_stages {pp_stages} x ep_size {ep_size} x "
+                f"mesh_shape ({cross}, {local}) does not cover "
+                f"{len(devices)} devices")
+        grid = np.array(devices, dtype=object).reshape(
+            pp_stages, ep_size, cross, local)
+        return Mesh(grid, (PP_AXIS, EP_AXIS, CROSS_AXIS, LOCAL_AXIS))
     if ep_size is not None and ep_size > 1:
         # Expert-parallel mesh (docs/moe.md): a leading hvd_ep axis of
         # expert groups over the (cross, local) data mesh — the same
         # leading-axis layout as the pipeline mesh, so consecutive ep
         # groups sit a full data-mesh apart and the dispatch/combine
         # all-to-all crosses the slowest link class present.
-        if pp_stages is not None and pp_stages > 1:
-            raise ValueError(
-                "ep_size does not compose with pp_stages yet — both take "
-                "the leading mesh dimension (EP x PP needs a 4-D mesh)")
         if mesh_shape is not None and len(mesh_shape) == 3:
             raise ValueError(
                 "ep_size does not compose with a 3-level "
@@ -605,9 +633,10 @@ def pod_size() -> int:
 def pp_size() -> int:
     """Number of pipeline stages: the leading ``hvd_pp`` mesh dim of a
     pipeline mesh (``init(pp_stages=...)`` / ``HOROVOD_PP_STAGES``),
-    else 1 (docs/pipeline.md)."""
+    else 1 (docs/pipeline.md). On the 4-D composed ``(pp, ep, cross,
+    local)`` mesh the pp axis still leads."""
     s = _require_init()
-    if (s.mesh is not None and s.mesh.devices.ndim == 3
+    if (s.mesh is not None and s.mesh.devices.ndim in (3, 4)
             and s.mesh.axis_names[0] == PP_AXIS):
         return int(s.mesh.devices.shape[0])
     return 1
@@ -616,11 +645,16 @@ def pp_size() -> int:
 def ep_size() -> int:
     """Number of expert-parallel groups: the leading ``hvd_ep`` mesh dim
     of an expert-parallel mesh (``init(ep_size=...)`` /
-    ``HOROVOD_EP_SIZE``), else 1 (docs/moe.md)."""
+    ``HOROVOD_EP_SIZE``), else 1 (docs/moe.md). On the 4-D composed
+    ``(pp, ep, cross, local)`` mesh the ep axis sits second, inside a
+    stage."""
     s = _require_init()
     if (s.mesh is not None and s.mesh.devices.ndim == 3
             and s.mesh.axis_names[0] == EP_AXIS):
         return int(s.mesh.devices.shape[0])
+    if (s.mesh is not None and s.mesh.devices.ndim == 4
+            and s.mesh.axis_names[1] == EP_AXIS):
+        return int(s.mesh.devices.shape[1])
     return 1
 
 
@@ -633,6 +667,10 @@ def data_mesh_shape() -> Tuple[int, ...]:
     shp = s.mesh.devices.shape
     if len(shp) == 2:
         return (int(shp[0]), int(shp[1]))
+    if len(shp) == 4:
+        # 4-D composed (pp, ep, cross, local) mesh: the data mesh is
+        # the trailing pair — one (stage, expert-group) cell.
+        return (int(shp[2]), int(shp[3]))
     if s.mesh.axis_names[0] in (PP_AXIS, EP_AXIS):
         return (int(shp[1]), int(shp[2]))
     return (int(shp[1]), int(shp[2]), int(shp[0]))
@@ -655,6 +693,13 @@ def mesh_geometry(mesh_shape=None, mesh=None) -> str:
         shp = mesh.devices.shape
         if len(shp) == 2:
             mesh_shape = tuple(int(v) for v in shp)
+        elif len(shp) == 4:
+            # 4-D composed mesh: the fingerprint is the per-cell DATA
+            # mesh plus the combined pp/ep marker — a winner tuned at
+            # one (stage, expert-group) geometry never warm-starts
+            # another (docs/parallelism.md).
+            mesh_shape = (int(shp[2]), int(shp[3]))
+            pp = f"pp{int(shp[0])}.ep{int(shp[1])}"
         elif mesh.axis_names[0] == PP_AXIS:
             # Pipeline mesh: the fingerprint is the DATA mesh plus an
             # explicit pp marker — a winner tuned at one stage count

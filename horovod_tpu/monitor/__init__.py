@@ -82,6 +82,7 @@ from .flight import (  # noqa: F401
 )
 from .straggler import (  # noqa: F401
     StragglerDetector,
+    record_pp_bubble,
     straggler_detector,
 )
 

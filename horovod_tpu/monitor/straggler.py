@@ -426,6 +426,40 @@ def observe_wire(hop: str, nbytes: float, measured_ms: float):
     return straggler_detector().observe_wire(hop, nbytes, measured_ms)
 
 
+def record_pp_bubble(idle_ticks: int, ticks: int, step_ms: float,
+                     filled_ticks: int = 0,
+                     detector: Optional[StragglerDetector] = None) -> float:
+    """Attribute this rank's pipeline bubble time to the ``pp_bubble``
+    phase (docs/pipeline.md).
+
+    The zero-bubble scheduler exposes its *measured* per-rank idle-tick
+    count (``PPSchedule.idle_ticks_per_rank``) and the step loop knows
+    how many of those ticks ZeRO-3 flights actually filled
+    (``comm.pp.filled_ticks``). A filled tick is wire work hidden in
+    the bubble, not lost time, so it must NOT be charged as bubble skew
+    — otherwise every rank that successfully overlaps looks like a
+    straggler relative to one that could not. This helper charges only
+    the *unfilled* remainder::
+
+        ms = step_ms * (idle_ticks - min(idle_ticks, filled_ticks)) / ticks
+
+    On a clean run the schedule's idle ticks are identical across ranks
+    (the table is geometry-determined), so the phase is rank-uniform
+    and detect() stays silent; genuine cross-rank skew — one rank's
+    flights starved so its bubbles went unfilled — surfaces as a
+    ``pp_bubble`` outlier with the usual median/MAD gate.
+
+    Returns the charged milliseconds (0.0 when fully filled).
+    """
+    d = detector or straggler_detector()
+    t = max(1, int(ticks))
+    idle = max(0, int(idle_ticks))
+    filled = min(idle, max(0, int(filled_ticks)))
+    ms = float(step_ms) * (idle - filled) / float(t)
+    d.record_phase("pp_bubble", ms)
+    return ms
+
+
 def _reset_for_tests() -> None:
     global _global
     with _global_lock:

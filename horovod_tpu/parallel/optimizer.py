@@ -59,6 +59,7 @@ state (docs/zero.md):
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, List, NamedTuple, Optional, Tuple
 
 import jax
@@ -689,12 +690,14 @@ def _validate_pp_knobs(pp_stages, pp_microbatches, pp_schedule,
                 f"pp_stages={pp_stages} disagrees with the live mesh's "
                 f"hvd_pp axis of {basics.pp_size()} stages — the stage "
                 f"count is mesh geometry (hvd.init(pp_stages=...))")
-        if pp_interleave > 1 and pp_schedule != "interleaved_1f1b":
+        if pp_interleave > 1 and pp_schedule not in ("interleaved_1f1b",
+                                                     "zb1"):
             raise ValueError(
                 f"pp_interleave={pp_interleave} needs "
-                f"pp_schedule='interleaved_1f1b'; {pp_schedule!r} does "
-                f"not interleave virtual stages")
-        if (pp_schedule == "interleaved_1f1b" and pp_interleave > 1
+                f"pp_schedule='interleaved_1f1b' or 'zb1'; "
+                f"{pp_schedule!r} does not interleave virtual stages")
+        if (pp_schedule in ("interleaved_1f1b", "zb1")
+                and pp_interleave > 1
                 and pp_microbatches and pp_microbatches % pp_stages):
             raise ValueError(
                 f"pp_microbatches={pp_microbatches} must divide by "
@@ -800,10 +803,11 @@ def _zero_worlds(axes) -> Tuple[int, int, bool]:
         return w, w, True
     if not basics.is_initialized():
         return 1, 1, False
-    # On a pipeline mesh the ZeRO world is the DATA world: each stage's
-    # shards split over (cross, local) only — exactly what the in-trace
-    # path resolves, since the hvd_pp axis is never a world axis.
-    plan_w = basics.size() // basics.pp_size()
+    # On a pipeline / expert-parallel / 4-D composed mesh the ZeRO
+    # world is the DATA world: each (stage, expert-group) cell's shards
+    # split over (cross, local) only — exactly what the in-trace path
+    # resolves, since hvd_pp/hvd_ep are never world axes.
+    plan_w = basics.size() // (basics.pp_size() * basics.ep_size())
     own_w = plan_w if basics._process_world() else 1
     return plan_w, own_w, False
 
@@ -1378,6 +1382,7 @@ def zero3_gather_params(
     axes=None,
     overlap: Optional[bool] = None,
     num_comm_streams: Optional[int] = None,
+    fill_sched=None,
 ):
     """Reassemble the full model pytree from stage-3 parameter shards —
     the just-in-time gather a ``zero_stage=3`` forward runs on.
@@ -1392,7 +1397,16 @@ def zero3_gather_params(
     already-gathered layers' compute. Host-side, on the GLOBAL shard
     form, this is a pure unpack (no wire) — the exact inverse of
     :func:`zero3_shard_params`. ``params_template`` supplies structure
-    and shapes only (``jax.ShapeDtypeStruct`` leaves work)."""
+    and shapes only (``jax.ShapeDtypeStruct`` leaves work).
+
+    ``fill_sched`` (a ``PPSchedule``) opens a T3-style
+    :func:`~horovod_tpu.plan.accounting.bubble_fill` window around the
+    streamed gathers: up to ``fill_sched.idle_ticks_per_rank`` bucket
+    flights are credited against the pipeline schedule's idle ticks
+    (``WireStats.bubble_hidden_bytes`` / ``comm.pp.filled_ticks``,
+    docs/pipeline.md). Accounting-only — the issue order is unchanged;
+    requires ``overlap`` (unstreamed gathers cannot be latency-hidden).
+    """
     tleaves, treedef = jax.tree.flatten(params_template)
     plan_world, own_world, in_trace = _zero_worlds(axes)
     plan = fusion.plan_buckets(tleaves, fusion_threshold_bytes,
@@ -1409,24 +1423,32 @@ def zero3_gather_params(
     if not overlap_on:
         flight = 1
     eager_local = (not in_trace) and own_world == 1
+    fill_ctx = contextlib.nullcontext()
+    if fill_sched is not None and overlap_on and not eager_local:
+        from ..plan import accounting as _acct_mod
+
+        fill_ctx = _acct_mod.bubble_fill(fill_sched.idle_ticks_per_rank,
+                                         kind="zero3.ag")
     uleaves: List[Any] = [None] * len(tleaves)
-    for s in range(0, len(order), flight):
-        issued = []
-        for i in order[s:s + flight]:
-            if eager_local:
-                full = shards[i]  # global form already
-            elif overlap_on:
-                full = C.all_gather_stream(shards[i], bucket_id=i,
-                                           axes=axes)
-            else:
-                full = C.all_gather(shards[i], axes=axes)
-            issued.append((i, full))
-        # Unpack AFTER the whole flight is issued (ops/fusion.py flight
-        # contract): no consumer sits between in-flight gathers.
-        for i, full in issued:
-            for j, leaf in zip(plan[i].leaf_indices,
-                               fusion.unpack(plan[i], full)):
-                uleaves[j] = leaf
+    with fill_ctx:
+        for s in range(0, len(order), flight):
+            issued = []
+            for i in order[s:s + flight]:
+                if eager_local:
+                    full = shards[i]  # global form already
+                elif overlap_on:
+                    full = C.all_gather_stream(shards[i], bucket_id=i,
+                                               axes=axes)
+                else:
+                    full = C.all_gather(shards[i], axes=axes)
+                issued.append((i, full))
+            # Unpack AFTER the whole flight is issued (ops/fusion.py
+            # flight contract): no consumer sits between in-flight
+            # gathers.
+            for i, full in issued:
+                for j, leaf in zip(plan[i].leaf_indices,
+                                   fusion.unpack(plan[i], full)):
+                    uleaves[j] = leaf
     return jax.tree.unflatten(treedef, uleaves)
 
 

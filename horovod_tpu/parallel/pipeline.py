@@ -598,7 +598,24 @@ def pipelined_gpt_train_1f1b(cfg, stage_params, rest, tokens, targets, *,
 # schedule — including every stash slot — is STATIC tables the SPMD scan
 # body indexes with the traced rank. Bubble fraction falls from GPipe's
 # (S-1)/(M+S-1) to ~(S-1)/(Mv+S-1): the interleave divides the fill.
+#
+# The ZERO-BUBBLE family (``family="zb1"``, ZB-H1 of arXiv 2401.10241,
+# docs/pipeline.md): the backward splits into a dx unit **B** (the
+# input-cotangent half — the only part the upstream stage waits on; it
+# stays on the critical path and keeps the 1F1B placement) and a dw unit
+# **W** (the weight-cotangent half — consumed by nobody downstream, so
+# it is DEFERRED into the cooldown/idle ticks after its B). Each unit is
+# one vjp half instead of the fused dx+dw vjp, so the per-tick compute
+# shrinks while the busy fraction of the rank x tick grid rises: the
+# measured ``bubble_fraction`` (idle issue slots / grid) drops strictly
+# below the interleaved-1F1B bound on the same (S, M, v). The remaining
+# idle ticks are enumerated per rank in ``fill_ticks`` — the T3-style
+# fill capacity the ZeRO-3 bucket flights are credited against
+# (``plan/accounting.bubble_fill``).
 # ---------------------------------------------------------------------------
+
+#: Schedule-table families build_interleaved_schedule can simulate.
+PP_TABLE_FAMILIES = ("1f1b", "zb1")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -635,17 +652,53 @@ class PPSchedule:
     # arrival routing: where this tick's incoming ppermute values land
     arr_a: np.ndarray
     arr_g: np.ndarray
+    # schedule family: "1f1b" (fused dx+dw backward) or "zb1" (ZB-H1
+    # B/W split — the W tables below are live only for zb1)
+    family: str = "1f1b"
+    # weight-grad unit (zb1): valid, microbatch, local chunk, stashed
+    # act slot (-1 = x_mbs), grad slot to read (-1 = read dy), dy slot
+    w_valid: Optional[np.ndarray] = None
+    w_m: Optional[np.ndarray] = None
+    w_j: Optional[np.ndarray] = None
+    w_src: Optional[np.ndarray] = None
+    w_g: Optional[np.ndarray] = None
+    w_dy: Optional[np.ndarray] = None
+    # fill_ticks[r, t] = k if tick t is rank r's k-th idle tick (no
+    # F/B/W unit), else -1 — the T3 bubble-fill capacity table
+    # (docs/pipeline.md): idle counts are rank-uniform by construction.
+    fill_ticks: Optional[np.ndarray] = None
 
     @property
     def bubble_fraction(self) -> float:
         """Idle fraction of the rank x tick grid — the measured bubble
         (each tick is one chunk-unit of compute; garbage masked units in
-        the bubble cost the same wall time as real ones under SPMD)."""
-        busy = int(self.f_valid.sum() + self.b_valid.sum())
-        return 1.0 - busy / float(self.stages * self.ticks)
+        the bubble cost the same wall time as real ones under SPMD).
+        Under zb1 a unit is one vjp HALF (dx-only B or dw-only W), so
+        the grid is finer and the idle fraction strictly smaller than
+        the fused-backward 1f1b grid on the same (S, M, v)."""
+        return 1.0 - self.unit_count() / float(self.stages * self.ticks)
 
     def unit_count(self) -> int:
-        return int(self.f_valid.sum() + self.b_valid.sum())
+        busy = int(self.f_valid.sum() + self.b_valid.sum())
+        if self.w_valid is not None:
+            busy += int(self.w_valid.sum())
+        return busy
+
+    @property
+    def units_per_rank(self) -> int:
+        """Compute units per rank: Mv forwards + Mv backwards, plus Mv
+        deferred W units under zb1. Exact on every rank (the streams
+        pump every microbatch through every local chunk)."""
+        per = 2 * self.microbatches * self.interleave
+        if self.family == "zb1":
+            per += self.microbatches * self.interleave
+        return per
+
+    @property
+    def idle_ticks_per_rank(self) -> int:
+        """Per-rank bubble capacity in ticks — the T3 fill budget
+        (rank-uniform: every rank runs exactly ``units_per_rank``)."""
+        return self.ticks - self.units_per_rank
 
 
 def _interleaved_streams(M: int, n: int, v: int) -> List[List[tuple]]:
@@ -716,9 +769,18 @@ def _alloc_slots(intervals: List[tuple]) -> Tuple[dict, int]:
     return slot_of, n_slots
 
 
-def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
+def build_interleaved_schedule(M: int, n: int, v: int = 1,
+                               family: str = "1f1b") -> PPSchedule:
     """Simulate the interleaved-1F1B streams under the 1-tick hop
     latency and freeze the result as static tables (docs/pipeline.md).
+
+    ``family="zb1"`` runs the SAME simulation for F and B (B stays on
+    the critical path: its dx is what the upstream rank waits on), then
+    places each deferred W(m, c) unit greedily in the earliest idle
+    tick of its rank strictly after B(m, c) — extending the tick count
+    when the cooldown overflows — and re-allocates the stash pools with
+    the W-extended lifetimes (W re-reads the stashed activation and
+    incoming grad AFTER B consumed them).
 
     Requires ``M % n == 0`` when ``v > 1`` (the Megatron grouping the
     forward/backward unit order is built from)."""
@@ -726,6 +788,10 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
         raise ValueError("build_interleaved_schedule needs >= 2 stages")
     if v < 1:
         raise ValueError(f"interleave must be >= 1, got {v}")
+    if family not in PP_TABLE_FAMILIES:
+        raise ValueError(
+            f"unknown schedule family {family!r}: expected one of "
+            f"{PP_TABLE_FAMILIES}")
     if v > 1 and M % n:
         raise ValueError(
             f"interleaved-1F1B needs microbatches ({M}) divisible by "
@@ -764,20 +830,41 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
         t += 1
     T = t
 
+    # --- zb1 W-unit placement (ZB-H1): each W(m, c) lands in the
+    # earliest idle tick of its rank strictly after B(m, c), in done_b
+    # order (greedy; extends T when the cooldown overflows) ------------
+    done_w: dict = {}
+    if family == "zb1":
+        for r in range(n):
+            busy_t = {tick for tick, _ in exec_at[r]}
+            for tb, m, c in sorted((done_b[(m, c)], m, c)
+                                   for m in range(M)
+                                   for c in range(r, K, n)):
+                tw = tb + 1
+                while tw in busy_t:
+                    tw += 1
+                busy_t.add(tw)
+                done_w[(m, c)] = tw
+                exec_at[r].append((tw, ("W", m, c // n, c)))
+        T = max(T, max(done_w.values()) + 1)
+
     # --- stash slot allocation (per pool, shared across ranks so the
-    # tables index one pool shape) -------------------------------------
+    # tables index one pool shape). Under zb1 the stashed activation
+    # and incoming grad outlive B: W re-reads both, so every lifetime
+    # extends to done_w. -----------------------------------------------
     act_iv, grad_iv, dy_iv = [], [], []
     for m in range(M):
         for c in range(K):
             tf, tb = done_f[(m, c)], done_b[(m, c)]
+            te = done_w.get((m, c), tb)
             if c > 0:
                 ta = done_f[(m, c - 1)] + 1
-                act_iv.append(((m, c), ta, tb))
+                act_iv.append(((m, c), ta, te))
             if c < K - 1:
                 ta = done_b[(m, c + 1)] + 1
-                grad_iv.append(((m, c), ta, tb))
+                grad_iv.append(((m, c), ta, te))
             else:
-                dy_iv.append(((m, c), tf, tb))
+                dy_iv.append(((m, c), tf, te))
     act_slot, n_act = _alloc_slots(act_iv)
     grad_slot, n_grad = _alloc_slots(grad_iv)
     dy_slot, n_dy = _alloc_slots(dy_iv)
@@ -787,6 +874,8 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
                              full(-1))
     bv, bm, bj, bsrc, bg, bdy = (full(0), full(0), full(0), full(-1),
                                  full(-1), full(-1))
+    wv, wm_, wj_, wsrc, wg, wdy = (full(0), full(0), full(0), full(-1),
+                                   full(-1), full(-1))
     arr_a, arr_g = full(-1), full(-1)
     for r in range(n):
         for tick, (kind, m, j, c) in exec_at[r]:
@@ -796,7 +885,7 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
                     fsrc[r, tick] = act_slot[(m, c)]
                 if c == K - 1:
                     fdy[r, tick] = dy_slot[(m, c)]
-            else:
+            elif kind == "B":
                 bv[r, tick], bm[r, tick], bj[r, tick] = 1, m, j
                 if c > 0:
                     bsrc[r, tick] = act_slot[(m, c)]
@@ -804,6 +893,14 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
                     bdy[r, tick] = dy_slot[(m, c)]
                 else:
                     bg[r, tick] = grad_slot[(m, c)]
+            else:  # W (zb1): same stash reads as B, one tick later
+                wv[r, tick], wm_[r, tick], wj_[r, tick] = 1, m, j
+                if c > 0:
+                    wsrc[r, tick] = act_slot[(m, c)]
+                if c == K - 1:
+                    wdy[r, tick] = dy_slot[(m, c)]
+                else:
+                    wg[r, tick] = grad_slot[(m, c)]
             # Arrival routing at the CONSUMER: the up hop of F(m, c)
             # lands the activation of chunk c+1 on rank (r+1) % n one
             # tick later; the down hop of B(m, c) lands the grad of
@@ -812,13 +909,30 @@ def build_interleaved_schedule(M: int, n: int, v: int = 1) -> PPSchedule:
                 arr_a[(r + 1) % n, tick + 1] = act_slot[(m, c + 1)]
             if kind == "B" and c > 0 and tick + 1 < T:
                 arr_g[(r - 1) % n, tick + 1] = grad_slot[(m, c - 1)]
+
+    # Idle-tick enumeration: the T3 fill capacity table. Rank-uniform
+    # by construction (every rank runs exactly units_per_rank units).
+    fill = full(-1)
+    for r in range(n):
+        busy_t = {tick for tick, _ in exec_at[r]}
+        k = 0
+        for tick in range(T):
+            if tick not in busy_t:
+                fill[r, tick] = k
+                k += 1
+
+    zb = family == "zb1"
     return PPSchedule(
         stages=n, interleave=v, microbatches=M, ticks=T,
         act_slots=max(1, n_act), grad_slots=max(1, n_grad),
         dy_slots=max(1, n_dy),
         f_valid=fv, f_m=fm, f_j=fj, f_src=fsrc, f_dy=fdy,
         b_valid=bv, b_m=bm, b_j=bj, b_src=bsrc, b_g=bg, b_dy=bdy,
-        arr_a=arr_a, arr_g=arr_g)
+        arr_a=arr_a, arr_g=arr_g, family=family,
+        w_valid=wv if zb else None, w_m=wm_ if zb else None,
+        w_j=wj_ if zb else None, w_src=wsrc if zb else None,
+        w_g=wg if zb else None, w_dy=wdy if zb else None,
+        fill_ticks=fill)
 
 
 def emit_schedule_spans(sched: PPSchedule) -> None:
@@ -835,6 +949,8 @@ def emit_schedule_spans(sched: PPSchedule) -> None:
     tl.instant("PP:SCHEDULE", tid="pp", args={
         "stages": sched.stages, "interleave": sched.interleave,
         "microbatches": sched.microbatches, "ticks": sched.ticks,
+        "family": sched.family,
+        "idle_ticks": sched.idle_ticks_per_rank,
         "bubble_fraction": round(sched.bubble_fraction, 6)})
     for r in range(sched.stages):
         tid = f"pp-rank{r}"
@@ -845,6 +961,9 @@ def emit_schedule_spans(sched: PPSchedule) -> None:
             if sched.b_valid[r, t]:
                 tl.begin(tid, "PP:B")
                 tl.end(tid, "PP:B")
+            if sched.w_valid is not None and sched.w_valid[r, t]:
+                tl.begin(tid, "PP:W")
+                tl.end(tid, "PP:W")
 
 
 def pp_split_chunks(params, n: int, v: int = 1):
@@ -881,7 +1000,8 @@ def pp_split_chunks(params, n: int, v: int = 1):
 
 def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
                      tgt_mbs, *, axis, interleave: int = 1,
-                     send_plan=None, sched: Optional[PPSchedule] = None):
+                     send_plan=None, sched: Optional[PPSchedule] = None,
+                     family: str = "1f1b"):
     """Interleaved-1F1B pipeline: loss + gradients in one fused pass,
     bubble ~``(S-1)/(Mv+S-1)`` vs GPipe's ``(S-1)/(M+S-1)``.
 
@@ -908,13 +1028,14 @@ def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
     from ..plan.accounting import pp_span
 
     if sched is None:
-        sched = build_interleaved_schedule(M, n, v)
+        sched = build_interleaved_schedule(M, n, v, family=family)
     if sched.microbatches != M or sched.stages != n \
             or sched.interleave != v:
         raise ValueError(
             f"schedule is ({sched.microbatches} microbatches, "
             f"{sched.stages} stages, x{sched.interleave}), step wants "
             f"({M}, {n}, x{v})")
+    zb = sched.family == "zb1"   # host-level: the 1f1b trace is unchanged
     if send_plan is None:
         send_plan = _send_plan_for_axis(axis)
     splan = send_plan.validate()
@@ -935,10 +1056,12 @@ def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
     def vary(tree):
         return _pvary_tree(tree, axes_t)
 
-    tables = {k: jnp.asarray(getattr(sched, k)) for k in (
-        "f_valid", "f_m", "f_j", "f_src", "f_dy",
-        "b_valid", "b_m", "b_j", "b_src", "b_g", "b_dy",
-        "arr_a", "arr_g")}
+    table_keys = ["f_valid", "f_m", "f_j", "f_src", "f_dy",
+                  "b_valid", "b_m", "b_j", "b_src", "b_g", "b_dy",
+                  "arr_a", "arr_g"]
+    if zb:
+        table_keys += ["w_valid", "w_m", "w_j", "w_src", "w_g", "w_dy"]
+    tables = {k: jnp.asarray(getattr(sched, k)) for k in table_keys}
 
     mb_shape = x_mbs.shape[1:]
     zmb = pvary_missing(jnp.zeros(mb_shape, x_mbs.dtype), axes_t)
@@ -986,22 +1109,61 @@ def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
             bsrc >= 0,
             apool[jnp.clip(bsrc, 0, sched.act_slots - 1)],
             x_mbs[bm])
-        _, chunk_vjp = jax.vjp(
-            lambda p, x: stage_fn(jax.tree.map(lambda a: a[bj], p), x),
-            vary(chunk_params), x_saved)
         bdy = at(tables["b_dy"])
         bgs = at(tables["b_g"])
         gy = jnp.where(
             bdy >= 0,
             dypool[jnp.clip(bdy, 0, sched.dy_slots - 1)],
             gpool[jnp.clip(bgs, 0, sched.grad_slots - 1)])
-        g_cp, gx = chunk_vjp(gy.astype(x_saved.dtype))
-        d_cp = jax.tree.map(
-            lambda acc, g: acc + jnp.where(b_on, g, 0.0).astype(
-                acc.dtype), d_cp, g_cp)
+        if zb:
+            # zb1 B unit: the dx HALF only — params are closed over, so
+            # the transpose never forms their cotangent (that is the
+            # deferred W unit's tick).
+            _, x_vjp = jax.vjp(
+                lambda x: stage_fn(
+                    jax.tree.map(lambda a: a[bj], chunk_params), x),
+                x_saved)
+            (gx,) = x_vjp(gy.astype(x_saved.dtype))
+        else:
+            _, chunk_vjp = jax.vjp(
+                lambda p, x: stage_fn(
+                    jax.tree.map(lambda a: a[bj], p), x),
+                vary(chunk_params), x_saved)
+            g_cp, gx = chunk_vjp(gy.astype(x_saved.dtype))
+            d_cp = jax.tree.map(
+                lambda acc, g: acc + jnp.where(b_on, g, 0.0).astype(
+                    acc.dtype), d_cp, g_cp)
         write_dx = jnp.logical_and(b_on, bsrc < 0)  # chunk 0 <=> rank 0
         d_x = d_x.at[bm].set(
             jnp.where(write_dx, gx.astype(jnp.float32), d_x[bm]))
+
+        # -- zb1 W unit: the deferred dw HALF — re-reads the stashed
+        # activation and incoming grad B left alive (the builder
+        # extended both lifetimes to done_w) and forms ONLY the param
+        # cotangent.
+        if zb:
+            w_on = at(tables["w_valid"]) > 0
+            wm = jnp.clip(at(tables["w_m"]), 0, M - 1)
+            wj = at(tables["w_j"])
+            wsrc = at(tables["w_src"])
+            x_w = jnp.where(
+                wsrc >= 0,
+                apool[jnp.clip(wsrc, 0, sched.act_slots - 1)],
+                x_mbs[wm])
+            wdy = at(tables["w_dy"])
+            wgs = at(tables["w_g"])
+            gy_w = jnp.where(
+                wdy >= 0,
+                dypool[jnp.clip(wdy, 0, sched.dy_slots - 1)],
+                gpool[jnp.clip(wgs, 0, sched.grad_slots - 1)])
+            _, w_vjp = jax.vjp(
+                lambda p: stage_fn(
+                    jax.tree.map(lambda a: a[wj], p), x_w),
+                vary(chunk_params))
+            (g_cp_w,) = w_vjp(gy_w.astype(x_w.dtype))
+            d_cp = jax.tree.map(
+                lambda acc, g: acc + jnp.where(w_on, g, 0.0).astype(
+                    acc.dtype), d_cp, g_cp_w)
 
         # -- forward unit ----------------------------------------------
         f_on = at(tables["f_valid"]) > 0
@@ -1055,8 +1217,9 @@ def interleaved_1f1b(stage_fn, loss_fn, chunk_params, head_params, x_mbs,
 # The schedule family (docs/pipeline.md): gpipe is the autodiff baseline,
 # 1f1b the O(depth)-memory hand schedule, interleaved_1f1b the
 # production schedule (1f1b == interleaved with v pinned to 1; the
-# explicit name keeps the baseline selectable).
-PP_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
+# explicit name keeps the baseline selectable), zb1 the ZB-H1
+# zero-bubble variant of interleaved_1f1b (B/W backward split).
+PP_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b", "zb1")
 
 
 def pipelined_gpt_train(cfg, chunk_params, rest, tokens, targets, *,
@@ -1139,7 +1302,8 @@ def pipelined_gpt_train(cfg, chunk_params, rest, tokens, targets, *,
     else:
         loss, g_cp, g_hp, d_x = interleaved_1f1b(
             stage_fn, loss_fn, chunk_params, hp, x_mbs, tgt_mbs,
-            axis=axis, interleave=v, send_plan=send_plan)
+            axis=axis, interleave=v, send_plan=send_plan,
+            family="zb1" if schedule == "zb1" else "1f1b")
 
     (g_ep,) = embed_vjp(d_x.reshape(B, T, -1).astype(x.dtype))
     g_rest = {

@@ -75,6 +75,16 @@ class WireStats:
         self.a2a_bytes = 0.0
         self.a2a_bytes_fp = 0.0
         self.a2a_calls = 0
+        # T3-style pipeline-bubble filling (docs/pipeline.md): bytes of
+        # streamed bucket collectives issued inside a ``bubble_fill``
+        # window — ZeRO-3 forward-order gathers / grad reduce-scatters
+        # positioned so the latency-hiding scheduler runs them in the
+        # schedule's idle ticks. A subset of ``overlap_bytes`` (a filled
+        # flight is still overlap-scheduled); ``filled_ticks`` counts
+        # how many of the schedule's idle ticks took a flight, capped at
+        # the PPSchedule's per-rank idle-tick capacity.
+        self.bubble_hidden_bytes = 0.0
+        self.filled_ticks = 0
         # Serving KV-migration wire (docs/serving.md): bytes moved by
         # kv_migrate send legs — prefill→decode page handoffs between
         # replica groups. Same double-charging discipline as the
@@ -143,6 +153,8 @@ def _publish_wire_stats(ws: "WireStats") -> None:
     r.gauge("comm.wire.fused_hbm_saved_bytes").set(ws.fused_hbm_saved_bytes)
     r.gauge("comm.wire.pp_bytes").set(ws.pp_bytes)
     r.gauge("comm.wire.pp_sends").set(ws.pp_sends)
+    r.gauge("comm.wire.bubble_hidden_bytes").set(ws.bubble_hidden_bytes)
+    r.gauge("comm.wire.filled_ticks").set(ws.filled_ticks)
     r.gauge("comm.wire.a2a_bytes").set(ws.a2a_bytes)
     r.gauge("comm.wire.a2a_calls").set(ws.a2a_calls)
     r.gauge("comm.wire.kv_bytes").set(ws.kv_bytes)
@@ -201,12 +213,80 @@ def modeled_wire_ms(ici_bytes: float, dcn_bytes: float,
 _modeled_wire_ms = modeled_wire_ms
 
 
+# Active bubble-fill windows (docs/pipeline.md): a stack because
+# nesting is legal (an inner window narrows the budget). Each entry is
+# a mutable dict: remaining fill capacity in ticks, flights credited,
+# bytes credited, and the window's label.
+_fill_windows: list = []
+
+
+@contextlib.contextmanager
+def bubble_fill(capacity_ticks: int, kind: str = "zero3"):
+    """T3-style pipeline-bubble fill window (docs/pipeline.md).
+
+    While the window is active, every streamed bucket collective that
+    closes (:func:`overlap_stream` — the ZeRO-3 forward-order
+    ``all_gather_stream`` flights, the grad reduce-scatter flights) is
+    ADDITIONALLY credited as bubble-filled: one flight consumes one of
+    the schedule's idle ticks (``PPSchedule.idle_ticks_per_rank`` — the
+    fill capacity is rank-uniform by construction), its bytes land on
+    ``WireStats.bubble_hidden_bytes``, and the ``comm.pp.filled_ticks``
+    / ``comm.pp.bubble_hidden_bytes`` counters bump. Flights beyond the
+    capacity get NO credit — the bubble cannot hide more flights than
+    it has ticks.
+
+    Trace-time only, like all accounting here: the wrapped collectives
+    are issued uniformly on every rank (SPMD collectives cannot be
+    per-rank-conditional), positioned adjacent to the schedule scan so
+    the latency-hiding scheduler runs them in the idle ticks; this
+    window is the accounting contract that prices the placement.
+    Yields the window record so callers can read ``filled``/``bytes``.
+    """
+    tl = basics._state.timeline if basics.is_initialized() else None
+    activity = "PP:FILL"
+    win = {"remaining": max(0, int(capacity_ticks)), "filled": 0,
+           "bytes": 0.0, "kind": str(kind)}
+    _fill_windows.append(win)
+    if tl is not None:
+        tl.begin("pp", activity)
+    try:
+        yield win
+    finally:
+        _fill_windows.remove(win)
+        if tl is not None:
+            tl.end("pp", activity)
+
+
+def _credit_bubble_fill(delta: float, outer: list) -> None:
+    """One streamed flight closed under an active fill window: consume
+    an idle tick and credit its bytes as bubble-hidden (every window on
+    the stack narrows independently, so nested budgets both count)."""
+    credited = False
+    for win in _fill_windows:
+        if win["remaining"] > 0:
+            win["remaining"] -= 1
+            win["filled"] += 1
+            win["bytes"] += delta
+            credited = True
+            if _metrics.metrics_enabled():
+                _metrics.counter("comm.pp.filled_ticks",
+                                 kind=win["kind"]).inc()
+                _metrics.counter("comm.pp.bubble_hidden_bytes",
+                                 kind=win["kind"]).inc(delta)
+    if credited:
+        for ws in outer:
+            ws.bubble_hidden_bytes += delta
+            ws.filled_ticks += 1
+
+
 @contextlib.contextmanager
 def overlap_stream(kind: str, bucket_id):
     """Bracket one streamed bucket collective: emit an ``OVERLAP:<kind>``
     timeline span (host trace time), account the bytes the wrapped
     collective records as overlap-scheduled, and feed the per-bucket
-    bytes / modeled-latency histograms of the metrics registry."""
+    bytes / modeled-latency histograms of the metrics registry. Inside
+    an active :func:`bubble_fill` window the closing flight is also
+    credited against the pipeline bubble's idle-tick budget."""
     tl = basics._state.timeline if basics.is_initialized() else None
     tid = f"bucket{bucket_id}"
     activity = f"OVERLAP:{kind}"
@@ -223,6 +303,8 @@ def overlap_stream(kind: str, bucket_id):
         for ws in outer:
             ws.overlap_bytes += delta
             ws.streamed_buckets += 1
+        if _fill_windows:
+            _credit_bubble_fill(delta, outer)
         if _metrics.metrics_enabled():
             r = _metrics.default_registry()
             r.counter("comm.streamed_buckets", kind=kind).inc()

@@ -224,6 +224,11 @@ class StepCost:
     # compute_ms is known — zero with pp off.
     pp_ms: float = 0.0
     pp_bubble_ms: float = 0.0
+    # T3 bubble-fill credit (docs/pipeline.md): streamed ZeRO wire the
+    # schedule's idle ticks absorb — the bubble is busy moving bytes
+    # instead of idling, so the step does not pay both. Bounded by
+    # pp_bubble_ms at construction; zero without pp + ZeRO-3 + overlap.
+    pp_fill_ms: float = 0.0
     # MoE term (docs/moe.md): the expert dispatch/combine a2a wire (2
     # issues per MoE layer of a capacity-factor-scaled buffer) — zero
     # with MoE off.
@@ -236,7 +241,7 @@ class StepCost:
     @property
     def predicted_ms(self) -> float:
         return (self.sync_ms - self.hidden_ms + self.pp_ms
-                + self.pp_bubble_ms + self.moe_ms)
+                + self.pp_bubble_ms - self.pp_fill_ms + self.moe_ms)
 
     def as_dict(self) -> dict:
         return {
@@ -248,6 +253,7 @@ class StepCost:
             "hidden_ms": round(self.hidden_ms, 6),
             "pp_ms": round(self.pp_ms, 6),
             "pp_bubble_ms": round(self.pp_bubble_ms, 6),
+            "pp_fill_ms": round(self.pp_fill_ms, 6),
             "moe_ms": round(self.moe_ms, 6),
             "buckets": self.buckets,
             "model": self.source,
@@ -362,6 +368,7 @@ def price_step(step_plan, payload_bytes: float, *,
         moe_ms = mpc.total_ms * 2
     pp_ms = 0.0
     pp_bubble_ms = 0.0
+    pp_fill_ms = 0.0
     send = getattr(step_plan, "send", None)
     stages = int(getattr(step_plan, "pp_stages", 0) or 0)
     if send is not None and stages > 1:
@@ -378,14 +385,41 @@ def price_step(step_plan, payload_bytes: float, *,
         ticks = 2 * M * v + 2 * (stages - 1)
         pp_ms = spc.total_ms * ticks
         if compute_ms is not None:
-            bf = (stages - 1) / (M * v + stages - 1)
+            sched_name = str(getattr(step_plan, "pp_schedule", "") or "")
+            if sched_name == "zb1":
+                # Zero-bubble: the analytic interleaved bound no longer
+                # applies — price the EXACT measured bubble of the zb
+                # tables (the same builder the step executes).
+                from ..parallel import pipeline as _pipeline  # lazy: cycle
+
+                try:
+                    bf = _pipeline.build_interleaved_schedule(
+                        M, stages, v, family="zb1").bubble_fraction
+                except ValueError:
+                    # un-buildable geometry (e.g. M % S with v > 1):
+                    # fall back to the analytic interleaved bound
+                    bf = (stages - 1) / (M * v + stages - 1)
+            else:
+                bf = (stages - 1) / (M * v + stages - 1)
             pp_bubble_ms = float(compute_ms) * bf / max(1e-9, 1.0 - bf)
+            # T3 fill credit (docs/pipeline.md): with ZeRO-3 + overlap
+            # the forward-order bucket gathers issue into the bubble's
+            # idle ticks, so the streamed wire NOT already hidden under
+            # backward compute is absorbed by the bubble instead —
+            # capped at the bubble itself (it cannot hide more wire
+            # than it has idle time).
+            if (int(getattr(step_plan, "zero_stage", 0) or 0) >= 3
+                    and step_plan.overlap
+                    and getattr(step_plan, "gather", None) is not None):
+                pp_fill_ms = min(pp_bubble_ms,
+                                 max(0.0, wire_ms - hidden_ms))
     return StepCost(plan_costs=plan_costs, buckets=buckets,
                     flights=flights, wire_ms=wire_ms,
                     modeled_ms=modeled_ms, alpha_ms=alpha_ms,
                     quant_ms=quant_ms, hidden_ms=hidden_ms,
                     source=model.source, pp_ms=pp_ms,
-                    pp_bubble_ms=pp_bubble_ms, moe_ms=moe_ms)
+                    pp_bubble_ms=pp_bubble_ms, pp_fill_ms=pp_fill_ms,
+                    moe_ms=moe_ms)
 
 
 def price_a2a(plan: ir.WirePlan, payload_bytes: float, *,

@@ -1038,7 +1038,7 @@ def describe_plan(
 _PLAN_RE = re.compile(
     r"^(?P<grad>ar\.flat|ar\.tree|rs\+ag\.z[123])\|"
     r"(?P<wire>fp|int8/\d+)\|s(?P<streams>\d+)\|(?P<sched>sync|ovl)"
-    r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+))?"
+    r"(?P<fused>\|pl)?(\|pp(?P<ppm>\d+)/(?P<ppv>\d+)(?P<ppzb>\|zb1)?)?"
     r"(\|moe(?P<moecap>[0-9.]+)/(?P<moeq>q8|fp))?"
     r"(\|sv(?P<svk>\d+)/(?P<svq>q8|fp))?$")
 
@@ -1080,6 +1080,12 @@ def encode_tuned(params, *, quantized: bool = False,
         m = int(getattr(params, "pp_microbatches", 0) or 0)
         v = max(1, int(getattr(params, "pp_interleave", 1) or 1))
         enc += f"|pp{m}/{v}"
+        # Schema v11 (docs/pipeline.md): the zero-bubble family marker —
+        # present only when the tuned schedule is zb1 (so every v10
+        # encoding is also a valid v11 encoding); with pp off the
+        # schedule is a dead knob and collapses to interleaved-1F1B.
+        if str(getattr(params, "pp_schedule", "") or "") == "zb1":
+            enc += "|zb1"
     if moe:
         # Schema v9 (docs/moe.md): the MoE routing knobs — dispatch
         # capacity factor / a2a wire dtype — join the plan encoding only
@@ -1180,9 +1186,16 @@ def enumerate_tuned(*, quantized: bool = False,
                              if initial.pp_microbatches else set()))
         ppv_opts = sorted({v for v in (1, 2, 4)
                            if v <= max(1, pp_max_interleave)})
+        # Schedule family (v11, docs/pipeline.md): the zero-bubble B/W
+        # split trades more send launches per tick grid for a strictly
+        # smaller bubble — a real candidate axis, not a dead knob.
+        ppsched_opts = ("interleaved_1f1b", "zb1")
     else:
         ppm_opts = (initial.pp_microbatches,)
         ppv_opts = (initial.pp_interleave,)
+        ppsched_opts = (str(getattr(initial, "pp_schedule",
+                                    "interleaved_1f1b")
+                            or "interleaved_1f1b"),)
     if tune_moe and moe_experts > 1:
         # MoE candidates (docs/moe.md): the capacity/wire tradeoff the
         # cost model prices — a higher capacity factor drops fewer
@@ -1224,30 +1237,32 @@ def enumerate_tuned(*, quantized: bool = False,
                             for fz in fz_opts:
                                 for ppm in ppm_opts:
                                     for ppv in ppv_opts:
-                                        for cap in cap_opts:
-                                            for mq in moeq_opts:
-                                                p = TunedParams(
-                                                    fusion_threshold_bytes=thr,
-                                                    quant_block=blk,
-                                                    hierarchical_allreduce=hier,
-                                                    zero_stage=stage,
-                                                    overlap=ovl,
-                                                    num_comm_streams=s,
-                                                    fused=fz,
-                                                    pp_microbatches=ppm,
-                                                    pp_interleave=ppv,
-                                                    moe_capacity_factor=cap,
-                                                    moe_quantized=mq)
-                                                key = (thr, blk,
-                                                       encode_tuned(
-                                                           p,
-                                                           quantized=quantized,
-                                                           pp=tune_pp,
-                                                           moe=tune_moe))
-                                                if key in seen:
-                                                    continue
-                                                seen.add(key)
-                                                out.append(p)
+                                        for pps in ppsched_opts:
+                                            for cap in cap_opts:
+                                                for mq in moeq_opts:
+                                                    p = TunedParams(
+                                                        fusion_threshold_bytes=thr,
+                                                        quant_block=blk,
+                                                        hierarchical_allreduce=hier,
+                                                        zero_stage=stage,
+                                                        overlap=ovl,
+                                                        num_comm_streams=s,
+                                                        fused=fz,
+                                                        pp_microbatches=ppm,
+                                                        pp_interleave=ppv,
+                                                        pp_schedule=pps,
+                                                        moe_capacity_factor=cap,
+                                                        moe_quantized=mq)
+                                                    key = (thr, blk,
+                                                           encode_tuned(
+                                                               p,
+                                                               quantized=quantized,
+                                                               pp=tune_pp,
+                                                               moe=tune_moe))
+                                                    if key in seen:
+                                                        continue
+                                                    seen.add(key)
+                                                    out.append(p)
     return out
 
 
@@ -1343,6 +1358,11 @@ def decode_tuned(encoding: str) -> dict:
         "fused": m.group("fused") is not None,
         "pp_microbatches": int(m.group("ppm") or 0),
         "pp_interleave": int(m.group("ppv") or 1),
+        # v11: |zb1 rides the pp segment — absent (or pp off) decodes
+        # to the interleaved-1F1B default, so zb collapses to 1f1b
+        # whenever the pipeline knobs are dead.
+        "pp_schedule": ("zb1" if m.group("ppzb")
+                        else "interleaved_1f1b"),
         "moe_capacity_factor": float(m.group("moecap") or 0.0),
         "moe_quantized": m.group("moeq") == "q8",
         "spec_draft_k": int(m.group("svk") or 0),

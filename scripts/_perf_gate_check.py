@@ -29,6 +29,13 @@ artifact can never land in the repo root again): per-leg measured vs
 baseline gauges, the tolerance, and pass/fail — regressions become
 queryable data, not just an exit code.
 
+pp4d leg (``--pp x --moe x --zero-stage 3`` combined): hard-gates the
+pipelined-MoE-vs-dense parity, the T3 bubble-fill contract — nonzero
+``bubble_hidden_bytes`` with accounted == predicted fill bytes
+(docs/pipeline.md) — engaged a2a AND send wire, and the a2a
+predicted-vs-modeled wire-ms drift — then throughput vs the
+trajectory.
+
 moe leg (``--moe`` A/B): hard-gates the forced-routing parity probe,
 the dropped-token fraction (<= PERF_GATE_MOE_DROPPED, default 0.25),
 and the a2a predicted-vs-modeled wire-ms drift (<=
@@ -434,6 +441,90 @@ def _main():
                   f"{'OK' if within else 'REGRESSION'}")
             record_verdict("pp", "send_wire_ms_drift", drift, drift_tol,
                            drift_tol, within)
+            ok &= within
+        if not ok:
+            return 1
+        # fall through: throughput still gates against the trajectory
+
+    if leg == "pp4d":
+        # 4-D composition leg (docs/pipeline.md, docs/moe.md): PP x EP
+        # x ZeRO-3 x quantized x overlap in ONE compiled step. Hard
+        # gates: (1) pipelined-MoE-vs-dense parity within its recorded
+        # tolerance, (2) the bubble-fill contract — the ZeRO-3 bucket
+        # flights must actually have streamed into the pipeline's idle
+        # ticks (nonzero filled_ticks / bubble_hidden_bytes when the
+        # schedule has capacity) and the accounted fill bytes must
+        # EQUAL the planner's prediction, (3) engaged a2a and send
+        # wire, (4) the a2a predicted-vs-modeled wire-ms drift — then
+        # throughput gates against the trajectory like a train leg.
+        ok = True
+        par = rec.get("parity_rel_err")
+        ptol = rec.get("parity_tol", 1e-4)
+        if par is None or par > ptol:
+            print(f"perf gate [pp4d]: parity {par} exceeds tolerance "
+                  f"{ptol} — hard fail")
+            record_verdict("pp4d", "parity_rel_err", par or -1, ptol,
+                           tol, False)
+            ok = False
+        else:
+            record_verdict("pp4d", "parity_rel_err", par, ptol, tol,
+                           True)
+        cap = int(rec.get("fill_capacity_ticks") or 0)
+        filled = int(rec.get("filled_ticks") or 0)
+        hidden = float(rec.get("bubble_hidden_bytes") or 0)
+        if cap > 0 and (filled < 1 or hidden <= 0):
+            print(f"perf gate [pp4d fill]: schedule has {cap} idle "
+                  f"ticks but fill never engaged (filled {filled}, "
+                  f"hidden {hidden} B) — hard fail")
+            record_verdict("pp4d", "bubble_fill_engaged", filled, 1,
+                           tol, False)
+            ok = False
+        else:
+            record_verdict("pp4d", "bubble_fill_engaged", filled,
+                           min(1, cap), tol, True)
+        pred_fill = float(rec.get("fill_predicted_bytes") or 0)
+        fdrift = abs(pred_fill - hidden) / max(1.0, pred_fill)
+        if fdrift > 1e-6:
+            print(f"perf gate [pp4d fill]: accounted {hidden} B != "
+                  f"predicted {pred_fill} B (drift {fdrift:.2e}) — "
+                  f"hard fail")
+            record_verdict("pp4d", "fill_bytes_drift", fdrift, 1e-6,
+                           tol, False)
+            ok = False
+        else:
+            print(f"perf gate [pp4d fill]: {filled}/{cap} idle ticks "
+                  f"filled, {hidden:.0f} B accounted == predicted -> "
+                  f"OK")
+            record_verdict("pp4d", "fill_bytes_drift", fdrift, 1e-6,
+                           tol, True)
+        if float(rec.get("a2a_bytes") or 0) <= 0:
+            print("perf gate [pp4d]: zero a2a wire bytes — the expert "
+                  "exchange never engaged — hard fail")
+            record_verdict("pp4d", "a2a_bytes", 0, 1, tol, False)
+            ok = False
+        if float(rec.get("pp_send_bytes") or 0) <= 0:
+            print("perf gate [pp4d]: zero send-leg wire bytes — the "
+                  "pipeline hop never engaged — hard fail")
+            record_verdict("pp4d", "pp_send_bytes", 0, 1, tol, False)
+            ok = False
+        wm = rec.get("wire_ms") or {}
+        pred, mod = wm.get("predicted"), wm.get("modeled")
+        drift_tol = float(os.environ.get("PERF_GATE_COST_DRIFT", "0.25"))
+        if pred is None or mod is None or mod <= 0:
+            print(f"perf gate [pp4d]: record lacks the a2a wire_ms "
+                  f"pair ({wm}) — hard fail")
+            record_verdict("pp4d", "a2a_wire_ms_present", 0, 1,
+                           drift_tol, False)
+            ok = False
+        else:
+            drift = abs(pred - mod) / mod
+            within = drift <= drift_tol
+            print(f"perf gate [pp4d a2a drift]: predicted {pred:.4f} "
+                  f"ms vs measured-model {mod:.4f} ms (|drift| "
+                  f"{drift:.3f} vs cap {drift_tol}) -> "
+                  f"{'OK' if within else 'REGRESSION'}")
+            record_verdict("pp4d", "a2a_wire_ms_drift", drift,
+                           drift_tol, drift_tol, within)
             ok &= within
         if not ok:
             return 1

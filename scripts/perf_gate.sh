@@ -29,6 +29,11 @@
 #                     parity + bubble <= PERF_GATE_PP_BUBBLE x the
 #                     GPipe analytic bound + send-leg wire-ms drift
 #                     (docs/pipeline.md)
+#   PERF_GATE_LEGS="pp4d" scripts/perf_gate.sh  # 4-D composition:
+#                     PP x EP x ZeRO-3 x quantized x overlap in one
+#                     compiled step — parity + bubble-fill predicted
+#                     == accounted + a2a wire-ms drift
+#                     (docs/pipeline.md, docs/moe.md)
 #   PERF_GATE_LEGS="moe" scripts/perf_gate.sh   # expert-parallel MoE:
 #                     forced-routing parity + dropped-token fraction
 #                     <= PERF_GATE_MOE_DROPPED + a2a wire-ms drift
@@ -147,6 +152,20 @@ for leg in $LEGS; do
                 --platform cpu --cpu-devices 8 \
                 --num-iters 2 --num-batches-per-iter 2
             ;;
+        pp4d)
+            # 4-D composition gate (docs/pipeline.md, docs/moe.md):
+            # the combined --pp x --moe leg — zero-bubble-capable
+            # pipeline over per-(stage, expert-group) ZeRO-3 cells
+            # with int8+EF a2a and bucket flights streamed into the
+            # idle ticks. The bench hard-fails itself on parity / fill
+            # drift; the checker re-asserts parity, the fill contract
+            # (nonzero hidden bytes, accounted == predicted), engaged
+            # a2a + send wire, and the a2a wire-ms drift, then
+            # throughput vs trajectory.
+            run_leg pp4d --pp 2 --moe 2 --zero-stage 3 --quantized \
+                --overlap --platform cpu --cpu-devices 8 \
+                --num-iters 2 --num-batches-per-iter 2
+            ;;
         cost)
             # Cost-model drift gate (docs/cost-model.md): the quantized
             # A/B's JSON carries wire_ms.predicted (the analytic
@@ -191,7 +210,7 @@ for leg in $LEGS; do
             fi
             ;;
         *)
-            echo "unknown gate leg: $leg (serve|serve_disagg|train|zero{1,2,3}|plan|fused|cost|pp|moe|soak)" >&2
+            echo "unknown gate leg: $leg (serve|serve_disagg|train|zero{1,2,3}|plan|fused|cost|pp|pp4d|moe|soak)" >&2
             exit 2
             ;;
     esac

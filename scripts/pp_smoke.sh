@@ -4,10 +4,16 @@
 # data mesh — asserting the three contracts the pp perf-gate leg hard
 # checks: pipelined-vs-dense parity, measured bubble fraction strictly
 # under the no-overlap GPipe analytic bound (S-1)/(M+S-1), and the
-# send-leg predicted-vs-measured wire-ms drift.
+# send-leg predicted-vs-measured wire-ms drift. A second zb leg runs
+# the zero-bubble schedule with ZeRO-3 fill on the same geometry and
+# asserts the zb1 contracts: measured zb1 bubble strictly below
+# interleaved-1F1B's (the bench A/Bs both schedules in one run), a
+# nonzero accounted bubble fill, and accounted == predicted fill
+# bytes.
 #
 # Usage: scripts/pp_smoke.sh
-# Env:   PP_SMOKE_KNOBS="--zero-stage 2 --quantized" adds composition.
+# Env:   PP_SMOKE_KNOBS="--zero-stage 2 --quantized" adds composition
+#        to the first leg (the zb leg always runs --zero-stage 3).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -35,4 +41,34 @@ assert rec["value"] > 0, "pp smoke: zero throughput"
 print(f"pp smoke OK: {rec['value']} tok/s, bubble "
       f"{rec['bubble_fraction']} < {rec['bubble_bound_gpipe']}, "
       f"send drift {drift:.4f}")
+EOF
+
+# zb leg: zero-bubble schedule + ZeRO-3 bubble fill, same geometry.
+zb=$(JAX_PLATFORMS=cpu python bench.py --pp 2 --mesh-shape 2x2 \
+    --pp-microbatches 8 --pp-interleave 2 --pp-schedule zb1 \
+    --zero-stage 3 --platform cpu --cpu-devices 8 \
+    --num-iters 2 --num-batches-per-iter 2 | tail -n 1)
+echo "$zb"
+
+python - "$zb" <<'EOF'
+import json, sys
+rec = json.loads(sys.argv[1])
+assert rec["parity_rel_err"] <= rec["parity_tol"], (
+    f"zb smoke: parity {rec['parity_rel_err']} > {rec['parity_tol']}")
+zb, fb = rec["bubble_fraction_zb1"], rec["bubble_fraction_1f1b"]
+assert zb < fb, (
+    f"zb smoke: zb1 bubble {zb} not strictly below 1F1B {fb} on the "
+    f"same geometry")
+assert rec["bubble_hidden_bytes"] > 0, (
+    "zb smoke: zero accounted bubble-fill bytes — the ZeRO-3 flights "
+    "never streamed into the idle ticks")
+assert rec["filled_ticks"] >= 1, "zb smoke: no idle ticks filled"
+pred = rec["fill_predicted_bytes"]
+fdrift = abs(pred - rec["bubble_hidden_bytes"]) / max(1.0, pred)
+assert fdrift <= 1e-6, (
+    f"zb smoke: fill accounted {rec['bubble_hidden_bytes']} != "
+    f"predicted {pred}")
+print(f"zb smoke OK: bubble {zb} < {fb} (1F1B), fill "
+      f"{rec['filled_ticks']}/{rec['fill_capacity_ticks']} ticks, "
+      f"{rec['bubble_hidden_bytes']:.0f} B hidden == predicted")
 EOF

@@ -292,10 +292,10 @@ class TestPlanSchemaV5:
         TestSession._reset_kernel_cache()
         key = cache_key_for("v9-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        # v10: the serve pair joins TunedParams (docs/serving.md); v9
-        # added the MoE pair (docs/moe.md); v8 the pipeline pair; v7
-        # the geometry-fingerprinted key + stored predicted_ms.
-        assert key.endswith("|v10")
+        # v11: pp_schedule joins TunedParams (docs/pipeline.md); v10
+        # added the serve pair (docs/serving.md); v9 the MoE pair;
+        # v8 the pipeline pair; v7 the geometry-fingerprinted key.
+        assert key.endswith("|v11")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)
@@ -499,14 +499,14 @@ class TestWarmStart:
 class TestCacheSchemaV7:
     """v7 = geometry-fingerprinted keys + stored predicted_ms
     (docs/cost-model.md); v8 = the pipeline pair (docs/pipeline.md);
-    v9 = the MoE pair (docs/moe.md); reads stay tolerant of older
-    entries."""
+    v9 = the MoE pair (docs/moe.md); v11 = the pp_schedule knob
+    (docs/pipeline.md); reads stay tolerant of older entries."""
 
     def test_key_carries_geometry_fingerprint(self):
         key = cache_key_for("geo-probe")
         geo = basics.mesh_geometry()
         assert f"|{geo}|" in key
-        assert key.endswith("|v10")
+        assert key.endswith("|v11")
 
     def test_load_tolerant_of_v6_entry(self, tmp_path, monkeypatch):
         from horovod_tpu.ops import kernel_autotune

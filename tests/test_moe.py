@@ -394,12 +394,29 @@ class TestEPMesh:
         finally:
             restore_mesh()
 
-    def test_ep_does_not_compose_with_pp_or_pods(self):
+    def test_ep_composes_with_pp_on_4d_mesh(self):
         hvd.shutdown()
         try:
-            with pytest.raises(ValueError, match="pp_stages"):
-                hvd.init(devices=jax.devices(), mesh_shape=(1, 2),
-                         ep_size=2, pp_stages=2)
+            hvd.init(devices=jax.devices(), mesh_shape=(1, 2),
+                     ep_size=2, pp_stages=2)
+            from horovod_tpu.common import basics
+
+            assert hvd.pp_size() == 2
+            assert hvd.ep_size() == 2
+            assert hvd.data_mesh_shape() == (1, 2)
+            assert hvd.mesh().axis_names == (
+                hvd.PP_AXIS, hvd.EP_AXIS, hvd.CROSS_AXIS,
+                hvd.LOCAL_AXIS)
+            # pp/ep are NOT data axes: shards and gradient collectives
+            # stay on (cross, local) per (stage, expert-group) cell.
+            assert basics.world_axes() == hvd.HVD_AXES
+            assert "pp2.ep2" in basics.mesh_geometry()
+        finally:
+            restore_mesh()
+
+    def test_ep_does_not_compose_with_pods(self):
+        hvd.shutdown()
+        try:
             with pytest.raises(ValueError, match="3-level"):
                 hvd.init(devices=jax.devices(), mesh_shape=(1, 2, 2),
                          ep_size=2)

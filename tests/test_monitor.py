@@ -844,6 +844,58 @@ class TestStragglerDetection:
         assert not any(k.startswith("straggler.detected")
                        for k in reg.snapshot()["counters"])
 
+    def test_pp_bubble_clean_run_zero_false_positives(self):
+        """Rank-uniform zb idle ticks (the schedule table is geometry-
+        determined) with uniform fill credit must never flag: the
+        pp_bubble phase is identical across ranks."""
+        from horovod_tpu.monitor import straggler as straggler_mod
+        reg, dets = _rank_farm(world=4)
+        # zb1 on (S=2, M=8): 2 idle ticks of 50 total, half of them
+        # filled by ZeRO-3 flights on every rank.
+        for step in range(10):
+            for r, det in enumerate(dets):
+                det.record_phase("compute", 100.0 + 0.3 * r)
+                ms = straggler_mod.record_pp_bubble(
+                    idle_ticks=2, ticks=50, step_ms=100.0,
+                    filled_ticks=1, detector=det)
+                assert ms == pytest.approx(100.0 * 1 / 50)
+                det.end_step(step)
+            assert dets[0].detect(snapshot=reg.snapshot()) == []
+        assert not any(k.startswith("straggler.detected")
+                       for k in reg.snapshot()["counters"])
+
+    def test_pp_bubble_fill_credit_math(self):
+        from horovod_tpu.monitor import straggler as straggler_mod
+        reg, dets = _rank_farm(world=4)
+        det = dets[0]
+        # fully filled bubble charges nothing
+        assert straggler_mod.record_pp_bubble(
+            4, 40, 200.0, filled_ticks=4, detector=det) == 0.0
+        # credit is capped at the measured idle ticks
+        assert straggler_mod.record_pp_bubble(
+            4, 40, 200.0, filled_ticks=99, detector=det) == 0.0
+        # no credit charges the full idle fraction
+        assert straggler_mod.record_pp_bubble(
+            4, 40, 200.0, detector=det) == pytest.approx(20.0)
+        # degenerate inputs clamp instead of raising
+        assert straggler_mod.record_pp_bubble(
+            -1, 0, 200.0, filled_ticks=-5, detector=det) == 0.0
+
+    def test_pp_bubble_starved_rank_attributed(self):
+        """One rank whose flights starve (no fill credit) surfaces as a
+        pp_bubble outlier through the ordinary median/MAD gate."""
+        from horovod_tpu.monitor import straggler as straggler_mod
+        reg, dets = _rank_farm(world=4)
+        for r, det in enumerate(dets):
+            det.record_phase("compute", 100.0)
+            straggler_mod.record_pp_bubble(
+                idle_ticks=8, ticks=40, step_ms=100.0,
+                filled_ticks=(0 if r == 2 else 8), detector=det)
+            det.end_step(0)
+        found = dets[0].detect(snapshot=reg.snapshot())
+        assert [(d["rank"], d["phase"]) for d in found] == \
+            [(2, "pp_bubble")]
+
     def test_delayed_rank_detected_and_attributed(self):
         reg, dets = _rank_farm(world=4)
         flagged_at = None

@@ -145,6 +145,70 @@ class TestSchedule:
 
 
 # ---------------------------------------------------------------------------
+# The zero-bubble family (zb1): B/W split + fill-tick capacity.
+# ---------------------------------------------------------------------------
+
+
+class TestZeroBubbleSchedule:
+    def test_unknown_family_rejected(self):
+        with pytest.raises(ValueError, match="unknown schedule family"):
+            build_interleaved_schedule(8, 4, 1, family="zb2")
+
+    def test_zb_units_complete_and_w_after_b(self):
+        """Every (m, chunk) gets exactly one W unit, strictly after its
+        B (dw consumes the grads B stashed), on the owner rank."""
+        M, n, v = 8, 4, 2
+        s = build_interleaved_schedule(M, n, v, family="zb1")
+        K = n * v
+        done_b, done_w = {}, {}
+        for r in range(n):
+            for t in range(s.ticks):
+                assert not (s.f_valid[r, t] and s.w_valid[r, t])
+                assert not (s.b_valid[r, t] and s.w_valid[r, t])
+                if s.b_valid[r, t]:
+                    done_b[(s.b_m[r, t], s.b_j[r, t] * n + r)] = t
+                if s.w_valid[r, t]:
+                    key = (s.w_m[r, t], s.w_j[r, t] * n + r)
+                    assert key not in done_w, key
+                    done_w[key] = t
+        assert set(done_w) == {(m, c) for m in range(M)
+                               for c in range(K)}
+        for key, t in done_w.items():
+            assert done_b[key] < t, key
+        assert s.unit_count() == 3 * M * K
+        assert s.units_per_rank == 3 * M * v
+
+    def test_zb_bubble_strictly_below_1f1b(self):
+        """The tentpole claim: on the same (S, M, v) the measured zb1
+        bubble is strictly below the interleaved-1F1B bubble."""
+        for (M, n, v) in ((8, 2, 1), (8, 4, 1), (16, 4, 4)):
+            s1 = build_interleaved_schedule(M, n, v)
+            sz = build_interleaved_schedule(M, n, v, family="zb1")
+            assert sz.bubble_fraction < s1.bubble_fraction, (M, n, v)
+            # and still below the GPipe bound, trivially
+            assert sz.bubble_fraction < pp_bubble_bound(n, M)
+
+    def test_zb_fill_ticks_enumerate_the_idle_grid(self):
+        """fill_ticks[r, t] numbers rank r's idle ticks 0..cap-1 and is
+        -1 on every busy tick — the T3 fill-capacity contract the
+        ZeRO-3 flights are credited against (rank-uniform)."""
+        for family in ("1f1b", "zb1"):
+            s = build_interleaved_schedule(8, 4, 1, family=family)
+            for r in range(s.stages):
+                ks = []
+                for t in range(s.ticks):
+                    busy = bool(s.f_valid[r, t]) or bool(s.b_valid[r, t])
+                    if s.w_valid is not None:
+                        busy = busy or bool(s.w_valid[r, t])
+                    if busy:
+                        assert s.fill_ticks[r, t] == -1
+                    else:
+                        ks.append(int(s.fill_ticks[r, t]))
+                assert ks == list(range(len(ks)))
+                assert len(ks) == s.idle_ticks_per_rank
+
+
+# ---------------------------------------------------------------------------
 # Exactness: the schedule family vs the dense model, through gradients.
 # ---------------------------------------------------------------------------
 
@@ -226,6 +290,36 @@ class TestInterleavedParity:
             # interleaved chunk grads == the dense per-block grads:
             # rank r's local chunk j is global chunk c = j*n + r.
             _, g_cp, _ = results["interleaved_1f1b"]
+            for (r, j) in ((0, 0), (n - 1, v - 1)):
+                got = jax.tree.map(lambda a: np.asarray(a[r, j, 0]), g_cp)
+                want = jax.tree.map(np.asarray, g_dense[f"h{j * n + r}"])
+                jax.tree.map(
+                    lambda a, b: np.testing.assert_allclose(
+                        a, b, rtol=1e-3, atol=1e-6), got, want)
+        finally:
+            hvd.shutdown()
+            hvd.init(devices=jax.devices())
+
+    def test_zb1_matches_dense(self):
+        """zb1 == the dense model: the B/W split changes WHEN dw runs,
+        never WHAT it computes — loss and per-block gradients at the
+        same documented tolerance as interleaved-1F1B."""
+        hvd.shutdown()
+        try:
+            hvd.init(devices=jax.devices(), mesh_shape=(2, 4))
+            n, v, M = 4, 2, 4
+            cfg, params, tokens, targets = _setup_gpt(
+                L=n * v, B=2 * M, T=16, seed=4)
+            want_loss, g_dense = _dense_ref(cfg, params, tokens, targets)
+            chunks, rest = pp_split_chunks(params, n, v)
+            loss, g_cp, g_rest = self._train(
+                cfg, chunks, rest, tokens, targets, axis=hvd.LOCAL_AXIS,
+                n=n, v=v, M=M, schedule="zb1", dp_axes=hvd.CROSS_AXIS)
+            np.testing.assert_allclose(float(loss), float(want_loss),
+                                       rtol=3e-5)
+            np.testing.assert_allclose(
+                np.asarray(g_rest["wte"]), np.asarray(g_dense["wte"]),
+                rtol=1e-3, atol=1e-6)
             for (r, j) in ((0, 0), (n - 1, v - 1)):
                 got = jax.tree.map(lambda a: np.asarray(a[r, j, 0]), g_cp)
                 want = jax.tree.map(np.asarray, g_dense[f"h{j * n + r}"])
@@ -410,7 +504,8 @@ class TestPPMesh:
 
 
 class TestAccounting:
-    def _trace_interleaved(self, send_plan_=None):
+    def _trace_interleaved(self, send_plan_=None,
+                           schedule="interleaved_1f1b"):
         n, v, M = 4, 2, 4
         cfg, params, tokens, targets = _setup_gpt(L=n * v, B=2 * M, T=8,
                                                   seed=3)
@@ -421,7 +516,7 @@ class TestAccounting:
             local = jax.tree.map(lambda a: a[0], cp)
             loss, g_cp, g_rest = pipelined_gpt_train(
                 cfg, local, rst, tok, tgt, axis=hvd.LOCAL_AXIS,
-                num_microbatches=M, schedule="interleaved_1f1b",
+                num_microbatches=M, schedule=schedule,
                 interleave=v, send_plan=send_plan_)
             loss = hvd.allreduce(loss, op=hvd.Average,
                                  axes=hvd.CROSS_AXIS)
@@ -486,6 +581,82 @@ class TestAccounting:
         finally:
             hvd.shutdown()
             hvd.init(devices=jax.devices())
+
+    def test_zb_spans_count_w_units(self, tmp_path):
+        """Under zb1 the W units show up as PP:W spans and the measured
+        busy fraction reproduces the (smaller) zb bubble — the same
+        span-derived bubble bench.py reports."""
+        hvd.shutdown()
+        try:
+            hvd.init(devices=jax.devices(), mesh_shape=(2, 4))
+            path = str(tmp_path / "zb_tl.json")
+            hvd.start_timeline(path)
+            try:
+                self._trace_interleaved(schedule="zb1")
+            finally:
+                hvd.stop_timeline()
+            events = json.load(open(path))
+            from horovod_tpu.monitor.span_audit import audit_spans
+
+            audit = audit_spans(events, prefix="PP:", require_spans=True,
+                                strict=True)
+            assert audit.balanced
+            sched = build_interleaved_schedule(4, 4, 2, family="zb1")
+            assert audit.count.get("PP:W", 0) == \
+                sched.microbatches * sched.interleave * sched.stages
+            busy = (audit.count.get("PP:F", 0)
+                    + audit.count.get("PP:B", 0)
+                    + audit.count.get("PP:W", 0))
+            assert busy == sched.unit_count()
+            bubble = 1.0 - busy / float(sched.stages * sched.ticks)
+            assert bubble == pytest.approx(sched.bubble_fraction)
+            ref = build_interleaved_schedule(4, 4, 2)
+            assert bubble < ref.bubble_fraction
+        finally:
+            hvd.shutdown()
+            hvd.init(devices=jax.devices())
+
+    def test_bubble_fill_credits_streamed_gathers(self):
+        """A zero3_gather_params trace under fill_sched= credits one
+        idle tick per streamed bucket flight, capped at the schedule's
+        per-rank fill capacity; without the window nothing is
+        credited."""
+        params = {f"w{i}": jnp.ones((1024,), jnp.float32)
+                  for i in range(6)}
+        tpl = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        psh = hvd.zero3_shard_params(params,
+                                     fusion_threshold_bytes=4096)
+        pspec = hvd.zero3_param_pspecs(psh)
+        n_buckets = len(jax.tree.leaves(psh))
+        sched = build_interleaved_schedule(8, 4, 1, family="zb1")
+        cap = sched.idle_ticks_per_rank
+        assert 0 < cap < n_buckets  # the capacity cap is exercised
+
+        def run(fill):
+            def spmd(psh):
+                p = hvd.zero3_gather_params(
+                    psh, tpl, fusion_threshold_bytes=4096,
+                    overlap=True, num_comm_streams=2, fill_sched=fill)
+                return jax.tree.map(lambda a: a.sum(), p)
+
+            f = jax.jit(hvd.shard_map(
+                spmd, mesh=hvd.mesh(), in_specs=(pspec,),
+                out_specs=jax.tree.map(lambda _: P(), tpl)))
+            with hvd.record_wire_stats() as ws:
+                f.lower(psh)
+            return ws
+
+        ws = run(sched)
+        assert ws.filled_ticks == cap
+        assert ws.bubble_hidden_bytes > 0
+        # a filled flight is still overlap-scheduled — never double
+        # freedom: hidden-in-bubble bytes are a subset of overlap bytes
+        assert ws.bubble_hidden_bytes <= ws.overlap_bytes
+        ws0 = run(None)
+        assert ws0.filled_ticks == 0
+        assert ws0.bubble_hidden_bytes == 0.0
+        assert ws0.overlap_bytes == ws.overlap_bytes
 
 
 # ---------------------------------------------------------------------------
@@ -623,6 +794,130 @@ class TestAutotuneV8:
         for r in rows:
             assert r.plan.send is not None
             assert r.cost.pp_ms > 0
+
+
+# ---------------------------------------------------------------------------
+# Autotune schema v11: the pp_schedule knob (zero-bubble family).
+# ---------------------------------------------------------------------------
+
+
+class TestAutotuneV11:
+    def test_encode_decode_zb_segment(self):
+        from horovod_tpu.autotune.parameter_manager import TunedParams
+        from horovod_tpu.plan.planner import decode_tuned, encode_tuned
+
+        p = TunedParams(pp_microbatches=8, pp_interleave=2,
+                        pp_schedule="zb1")
+        enc = encode_tuned(p, pp=True)
+        assert enc == "ar.flat|fp|s1|sync|pp8/2|zb1"
+        d = decode_tuned(enc)
+        assert d["pp_schedule"] == "zb1"
+        assert d["pp_microbatches"] == 8 and d["pp_interleave"] == 2
+        # the segment is optional: every v10 encoding is a valid v11
+        # encoding and decodes to the exact pre-v11 default
+        d10 = decode_tuned("ar.flat|fp|s1|sync|pp8/2")
+        assert d10["pp_schedule"] == "interleaved_1f1b"
+        # pp off: schedule rides the pp group, so it drops with it
+        assert encode_tuned(p) == "ar.flat|fp|s1|sync"
+        assert decode_tuned("ar.flat|fp|s1|sync")["pp_schedule"] == \
+            "interleaved_1f1b"
+
+    def test_manager_canonicalizes_dead_zb_knob(self):
+        from horovod_tpu.autotune.parameter_manager import (
+            ParameterManager, TunedParams)
+
+        pm = ParameterManager(TunedParams(), warmup_samples=0,
+                              max_samples=3, tune_pp=False)
+        c = pm._canonicalize(TunedParams(pp_microbatches=16,
+                                         pp_interleave=2,
+                                         pp_schedule="zb1"))
+        # zb1 is meaningless without a pipeline: collapses with the
+        # other pp knobs so equal plans dedup as ONE trial
+        assert c.pp_schedule == "interleaved_1f1b"
+        assert c.pp_microbatches == 0 and c.pp_interleave == 1
+
+    def test_unit_cube_roundtrip_and_v10_tuple_tolerance(self):
+        from horovod_tpu.autotune.parameter_manager import (
+            ParameterManager, TunedParams)
+
+        pm = ParameterManager(TunedParams(pp_microbatches=8),
+                              warmup_samples=0, max_samples=8,
+                              tune_pp=True, pp_stages=4,
+                              pp_max_interleave=1)
+        for u13, want in ((0.0, "interleaved_1f1b"),
+                          (0.25, "interleaved_1f1b"),
+                          (0.75, "zb1"), (1.0, "zb1")):
+            p = pm._from_unit((0.5, 0.5, 0.25, 0.25, 0.25, 0.0, 0.25,
+                               0.5, 0.0, 0.25, 0.25, 0.25, 0.25, u13))
+            assert p.pp_schedule == want
+            # round trip: _to_unit lands the same side of 0.5
+            back = pm._from_unit(pm._to_unit(p))
+            assert back.pp_schedule == want
+        # pre-v11 unit tuples (len < 14) still resolve — the zb dim
+        # was appended at the tail precisely so old coordinates stay
+        # valid, defaulting to the pre-v11 schedule
+        p9 = pm._from_unit((0.5, 0.5, 0.25, 0.25, 0.25, 0.0, 0.25,
+                            0.5, 0.0))
+        assert p9.pp_schedule == "interleaved_1f1b"
+
+    def test_csv_roundtrip_with_pp_schedule_column(self, tmp_path):
+        from horovod_tpu.autotune.parameter_manager import (
+            CSV_FIELDS, ParameterManager, TunedParams, read_log)
+
+        assert "pp_schedule" in CSV_FIELDS
+        path = str(tmp_path / "log.csv")
+        pm = ParameterManager(TunedParams(pp_microbatches=8,
+                                          pp_schedule="zb1"),
+                              warmup_samples=0, max_samples=3,
+                              tune_pp=True, pp_stages=4,
+                              pp_max_interleave=1, log_path=path)
+        while not pm.done:
+            pm.record_sample(1.0)
+        rows = read_log(path)
+        assert rows and all("pp_schedule" in r for r in rows)
+        assert rows[0]["pp_schedule"] == "zb1"
+        assert rows[0]["plan"].endswith("|zb1")
+
+    def test_read_log_tolerant_of_v10_csv(self, tmp_path):
+        from horovod_tpu.autotune.parameter_manager import read_log
+
+        # A v10-era log: no pp_schedule column — reads cleanly and
+        # defaults to the exact pre-v11 schedule.
+        path = tmp_path / "v10.csv"
+        path.write_text(
+            "sample,fusion_threshold_bytes,quant_block,"
+            "hierarchical_allreduce,zero_sharding,zero_stage,overlap,"
+            "num_comm_streams,fused,pp_microbatches,pp_interleave,"
+            "moe_capacity_factor,moe_quantized,spec_draft_k,"
+            "kv_migrate_quantized,score_steps_per_sec,plan\n"
+            "1,4194304,256,0,0,0,0,1,0,8,2,0.0,0,0,0,12.5,"
+            "ar.flat|fp|s1|sync|pp8/2\n")
+        rows = read_log(str(path))
+        assert rows[0]["pp_schedule"] == "interleaved_1f1b"
+        assert rows[0]["pp_microbatches"] == 8
+
+    def test_tuned_params_from_v10_dict(self):
+        from horovod_tpu.autotune.parameter_manager import TunedParams
+
+        p = TunedParams.from_dict({
+            "fusion_threshold_bytes": 4 << 20, "quant_block": 256,
+            "hierarchical_allreduce": False, "zero_stage": 2,
+            "overlap": True, "num_comm_streams": 2,
+            "pp_microbatches": 8, "pp_interleave": 2})
+        assert p.pp_schedule == "interleaved_1f1b"
+        rt = TunedParams.from_dict(p.as_dict())
+        assert rt == p
+
+    def test_enumerate_offers_both_schedules_under_tune_pp(self):
+        from horovod_tpu.plan.planner import enumerate_tuned
+
+        cands = enumerate_tuned(tune_pp=True, pp_stages=4,
+                                pp_max_interleave=1)
+        scheds = {p.pp_schedule for p in cands}
+        assert scheds == {"interleaved_1f1b", "zb1"}
+        # tune_pp off: the schedule stays pinned — no phantom trials
+        pinned = {p.pp_schedule for p in enumerate_tuned()}
+        assert pinned == {"interleaved_1f1b"}
 
 
 # ---------------------------------------------------------------------------

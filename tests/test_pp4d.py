@@ -1,0 +1,354 @@
+"""4-D composed mesh: PP x EP x DP/ZeRO (docs/parallelism.md).
+
+The ``(hvd_pp, hvd_ep, hvd_cross, hvd_local)`` mesh replaces the PR-14
+EP x PP loud-fail. The contracts under test:
+
+* geometry: pp leads, ep nests inside a stage, the data mesh is the
+  trailing (cross, local) pair; the fingerprint carries the combined
+  ``ppS.epE`` marker;
+* expert a2a dispatch stays STAGE-LOCAL (the ep axis never crosses
+  stage boundaries);
+* gradient reductions: router/dense leaves pmean over hvd_ep and
+  average over the data axes, expert leaves scale by 1/ep and average
+  over the data axes, and NEITHER ever reduces over hvd_pp;
+* one pipelined MoE ZeRO-2 step — under both the interleaved-1F1B and
+  the zero-bubble zb1 schedule — equals the dense single-device step.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.moe import (
+    EXPERT_LEAVES,
+    ep_mean_dense_grads,
+    ep_stack_params,
+    moe_ffn,
+)
+from horovod_tpu.parallel.pipeline import interleaved_1f1b
+
+E, C, F, K = 4, 8, 16, 2       # experts, model, ffn, topk
+DOUT = 4                       # head output width
+
+PP, EP = 2, 2                  # stage count, expert-group count
+DATA = (1, 2)                  # per-cell data mesh
+NCELL = EP * DATA[0] * DATA[1]
+M, NL = 4, 8                   # microbatches, tokens per mb per cell
+
+EPALL = (hvd.EP_AXIS,) + hvd.HVD_AXES
+SALL = (hvd.PP_AXIS, hvd.EP_AXIS) + hvd.HVD_AXES
+
+
+def mesh4d():
+    hvd.shutdown()
+    hvd.init(devices=jax.devices(), mesh_shape=DATA, ep_size=EP,
+             pp_stages=PP)
+    return hvd.mesh()
+
+
+def restore_mesh():
+    hvd.shutdown()
+    hvd.init(devices=jax.devices())
+
+
+def stage_dense_params(seed):
+    """One stage's dense (world-1) MoE block params."""
+    rs = np.random.RandomState(seed)
+    return {
+        "router": jnp.asarray(rs.randn(C, E) * 0.1, jnp.float32),
+        "w1": jnp.asarray(rs.randn(E, C, F) * 0.1, jnp.float32),
+        "b1": jnp.asarray(rs.randn(E, F) * 0.01, jnp.float32),
+        "w2": jnp.asarray(rs.randn(E, F, C) * 0.1, jnp.float32),
+        "b2": jnp.asarray(rs.randn(E, C) * 0.01, jnp.float32),
+    }
+
+
+def stack_stages(stages):
+    """Per-stage dense params -> the 4-D mesh's sharded layout: expert
+    leaves ``[pp, ep, E_local, ...]``, replicated leaves ``[pp, ...]``."""
+    ep_stacked = [ep_stack_params(p, EP) for p in stages]
+    return jax.tree.map(lambda *ls: jnp.stack(ls), *ep_stacked)
+
+
+def chunk_pspecs(chunks):
+    """Expert leaves shard over (pp, ep); the rest over pp only."""
+    def spec(path, _leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in EXPERT_LEAVES:
+            return P(hvd.PP_AXIS, hvd.EP_AXIS)
+        return P(hvd.PP_AXIS)
+
+    return jax.tree_util.tree_map_with_path(spec, chunks)
+
+
+def local_chunks(cp):
+    """shard_map-local chunk tree -> the ``[v=1, ...]`` stacked form
+    ``interleaved_1f1b`` consumes: expert leaves drop the pp-local unit
+    dim (the ep-local unit dim doubles as the v dim); replicated leaves
+    already lead with it."""
+    def pick(path, a):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return a[0] if name in EXPERT_LEAVES else a
+
+    return jax.tree_util.tree_map_with_path(pick, cp)
+
+
+def relift_chunks(cp_local):
+    """Inverse of :func:`local_chunks` for the update's return trip."""
+    def lift(path, a):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        return a[None] if name in EXPERT_LEAVES else a
+
+    return jax.tree_util.tree_map_with_path(lift, cp_local)
+
+
+def stage_fn(p, x):
+    """One pipeline stage: a residual MoE FFN. capacity_factor=E keeps
+    every top-k choice (no drops) so the dense reference is exact."""
+    y, _, _ = moe_ffn(x, p, topk=K, capacity_factor=float(E),
+                      ep_axis=hvd.EP_AXIS)
+    return x + y
+
+
+def loss_fn(hp, y, tgt):
+    """Per-microbatch LOCAL-MEAN loss — the convention
+    :func:`ep_mean_dense_grads` normalizes (docs/moe.md)."""
+    return jnp.mean((y @ hp["wh"] - tgt) ** 2)
+
+
+def make_data(seed):
+    rs = np.random.RandomState(seed)
+    x = jnp.asarray(rs.randn(M, NCELL * NL, C), jnp.float32)
+    tgt = jnp.asarray(rs.randn(M, NCELL * NL, DOUT), jnp.float32)
+    return x, tgt
+
+
+def dense_step(stages, hp, x, tgt, lr=0.1, mom=0.9):
+    """Single-device reference: full-batch forward through both stages,
+    global-mean loss, one SGD-momentum step."""
+    def ref_loss(tree):
+        y = x.reshape(-1, C)
+        for p in tree["stages"]:
+            y = stage_fn_dense(p, y)
+        return jnp.mean((y @ tree["head"]["wh"]
+                         - tgt.reshape(-1, DOUT)) ** 2)
+
+    def stage_fn_dense(p, xx):
+        y, _, _ = moe_ffn(xx, p, topk=K, capacity_factor=float(E))
+        return xx + y
+
+    tree = {"stages": list(stages), "head": hp}
+    loss, g = jax.value_and_grad(ref_loss)(tree)
+    tx = optax.sgd(lr, momentum=mom)
+    upd, _ = tx.update(g, tx.init(tree), tree)
+    return loss, g, optax.apply_updates(tree, upd)
+
+
+class TestMesh4D:
+    def test_4d_geometry(self):
+        try:
+            m = mesh4d()
+            assert m.axis_names == SALL
+            assert m.devices.shape == (PP, EP) + DATA
+            assert hvd.pp_size() == PP
+            assert hvd.ep_size() == EP
+            assert hvd.pod_size() == 1
+            assert hvd.data_mesh_shape() == DATA
+            assert basics.world_axes() == hvd.HVD_AXES
+            assert f"pp{PP}.ep{EP}" in basics.mesh_geometry()
+        finally:
+            restore_mesh()
+
+    def test_a2a_plan_is_stage_local(self):
+        """The expert a2a prices against the per-cell DATA mesh, not
+        the whole world: dispatch never crosses a stage boundary."""
+        from horovod_tpu.moe import default_a2a_plan
+        from horovod_tpu.plan import ep_a2a_level
+
+        try:
+            mesh4d()
+            plan = default_a2a_plan()
+            assert plan.legs[0].level == ep_a2a_level(DATA)
+        finally:
+            restore_mesh()
+
+
+class TestCheckpointEPGuard:
+    def test_ep_group_count_change_fails_loudly(self, tmp_path):
+        """The manifest records ep_size alongside pp_stages; restoring
+        on a different expert-group count fails with the recovery
+        recipe instead of silently re-assigning experts."""
+        from horovod_tpu import checkpoint as hvd_ckpt
+
+        hvd.shutdown()
+        try:
+            hvd.init(devices=jax.devices(), mesh_shape=(1, 4),
+                     ep_size=2)
+            mgr = hvd_ckpt.CheckpointManager(str(tmp_path), keep=2)
+            state = hvd_ckpt.CheckpointedJaxState(
+                mgr, params=jnp.arange(8.0), step=0)
+            state.step = 3
+            state.commit()
+            assert state.wait(30)
+            mgr.close()
+        finally:
+            hvd.shutdown()
+        try:
+            hvd.init(devices=jax.devices())  # no-ep mesh
+            mgr = hvd_ckpt.CheckpointManager(str(tmp_path), keep=2)
+            with pytest.raises(ValueError,
+                               match="2-group expert-parallel mesh"):
+                hvd_ckpt.CheckpointedJaxState(
+                    mgr, params=jnp.arange(8.0), step=0)
+            mgr.close()
+        finally:
+            restore_mesh()
+
+    def test_same_geometry_roundtrip_on_4d_mesh(self, tmp_path):
+        """A matching (pp, ep) geometry restores bit-identically — the
+        guards only reject actual geometry changes."""
+        from horovod_tpu import checkpoint as hvd_ckpt
+
+        hvd.shutdown()
+        try:
+            hvd.init(devices=jax.devices(), mesh_shape=DATA,
+                     ep_size=EP, pp_stages=PP)
+            vals = jnp.asarray(
+                np.random.RandomState(0).randn(16).astype(np.float32))
+            mgr = hvd_ckpt.CheckpointManager(str(tmp_path), keep=2)
+            state = hvd_ckpt.CheckpointedJaxState(mgr, params=vals,
+                                                  step=0)
+            state.step = 5
+            state.commit()
+            assert state.wait(30)
+            mgr.close()
+            hvd.shutdown()
+            hvd.init(devices=jax.devices(), mesh_shape=DATA,
+                     ep_size=EP, pp_stages=PP)
+            mgr = hvd_ckpt.CheckpointManager(str(tmp_path), keep=2)
+            restored = hvd_ckpt.CheckpointedJaxState(
+                mgr, params=jnp.zeros(16), step=0)
+            assert restored.restored_from == 5
+            np.testing.assert_array_equal(np.asarray(restored.params),
+                                          np.asarray(vals))
+            mgr.close()
+        finally:
+            restore_mesh()
+
+
+class TestEPxPPxZero2Parity:
+    @pytest.mark.parametrize("family", ["1f1b", "zb1"])
+    def test_one_step_parity_vs_dense(self, family):
+        """One pipelined MoE ZeRO-2 step on the 4-D mesh == the dense
+        single-device SGD-momentum step: loss, and updated router /
+        expert / head leaves (per-stage shard worlds = the per-cell
+        data world)."""
+        try:
+            mesh = mesh4d()
+            stages = [stage_dense_params(3), stage_dense_params(4)]
+            rs = np.random.RandomState(6)
+            hp = {"wh": jnp.asarray(rs.randn(C, DOUT) * 0.1,
+                                    jnp.float32)}
+            x, tgt = make_data(7)
+            want_loss, _, want_tree = dense_step(stages, hp, x, tgt)
+
+            chunks = stack_stages(stages)
+            pspec = chunk_pspecs(chunks)
+            tx = hvd.DistributedOptimizer(
+                optax.sgd(0.1, momentum=0.9), zero_stage=2,
+                pp_stages=PP, pp_microbatches=M,
+                pp_schedule=("zb1" if family == "zb1"
+                             else "interleaved_1f1b"),
+                moe_experts=E, moe_capacity_factor=float(E))
+            state_tpl = tx.init(
+                {"chunks": local_chunks(
+                    jax.tree.map(lambda a: a[:1], chunks)),
+                 "head": hp})
+            sspec_of = lambda st: jax.tree.map(  # noqa: E731
+                lambda l: P(SALL) if getattr(l, "ndim", 0) >= 1
+                else P(), st)
+
+            def init_spmd(cp, h):
+                return tx.init({"chunks": local_chunks(cp), "head": h})
+
+            state = jax.jit(hvd.shard_map(
+                init_spmd, mesh=mesh, in_specs=(pspec, P()),
+                out_specs=sspec_of(state_tpl)))(chunks, hp)
+            sspec = sspec_of(state)
+
+            def step_spmd(cp, h, xb, tg, st):
+                local_c = local_chunks(cp)
+                loss, g_cp, g_hp, _ = interleaved_1f1b(
+                    stage_fn, loss_fn, local_c, h, xb, tg,
+                    axis=hvd.PP_AXIS, interleave=1, family=family)
+                # Normalize to the global-mean gradient's share
+                # (docs/moe.md): router/head pmean over hvd_ep, expert
+                # leaves 1/ep — NEVER a reduction over hvd_pp.
+                g = ep_mean_dense_grads({"chunks": g_cp, "head": g_hp})
+                local = {"chunks": local_c, "head": h}
+                upd, st2 = tx.update(g, st, local)
+                new = optax.apply_updates(local, upd)
+                loss = hvd.allreduce(loss, op=hvd.Average, axes=EPALL)
+                # Re-establish the head's pp x ep replication by
+                # construction (the ZeRO buckets mixed pp/ep-varying
+                # chunk leaves into the gather; every cell holds the
+                # same head values).
+                rpp = lax.axis_index(hvd.PP_AXIS)
+                rep = lax.axis_index(hvd.EP_AXIS)
+                on0 = jnp.logical_and(rpp == 0, rep == 0)
+                new_head = jax.tree.map(
+                    lambda a: lax.psum(
+                        jnp.where(on0, a, jnp.zeros_like(a)),
+                        (hvd.PP_AXIS, hvd.EP_AXIS)), new["head"])
+                # Same for the ep replication of the non-expert chunk
+                # leaves (router): pp-varying, ep-replicated.
+                def fix_ep(path, a):
+                    name = (path[-1].key if hasattr(path[-1], "key")
+                            else str(path[-1]))
+                    if name in EXPERT_LEAVES:
+                        return a
+                    return lax.psum(
+                        jnp.where(rep == 0, a, jnp.zeros_like(a)),
+                        hvd.EP_AXIS)
+
+                new_c = jax.tree_util.tree_map_with_path(
+                    fix_ep, new["chunks"])
+                return loss, relift_chunks(new_c), new_head, st2
+
+            data_spec = P(None, EPALL)
+            step = jax.jit(hvd.shard_map(
+                step_spmd, mesh=mesh,
+                in_specs=(pspec, P(), data_spec, data_spec, sspec),
+                out_specs=(P(), pspec, P(), sspec)))
+            loss, new_chunks, new_head, state = step(
+                chunks, hp, x, tgt, state)
+
+            np.testing.assert_allclose(float(loss), float(want_loss),
+                                       rtol=3e-5)
+            got = jax.device_get(new_chunks)
+            for s in range(PP):
+                want_s = want_tree["stages"][s]
+                np.testing.assert_allclose(
+                    np.asarray(got["router"][s]),
+                    np.asarray(want_s["router"]),
+                    rtol=2e-4, atol=2e-6)
+                # expert leaf: ep group g holds experts
+                # [g*E/EP, (g+1)*E/EP)
+                for g in range(EP):
+                    np.testing.assert_allclose(
+                        np.asarray(got["w1"][s, g]),
+                        np.asarray(want_s["w1"].reshape(
+                            (EP, E // EP) + want_s["w1"].shape[1:])[g]),
+                        rtol=2e-4, atol=2e-6)
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(new_head)["wh"]),
+                np.asarray(want_tree["head"]["wh"]),
+                rtol=2e-4, atol=2e-6)
+        finally:
+            restore_mesh()
