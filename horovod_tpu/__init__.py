@@ -206,6 +206,8 @@ from .plan import (  # noqa: F401
     WirePlan,
     describe_plan,
 )
+from . import compile  # noqa: F401  (compile-once runtime, docs/compile.md)
+from .compile import precompile  # noqa: F401  (AOT warm pools)
 from . import chaos  # noqa: F401  (fault injection: hvd.chaos.FaultPlan)
 from . import checkpoint  # noqa: F401  (async rank-sharded save/restore)
 from . import elastic  # noqa: F401  (hvd.elastic.run / State / ElasticSampler)

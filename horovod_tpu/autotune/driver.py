@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import logging
+import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
@@ -74,7 +75,14 @@ log = logging.getLogger("horovod_tpu.autotune")
 #     optional `|zb1` segment riding the `|ppM/V` group). from_dict/load
 #     stay tolerant of v10/v9 entries (pp_schedule defaults to the
 #     dead-knob "interleaved_1f1b" value — the exact pre-v11 step).
-_CACHE_VERSION = 11
+# v12: compile-once runtime (docs/compile.md) — the trial CSV gains the
+#     per-trial `compile_ms`/`compile_cache_hit` pair (the previously
+#     untimed build+absorb step, now bracketed by AUTOTUNE:COMPILE
+#     spans and overlapped with the prior trial's measurement window
+#     when the next setting is knowable). The TunedParams schema is
+#     unchanged; read_log stays tolerant of v11/v10 logs lacking the
+#     new columns (compile_ms defaults 0.0, compile_cache_hit False).
+_CACHE_VERSION = 12
 
 # Process-lifetime session counter — hvd.shutdown() warns when
 # HOROVOD_AUTOTUNE=1 never reached a session (the knob is otherwise a
@@ -205,6 +213,53 @@ def _timeline_instant(name: str, args: dict) -> None:
     tl = basics._state.timeline if basics.is_initialized() else None
     if tl is not None:
         tl.instant(name, tid="autotune", args=args)
+
+
+def _timeline_span(name: str, ph: str, args: Optional[dict] = None) -> None:
+    # Compile spans ride their own tid: a background prefetch build can
+    # open while the main autotune tid is mid-window, and per-tid B/E
+    # balance (span_audit) must hold on both. Builds themselves are
+    # serialized (at most one prefetch thread, joined before any
+    # foreground build), so this tid never nests concurrent spans.
+    tl = basics._state.timeline if basics.is_initialized() else None
+    if tl is not None:
+        tl.emit(name, ph, tid="autotune.compile", args=args)
+
+
+def _build_trial(make_step, tuned: TunedParams, box: dict,
+                 *, background: bool) -> None:
+    """Build (and absorb the compile of) one trial's step into ``box``.
+
+    ``box`` gains ``step`` (the callable to time), ``compile_ms`` and
+    ``cache_hit`` (executable-cache miss delta == 0 across the build) on
+    success, ``error`` on failure. Runs either inline or as the
+    compile-ahead prefetch thread overlapping the prior trial's
+    measurement window (docs/compile.md); AUTOTUNE:COMPILE brackets the
+    build either way — the step that was untimed before v12."""
+    from .. import compile as _xc
+
+    s0 = _xc.stats()
+    _timeline_span("AUTOTUNE:COMPILE", "B",
+                   {"background": background, **tuned.as_dict()})
+    try:
+        t0 = time.perf_counter()
+        step = make_step(tuned)
+        if hasattr(step, "lower"):
+            # An un-called jit step: drive the AOT path so the XLA
+            # compile genuinely happens here (on the prefetch thread,
+            # off the measured window) instead of at first dispatch.
+            step = step.lower().compile()
+        box["step"] = step
+        box["compile_ms"] = (time.perf_counter() - t0) * 1e3
+        s1 = _xc.stats()
+        box["cache_hit"] = (s1["misses"] == s0["misses"]
+                            and s1["hits"] > s0["hits"])
+    except Exception as e:
+        box["error"] = e
+    finally:
+        _timeline_span("AUTOTUNE:COMPILE", "E",
+                       {"background": background,
+                        "compile_ms": round(box.get("compile_ms", 0.0), 3)})
 
 
 def autotune_session(
@@ -422,19 +477,51 @@ def autotune_session(
         "warm_start_seeds": pm.seeded})
 
     built: Optional[Tuple[TunedParams, Callable[[], object]]] = None
+    # Compile-ahead prefetch (docs/compile.md): while trial k's window
+    # is being measured, trial k+1's step lowers/compiles on a host
+    # thread — but only when the NEXT setting is knowable without the
+    # pending score (warmup repeats + the cost-model seed queue;
+    # ParameterManager.peek_next). GP-phase proposals depend on the
+    # score, so those builds stay in the foreground.
+    prefetch: Optional[Tuple[TunedParams, threading.Thread, dict]] = None
     while not pm.done:
         tuned = pm.current
         warmup = pm.warming_up
+        compile_ms = 0.0
+        cache_hit = False
         try:
             if built is None or built[0] != tuned:
-                t0 = time.perf_counter()
-                built = (tuned, make_step(tuned))
-                # One untimed step absorbs this trial's compile + first
-                # dispatch so the scored window measures steady state.
+                box: dict = {}
+                if prefetch is not None:
+                    p_tuned, p_thread, p_box = prefetch
+                    prefetch = None
+                    p_thread.join()
+                    if p_tuned == tuned and "step" in p_box:
+                        box = p_box
+                if "step" not in box:
+                    box = {}
+                    _build_trial(make_step, tuned, box, background=False)
+                    if "error" in box:
+                        raise box["error"]
+                compile_ms = box.get("compile_ms", 0.0)
+                cache_hit = bool(box.get("cache_hit", False))
+                built = (tuned, box["step"])
+                # One untimed step absorbs this trial's first dispatch
+                # so the scored window measures steady state.
                 jax.block_until_ready(built[1]())
-                log.info("autotune trial build %s: %.1fs to first step",
-                         tuned.as_dict(), time.perf_counter() - t0)
+                log.info("autotune trial build %s: %.0fms compile%s",
+                         tuned.as_dict(), compile_ms,
+                         " (cache hit)" if cache_hit else "")
             step = built[1]
+            nxt = pm.peek_next()
+            if nxt is not None and nxt != tuned and prefetch is None:
+                p_box: dict = {}
+                p_thread = threading.Thread(
+                    target=_build_trial, args=(make_step, nxt, p_box),
+                    kwargs={"background": True}, daemon=True,
+                    name="autotune-compile-ahead")
+                p_thread.start()
+                prefetch = (nxt, p_thread, p_box)
             t0 = time.perf_counter()
             for _ in range(pm.steps_per_sample):
                 out = step()
@@ -450,14 +537,22 @@ def autotune_session(
             score = 0.0
             log.warning("autotune trial %s failed (%s: %s); scoring 0",
                         tuned.as_dict(), type(e).__name__, str(e)[:200])
-        pm.record_sample(score)
+        pm.record_sample(score, compile_ms=compile_ms,
+                         compile_cache_hit=cache_hit)
         _timeline_instant("AUTOTUNE:SAMPLE", {
             "warmup": warmup, "score_steps_per_sec": round(score, 4),
+            "compile_ms": round(compile_ms, 3),
+            "compile_cache_hit": cache_hit,
             **tuned.as_dict()})
         if not warmup:
             log.info("autotune sample %d/%d: %s -> %.3f steps/sec",
                      pm.samples_done, max_samples, tuned.as_dict(), score)
 
+    if prefetch is not None:
+        # A frozen session can leave one compile-ahead build in flight;
+        # join it so its AUTOTUNE:COMPILE span closes before the
+        # timeline can be dumped (span_audit strict mode).
+        prefetch[1].join()
     best = pm.best
     _timeline_instant("AUTOTUNE:CONVERGED", {
         "samples": pm.samples_done,

@@ -106,6 +106,11 @@ _MAX_SPEC_K = 4      # speculative draft-window search box (0..4)
 # lacking the newer columns.
 # v11 appends the pipeline schedule family; read_log stays tolerant of
 # v3..v10 logs lacking the newer columns.
+# v12 appends the per-trial compile pair (docs/compile.md): compile_ms
+# is the trial's build+absorb wall time (overlapped with the prior
+# trial's window when compile-ahead prefetch hit), compile_cache_hit
+# whether the executable cache served it without an XLA compile.
+# read_log stays tolerant of v3..v11 logs lacking the newer columns.
 CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "hierarchical_allreduce", "zero_sharding", "zero_stage",
               "overlap", "num_comm_streams", "fused",
@@ -113,7 +118,8 @@ CSV_FIELDS = ("sample", "fusion_threshold_bytes", "quant_block",
               "moe_capacity_factor", "moe_quantized",
               "spec_draft_k", "kv_migrate_quantized",
               "pp_schedule",
-              "score_steps_per_sec", "plan")
+              "score_steps_per_sec", "plan",
+              "compile_ms", "compile_cache_hit")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -546,9 +552,33 @@ class ParameterManager:
     def samples_done(self) -> int:
         return len(self.history)
 
-    def record_sample(self, score: float) -> None:
+    def peek_next(self) -> Optional[TunedParams]:
+        """The setting the NEXT ``record_sample`` will make current,
+        when that is knowable without the pending score: the initial
+        setting during warmup (warmup windows never advance it), the
+        first untried cost-model seed during the seed-queue phase.
+        None once proposals are GP-driven (they depend on the score
+        being measured right now) or when the next sample freezes the
+        session — the driver's compile-ahead prefetch only overlaps
+        builds this method can name exactly (docs/compile.md)."""
+        if self.done:
+            return None
+        if self._warmups_done < self.warmup_samples:
+            return self.current
+        if len(self.history) + 1 >= self.max_samples:
+            return None  # next record freezes at best: no new trial
+        for cand in self._seed_queue:
+            if self._unit_key(cand) not in self._tried:
+                return cand
+        return None
+
+    def record_sample(self, score: float, *,
+                      compile_ms: float = 0.0,
+                      compile_cache_hit: bool = False) -> None:
         """Feed one scored window (steps/sec of ``current``); advances the
-        warmup → sample → freeze machine (parameter_manager.cc:139-194)."""
+        warmup → sample → freeze machine (parameter_manager.cc:139-194).
+        ``compile_ms``/``compile_cache_hit`` describe the trial's build
+        step for the v12 CSV columns (docs/compile.md)."""
         if self.done:
             raise RuntimeError("record_sample() after convergence")
         if self._warmups_done < self.warmup_samples:
@@ -556,7 +586,7 @@ class ParameterManager:
             return  # discarded: current stays the initial setting
         score = float(score)
         self.history.append((self.current, score))
-        self._write_row(score)
+        self._write_row(score, compile_ms, compile_cache_hit)
         if score > self.best_score:
             self.best_score = score
             self.best = self.current
@@ -565,7 +595,8 @@ class ParameterManager:
             return
         self.current = self._propose_next()
 
-    def _write_row(self, score: float) -> None:
+    def _write_row(self, score: float, compile_ms: float = 0.0,
+                   compile_cache_hit: bool = False) -> None:
         if self._csv is None:
             return
         p = self.current
@@ -585,7 +616,9 @@ class ParameterManager:
                             int(p.kv_migrate_quantized),
                             p.pp_schedule,
                             f"{score:.6g}",
-                            self._plan_of(p)])
+                            self._plan_of(p),
+                            f"{float(compile_ms):.3f}",
+                            int(compile_cache_hit)])
         self._log.flush()
 
     def _freeze(self) -> None:
@@ -725,6 +758,10 @@ def read_log(path: str) -> List[dict]:
                 "pp_schedule": str(rec.get("pp_schedule")
                                    or "interleaved_1f1b"),
                 "score_steps_per_sec": float(rec["score_steps_per_sec"]),
+                # v12 compile pair; pre-v12 logs never timed the build.
+                "compile_ms": float(rec.get("compile_ms", 0.0) or 0.0),
+                "compile_cache_hit": bool(
+                    int(rec.get("compile_cache_hit", 0) or 0)),
             }
             enc = (rec.get("plan") or "").strip()
             if not enc:  # pre-v5 log: derive the canonical encoding

@@ -346,6 +346,13 @@ def init(
             from .backend import enable_overlap_scheduling
 
             enable_overlap_scheduling()
+        # Compile-once runtime (docs/compile.md): arm JAX's persistent
+        # compilation cache BEFORE the mesh exists — the knob only
+        # covers compiles issued after arming, and the first collective
+        # compile can happen as soon as the mesh does.
+        from ..compile import cache as _compile_cache
+
+        _compile_cache.arm_persistent_cache(_state.config)
         if pp_stages is None:
             pp_stages = _state.config.pp_stages or None
         if ep_size is None:

@@ -133,6 +133,14 @@ class Config:
     #     kept beside the autotune cache by default ---
     calibration_cache: Optional[str] = None
 
+    # --- compile-once runtime (docs/compile.md): JAX persistent
+    #     compilation cache + serialized-executable registry, armed from
+    #     init so warm reruns / restarted workers skip lower+compile.
+    #     Dir defaults beside the autotune cache
+    #     (~/.cache/horovod_tpu/compile). ---
+    compile_cache: bool = True
+    compile_cache_dir: Optional[str] = None
+
     # --- timeline (operations.cc:420-434) ---
     timeline: Optional[str] = None
     timeline_mark_cycles: bool = False
@@ -224,6 +232,8 @@ def from_env() -> Config:
         ),
         autotune_warm_start=_env_int("HOROVOD_AUTOTUNE_WARM_START", 0),
         calibration_cache=_env_str("HOROVOD_CALIBRATION_CACHE", None),
+        compile_cache=_env_bool("HOROVOD_COMPILE_CACHE", True),
+        compile_cache_dir=_env_str("HOROVOD_COMPILE_CACHE_DIR", None),
         timeline=_env_str("HOROVOD_TIMELINE", None),
         timeline_mark_cycles=_env_bool("HOROVOD_TIMELINE_MARK_CYCLES", False),
         metrics_jsonl=_env_str("HOROVOD_METRICS_JSONL", None),

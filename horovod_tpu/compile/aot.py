@@ -1,0 +1,62 @@
+"""AOT warm pools: ``hvd.precompile(fn, specs)``.
+
+Ahead-of-time compilation through the executable cache: callers hand a
+function plus the abstract argument shapes they will serve, and get back
+ready-to-call executables (``jit(fn).lower(*spec).compile()`` routed via
+:mod:`.cache` so identical requests — across warm pools, engines, and
+processes — compile exactly once). The serve engine warms its step for
+every admission shape bucket at startup, and ``ReplicaSet`` warms the
+TARGET geometry's executables in the background before a resize drain
+(docs/compile.md has the lifecycle and ordering contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+from . import cache as _cache
+
+
+def _as_spec_tuple(spec) -> Tuple:
+    """Normalize one precompile spec to an args tuple."""
+    if isinstance(spec, tuple):
+        return spec
+    if isinstance(spec, list):
+        return tuple(spec)
+    return (spec,)
+
+
+def precompile(fn: Callable, specs: Union[Sequence, Any], *,
+               tag: Optional[str] = None, plan: Optional[str] = None,
+               mesh=None, static_argnums=(),
+               donate_argnums=()) -> List[_cache.CompileResult]:
+    """AOT-compile ``fn`` for every abstract-args spec in ``specs``.
+
+    ``specs`` is a sequence of argument tuples (each element a
+    ``jax.ShapeDtypeStruct`` — attach ``sharding=NamedSharding(...)`` for
+    sharded programs — or a concrete array to borrow shapes from); a
+    single tuple is accepted for the one-bucket case. Returns one
+    :class:`~horovod_tpu.compile.cache.CompileResult` per spec, in
+    order; ``.compiled`` is the executable to call. Compiles are
+    deduplicated and persisted through the executable cache, so a warm
+    pool on a restarted worker loads from disk instead of compiling.
+
+    ``fn`` may already be a ``jax.jit`` wrapper (used as-is); otherwise
+    it is jitted here with ``static_argnums``/``donate_argnums``.
+    """
+    import jax
+
+    if isinstance(specs, tuple):
+        spec_list = [specs]
+    else:
+        spec_list = [_as_spec_tuple(s) for s in specs]
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, static_argnums=static_argnums,
+        donate_argnums=donate_argnums)
+    label = tag or getattr(fn, "__name__", None) or "precompile"
+    out: List[_cache.CompileResult] = []
+    for i, spec in enumerate(spec_list):
+        out.append(_cache.get_or_compile(
+            label, lambda spec=spec: jitted.lower(*spec),
+            plan=plan, mesh=mesh, shapes=spec, extra=f"bucket{i}"))
+    return out

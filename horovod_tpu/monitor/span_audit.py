@@ -41,6 +41,7 @@ KNOWN_PREFIXES = frozenset({
     "STRAGGLER",   # skew / link-health diagnoses (monitor/straggler.py)
     "FLIGHT",      # flight-recorder marks (monitor/flight.py)
     "RESILIENCE",  # supervisor policy actions (resilience/supervisor.py)
+    "COMPILE",     # executable-cache lower/compile/hit (docs/compile.md)
 })
 
 

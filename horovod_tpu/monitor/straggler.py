@@ -67,9 +67,11 @@ logger = logging.getLogger("horovod_tpu.straggler")
 #: exchange phase, separate from the gradient wire's hop classes.
 #: ``wire.kv`` is disaggregated serving's KV-migration wire
 #: (docs/serving.md) — a replica stuck in it is blocked on a
-#: prefill→decode handoff, not on compute.
+#: prefill→decode handoff, not on compute. ``compile`` is
+#: lowering+XLA-compile time paid through the executable cache
+#: (docs/compile.md) — a rank stuck there missed the cache others hit.
 PHASES = ("compute", "wire.ici", "wire.dcn", "wire.pod", "wire.a2a",
-          "wire.kv", "pp_bubble", "ckpt")
+          "wire.kv", "pp_bubble", "ckpt", "compile")
 
 HOPS = ("ici", "dcn", "pod")
 

@@ -39,6 +39,7 @@ Three opt-in extensions (docs/serving.md) compose with the base loop:
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -60,6 +61,133 @@ from .scheduler import Request, Scheduler
 from .spec import NGramDrafter
 
 SERVE_TP_AXIS = "serve_tp"
+
+logger = logging.getLogger("horovod_tpu.serve")
+
+
+def _tp_degree(mesh: Mesh, tp_axis) -> int:
+    return int(np.prod([mesh.shape[a] for a in (
+        (tp_axis,) if isinstance(tp_axis, str) else tp_axis)]))
+
+
+def _step_specs(tp: int, tp_axis):
+    """(stacked-params spec, KV cache spec tree) for a tp-degree replica.
+
+    tp=1: fully replicated specs (a head-sharded in_spec on a size-1
+    axis would mark every downstream value varying and fail the
+    out_specs replication check even though no collective differs)."""
+    stk_spec = P(tp_axis) if tp > 1 else P()
+    pool_spec = (P(None, None, None, tp_axis, None) if tp > 1 else P())
+    cache_specs = KVCache(k=pool_spec, v=pool_spec,
+                          page_table=P(), seq_lens=P())
+    return stk_spec, cache_specs
+
+
+def _make_step_fn(model_cfg: GPTConfig, mesh: Mesh, stk_spec,
+                  cache_specs):
+    """The jitted mixed prefill/decode step program. One function serves
+    both admission shape buckets — the W=1 step (tokens ``[S]``) and the
+    speculative window (``[S, W]``); each shape is its own executable."""
+
+    def spmd(stk, rp, cache, tokens, active):
+        local = tp_merge_params(
+            jax.tree.map(lambda a: a[0], stk), rp)
+        return GPT(model_cfg).apply({"params": local}, tokens,
+                                    cache=cache, active=active)
+
+    return jax.jit(basics.shard_map(
+        spmd, mesh=mesh,
+        in_specs=(stk_spec, P(), cache_specs, P(), P()),
+        out_specs=(P(), cache_specs)))
+
+
+def step_abstract_args(params, page_config: PageConfig, mesh: Mesh,
+                       tp_axis, *, window: int = 0):
+    """The engine step's abstract ``(stacked, repl, cache, tokens,
+    active)`` argument tuple: sharding-carrying ``ShapeDtypeStruct``
+    trees, no device allocation. ``params`` is the dense param tree (or
+    its ``jax.eval_shape`` counterpart); ``window`` > 1 produces the
+    speculative ``[S, W]`` token/valid bucket. Both the engine's own
+    startup warm and :func:`warm_step_executables` build their cache
+    keys from this ONE function, so a background precompile and the
+    engine that follows it always agree."""
+    tp = _tp_degree(mesh, tp_axis)
+    stk_spec, cache_specs = _step_specs(tp, tp_axis)
+    tp_sh = jax.sharding.NamedSharding(mesh, stk_spec)
+    rep_sh = jax.sharding.NamedSharding(mesh, P())
+
+    def _sds(tree, sh):
+        return jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            tree)
+
+    stacked_s, repl_s = jax.eval_shape(
+        lambda p: tp_split_params(p, tp), params)
+    stacked_s, repl_s = _sds(stacked_s, tp_sh), _sds(repl_s, rep_sh)
+    cache_s = jax.tree.map(
+        lambda s, spec: jax.ShapeDtypeStruct(
+            s.shape, s.dtype,
+            sharding=jax.sharding.NamedSharding(mesh, spec)),
+        jax.eval_shape(lambda: kvlib.init_cache(page_config, tp=1)),
+        cache_specs)
+    S = page_config.max_slots
+    tok_shape = (S, window) if window > 1 else (S,)
+    tokens_s = jax.ShapeDtypeStruct(tok_shape, jnp.int32, sharding=rep_sh)
+    active_s = jax.ShapeDtypeStruct(tok_shape, jnp.bool_, sharding=rep_sh)
+    return (stacked_s, repl_s, cache_s, tokens_s, active_s)
+
+
+def _device_ids_token(mesh: Mesh) -> str:
+    import hashlib
+
+    ids = ",".join(str(getattr(d, "id", "?"))
+                   for d in mesh.devices.ravel())
+    if len(ids) > 48:
+        ids = hashlib.sha1(ids.encode()).hexdigest()[:12]
+    return f"dev{ids}"
+
+
+def warm_step_executables(cfg: GPTConfig, params,
+                          page_config: PageConfig,
+                          devices: Optional[Sequence] = None, *,
+                          mesh: Optional[Mesh] = None, tp_axis=None,
+                          spec_k: int = 0) -> dict:
+    """AOT-compile (or cache-load) the step executables an engine over
+    ``devices`` will need — WITHOUT building the engine: no param split,
+    no KV pool allocation, nothing placed on the target devices until
+    the executables are warm. ``ReplicaSet`` runs this in the background
+    for the TARGET geometry before a resize drains anything
+    (docs/compile.md ordering contract); the engine built afterwards
+    hits the registry in memory and pays zero compile. Returns
+    ``{"step": CompileResult[, "window": CompileResult]}``."""
+    import dataclasses as _dc
+
+    from .. import compile as _xc
+
+    if mesh is None:
+        if devices is None:
+            devices = [jax.devices()[0]]
+        mesh = Mesh(np.array(list(devices)), (SERVE_TP_AXIS,))
+        tp_axis = SERVE_TP_AXIS
+    if tp_axis is None:
+        raise ValueError("pass tp_axis along with mesh")
+    tp = _tp_degree(mesh, tp_axis)
+    model_cfg = _dc.replace(cfg, tp_axis=(tp_axis if tp > 1 else None))
+    stk_spec, cache_specs = _step_specs(tp, tp_axis)
+    fn = _make_step_fn(model_cfg, mesh, stk_spec, cache_specs)
+    dev_tok = _device_ids_token(mesh)
+    out = {}
+    args = step_abstract_args(params, page_config, mesh, tp_axis)
+    out["step"] = _xc.get_or_compile(
+        "serve.step", lambda: fn.lower(*args),
+        mesh=mesh, shapes=args, extra=dev_tok)
+    if spec_k:
+        wargs = step_abstract_args(params, page_config, mesh, tp_axis,
+                                   window=spec_k + 1)
+        out["window"] = _xc.get_or_compile(
+            "serve.step", lambda: fn.lower(*wargs),
+            mesh=mesh, shapes=wargs, extra=dev_tok)
+    return out
 
 
 class WallClock:
@@ -238,31 +366,15 @@ class GenerationEngine:
                               if self.moe_experts else None)
 
         stacked, repl = tp_split_params(params, tp)
-        stk_spec = P(tp_axis) if tp > 1 else P()
+        stk_spec, cache_specs = _step_specs(tp, tp_axis)
         rep_sh = jax.sharding.NamedSharding(mesh, P())
         tp_sh = jax.sharding.NamedSharding(mesh, stk_spec)
         self._stacked = jax.device_put(stacked, tp_sh)
         self._repl = jax.device_put(repl, rep_sh)
 
-        # tp=1: fully replicated specs (a head-sharded in_spec on a size-1
-        # axis would mark every downstream value varying and fail the
-        # out_specs replication check even though no collective differs).
-        pool_spec = (P(None, None, None, tp_axis, None) if tp > 1
-                     else P())
-        cache_specs = KVCache(k=pool_spec, v=pool_spec,
-                              page_table=P(), seq_lens=P())
         model_cfg = self.cfg
-
-        def spmd(stk, rp, cache, tokens, active):
-            local = tp_merge_params(
-                jax.tree.map(lambda a: a[0], stk), rp)
-            return GPT(model_cfg).apply({"params": local}, tokens,
-                                        cache=cache, active=active)
-
-        self._step_fn = jax.jit(basics.shard_map(
-            spmd, mesh=mesh,
-            in_specs=(stk_spec, P(), cache_specs, P(), P()),
-            out_specs=(P(), cache_specs)))
+        self._step_fn = _make_step_fn(model_cfg, mesh, stk_spec,
+                                      cache_specs)
 
         # Speculative window: ONE compiled program feeding W = spec_k+1
         # tokens per slot — a single batched apply returning logits
@@ -271,24 +383,43 @@ class GenerationEngine:
         # blind to positions > w, so greedy verification is bit-identical
         # to W chained single-token steps at ~1/W the dispatch cost (the
         # whole point of verifying the draft in one batched step).
-        self._window_fn = None
-        if self.spec_k:
-
-            def spmd_w(stk, rp, cache, tokens, valid):
-                local = tp_merge_params(
-                    jax.tree.map(lambda a: a[0], stk), rp)
-                return GPT(model_cfg).apply(
-                    {"params": local}, tokens, cache=cache, active=valid)
-
-            self._window_fn = jax.jit(basics.shard_map(
-                spmd_w, mesh=mesh,
-                in_specs=(stk_spec, P(), cache_specs, P(), P()),
-                out_specs=(P(), cache_specs)))
+        self._window_fn = (_make_step_fn(model_cfg, mesh, stk_spec,
+                                         cache_specs)
+                           if self.spec_k else None)
 
         cache = kvlib.init_cache(page_config, tp=1)  # global-shaped pools
         cache_sh = jax.tree.map(
             lambda s: jax.sharding.NamedSharding(mesh, s), cache_specs)
         self.cache = jax.device_put(cache, cache_sh)
+
+        # AOT warm pool (docs/compile.md): every admission shape bucket
+        # — the W=1 step and (with speculation on) the W=spec_k+1
+        # window — is compiled ahead of the first request, through the
+        # executable cache. A background resize precompile
+        # (warm_step_executables) or a previous process already paid
+        # this compile; then these are registry hits and the engine
+        # starts warm. Cache trouble falls back to the jit path.
+        self._step_exec = None
+        self._window_exec = None
+        try:
+            from .. import compile as _xc
+
+            dev_tok = _device_ids_token(mesh)
+            args = step_abstract_args(params, page_config, mesh, tp_axis)
+            self._step_exec = _xc.get_or_compile(
+                "serve.step", lambda: self._step_fn.lower(*args),
+                mesh=mesh, shapes=args, extra=dev_tok).compiled
+            if self.spec_k:
+                wargs = step_abstract_args(params, page_config, mesh,
+                                           tp_axis,
+                                           window=self.spec_k + 1)
+                self._window_exec = _xc.get_or_compile(
+                    "serve.step", lambda: self._window_fn.lower(*wargs),
+                    mesh=mesh, shapes=wargs, extra=dev_tok).compiled
+        except Exception as e:  # warm pool is an optimization only
+            logger.warning("serve step AOT precompile failed (%s: %s) — "
+                           "running on the jit path",
+                           type(e).__name__, str(e)[:200])
 
     # -- queue ------------------------------------------------------------
 
@@ -316,6 +447,24 @@ class GenerationEngine:
         return len(self.slots)
 
     # -- the continuous-batching step -------------------------------------
+
+    def _run_step(self, exec_attr: str, jit_fn, cache, tokens, active):
+        """Run one compiled step: the AOT warm-pool executable when one
+        loaded, dropping permanently to the jit path the first time it
+        rejects its inputs (shape drift means the warm key no longer
+        describes this engine — an optimization lost, never an error)."""
+        exec_ = getattr(self, exec_attr)
+        if exec_ is not None:
+            try:
+                return exec_(self._stacked, self._repl, cache,
+                             tokens, active)
+            except Exception as e:
+                setattr(self, exec_attr, None)
+                logger.warning(
+                    "AOT step executable rejected its inputs "
+                    "(%s: %s) — engine %s continues on the jit path",
+                    type(e).__name__, str(e)[:200], self.name)
+        return jit_fn(self._stacked, self._repl, cache, tokens, active)
 
     def step(self, now: float) -> int:
         """Admit, run ONE compiled mixed prefill/decode step, sample,
@@ -391,8 +540,8 @@ class GenerationEngine:
         # DistributedOptimizer use — host/device trace correlation).
         with jax.profiler.StepTraceAnnotation("serve_step",
                                               step_num=self.stats.steps):
-            logits, self.cache = self._step_fn(
-                self._stacked, self._repl, cache,
+            logits, self.cache = self._run_step(
+                "_step_exec", self._step_fn, cache,
                 jnp.asarray(tokens), jnp.asarray(active))
         if tl is not None:
             for ph, _ in reversed(phases):
@@ -656,8 +805,8 @@ class GenerationEngine:
                 tl.begin(self.name, f"SERVE:{ph}")
         with jax.profiler.StepTraceAnnotation("serve_step",
                                               step_num=self.stats.steps):
-            logits, self.cache = self._window_fn(
-                self._stacked, self._repl, cache,
+            logits, self.cache = self._run_step(
+                "_window_exec", self._window_fn, cache,
                 jnp.asarray(tokens), jnp.asarray(valid))
         if tl is not None:
             for ph, _ in reversed(phases):

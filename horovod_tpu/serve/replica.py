@@ -35,6 +35,8 @@ autoscaler re-splits ``P:D`` by measured prefill:decode token demand.
 
 from __future__ import annotations
 
+import logging
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -50,9 +52,12 @@ from ..plan import ir as _ir
 from ..plan.accounting import kv_span
 from ..plan.cost import predict_hop_ms, price_kv_migrate
 from ..plan.planner import derive_kv_migrate, predict_kv_migrate_bytes
-from .engine import GenerationEngine, ServeStats, VirtualClock, WallClock
+from .engine import (GenerationEngine, ServeStats, VirtualClock,
+                     WallClock, warm_step_executables)
 from .kv_cache import PageConfig
 from .scheduler import Request
+
+logger = logging.getLogger("horovod_tpu.serve")
 
 
 class ReplicaSet:
@@ -125,6 +130,11 @@ class ReplicaSet:
         self.kv_migration_bytes = 0.0
         self.kv_migration_fp_bytes = 0.0
         self.kv_stall_steps = 0
+        # Background-precompiled resize state (docs/compile.md): a
+        # pending request_resize() and the post-resize first-token clock.
+        self._pending_resize: Optional[Dict] = None
+        self._post_resize_t0: Optional[float] = None
+        self._reused_engines = 0
         self._build(n_replicas)
 
     @property
@@ -144,6 +154,21 @@ class ReplicaSet:
                     f"disagg split {self._disagg} must be two positive "
                     f"counts summing to n_replicas={n_replicas}")
         per = n_dev // n_replicas
+        # Identical-geometry reuse (the PR-20 resize fix): a live,
+        # drained engine whose device slice AND role configuration match
+        # a slot in the new partition is kept as-is — its compiled step,
+        # KV pools, and prefix cache all transfer; only the name
+        # changes. Rebuilding it from scratch re-paid param split +
+        # pool allocation (and, pre-executable-cache, the XLA compile)
+        # for a byte-identical engine.
+        reusable: Dict[tuple, GenerationEngine] = {}
+        for eng in self.engines:
+            key = (tuple(getattr(d, "id", None)
+                         for d in eng.mesh.devices.ravel()),
+                   eng.prefill_only, eng.prefix_cache is not None,
+                   eng.spec_k)
+            reusable.setdefault(key, eng)
+        self._reused_engines = 0
         self.engines = []
         for i in range(n_replicas):
             is_prefill = self._disagg is not None and i < self._disagg[0]
@@ -151,9 +176,19 @@ class ReplicaSet:
             name = (f"prefill{i}" if is_prefill else
                     f"decode{i - self._disagg[0]}" if is_decode else
                     f"replica{i}")
+            group = self.devices[i * per:(i + 1) * per]
+            want_prefix = self.prefix_cache_enabled and not is_decode
+            key = (tuple(getattr(d, "id", None) for d in group),
+                   is_prefill, want_prefix, self.spec_k)
+            eng = reusable.pop(key, None)
+            if eng is not None:
+                eng.name = name
+                self.engines.append(eng)
+                self._reused_engines += 1
+                continue
             self.engines.append(GenerationEngine(
                 self.cfg, self.params, self.page_config,
-                devices=self.devices[i * per:(i + 1) * per],
+                devices=group,
                 eos_id=self.eos_id, temperature=self.temperature,
                 seed=self.seed + i, name=name,
                 moe_experts=self.moe_experts,
@@ -165,8 +200,7 @@ class ReplicaSet:
                 # spec_k+1 prompt tokens per step (chunked prefill: the
                 # same compiled window program, fed prompt instead of
                 # drafts, so a P-replica drains prompts W× faster).
-                prefix_cache=(self.prefix_cache_enabled
-                              and not is_decode),
+                prefix_cache=want_prefix,
                 spec_k=self.spec_k))
         if self.expert_replicas is not None:
             # New partition: replication counts re-clamp to what it can
@@ -297,9 +331,11 @@ class ReplicaSet:
         return grown
 
     def step_all(self, now: float) -> int:
+        self.maybe_finish_resize(now)
         self._dispatch(now)
         if self._disagg is None:
-            return sum(e.step(now) for e in self.engines)
+            tok = sum(e.step(now) for e in self.engines)
+            return self._after_step(tok)
         # Disaggregated order: prefill steps produce handoffs, the wire
         # pumps a bounded chunk of the head migration, decode steps keep
         # their in-flight batches moving while the rest of the payload
@@ -318,6 +354,18 @@ class ReplicaSet:
                 _metrics.counter("serve.kv.stall_steps_by",
                                  replica=eng.name).inc()
             tok += t
+        return self._after_step(tok)
+
+    def _after_step(self, tok: int) -> int:
+        """Post-step accounting: the first productive step after a
+        resize closes the rebuilt partition's time-to-first-token."""
+        if tok > 0 and self._post_resize_t0 is not None \
+                and self.resize_events:
+            ttft_ms = (time.perf_counter() - self._post_resize_t0) * 1e3
+            self._post_resize_t0 = None
+            self.resize_events[-1]["post_resize_ttft_ms"] = round(
+                ttft_ms, 3)
+            _metrics.gauge("serve.post_resize_ttft_ms").set(ttft_ms)
         return tok
 
     # -- KV migration (disaggregation) ------------------------------------
@@ -440,8 +488,78 @@ class ReplicaSet:
 
     # -- elastic resize ---------------------------------------------------
 
+    def _warm_targets(self, n_replicas: int) -> None:
+        """AOT-compile the TARGET partition's step executables through
+        the executable cache, one per distinct device slice — without
+        touching the live engines. After this, ``_build``'s engine
+        constructors hit the registry in memory and pay zero compile."""
+        n_dev = len(self.devices)
+        if n_replicas < 1 or n_dev % n_replicas:
+            return  # resize() raises the real error
+        per = n_dev // n_replicas
+        seen = set()
+        for i in range(n_replicas):
+            group = self.devices[i * per:(i + 1) * per]
+            key = tuple(getattr(d, "id", None) for d in group)
+            if key in seen:
+                continue
+            seen.add(key)
+            warm_step_executables(self.cfg, self.params,
+                                  self.page_config, group,
+                                  spec_k=self.spec_k)
+
+    @property
+    def resize_pending(self) -> bool:
+        """A :meth:`request_resize` whose background precompile has not
+        yet completed into a drain."""
+        return self._pending_resize is not None
+
+    def request_resize(self, n_replicas: int, *,
+                       split: Optional[Tuple[int, int]] = None) -> bool:
+        """Begin a background-precompiled resize: a host thread warms
+        the TARGET geometry's executables while serving continues; the
+        drain runs in a later ``step_all`` tick, only once the warm
+        executables are ready (``maybe_finish_resize`` — the
+        docs/compile.md ordering contract). Returns False when a resize
+        is already pending."""
+        if self._pending_resize is not None:
+            return False
+        ready = threading.Event()
+        t0 = time.perf_counter()
+
+        def _warm() -> None:
+            try:
+                self._warm_targets(n_replicas)
+            except Exception as e:  # warm pool is an optimization only
+                logger.warning("background resize precompile failed "
+                               "(%s: %s) — the drain will compile cold",
+                               type(e).__name__, str(e)[:200])
+            finally:
+                ready.set()
+
+        thread = threading.Thread(target=_warm, daemon=True,
+                                  name="serve-resize-precompile")
+        self._pending_resize = {"n": int(n_replicas), "split": split,
+                                "ready": ready, "t0": t0}
+        thread.start()
+        return True
+
+    def maybe_finish_resize(self, now: float = 0.0) -> Optional[int]:
+        """Complete a pending :meth:`request_resize` once its background
+        precompile finished; None while it is still compiling (serving
+        keeps stepping) or when nothing is pending."""
+        p = self._pending_resize
+        if p is None or not p["ready"].is_set():
+            return None
+        self._pending_resize = None
+        bg_ms = (time.perf_counter() - p["t0"]) * 1e3
+        return self.resize(p["n"], now, split=p["split"], warm=False,
+                           _bg_precompile_ms=bg_ms)
+
     def resize(self, n_replicas: int, now: float = 0.0, *,
-               split: Optional[Tuple[int, int]] = None) -> int:
+               split: Optional[Tuple[int, int]] = None,
+               warm: bool = True,
+               _bg_precompile_ms: Optional[float] = None) -> int:
         """Drain every engine and rebuild over ``n_replicas`` groups.
 
         In-flight requests fold generated progress into their prompts and
@@ -451,7 +569,14 @@ class ReplicaSet:
         proceeds when EITHER the count or the split changes); in-flight
         KV migrations and undelivered handoffs requeue their requests
         (the payload is dropped — the new partition replays those
-        prefills). Returns how many requests were migrated."""
+        prefills). Returns how many requests were migrated.
+
+        ``warm=True`` (default) precompiles the target geometry's step
+        executables BEFORE the drain starts, so the measured stall
+        (``serve.resize_stall_ms``: drain start → new engines ready)
+        contains no XLA compile; ``warm=False`` is the cold-rebuild
+        baseline (or the :meth:`request_resize` completion path, which
+        already warmed in the background)."""
         if split is not None:
             split = (int(split[0]), int(split[1]))
             if self._disagg is None:
@@ -465,6 +590,20 @@ class ReplicaSet:
         if n_replicas == self.n_replicas and \
                 (split is None or split == self._disagg):
             return 0
+        precompile_ms = _bg_precompile_ms or 0.0
+        if warm and _bg_precompile_ms is None:
+            # Warm BEFORE the drain: nothing has stopped serving yet
+            # while the target executables compile (or load from the
+            # persistent cache).
+            t_warm = time.perf_counter()
+            try:
+                self._warm_targets(n_replicas)
+            except Exception as e:  # warm pool is an optimization only
+                logger.warning("resize precompile failed (%s: %s) — "
+                               "rebuilding cold", type(e).__name__,
+                               str(e)[:200])
+            precompile_ms = (time.perf_counter() - t_warm) * 1e3
+        t_stall = time.perf_counter()
         tl = basics._state.timeline if basics.is_initialized() else None
         migrated: List[Request] = []
         for eng in self.engines:
@@ -485,11 +624,19 @@ class ReplicaSet:
         old_split = self._disagg
         if split is not None:
             self._disagg = split
+        self._reused_engines = 0
         self._build(n_replicas)
+        stall_ms = (time.perf_counter() - t_stall) * 1e3
+        self._post_resize_t0 = time.perf_counter()
         self.resize_events.append({
             "time": now, "from": old, "to": n_replicas,
             "from_split": old_split, "to_split": self._disagg,
-            "migrated": len(migrated), "in_flight": in_flight})
+            "migrated": len(migrated), "in_flight": in_flight,
+            "resize_stall_ms": round(stall_ms, 3),
+            "precompile_ms": round(precompile_ms, 3),
+            "warm": bool(warm), "background": _bg_precompile_ms is not None,
+            "reused_engines": self._reused_engines})
+        _metrics.gauge("serve.resize_stall_ms").set(stall_ms)
         _metrics.counter("serve.resizes").inc()
         _metrics.counter("serve.migrated_requests").inc(len(migrated))
         _metrics.gauge("serve.replicas").set(n_replicas)

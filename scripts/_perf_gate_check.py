@@ -628,6 +628,9 @@ def _main():
     if leg == "soak":
         return _soak_leg(rec)
 
+    if leg == "compile":
+        return _compile_leg(rec)
+
     # Training legs: best same-platform value for this metric across the
     # recorded trajectory; an empty trajectory falls back to the
     # committed (self-seeding) train baseline instead of passing.
@@ -675,6 +678,53 @@ def _train_baseline_gate(rec, leg, tol, update):
     print(f"perf gate [{leg}]: empty trajectory — baseline anchor "
           f"{os.path.basename(TRAIN_BASELINE)}[{key}]")
     return 0 if gate(value, entry["value"], tol, metric) else 1
+
+
+def _compile_leg(rec):
+    """Compile-once gate (docs/compile.md) over the
+    scripts/compile_smoke.sh report: the warm rerun must pay ZERO
+    compiles, its time-to-first-step must sit at least
+    PERF_GATE_COMPILE_TTFS (default 0.30) below the cold run's, and the
+    background-precompiled elastic resize must stall strictly under the
+    cold-rebuild baseline measured in the same serve leg."""
+    ttfs_cut = float(os.environ.get("PERF_GATE_COMPILE_TTFS", "0.30"))
+    ok = True
+    warm_compiles = rec.get("warm_compile_count")
+    within = warm_compiles == 0
+    print(f"perf gate [compile]: warm rerun compiled "
+          f"{warm_compiles} executable(s) (cache "
+          f"{rec.get('warm_compile_cache')}) -> "
+          f"{'OK' if within else 'REGRESSION'}")
+    record_verdict("compile", "warm_compile_count",
+                   -1 if warm_compiles is None else warm_compiles, 0,
+                   ttfs_cut, within)
+    ok &= within
+    reduction = rec.get("ttfs_reduction")
+    within = reduction is not None and reduction >= ttfs_cut
+    print(f"perf gate [compile]: warm TTFS {rec.get('ttfs_warm_ms')} ms "
+          f"vs cold {rec.get('ttfs_cold_ms')} ms (reduction {reduction} "
+          f"vs floor {ttfs_cut}) -> {'OK' if within else 'REGRESSION'}")
+    record_verdict("compile", "ttfs_reduction",
+                   -1.0 if reduction is None else reduction, ttfs_cut,
+                   ttfs_cut, within)
+    ok &= within
+    bg = rec.get("resize_stall_ms_bg")
+    cold = rec.get("resize_stall_ms_cold")
+    if bg is None or cold is None:
+        print("perf gate [compile]: report lacks the resize stall pair "
+              "— the serve leg did not run — hard fail")
+        record_verdict("compile", "resize_stall_present", 0, 1, ttfs_cut,
+                       False)
+        ok = False
+    else:
+        within = bg < cold
+        print(f"perf gate [compile]: resize stall background "
+              f"{bg} ms vs cold rebuild {cold} ms -> "
+              f"{'OK' if within else 'REGRESSION'}")
+        record_verdict("compile", "resize_stall_ms_bg", bg, cold,
+                       ttfs_cut, within)
+        ok &= within
+    return 0 if ok else 1
 
 
 def _soak_leg(rec):

@@ -46,6 +46,12 @@
 #   PERF_GATE_LEGS="soak" scripts/perf_gate.sh  # self-healing soak:
 #                     the smoke gauntlet (preempt + flap + resize) must
 #                     pass every soak-report gate (docs/robustness.md)
+#   PERF_GATE_LEGS="compile" scripts/perf_gate.sh # compile-once
+#                     runtime: warm rerun against the populated
+#                     executable cache must pay ZERO compiles with TTFS
+#                     >= PERF_GATE_COMPILE_TTFS (default 0.30) below
+#                     cold, and the background-precompiled resize must
+#                     stall under the cold rebuild (docs/compile.md)
 #   PERF_GATE_UPDATE=1 scripts/perf_gate.sh   # re-seed baselines
 #
 # The zero<stage> legs gate the --zero-stage A/B STRUCTURALLY against
@@ -209,8 +215,27 @@ for leg in $LEGS; do
                 FAIL=1
             fi
             ;;
+        compile)
+            # Compile-once gate (docs/compile.md): the smoke runs the
+            # cold/warm A/B + the serve resize leg and writes a report;
+            # the checker hard-gates zero warm compiles, the TTFS cut,
+            # and background-precompiled stall < cold rebuild.
+            echo "== perf gate: compile leg ==" >&2
+            COMPILE_REPORT="${TMPDIR:-/tmp}/perf_gate_compile_report.json"
+            rm -f "$COMPILE_REPORT"
+            scripts/compile_smoke.sh --report "$COMPILE_REPORT" >&2 || FAIL=1
+            if [ -f "$COMPILE_REPORT" ]; then
+                PERF_GATE_LEG=compile PERF_GATE_TOL="$TOL" \
+                    PERF_GATE_UPDATE="$UPDATE" \
+                    python scripts/_perf_gate_check.py \
+                    "$(cat "$COMPILE_REPORT")" || FAIL=1
+            else
+                echo "perf gate [compile]: no compile report written" >&2
+                FAIL=1
+            fi
+            ;;
         *)
-            echo "unknown gate leg: $leg (serve|serve_disagg|train|zero{1,2,3}|plan|fused|cost|pp|pp4d|moe|soak)" >&2
+            echo "unknown gate leg: $leg (serve|serve_disagg|train|zero{1,2,3}|plan|fused|cost|pp|pp4d|moe|soak|compile)" >&2
             exit 2
             ;;
     esac

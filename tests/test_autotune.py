@@ -244,7 +244,9 @@ class TestPlanSchemaV5:
         with open(path) as f:
             header = f.readline().strip().split(",")
         assert header == list(pm_mod.CSV_FIELDS)
-        assert header[-1] == "plan"
+        # v12 appends the compile pair after the v5 plan column
+        # (docs/compile.md); plan stays the last knob-derived column.
+        assert header[-3:] == ["plan", "compile_ms", "compile_cache_hit"]
         rows = read_log(path)
         for row, (p, _) in zip(rows, pm.history):
             assert row["plan"] == encode_tuned(p)
@@ -292,10 +294,11 @@ class TestPlanSchemaV5:
         TestSession._reset_kernel_cache()
         key = cache_key_for("v9-schema-probe")
         assert key.endswith(f"|v{at_driver._CACHE_VERSION}")
-        # v11: pp_schedule joins TunedParams (docs/pipeline.md); v10
-        # added the serve pair (docs/serving.md); v9 the MoE pair;
+        # v12: the per-trial compile pair joins the CSV
+        # (docs/compile.md); v11 added pp_schedule (docs/pipeline.md);
+        # v10 the serve pair (docs/serving.md); v9 the MoE pair;
         # v8 the pipeline pair; v7 the geometry-fingerprinted key.
-        assert key.endswith("|v11")
+        assert key.endswith("|v12")
         winner = TunedParams(fusion_threshold_bytes=8 * MIB,
                              zero_stage=2, overlap=True,
                              num_comm_streams=2)
@@ -506,7 +509,7 @@ class TestCacheSchemaV7:
         key = cache_key_for("geo-probe")
         geo = basics.mesh_geometry()
         assert f"|{geo}|" in key
-        assert key.endswith("|v11")
+        assert key.endswith("|v12")
 
     def test_load_tolerant_of_v6_entry(self, tmp_path, monkeypatch):
         from horovod_tpu.ops import kernel_autotune

@@ -482,10 +482,10 @@ def test_cache_schema_v4_tolerant_from_dict():
     from horovod_tpu.autotune import TunedParams
     from horovod_tpu.autotune import driver as at_driver
 
-    # v11 = the zero-bubble pipeline schema (docs/pipeline.md); the
+    # v12 = the compile-ahead autotune schema (docs/compile.md); the
     # tolerant-read contract below is version-independent.
-    assert at_driver._CACHE_VERSION == 11
-    assert "v11" in at_driver.cache_key_for("x")
+    assert at_driver._CACHE_VERSION == 12
+    assert "v12" in at_driver.cache_key_for("x")
     # v1/v2-era dicts (no overlap keys) stay readable with defaults
     old = {"fusion_threshold_bytes": 1 << 22, "quant_block": 128,
            "hierarchical_allreduce": True}
